@@ -1,0 +1,36 @@
+"""daft_tpu: a TPU-native distributed dataframe / query engine.
+
+A ground-up redesign of the capabilities of the reference engine (Daft) for TPU
+hardware: lazy DataFrame + SQL over an Arrow-backed columnar core, with the hot
+execution path compiled to jax.jit/XLA kernels on HBM-resident device arrays, and
+partition parallelism mapped onto a jax.sharding Mesh (shuffles = all_to_all over ICI).
+"""
+
+from .datatypes import DataType, TypeKind
+from .schema import Field, Schema
+from .series import Series
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataType",
+    "TypeKind",
+    "Field",
+    "Schema",
+    "Series",
+]
+
+
+def _late_imports():
+    """Populate the public API lazily to avoid import cycles during bootstrap."""
+
+
+# The full public API (DataFrame, col, lit, udf, read_*, sql, context) is appended to
+# this module by daft_tpu.api once those layers exist; see api.py.
+try:
+    from .api import *  # noqa: F401,F403
+    from .api import __all__ as _api_all
+
+    __all__ += list(_api_all)
+except ImportError:  # during early bootstrap some layers may not exist yet
+    pass

@@ -1,0 +1,6 @@
+"""Kernel layer: host (Arrow C++ / numpy) kernels and device (jax/XLA/pallas) kernels.
+
+The host kernels mirror the reference's Rust kernel set under
+`src/daft-core/src/array/ops/` and `src/daft-core/src/kernels/`; the device kernels are
+the TPU-native path used by the device executor (jit-fused columnar compute).
+"""

@@ -1,0 +1,51 @@
+"""Test bootstrap: force jax onto a virtual 8-device CPU mesh BEFORE jax imports.
+
+Mirrors the reference's runner-matrix CI trick (SURVEY.md §4): the same suite runs on a
+single-device and a multi-device mesh; TPU hardware is not required for tests.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(params=[1, 4])
+def num_partitions(request):
+    return request.param
+
+
+@pytest.fixture(params=["arrow", "parquet"])
+def data_source(request):
+    """Like the reference's make_df fixture: in-memory arrow vs parquet tmp files."""
+    return request.param
+
+
+@pytest.fixture
+def make_df(data_source, tmp_path):
+    import daft_tpu
+
+    def _make(data: dict, repartition: int = 1):
+        if data_source == "arrow":
+            df = daft_tpu.from_pydict(data)
+        else:
+            import pyarrow as pa
+            import pyarrow.parquet as papq
+
+            p = str(tmp_path / "make_df.parquet")
+            papq.write_table(pa.table(data), p)
+            df = daft_tpu.read_parquet(p)
+        if repartition != 1:
+            df = df.repartition(repartition)
+        return df
+
+    return _make

@@ -1,0 +1,205 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from daft_tpu import DataType, Series
+
+
+def test_from_pylist_infer():
+    s = Series.from_pylist([1, 2, None, 4], "a")
+    assert s.datatype() == DataType.int64()
+    assert s.to_pylist() == [1, 2, None, 4]
+    assert s.null_count() == 1
+
+
+def test_from_pylist_float_promotion():
+    s = Series.from_pylist([1, 2.5, None], "a")
+    assert s.datatype() == DataType.float64()
+
+
+def test_from_pylist_strings():
+    s = Series.from_pylist(["a", "b", None], "s")
+    assert s.datatype() == DataType.string()
+    assert s.to_pylist() == ["a", "b", None]
+
+
+def test_python_fallback():
+    class Thing:
+        pass
+
+    t = Thing()
+    s = Series.from_pylist([t, None], "obj")
+    assert s.datatype() == DataType.python()
+    assert s.to_pylist()[0] is t
+
+
+def test_arithmetic_with_nulls():
+    a = Series.from_pylist([1, 2, None], "a")
+    b = Series.from_pylist([10, None, 30], "b")
+    assert (a + b).to_pylist() == [11, None, None]
+    assert (a - b).to_pylist() == [-9, None, None]
+    assert (a * b).to_pylist() == [10, None, None]
+
+
+def test_division_returns_float():
+    a = Series.from_pylist([1, 7], "a")
+    b = Series.from_pylist([2, 2], "b")
+    out = a / b
+    assert out.datatype() == DataType.float64()
+    assert out.to_pylist() == [0.5, 3.5]
+
+
+def test_floordiv_and_mod_python_semantics():
+    a = Series.from_pylist([7, -7, 7, -7], "a")
+    b = Series.from_pylist([2, 2, -2, -2], "b")
+    assert (a // b).to_pylist() == [3, -4, -4, 3]
+    assert (a % b).to_pylist() == [1, 1, -1, -1]
+
+
+def test_comparison():
+    a = Series.from_pylist([1, 2, 3, None], "a")
+    assert (a > 2).to_pylist() == [False, False, True, None]
+    assert (a == 2).to_pylist() == [False, True, False, None]
+
+
+def test_cross_type_comparison():
+    a = Series.from_pylist([1, 2], "a")
+    b = Series.from_pylist([1.5, 1.5], "b")
+    assert (a < b).to_pylist() == [True, False]
+
+
+def test_logical_kleene():
+    a = Series.from_pylist([True, False, None], "a")
+    b = Series.from_pylist([True, True, True], "b")
+    assert (a & b).to_pylist() == [True, False, None]
+    assert (a | b).to_pylist() == [True, True, True]
+
+
+def test_broadcast_scalar():
+    a = Series.from_pylist([1, 2, 3], "a")
+    assert (a + 10).to_pylist() == [11, 12, 13]
+
+
+def test_cast():
+    a = Series.from_pylist([1, 2, None], "a")
+    f = a.cast(DataType.float32())
+    assert f.datatype() == DataType.float32()
+    s = a.cast(DataType.string())
+    assert s.to_pylist() == ["1", "2", None]
+
+
+def test_filter_take_slice():
+    a = Series.from_pylist([10, 20, 30, 40], "a")
+    m = Series.from_pylist([True, False, True, None], "m")
+    assert a.filter(m).to_pylist() == [10, 30]
+    idx = Series.from_pylist([3, 0], "i")
+    assert a.take(idx).to_pylist() == [40, 10]
+    assert a.slice(1, 3).to_pylist() == [20, 30]
+
+
+def test_sort_with_nulls():
+    a = Series.from_pylist([3, None, 1, 2], "a")
+    assert a.sort().to_pylist() == [1, 2, 3, None]
+    assert a.sort(descending=True).to_pylist() == [None, 3, 2, 1]
+
+
+def test_concat():
+    a = Series.from_pylist([1, 2], "a")
+    b = Series.from_pylist([3.5], "b")
+    out = Series.concat([a, b])
+    assert out.datatype() == DataType.float64()
+    assert out.to_pylist() == [1.0, 2.0, 3.5]
+
+
+def test_hash_deterministic_and_distinct():
+    a = Series.from_pylist([1, 2, 1, None], "a")
+    h1 = a.hash().to_pylist()
+    h2 = a.hash().to_pylist()
+    assert h1 == h2
+    assert h1[0] == h1[2]
+    assert h1[0] != h1[1]
+    assert h1[3] is not None  # nulls hash to a fixed value
+
+
+def test_hash_strings():
+    s = Series.from_pylist(["foo", "bar", "foo", "", None], "s")
+    h = s.hash().to_pylist()
+    assert h[0] == h[2]
+    assert h[0] != h[1]
+    assert h[3] is not None and h[3] != h[0]
+
+
+def test_hash_seed_combination():
+    a = Series.from_pylist([1, 1], "a")
+    seed = Series.from_pylist([0, 1], "s").cast(DataType.uint64())
+    h = a.hash(seed=seed).to_pylist()
+    assert h[0] != h[1]
+
+
+def test_if_else():
+    c = Series.from_pylist([True, False, None], "c")
+    t = Series.from_pylist([1, 2, 3], "t")
+    f = Series.from_pylist([10, 20, 30], "f")
+    assert c.if_else(t, f).to_pylist() == [1, 20, None]
+
+
+def test_is_in():
+    a = Series.from_pylist([1, 2, 3, None], "a")
+    items = Series.from_pylist([1, 3], "items")
+    assert a.is_in(items).to_pylist() == [True, False, True, None]
+
+
+def test_fill_null():
+    a = Series.from_pylist([1, None, 3], "a")
+    assert a.fill_null(Series.from_pylist([0], "z")).to_pylist() == [1, 0, 3]
+
+
+def test_aggregations():
+    a = Series.from_pylist([1, 2, 3, None], "a")
+    assert a.sum().to_pylist() == [6]
+    assert a.mean().to_pylist() == [2.0]
+    assert a.min().to_pylist() == [1]
+    assert a.max().to_pylist() == [3]
+    assert a.count().to_pylist() == [3]
+    assert a.count("all").to_pylist() == [4]
+    assert a.agg_list().to_pylist() == [[1, 2, 3, None]]
+
+
+def test_sum_dtype_promotion():
+    a = Series.from_pylist([1, 2], "a").cast(DataType.int8())
+    assert a.sum().datatype() == DataType.int64()
+    u = a.cast(DataType.uint8())
+    assert u.sum().datatype() == DataType.uint64()
+
+
+def test_float_ops():
+    a = Series.from_pylist([1.0, float("nan"), None], "a")
+    assert a.float_is_nan().to_pylist() == [False, True, None]
+    filled = a.float_fill_nan(Series.from_pylist([0.0], "z"))
+    assert filled.to_pylist()[:2] == [1.0, 0.0]
+
+
+def test_numeric_unary():
+    a = Series.from_pylist([4.0, 9.0], "a")
+    assert a.sqrt().to_pylist() == [2.0, 3.0]
+    assert Series.from_pylist([-1, 2], "b").abs().to_pylist() == [1, 2]
+
+
+def test_tensor_series_roundtrip():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 2, 2)
+    s = Series.from_numpy(arr, "t")
+    assert s.datatype() == DataType.tensor(DataType.float32(), (2, 2))
+    np.testing.assert_array_equal(s.to_numpy(), arr)
+
+
+def test_murmur3_iceberg_reference_values():
+    # Spec test vectors from the Iceberg spec (bucket transform hashes)
+    s = Series.from_pylist([34], "i")
+    assert s.murmur3_32().to_pylist() == [2017239379]
+    st = Series.from_pylist(["iceberg"], "s")
+    assert st.murmur3_32().to_pylist() == [1210000089]
+
+
+def test_between():
+    a = Series.from_pylist([1, 5, 10], "a")
+    assert a.between(2, 9).to_pylist() == [False, True, False]
