@@ -21,16 +21,12 @@ __all__ = [
 ]
 
 
-def _late_imports():
-    """Populate the public API lazily to avoid import cycles during bootstrap."""
-
-
-# The full public API (DataFrame, col, lit, udf, read_*, sql, context) is appended to
-# this module by daft_tpu.api once those layers exist; see api.py.
+# The full public API (DataFrame, col, lit, udf, read_*, sql, context) lives in api.py.
 try:
     from .api import *  # noqa: F401,F403
     from .api import __all__ as _api_all
 
     __all__ += list(_api_all)
-except ImportError:  # during early bootstrap some layers may not exist yet
-    pass
+except ModuleNotFoundError as _e:  # only tolerate api.py itself being absent (bootstrap)
+    if _e.name != f"{__name__}.api":
+        raise

@@ -204,7 +204,7 @@ class DataType:
 
     @staticmethod
     def struct(fields: dict) -> "DataType":
-        return DataType(TypeKind.STRUCT, tuple(sorted(fields.items(), key=lambda kv: ())) if False else tuple(fields.items()))
+        return DataType(TypeKind.STRUCT, tuple(fields.items()))
 
     @staticmethod
     def map(key: "DataType", value: "DataType") -> "DataType":
@@ -656,8 +656,11 @@ def _numeric_supertype(a: DataType, b: DataType) -> DataType:
         wide = max(aw, bw)
         kinds = _SIGNED_INTS if a.is_signed_integer() else _UNSIGNED_INTS
         return DataType(kinds[{8: 0, 16: 1, 32: 2, 64: 3}[wide]])
-    # mixed signedness: need a signed type wider than the unsigned one
+    # mixed signedness: need a signed type wider than the unsigned one; signed+uint64
+    # has no such integer, so follow numpy (and the reference's supertype.rs): float64
     uw = aw if a.is_unsigned_integer() else bw
     sw = aw if a.is_signed_integer() else bw
-    target = max(sw, min(uw * 2, 64))
+    if uw >= 64:
+        return DataType.float64()
+    target = max(sw, uw * 2)
     return DataType(_SIGNED_INTS[{8: 0, 16: 1, 32: 2, 64: 3}[target]])

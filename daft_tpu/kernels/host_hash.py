@@ -56,14 +56,13 @@ def hash_array(arr: pa.Array, seed: np.ndarray | int | None = None) -> np.ndarra
     if pa.types.is_boolean(t):
         vals = arr.cast(pa.uint8())
         return _hash_fixed(vals, seeds)
+    if pa.types.is_decimal(t):
+        return _hash_decimal128(arr, seeds)
     if (
         pa.types.is_integer(t) or pa.types.is_floating(t)
         or pa.types.is_date(t) or pa.types.is_timestamp(t)
         or pa.types.is_time(t) or pa.types.is_duration(t)
-        or pa.types.is_decimal(t)
     ):
-        if pa.types.is_decimal(t):
-            arr = arr.cast(pa.float64())
         return _hash_fixed(arr, seeds)
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         arr = arr.cast(pa.large_binary())
@@ -74,9 +73,18 @@ def hash_array(arr: pa.Array, seed: np.ndarray | int | None = None) -> np.ndarra
         arr = arr.cast(pa.large_binary())
         return _hash_varlen(arr, seeds)
     if pa.types.is_list(t) or pa.types.is_large_list(t) or pa.types.is_fixed_size_list(t):
-        flat = arr.flatten()
-        inner = hash_array(flat)
-        return _hash_segments(arr, inner, seeds, n)
+        # NB: use .values (keeps slots behind null rows), never .flatten() (drops them,
+        # which would desync offsets for every row after a null).
+        if pa.types.is_fixed_size_list(t):
+            size = t.list_size
+            offs = (np.arange(n + 1, dtype=np.int64) + arr.offset) * size
+            child = arr.values
+        else:
+            offs = np.asarray(arr.offsets).astype(np.int64)
+            child = arr.values
+        lo, hi = int(offs[0]), int(offs[-1])
+        inner = hash_array(child.slice(lo, hi - lo)) if hi > lo else np.empty(0, np.uint64)
+        return _hash_segments_from_offsets(arr, offs - lo, inner, seeds, n)
     if pa.types.is_struct(t):
         h = seeds
         for i in range(t.num_fields):
@@ -180,15 +188,30 @@ def _segment_sums(terms: np.ndarray, starts: np.ndarray, lengths: np.ndarray, n:
     return sums
 
 
-def _hash_segments(arr: pa.Array, inner_hashes: np.ndarray, seeds: np.ndarray, n: int) -> np.ndarray:
+def _hash_decimal128(arr: pa.Array, seeds: np.ndarray) -> np.ndarray:
+    """Hash decimals exactly from their little-endian two's-complement representation
+    (the reference hashes decimals by value, not via a lossy float cast). Narrow
+    decimals are widened to decimal128 so equal values hash equally across widths;
+    decimal256 folds its four uint64 lanes."""
     t = arr.type
-    if pa.types.is_fixed_size_list(t):
-        size = t.list_size
-        offs = np.arange(n + 1, dtype=np.int64) * size
-    else:
-        arr2 = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
-        offs = np.asarray(arr2.offsets).astype(np.int64)
-        offs = offs - offs[0]
+    if t.byte_width < 16:
+        arr = arr.cast(pa.decimal128(t.precision, t.scale))
+        t = arr.type
+    filled = pc.fill_null(arr, pa.scalar(0, t).cast(t)) if arr.null_count else arr
+    n = len(filled)
+    lanes_per = t.byte_width // 8
+    lanes = np.frombuffer(filled.buffers()[1], dtype=np.uint64, count=lanes_per * (n + filled.offset))
+    lanes = lanes[lanes_per * filled.offset:]
+    with np.errstate(over="ignore"):
+        h = seeds
+        for i in range(lanes_per - 1, -1, -1):
+            h = _splitmix64(lanes[i::lanes_per] ^ h)
+    return _apply_null_mask(arr, h, seeds)
+
+
+def _hash_segments_from_offsets(
+    arr: pa.Array, offs: np.ndarray, inner_hashes: np.ndarray, seeds: np.ndarray, n: int
+) -> np.ndarray:
     lengths = offs[1:] - offs[:-1]
     if len(inner_hashes):
         pos = np.arange(len(inner_hashes), dtype=np.int64) - np.repeat(offs[:-1], lengths)

@@ -41,7 +41,9 @@ class Series:
         if dtype is None:
             dtype = inferred
         else:
-            target = dtype.to_physical().to_arrow() if not dtype.is_python() else None
+            # Canonical storage for every logical dtype is dtype.to_arrow() — temporal
+            # logical types keep real arrow temporal storage in all construction paths.
+            target = dtype.to_arrow() if not dtype.is_python() else None
             if target is not None and arr.type != target:
                 arr = arr.cast(target)
         if dtype.is_string() and not pa.types.is_large_string(arr.type):
@@ -103,13 +105,13 @@ class Series:
     def empty(name: str, dtype: DataType) -> "Series":
         if dtype.is_python():
             return Series(name, dtype, None, np.empty(0, dtype=object))
-        return Series(name, dtype, pa.array([], type=dtype.to_physical().to_arrow()))
+        return Series(name, dtype, pa.array([], type=dtype.to_arrow()))
 
     @staticmethod
     def full_null(name: str, dtype: DataType, length: int) -> "Series":
         if dtype.is_python():
             return Series(name, dtype, None, np.full(length, None, dtype=object))
-        return Series(name, dtype, pa.nulls(length, type=dtype.to_physical().to_arrow()))
+        return Series(name, dtype, pa.nulls(length, type=dtype.to_arrow()))
 
     # ------------------------------------------------------------------ basics
     @property
@@ -149,8 +151,22 @@ class Series:
         if self._arrow is None:
             return self._pyobjs
         if self._dtype.kind in (TypeKind.FIXED_SHAPE_TENSOR, TypeKind.EMBEDDING, TypeKind.FIXED_SHAPE_IMAGE):
-            flat = np.asarray(self._arrow.flatten())
-            return flat.reshape((len(self),) + _static_shape(self._dtype))
+            arr = self._arrow
+            shape = _static_shape(self._dtype)
+            size = int(np.prod(shape)) if shape else 1
+            # .values keeps slots behind null rows (flatten() would drop them)
+            child = arr.values.slice(arr.offset * size, len(arr) * size)
+            if child.null_count:
+                fill = np.nan if pa.types.is_floating(child.type) else 0
+                child = pc.fill_null(child, fill)
+            flat = np.asarray(child).reshape((len(self),) + shape)
+            if arr.null_count:
+                out = np.empty(len(self), dtype=object)
+                valid = np.asarray(pc.is_valid(arr))
+                for i in range(len(self)):
+                    out[i] = flat[i] if valid[i] else None
+                return out
+            return flat
         try:
             return self._arrow.to_numpy(zero_copy_only=False)
         except pa.ArrowInvalid:
@@ -182,7 +198,7 @@ class Series:
             return Series(self._name, dtype, None, objs)
         if self.is_python():
             return Series.from_pylist(self.to_pylist(), self._name, dtype)
-        target = dtype.to_physical().to_arrow()
+        target = dtype.to_arrow()
         src = self._arrow
         opts = pc.CastOptions(target_type=target, allow_float_truncate=True, allow_time_truncate=True)
         try:
@@ -190,12 +206,23 @@ class Series:
         except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
             if dtype.is_string():
                 out = pa.array([None if v is None else str(v) for v in src.to_pylist()], type=pa.large_string())
+            elif dtype.is_temporal() and (self._dtype.is_integer() or self._dtype.is_floating()):
+                # numeric -> temporal: interpret as epoch count in the target unit
+                phys = src.cast(dtype.to_physical().to_arrow())
+                out = phys.view(target) if phys.type.bit_width == target.bit_width else phys.cast(target)
             else:
                 raise
         return Series(self._name, dtype, out)
 
+    def _require_arrow(self, op: str) -> pa.Array:
+        if self._arrow is None:
+            raise ValueError(f"{op} is not supported for python-dtype Series (cast first)")
+        return self._arrow
+
     # ------------------------------------------------------------------ arithmetic
     def _binary_numeric(self, other: "Series", fn, name=None, force_dtype: Optional[DataType] = None) -> "Series":
+        self._require_arrow("arithmetic")
+        other._require_arrow("arithmetic")
         l, r = _broadcast(self, other)
         out = fn(l._arrow, r._arrow)
         s = Series.from_arrow(out, name or self._name)
@@ -269,7 +296,9 @@ class Series:
 
     # ------------------------------------------------------------------ comparison
     def _cmp(self, other, fn) -> "Series":
+        self._require_arrow("comparison")
         other = _as_series(other)
+        other._require_arrow("comparison")
         l, r = _broadcast(self, other)
         la, ra = l._arrow, r._arrow
         if la.type != ra.type:
@@ -418,6 +447,7 @@ class Series:
 
     # ------------------------------------------------------------------ sorting
     def argsort(self, descending: bool = False, nulls_first: Optional[bool] = None) -> "Series":
+        self._require_arrow("argsort/sort")
         order = "descending" if descending else "ascending"
         placement = "at_start" if (nulls_first if nulls_first is not None else descending) else "at_end"
         idx = pc.array_sort_indices(self._arrow, order=order, null_placement=placement)
@@ -625,6 +655,7 @@ class Series:
         return Series.from_arrow(pc.if_else(isnan, r._arrow, l._arrow), self._name, self._dtype)
 
     def shift(self, periods: int = 1) -> "Series":
+        self._require_arrow("shift")
         n = len(self)
         if periods == 0 or n == 0:
             return self
