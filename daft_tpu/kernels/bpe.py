@@ -1,0 +1,79 @@
+"""Byte-pair-encoding tokenizer for `.str.tokenize_encode/decode`.
+
+Role-equivalent to the reference's tokenize functions (src/daft-functions/src/tokenize/,
+tiktoken-style ranks). Loads a tiktoken-format ranks file from a local path
+("<base64 token> <rank>" per line); the built-in "bytes" vocabulary (each byte is its
+own token) is always available so encode/decode roundtrips work without any external
+vocabulary file (this image has no network egress to fetch published rank files).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Dict, List, Tuple
+
+_ENCODERS: Dict[str, "BpeEncoder"] = {}
+
+
+class BpeEncoder:
+    def __init__(self, ranks: Dict[bytes, int]):
+        self.ranks = ranks
+        self.decoder = {v: k for k, v in ranks.items()}
+
+    def _bpe_merge(self, piece: bytes) -> List[int]:
+        parts: List[bytes] = [piece[i:i + 1] for i in range(len(piece))]
+        while len(parts) > 1:
+            best_rank = None
+            best_i = -1
+            for i in range(len(parts) - 1):
+                pair = parts[i] + parts[i + 1]
+                r = self.ranks.get(pair)
+                if r is not None and (best_rank is None or r < best_rank):
+                    best_rank, best_i = r, i
+            if best_i < 0:
+                break
+            parts = parts[:best_i] + [parts[best_i] + parts[best_i + 1]] + parts[best_i + 2:]
+        return [self.ranks[p] for p in parts]
+
+    def encode(self, text: str) -> List[int]:
+        return self._bpe_merge(text.encode("utf-8"))
+
+    def decode(self, tokens: List[int]) -> str:
+        return b"".join(self.decoder[t] for t in tokens).decode("utf-8", errors="replace")
+
+
+def _bytes_encoder() -> BpeEncoder:
+    return BpeEncoder({bytes([i]): i for i in range(256)})
+
+
+def load_tiktoken_ranks(path: str) -> BpeEncoder:
+    ranks: Dict[bytes, int] = {}
+    with open(path, "rb") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            tok_b64, rank = line.split()
+            ranks[base64.b64decode(tok_b64)] = int(rank)
+    return BpeEncoder(ranks)
+
+
+#: names that resolve to the built-in byte-level vocabulary
+BUILTIN_VOCABS = ("bytes",)
+
+
+def get_encoder(name_or_path: str) -> BpeEncoder:
+    if name_or_path not in _ENCODERS:
+        import os
+
+        if name_or_path in BUILTIN_VOCABS:
+            _ENCODERS[name_or_path] = _bytes_encoder()
+        elif os.path.exists(name_or_path):
+            _ENCODERS[name_or_path] = load_tiktoken_ranks(name_or_path)
+        else:
+            raise FileNotFoundError(
+                f"tokenizer vocabulary {name_or_path!r} not found: pass a local "
+                f"tiktoken-format ranks file path, or one of the builtins {BUILTIN_VOCABS} "
+                f"(published rank files cannot be fetched in this environment)"
+            )
+    return _ENCODERS[name_or_path]

@@ -1,0 +1,183 @@
+"""Mergeable approximate sketches: HyperLogLog, MinHash, and a quantile sketch.
+
+Role-equivalent to the reference's src/hyperloglog/src/lib.rs, src/daft-minhash/ and
+src/daft-sketch/ — required so approx_count_distinct / approx_percentiles decompose
+into stage-1 (per-partition sketch) + shuffle + stage-2 (sketch merge) like every
+other distributed aggregation. Implementations are vectorized numpy; the fixed-size
+register arrays are device-friendly (a future pallas path can merge them with
+elementwise max on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from .host_hash import hash_array
+
+# ---------------------------------------------------------------------------
+# HyperLogLog (dense, p=14 like the reference's NUM_REGISTERS=16384)
+# ---------------------------------------------------------------------------
+
+HLL_P = 14
+HLL_M = 1 << HLL_P  # 16384 registers
+
+
+class HllSketch:
+    """Dense HyperLogLog over 64-bit hashes. Mergeable via elementwise max."""
+
+    __slots__ = ("registers",)
+
+    def __init__(self, registers: Optional[np.ndarray] = None):
+        self.registers = (
+            np.zeros(HLL_M, dtype=np.uint8) if registers is None else registers
+        )
+
+    def add_hashes(self, hashes: np.ndarray) -> "HllSketch":
+        if len(hashes) == 0:
+            return self
+        h = hashes.astype(np.uint64, copy=False)
+        idx = (h >> np.uint64(64 - HLL_P)).astype(np.int64)
+        with np.errstate(over="ignore"):
+            rest = (h << np.uint64(HLL_P)) | np.uint64((1 << HLL_P) - 1)
+        # rank = leading zeros of remaining bits + 1; vectorized clz via binary reduction
+        v = rest.copy()
+        cnt = np.zeros(len(h), dtype=np.uint8)
+        for sbits in (32, 16, 8, 4, 2, 1):
+            s = np.uint64(sbits)
+            mask = (v >> np.uint64(64 - sbits)) == 0
+            cnt = np.where(mask, cnt + np.uint8(sbits), cnt)
+            v = np.where(mask, v << s, v)
+        rank = (cnt + 1).astype(np.uint8)
+        np.maximum.at(self.registers, idx, rank)
+        return self
+
+    def add_array(self, arr: pa.Array) -> "HllSketch":
+        if arr.null_count:
+            import pyarrow.compute as pc
+
+            arr = arr.drop_null()
+        if len(arr) == 0:
+            return self
+        return self.add_hashes(hash_array(arr))
+
+    def merge(self, other: "HllSketch") -> "HllSketch":
+        np.maximum(self.registers, other.registers, out=self.registers)
+        return self
+
+    def estimate(self) -> int:
+        m = float(HLL_M)
+        regs = self.registers.astype(np.float64)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        raw = alpha * m * m / np.sum(np.exp2(-regs))
+        zeros = int(np.count_nonzero(self.registers == 0))
+        if raw <= 2.5 * m and zeros:
+            raw = m * np.log(m / zeros)  # linear counting for small cardinalities
+        return int(round(raw))
+
+    def to_bytes(self) -> bytes:
+        return self.registers.tobytes()
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "HllSketch":
+        return HllSketch(np.frombuffer(b, dtype=np.uint8).copy())
+
+
+# ---------------------------------------------------------------------------
+# MinHash (permutation family a*x+b mod prime, like daft-minhash)
+# ---------------------------------------------------------------------------
+
+_MERSENNE = np.uint64((1 << 61) - 1)
+
+
+def _perm_params(num_hashes: int, seed: int):
+    rng = np.random.RandomState(seed)
+    a = rng.randint(1, 1 << 31, size=num_hashes).astype(np.uint64) * np.uint64(2) + np.uint64(1)
+    b = rng.randint(0, 1 << 31, size=num_hashes).astype(np.uint64)
+    return a, b
+
+
+def minhash_strings(arr: pa.Array, num_hashes: int = 64, ngram_size: int = 1, seed: int = 1) -> pa.Array:
+    """Per-row MinHash signatures of whitespace-tokenized text (word ngrams)."""
+    a, b = _perm_params(num_hashes, seed)
+    out_sigs: List[Optional[List[int]]] = []
+    for v in arr.to_pylist():
+        if v is None:
+            out_sigs.append(None)
+            continue
+        words = v.split(" ")
+        if len(words) >= ngram_size:
+            grams = [" ".join(words[i:i + ngram_size]) for i in range(len(words) - ngram_size + 1)]
+        else:
+            grams = [v]
+        gh = hash_array(pa.array(grams, type=pa.large_string())).astype(np.uint64)
+        with np.errstate(over="ignore"):
+            sig = (gh[:, None] * a[None, :] + b[None, :]) % _MERSENNE
+        out_sigs.append((sig.min(axis=0) & np.uint64(0xFFFFFFFF)).astype(np.uint32).tolist())
+    return pa.array(out_sigs, type=pa.list_(pa.uint32(), num_hashes))
+
+
+# ---------------------------------------------------------------------------
+# Quantile sketch: mergeable reservoir-of-sorted-samples (GK-lite)
+# ---------------------------------------------------------------------------
+
+class QuantileSketch:
+    """Mergeable quantile sketch: keeps a bounded uniform sample with weights.
+
+    Simpler than DDSketch but mergeable and accurate to ~1/cap quantile error,
+    which matches the approx_percentiles contract.
+    """
+
+    __slots__ = ("values", "weights", "cap", "_rng")
+
+    def __init__(self, cap: int = 4096, values=None, weights=None, seed: int = 0x5EED):
+        self.cap = cap
+        self.values = np.empty(0, dtype=np.float64) if values is None else values
+        self.weights = np.empty(0, dtype=np.float64) if weights is None else weights
+        self._rng = np.random.RandomState(seed & 0x7FFFFFFF)
+
+    def add(self, vals: np.ndarray) -> "QuantileSketch":
+        vals = np.asarray(vals, dtype=np.float64)
+        vals = vals[~np.isnan(vals)]
+        if len(vals) == 0:
+            return self
+        self.values = np.concatenate([self.values, vals])
+        self.weights = np.concatenate([self.weights, np.ones(len(vals))])
+        self._compress()
+        return self
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        self.values = np.concatenate([self.values, other.values])
+        self.weights = np.concatenate([self.weights, other.weights])
+        self._compress()
+        return self
+
+    def _compress(self) -> None:
+        if len(self.values) <= self.cap:
+            return
+        total = self.weights.sum()
+        keep = self.cap
+        idx = self._rng.choice(len(self.values), size=keep, replace=False,
+                               p=self.weights / total)
+        self.values = self.values[idx]
+        self.weights = np.full(keep, total / keep)
+
+    def quantiles(self, qs: Sequence[float]):
+        if len(self.values) == 0:
+            return [None for _ in qs]
+        order = np.argsort(self.values)
+        v = self.values[order]
+        w = self.weights[order]
+        cum = np.cumsum(w)
+        cum = (cum - w / 2.0) / w.sum()
+        return [float(np.interp(q, cum, v)) for q in qs]
+
+    def to_state(self):
+        return (self.values.tolist(), self.weights.tolist(), self.cap)
+
+    @staticmethod
+    def from_state(state) -> "QuantileSketch":
+        vals, wts, cap = state
+        return QuantileSketch(cap, np.asarray(vals, dtype=np.float64), np.asarray(wts, dtype=np.float64))

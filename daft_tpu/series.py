@@ -220,10 +220,15 @@ class Series:
         return self._arrow
 
     # ------------------------------------------------------------------ arithmetic
-    def _binary_numeric(self, other: "Series", fn, name=None, force_dtype: Optional[DataType] = None) -> "Series":
+    def _binary_numeric(self, other: "Series", fn, name=None, force_dtype: Optional[DataType] = None,
+                        unify: bool = True) -> "Series":
         self._require_arrow("arithmetic")
         other._require_arrow("arithmetic")
         l, r = _broadcast(self, other)
+        if unify and l._dtype != r._dtype and l._dtype.is_numeric() and r._dtype.is_numeric():
+            u = try_unify(l._dtype, r._dtype)
+            if u is not None:
+                l, r = l.cast(u), r.cast(u)
         out = fn(l._arrow, r._arrow)
         s = Series.from_arrow(out, name or self._name)
         if force_dtype is not None and s._dtype != force_dtype:
@@ -233,9 +238,12 @@ class Series:
     def __add__(self, other: "Series") -> "Series":
         other = _as_series(other)
         if self._dtype.is_string() or other._dtype.is_string():
+            self._require_arrow("arithmetic")
+            other._require_arrow("arithmetic")
             l, r = _broadcast(self, other)
             return Series.from_arrow(pc.binary_join_element_wise(
-                l._arrow.cast(pa.large_string()), r._arrow.cast(pa.large_string()), ""), self._name)
+                l._arrow.cast(pa.large_string()), r._arrow.cast(pa.large_string()),
+                pa.scalar("", pa.large_string())), self._name)
         return self._binary_numeric(other, pc.add_checked)
 
     def __sub__(self, other):
@@ -289,10 +297,10 @@ class Series:
         return Series.from_arrow(pc.abs_checked(self._arrow), self._name)
 
     def left_shift(self, other):
-        return self._binary_numeric(_as_series(other), pc.shift_left)
+        return self._binary_numeric(_as_series(other), pc.shift_left, unify=False)
 
     def right_shift(self, other):
-        return self._binary_numeric(_as_series(other), pc.shift_right)
+        return self._binary_numeric(_as_series(other), pc.shift_right, unify=False)
 
     # ------------------------------------------------------------------ comparison
     def _cmp(self, other, fn) -> "Series":

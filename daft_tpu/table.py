@@ -1,0 +1,739 @@
+"""Table: an eager multi-column batch (schema + equal-length Series).
+
+Role-equivalent to the reference's Table (src/daft-table/src/lib.rs) and its ops/
+directory (agg.rs, groups.rs, sort.rs, partition.rs, joins/, explode.rs, pivot.rs,
+unpivot.rs). Host kernels are pyarrow/acero + numpy; the executor routes
+device-eligible pipelines through the jax kernel layer (kernels/device.py) instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .datatypes import DataType, TypeKind, try_unify
+from .expressions import (
+    AggExpr,
+    Alias,
+    Expression,
+    ExpressionsProjection,
+    _eval_agg_on_series,
+    col,
+)
+from .kernels.host_hash import hash_table_columns
+from .schema import Field, Schema
+from .series import Series
+
+
+def _as_expressions(exprs) -> List[Expression]:
+    if isinstance(exprs, Expression):
+        return [exprs]
+    out = []
+    for e in exprs:
+        out.append(col(e) if isinstance(e, str) else e)
+    return out
+
+
+class Table:
+    __slots__ = ("schema", "_columns")
+
+    def __init__(self, schema: Schema, columns: List[Series]):
+        if len(schema) != len(columns):
+            raise ValueError(f"schema has {len(schema)} fields but got {len(columns)} columns")
+        n = len(columns[0]) if columns else 0
+        for f, c in zip(schema, columns):
+            if len(c) != n:
+                raise ValueError(f"column {f.name!r} length {len(c)} != {n}")
+        self.schema = schema
+        self._columns = columns
+
+    # ------------------------------------------------------------------ ctors
+    @staticmethod
+    def empty(schema: Optional[Schema] = None) -> "Table":
+        schema = schema or Schema.empty()
+        return Table(schema, [Series.empty(f.name, f.dtype) for f in schema])
+
+    @staticmethod
+    def from_pydict(data: Dict[str, Any]) -> "Table":
+        cols: List[Series] = []
+        for name, vals in data.items():
+            if isinstance(vals, Series):
+                cols.append(vals.rename(name))
+            elif isinstance(vals, (pa.Array, pa.ChunkedArray)):
+                cols.append(Series.from_arrow(vals, name))
+            elif isinstance(vals, np.ndarray):
+                cols.append(Series.from_numpy(vals, name))
+            else:
+                cols.append(Series.from_pylist(list(vals), name))
+        n = max((len(c) for c in cols), default=0)
+        cols = [c if len(c) == n else _broadcast_series(c, n) for c in cols]
+        schema = Schema([Field(c.name, c.dtype) for c in cols])
+        return Table(schema, cols)
+
+    @staticmethod
+    def from_arrow(tbl: Union[pa.Table, pa.RecordBatch]) -> "Table":
+        if isinstance(tbl, pa.RecordBatch):
+            tbl = pa.Table.from_batches([tbl])
+        tbl = tbl.combine_chunks()
+        cols = [Series.from_arrow(tbl.column(i), tbl.schema.names[i]) for i in range(tbl.num_columns)]
+        schema = Schema([Field(c.name, c.dtype) for c in cols])
+        return Table(schema, cols)
+
+    @staticmethod
+    def from_pylist(rows: List[dict]) -> "Table":
+        keys: List[str] = []
+        for r in rows:
+            for k in r:
+                if k not in keys:
+                    keys.append(k)
+        return Table.from_pydict({k: [r.get(k) for r in rows] for k in keys})
+
+    # ------------------------------------------------------------------ basics
+    def __len__(self) -> int:
+        return len(self._columns[0]) if self._columns else 0
+
+    @property
+    def column_names(self) -> List[str]:
+        return self.schema.field_names()
+
+    def columns(self) -> List[Series]:
+        return list(self._columns)
+
+    def get_column(self, name: str) -> Series:
+        return self._columns[self.schema.index(name)]
+
+    def num_columns(self) -> int:
+        return len(self._columns)
+
+    def size_bytes(self) -> int:
+        return sum(c.size_bytes() for c in self._columns)
+
+    def to_arrow(self) -> pa.Table:
+        arrays, fields = [], []
+        for f, c in zip(self.schema, self._columns):
+            if c.is_python():
+                raise ValueError(f"column {f.name!r} has python dtype; no arrow representation")
+            arrays.append(c.to_arrow())
+            fields.append(pa.field(f.name, c.to_arrow().type))
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+    def to_pydict(self) -> Dict[str, list]:
+        return {f.name: c.to_pylist() for f, c in zip(self.schema, self._columns)}
+
+    def to_pylist(self) -> List[dict]:
+        d = self.to_pydict()
+        names = list(d)
+        return [dict(zip(names, vals)) for vals in zip(*d.values())] if names else []
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def __repr__(self) -> str:
+        return f"Table({self.schema!r}, rows={len(self)})"
+
+    def select_columns(self, names: List[str]) -> "Table":
+        return Table(self.schema.select(names), [self.get_column(n) for n in names])
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Table":
+        return Table(self.schema.rename(mapping),
+                     [c.rename(mapping.get(c.name, c.name)) for c in self._columns])
+
+    def cast_to_schema(self, schema: Schema) -> "Table":
+        cols = []
+        for f in schema:
+            if f.name in self.schema:
+                cols.append(self.get_column(f.name).cast(f.dtype))
+            else:
+                cols.append(Series.full_null(f.name, f.dtype, len(self)))
+        return Table(schema, cols)
+
+    # ------------------------------------------------------------------ eval
+    def eval_expression_list(self, exprs: Sequence[Expression]) -> "Table":
+        exprs = _as_expressions(exprs)
+        n = len(self)
+        out: List[Series] = []
+        names: List[str] = []
+        any_agg = any(e._node.is_aggregation() for e in exprs)
+        for e in exprs:
+            s = e._node.evaluate(self)
+            out.append(s)
+            names.append(e.name())
+        if any_agg:
+            m = max((len(s) for s in out), default=0)
+        else:
+            m = n
+        out = [_broadcast_series(s, m) if len(s) != m else s for s in out]
+        schema = Schema([Field(nm, s.dtype) for nm, s in zip(names, out)])
+        return Table(schema, [s.rename(nm) for nm, s in zip(names, out)])
+
+    # ------------------------------------------------------------------ selection
+    def filter(self, predicate: Union[Expression, Sequence[Expression]]) -> "Table":
+        preds = _as_expressions(predicate)
+        mask: Optional[Series] = None
+        for p in preds:
+            s = p._node.evaluate(self)
+            if not s.dtype.is_boolean() and not s.dtype.is_null():
+                raise ValueError(f"filter predicate must be boolean, got {s.dtype}")
+            mask = s if mask is None else (mask & s)
+        if mask is None:
+            return self
+        mask = _broadcast_series(mask, len(self))
+        return Table(self.schema, [c.filter(mask) for c in self._columns])
+
+    def take(self, indices: Series) -> "Table":
+        return Table(self.schema, [c.take(indices) for c in self._columns])
+
+    def slice(self, start: int, end: int) -> "Table":
+        return Table(self.schema, [c.slice(start, end) for c in self._columns])
+
+    def head(self, n: int) -> "Table":
+        return self.slice(0, min(n, len(self)))
+
+    def sample(self, fraction: Optional[float] = None, size: Optional[int] = None,
+               with_replacement: bool = False, seed: Optional[int] = None) -> "Table":
+        if fraction is None and size is None:
+            raise ValueError("sample requires either fraction or size")
+        n = len(self)
+        k = int(round(n * fraction)) if fraction is not None else int(size)
+        rng = np.random.RandomState(seed if seed is not None else None)
+        if with_replacement:
+            idx = rng.randint(0, max(n, 1), size=k) if n else np.empty(0, np.int64)
+        else:
+            k = min(k, n)
+            idx = rng.permutation(n)[:k]
+        return self.take(Series.from_arrow(pa.array(idx.astype(np.uint64)), "idx"))
+
+    @staticmethod
+    def concat(tables: List["Table"]) -> "Table":
+        if not tables:
+            raise ValueError("concat of zero tables")
+        first = tables[0]
+        names = first.column_names
+        for t in tables[1:]:
+            if t.column_names != names:
+                raise ValueError(f"concat schema mismatch: {names} vs {t.column_names}")
+        cols = []
+        for i, name in enumerate(names):
+            cols.append(Series.concat([t._columns[i] for t in tables]))
+        schema = Schema([Field(c.name, c.dtype) for c in cols])
+        return Table(schema, cols)
+
+    # ------------------------------------------------------------------ sort
+    def argsort(self, sort_keys: Sequence[Expression], descending=None, nulls_first=None) -> Series:
+        sort_keys = _as_expressions(sort_keys)
+        k = len(sort_keys)
+        descending = _norm_flag(descending, k, False)
+        nulls_first = _norm_flag(nulls_first, k, None)
+        keys = [e._node.evaluate(self) for e in sort_keys]
+        arrs, sort_spec = [], []
+        for i, (s, d, nf) in enumerate(zip(keys, descending, nulls_first)):
+            arrs.append(_broadcast_series(s, len(self)).to_arrow())
+            placement = "at_start" if (nf if nf is not None else d) else "at_end"
+            sort_spec.append((f"k{i}", "descending" if d else "ascending", placement))
+        tbl = pa.Table.from_arrays(arrs, names=[f"k{i}" for i in range(k)])
+        idx = pc.sort_indices(tbl, sort_keys=sort_spec)
+        return Series.from_arrow(idx.cast(pa.uint64()), "indices")
+
+    def sort(self, sort_keys: Sequence[Expression], descending=None, nulls_first=None) -> "Table":
+        return self.take(self.argsort(sort_keys, descending, nulls_first))
+
+    # ------------------------------------------------------------------ hashing / partitioning
+    def hash_rows(self, exprs: Optional[Sequence[Expression]] = None, seed: int = 0) -> np.ndarray:
+        exprs = _as_expressions(exprs) if exprs is not None else [col(n) for n in self.column_names]
+        cols = []
+        for e in exprs:
+            s = e._node.evaluate(self)
+            if s.is_python():
+                s = s.cast(DataType.string())
+            cols.append(_broadcast_series(s, len(self)).to_arrow())
+        return hash_table_columns(cols, seed=seed)
+
+    def partition_by_hash(self, exprs: Sequence[Expression], num_partitions: int) -> List["Table"]:
+        if num_partitions <= 0:
+            raise ValueError("num_partitions must be positive")
+        h = self.hash_rows(exprs)
+        buckets = (h % np.uint64(num_partitions)).astype(np.int64)
+        return self._split_by_buckets(buckets, num_partitions)
+
+    def partition_by_random(self, num_partitions: int, seed: int = 0) -> List["Table"]:
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        buckets = rng.randint(0, num_partitions, size=len(self))
+        return self._split_by_buckets(buckets, num_partitions)
+
+    def partition_by_range(self, exprs: Sequence[Expression], boundaries: "Table",
+                           descending: Optional[List[bool]] = None) -> List["Table"]:
+        """Split rows by comparing sort keys against per-partition boundary rows."""
+        exprs = _as_expressions(exprs)
+        k = len(exprs)
+        descending = _norm_flag(descending, k, False)
+        nb = len(boundaries)
+        if nb == 0:
+            return [self]
+        keys = [_broadcast_series(e._node.evaluate(self), len(self)) for e in exprs]
+        ranks = _composite_rank(keys, [b for b in boundaries._columns], descending)
+        return self._split_by_buckets(ranks, nb + 1)
+
+    def partition_by_value(self, exprs: Sequence[Expression]) -> Tuple[List["Table"], "Table"]:
+        """Group rows by exact key values; returns (partitions, unique_key_table)."""
+        exprs = _as_expressions(exprs)
+        keyed = self.eval_expression_list(exprs)
+        codes, uniq = _group_codes(keyed)
+        parts = self._split_by_buckets(codes, len(uniq))
+        return parts, uniq
+
+    def _split_by_buckets(self, buckets: np.ndarray, num: int) -> List["Table"]:
+        if len(self) == 0:
+            return [self.slice(0, 0) for _ in range(num)]
+        order = np.argsort(buckets, kind="stable")
+        sorted_tbl = self.take(Series.from_arrow(pa.array(order.astype(np.uint64)), "idx"))
+        counts = np.bincount(buckets, minlength=num)
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        return [sorted_tbl.slice(int(offs[i]), int(offs[i + 1])) for i in range(num)]
+
+    # ------------------------------------------------------------------ aggregation
+    def agg(self, to_agg: Sequence[Expression], group_by: Optional[Sequence[Expression]] = None) -> "Table":
+        group_by = _as_expressions(group_by) if group_by else []
+        to_agg = _as_expressions(to_agg)
+        if not group_by:
+            return self.eval_expression_list(to_agg)
+        return self._grouped_agg(to_agg, group_by)
+
+    def _grouped_agg(self, to_agg: List[Expression], group_by: List[Expression]) -> "Table":
+        key_tbl = self.eval_expression_list(group_by)
+        n = len(self)
+        codes, uniq = _group_codes(key_tbl)
+        num_groups = len(uniq)
+
+        out_cols: List[Series] = list(uniq._columns)
+        out_fields: List[Field] = list(uniq.schema)
+
+        # Sort rows by group code once; per-group segments are then contiguous.
+        order = np.argsort(codes, kind="stable")
+        counts = np.bincount(codes, minlength=num_groups) if n else np.zeros(num_groups, np.int64)
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        order_s = Series.from_arrow(pa.array(order.astype(np.uint64)), "o")
+
+        for e in to_agg:
+            node = e._node
+            alias = e.name()
+            while isinstance(node, Alias):
+                node = node.child
+            if not isinstance(node, AggExpr):
+                raise ValueError(f"aggregation list contains non-aggregation {e!r}")
+            child_s = _broadcast_series(node.child.evaluate(self), n)
+            expected_dt = node.to_field(self.schema).dtype
+            merged = _hash_agg_fast(node, child_s, codes, num_groups)
+            if merged is None:
+                # fallback: contiguous per-group segments after a stable sort by code
+                sorted_child = child_s.take(order_s)
+                outs = []
+                for g in range(num_groups):
+                    seg = sorted_child.slice(int(offs[g]), int(offs[g + 1]))
+                    outs.append(_eval_agg_on_series(node, seg))
+                merged = Series.concat(outs) if outs else _empty_agg_series(node, child_s)
+            if merged.dtype != expected_dt:
+                merged = merged.cast(expected_dt)
+            out_cols.append(merged.rename(alias))
+            out_fields.append(Field(alias, expected_dt))
+        return Table(Schema(out_fields), out_cols)
+
+    def distinct(self, subset: Optional[Sequence[Expression]] = None) -> "Table":
+        exprs = _as_expressions(subset) if subset else [col(n) for n in self.column_names]
+        key_tbl = self.eval_expression_list(exprs)
+        codes, _uniq = _group_codes(key_tbl)
+        if len(codes) == 0:
+            return self
+        first_idx = _first_occurrence(codes)
+        return self.take(Series.from_arrow(pa.array(first_idx.astype(np.uint64)), "idx"))
+
+    # ------------------------------------------------------------------ joins
+    def hash_join(self, right: "Table", left_on: Sequence[Expression],
+                  right_on: Sequence[Expression], how: str = "inner",
+                  suffix: str = "right.") -> "Table":
+        """Hash join with SQL null semantics (null keys never match)."""
+        how_map = {
+            "inner": "inner", "left": "left outer", "right": "right outer",
+            "outer": "full outer", "semi": "left semi", "anti": "left anti",
+        }
+        if how not in how_map:
+            raise ValueError(f"unknown join type {how!r}")
+        left_on = _as_expressions(left_on)
+        right_on = _as_expressions(right_on)
+        lk = self.eval_expression_list(left_on)
+        rk = right.eval_expression_list(right_on)
+        # align key dtypes
+        lkc, rkc = [], []
+        for a, b in zip(lk._columns, rk._columns):
+            u = try_unify(a.dtype, b.dtype)
+            if u is None:
+                raise ValueError(f"cannot join on {a.dtype} vs {b.dtype}")
+            lkc.append(a.cast(u))
+            rkc.append(b.cast(u))
+
+        key_names = [f"__k{i}" for i in range(len(lkc))]
+        lt = pa.Table.from_arrays(
+            [s.to_arrow() for s in lkc] + [c.to_arrow() for c in self._columns]
+            + [pa.array(np.arange(len(self), dtype=np.int64))],
+            names=key_names + [f"__l{i}" for i in range(len(self._columns))] + ["__lidx"],
+        )
+        rt = pa.Table.from_arrays(
+            [s.to_arrow() for s in rkc] + [c.to_arrow() for c in right._columns]
+            + [pa.array(np.arange(len(right), dtype=np.int64))],
+            names=key_names + [f"__r{i}" for i in range(len(right._columns))] + ["__ridx"],
+        )
+        joined = lt.join(rt, keys=key_names, join_type=how_map[how], use_threads=True)
+        # deterministic output order: by left index then right index
+        sort_keys = [(c, "ascending", "at_end") for c in ("__lidx", "__ridx") if c in joined.column_names]
+        if sort_keys:
+            joined = joined.take(pc.sort_indices(joined, sort_keys=sort_keys))
+        joined = joined.combine_chunks()
+
+        if how in ("semi", "anti"):
+            cols = [Series.from_arrow(joined.column(f"__l{i}"), f.name, f.dtype)
+                    for i, f in enumerate(self.schema)]
+            return Table(Schema(list(self.schema)), cols)
+
+        out_cols: List[Series] = []
+        out_fields: List[Field] = []
+        left_names = set(self.column_names)
+        # join keys: single merged column named after the left key (reference merges key cols)
+        lk_names = [e.name() for e in left_on]
+        rk_names = [e.name() for e in right_on]
+        for i, kn in enumerate(key_names):
+            name = lk_names[i]
+            out_cols.append(Series.from_arrow(joined.column(kn), name))
+            out_fields.append(Field(name, out_cols[-1].dtype))
+        for i, f in enumerate(self.schema):
+            if f.name in lk_names:
+                continue
+            s = Series.from_arrow(joined.column(f"__l{i}"), f.name, f.dtype)
+            out_cols.append(s)
+            out_fields.append(Field(f.name, s.dtype))
+        for i, f in enumerate(right.schema):
+            if f.name in rk_names:
+                continue
+            name = f.name if f.name not in left_names else f"{suffix}{f.name}"
+            s = Series.from_arrow(joined.column(f"__r{i}"), name, f.dtype)
+            out_cols.append(s)
+            out_fields.append(Field(name, s.dtype))
+        return Table(Schema(out_fields), out_cols)
+
+    def sort_merge_join(self, right: "Table", left_on, right_on, how: str = "inner",
+                        suffix: str = "right.", is_sorted: bool = False) -> "Table":
+        """Join pre-sorted (or sorted here) sides; host fallback delegates to hash_join
+        after sorting, preserving the sorted output property of the reference."""
+        left_on = _as_expressions(left_on)
+        right_on = _as_expressions(right_on)
+        l = self if is_sorted else self.sort(left_on)
+        r = right if is_sorted else right.sort(right_on)
+        out = l.hash_join(r, left_on, right_on, how=how, suffix=suffix)
+        return out.sort([col(e.name()) for e in left_on])
+
+    # ------------------------------------------------------------------ reshaping
+    def explode(self, exprs: Sequence[Expression]) -> "Table":
+        exprs = _as_expressions(exprs)
+        names = [e.name() for e in exprs]
+        list_cols: Dict[str, Series] = {}
+        for e in exprs:
+            s = e._node.evaluate(self)
+            if not s.dtype.is_list():
+                raise ValueError(f"explode requires list column, got {s.dtype} for {e.name()!r}")
+            list_cols[e.name()] = _broadcast_series(s, len(self))
+        first = list_cols[names[0]]
+        arr0 = first.to_arrow()
+        lens = pc.list_value_length(arr0)
+        lens_np = np.asarray(pc.fill_null(lens, 0), dtype=np.int64)
+        # null/empty lists explode to a single null row (reference semantics)
+        out_lens = np.maximum(lens_np, 1)
+        for nm, s in list_cols.items():
+            ln = np.asarray(pc.fill_null(pc.list_value_length(s.to_arrow()), 0), dtype=np.int64)
+            if not np.array_equal(np.maximum(ln, 1), out_lens):
+                raise ValueError("exploded columns must have equal list lengths per row")
+        repeat_idx = np.repeat(np.arange(len(self), dtype=np.int64), out_lens)
+        out_cols: List[Series] = []
+        out_fields: List[Field] = []
+        for f, c in zip(self.schema, self._columns):
+            if f.name in list_cols:
+                s = list_cols[f.name]
+                flat = _explode_series(s, out_lens)
+                out_cols.append(flat.rename(f.name))
+                out_fields.append(Field(f.name, flat.dtype))
+            else:
+                taken = c.take(Series.from_arrow(pa.array(repeat_idx), "i"))
+                out_cols.append(taken)
+                out_fields.append(f)
+        return Table(Schema(out_fields), out_cols)
+
+    def unpivot(self, ids: Sequence[Expression], values: Sequence[Expression],
+                variable_name: str = "variable", value_name: str = "value") -> "Table":
+        ids = _as_expressions(ids)
+        values = _as_expressions(values)
+        if not values:
+            raise ValueError("unpivot requires at least one value column")
+        id_tbl = self.eval_expression_list(ids) if ids else None
+        n = len(self)
+        val_series = [e._node.evaluate(self) for e in values]
+        vdt = val_series[0].dtype
+        for s in val_series[1:]:
+            u = try_unify(vdt, s.dtype)
+            if u is None:
+                raise ValueError(f"unpivot value columns have incompatible types {vdt} vs {s.dtype}")
+            vdt = u
+        out_cols: List[Series] = []
+        out_fields: List[Field] = []
+        m = len(values)
+        if id_tbl is not None:
+            tile_idx = np.tile(np.arange(n, dtype=np.int64), m)
+            idx_s = Series.from_arrow(pa.array(tile_idx), "i")
+            for f, c in zip(id_tbl.schema, id_tbl._columns):
+                out_cols.append(c.take(idx_s))
+                out_fields.append(f)
+        var_vals = np.repeat([e.name() for e in values], n)
+        out_cols.append(Series.from_pylist(list(var_vals), variable_name, DataType.string()))
+        out_fields.append(Field(variable_name, DataType.string()))
+        value_col = Series.concat([s.cast(vdt) for s in val_series]).rename(value_name)
+        out_cols.append(value_col)
+        out_fields.append(Field(value_name, vdt))
+        return Table(Schema(out_fields), out_cols)
+
+    def pivot(self, group_by: Sequence[Expression], pivot_col: Expression,
+              value_col: Expression, names: List[str], agg_fn: str = "sum") -> "Table":
+        group_by = _as_expressions(group_by)
+        pivot_e = _as_expressions(pivot_col)[0]
+        value_e = _as_expressions(value_col)[0]
+        agg_e = Expression(AggExpr(agg_fn, value_e._node))
+        grouped = self.agg([agg_e.alias("__v")], group_by + [pivot_e])
+        key_names = [e.name() for e in group_by]
+        piv_name = pivot_e.name()
+        base = grouped.distinct([col(n) for n in key_names]).select_columns(key_names)
+        out = base
+        for nm in names:
+            sub = grouped.filter(col(piv_name) == nm) if nm is not None else grouped.filter(col(piv_name).is_null())
+            sub = sub.select_columns(key_names + ["__v"]).rename_columns({"__v": str(nm)})
+            out = out.hash_join(sub, [col(n) for n in key_names], [col(n) for n in key_names], how="left")
+        return out
+
+    def add_monotonic_id(self, partition_offset: int = 0, column_name: str = "id") -> "Table":
+        ids = np.arange(len(self), dtype=np.uint64) + np.uint64(partition_offset)
+        s = Series.from_arrow(pa.array(ids), column_name)
+        return Table(Schema([Field(column_name, s.dtype)] + list(self.schema)), [s] + self._columns)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _broadcast_series(s: Series, n: int) -> Series:
+    from .series import _broadcast_to
+
+    return _broadcast_to(s, n)
+
+
+def _norm_flag(v, k: int, default):
+    if v is None:
+        return [default] * k
+    if isinstance(v, (bool, int)):
+        return [bool(v)] * k
+    out = list(v)
+    if len(out) != k:
+        raise ValueError(f"expected {k} flags, got {len(out)}")
+    return out
+
+
+def _group_codes(key_tbl: Table) -> Tuple[np.ndarray, Table]:
+    """Dense group codes per row + table of unique key rows (nulls form a group)."""
+    n = len(key_tbl)
+    if n == 0:
+        return np.empty(0, dtype=np.int64), key_tbl
+    # dictionary-encode each key column, then combine codes by mixed-radix
+    combined = np.zeros(n, dtype=np.int64)
+    for s in key_tbl._columns:
+        arr = s.to_arrow() if not s.is_python() else None
+        if arr is None:
+            vals = s.to_pylist()
+            uniq_map: Dict[Any, int] = {}
+            codes = np.empty(n, dtype=np.int64)
+            for i, v in enumerate(vals):
+                k = repr(v)
+                codes[i] = uniq_map.setdefault(k, len(uniq_map))
+            card = len(uniq_map)
+        else:
+            if pa.types.is_nested(arr.type):
+                # nested keys: exact repr-based encoding (hash-only grouping could
+                # silently merge colliding keys); nested group keys are rare enough
+                # that the python path is acceptable
+                vals = s.to_pylist()
+                uniq_map2: Dict[Any, int] = {}
+                codes = np.empty(n, dtype=np.int64)
+                for i, v in enumerate(vals):
+                    codes[i] = uniq_map2.setdefault(repr(v), len(uniq_map2))
+                card = len(uniq_map2)
+            else:
+                enc = arr.dictionary_encode()
+                codes = np.asarray(enc.indices.fill_null(-1)).astype(np.int64)
+                codes = codes + 1  # null -> 0
+                card = len(enc.dictionary) + 1
+        card = max(card, 1)
+        if (int(combined.max(initial=0)) + 1) * card >= (1 << 62):
+            # overflow guard: re-densify intermediate codes before combining
+            _, combined = np.unique(combined, return_inverse=True)
+            combined = combined.astype(np.int64)
+        combined = combined * np.int64(card) + codes
+    uniq_vals, first_idx, codes = np.unique(combined, return_index=True, return_inverse=True)
+    codes = codes.astype(np.int64)
+    # order groups by first occurrence for determinism
+    order = np.argsort(first_idx, kind="stable")
+    remap = np.empty(len(uniq_vals), dtype=np.int64)
+    remap[order] = np.arange(len(uniq_vals))
+    codes = remap[codes]
+    first_idx = first_idx[order]
+    uniq = key_tbl.take(Series.from_arrow(pa.array(first_idx.astype(np.uint64)), "i"))
+    return codes, uniq
+
+
+def _hash_agg_fast(node: AggExpr, child: Series, codes: np.ndarray, num_groups: int) -> Optional[Series]:
+    """Vectorized grouped aggregation through arrow's hash-agg engine.
+
+    Returns None when the (kind, dtype) combination needs the segment fallback.
+    """
+    if child.is_python() or num_groups == 0:
+        return None
+    k = node.kind
+    opts = None
+    if k == "sum":
+        fname = "sum"
+    elif k == "mean":
+        fname = "mean"
+    elif k == "min":
+        fname = "min"
+    elif k == "max":
+        fname = "max"
+    elif k == "count":
+        mode = node.extra.get("mode", "valid")
+        fname = "count"
+        opts = pc.CountOptions(mode={"valid": "only_valid", "null": "only_null", "all": "all"}[mode])
+    elif k in ("count_distinct",):
+        fname = "count_distinct"
+    elif k == "stddev":
+        fname = "stddev"
+        opts = pc.VarianceOptions(ddof=0)
+    elif k == "list":
+        fname = "list"
+    elif k == "any_value":
+        fname = "first"
+        opts = pc.ScalarAggregateOptions(skip_nulls=bool(node.extra.get("ignore_nulls", False)))
+    else:
+        return None
+    arr = child.to_arrow()
+    if pa.types.is_nested(arr.type) and k in ("sum", "mean", "min", "max", "stddev", "count_distinct", "list"):
+        return None
+    try:
+        tbl = pa.table({"g": pa.array(codes), "v": arr})
+        agg = tbl.group_by("g", use_threads=False).aggregate([("v", fname, opts)])
+    except (pa.ArrowNotImplementedError, pa.ArrowInvalid):
+        return None
+    out_name = [c for c in agg.column_names if c != "g"][0]
+    g = np.asarray(agg.column("g").combine_chunks())
+    v = agg.column(out_name).combine_chunks()
+    if isinstance(v, pa.ChunkedArray):
+        v = v.combine_chunks()
+    # scatter into group order 0..num_groups-1
+    order = np.argsort(g, kind="stable")
+    inv = np.empty(num_groups, dtype=np.int64)
+    inv[g[order]] = order
+    v = v.take(pa.array(inv))
+    return Series.from_arrow(v, child.name)
+
+
+def _first_occurrence(codes: np.ndarray) -> np.ndarray:
+    _, first_idx = np.unique(codes, return_index=True)
+    return np.sort(first_idx)
+
+
+def _composite_rank(keys: List[Series], bounds: List[Series], descending: List[bool]) -> np.ndarray:
+    """For each row, the number of boundary rows strictly below it (lexicographic)."""
+    n = len(keys[0])
+    nb = len(bounds[0])
+    rank = np.zeros(n, dtype=np.int64)
+    # lexicographic compare row vs each boundary, vectorized per boundary
+    ge_all = np.zeros((nb, n), dtype=bool)
+    for bi in range(nb):
+        cmp_state = np.zeros(n, dtype=np.int8)  # -1 lt, 0 eq, +1 gt
+        for s, b, d in zip(keys, bounds, descending):
+            bv = b.slice(bi, bi + 1)
+            eq_mask = cmp_state == 0
+            if not eq_mask.any():
+                break
+            sv = s.to_arrow()
+            bscalar = bv.to_arrow()[0]
+            lt = np.asarray(pc.fill_null(pc.less(sv, bscalar), False))
+            gt = np.asarray(pc.fill_null(pc.greater(sv, bscalar), False))
+            isnull = np.asarray(pc.is_null(sv))
+            bnull = not bscalar.is_valid
+            # nulls sort last (ascending)
+            if bnull:
+                lt2, gt2 = ~isnull, np.zeros(n, dtype=bool)
+            else:
+                lt2 = np.where(isnull, False, lt)
+                gt2 = np.where(isnull, True, gt)
+            if d:
+                lt2, gt2 = gt2, lt2
+            cmp_state = np.where(eq_mask & lt2, -1, cmp_state)
+            cmp_state = np.where(eq_mask & gt2, 1, cmp_state)
+        ge_all[bi] = cmp_state >= 0
+    rank = ge_all.sum(axis=0).astype(np.int64)
+    return rank
+
+
+def _explode_series(s: Series, out_lens: np.ndarray) -> Series:
+    arr = s.to_arrow()
+    if pa.types.is_fixed_size_list(arr.type):
+        arr = arr.cast(pa.large_list(arr.type.value_type))
+    offs = np.asarray(arr.offsets).astype(np.int64)
+    child = arr.values
+    lo = int(offs[0])
+    starts, ends = offs[:-1] - lo, offs[1:] - lo
+    child = child.slice(lo, int(offs[-1]) - lo)
+    n = len(arr)
+    idx = np.empty(int(out_lens.sum()), dtype=np.int64)
+    valid = np.empty(int(out_lens.sum()), dtype=bool)
+    pos = 0
+    valid_row = np.asarray(pc.is_valid(arr))
+    for i in range(n):
+        ln = int(out_lens[i])
+        real = int(ends[i] - starts[i]) if valid_row[i] else 0
+        if real == 0:
+            idx[pos:pos + 1] = 0
+            valid[pos:pos + 1] = False
+            pos += 1
+        else:
+            idx[pos:pos + real] = np.arange(starts[i], ends[i])
+            valid[pos:pos + real] = True
+            pos += real
+    if len(child) == 0:
+        out = pa.nulls(len(idx), arr.type.value_type)
+    else:
+        taken = child.take(pa.array(np.clip(idx, 0, len(child) - 1)))
+        out = pc.if_else(pa.array(valid), taken, pa.nulls(len(idx), taken.type))
+    return Series.from_arrow(out, s.name)
+
+
+def _empty_agg_series(node: AggExpr, child: Series) -> Series:
+    out_field = AggExpr(node.kind, _ConstNode(child.dtype), node.extra).to_field(Schema([]))
+    return Series.empty(child.name, out_field.dtype)
+
+
+class _ConstNode:
+    """Internal: an ExprNode-like carrying a fixed dtype for empty-agg typing."""
+
+    def __init__(self, dtype: DataType):
+        self._dtype = dtype
+
+    def to_field(self, _schema):
+        return Field("x", self._dtype)
+
+    def name(self):
+        return "x"

@@ -1,0 +1,153 @@
+"""Expression DSL: typing resolution + evaluation (reference test model:
+tests/expressions/ and tests/expressions/typing/ exhaustive matrix)."""
+
+import datetime
+
+import pytest
+
+from daft_tpu.datatypes import DataType
+from daft_tpu.expressions import col, lit
+from daft_tpu.schema import Field, Schema
+from daft_tpu.table import Table
+
+
+SCHEMA = Schema.from_pairs({
+    "i8": DataType.int8(), "i64": DataType.int64(), "u32": DataType.uint32(),
+    "u64": DataType.uint64(), "f32": DataType.float32(), "f64": DataType.float64(),
+    "b": DataType.bool(), "s": DataType.string(), "d": DataType.date(),
+    "ts": DataType.timestamp("us"), "l": DataType.list(DataType.int64()),
+})
+
+
+class TestTypingMatrix:
+    """Resolver dtype must equal kernel output dtype (the reference's typing oracle,
+    tests/expressions/typing/conftest.py:16-33)."""
+
+    CASES = [
+        (col("i8") + col("i64"), "int64"),
+        (col("i8") + col("u32"), "int64"),
+        (col("i64") + col("u64"), "float64"),
+        (col("i64") + col("f32"), "float64"),
+        (col("f32") + col("f32"), "float32"),
+        (col("i64") / col("i64"), "float64"),
+        (col("s") + col("s"), "string"),
+        (col("i64") > col("f64"), "bool"),
+        (col("b") & col("b"), "bool"),
+        (col("i64").cast(DataType.int32()), "int32"),
+        (col("s").str.length(), "uint64"),
+        (col("ts").dt.year(), "int32"),
+        (col("l").list.lengths(), "uint64"),
+        (col("i64").is_null(), "bool"),
+        (col("i64").fill_null(lit(0)), "int64"),
+        (col("i64").sum(), "int64"),
+        (col("u32").sum(), "uint64"),
+        (col("i8").mean(), "float64"),
+        (col("i64").count(), "uint64"),
+        (col("i64").agg_list(), "list[int64]"),
+    ]
+
+    @pytest.mark.parametrize("expr,expected", CASES, ids=[str(i) for i in range(len(CASES))])
+    def test_resolution(self, expr, expected):
+        assert repr(expr.to_field(SCHEMA).dtype) == expected
+
+    def test_resolver_matches_kernel(self):
+        t = Table.from_pydict({
+            "i8": [1, 2], "i64": [1, None], "u32": [1, 2], "u64": [1, 2],
+            "f32": [1.0, 2.0], "f64": [1.5, None], "b": [True, False],
+            "s": ["a", "b"], "d": [datetime.date(2020, 1, 1)] * 2,
+            "ts": [datetime.datetime(2020, 1, 1)] * 2, "l": [[1], [2, 3]],
+        }).cast_to_schema(SCHEMA)
+        for expr, _ in self.CASES:
+            resolved = expr.to_field(SCHEMA).dtype
+            actual = t.eval_expression_list([expr])._columns[0].dtype
+            assert actual == resolved, f"{expr}: resolver={resolved} kernel={actual}"
+
+    def test_incompatible_raises(self):
+        with pytest.raises(ValueError):
+            (col("s") - col("i64")).to_field(SCHEMA)
+        with pytest.raises((ValueError, KeyError)):
+            col("nope").to_field(SCHEMA)
+
+
+class TestEval:
+    def test_arith_and_alias(self):
+        t = Table.from_pydict({"a": [1, 2, None]})
+        out = t.eval_expression_list([(col("a") * 2 + 1).alias("x")])
+        assert out.to_pydict() == {"x": [3, 5, None]}
+
+    def test_if_else_between_isin(self):
+        t = Table.from_pydict({"a": [1, 2, 3, 4]})
+        out = t.eval_expression_list([
+            (col("a") > 2).if_else(lit("hi"), lit("lo")).alias("c"),
+            col("a").between(2, 3).alias("btw"),
+            col("a").is_in([1, 4]).alias("isin"),
+        ])
+        assert out.to_pydict() == {
+            "c": ["lo", "lo", "hi", "hi"],
+            "btw": [False, True, True, False],
+            "isin": [True, False, False, True],
+        }
+
+    def test_str_namespace(self):
+        t = Table.from_pydict({"s": ["Hello World", "daft_tpu", None]})
+        out = t.eval_expression_list([
+            col("s").str.contains("World").alias("c"),
+            col("s").str.lower().alias("lo"),
+            col("s").str.split(" ").alias("sp"),
+            col("s").str.left(4).alias("l4"),
+        ])
+        d = out.to_pydict()
+        assert d["c"] == [True, False, None]
+        assert d["lo"] == ["hello world", "daft_tpu", None]
+        assert d["sp"] == [["Hello", "World"], ["daft_tpu"], None]
+        assert d["l4"] == ["Hell", "daft", None]
+
+    def test_dt_namespace(self):
+        t = Table.from_pydict({"ts": [datetime.datetime(2021, 3, 14, 15, 9, 26), None]})
+        out = t.eval_expression_list([
+            col("ts").dt.year().alias("y"), col("ts").dt.month().alias("m"),
+            col("ts").dt.day().alias("d"), col("ts").dt.hour().alias("h"),
+        ])
+        assert out.to_pydict() == {"y": [2021, None], "m": [3, None], "d": [14, None], "h": [15, None]}
+
+    def test_list_namespace(self):
+        t = Table.from_pydict({"l": [[3, 1, 2], [], None, [5]]})
+        out = t.eval_expression_list([
+            col("l").list.lengths().alias("n"),
+            col("l").list.get(0).alias("g0"),
+            col("l").list.sum().alias("s"),
+            col("l").list.sort().alias("srt"),
+        ])
+        d = out.to_pydict()
+        assert d["n"] == [3, 0, None, 1]
+        assert d["g0"] == [3, None, None, 5]
+        assert d["s"] == [6, None, None, 5]
+        assert d["srt"] == [[1, 2, 3], [], None, [5]]
+
+    def test_struct_get(self):
+        t = Table.from_pydict({"st": [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}, None]})
+        out = t.eval_expression_list([col("st").struct.get("a")])
+        assert out.to_pydict() == {"a": [1, 2, None]}
+
+    def test_temporal_arith(self):
+        t = Table.from_pydict({"ts": [datetime.datetime(2020, 1, 2)],
+                               "ts2": [datetime.datetime(2020, 1, 1)]})
+        out = t.eval_expression_list([(col("ts") - col("ts2")).alias("dur")])
+        assert out.to_pydict()["dur"] == [datetime.timedelta(days=1)]
+        f = (col("ts") - col("ts2")).to_field(Schema.from_pairs(
+            {"ts": DataType.timestamp("us"), "ts2": DataType.timestamp("us")}))
+        assert f.dtype == DataType.duration("us")
+
+    def test_udf_apply(self):
+        t = Table.from_pydict({"a": [1, 2, 3]})
+        out = t.eval_expression_list([col("a").apply(lambda x: x * 10, DataType.int64()).alias("x")])
+        assert out.to_pydict() == {"x": [10, 20, 30]}
+
+    def test_expression_truthiness_raises(self):
+        with pytest.raises(ValueError, match="truth value"):
+            bool(col("a") > 1)
+
+    def test_required_columns(self):
+        from daft_tpu.expressions import required_columns
+
+        assert required_columns((col("a") + col("b")) * col("a")) == ["a", "b"]

@@ -1,0 +1,196 @@
+"""Table ops (reference test model: tests/table/)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from daft_tpu.datatypes import DataType
+from daft_tpu.expressions import col, lit
+from daft_tpu.table import Table
+
+
+class TestBasics:
+    def test_roundtrip(self):
+        d = {"a": [1, 2, None], "s": ["x", None, "z"]}
+        t = Table.from_pydict(d)
+        assert t.to_pydict() == d
+        assert len(t) == 3
+        t2 = Table.from_arrow(t.to_arrow())
+        assert t2.to_pydict() == d
+
+    def test_broadcast_scalar_column(self):
+        t = Table.from_pydict({"a": [1, 2, 3], "b": [7]})
+        assert t.to_pydict()["b"] == [7, 7, 7]
+
+    def test_cast_to_schema_adds_missing_as_null(self):
+        from daft_tpu.schema import Field, Schema
+
+        t = Table.from_pydict({"a": [1]})
+        out = t.cast_to_schema(Schema.from_pairs({"a": DataType.float64(), "b": DataType.string()}))
+        assert out.to_pydict() == {"a": [1.0], "b": [None]}
+
+
+class TestFilterSortSlice:
+    def test_filter_multiple_predicates(self):
+        t = Table.from_pydict({"a": [1, 2, 3, 4], "b": [1, 1, 0, 1]})
+        out = t.filter([col("a") > 1, col("b") == 1])
+        assert out.to_pydict()["a"] == [2, 4]
+
+    def test_filter_null_mask_drops(self):
+        t = Table.from_pydict({"a": [1, None, 3]})
+        out = t.filter([col("a") > 0])
+        assert out.to_pydict()["a"] == [1, 3]
+
+    def test_sort_nulls_and_desc(self):
+        t = Table.from_pydict({"a": [3, None, 1, 2]})
+        assert t.sort([col("a")]).to_pydict()["a"] == [1, 2, 3, None]
+        assert t.sort([col("a")], descending=True).to_pydict()["a"] == [None, 3, 2, 1]
+        assert t.sort([col("a")], descending=True, nulls_first=[False]).to_pydict()["a"] == [3, 2, 1, None]
+
+    def test_multi_key_sort(self):
+        t = Table.from_pydict({"k": ["b", "a", "b", "a"], "v": [1, 2, 3, 4]})
+        out = t.sort([col("k"), col("v")], descending=[False, True])
+        assert out.to_pydict() == {"k": ["a", "a", "b", "b"], "v": [4, 2, 3, 1]}
+
+
+class TestAgg:
+    def test_global(self):
+        t = Table.from_pydict({"a": [1, 2, 3, None]})
+        out = t.agg([col("a").sum().alias("s"), col("a").count().alias("c"),
+                     col("a").count("all").alias("ca"), col("a").mean().alias("m"),
+                     col("a").min().alias("lo"), col("a").max().alias("hi")])
+        assert out.to_pydict() == {"s": [6], "c": [3], "ca": [4], "m": [2.0], "lo": [1], "hi": [3]}
+
+    def test_grouped_with_null_group(self):
+        t = Table.from_pydict({"k": ["x", None, "x", None, "y"], "v": [1, 2, 3, 4, 5]})
+        out = t.agg([col("v").sum().alias("s")], group_by=[col("k")]).sort([col("k")])
+        assert out.to_pydict() == {"k": ["x", "y", None], "s": [4, 5, 6]}
+
+    def test_grouped_list_and_concat(self):
+        t = Table.from_pydict({"k": [1, 1, 2], "v": [[1], [2, 3], [4]]})
+        out = t.agg([col("v").agg_concat().alias("c")], group_by=[col("k")]).sort([col("k")])
+        assert out.to_pydict() == {"k": [1, 2], "c": [[1, 2, 3], [4]]}
+
+    def test_grouped_any_value_stddev(self):
+        t = Table.from_pydict({"k": [1, 1, 2], "v": [2.0, 4.0, 9.0]})
+        out = t.agg([col("v").stddev().alias("sd"), col("v").any_value().alias("av")],
+                    group_by=[col("k")]).sort([col("k")])
+        assert out.to_pydict()["sd"] == [1.0, 0.0]
+
+    def test_empty_table_grouped(self):
+        t = Table.from_pydict({"k": [], "v": []})
+        out = t.agg([col("v").sum().alias("s")], group_by=[col("k")])
+        assert len(out) == 0
+
+    def test_multi_key_groupby(self):
+        t = Table.from_pydict({"a": [1, 1, 2, 2], "b": ["x", "x", "x", "y"], "v": [1, 2, 3, 4]})
+        out = t.agg([col("v").sum().alias("s")], group_by=[col("a"), col("b")]).sort([col("a"), col("b")])
+        assert out.to_pydict() == {"a": [1, 2, 2], "b": ["x", "x", "y"], "s": [3, 3, 4]}
+
+
+class TestJoin:
+    L = {"k": [1, 2, None, 4], "v": [10, 20, 30, 40]}
+    R = {"k": [2, None, 4, 5], "w": ["b", "n", "d", "e"]}
+
+    def test_inner_nulls_dont_match(self):
+        out = Table.from_pydict(self.L).hash_join(Table.from_pydict(self.R),
+                                                  [col("k")], [col("k")], "inner")
+        assert out.to_pydict() == {"k": [2, 4], "v": [20, 40], "w": ["b", "d"]}
+
+    def test_left_right_outer(self):
+        l, r = Table.from_pydict(self.L), Table.from_pydict(self.R)
+        left = l.hash_join(r, [col("k")], [col("k")], "left")
+        assert left.to_pydict()["w"] == [None, "b", None, "d"]
+        outer = l.hash_join(r, [col("k")], [col("k")], "outer")
+        assert len(outer) == 6
+
+    def test_semi_anti(self):
+        l, r = Table.from_pydict(self.L), Table.from_pydict(self.R)
+        assert l.hash_join(r, [col("k")], [col("k")], "semi").to_pydict()["v"] == [20, 40]
+        assert l.hash_join(r, [col("k")], [col("k")], "anti").to_pydict()["v"] == [10, 30]
+
+    def test_name_collision_gets_suffix(self):
+        l = Table.from_pydict({"k": [1], "v": [1]})
+        r = Table.from_pydict({"k": [1], "v": [2]})
+        out = l.hash_join(r, [col("k")], [col("k")], "inner")
+        assert out.column_names == ["k", "v", "right.v"]
+
+    def test_multi_key(self):
+        l = Table.from_pydict({"a": [1, 1], "b": ["x", "y"], "v": [1, 2]})
+        r = Table.from_pydict({"a": [1, 1], "b": ["y", "z"], "w": [8, 9]})
+        out = l.hash_join(r, [col("a"), col("b")], [col("a"), col("b")], "inner")
+        assert out.to_pydict() == {"a": [1], "b": ["y"], "v": [2], "w": [8]}
+
+    def test_mismatched_key_dtypes_unify(self):
+        l = Table.from_pydict({"k": [1, 2]})
+        r = Table.from_pydict({"k": [1.0, 3.0], "w": [5, 6]})
+        out = l.hash_join(r, [col("k")], [col("k")], "inner")
+        assert out.to_pydict()["w"] == [5]
+
+    def test_sort_merge_join_sorted_output(self):
+        l = Table.from_pydict({"k": [3, 1, 2], "v": [30, 10, 20]})
+        r = Table.from_pydict({"k": [2, 3], "w": [200, 300]})
+        out = l.sort_merge_join(r, [col("k")], [col("k")], "inner")
+        assert out.to_pydict() == {"k": [2, 3], "v": [20, 30], "w": [200, 300]}
+
+
+class TestPartition:
+    def test_hash_partition_consistency(self):
+        t = Table.from_pydict({"k": list(range(100)) * 2, "v": list(range(200))})
+        parts = t.partition_by_hash([col("k")], 7)
+        assert sum(len(p) for p in parts) == 200
+        # same key never lands in two partitions
+        seen = {}
+        for i, p in enumerate(parts):
+            for k in set(p.to_pydict()["k"]):
+                assert seen.setdefault(k, i) == i
+
+    def test_random_partition_roundtrip(self):
+        t = Table.from_pydict({"v": list(range(50))})
+        parts = t.partition_by_random(4, seed=1)
+        assert sum(len(p) for p in parts) == 50
+        got = sorted(x for p in parts for x in p.to_pydict()["v"])
+        assert got == list(range(50))
+
+    def test_range_partition(self):
+        t = Table.from_pydict({"v": [5, 1, 9, 3, 7]})
+        bounds = Table.from_pydict({"v": [4, 8]})
+        parts = t.partition_by_range([col("v")], bounds)
+        assert [sorted(p.to_pydict()["v"]) for p in parts] == [[1, 3], [5, 7], [9]]
+
+    def test_partition_empty(self):
+        t = Table.from_pydict({"k": [], "v": []})
+        parts = t.partition_by_hash([col("k")], 3)
+        assert len(parts) == 3 and all(len(p) == 0 for p in parts)
+
+
+class TestReshape:
+    def test_explode_with_empty_and_null(self):
+        t = Table.from_pydict({"i": [1, 2, 3], "l": [[1, 2], [], None]})
+        out = t.explode([col("l")])
+        assert out.to_pydict() == {"i": [1, 1, 2, 3], "l": [1, 2, None, None]}
+
+    def test_distinct_with_nulls(self):
+        t = Table.from_pydict({"x": [1, 1, None, None, 2]})
+        assert sorted(t.distinct().to_pydict()["x"], key=lambda v: (v is None, v)) == [1, 2, None]
+
+    def test_unpivot(self):
+        t = Table.from_pydict({"id": [1], "a": [10], "b": [20]})
+        out = t.unpivot([col("id")], [col("a"), col("b")], "var", "val")
+        assert out.to_pydict() == {"id": [1, 1], "var": ["a", "b"], "val": [10, 20]}
+
+    def test_pivot(self):
+        t = Table.from_pydict({"g": ["x", "x", "y"], "p": ["m", "n", "m"], "v": [1, 2, 3]})
+        out = t.pivot([col("g")], col("p"), col("v"), ["m", "n"]).sort([col("g")])
+        assert out.to_pydict() == {"g": ["x", "y"], "m": [1, 3], "n": [2, None]}
+
+    def test_monotonic_id(self):
+        t = Table.from_pydict({"v": ["a", "b"]})
+        out = t.add_monotonic_id(1000, "id")
+        assert out.to_pydict() == {"id": [1000, 1001], "v": ["a", "b"]}
+
+    def test_concat_unifies_types(self):
+        a = Table.from_pydict({"x": [1, 2]})
+        b = Table.from_pydict({"x": [3.5]})
+        out = Table.concat([a, b])
+        assert out.to_pydict()["x"] == [1.0, 2.0, 3.5]
