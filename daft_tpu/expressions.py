@@ -41,9 +41,11 @@ def element() -> "Expression":
 
 def interval(**kwargs) -> "Expression":
     """An interval literal for temporal arithmetic, e.g. interval(days=3)."""
-    td = datetime.timedelta(**{k: v for k, v in kwargs.items() if k in (
-        "weeks", "days", "hours", "minutes", "seconds", "milliseconds", "microseconds")})
-    return lit(td, DataType.duration("us"))
+    allowed = ("weeks", "days", "hours", "minutes", "seconds", "milliseconds", "microseconds")
+    unknown = set(kwargs) - set(allowed)
+    if unknown:
+        raise ValueError(f"unsupported interval unit(s) {sorted(unknown)}; allowed: {allowed}")
+    return lit(datetime.timedelta(**kwargs), DataType.duration("us"))
 
 
 # ---------------------------------------------------------------------------

@@ -115,8 +115,16 @@ for _name in ["sqrt", "cbrt", "exp", "log2", "log10", "log1p", "sin", "cos", "ta
 register("numeric.negate", _ret_same, lambda s: -s)
 register("numeric.log", _ret_float64, lambda s, base=None: s.log(base))
 register("numeric.round", _ret_same, lambda s, decimals=0: s.round(decimals))
-register("numeric.shift_left", _ret_same, lambda s, o: s.left_shift(o))
-register("numeric.shift_right", _ret_same, lambda s, o: s.right_shift(o))
+def _shift_resolve(*arg_dtypes, **_kw):
+    # arrow promotes shift operands to the common integer type; declare the same
+    u = try_unify(*arg_dtypes) if len(arg_dtypes) == 2 else arg_dtypes[0]
+    if u is None or not u.is_integer():
+        raise ValueError(f"shift requires integer operands, got {arg_dtypes}")
+    return u
+
+
+register("numeric.shift_left", _shift_resolve, lambda s, o: s.left_shift(o))
+register("numeric.shift_right", _shift_resolve, lambda s, o: s.right_shift(o))
 register("numeric.exp2", _ret_float64,
          lambda s: Series.from_pylist([2.0], "two")._binary_numeric(s.cast(DataType.float64()), pc.power, s.name))
 register(
@@ -475,16 +483,23 @@ def _dt_truncate(s: Series, interval: str, relative_to=None) -> Series:
         raise ValueError(f"truncate with relative_to supports fixed-width units only, not {unit!r}")
     if isinstance(relative_to, Series):
         relative_to = relative_to.to_arrow()[0].as_py()
-    origin = pa.scalar(relative_to, type=pa.timestamp("us")).value
-    step = np.int64(mult * _TRUNC_UNIT_US[unit])
-    ts = s.to_arrow().cast(pa.timestamp("us"))
+    # compute in the input's own unit so the declared dtype is preserved exactly
+    in_unit = s.dtype.params[0] if s.dtype.kind == TypeKind.TIMESTAMP else "us"
+    unit_us = {"s": 1 / 1_000_000, "ms": 1 / 1_000, "us": 1, "ns": 1_000}[in_unit]
+    step_ticks = mult * _TRUNC_UNIT_US[unit] * unit_us
+    if step_ticks < 1:
+        step_ticks = 1  # sub-resolution step on coarse storage: identity
+    step = np.int64(step_ticks)
+    work_type = pa.timestamp(in_unit, tz=s.dtype.params[1]) if s.dtype.kind == TypeKind.TIMESTAMP else pa.timestamp("us")
+    origin = pa.scalar(relative_to, type=pa.timestamp(in_unit)).value
+    ts = s.to_arrow().cast(work_type)
     v = np.asarray(pc.fill_null(ts.cast(pa.int64()), 0))
     delta = v - np.int64(origin)
     floored = (delta - ((delta % step) + step) % step) + np.int64(origin)
-    out = pa.array(floored).view(pa.timestamp("us"))
+    out = pa.array(floored).view(work_type)
     if ts.null_count:
         out = pc.if_else(pc.is_valid(ts), out, pa.nulls(len(out), out.type))
-    return Series.from_arrow(out, s.name, DataType.timestamp("us"))
+    return Series.from_arrow(out, s.name, s.dtype if s.dtype.kind == TypeKind.TIMESTAMP else DataType.timestamp("us"))
 
 
 register("dt.truncate", lambda *a, **k: a[0], _dt_truncate)

@@ -450,7 +450,7 @@ class Table:
         out_lens = np.maximum(lens_np, 1)
         for nm, s in list_cols.items():
             ln = np.asarray(pc.fill_null(pc.list_value_length(s.to_arrow()), 0), dtype=np.int64)
-            if not np.array_equal(np.maximum(ln, 1), out_lens):
+            if not np.array_equal(ln, lens_np):
                 raise ValueError("exploded columns must have equal list lengths per row")
         repeat_idx = np.repeat(np.arange(len(self), dtype=np.int64), out_lens)
         out_cols: List[Series] = []
