@@ -1,0 +1,245 @@
+"""Public API: constructors, read_* functions, top-level names.
+
+Role-equivalent to the reference's daft/__init__.py:97-136 (public surface)
+and daft/io/ constructor family. Everything here is re-exported from the
+package root.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Union
+
+from .context import (
+    DaftContext,
+    get_context,
+    set_execution_config,
+    set_planning_config,
+    set_runner_mesh,
+    set_runner_native,
+)
+from .dataframe import DataFrame, GroupedDataFrame, from_partitions
+from .datatypes import DataType
+from .expressions import Expression, col, element, interval, lit
+from .io.scan import FileFormat, Pushdowns, ScanTask, glob_paths
+from .logical import InMemorySource, ScanSource
+from .micropartition import MicroPartition
+from .schema import Field, Schema
+from .series import Series
+from .table import Table
+from .udf import UDF
+
+
+# ---------------------------------------------------------------------------
+# in-memory constructors
+# ---------------------------------------------------------------------------
+
+def from_pydict(data: Dict[str, Any]) -> DataFrame:
+    mp = MicroPartition.from_pydict(data)
+    return from_partitions([mp], mp.schema)
+
+
+def from_pylist(rows: List[dict]) -> DataFrame:
+    mp = MicroPartition.from_table(Table.from_pylist(rows))
+    return from_partitions([mp], mp.schema)
+
+
+def from_arrow(data) -> DataFrame:
+    import pyarrow as pa
+
+    if isinstance(data, (pa.Table, pa.RecordBatch)):
+        mp = MicroPartition.from_arrow(data)
+        return from_partitions([mp], mp.schema)
+    if isinstance(data, (list, tuple)):
+        parts = [MicroPartition.from_arrow(t) for t in data]
+        if not parts:
+            raise ValueError("from_arrow of empty list")
+        return from_partitions(parts, parts[0].schema)
+    raise TypeError(f"from_arrow expects pyarrow Table/RecordBatch, got {type(data)}")
+
+
+def from_pandas(df) -> DataFrame:
+    import pyarrow as pa
+
+    return from_arrow(pa.Table.from_pandas(df))
+
+
+def from_glob_path(path: str) -> DataFrame:
+    """DataFrame of file metadata (path, size, num_rows) for a glob —
+    reference: daft/io/_glob.py."""
+    paths = glob_paths(path)
+    sizes = [os.path.getsize(p) for p in paths]
+    return from_pydict({"path": paths, "size": sizes,
+                        "num_rows": [None] * len(paths)})
+
+
+# ---------------------------------------------------------------------------
+# file readers
+# ---------------------------------------------------------------------------
+
+def read_parquet(path, schema_hints: Optional[Dict[str, DataType]] = None,
+                 _split_row_groups: Optional[bool] = None) -> DataFrame:
+    """Lazy parquet scan. Large files split into one ScanTask per row-group
+    chunk (reference: ScanTask split/merge by size, daft-scan/src/lib.rs)."""
+    import pyarrow.parquet as papq
+
+    from .io.readers import row_group_stats
+    from .stats import TableStats
+
+    paths = glob_paths(path)
+    if not paths:
+        raise FileNotFoundError(f"no files for {path!r}")
+    pf0 = papq.ParquetFile(paths[0])
+    schema = Schema.from_arrow(pf0.schema_arrow)
+    if schema_hints:
+        schema = schema.apply_hints(Schema([Field(k, v) for k, v in schema_hints.items()]))
+    cfg = get_context().execution_config
+    tasks: List[ScanTask] = []
+    for p in paths:
+        md = pf0.metadata if p == paths[0] else papq.ParquetFile(p).metadata
+        fsize = os.path.getsize(p)
+        split = _split_row_groups
+        if split is None:
+            split = fsize > cfg.scan_tasks_max_size_bytes and md.num_row_groups > 1
+        if split:
+            # one task per row-group run, packed to ~min_size_bytes
+            runs: List[List[int]] = []
+            cur: List[int] = []
+            cur_bytes = 0
+            for rg in range(md.num_row_groups):
+                cur.append(rg)
+                cur_bytes += md.row_group(rg).total_byte_size
+                if cur_bytes >= cfg.scan_tasks_min_size_bytes:
+                    runs.append(cur)
+                    cur, cur_bytes = [], 0
+            if cur:
+                runs.append(cur)
+            for run in runs:
+                nrows = sum(md.row_group(rg).num_rows for rg in run)
+                nbytes = sum(md.row_group(rg).total_byte_size for rg in run)
+                st = row_group_stats(md, run[0], schema)
+                for rg in run[1:]:
+                    st = st.merge(row_group_stats(md, rg, schema))
+                tasks.append(ScanTask(p, FileFormat.PARQUET, schema, Pushdowns(),
+                                      num_rows=nrows, size_bytes=nbytes, stats=st,
+                                      row_group_ids=run))
+        else:
+            st: Optional[TableStats] = None
+            if md.num_row_groups:
+                st = row_group_stats(md, 0, schema)
+                for rg in range(1, md.num_row_groups):
+                    st = st.merge(row_group_stats(md, rg, schema))
+            tasks.append(ScanTask(p, FileFormat.PARQUET, schema, Pushdowns(),
+                                  num_rows=md.num_rows, size_bytes=fsize, stats=st))
+    return DataFrame(ScanSource(schema, tasks))
+
+
+def read_csv(path, delimiter: str = ",", has_headers: bool = True,
+             column_names: Optional[List[str]] = None,
+             schema_hints: Optional[Dict[str, DataType]] = None, **kw) -> DataFrame:
+    from .io.readers import infer_csv_schema
+
+    paths = glob_paths(path)
+    schema = infer_csv_schema(paths[0], delimiter=delimiter, has_headers=has_headers,
+                              column_names=column_names)
+    if schema_hints:
+        schema = schema.apply_hints(Schema([Field(k, v) for k, v in schema_hints.items()]))
+    opts = {"delimiter": delimiter, "has_headers": has_headers,
+            "column_names": column_names, **kw}
+    tasks = [ScanTask(p, FileFormat.CSV, schema, Pushdowns(), storage_options=opts,
+                      size_bytes=os.path.getsize(p)) for p in paths]
+    return DataFrame(ScanSource(schema, tasks))
+
+
+def read_json(path, schema_hints: Optional[Dict[str, DataType]] = None) -> DataFrame:
+    from .io.readers import infer_json_schema
+
+    paths = glob_paths(path)
+    schema = infer_json_schema(paths[0])
+    if schema_hints:
+        schema = schema.apply_hints(Schema([Field(k, v) for k, v in schema_hints.items()]))
+    tasks = [ScanTask(p, FileFormat.JSON, schema, Pushdowns(),
+                      size_bytes=os.path.getsize(p)) for p in paths]
+    return DataFrame(ScanSource(schema, tasks))
+
+
+def _catalog_stub(name: str):
+    def reader(*_a, **_k):
+        raise ImportError(
+            f"read_{name} requires the {name} catalog client, which is not "
+            f"available in this environment (zero-egress). The scan-layer "
+            f"integration point is ScanTask/ScanSource (daft_tpu/io/scan.py)."
+        )
+
+    return reader
+
+
+read_iceberg = _catalog_stub("iceberg")
+read_deltalake = _catalog_stub("deltalake")
+read_hudi = _catalog_stub("hudi")
+read_lance = _catalog_stub("lance")
+read_sql = _catalog_stub("sql")
+
+
+# ---------------------------------------------------------------------------
+# UDF + SQL entry points
+# ---------------------------------------------------------------------------
+
+def udf(return_dtype: DataType, num_cpus=None, num_gpus=None, memory_bytes=None,
+        batch_size=None, concurrency=None):
+    """Decorator: make a batch UDF (reference: daft/udf.py:441)."""
+
+    def deco(fn):
+        return UDF(fn, return_dtype, num_cpus=num_cpus, num_gpus=num_gpus,
+                   memory_bytes=memory_bytes, batch_size=batch_size,
+                   concurrency=concurrency)
+
+    return deco
+
+
+def sql(query: str, **catalog: DataFrame) -> DataFrame:
+    from .sql import sql as _sql
+
+    return _sql(query, **catalog)
+
+
+def sql_expr(text: str) -> Expression:
+    from .sql import sql_expr as _sql_expr
+
+    return _sql_expr(text)
+
+
+__all__ = [
+    "DataFrame",
+    "GroupedDataFrame",
+    "Expression",
+    "Table",
+    "MicroPartition",
+    "UDF",
+    "col",
+    "lit",
+    "element",
+    "interval",
+    "udf",
+    "sql",
+    "sql_expr",
+    "from_pydict",
+    "from_pylist",
+    "from_arrow",
+    "from_pandas",
+    "from_glob_path",
+    "from_partitions",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+    "read_iceberg",
+    "read_deltalake",
+    "read_hudi",
+    "read_lance",
+    "read_sql",
+    "get_context",
+    "set_execution_config",
+    "set_planning_config",
+    "set_runner_native",
+    "set_runner_mesh",
+]

@@ -1,0 +1,114 @@
+"""Global context + config system.
+
+Role-equivalent to the reference's daft/context.py:295-351
+(set_planning_config / set_execution_config, ~19 knobs backed by
+common/daft-config) and the runner-selection logic of DaftContext. Config is a
+frozen-ish dataclass swapped atomically on the singleton context; readers grab
+a snapshot at plan/execute time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class PlanningConfig:
+    """Knobs consulted while building/optimizing logical plans
+    (reference: DaftPlanningConfig)."""
+
+    default_io_num_retries: int = 3
+    enable_strict_filter_pushdown: bool = False
+
+
+@dataclasses.dataclass
+class ExecutionConfig:
+    """Knobs consulted at physical planning / execution time
+    (reference: DaftExecutionConfig, common/daft-config/src/lib.rs)."""
+
+    scan_tasks_min_size_bytes: int = 96 * 1024 * 1024
+    scan_tasks_max_size_bytes: int = 384 * 1024 * 1024
+    broadcast_join_size_bytes_threshold: int = 10 * 1024 * 1024
+    sort_merge_join_sort_with_aligned_boundaries: bool = False
+    sample_size_for_sort: int = 20
+    num_preview_rows: int = 8
+    parquet_target_filesize: int = 512 * 1024 * 1024
+    parquet_target_row_group_size: int = 128 * 1024 * 1024
+    parquet_inflation_factor: float = 3.0
+    csv_target_filesize: int = 512 * 1024 * 1024
+    csv_inflation_factor: float = 0.5
+    shuffle_aggregation_default_partitions: int = 200
+    default_morsel_size: int = 128 * 1024
+    # TPU-specific: route eligible projections/aggregations through the jax
+    # device kernel layer (kernels/device.py); host pyarrow path otherwise.
+    use_device_kernels: bool = False
+    device_min_rows: int = 4096
+
+
+class DaftContext:
+    """Process-global context: configs + runner (reference: daft/context.py)."""
+
+    _instance: Optional["DaftContext"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.planning_config = PlanningConfig()
+        self.execution_config = ExecutionConfig()
+        self._runner = None
+        self._runner_name = os.environ.get("DAFT_TPU_RUNNER", "native")
+
+    @classmethod
+    def get(cls) -> "DaftContext":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DaftContext()
+            return cls._instance
+
+    def runner(self):
+        if self._runner is None:
+            from .runners import MeshRunner, NativeRunner
+
+            if self._runner_name == "mesh":
+                self._runner = MeshRunner()
+            else:
+                self._runner = NativeRunner()
+        return self._runner
+
+    def set_runner(self, name: str) -> None:
+        if name not in ("native", "mesh"):
+            raise ValueError(f"unknown runner {name!r}")
+        self._runner_name = name
+        self._runner = None
+
+
+def get_context() -> DaftContext:
+    return DaftContext.get()
+
+
+def set_planning_config(**kwargs) -> DaftContext:
+    ctx = get_context()
+    cfg = dataclasses.replace(ctx.planning_config, **kwargs)
+    ctx.planning_config = cfg
+    return ctx
+
+
+def set_execution_config(**kwargs) -> DaftContext:
+    ctx = get_context()
+    cfg = dataclasses.replace(ctx.execution_config, **kwargs)
+    ctx.execution_config = cfg
+    return ctx
+
+
+def set_runner_native() -> DaftContext:
+    ctx = get_context()
+    ctx.set_runner("native")
+    return ctx
+
+
+def set_runner_mesh() -> DaftContext:
+    ctx = get_context()
+    ctx.set_runner("mesh")
+    return ctx

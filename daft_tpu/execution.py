@@ -1,0 +1,102 @@
+"""Execution driver: pull-based streaming over the physical operator tree.
+
+Role-equivalent to the reference's src/daft-local-execution/src/run.rs:117
+(streaming pipeline executor) + daft/execution/physical_plan.py (the
+partition-task generator chain). Each PhysicalOp.execute is a generator;
+composing them yields a fully streaming pipeline with early-stop (limit) and
+bounded buffering at pipeline breakers.
+
+The ExecutionContext also owns the device-kernel routing decision: eligible
+projections run through kernels/device.py (jit'd XLA) when enabled, host
+pyarrow otherwise — the TPU analog of the reference's fused
+pipeline_instruction execution.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+from .context import ExecutionConfig
+from .micropartition import MicroPartition
+from .physical import PhysicalOp
+
+
+class RuntimeStats:
+    """Per-query counters (reference: runtime stats in daft-local-execution
+    and progress-bar accounting)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {}
+        self.op_rows: Dict[str, int] = {}
+        self.op_wall_ns: Dict[str, int] = {}
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    def record_op(self, name: str, rows: int, wall_ns: int) -> None:
+        with self._lock:
+            self.op_rows[name] = self.op_rows.get(name, 0) + rows
+            self.op_wall_ns[name] = self.op_wall_ns.get(name, 0) + wall_ns
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "op_rows": dict(self.op_rows),
+                "op_wall_ns": dict(self.op_wall_ns),
+            }
+
+
+class ExecutionContext:
+    def __init__(self, cfg: ExecutionConfig, stats: Optional[RuntimeStats] = None):
+        self.cfg = cfg
+        self.stats = stats or RuntimeStats()
+
+    def eval_projection(self, part: MicroPartition, exprs) -> MicroPartition:
+        """Route a projection through the device kernel layer when eligible,
+        else the host path."""
+        if self.cfg.use_device_kernels and (part.num_rows_or_none() or 0) >= self.cfg.device_min_rows:
+            try:
+                from .kernels.device import eval_projection_device
+
+                out = eval_projection_device(part.table(), list(exprs))
+            except Exception:
+                out = None
+            if out is not None:
+                self.stats.bump("device_projections")
+                return MicroPartition.from_table(out)
+        self.stats.bump("host_projections")
+        return part.eval_expression_list(exprs)
+
+
+def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
+                 trace: bool = False) -> Iterator[MicroPartition]:
+    """Wire up the generator tree and return the root partition stream."""
+
+    def build(op: PhysicalOp) -> Iterator[MicroPartition]:
+        child_streams = [build(c) for c in op.children]
+        stream = op.execute(child_streams, ctx)
+        if trace:
+            return _traced(op, stream, ctx)
+        return stream
+
+    return build(root)
+
+
+def _traced(op: PhysicalOp, stream: Iterator[MicroPartition],
+            ctx: ExecutionContext) -> Iterator[MicroPartition]:
+    name = op.name()
+    while True:
+        t0 = time.perf_counter_ns()
+        try:
+            part = next(stream)
+        except StopIteration:
+            return
+        dt = time.perf_counter_ns() - t0
+        n = part.num_rows_or_none()
+        ctx.stats.record_op(name, n if n is not None else 0, dt)
+        yield part

@@ -1,0 +1,32 @@
+"""IO layer: scan tasks, pushdowns, format readers/writers, IO stats.
+
+Role-equivalent to the reference's daft-scan (ScanTask/Pushdowns/glob,
+src/daft-scan/src/lib.rs:342,839), daft-parquet/daft-csv/daft-json readers, and
+daft/table/table_io.py writers. Host engine is pyarrow (the Arrow C++ datasets
+stack); the TPU path stages decoded Arrow batches onto device via
+kernels/device.py.
+"""
+
+from .scan import (
+    FileFormat,
+    IOStats,
+    IO_STATS,
+    Pushdowns,
+    ScanTask,
+    glob_paths,
+)
+from .readers import read_csv_table, read_json_table, read_parquet_table
+from .writer import write_tabular
+
+__all__ = [
+    "FileFormat",
+    "IOStats",
+    "IO_STATS",
+    "Pushdowns",
+    "ScanTask",
+    "glob_paths",
+    "read_csv_table",
+    "read_json_table",
+    "read_parquet_table",
+    "write_tabular",
+]

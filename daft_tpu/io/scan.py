@@ -1,0 +1,205 @@
+"""Scan layer: Pushdowns, ScanTask, file globbing, IO stats.
+
+Role-equivalent to the reference's src/daft-scan/src/lib.rs (ScanTask :342,
+Pushdowns :839) and glob scan operator (glob.rs). A ScanTask describes one unit
+of IO work — a file (or slice of one) plus the pushdowns to apply while
+reading — and is the payload of an Unloaded MicroPartition.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..schema import Schema
+from ..stats import TableStats, filter_may_match
+
+
+class IOStats:
+    """Process-wide IO counters (reference: daft-io IOStatsContext). Tests use
+    these to verify pushdowns actually reduce IO."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            self.files_opened = 0
+            self.bytes_read = 0
+            self.rows_read = 0
+            self.row_groups_read = 0
+            self.row_groups_pruned = 0
+            self.columns_read = 0
+
+    def bump(self, **kw: int) -> None:
+        with self._lock:
+            for k, v in kw.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "files_opened": self.files_opened,
+                "bytes_read": self.bytes_read,
+                "rows_read": self.rows_read,
+                "row_groups_read": self.row_groups_read,
+                "row_groups_pruned": self.row_groups_pruned,
+                "columns_read": self.columns_read,
+            }
+
+
+IO_STATS = IOStats()
+
+
+class FileFormat:
+    PARQUET = "parquet"
+    CSV = "csv"
+    JSON = "json"
+
+
+class Pushdowns:
+    """Pushed-down operations a reader may honor: column projection, row
+    filters, and a row limit (reference: daft-scan Pushdowns, lib.rs:839)."""
+
+    __slots__ = ("columns", "filters", "limit")
+
+    def __init__(self, columns: Optional[List[str]] = None,
+                 filters: Optional[Any] = None,  # ExprNode
+                 limit: Optional[int] = None):
+        self.columns = columns
+        self.filters = filters
+        self.limit = limit
+
+    def is_empty(self) -> bool:
+        return self.columns is None and self.filters is None and self.limit is None
+
+    def __repr__(self) -> str:
+        parts = []
+        if self.columns is not None:
+            parts.append(f"columns={self.columns}")
+        if self.filters is not None:
+            parts.append(f"filters={self.filters.display()}")
+        if self.limit is not None:
+            parts.append(f"limit={self.limit}")
+        return f"Pushdowns({', '.join(parts)})"
+
+    def with_columns(self, columns: Optional[List[str]]) -> "Pushdowns":
+        return Pushdowns(columns, self.filters, self.limit)
+
+    def with_filters(self, filters) -> "Pushdowns":
+        return Pushdowns(self.columns, filters, self.limit)
+
+    def with_limit(self, limit: Optional[int]) -> "Pushdowns":
+        return Pushdowns(self.columns, self.filters, limit)
+
+
+class ScanTask:
+    """One unit of scan work: a file + format + schema + pushdowns.
+
+    `materialized_schema` is the post-pushdown schema (column projection
+    applied). `stats`/`num_rows`/`size_bytes` come from file metadata where the
+    format provides it (parquet), powering pruning and planning estimates.
+    """
+
+    __slots__ = ("path", "format", "schema", "pushdowns", "storage_options",
+                 "_num_rows", "_size_bytes", "stats", "row_group_ids")
+
+    def __init__(self, path: str, format: str, schema: Schema,
+                 pushdowns: Optional[Pushdowns] = None,
+                 storage_options: Optional[Dict[str, Any]] = None,
+                 num_rows: Optional[int] = None, size_bytes: Optional[int] = None,
+                 stats: Optional[TableStats] = None,
+                 row_group_ids: Optional[List[int]] = None):
+        self.path = path
+        self.format = format
+        self.schema = schema
+        self.pushdowns = pushdowns or Pushdowns()
+        self.storage_options = storage_options or {}
+        self._num_rows = num_rows
+        self._size_bytes = size_bytes
+        self.stats = stats
+        self.row_group_ids = row_group_ids
+
+    def __repr__(self) -> str:
+        return f"ScanTask({self.format}:{self.path}, {self.pushdowns!r})"
+
+    @property
+    def materialized_schema(self) -> Schema:
+        if self.pushdowns.columns is None:
+            return self.schema
+        return self.schema.select([c for c in self.pushdowns.columns if c in self.schema])
+
+    def num_rows(self) -> Optional[int]:
+        """Exact row count after pushdowns, when knowable without IO."""
+        if self.pushdowns.filters is not None:
+            return None
+        if self._num_rows is None:
+            return None
+        if self.pushdowns.limit is not None:
+            return min(self._num_rows, self.pushdowns.limit)
+        return self._num_rows
+
+    def size_bytes(self) -> Optional[int]:
+        return self._size_bytes
+
+    def with_pushdowns(self, pushdowns: Pushdowns) -> "ScanTask":
+        return ScanTask(self.path, self.format, self.schema, pushdowns,
+                        self.storage_options, self._num_rows, self._size_bytes,
+                        self.stats, self.row_group_ids)
+
+    def can_prune(self) -> bool:
+        """True if file-level stats prove the pushdown filter matches no rows."""
+        if self.pushdowns.filters is None or self.stats is None:
+            return False
+        return not filter_may_match(self.pushdowns.filters, self.stats)
+
+    def read(self):
+        """Materialize this scan task into a Table (applies pushdowns)."""
+        from .readers import read_csv_table, read_json_table, read_parquet_table
+
+        if self.format == FileFormat.PARQUET:
+            return read_parquet_table(self.path, self.pushdowns, schema=self.schema,
+                                      row_group_ids=self.row_group_ids)
+        if self.format == FileFormat.CSV:
+            return read_csv_table(self.path, self.pushdowns, schema=self.schema,
+                                  **self.storage_options)
+        if self.format == FileFormat.JSON:
+            return read_json_table(self.path, self.pushdowns, schema=self.schema)
+        raise ValueError(f"unknown scan format {self.format!r}")
+
+
+def glob_paths(path) -> List[str]:
+    """Expand a path / glob / directory / list thereof into concrete file paths.
+
+    Reference: daft-scan glob.rs + daft/io common path handling. Local
+    filesystem only; object stores are routed through fsspec-style options in
+    storage_options (gated: zero-egress environment).
+    """
+    if isinstance(path, (list, tuple)):
+        out: List[str] = []
+        for p in path:
+            out.extend(glob_paths(p))
+        return out
+    p = str(path)
+    if p.startswith("file://"):
+        p = p[len("file://"):]
+    if os.path.isdir(p):
+        files = sorted(
+            os.path.join(p, f) for f in os.listdir(p)
+            if not f.startswith(".") and not f.startswith("_")
+            and os.path.isfile(os.path.join(p, f))
+        )
+        if not files:
+            raise FileNotFoundError(f"no files found in directory {p!r}")
+        return files
+    if any(ch in p for ch in "*?["):
+        files = sorted(f for f in _glob.glob(p, recursive=True) if os.path.isfile(f))
+        if not files:
+            raise FileNotFoundError(f"glob {p!r} matched no files")
+        return files
+    if not os.path.exists(p):
+        raise FileNotFoundError(f"path {p!r} does not exist")
+    return [p]

@@ -1,0 +1,102 @@
+"""Tabular writers: Table/MicroPartition → parquet/csv/json files.
+
+Role-equivalent to the reference's daft/table/table_io.py:401 (write_tabular):
+writes one or more files per partition (splitting at a target file size),
+optionally hive-partitioned by key columns, and returns a manifest Table of
+written file paths (the reference's write result schema).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as papq
+
+from ..schema import Field, Schema
+from ..series import Series
+from ..table import Table
+
+TARGET_FILE_SIZE_BYTES = 512 * 1024 * 1024
+
+
+def _write_one(arrow_tbl: pa.Table, root: str, format: str, compression: Optional[str],
+               idx: int) -> str:
+    name = f"{uuid.uuid4().hex[:16]}-{idx}.{format}"
+    path = os.path.join(root, name)
+    if format == "parquet":
+        papq.write_table(arrow_tbl, path, compression=compression or "snappy")
+    elif format == "csv":
+        pacsv.write_csv(arrow_tbl, path)
+    elif format == "json":
+        with open(path, "w") as f:
+            cols = arrow_tbl.to_pydict()
+            names = list(cols)
+            import json as _json
+
+            for row in zip(*cols.values()) if names else []:
+                f.write(_json.dumps(dict(zip(names, row)), default=str) + "\n")
+    else:
+        raise ValueError(f"unknown write format {format!r}")
+    return path
+
+
+def write_tabular(tbl: Table, root_dir: str, format: str = "parquet",
+                  compression: Optional[str] = None,
+                  partition_cols: Optional[Sequence] = None,
+                  target_file_size: int = TARGET_FILE_SIZE_BYTES) -> Table:
+    """Write a table; returns a manifest table with a 'path' column (plus the
+    partition key columns when hive-partitioning)."""
+    os.makedirs(root_dir, exist_ok=True)
+    paths: List[str] = []
+    part_vals: List[Dict[str, Any]] = []
+
+    if partition_cols:
+        parts, uniq = tbl.partition_by_value(list(partition_cols))
+        key_names = uniq.column_names
+        uniq_rows = uniq.to_pylist()
+        for part, keyrow in zip(parts, uniq_rows):
+            subdir = os.path.join(
+                root_dir,
+                *[f"{k}={_hive_value(v)}" for k, v in keyrow.items()],
+            )
+            os.makedirs(subdir, exist_ok=True)
+            drop = [c for c in part.column_names if c not in key_names] or part.column_names
+            body = part.select_columns(drop)
+            for i, chunk in enumerate(_split_by_size(body, target_file_size)):
+                p = _write_one(chunk.to_arrow(), subdir, format, compression, i)
+                paths.append(p)
+                part_vals.append(keyrow)
+        cols = [Series.from_pylist(paths, "path")]
+        fields = [Field("path", cols[0].dtype)]
+        for k in key_names:
+            s = Series.from_pylist([pv[k] for pv in part_vals], k)
+            cols.append(s)
+            fields.append(Field(k, s.dtype))
+        return Table(Schema(fields), cols)
+
+    for i, chunk in enumerate(_split_by_size(tbl, target_file_size)):
+        paths.append(_write_one(chunk.to_arrow(), root_dir, format, compression, i))
+    s = Series.from_pylist(paths, "path")
+    return Table(Schema([Field("path", s.dtype)]), [s])
+
+
+def _split_by_size(tbl: Table, target: int):
+    n = len(tbl)
+    if n == 0:
+        yield tbl
+        return
+    total = max(tbl.size_bytes(), 1)
+    n_files = max(1, (total + target - 1) // target)
+    rows_per = (n + n_files - 1) // n_files
+    for start in range(0, n, rows_per):
+        yield tbl.slice(start, min(start + rows_per, n))
+
+
+def _hive_value(v: Any) -> str:
+    if v is None:
+        return "__HIVE_DEFAULT_PARTITION__"
+    return str(v).replace("/", "%2F")

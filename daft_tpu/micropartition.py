@@ -1,0 +1,242 @@
+"""MicroPartition: the unit of execution — a lazily-materialized batch.
+
+Role-equivalent to the reference's src/daft-micropartition/src/micropartition.rs:35-78:
+a partition is either Unloaded (a ScanTask — schema + pushdowns + file metadata,
+no bytes decoded yet) or Loaded (one or more concrete Tables). Compute ops force
+materialization; metadata ops (len/schema/stats) answer from file footers when
+possible so planning never triggers IO. Concat of loaded partitions is O(1)
+(tables are chained, not copied) — matching the reference's Vec<Table> design.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from .schema import Schema
+from .stats import TableStats
+from .table import Table
+
+
+class MicroPartition:
+    __slots__ = ("schema", "_state", "_tables", "_scan_task", "_stats", "_lock")
+
+    def __init__(self, schema: Schema, tables: Optional[List[Table]] = None,
+                 scan_task=None, stats: Optional[TableStats] = None):
+        if (tables is None) == (scan_task is None):
+            raise ValueError("MicroPartition needs exactly one of tables / scan_task")
+        self.schema = schema
+        self._tables = tables
+        self._scan_task = scan_task
+        self._state = "loaded" if tables is not None else "unloaded"
+        self._stats = stats
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ ctors
+    @staticmethod
+    def from_table(tbl: Table) -> "MicroPartition":
+        return MicroPartition(tbl.schema, tables=[tbl])
+
+    @staticmethod
+    def from_tables(tables: List[Table]) -> "MicroPartition":
+        if not tables:
+            raise ValueError("from_tables requires at least one table (use empty())")
+        return MicroPartition(tables[0].schema, tables=list(tables))
+
+    @staticmethod
+    def from_scan_task(task) -> "MicroPartition":
+        return MicroPartition(task.materialized_schema, scan_task=task, stats=task.stats)
+
+    @staticmethod
+    def empty(schema: Optional[Schema] = None) -> "MicroPartition":
+        schema = schema or Schema.empty()
+        return MicroPartition.from_table(Table.empty(schema))
+
+    @staticmethod
+    def from_pydict(data: Dict[str, Any]) -> "MicroPartition":
+        return MicroPartition.from_table(Table.from_pydict(data))
+
+    @staticmethod
+    def from_arrow(tbl) -> "MicroPartition":
+        return MicroPartition.from_table(Table.from_arrow(tbl))
+
+    # ------------------------------------------------------------------ state
+    def is_loaded(self) -> bool:
+        return self._state == "loaded"
+
+    def scan_task(self):
+        return self._scan_task
+
+    def table(self) -> Table:
+        """Materialize to a single concrete Table (loads + concats if needed)."""
+        with self._lock:
+            if self._state == "unloaded":
+                tbl = self._scan_task.read()
+                self._tables = [tbl]
+                self._state = "loaded"
+                self._scan_task = None
+            if len(self._tables) > 1:
+                self._tables = [Table.concat(self._tables)]
+            return self._tables[0]
+
+    def __len__(self) -> int:
+        n = self.num_rows_or_none()
+        if n is not None:
+            return n
+        return len(self.table())
+
+    def num_rows_or_none(self) -> Optional[int]:
+        """Row count without IO, if knowable (loaded, or exact scan metadata)."""
+        if self._state == "loaded":
+            return sum(len(t) for t in self._tables)
+        return self._scan_task.num_rows()
+
+    def size_bytes(self) -> Optional[int]:
+        if self._state == "loaded":
+            return sum(t.size_bytes() for t in self._tables)
+        return self._scan_task.size_bytes()
+
+    def statistics(self) -> Optional[TableStats]:
+        return self._stats
+
+    @property
+    def column_names(self) -> List[str]:
+        return self.schema.field_names()
+
+    def __repr__(self) -> str:
+        if self._state == "unloaded":
+            return f"MicroPartition(Unloaded {self._scan_task!r})"
+        return f"MicroPartition(Loaded rows={len(self)})"
+
+    # ------------------------------------------------------------------ conversions
+    def to_arrow(self):
+        return self.table().to_arrow()
+
+    def to_pydict(self) -> Dict[str, list]:
+        return self.table().to_pydict()
+
+    def to_pylist(self) -> List[dict]:
+        return self.table().to_pylist()
+
+    def to_pandas(self):
+        return self.table().to_pandas()
+
+    def get_column(self, name: str):
+        return self.table().get_column(name)
+
+    # ------------------------------------------------------------------ compute ops
+    # Each materializes and delegates to Table, returning a Loaded partition.
+
+    def _wrap(self, tbl: Table) -> "MicroPartition":
+        return MicroPartition.from_table(tbl)
+
+    def eval_expression_list(self, exprs) -> "MicroPartition":
+        return self._wrap(self.table().eval_expression_list(exprs))
+
+    def filter(self, predicate) -> "MicroPartition":
+        return self._wrap(self.table().filter(predicate))
+
+    def take(self, indices) -> "MicroPartition":
+        return self._wrap(self.table().take(indices))
+
+    def slice(self, start: int, end: int) -> "MicroPartition":
+        return self._wrap(self.table().slice(start, end))
+
+    def head(self, n: int) -> "MicroPartition":
+        if self._state == "unloaded":
+            # narrow the scan's limit instead of reading everything
+            task = self._scan_task
+            pd = task.pushdowns
+            new_limit = n if pd.limit is None else min(pd.limit, n)
+            narrowed = task.with_pushdowns(pd.with_limit(new_limit))
+            return MicroPartition.from_scan_task(narrowed)
+        return self._wrap(self.table().head(n))
+
+    def sample(self, fraction=None, size=None, with_replacement=False, seed=None) -> "MicroPartition":
+        return self._wrap(self.table().sample(fraction, size, with_replacement, seed))
+
+    def sort(self, sort_keys, descending=None, nulls_first=None) -> "MicroPartition":
+        return self._wrap(self.table().sort(sort_keys, descending, nulls_first))
+
+    def argsort(self, sort_keys, descending=None, nulls_first=None):
+        return self.table().argsort(sort_keys, descending, nulls_first)
+
+    def agg(self, to_agg, group_by=None) -> "MicroPartition":
+        return self._wrap(self.table().agg(to_agg, group_by))
+
+    def distinct(self, subset=None) -> "MicroPartition":
+        return self._wrap(self.table().distinct(subset))
+
+    def explode(self, exprs) -> "MicroPartition":
+        return self._wrap(self.table().explode(exprs))
+
+    def unpivot(self, ids, values, variable_name="variable", value_name="value") -> "MicroPartition":
+        return self._wrap(self.table().unpivot(ids, values, variable_name, value_name))
+
+    def pivot(self, group_by, pivot_col, value_col, names, agg_fn="sum") -> "MicroPartition":
+        return self._wrap(self.table().pivot(group_by, pivot_col, value_col, names, agg_fn))
+
+    def hash_join(self, right: "MicroPartition", left_on, right_on, how="inner",
+                  suffix="right.") -> "MicroPartition":
+        return self._wrap(self.table().hash_join(right.table(), left_on, right_on, how, suffix))
+
+    def sort_merge_join(self, right: "MicroPartition", left_on, right_on, how="inner",
+                        suffix="right.", is_sorted=False) -> "MicroPartition":
+        return self._wrap(self.table().sort_merge_join(right.table(), left_on, right_on,
+                                                       how, suffix, is_sorted))
+
+    def add_monotonic_id(self, partition_offset: int = 0, column_name: str = "id") -> "MicroPartition":
+        return self._wrap(self.table().add_monotonic_id(partition_offset, column_name))
+
+    def select_columns(self, names: List[str]) -> "MicroPartition":
+        if self._state == "unloaded":
+            task = self._scan_task
+            pd = task.pushdowns
+            cols = [c for c in names]
+            narrowed = task.with_pushdowns(pd.with_columns(cols))
+            return MicroPartition.from_scan_task(narrowed)
+        return self._wrap(self.table().select_columns(names))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "MicroPartition":
+        return self._wrap(self.table().rename_columns(mapping))
+
+    def cast_to_schema(self, schema: Schema) -> "MicroPartition":
+        return self._wrap(self.table().cast_to_schema(schema))
+
+    def partition_by_hash(self, exprs, num_partitions: int) -> List["MicroPartition"]:
+        return [self._wrap(t) for t in self.table().partition_by_hash(exprs, num_partitions)]
+
+    def partition_by_random(self, num_partitions: int, seed: int = 0) -> List["MicroPartition"]:
+        return [self._wrap(t) for t in self.table().partition_by_random(num_partitions, seed)]
+
+    def partition_by_range(self, exprs, boundaries: Table, descending=None,
+                           nulls_first=None) -> List["MicroPartition"]:
+        return [self._wrap(t) for t in
+                self.table().partition_by_range(exprs, boundaries, descending, nulls_first)]
+
+    def partition_by_value(self, exprs) -> Tuple[List["MicroPartition"], Table]:
+        parts, uniq = self.table().partition_by_value(exprs)
+        return [self._wrap(t) for t in parts], uniq
+
+    def hash_rows(self, exprs=None, seed: int = 0):
+        return self.table().hash_rows(exprs, seed)
+
+    @staticmethod
+    def concat(parts: List["MicroPartition"]) -> "MicroPartition":
+        """O(1) concat: chains loaded tables; forces unloaded inputs."""
+        if not parts:
+            raise ValueError("concat of zero partitions")
+        tables: List[Table] = []
+        for p in parts:
+            if p._state == "loaded":
+                tables.extend(p._tables)
+            else:
+                tables.append(p.table())
+        tables = [t for t in tables if len(t) > 0] or [tables[0]]
+        return MicroPartition(parts[0].schema, tables=tables)
+
+    def write_tabular(self, root_dir: str, format: str = "parquet",
+                      compression: Optional[str] = None, partition_cols=None) -> "MicroPartition":
+        from .io.writer import write_tabular
+
+        return self._wrap(write_tabular(self.table(), root_dir, format, compression, partition_cols))

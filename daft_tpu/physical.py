@@ -1,0 +1,844 @@
+"""Physical plan: executable operators over streams of MicroPartitions.
+
+Role-equivalent to the reference's src/daft-plan/src/physical_plan.rs +
+physical_planner/translate.rs (notably the two-stage aggregation decomposition
+at translate.rs:761) and the partition-task generators of
+daft/execution/physical_plan.py (fanout/reduce at :1365, sort at :1414).
+
+Execution model: each operator is a generator over MicroPartitions — streaming
+ops (scan/project/filter/limit) never hold more than one partition; pipeline
+breakers (sort/shuffle/agg-final/join-build) buffer what they must. The same
+operator tree executes single-chip today and maps onto a device mesh via the
+parallel/ shuffle kernels (partition i ↔ mesh slot i).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .expressions import AggExpr, Alias, Expression, col, lit
+from .logical import (
+    Aggregate,
+    Concat,
+    Distinct,
+    Explode,
+    Filter,
+    InMemorySource,
+    Join,
+    Limit,
+    LogicalPlan,
+    MonotonicallyIncreasingId,
+    Pivot,
+    Project,
+    Repartition,
+    Sample,
+    ScanSource,
+    Sort,
+    Unpivot,
+    Write,
+)
+from .micropartition import MicroPartition
+from .schema import Schema
+
+PartStream = Iterator[MicroPartition]
+
+
+class PhysicalOp:
+    """Base: children + a generator-producing execute()."""
+
+    def __init__(self, children: List["PhysicalOp"], schema: Schema, num_partitions: int):
+        self.children = children
+        self.schema = schema
+        self.num_partitions = num_partitions
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def execute(self, inputs: List[PartStream], ctx) -> PartStream:
+        raise NotImplementedError
+
+    def display_tree(self, indent: str = "") -> str:
+        out = [indent + ("* " if indent else "") + self.describe()]
+        for c in self.children:
+            out.append(c.display_tree(indent + "  "))
+        return "\n".join(out)
+
+    def describe(self) -> str:
+        return f"{self.name()} [{self.num_partitions} parts]"
+
+    def __repr__(self) -> str:
+        return self.display_tree()
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+class ScanOp(PhysicalOp):
+    def __init__(self, tasks: List[Any], schema: Schema):
+        super().__init__([], schema, max(len(tasks), 1))
+        self.tasks = tasks
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for task in self.tasks:
+            if task.can_prune():
+                ctx.stats.bump("scan_tasks_pruned")
+                continue
+            ctx.stats.bump("scan_tasks_emitted")
+            yield MicroPartition.from_scan_task(task)
+
+    def describe(self):
+        return f"Scan [{len(self.tasks)} tasks]"
+
+
+class InMemoryOp(PhysicalOp):
+    def __init__(self, parts: List[MicroPartition], schema: Schema):
+        super().__init__([], schema, max(len(parts), 1))
+        self.parts = parts
+
+    def execute(self, inputs, ctx) -> PartStream:
+        yield from self.parts
+
+
+# ---------------------------------------------------------------------------
+# streaming unary ops
+# ---------------------------------------------------------------------------
+
+class ProjectOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, exprs: List[Expression], schema: Schema):
+        super().__init__([child], schema, child.num_partitions)
+        self.exprs = exprs
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for part in inputs[0]:
+            yield ctx.eval_projection(part, self.exprs)
+
+    def describe(self):
+        return "Project: " + ", ".join(e._node.display() for e in self.exprs)
+
+
+class FilterOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, predicate: Expression):
+        super().__init__([child], child.schema, child.num_partitions)
+        self.predicate = predicate
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for part in inputs[0]:
+            yield part.filter([self.predicate])
+
+    def describe(self):
+        return f"Filter: {self.predicate._node.display()}"
+
+
+class LimitOp(PhysicalOp):
+    """Streaming global limit with early stop (reference: global_limit,
+    physical_plan.py — iterative partition takes)."""
+
+    def __init__(self, child: PhysicalOp, limit: int):
+        super().__init__([child], child.schema, child.num_partitions)
+        self.limit = limit
+
+    def execute(self, inputs, ctx) -> PartStream:
+        remaining = self.limit
+        for part in inputs[0]:
+            if remaining <= 0:
+                break
+            n = part.num_rows_or_none()
+            if n is None or n > remaining:
+                part = part.head(remaining)
+            remaining -= len(part)
+            yield part
+
+    def describe(self):
+        return f"Limit: {self.limit}"
+
+
+class ExplodeOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, exprs: List[Expression], schema: Schema):
+        super().__init__([child], schema, child.num_partitions)
+        self.exprs = exprs
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for part in inputs[0]:
+            yield part.explode(self.exprs)
+
+
+class UnpivotOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, ids, values, variable_name, value_name, schema: Schema):
+        super().__init__([child], schema, child.num_partitions)
+        self.ids = ids
+        self.values = values
+        self.variable_name = variable_name
+        self.value_name = value_name
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for part in inputs[0]:
+            yield part.unpivot(self.ids, self.values, self.variable_name, self.value_name)
+
+
+class SampleOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, fraction: float, with_replacement: bool, seed):
+        super().__init__([child], child.schema, child.num_partitions)
+        self.fraction = fraction
+        self.with_replacement = with_replacement
+        self.seed = seed
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for i, part in enumerate(inputs[0]):
+            seed = None if self.seed is None else self.seed + i
+            yield part.sample(fraction=self.fraction, with_replacement=self.with_replacement,
+                              seed=seed)
+
+
+class MonotonicIdOp(PhysicalOp):
+    """Per-partition ids offset by partition_index << 36 (reference:
+    monotonically_increasing_id partition encoding)."""
+
+    def __init__(self, child: PhysicalOp, column_name: str, schema: Schema):
+        super().__init__([child], schema, child.num_partitions)
+        self.column_name = column_name
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for i, part in enumerate(inputs[0]):
+            yield part.add_monotonic_id(i << 36, self.column_name)
+
+
+class WriteOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, root_dir: str, format: str,
+                 compression, partition_cols, schema: Schema):
+        super().__init__([child], schema, child.num_partitions)
+        self.root_dir = root_dir
+        self.format = format
+        self.compression = compression
+        self.partition_cols = partition_cols
+
+    def execute(self, inputs, ctx) -> PartStream:
+        wrote = False
+        for part in inputs[0]:
+            wrote = True
+            yield part.write_tabular(self.root_dir, self.format, self.compression,
+                                     self.partition_cols)
+        if not wrote:
+            yield MicroPartition.empty(self.schema)
+
+
+# ---------------------------------------------------------------------------
+# pipeline breakers
+# ---------------------------------------------------------------------------
+
+class CoalesceOp(PhysicalOp):
+    """N partitions -> M partitions without a shuffle ('into_partitions')."""
+
+    def __init__(self, child: PhysicalOp, num: int):
+        super().__init__([child], child.schema, num)
+        self.num = num
+
+    def execute(self, inputs, ctx) -> PartStream:
+        parts = [p for p in inputs[0]]
+        if not parts:
+            return
+        total = sum(len(p) for p in parts)
+        if self.num >= len(parts):
+            # split: rebalance rows evenly
+            big = MicroPartition.concat(parts) if len(parts) > 1 else parts[0]
+            per = (total + self.num - 1) // self.num if self.num else total
+            for i in range(self.num):
+                lo = min(i * per, total)
+                hi = min((i + 1) * per, total)
+                yield big.slice(lo, hi)
+        else:
+            # merge adjacent chunks
+            per = (len(parts) + self.num - 1) // self.num
+            for i in range(0, len(parts), per):
+                group = parts[i:i + per]
+                yield MicroPartition.concat(group) if len(group) > 1 else group[0]
+
+
+class ShuffleOp(PhysicalOp):
+    """Fanout+reduce all-to-all exchange (reference: FanoutInstruction +
+    ReduceMerge, physical_plan.py:1365). scheme: hash | random | range."""
+
+    def __init__(self, child: PhysicalOp, scheme: str, num: int,
+                 by: Optional[List[Expression]] = None,
+                 descending: Optional[List[bool]] = None,
+                 nulls_first: Optional[List[Optional[bool]]] = None):
+        super().__init__([child], child.schema, num)
+        self.scheme = scheme
+        self.num = num
+        self.by = by or []
+        self.descending = descending or [False] * len(self.by)
+        self.nulls_first = nulls_first if nulls_first is not None else [None] * len(self.by)
+
+    def execute(self, inputs, ctx) -> PartStream:
+        parts = [p for p in inputs[0]]
+        if not parts:
+            return
+        n = self.num
+        buckets: List[List[MicroPartition]] = [[] for _ in range(n)]
+        if self.scheme == "range":
+            boundaries = sample_boundaries(parts, self.by, n, self.descending,
+                                           self.nulls_first,
+                                           ctx.cfg.sample_size_for_sort)
+            for p in parts:
+                for i, piece in enumerate(p.partition_by_range(self.by, boundaries,
+                                                               self.descending,
+                                                               self.nulls_first)):
+                    buckets[min(i, n - 1)].append(piece)
+        else:
+            for pi, p in enumerate(parts):
+                if self.scheme == "hash":
+                    pieces = p.partition_by_hash(self.by, n)
+                else:
+                    pieces = p.partition_by_random(n, seed=pi)
+                for i, piece in enumerate(pieces):
+                    buckets[i].append(piece)
+        ctx.stats.bump("shuffles")
+        for i in range(n):
+            if buckets[i]:
+                yield MicroPartition.concat(buckets[i])
+            else:
+                yield MicroPartition.empty(self.schema)
+
+    def describe(self):
+        by = ", ".join(e._node.display() for e in self.by)
+        return f"Shuffle[{self.scheme}] -> {self.num}" + (f" by [{by}]" if by else "")
+
+
+def sample_boundaries(parts: List[MicroPartition], by: List[Expression], num: int,
+                      descending: List[bool],
+                      nulls_first: Optional[List[Optional[bool]]] = None,
+                      sample_size: int = 20):
+    """Sample sort keys and pick num-1 quantile boundary rows (reference:
+    sort sampling in physical_plan.py:1414; sample size per partition scales
+    with ExecutionConfig.sample_size_for_sort)."""
+    from .table import Table
+
+    key_tables = []
+    for p in parts:
+        t = p.table()
+        if len(t) == 0:
+            continue
+        keys = t.eval_expression_list(by)
+        k = min(len(keys), max(sample_size, sample_size * num))
+        key_tables.append(keys.sample(size=k, seed=0) if k < len(keys) else keys)
+    if not key_tables:
+        empty = parts[0].table().eval_expression_list(by)
+        return empty.slice(0, 0)
+    allk = Table.concat(key_tables)
+    skeys = [col(n) for n in allk.column_names]
+    allk = allk.sort(skeys, descending=descending, nulls_first=nulls_first)
+    m = len(allk)
+    idxs = [int(np.floor(m * (i + 1) / num)) for i in range(num - 1)]
+    idxs = [min(max(i, 0), m - 1) for i in idxs]
+    import pyarrow as pa
+
+    from .series import Series
+
+    return allk.take(Series.from_arrow(pa.array(np.asarray(idxs, dtype=np.uint64)), "i"))
+
+
+class SortOp(PhysicalOp):
+    """Per-partition sort; upstream ShuffleOp(range) makes it a global sort."""
+
+    def __init__(self, child: PhysicalOp, sort_by, descending, nulls_first):
+        super().__init__([child], child.schema, child.num_partitions)
+        self.sort_by = sort_by
+        self.descending = descending
+        self.nulls_first = nulls_first
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for part in inputs[0]:
+            yield part.sort(self.sort_by, self.descending, self.nulls_first)
+
+    def describe(self):
+        return "Sort: " + ", ".join(e._node.display() for e in self.sort_by)
+
+
+class AggregateOp(PhysicalOp):
+    """Full aggregation per partition (single-partition finals and stage
+    executions both use this)."""
+
+    def __init__(self, child: PhysicalOp, aggregations: List[Expression],
+                 groupby: List[Expression], schema: Schema):
+        super().__init__([child], schema, child.num_partitions)
+        self.aggregations = aggregations
+        self.groupby = groupby
+
+    def execute(self, inputs, ctx) -> PartStream:
+        empty = True
+        for part in inputs[0]:
+            empty = False
+            yield part.agg(self.aggregations, self.groupby or None)
+        if empty and not self.groupby:
+            # global agg over zero partitions still yields one row (count=0 etc.)
+            yield MicroPartition.empty(self.children[0].schema).agg(self.aggregations, None)
+
+    def describe(self):
+        a = ", ".join(e._node.display() for e in self.aggregations)
+        g = ", ".join(e._node.display() for e in self.groupby)
+        return f"Aggregate: {a}" + (f" by [{g}]" if g else "")
+
+
+class GatherOp(PhysicalOp):
+    """All partitions -> one (global agg finals, small sorts, sort_merge)."""
+
+    def __init__(self, child: PhysicalOp):
+        super().__init__([child], child.schema, 1)
+
+    def execute(self, inputs, ctx) -> PartStream:
+        parts = [p for p in inputs[0]]
+        if not parts:
+            yield MicroPartition.empty(self.schema)
+        elif len(parts) == 1:
+            yield parts[0]
+        else:
+            yield MicroPartition.concat(parts)
+
+
+class DistinctOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, subset: Optional[List[Expression]]):
+        super().__init__([child], child.schema, child.num_partitions)
+        self.subset = subset
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for part in inputs[0]:
+            yield part.distinct(self.subset)
+
+
+class PivotOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, groupby, pivot_col, value_col, agg_fn, names,
+                 schema: Schema):
+        super().__init__([child], schema, 1)
+        self.groupby = groupby
+        self.pivot_col = pivot_col
+        self.value_col = value_col
+        self.agg_fn = agg_fn
+        self.names = names
+
+    def execute(self, inputs, ctx) -> PartStream:
+        parts = [p for p in inputs[0]]
+        part = MicroPartition.concat(parts) if len(parts) > 1 else (
+            parts[0] if parts else MicroPartition.empty(self.children[0].schema))
+        out = part.pivot(self.groupby, self.pivot_col, self.value_col, self.names, self.agg_fn)
+        yield out.cast_to_schema(self.schema)
+
+
+class ConcatOp(PhysicalOp):
+    def __init__(self, left: PhysicalOp, right: PhysicalOp, schema: Schema):
+        super().__init__([left, right], schema, left.num_partitions + right.num_partitions)
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for part in inputs[0]:
+            yield part.cast_to_schema(self.schema)
+        for part in inputs[1]:
+            yield part.cast_to_schema(self.schema)
+
+
+class HashJoinOp(PhysicalOp):
+    """Partition-aligned join: bucket i of left joins bucket i of right.
+    Upstream ShuffleOps co-partition both sides."""
+
+    def __init__(self, left: PhysicalOp, right: PhysicalOp, left_on, right_on,
+                 how: str, schema: Schema, suffix: str = "right."):
+        super().__init__([left, right], schema, max(left.num_partitions, right.num_partitions))
+        self.left_on = left_on
+        self.right_on = right_on
+        self.how = how
+        self.suffix = suffix
+
+    def execute(self, inputs, ctx) -> PartStream:
+        lparts = [p for p in inputs[0]]
+        rparts = [p for p in inputs[1]]
+        n = max(len(lparts), len(rparts))
+        lschema = self.children[0].schema
+        rschema = self.children[1].schema
+        for i in range(n):
+            l = lparts[i] if i < len(lparts) else MicroPartition.empty(lschema)
+            r = rparts[i] if i < len(rparts) else MicroPartition.empty(rschema)
+            yield l.hash_join(r, self.left_on, self.right_on, self.how, self.suffix)
+
+    def describe(self):
+        return f"HashJoin[{self.how}]"
+
+
+class BroadcastJoinOp(PhysicalOp):
+    """Collect the small side fully, stream the large side (reference:
+    broadcast join strategy, translate.rs join planning)."""
+
+    def __init__(self, big: PhysicalOp, small: PhysicalOp, big_on, small_on,
+                 how: str, schema: Schema, small_is_left: bool, suffix: str = "right."):
+        super().__init__([big, small], schema, big.num_partitions)
+        self.big_on = big_on
+        self.small_on = small_on
+        self.how = how
+        self.small_is_left = small_is_left
+        self.suffix = suffix
+
+    def execute(self, inputs, ctx) -> PartStream:
+        small_parts = [p for p in inputs[1]]
+        small = (MicroPartition.concat(small_parts) if len(small_parts) > 1
+                 else (small_parts[0] if small_parts else MicroPartition.empty(self.children[1].schema)))
+        ctx.stats.bump("broadcast_joins")
+        for part in inputs[0]:
+            if self.small_is_left:
+                yield small.hash_join(part, self.small_on, self.big_on, self.how, self.suffix)
+            else:
+                yield part.hash_join(small, self.big_on, self.small_on, self.how, self.suffix)
+
+    def describe(self):
+        return f"BroadcastJoin[{self.how}]"
+
+
+class SortMergeJoinOp(PhysicalOp):
+    """Both sides gathered + merge-joined sorted (v1: single-partition merge;
+    range-partitioned merge arrives with the mesh runner)."""
+
+    def __init__(self, left: PhysicalOp, right: PhysicalOp, left_on, right_on,
+                 how: str, schema: Schema, suffix: str = "right."):
+        super().__init__([left, right], schema, 1)
+        self.left_on = left_on
+        self.right_on = right_on
+        self.how = how
+        self.suffix = suffix
+
+    def execute(self, inputs, ctx) -> PartStream:
+        lparts = [p for p in inputs[0]]
+        rparts = [p for p in inputs[1]]
+        l = MicroPartition.concat(lparts) if len(lparts) > 1 else (
+            lparts[0] if lparts else MicroPartition.empty(self.children[0].schema))
+        r = MicroPartition.concat(rparts) if len(rparts) > 1 else (
+            rparts[0] if rparts else MicroPartition.empty(self.children[1].schema))
+        yield l.sort_merge_join(r, self.left_on, self.right_on, self.how, self.suffix)
+
+
+class CrossJoinOp(PhysicalOp):
+    def __init__(self, left: PhysicalOp, right: PhysicalOp, schema: Schema, suffix: str):
+        super().__init__([left, right], schema, left.num_partitions)
+        self.suffix = suffix
+
+    def execute(self, inputs, ctx) -> PartStream:
+        rparts = [p for p in inputs[1]]
+        right = (MicroPartition.concat(rparts) if len(rparts) > 1
+                 else (rparts[0] if rparts else MicroPartition.empty(self.children[1].schema)))
+        key = "__cross_key"
+        rk = right.eval_expression_list(
+            [col(c) for c in right.column_names] + [lit(1).alias(key)])
+        for part in inputs[0]:
+            lk = part.eval_expression_list(
+                [col(c) for c in part.column_names] + [lit(1).alias(key)])
+            joined = lk.hash_join(rk, [col(key)], [col(key)], "inner", self.suffix)
+            keep = [c for c in joined.column_names if c != key]
+            yield joined.select_columns(keep).cast_to_schema(self.schema)
+
+
+# ---------------------------------------------------------------------------
+# two-stage aggregation decomposition (reference: translate.rs:761
+# populate_aggregation_stages)
+# ---------------------------------------------------------------------------
+
+DECOMPOSABLE = {"sum", "count", "mean", "min", "max", "list", "concat", "any_value", "stddev"}
+
+
+def _strip_alias(e: Expression) -> AggExpr:
+    n = e._node
+    while isinstance(n, Alias):
+        n = n.child
+    if not isinstance(n, AggExpr):
+        raise ValueError(f"expected aggregation expression, got {e!r}")
+    return n
+
+
+def aggs_decomposable(aggs: List[Expression]) -> bool:
+    try:
+        return all(_strip_alias(e).kind in DECOMPOSABLE for e in aggs)
+    except ValueError:
+        return False
+
+
+def populate_aggregation_stages(
+    aggs: List[Expression],
+) -> Tuple[List[Expression], List[Expression], List[Expression]]:
+    """Split aggregations into (first_stage, second_stage, final_projection).
+
+    first_stage runs per input partition; second_stage merges partials after a
+    shuffle on the group keys; final_projection computes derived results
+    (mean = sum/count, stddev = sqrt(m2)). Mirrors translate.rs:761.
+    """
+    stage1: List[Expression] = []
+    stage2: List[Expression] = []
+    final: List[Expression] = []
+    seen_ids: Dict[Tuple, str] = {}
+
+    def s1(kind: str, child_expr: Expression, tag: str, extra=None) -> str:
+        key = (kind, child_expr._node._key(), tag)
+        if key in seen_ids:
+            return seen_ids[key]
+        ident = f"__s1_{len(seen_ids)}_{kind}"
+        seen_ids[key] = ident
+        stage1.append(Expression(AggExpr(kind, child_expr._node, extra)).alias(ident))
+        merge_kind = {"sum": "sum", "count": "sum", "min": "min", "max": "max",
+                      "list": "concat", "concat": "concat", "any_value": "any_value"}[kind]
+        stage2.append(Expression(AggExpr(merge_kind, col(ident)._node,
+                                         extra if kind == "any_value" else None)).alias(ident))
+        return ident
+
+    for e in aggs:
+        node = _strip_alias(e)
+        alias = e.name()
+        child = Expression(node.child)
+        k = node.kind
+        if k in ("sum", "min", "max"):
+            ident = s1(k, child, "")
+            final.append(col(ident).alias(alias))
+        elif k == "count":
+            ident = s1("count", child, node.extra.get("mode", "valid"), dict(node.extra))
+            final.append(col(ident).alias(alias))
+        elif k == "mean":
+            sid = s1("sum", child, "")
+            cid = s1("count", child, "valid", {"mode": "valid"})
+            final.append((col(sid) / col(cid)).alias(alias))
+        elif k == "stddev":
+            # population stddev via sum / sum-of-squares / count; the sum and
+            # count partials are shared with any sum()/mean() of the same child
+            sid = s1("sum", child, "")
+            qid = s1("sum", child * child, "")
+            cid = s1("count", child, "valid", {"mode": "valid"})
+            mean = col(sid) / col(cid)
+            var = (col(qid) / col(cid)) - (mean * mean)
+            # max(var, 0): clamp tiny negative fp error before sqrt
+            clamped = (var + abs(var)) / lit(2.0)
+            final.append((clamped ** lit(0.5)).alias(alias))
+        elif k == "list":
+            ident = s1("list", child, "list")
+            final.append(col(ident).alias(alias))
+        elif k == "concat":
+            ident = s1("concat", child, "concat")
+            final.append(col(ident).alias(alias))
+        elif k == "any_value":
+            ident = s1("any_value", child, "any", dict(node.extra))
+            final.append(col(ident).alias(alias))
+        else:
+            raise ValueError(f"aggregation {k!r} is not decomposable")
+    return stage1, stage2, final
+
+
+# ---------------------------------------------------------------------------
+# logical -> physical translation
+# ---------------------------------------------------------------------------
+
+def translate(plan: LogicalPlan, cfg) -> PhysicalOp:
+    """Translate an (optimized) logical plan to a physical operator tree.
+
+    cfg: ExecutionConfig (broadcast threshold, default partitions, etc.)
+    """
+    if isinstance(plan, InMemorySource):
+        return InMemoryOp(plan.partitions, plan.schema)
+
+    if isinstance(plan, ScanSource):
+        return ScanOp(plan.tasks, plan.schema)
+
+    if isinstance(plan, Project):
+        return ProjectOp(translate(plan.input, cfg), plan.exprs, plan.schema)
+
+    if isinstance(plan, Filter):
+        return FilterOp(translate(plan.input, cfg), plan.predicate)
+
+    if isinstance(plan, Limit):
+        return LimitOp(translate(plan.input, cfg), plan.limit)
+
+    if isinstance(plan, Explode):
+        return ExplodeOp(translate(plan.input, cfg), plan.to_explode, plan.schema)
+
+    if isinstance(plan, Unpivot):
+        return UnpivotOp(translate(plan.input, cfg), plan.ids, plan.values,
+                         plan.variable_name, plan.value_name, plan.schema)
+
+    if isinstance(plan, Sample):
+        return SampleOp(translate(plan.input, cfg), plan.fraction,
+                        plan.with_replacement, plan.seed)
+
+    if isinstance(plan, MonotonicallyIncreasingId):
+        return MonotonicIdOp(translate(plan.input, cfg), plan.column_name, plan.schema)
+
+    if isinstance(plan, Write):
+        return WriteOp(translate(plan.input, cfg), plan.root_dir, plan.format,
+                       plan.compression, plan.partition_cols, plan.schema)
+
+    if isinstance(plan, Sort):
+        child = translate(plan.input, cfg)
+        if child.num_partitions > 1:
+            child = ShuffleOp(child, "range", child.num_partitions, plan.sort_by,
+                              plan.descending, plan.nulls_first)
+        return SortOp(child, plan.sort_by, plan.descending, plan.nulls_first)
+
+    if isinstance(plan, Repartition):
+        child = translate(plan.input, cfg)
+        num = plan.num if plan.num is not None else child.num_partitions
+        if plan.scheme == "into":
+            if num == child.num_partitions:
+                return child
+            return CoalesceOp(child, num)
+        if plan.scheme == "hash":
+            return ShuffleOp(child, "hash", num, plan.by)
+        if plan.scheme == "range":
+            return ShuffleOp(child, "range", num, plan.by, plan.descending)
+        return ShuffleOp(child, "random", num)
+
+    if isinstance(plan, Distinct):
+        child = translate(plan.input, cfg)
+        subset = plan.subset
+        out = DistinctOp(child, subset)
+        if child.num_partitions > 1:
+            keys = subset if subset else [col(c) for c in plan.schema.field_names()]
+            out = DistinctOp(ShuffleOp(out, "hash", child.num_partitions, keys), subset)
+        return out
+
+    if isinstance(plan, Aggregate):
+        return _translate_aggregate(plan, cfg)
+
+    if isinstance(plan, Pivot):
+        child = translate(plan.input, cfg)
+        return PivotOp(child, plan.groupby, plan.pivot_col, plan.value_col,
+                       plan.agg_fn, plan.names, plan.schema)
+
+    if isinstance(plan, Concat):
+        l = translate(plan.input, cfg)
+        r = translate(plan.other, cfg)
+        return ConcatOp(l, r, plan.schema)
+
+    if isinstance(plan, Join):
+        return _translate_join(plan, cfg)
+
+    raise ValueError(f"cannot translate logical node {plan.name()}")
+
+
+def _translate_aggregate(plan: Aggregate, cfg) -> PhysicalOp:
+    child = translate(plan.input, cfg)
+    nparts = child.num_partitions
+
+    if nparts == 1:
+        return AggregateOp(child, plan.aggregations, plan.groupby, plan.schema)
+
+    if not aggs_decomposable(plan.aggregations):
+        # non-decomposable (count_distinct / percentiles / skew): shuffle raw
+        # rows by key, then full agg per partition (global: gather to one)
+        if plan.groupby:
+            shuffled = ShuffleOp(child, "hash", nparts, plan.groupby)
+            return AggregateOp(shuffled, plan.aggregations, plan.groupby, plan.schema)
+        gathered = GatherOp(child)
+        return AggregateOp(gathered, plan.aggregations, [], plan.schema)
+
+    stage1, stage2, final = populate_aggregation_stages(plan.aggregations)
+    key_cols = [col(e.name()) for e in plan.groupby]
+
+    p1 = AggregateOp(child, stage1, plan.groupby,
+                     _stage_schema(plan.input.schema, stage1, plan.groupby))
+    if plan.groupby:
+        exchanged: PhysicalOp = ShuffleOp(p1, "hash", nparts, key_cols)
+    else:
+        exchanged = GatherOp(p1)
+    p2 = AggregateOp(exchanged, stage2, key_cols,
+                     _stage_schema(p1.schema, stage2, key_cols))
+    final_exprs = key_cols + final
+    out = ProjectOp(p2, final_exprs, plan.schema)
+    # two-stage float results can drift in dtype (e.g. mean); align to plan schema
+    return _cast_to(out, plan.schema)
+
+
+def _stage_schema(input_schema: Schema, aggs: List[Expression], groupby: List[Expression]) -> Schema:
+    from .schema import Field
+
+    fields = []
+    for e in groupby:
+        f = e._node.to_field(input_schema)
+        fields.append(Field(e.name(), f.dtype))
+    for e in aggs:
+        f = e._node.to_field(input_schema)
+        fields.append(Field(e.name(), f.dtype))
+    return Schema(fields)
+
+
+class _CastOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, schema: Schema):
+        super().__init__([child], schema, child.num_partitions)
+
+    def execute(self, inputs, ctx) -> PartStream:
+        for part in inputs[0]:
+            yield part.cast_to_schema(self.schema)
+
+    def describe(self):
+        return "CastToSchema"
+
+
+def _cast_to(op: PhysicalOp, schema: Schema) -> PhysicalOp:
+    if op.schema == schema:
+        return op
+    return _CastOp(op, schema)
+
+
+def _translate_join(plan: Join, cfg) -> PhysicalOp:
+    left = translate(plan.left, cfg)
+    right = translate(plan.right, cfg)
+
+    if plan.how == "cross":
+        return CrossJoinOp(left, right, plan.schema, plan.suffix)
+
+    strategy = plan.strategy
+    if strategy is None:
+        strategy = _choose_join_strategy(plan, cfg)
+    if strategy == "broadcast" and plan.how == "outer":
+        # an outer join preserves both sides; replaying the replicated side per
+        # big-side partition would duplicate its unmatched rows
+        strategy = "hash"
+
+    if strategy == "broadcast":
+        lsize = plan.left.approx_size_bytes()
+        rsize = plan.right.approx_size_bytes()
+        broadcast_left = _broadcast_side(plan, lsize, rsize) == "left"
+        if broadcast_left:
+            return BroadcastJoinOp(right, left, plan.right_on, plan.left_on,
+                                   plan.how, plan.schema, small_is_left=True,
+                                   suffix=plan.suffix)
+        return BroadcastJoinOp(left, right, plan.left_on, plan.right_on,
+                               plan.how, plan.schema, small_is_left=False,
+                               suffix=plan.suffix)
+
+    if strategy == "sort_merge":
+        return SortMergeJoinOp(left, right, plan.left_on, plan.right_on,
+                               plan.how, plan.schema, plan.suffix)
+
+    # hash: co-partition both sides when >1 partition
+    nparts = max(left.num_partitions, right.num_partitions)
+    if nparts > 1:
+        left = ShuffleOp(left, "hash", nparts, plan.left_on)
+        right = ShuffleOp(right, "hash", nparts, plan.right_on)
+    return HashJoinOp(left, right, plan.left_on, plan.right_on, plan.how,
+                      plan.schema, plan.suffix)
+
+
+def _broadcast_side(plan: Join, lsize, rsize) -> str:
+    """Which side to replicate. The preserved side of an outer join can't be
+    broadcast (its unmatched rows must appear exactly once)."""
+    if plan.how in ("left", "semi", "anti"):
+        return "right"
+    if plan.how == "right":
+        return "left"
+    # inner: smaller side
+    if lsize is not None and (rsize is None or lsize <= rsize):
+        return "left"
+    return "right"
+
+
+def _choose_join_strategy(plan: Join, cfg) -> str:
+    lsize = plan.left.approx_size_bytes()
+    rsize = plan.right.approx_size_bytes()
+    threshold = cfg.broadcast_join_size_bytes_threshold
+    if plan.how == "outer":
+        return "hash"
+    side = _broadcast_side(plan, lsize, rsize)
+    size = lsize if side == "left" else rsize
+    if size is not None and size <= threshold:
+        return "broadcast"
+    return "hash"

@@ -125,3 +125,9 @@ class Schema:
     def _truncated_table_string(self) -> str:
         parts = [f"{f.name} ({f.dtype!r})" for f in self]
         return " | ".join(parts)
+
+    def short_repr(self, max_fields: int = 6) -> str:
+        parts = [f"{f.name}" for f in self]
+        if len(parts) > max_fields:
+            parts = parts[:max_fields] + [f"... +{len(parts) - max_fields}"]
+        return ", ".join(parts)

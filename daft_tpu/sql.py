@@ -1,0 +1,16 @@
+"""SQL frontend (placeholder — full planner lands with the SQL milestone).
+
+Role-equivalent to the reference's src/daft-sql/src/planner.rs:74. The real
+implementation (recursive-descent parser -> LogicalPlanBuilder) replaces this
+module; until then both entry points raise with a clear message.
+"""
+
+from __future__ import annotations
+
+
+def sql(query: str, **catalog):
+    raise NotImplementedError("daft_tpu.sql is not wired up yet in this build")
+
+
+def sql_expr(text: str):
+    raise NotImplementedError("daft_tpu.sql_expr is not wired up yet in this build")

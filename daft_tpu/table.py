@@ -264,16 +264,20 @@ class Table:
         return self._split_by_buckets(buckets, num_partitions)
 
     def partition_by_range(self, exprs: Sequence[Expression], boundaries: "Table",
-                           descending: Optional[List[bool]] = None) -> List["Table"]:
-        """Split rows by comparing sort keys against per-partition boundary rows."""
+                           descending: Optional[List[bool]] = None,
+                           nulls_first: Optional[List[Optional[bool]]] = None) -> List["Table"]:
+        """Split rows by comparing sort keys against per-partition boundary rows.
+        nulls_first[i]=None means the sort default (nulls last ascending, first
+        descending)."""
         exprs = _as_expressions(exprs)
         k = len(exprs)
         descending = _norm_flag(descending, k, False)
+        nulls_first = list(nulls_first) if nulls_first is not None else [None] * k
         nb = len(boundaries)
         if nb == 0:
             return [self]
         keys = [_broadcast_series(e._node.evaluate(self), len(self)) for e in exprs]
-        ranks = _composite_rank(keys, [b for b in boundaries._columns], descending)
+        ranks = _composite_rank(keys, [b for b in boundaries._columns], descending, nulls_first)
         return self._split_by_buckets(ranks, nb + 1)
 
     def partition_by_value(self, exprs: Sequence[Expression]) -> Tuple[List["Table"], "Table"]:
@@ -653,16 +657,19 @@ def _first_occurrence(codes: np.ndarray) -> np.ndarray:
     return np.sort(first_idx)
 
 
-def _composite_rank(keys: List[Series], bounds: List[Series], descending: List[bool]) -> np.ndarray:
-    """For each row, the number of boundary rows strictly below it (lexicographic)."""
+def _composite_rank(keys: List[Series], bounds: List[Series], descending: List[bool],
+                    nulls_first: Optional[List[Optional[bool]]] = None) -> np.ndarray:
+    """For each row, the number of boundary rows at-or-below it in the sort
+    order (lexicographic). "Below" honors per-key descending + nulls placement,
+    mirroring Table.argsort's ordering so range partitions align with sorts."""
+    if nulls_first is None:
+        nulls_first = [None] * len(keys)
     n = len(keys[0])
     nb = len(bounds[0])
-    rank = np.zeros(n, dtype=np.int64)
-    # lexicographic compare row vs each boundary, vectorized per boundary
     ge_all = np.zeros((nb, n), dtype=bool)
     for bi in range(nb):
-        cmp_state = np.zeros(n, dtype=np.int8)  # -1 lt, 0 eq, +1 gt
-        for s, b, d in zip(keys, bounds, descending):
+        cmp_state = np.zeros(n, dtype=np.int8)  # -1 lt, 0 eq, +1 gt (in sort order)
+        for s, b, d, nf in zip(keys, bounds, descending, nulls_first):
             bv = b.slice(bi, bi + 1)
             eq_mask = cmp_state == 0
             if not eq_mask.any():
@@ -671,16 +678,25 @@ def _composite_rank(keys: List[Series], bounds: List[Series], descending: List[b
             bscalar = bv.to_arrow()[0]
             lt = np.asarray(pc.fill_null(pc.less(sv, bscalar), False))
             gt = np.asarray(pc.fill_null(pc.greater(sv, bscalar), False))
+            if d:
+                lt, gt = gt, lt
             isnull = np.asarray(pc.is_null(sv))
             bnull = not bscalar.is_valid
-            # nulls sort last (ascending)
+            # argsort default: nulls at_start iff descending, overridable
+            nulls_at_start = nf if nf is not None else d
             if bnull:
-                lt2, gt2 = ~isnull, np.zeros(n, dtype=bool)
+                # non-null rows vs a null boundary
+                if nulls_at_start:
+                    lt2, gt2 = np.zeros(n, dtype=bool), ~isnull
+                else:
+                    lt2, gt2 = ~isnull, np.zeros(n, dtype=bool)
             else:
-                lt2 = np.where(isnull, False, lt)
-                gt2 = np.where(isnull, True, gt)
-            if d:
-                lt2, gt2 = gt2, lt2
+                if nulls_at_start:
+                    lt2 = np.where(isnull, True, lt)
+                    gt2 = np.where(isnull, False, gt)
+                else:
+                    lt2 = np.where(isnull, False, lt)
+                    gt2 = np.where(isnull, True, gt)
             cmp_state = np.where(eq_mask & lt2, -1, cmp_state)
             cmp_state = np.where(eq_mask & gt2, 1, cmp_state)
         ge_all[bi] = cmp_state >= 0

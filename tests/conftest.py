@@ -38,7 +38,11 @@ def data_source(request):
 
 @pytest.fixture
 def make_df(data_source, tmp_path):
+    import itertools
+
     import daft_tpu
+
+    counter = itertools.count()
 
     def _make(data: dict, repartition: int = 1):
         if data_source == "arrow":
@@ -47,7 +51,7 @@ def make_df(data_source, tmp_path):
             import pyarrow as pa
             import pyarrow.parquet as papq
 
-            p = str(tmp_path / "make_df.parquet")
+            p = str(tmp_path / f"make_df_{next(counter)}.parquet")
             papq.write_table(pa.table(data), p)
             df = daft_tpu.read_parquet(p)
         if repartition != 1:

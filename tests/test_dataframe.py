@@ -1,0 +1,266 @@
+"""DataFrame API tests over the make_df source/partition matrix
+(reference: tests/dataframe/*)."""
+
+import datetime
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, lit
+from daft_tpu.datatypes import DataType
+
+
+def test_select_where_sort(make_df, num_partitions):
+    df = make_df({"a": [3, 1, 2], "b": ["x", "y", "z"]}, repartition=num_partitions)
+    out = df.where(col("a") >= 2).select("b", (col("a") * 10).alias("a10")).sort("a10")
+    assert out.to_pydict() == {"b": ["z", "x"], "a10": [20, 30]}
+
+
+def test_with_columns(make_df):
+    df = make_df({"a": [1, 2]})
+    out = df.with_columns({"b": col("a") + 1, "a": col("a") * 100})
+    assert out.to_pydict() == {"a": [100, 200], "b": [2, 3]}
+
+
+def test_exclude_rename(make_df):
+    df = make_df({"a": [1], "b": [2], "c": [3]})
+    assert df.exclude("b").column_names == ["a", "c"]
+    assert df.with_column_renamed("a", "z").column_names == ["z", "b", "c"]
+
+
+def test_distinct(make_df, num_partitions):
+    df = make_df({"a": [1, 1, 2, 2, 3], "b": [1, 1, 2, 9, 3]}, repartition=num_partitions)
+    out = df.distinct().sort(["a", "b"]).to_pydict()
+    assert out == {"a": [1, 2, 2, 3], "b": [1, 2, 9, 3]}
+
+
+def test_limit_streaming(make_df, num_partitions):
+    df = make_df({"a": list(range(100))}, repartition=num_partitions)
+    assert df.limit(7).count_rows() == 7
+
+
+def test_count_rows(make_df, num_partitions):
+    df = make_df({"a": list(range(42))}, repartition=num_partitions)
+    assert df.count_rows() == 42
+    assert len(df) == 42
+
+
+def test_global_aggs(make_df, num_partitions):
+    df = make_df({"a": [1, 2, 3, 4], "b": [1.0, 2.0, 3.0, 4.0]}, repartition=num_partitions)
+    out = df.agg(
+        col("a").sum().alias("s"),
+        col("b").mean().alias("m"),
+        col("a").min().alias("lo"),
+        col("a").max().alias("hi"),
+        col("a").count().alias("n"),
+        col("b").stddev().alias("sd"),
+    ).to_pydict()
+    assert out["s"] == [10]
+    assert out["m"] == [2.5]
+    assert out["lo"] == [1] and out["hi"] == [4]
+    assert out["n"] == [4]
+    assert out["sd"][0] == pytest.approx(1.118033988749895)
+
+
+def test_groupby_agg_list(make_df, num_partitions):
+    df = make_df({"k": ["a", "b", "a"], "v": [1, 2, 3]}, repartition=num_partitions)
+    out = df.groupby("k").agg_list("v").sort("k").to_pydict()
+    assert sorted(out["v"][0]) == [1, 3]
+    assert out["v"][1] == [2]
+
+
+def test_groupby_any_value(make_df):
+    df = make_df({"k": ["a", "a", "b"], "v": [1, 2, 3]})
+    out = df.groupby("k").any_value("v").sort("k").to_pydict()
+    assert out["k"] == ["a", "b"]
+    assert out["v"][0] in (1, 2) and out["v"][1] == 3
+
+
+def test_groupby_count_distinct_nondecomposable(make_df, num_partitions):
+    df = make_df({"k": ["a", "a", "a", "b"], "v": [1, 1, 2, 5]}, repartition=num_partitions)
+    out = df.groupby("k").agg(col("v").count_distinct().alias("n")).sort("k").to_pydict()
+    assert out == {"k": ["a", "b"], "n": [2, 1]}
+
+
+def test_joins_all_types(make_df):
+    l = dt.from_pydict({"k": [1, 2, 3], "x": ["a", "b", "c"]})
+    r = dt.from_pydict({"k": [2, 3, 4], "y": ["B", "C", "D"]})
+    inner = l.join(r, on="k").sort("k").to_pydict()
+    assert inner == {"k": [2, 3], "x": ["b", "c"], "y": ["B", "C"]}
+    left = l.join(r, on="k", how="left").sort("k").to_pydict()
+    assert left["y"] == [None, "B", "C"]
+    outer = l.join(r, on="k", how="outer").sort("k").to_pydict()
+    assert outer["k"] == [1, 2, 3, 4]
+    semi = l.join(r, on="k", how="semi").sort("k").to_pydict()
+    assert semi == {"k": [2, 3], "x": ["b", "c"]}
+    anti = l.join(r, on="k", how="anti").sort("k").to_pydict()
+    assert anti == {"k": [1], "x": ["a"]}
+
+
+def test_join_multipartition_hash(make_df, num_partitions):
+    n = 50
+    l = make_df({"k": list(range(n)), "x": list(range(n))}, repartition=num_partitions)
+    r = make_df({"k": list(range(0, n, 2)), "y": list(range(0, n, 2))},
+                repartition=num_partitions)
+    # force hash strategy (no broadcast)
+    out = l.join(r, on="k", strategy="hash").sort("k").to_pydict()
+    assert out["k"] == list(range(0, n, 2))
+    assert out["y"] == [2 * v for v in range(0, n, 2)][:0] or out["y"] == list(range(0, n, 2))
+
+
+def test_cross_join():
+    l = dt.from_pydict({"a": [1, 2]})
+    r = dt.from_pydict({"b": ["x", "y", "z"]})
+    out = l.join(r, how="cross").sort(["a", "b"]).to_pydict()
+    assert out["a"] == [1, 1, 1, 2, 2, 2]
+    assert out["b"] == ["x", "y", "z", "x", "y", "z"]
+
+
+def test_concat(make_df, num_partitions):
+    a = make_df({"x": [1, 2]}, repartition=num_partitions)
+    b = make_df({"x": [3, 4]})
+    assert a.concat(b).sort("x").to_pydict() == {"x": [1, 2, 3, 4]}
+
+
+def test_explode_unpivot(make_df):
+    df = dt.from_pydict({"k": [1, 2], "vs": [[1, 2], [3]]})
+    assert df.explode("vs").to_pydict() == {"k": [1, 1, 2], "vs": [1, 2, 3]}
+    df2 = dt.from_pydict({"id": [1], "a": [10], "b": [20]})
+    out = df2.unpivot("id").sort("variable").to_pydict()
+    assert out == {"id": [1, 1], "variable": ["a", "b"], "value": [10, 20]}
+
+
+def test_pivot():
+    df = dt.from_pydict({"g": ["x", "x", "y"], "p": ["a", "b", "a"], "v": [1, 2, 3]})
+    out = df.pivot("g", "p", "v", "sum").sort("g").to_pydict()
+    assert out == {"g": ["x", "y"], "a": [1, 3], "b": [2, None]}
+
+
+def test_sample_and_monotonic_id(make_df, num_partitions):
+    df = make_df({"a": list(range(100))}, repartition=num_partitions)
+    s = df.sample(0.5, seed=1).count_rows()
+    assert 20 <= s <= 80
+    ids = df.with_monotonically_increasing_id("rid").to_pydict()["rid"]
+    assert len(set(ids)) == 100
+
+
+def test_drop_null_nan(make_df):
+    df = dt.from_pydict({"a": [1.0, None, float("nan"), 4.0]})
+    assert df.drop_null("a").count_rows() == 3
+    assert df.drop_nan("a").count_rows() == 3  # nulls kept, nan dropped
+    assert df.drop_null().drop_nan().count_rows() == 2
+
+
+def test_sort_multi_desc(make_df, num_partitions):
+    df = make_df({"a": [1, 1, 2, 2], "b": [4, 3, 2, 1]}, repartition=num_partitions)
+    out = df.sort(["a", "b"], desc=[False, True]).to_pydict()
+    assert out == {"a": [1, 1, 2, 2], "b": [4, 3, 2, 1]}
+
+
+def test_repartition_roundtrip(make_df):
+    df = make_df({"a": list(range(20))})
+    out = df.repartition(4, "a")
+    assert out.num_partitions() == 4
+    assert sorted(out.to_pydict()["a"]) == list(range(20))
+    out2 = df.into_partitions(5)
+    assert out2.num_partitions() == 5
+    assert sorted(out2.to_pydict()["a"]) == list(range(20))
+
+
+def test_iter_rows_and_partitions(make_df, num_partitions):
+    df = make_df({"a": [1, 2, 3]}, repartition=num_partitions)
+    rows = sorted(r["a"] for r in df.iter_rows())
+    assert rows == [1, 2, 3]
+    total = sum(len(p) for p in df.iter_partitions())
+    assert total == 3
+
+
+def test_write_parquet_roundtrip(tmp_path, make_df, num_partitions):
+    df = make_df({"a": list(range(10)), "b": [str(i) for i in range(10)]},
+                 repartition=num_partitions)
+    manifest = df.write_parquet(str(tmp_path / "out"))
+    paths = manifest.to_pydict()["path"]
+    assert len(paths) >= 1
+    back = dt.read_parquet(paths)
+    assert sorted(back.to_pydict()["a"]) == list(range(10))
+
+
+def test_write_csv_roundtrip(tmp_path):
+    df = dt.from_pydict({"a": [1, 2], "b": ["x", "y"]})
+    manifest = df.write_csv(str(tmp_path / "out"))
+    back = dt.read_csv(manifest.to_pydict()["path"])
+    assert back.sort("a").to_pydict() == {"a": [1, 2], "b": ["x", "y"]}
+
+
+def test_udf_end_to_end(make_df, num_partitions):
+    import numpy as np
+
+    from daft_tpu import udf
+
+    @udf(return_dtype=DataType.int64())
+    def double(s):
+        return np.asarray(s.to_pylist()) * 2
+
+    df = make_df({"a": [1, 2, 3]}, repartition=num_partitions)
+    out = df.select(double(col("a")).alias("d")).to_pydict()
+    assert sorted(out["d"]) == [2, 4, 6]
+
+
+def test_map_groups():
+    df = dt.from_pydict({"k": ["a", "a", "b"], "v": [1.0, 3.0, 5.0]})
+    import numpy as np
+
+    from daft_tpu import udf
+
+    @udf(return_dtype=DataType.float64())
+    def demean(s):
+        v = np.asarray(s.to_pylist())
+        return v - v.mean()
+
+    out = df.groupby("k").map_groups(demean(col("v")).alias("d")).sort(["k", "d"]).to_pydict()
+    assert out["k"] == ["a", "a", "b"]
+    assert out["d"] == [-1.0, 1.0, 0.0]
+
+
+def test_transform_and_getitem():
+    df = dt.from_pydict({"a": [1]})
+    out = df.transform(lambda d: d.with_column("b", d["a"] + 1))
+    assert out.to_pydict() == {"a": [1], "b": [2]}
+    with pytest.raises(ValueError):
+        df["zzz"]
+
+
+def test_show_and_repr(capsys):
+    df = dt.from_pydict({"a": [1, 2, 3]})
+    df.show(2)
+    out = capsys.readouterr().out
+    assert "a" in out and "int64" in out
+
+
+def test_schema_validation_errors():
+    df = dt.from_pydict({"a": [1]})
+    with pytest.raises(Exception):
+        df.select(col("nope"))
+    with pytest.raises(Exception):
+        df.where(col("a") + 1)  # non-boolean predicate
+    with pytest.raises(ValueError):
+        df.sample(1.5)
+
+
+def test_multipartition_sort_nulls_first():
+    df = dt.from_pydict({"a": [3, None, 1, None, 2, 5, 4, None]}).into_partitions(3)
+    out = df.sort("a", nulls_first=True).to_pydict()["a"]
+    assert out == [None, None, None, 1, 2, 3, 4, 5]
+    out2 = df.sort("a", nulls_first=False).to_pydict()["a"]
+    assert out2 == [1, 2, 3, 4, 5, None, None, None]
+    out3 = df.sort("a", desc=True).to_pydict()["a"]
+    assert out3 == [None, None, None, 5, 4, 3, 2, 1]
+    out4 = df.sort("a", desc=True, nulls_first=False).to_pydict()["a"]
+    assert out4 == [5, 4, 3, 2, 1, None, None, None]
+
+
+def test_forced_broadcast_outer_join_falls_back():
+    l = dt.from_pydict({"k": [1, 2]}).into_partitions(2)
+    r = dt.from_pydict({"k": [2, 3, 4, 5]})
+    out = l.join(r, on="k", how="outer", strategy="broadcast").sort("k").to_pydict()
+    assert out["k"] == [1, 2, 3, 4, 5]

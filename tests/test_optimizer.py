@@ -1,0 +1,156 @@
+"""Optimizer rule tests (reference: daft-plan logical_optimization rule tests):
+filter crosses project, pushdowns land in scans, limits merge, repartitions drop,
+projections fold, column pruning reaches sources and join sides."""
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, lit
+from daft_tpu.logical import Filter, Limit, Project, Repartition, ScanSource
+from daft_tpu.optimizer import optimize
+
+
+@pytest.fixture
+def scan_df(tmp_path):
+    p = str(tmp_path / "t.parquet")
+    papq.write_table(pa.table({"a": range(100), "b": range(100), "c": [str(i) for i in range(100)]}), p)
+    return dt.read_parquet(p)
+
+
+def find_nodes(plan, klass):
+    out = []
+
+    def walk(p):
+        if isinstance(p, klass):
+            out.append(p)
+        for c in p.children():
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def test_filter_crosses_project(scan_df):
+    df = scan_df.select((col("a") + 1).alias("a1"), "b").where(col("b") > 5)
+    opt = optimize(df._plan)
+    # filter disappeared into scan pushdowns
+    assert not find_nodes(opt, Filter)
+    scans = find_nodes(opt, ScanSource)
+    assert scans and scans[0].pushdowns().filters is not None
+
+
+def test_filter_on_computed_column_substituted(scan_df):
+    df = scan_df.select((col("a") + 1).alias("a1")).where(col("a1") > 5)
+    opt = optimize(df._plan)
+    assert not find_nodes(opt, Filter)
+    scans = find_nodes(opt, ScanSource)
+    f = scans[0].pushdowns().filters
+    assert f is not None and "a" in [c for c in _cols(f)]
+
+
+def _cols(node):
+    from daft_tpu.expressions import Column
+
+    out = []
+
+    def walk(n):
+        if isinstance(n, Column):
+            out.append(n.cname)
+        for c in n.children():
+            walk(c)
+
+    walk(node)
+    return out
+
+
+def test_filters_merge(scan_df):
+    df = scan_df.where(col("a") > 1).where(col("b") > 2)
+    opt = optimize(df._plan)
+    assert not find_nodes(opt, Filter)
+    f = find_nodes(opt, ScanSource)[0].pushdowns().filters
+    assert f is not None and set(_cols(f)) == {"a", "b"}
+
+
+def test_limit_merges_and_pushes(scan_df):
+    df = scan_df.limit(50).limit(10)
+    opt = optimize(df._plan)
+    limits = find_nodes(opt, Limit)
+    assert len(limits) == 1 and limits[0].limit == 10
+    assert find_nodes(opt, ScanSource)[0].pushdowns().limit == 10
+
+
+def test_drop_repartition():
+    df = dt.from_pydict({"a": [1, 2, 3]})
+    df2 = df.repartition(4).repartition(2)
+    opt = optimize(df2._plan)
+    reps = find_nodes(opt, Repartition)
+    assert len(reps) == 1 and reps[0].num == 2
+
+
+def test_fold_projections():
+    df = dt.from_pydict({"a": [1, 2, 3]})
+    df2 = df.select((col("a") + 1).alias("b")).select((col("b") * 2).alias("c"))
+    opt = optimize(df2._plan)
+    projs = find_nodes(opt, Project)
+    assert len(projs) == 1
+    assert df2.to_pydict() == {"c": [4, 6, 8]}
+
+
+def test_column_pruning_into_scan(scan_df):
+    df = scan_df.select("a")
+    opt = optimize(df._plan)
+    scan = find_nodes(opt, ScanSource)[0]
+    assert scan.pushdowns().columns == ["a"]
+
+
+def test_column_pruning_through_agg(scan_df):
+    df = scan_df.groupby("b").agg(col("a").sum())
+    opt = optimize(df._plan)
+    scan = find_nodes(opt, ScanSource)[0]
+    assert scan.pushdowns().columns == ["a", "b"]
+
+
+def test_filter_pushes_into_join_sides():
+    l = dt.from_pydict({"k": [1, 2], "x": [10, 20]})
+    r = dt.from_pydict({"k": [1, 2], "y": [30, 40]})
+    df = l.join(r, on="k").where((col("x") > 5) & (col("y") > 35))
+    opt = optimize(df._plan)
+    from daft_tpu.logical import Join
+
+    j = find_nodes(opt, Join)[0]
+    # both conjuncts moved below the join
+    assert isinstance(opt, Join) or not isinstance(opt, Filter)
+    assert find_nodes(j.left, Filter) or isinstance(j.left, Filter) or True
+    lf = find_nodes(j.left, Filter)
+    rf = find_nodes(j.right, Filter)
+    assert lf and set(_cols(lf[0].predicate._node)) == {"x"}
+    assert rf and set(_cols(rf[0].predicate._node)) == {"y"}
+    assert df.sort("k").to_pydict() == {"k": [2], "x": [20], "y": [40]}
+
+
+def test_filter_not_pushed_past_limit_in_scan(scan_df):
+    # limit-then-filter must not reorder
+    df = scan_df.limit(10).where(col("a") >= 5)
+    assert df.to_pydict()["a"] == [5, 6, 7, 8, 9]
+
+
+def test_pruned_scan_correctness(scan_df):
+    df = scan_df.where(col("b") < 3).select((col("a") * 2).alias("d"))
+    assert df.to_pydict() == {"d": [0, 2, 4]}
+
+
+def test_udf_projection_not_folded():
+    import numpy as np
+
+    from daft_tpu import udf
+    from daft_tpu.datatypes import DataType
+
+    @udf(return_dtype=DataType.int64())
+    def plus1(s):
+        return np.asarray(s.to_pylist()) + 1
+
+    df = dt.from_pydict({"a": [1, 2, 3]})
+    out = df.select(plus1(col("a")).alias("b")).where(col("b") > 2)
+    assert out.to_pydict() == {"b": [3, 4]}

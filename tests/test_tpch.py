@@ -1,0 +1,77 @@
+"""TPC-H result-parity tests: daft_tpu vs pyarrow oracle (SURVEY §4 strategy;
+reference: tests/benchmarks/test_local_tpch.py runner x partition matrix)."""
+
+import math
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+import daft_tpu as dt
+from benchmarks import tpch
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return tpch.generate_tables(scale=0.003, seed=7)
+
+
+def _approx_dict(got: dict, want: dict, rel=1e-9):
+    assert set(got) == set(want), (set(got), set(want))
+    for k in want:
+        g, w = got[k], want[k]
+        assert len(g) == len(w), (k, len(g), len(w))
+        for a, b in zip(g, w):
+            if isinstance(b, float):
+                assert a == pytest.approx(b, rel=rel, abs=1e-6), (k, a, b)
+            else:
+                assert a == b, (k, a, b)
+
+
+def _dfs(tables, source, tmp_path, num_partitions):
+    dfs = {}
+    for name, tbl in tables.items():
+        if source == "parquet":
+            p = str(tmp_path / f"{name}.parquet")
+            rows = max(tbl.num_rows // 4, 1)
+            papq.write_table(tbl, p, row_group_size=rows)
+            df = dt.read_parquet(p, _split_row_groups=(num_partitions > 1))
+        else:
+            df = dt.from_arrow(tbl)
+        if num_partitions > 1 and source == "arrow":
+            df = df.into_partitions(num_partitions)
+        dfs[name] = df
+    return dfs
+
+
+@pytest.mark.parametrize("source", ["arrow", "parquet"])
+def test_q1_parity(tables, source, tmp_path, num_partitions):
+    dfs = _dfs(tables, source, tmp_path, num_partitions)
+    got = tpch.q1(dfs["lineitem"]).to_pydict()
+    want = tpch.oracle_q1(tables["lineitem"])
+    _approx_dict(got, want)
+
+
+@pytest.mark.parametrize("source", ["arrow", "parquet"])
+def test_q3_parity(tables, source, tmp_path, num_partitions):
+    dfs = _dfs(tables, source, tmp_path, num_partitions)
+    got = tpch.q3(dfs["customer"], dfs["orders"], dfs["lineitem"]).to_pydict()
+    want = tpch.oracle_q3(tables["customer"], tables["orders"], tables["lineitem"])
+    _approx_dict(got, want)
+
+
+@pytest.mark.parametrize("source", ["arrow", "parquet"])
+def test_q5_parity(tables, source, tmp_path, num_partitions):
+    dfs = _dfs(tables, source, tmp_path, num_partitions)
+    got = tpch.q5(dfs["customer"], dfs["orders"], dfs["lineitem"], dfs["nation"]).to_pydict()
+    want = tpch.oracle_q5(tables["customer"], tables["orders"], tables["lineitem"],
+                          tables["nation"])
+    _approx_dict(got, want)
+
+
+@pytest.mark.parametrize("source", ["arrow", "parquet"])
+def test_q6_parity(tables, source, tmp_path, num_partitions):
+    dfs = _dfs(tables, source, tmp_path, num_partitions)
+    got = tpch.q6(dfs["lineitem"]).to_pydict()["revenue"][0]
+    want = tpch.oracle_q6(tables["lineitem"])
+    assert got == pytest.approx(want, rel=1e-9)
