@@ -1,0 +1,76 @@
+"""Benchmark harness: TPC-H Q1 wall-clock vs the pyarrow oracle baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value is
+lineitem rows/sec through the full daft_tpu engine (lazy plan -> optimizer ->
+physical plan -> streaming executor) for TPC-H Q1, and vs_baseline is the
+speedup vs a hand-written pyarrow.compute implementation of the same query
+(>1.0 = faster than baseline). Result parity vs the oracle is asserted before
+timing; a parity failure prints value 0.
+
+Reference role-equivalent: tests/benchmarks/test_local_tpch.py +
+benchmarking/tpch (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _best_of(fn, n=3):
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> int:
+    scale = float(sys.argv[1]) if len(sys.argv) > 1 else 0.1
+    from benchmarks import tpch
+
+    tables = tpch.generate_tables(scale=scale, seed=42)
+    lineitem = tables["lineitem"]
+    rows = lineitem.num_rows
+
+    import daft_tpu as dt
+
+    def run_daft():
+        # rebuild the plan each run: .collect() caches its materialized result
+        return tpch.q1(dt.from_arrow(lineitem)).collect().to_pydict()
+
+    def run_oracle():
+        return tpch.oracle_q1(lineitem)
+
+    # warm-up + parity check
+    got = run_daft()
+    want = run_oracle()
+    ok = set(got) == set(want)
+    if ok:
+        for k in want:
+            for a, b in zip(got[k], want[k]):
+                if isinstance(b, float):
+                    ok = ok and abs(a - b) <= max(1e-9 * abs(b), 1e-6)
+                else:
+                    ok = ok and a == b
+    if not ok:
+        print(json.dumps({"metric": f"tpch_q1_sf{scale:g}_rows_per_sec",
+                          "value": 0, "unit": "rows/s", "vs_baseline": 0.0,
+                          "error": "parity_mismatch"}))
+        return 1
+
+    t_daft, _ = _best_of(run_daft)
+    t_oracle, _ = _best_of(run_oracle)
+    print(json.dumps({
+        "metric": f"tpch_q1_sf{scale:g}_rows_per_sec",
+        "value": round(rows / t_daft, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(t_oracle / t_daft, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,8 +107,12 @@ class DeviceColumn:
         return self.values.shape[0]
 
 
-def stage_series(s, bucket: Optional[int] = None) -> DeviceColumn:
-    """Stage a host Series onto the device (values + validity, padded)."""
+def stage_np(s, bucket: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Host-side staging core: (values [bucket,*trailing], valid [bucket], n).
+
+    Shared by the single-device path (stage_series) and the mesh shuffle
+    (parallel/mesh_exec.py) so padding/fixed-shape/validity logic lives once.
+    """
     from ..series import Series
 
     assert isinstance(s, Series)
@@ -130,8 +134,15 @@ def stage_series(s, bucket: Optional[int] = None) -> DeviceColumn:
         if b > n:
             vals = np.concatenate([vals, np.zeros(b - n, dtype=vals.dtype)])
     valid = np.zeros(b, dtype=bool)
-    valid[:n] = np.asarray(pc.is_valid(arr)) if arr.null_count else True
-    return DeviceColumn(jnp.asarray(vals), jnp.asarray(valid), n, dt)
+    if n:
+        valid[:n] = np.asarray(pc.is_valid(arr)) if arr.null_count else True
+    return vals, valid, n
+
+
+def stage_series(s, bucket: Optional[int] = None) -> DeviceColumn:
+    """Stage a host Series onto the device (values + validity, padded)."""
+    vals, valid, n = stage_np(s, bucket)
+    return DeviceColumn(jnp.asarray(vals), jnp.asarray(valid), n, s.dtype)
 
 
 def unstage(col: DeviceColumn):
@@ -142,8 +153,9 @@ def unstage(col: DeviceColumn):
     valid = np.asarray(jax.device_get(col.valid))[:col.length]
     dt = col.dtype
     if dt.kind in (TypeKind.EMBEDDING, TypeKind.FIXED_SHAPE_TENSOR, TypeKind.FIXED_SHAPE_IMAGE):
-        flat = pa.array(vals.reshape(col.length, -1).ravel())
-        size = vals.size // max(col.length, 1) if col.length else 0
+        shape = (dt.params[1],) if dt.kind == TypeKind.EMBEDDING else dt.tensor_shape
+        size = int(np.prod(shape))
+        flat = pa.array(vals.reshape(col.length, size).ravel())
         out = pa.FixedSizeListArray.from_arrays(flat, size or 1)
         if not valid.all():
             out = pc.if_else(pa.array(valid), out, pa.nulls(col.length, out.type))

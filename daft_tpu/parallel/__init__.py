@@ -1,0 +1,20 @@
+"""Multi-chip parallelism: jax.sharding.Mesh execution + ICI collectives.
+
+This package is the TPU-native replacement for the reference's distributed
+runtime (reference: daft/runners/ray_runner.py + the FanoutHash/FanoutRange/
+ReduceMerge instruction pairs in daft/execution/execution_step.py:834-985 and
+the generator combinators in daft/execution/physical_plan.py:1365,1414).
+Where the reference moves partitions through the Ray object store, here the
+exchange is a single XLA `all_to_all` collective over the mesh axis — data
+plane on ICI, control plane (bucket assignment, capacity negotiation) on host.
+"""
+
+from .collectives import build_exchange, exchange_capacity
+from .mesh_exec import MeshExecutionContext, default_mesh
+
+__all__ = [
+    "build_exchange",
+    "exchange_capacity",
+    "MeshExecutionContext",
+    "default_mesh",
+]

@@ -1,0 +1,134 @@
+"""Mesh execution context: partition shuffles ride ICI collectives.
+
+Role-equivalent to the reference's RayRunner data plane
+(daft/runners/ray_runner.py:504-685 — dispatch loop + object-store transfer).
+Redesign for TPU: the N output partitions of a shuffle live one-per-device of a
+`jax.sharding.Mesh`; the fanout+reduce pair becomes ONE all_to_all collective
+(collectives.build_exchange). Host keeps the control plane: bucket assignment
+(host hash kernels work for every dtype incl. strings), capacity negotiation,
+and re-chunking partitions onto the mesh axis.
+
+Columns whose dtype is not device-representable (strings, lists, ...) force a
+host-path shuffle for that exchange — the same Native-vs-Python storage split
+the reference keeps (SURVEY.md §7 step 1).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+
+from ..execution import ExecutionContext, RuntimeStats
+from ..kernels.device import DeviceColumn, is_device_dtype, size_bucket, stage_np, unstage
+from ..micropartition import MicroPartition
+from .collectives import build_exchange, exchange_capacity, shard_to_mesh
+
+
+def default_mesh(n: Optional[int] = None):
+    """A 1-D mesh over the first n (default: all) local devices, axis 'parts'."""
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return jax.sharding.Mesh(np.array(devs), ("parts",))
+
+
+class MeshExecutionContext(ExecutionContext):
+    """ExecutionContext whose shuffles use the device exchange when eligible."""
+
+    def __init__(self, cfg, stats: Optional[RuntimeStats] = None, mesh=None):
+        super().__init__(cfg, stats)
+        self.mesh = mesh if mesh is not None else default_mesh()
+
+    @property
+    def n_devices(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def try_device_shuffle(self, parts: List[MicroPartition], by, num: int,
+                           scheme: str) -> Optional[List[MicroPartition]]:
+        """All-to-all hash/random shuffle over the mesh; None if ineligible
+        (wrong fanout, non-device payload dtype, empty input)."""
+        n = self.n_devices
+        if num != n or scheme not in ("hash", "random"):
+            return None
+        schema = parts[0].schema
+        if any(not is_device_dtype(f.dtype) for f in schema):
+            return None
+        tables = [p.table() for p in parts]
+        total = sum(len(t) for t in tables)
+        if total == 0:
+            return None
+        # Re-chunk onto the mesh axis: exactly n equal-ish source shards.
+        from ..table import Table
+
+        merged = Table.concat(tables) if len(tables) != 1 else tables[0]
+        step = -(-total // n)
+        chunks = [merged.slice(min(i * step, total), min((i + 1) * step, total))
+                  for i in range(n)]
+        # Control plane: per-row destination bucket, computed with the host
+        # hash kernels (identical assignment to the host shuffle path).
+        buckets_np, inbounds = [], []
+        for ci, c in enumerate(chunks):
+            if scheme == "hash":
+                h = c.hash_rows(by)
+                buckets_np.append((h % np.uint64(n)).astype(np.int32))
+            else:
+                rng = np.random.RandomState(ci)
+                buckets_np.append(rng.randint(0, n, size=len(c)).astype(np.int32))
+            inbounds.append(np.ones(len(c), dtype=bool))
+        cap = exchange_capacity(buckets_np, inbounds, n)
+        r = size_bucket(max((len(c) for c in chunks), default=1))
+        # Stage: stacked [n, R] global arrays, one row of the leading axis per
+        # device. Row validity (vmat) marks real vs padding rows; each column
+        # additionally ships its own null mask as an extra bool lane so nulls
+        # survive the exchange.
+        names = [f.name for f in schema]
+        bmat = np.zeros((n, r), dtype=np.int32)
+        vmat = np.zeros((n, r), dtype=bool)
+        col_mats: List[Optional[np.ndarray]] = [None] * len(names)
+        null_lanes = [np.zeros((n, r), dtype=bool) for _ in names]
+        dtypes = []
+        for i, c in enumerate(chunks):
+            bmat[i, :len(c)] = buckets_np[i]
+            vmat[i, :len(c)] = True
+            for j, name in enumerate(names):
+                vals, valid, _ = stage_np(c.get_column(name), r)
+                if col_mats[j] is None:
+                    col_mats[j] = np.zeros((n,) + vals.shape, dtype=vals.dtype)
+                    dtypes.append(vals.dtype)
+                col_mats[j][i] = vals
+                null_lanes[j][i] = valid
+
+        trailing = tuple(tuple(m.shape[2:]) for m in col_mats) + tuple(
+            () for _ in null_lanes)
+        all_dtypes = tuple(dtypes) + tuple(np.dtype(bool) for _ in null_lanes)
+        fn = build_exchange(self.mesh, cap, all_dtypes, trailing)
+        dev_args = [shard_to_mesh(bmat, self.mesh), shard_to_mesh(vmat, self.mesh)]
+        for m in list(col_mats) + null_lanes:
+            dev_args.append(shard_to_mesh(m, self.mesh))
+        out = fn(*dev_args)
+        recv_valid = np.asarray(jax.device_get(out[0]))  # [n, n, cap]
+        ncols = len(col_mats)
+        recv_cols = [np.asarray(jax.device_get(o)) for o in out[1:1 + ncols]]
+        recv_nulls = [np.asarray(jax.device_get(o)) for o in out[1 + ncols:]]
+        self.stats.bump("device_shuffles")
+        # Unstage: per destination device, mask-compact the received slabs.
+        results: List[MicroPartition] = []
+        from ..schema import Schema
+        from ..table import Table as T
+
+        for d in range(n):
+            mask = recv_valid[d].reshape(-1)
+            cnt = int(mask.sum())
+            series_out = []
+            for j, f in enumerate(schema):
+                flat = recv_cols[j][d].reshape((-1,) + recv_cols[j][d].shape[2:])
+                nulls = recv_nulls[j][d].reshape(-1)
+                vals = flat[mask]
+                col_valid = nulls[mask]
+                dc = DeviceColumn(vals, col_valid, cnt, f.dtype)
+                series_out.append(unstage(dc).rename(f.name))
+            results.append(MicroPartition.from_table(T(Schema(list(schema)), series_out)))
+        return results

@@ -276,6 +276,14 @@ class ShuffleOp(PhysicalOp):
         if not parts:
             return
         n = self.num
+        # Mesh path: one all_to_all collective over ICI instead of host fanout
+        # (parallel/mesh_exec.py); falls through to host on ineligibility.
+        dev_shuffle = getattr(ctx, "try_device_shuffle", None)
+        if dev_shuffle is not None and self.scheme in ("hash", "random"):
+            out = dev_shuffle(parts, self.by, n, self.scheme)
+            if out is not None:
+                yield from out
+                return
         buckets: List[List[MicroPartition]] = [[] for _ in range(n)]
         if self.scheme == "range":
             boundaries = sample_boundaries(parts, self.by, n, self.descending,
