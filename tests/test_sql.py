@@ -1,0 +1,257 @@
+"""SQL frontend tests (reference strategy: tests/sql/test_sql.py — SQL vs
+DataFrame-API oracle on the same engine)."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, lit, sql, sql_expr
+
+
+@pytest.fixture
+def df():
+    return dt.from_pydict({
+        "a": [1, 2, 3, 4, 5, None],
+        "b": [10.0, 20.0, 30.0, 40.0, 50.0, 60.0],
+        "s": ["apple", "banana", "cherry", "date", "apple", None],
+        "g": ["x", "y", "x", "y", "x", "y"],
+    })
+
+
+def test_select_where(df):
+    out = sql("SELECT a, b * 2 AS b2 FROM t WHERE a > 2", t=df).to_pydict()
+    assert out == {"a": [3, 4, 5], "b2": [60.0, 80.0, 100.0]}
+
+
+def test_select_star_limit(df):
+    out = sql("SELECT * FROM t LIMIT 2", t=df).to_pydict()
+    assert out["a"] == [1, 2]
+    assert set(out) == {"a", "b", "s", "g"}
+
+
+def test_arith_precedence():
+    d = dt.from_pydict({"x": [2, 3]})
+    out = sql("SELECT 1 + x * 3 AS y, (1 + x) * 3 AS z FROM t", t=d).to_pydict()
+    assert out == {"y": [7, 10], "z": [9, 12]}
+
+
+def test_groupby_agg_having(df):
+    out = sql("""
+        SELECT g, SUM(b) AS sb, COUNT(a) AS ca
+        FROM t GROUP BY g HAVING SUM(b) > 80 ORDER BY g
+    """, t=df).to_pydict()
+    assert out == {"g": ["x", "y"], "sb": [90.0, 120.0], "ca": [3, 2]}
+
+
+def test_compound_agg_expression(df):
+    out = sql("SELECT g, SUM(b) / COUNT(b) AS avg_b FROM t GROUP BY g ORDER BY g",
+              t=df).to_pydict()
+    assert out["avg_b"] == [30.0, 40.0]
+
+
+def test_count_star(df):
+    out = sql("SELECT COUNT(*) FROM t", t=df).to_pydict()
+    assert out == {"count": [6]}
+
+
+def test_global_agg_no_group(df):
+    out = sql("SELECT SUM(a) AS s, MAX(b) AS m FROM t", t=df).to_pydict()
+    assert out == {"s": [15], "m": [60.0]}
+
+
+def test_case_when(df):
+    out = sql("""
+        SELECT a, CASE WHEN a >= 4 THEN 'hi' WHEN a >= 2 THEN 'mid'
+                  ELSE 'lo' END AS tier
+        FROM t WHERE a IS NOT NULL
+    """, t=df).to_pydict()
+    assert out["tier"] == ["lo", "mid", "mid", "hi", "hi"]
+
+
+def test_like_in_between(df):
+    out = sql("SELECT s FROM t WHERE s LIKE 'a%'", t=df).to_pydict()
+    assert out == {"s": ["apple", "apple"]}
+    out = sql("SELECT a FROM t WHERE a IN (1, 3, 5)", t=df).to_pydict()
+    assert out == {"a": [1, 3, 5]}
+    out = sql("SELECT a FROM t WHERE a BETWEEN 2 AND 4", t=df).to_pydict()
+    assert out == {"a": [2, 3, 4]}
+
+
+def test_string_functions(df):
+    out = sql("SELECT UPPER(s) AS u, LENGTH(s) AS l FROM t WHERE s = 'date'",
+              t=df).to_pydict()
+    assert out == {"u": ["DATE"], "l": [4]}
+
+
+def test_cast_and_null(df):
+    out = sql("SELECT CAST(b AS INT) AS bi, COALESCE(a, 0) AS a0 FROM t LIMIT 6",
+              t=df).to_pydict()
+    assert out["bi"] == [10, 20, 30, 40, 50, 60]
+    assert out["a0"] == [1, 2, 3, 4, 5, 0]
+
+
+def test_join():
+    left = dt.from_pydict({"id": [1, 2, 3], "v": ["a", "b", "c"]})
+    right = dt.from_pydict({"rid": [2, 3, 4], "w": [20, 30, 40]})
+    out = sql("""
+        SELECT l.id, l.v, r.w FROM l JOIN r ON l.id = r.rid ORDER BY id
+    """, l=left, r=right).to_pydict()
+    assert out == {"id": [2, 3], "v": ["b", "c"], "w": [20, 30]}
+
+
+def test_left_join_using():
+    left = dt.from_pydict({"id": [1, 2, 3], "v": ["a", "b", "c"]})
+    right = dt.from_pydict({"id": [2, 3, 4], "w": [20, 30, 40]})
+    out = sql("SELECT id, v, w FROM l LEFT JOIN r USING (id) ORDER BY id",
+              l=left, r=right).to_pydict()
+    assert out == {"id": [1, 2, 3], "v": ["a", "b", "c"], "w": [None, 20, 30]}
+
+
+def test_subquery():
+    d = dt.from_pydict({"x": [1, 2, 3, 4]})
+    out = sql("SELECT SUM(x2) AS s FROM (SELECT x * x AS x2 FROM t WHERE x > 1) sq",
+              t=d).to_pydict()
+    assert out == {"s": [29]}
+
+
+def test_order_by_desc_nulls(df):
+    out = sql("SELECT a FROM t ORDER BY a DESC NULLS LAST", t=df).to_pydict()
+    assert out == {"a": [5, 4, 3, 2, 1, None]}
+
+
+def test_distinct(df):
+    out = sql("SELECT DISTINCT g FROM t ORDER BY g", t=df).to_pydict()
+    assert out == {"g": ["x", "y"]}
+
+
+def test_group_by_position_and_alias(df):
+    o1 = sql("SELECT g AS grp, SUM(b) AS s FROM t GROUP BY 1 ORDER BY 1", t=df).to_pydict()
+    o2 = sql("SELECT g AS grp, SUM(b) AS s FROM t GROUP BY grp ORDER BY grp", t=df).to_pydict()
+    assert o1 == o2 == {"grp": ["x", "y"], "s": [90.0, 120.0]}
+
+
+def test_date_literal():
+    d = dt.from_pydict({"d": [datetime.date(2024, 1, 1), datetime.date(2024, 6, 1)]})
+    out = sql("SELECT d FROM t WHERE d >= DATE '2024-03-01'", t=d).to_pydict()
+    assert out == {"d": [datetime.date(2024, 6, 1)]}
+
+
+def test_sql_expr_single():
+    e = sql_expr("a + 1 > 3 AND b IS NOT NULL")
+    d = dt.from_pydict({"a": [1, 3], "b": [1.0, None]})
+    assert d.where(e).to_pydict() == {"a": [], "b": []}
+    e2 = sql_expr("ABS(a - 4)")
+    assert d.select(e2.alias("x")).to_pydict() == {"x": [3, 1]}
+
+
+def test_tpch_q1_sql_parity():
+    from benchmarks import tpch
+
+    tables = tpch.generate_tables(scale=0.002, seed=11)
+    li = dt.from_arrow(tables["lineitem"])
+    got = sql("""
+        SELECT l_returnflag, l_linestatus,
+               SUM(l_quantity) AS sum_qty,
+               SUM(l_extendedprice) AS sum_base_price,
+               SUM(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+               SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+               AVG(l_quantity) AS avg_qty,
+               AVG(l_extendedprice) AS avg_price,
+               AVG(l_discount) AS avg_disc,
+               COUNT(l_quantity) AS count_order
+        FROM lineitem
+        WHERE l_shipdate <= DATE '1998-09-02'
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+    """, lineitem=li).to_pydict()
+    want = tpch.q1(li).to_pydict()
+    assert got.keys() == want.keys()
+    for k in want:
+        for a, b in zip(got[k], want[k]):
+            if isinstance(b, float):
+                assert a == pytest.approx(b, rel=1e-9)
+            else:
+                assert a == b
+
+
+def test_tpch_q6_sql_parity():
+    from benchmarks import tpch
+
+    tables = tpch.generate_tables(scale=0.002, seed=11)
+    li = dt.from_arrow(tables["lineitem"])
+    got = sql("""
+        SELECT SUM(l_extendedprice * l_discount) AS revenue
+        FROM lineitem
+        WHERE l_shipdate >= DATE '1994-01-01' AND l_shipdate < DATE '1995-01-01'
+          AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24
+    """, lineitem=li).to_pydict()
+    want = tpch.q6(li).to_pydict()
+    assert got["revenue"][0] == pytest.approx(want["revenue"][0], rel=1e-9)
+
+
+def test_error_messages(df):
+    with pytest.raises(ValueError, match="unknown table"):
+        sql("SELECT * FROM missing", t=df)
+    with pytest.raises(ValueError, match="GROUP BY"):
+        sql("SELECT a, SUM(b) AS s FROM t", t=df)
+    with pytest.raises(ValueError, match="expected"):
+        sql("SELECT FROM t", t=df)
+
+
+def test_order_by_column_dropped_by_projection(df):
+    # SQL sorts before the projection drops the column
+    out = sql("SELECT b FROM t WHERE a IS NOT NULL ORDER BY a DESC", t=df).to_pydict()
+    assert out == {"b": [50.0, 40.0, 30.0, 20.0, 10.0]}
+    out = sql("SELECT a*a sq FROM t WHERE a IS NOT NULL ORDER BY sq DESC LIMIT 2",
+              t=df).to_pydict()
+    assert out == {"sq": [25, 16]}
+
+
+def test_order_by_agg_expression(df):
+    out = sql("SELECT g FROM t GROUP BY g ORDER BY SUM(b) DESC", t=df).to_pydict()
+    assert out == {"g": ["y", "x"]}
+
+
+def test_qualified_ref_duplicate_column_after_join():
+    # r.v must resolve to the right table's (suffix-renamed) column
+    left = dt.from_pydict({"id": [1, 2], "v": ["a", "b"]})
+    right = dt.from_pydict({"id": [1, 2], "v": ["X", "Y"]})
+    out = sql("SELECT l.id, l.v AS lv, r.v AS rv FROM l JOIN r ON l.id = r.id "
+              "ORDER BY 1", l=left, r=right).to_pydict()
+    assert out == {"id": [1, 2], "lv": ["a", "b"], "rv": ["X", "Y"]}
+
+
+def test_qualified_ref_unknown_column_errors():
+    left = dt.from_pydict({"id": [1]})
+    right = dt.from_pydict({"id": [1]})
+    with pytest.raises(ValueError, match="not found in table"):
+        sql("SELECT r.nope FROM l JOIN r ON l.id = r.id", l=left, r=right)
+
+
+def test_chained_comparison_rejected(df):
+    with pytest.raises(ValueError, match="chained comparisons"):
+        sql("SELECT a FROM t WHERE 1 < a < 3", t=df)
+
+
+def test_outer_join_non_equi_rejected():
+    left = dt.from_pydict({"id": [1, 2], "v": [1, 2]})
+    right = dt.from_pydict({"rid": [1, 2], "w": [5, 50]})
+    with pytest.raises(ValueError, match="OUTER JOIN"):
+        sql("SELECT * FROM l LEFT JOIN r ON l.id = r.rid AND r.w > 40",
+            l=left, r=right)
+
+
+def test_distinct_order_by_sorted():
+    d = dt.from_pydict({"x": [9, 1, 9, 3, 1, 7, 3, 5] * 10}).repartition(4)
+    out = sql("SELECT DISTINCT x FROM t ORDER BY x", t=d).to_pydict()
+    assert out == {"x": [1, 3, 5, 7, 9]}
+
+
+def test_group_by_input_column_precedence():
+    d = dt.from_pydict({"x": [1, 2, 1], "z": [10, 20, 30]})
+    out = sql("SELECT -x AS x, SUM(z) AS s FROM t GROUP BY x ORDER BY s",
+              t=d).to_pydict()
+    # groups by the INPUT column x (SQL precedence), then projects -x
+    assert out == {"x": [-2, -1], "s": [20, 40]}
