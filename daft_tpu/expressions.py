@@ -61,8 +61,35 @@ class ExprNode:
     def to_field(self, schema: Schema) -> Field:
         raise NotImplementedError
 
-    def evaluate(self, table) -> Series:
+    def _eval(self, table) -> Series:
         raise NotImplementedError
+
+    def _memoizable(self) -> bool:
+        """Subtrees containing a user function are never cached (UDFs may be
+        non-deterministic, and their _key uses id(fn) which can be reused)."""
+        cached = getattr(self, "_memoizable_cache", None)
+        if cached is None:
+            cached = not isinstance(self, PyUdf) and all(
+                c._memoizable() for c in self.children())
+            self._memoizable_cache = cached
+        return cached
+
+    def evaluate(self, table) -> Series:
+        """Evaluate against a Table, sharing results of structurally identical
+        subtrees within one eval pass (table._eval_memo, scoped by Table's
+        _memo_scope) — e.g. Q1's disc_price feeds two aggregates but runs once."""
+        memo = getattr(table, "_eval_memo", None)
+        if memo is None or not self._memoizable():
+            return self._eval(table)
+        try:
+            k = self._key()
+            hit = memo.get(k)
+        except TypeError:  # unhashable key component (e.g. list literal)
+            return self._eval(table)
+        if hit is None:
+            hit = self._eval(table)
+            memo[k] = hit
+        return hit
 
     def children(self) -> List["ExprNode"]:
         return []
@@ -96,7 +123,7 @@ class Column(ExprNode):
     def to_field(self, schema: Schema) -> Field:
         return schema[self.cname]
 
-    def evaluate(self, table) -> Series:
+    def _eval(self, table) -> Series:
         return table.get_column(self.cname)
 
     def _key(self):
@@ -119,7 +146,7 @@ class Literal(ExprNode):
     def to_field(self, schema: Schema) -> Field:
         return Field("literal", self.dtype)
 
-    def evaluate(self, table) -> Series:
+    def _eval(self, table) -> Series:
         s = Series.from_pylist([self.value], "literal", self.dtype)
         return s
 
@@ -144,7 +171,7 @@ class Alias(ExprNode):
     def to_field(self, schema: Schema) -> Field:
         return Field(self.alias, self.child.to_field(schema).dtype)
 
-    def evaluate(self, table) -> Series:
+    def _eval(self, table) -> Series:
         return self.child.evaluate(table).rename(self.alias)
 
     def children(self):
@@ -175,7 +202,7 @@ class Cast(ExprNode):
         self.child.to_field(schema)  # validates child
         return Field(self.name(), self.dtype)
 
-    def evaluate(self, table) -> Series:
+    def _eval(self, table) -> Series:
         return self.child.evaluate(table).cast(self.dtype)
 
     def children(self):
@@ -243,7 +270,7 @@ class BinaryOp(ExprNode):
             u = DataType.int64() if op != "+" else u
         return Field(nm, u)
 
-    def evaluate(self, table) -> Series:
+    def _eval(self, table) -> Series:
         l = self.left.evaluate(table)
         r = self.right.evaluate(table)
         fn = {
@@ -318,7 +345,7 @@ class Not(ExprNode):
             raise ValueError(f"~ expects bool, got {f.dtype}")
         return Field(f.name, DataType.bool())
 
-    def evaluate(self, table):
+    def _eval(self, table):
         return (~self.child.evaluate(table)).rename(self.name())
 
     def children(self):
@@ -346,7 +373,7 @@ class IsNull(ExprNode):
         f = self.child.to_field(schema)
         return Field(f.name, DataType.bool())
 
-    def evaluate(self, table):
+    def _eval(self, table):
         s = self.child.evaluate(table)
         out = s.not_null() if self.negate else s.is_null()
         return out.rename(self.name())
@@ -383,7 +410,7 @@ class FillNull(ExprNode):
             raise ValueError(f"fill_null type mismatch: {f.dtype} vs {g.dtype}")
         return Field(f.name, u)
 
-    def evaluate(self, table):
+    def _eval(self, table):
         f = self.to_field(table.schema)
         s = self.child.evaluate(table).cast(f.dtype)
         fill = self.fill.evaluate(table).cast(f.dtype)
@@ -414,7 +441,7 @@ class IsIn(ExprNode):
         f = self.child.to_field(schema)
         return Field(f.name, DataType.bool())
 
-    def evaluate(self, table):
+    def _eval(self, table):
         s = self.child.evaluate(table)
         items = self.items.evaluate(table)
         if items.dtype.is_list() and len(items) == 1:
@@ -449,7 +476,7 @@ class Between(ExprNode):
         self.upper.to_field(schema)
         return Field(f.name, DataType.bool())
 
-    def evaluate(self, table):
+    def _eval(self, table):
         s = self.child.evaluate(table)
         lo = self.lower.evaluate(table)
         hi = self.upper.evaluate(table)
@@ -488,7 +515,7 @@ class IfElse(ExprNode):
             raise ValueError(f"if_else branches incompatible: {t.dtype} vs {f.dtype}")
         return Field(t.name, u)
 
-    def evaluate(self, table):
+    def _eval(self, table):
         p = self.pred.evaluate(table)
         t = self.if_true.evaluate(table)
         f = self.if_false.evaluate(table)
@@ -525,7 +552,7 @@ class Function(ExprNode):
         arg_dts = [a.to_field(schema).dtype for a in self.args]
         return Field(self.name(), spec.resolve(*arg_dts, **self.kwargs))
 
-    def evaluate(self, table):
+    def _eval(self, table):
         spec = get_function(self.fname)
         args = [a.evaluate(table) for a in self.args]
         return spec.evaluate(*args, **self.kwargs).rename(self.name())
@@ -570,7 +597,11 @@ class PyUdf(ExprNode):
             a.to_field(schema)
         return Field(self.name(), self.return_dtype)
 
+    # user functions may be non-deterministic: never memoize the udf call itself
     def evaluate(self, table):
+        return self._eval(table)
+
+    def _eval(self, table):
         from .udf import run_udf
 
         args = [a.evaluate(table) for a in self.args]
@@ -640,7 +671,7 @@ class AggExpr(ExprNode):
             return Field(f.name, DataType.list(DataType.float64()))
         raise AssertionError(k)
 
-    def evaluate(self, table) -> Series:
+    def _eval(self, table) -> Series:
         # global (ungrouped) aggregation path; grouped agg handled by Table.agg
         s = self.child.evaluate(table)
         return _eval_agg_on_series(self, s).rename(self.name())

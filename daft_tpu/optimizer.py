@@ -116,6 +116,12 @@ def _push_down_filter(p: LogicalPlan) -> Optional[LogicalPlan]:
         return Filter(child.input, child.predicate & pred)
 
     if isinstance(child, Project):
+        # a pure column-pruning Project over an in-memory source is there to
+        # narrow the filter's working set — swapping the filter below it would
+        # re-widen the filter to every source column for no pushdown benefit
+        if isinstance(child.input, InMemorySource) and all(
+                is_trivial_passthrough(e) is not None for e in child.exprs):
+            return None
         # substitute computed columns into the predicate; abort if any referenced
         # projection expr contains an agg/UDF (not freely movable)
         defs: Dict[str, Expression] = {}

@@ -32,7 +32,7 @@ class Series:
     @staticmethod
     def from_arrow(arr, name: str = "arrow_series", dtype: Optional[DataType] = None) -> "Series":
         if isinstance(arr, pa.ChunkedArray):
-            arr = arr.combine_chunks()
+            arr = arr.chunk(0) if arr.num_chunks == 1 else arr.combine_chunks()
         if isinstance(arr, pa.Scalar):
             arr = pa.array([arr.as_py()], type=arr.type)
         if pa.types.is_dictionary(arr.type):
@@ -224,12 +224,12 @@ class Series:
                         unify: bool = True) -> "Series":
         self._require_arrow("arithmetic")
         other._require_arrow("arithmetic")
-        l, r = _broadcast(self, other)
+        l, r = self, other
         if unify and l._dtype != r._dtype and l._dtype.is_numeric() and r._dtype.is_numeric():
             u = try_unify(l._dtype, r._dtype)
             if u is not None:
                 l, r = l.cast(u), r.cast(u)
-        out = fn(l._arrow, r._arrow)
+        out = fn(*_binary_args(l, r))
         s = Series.from_arrow(out, name or self._name)
         if force_dtype is not None and s._dtype != force_dtype:
             s = s.cast(force_dtype)
@@ -307,15 +307,16 @@ class Series:
         self._require_arrow("comparison")
         other = _as_series(other)
         other._require_arrow("comparison")
-        l, r = _broadcast(self, other)
-        la, ra = l._arrow, r._arrow
-        if la.type != ra.type:
+        l, r = self, other
+        if l._arrow.type != r._arrow.type:
             sup = try_unify(l._dtype, r._dtype)
             if sup is None:
                 raise ValueError(f"cannot compare {l._dtype} with {r._dtype}")
-            la = l.cast(sup)._arrow
-            ra = r.cast(sup)._arrow
-        return Series.from_arrow(fn(la, ra), self._name, DataType.bool())
+            l = l.cast(sup)
+            r = r.cast(sup)
+        if len(l) != len(r) and len(l) != 1 and len(r) != 1:
+            raise ValueError(f"length mismatch: {len(l)} vs {len(r)}")
+        return Series.from_arrow(fn(*_binary_args(l, r)), self._name, DataType.bool())
 
     def __eq__(self, other):  # type: ignore[override]
         return self._cmp(other, pc.equal)
@@ -693,6 +694,19 @@ def _as_series(v) -> Series:
     if isinstance(v, Series):
         return v
     return Series.from_pylist([v], "literal")
+
+
+def _binary_args(a: Series, b: Series):
+    """Kernel operands for an elementwise binary op: a length-1 side is passed
+    as a pa.Scalar so arrow kernels broadcast natively (no materialized repeat)."""
+    na, nb = len(a), len(b)
+    if na == nb:
+        return a._arrow, b._arrow
+    if na == 1:
+        return a._arrow[0], b._arrow
+    if nb == 1:
+        return a._arrow, b._arrow[0]
+    raise ValueError(f"length mismatch: {na} vs {nb}")
 
 
 def _broadcast(a: Series, b: Series):

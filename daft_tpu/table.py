@@ -8,6 +8,7 @@ device-eligible pipelines through the jax kernel layer (kernels/device.py) inste
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -38,7 +39,7 @@ def _as_expressions(exprs) -> List[Expression]:
 
 
 class Table:
-    __slots__ = ("schema", "_columns")
+    __slots__ = ("schema", "_columns", "_eval_memo", "_memo_depth")
 
     def __init__(self, schema: Schema, columns: List[Series]):
         if len(schema) != len(columns):
@@ -49,6 +50,26 @@ class Table:
                 raise ValueError(f"column {f.name!r} length {len(c)} != {n}")
         self.schema = schema
         self._columns = columns
+        # cache of evaluated subexpressions, active only inside _memo_scope
+        # (tables are immutable, so hits are always sound; the scope bounds
+        # the lifetime of the cached column-sized intermediates)
+        self._eval_memo: Optional[Dict[Tuple, Series]] = None
+        self._memo_depth = 0
+
+    @contextmanager
+    def _memo_scope(self):
+        """Share structurally-identical subexpression results across the
+        evaluates of one logical pass; dropped when the outermost scope
+        exits so intermediates are not pinned for the table's lifetime."""
+        if self._memo_depth == 0:
+            self._eval_memo = {}
+        self._memo_depth += 1
+        try:
+            yield
+        finally:
+            self._memo_depth -= 1
+            if self._memo_depth == 0:
+                self._eval_memo = None
 
     # ------------------------------------------------------------------ ctors
     @staticmethod
@@ -157,10 +178,11 @@ class Table:
         out: List[Series] = []
         names: List[str] = []
         any_agg = any(e._node.is_aggregation() for e in exprs)
-        for e in exprs:
-            s = e._node.evaluate(self)
-            out.append(s)
-            names.append(e.name())
+        with self._memo_scope():
+            for e in exprs:
+                s = e._node.evaluate(self)
+                out.append(s)
+                names.append(e.name())
         if any_agg:
             m = max((len(s) for s in out), default=0)
         else:
@@ -173,15 +195,36 @@ class Table:
     def filter(self, predicate: Union[Expression, Sequence[Expression]]) -> "Table":
         preds = _as_expressions(predicate)
         mask: Optional[Series] = None
-        for p in preds:
-            s = p._node.evaluate(self)
-            if not s.dtype.is_boolean() and not s.dtype.is_null():
-                raise ValueError(f"filter predicate must be boolean, got {s.dtype}")
-            mask = s if mask is None else (mask & s)
+        with self._memo_scope():
+            for p in preds:
+                s = p._node.evaluate(self)
+                if not s.dtype.is_boolean() and not s.dtype.is_null():
+                    raise ValueError(f"filter predicate must be boolean, got {s.dtype}")
+                mask = s if mask is None else (mask & s)
         if mask is None:
             return self
         mask = _broadcast_series(mask, len(self))
-        return Table(self.schema, [c.filter(mask) for c in self._columns])
+        m = mask._arrow
+        if m is None:
+            return Table(self.schema, [c.filter(mask) for c in self._columns])
+        if m.null_count:
+            m = pc.fill_null(m, False)
+        # one multithreaded arrow-table filter instead of a per-column pass
+        arrow_idx = [i for i, c in enumerate(self._columns) if c._arrow is not None]
+        ftbl = None
+        if arrow_idx:
+            ftbl = pa.Table.from_arrays(
+                [self._columns[i]._arrow for i in arrow_idx],
+                names=[str(i) for i in arrow_idx]).filter(m)
+        out: List[Series] = []
+        for i, c in enumerate(self._columns):
+            if c._arrow is None:
+                out.append(c.filter(mask))
+            else:
+                ch = ftbl.column(str(i))
+                arr = ch.chunk(0) if ch.num_chunks == 1 else ch.combine_chunks()
+                out.append(Series(c._name, c._dtype, arr))
+        return Table(self.schema, out)
 
     def take(self, indices: Series) -> "Table":
         return Table(self.schema, [c.take(indices) for c in self._columns])
@@ -308,17 +351,31 @@ class Table:
     def _grouped_agg(self, to_agg: List[Expression], group_by: List[Expression]) -> "Table":
         key_tbl = self.eval_expression_list(group_by)
         n = len(self)
+        with self._memo_scope():
+            fast = self._acero_grouped_agg(to_agg, key_tbl)
+            if fast is not None:
+                return fast
+            return self._generic_grouped_agg(to_agg, key_tbl, n)
+
+    def _generic_grouped_agg(self, to_agg: List[Expression], key_tbl: "Table", n: int) -> "Table":
         codes, uniq = _group_codes(key_tbl)
         num_groups = len(uniq)
 
         out_cols: List[Series] = list(uniq._columns)
         out_fields: List[Field] = list(uniq.schema)
 
-        # Sort rows by group code once; per-group segments are then contiguous.
-        order = np.argsort(codes, kind="stable")
-        counts = np.bincount(codes, minlength=num_groups) if n else np.zeros(num_groups, np.int64)
-        offs = np.concatenate([[0], np.cumsum(counts)])
-        order_s = Series.from_arrow(pa.array(order.astype(np.uint64)), "o")
+        # Lazily sort rows by group code (only aggs that miss every vectorized
+        # path need contiguous per-group segments).
+        _seg = {}
+
+        def segments():
+            if not _seg:
+                order = np.argsort(codes, kind="stable")
+                counts = np.bincount(codes, minlength=num_groups) if n else np.zeros(num_groups, np.int64)
+                offs = np.concatenate([[0], np.cumsum(counts)])
+                _seg["order_s"] = Series.from_arrow(pa.array(order.astype(np.uint64)), "o")
+                _seg["offs"] = offs
+            return _seg["order_s"], _seg["offs"]
 
         for e in to_agg:
             node = e._node
@@ -329,9 +386,12 @@ class Table:
                 raise ValueError(f"aggregation list contains non-aggregation {e!r}")
             child_s = _broadcast_series(node.child.evaluate(self), n)
             expected_dt = node.to_field(self.schema).dtype
-            merged = _hash_agg_fast(node, child_s, codes, num_groups)
+            merged = _bincount_agg_fast(node, child_s, codes, num_groups)
+            if merged is None:
+                merged = _hash_agg_fast(node, child_s, codes, num_groups)
             if merged is None:
                 # fallback: contiguous per-group segments after a stable sort by code
+                order_s, offs = segments()
                 sorted_child = child_s.take(order_s)
                 outs = []
                 for g in range(num_groups):
@@ -341,6 +401,77 @@ class Table:
             if merged.dtype != expected_dt:
                 merged = merged.cast(expected_dt)
             out_cols.append(merged.rename(alias))
+            out_fields.append(Field(alias, expected_dt))
+        return Table(Schema(out_fields), out_cols)
+
+    def _acero_grouped_agg(self, to_agg: List[Expression], key_tbl: "Table") -> Optional["Table"]:
+        """Single multithreaded C++ hash-agg pass (arrow acero) for the whole
+        aggregation list. Returns None when any key/agg needs the generic
+        path. Group order (first occurrence) is recovered with a min(row_id)
+        side-aggregate so results are deterministic and identical to the
+        generic path."""
+        n = len(self)
+        if n == 0:
+            return None
+        cols: Dict[str, pa.Array] = {}
+        key_names = []
+        for i, s in enumerate(key_tbl._columns):
+            if s.is_python():
+                return None
+            arr = s.to_arrow()
+            if pa.types.is_nested(arr.type) or pa.types.is_dictionary(arr.type):
+                return None
+            # acero's hash table is ~3x slower on large_string keys; the 32-bit
+            # offset downcast is safe whenever the buffer is < 2GiB
+            if arr.nbytes < (1 << 31) - 1:
+                if pa.types.is_large_string(arr.type):
+                    arr = arr.cast(pa.string())
+                elif pa.types.is_large_binary(arr.type):
+                    arr = arr.cast(pa.binary())
+            cols[f"k{i}"] = arr
+            key_names.append(f"k{i}")
+        plans = []  # (vname, fname, node, alias)
+        agg_list = []
+        for j, e in enumerate(to_agg):
+            node = e._node
+            alias = e.name()
+            while isinstance(node, Alias):
+                node = node.child
+            if not isinstance(node, AggExpr):
+                raise ValueError(f"aggregation list contains non-aggregation {e!r}")
+            spec = _acero_agg_fn(node)
+            if spec is None:
+                return None
+            child_s = _broadcast_series(node.child.evaluate(self), n)
+            if child_s.is_python():
+                return None
+            fname, opts = spec
+            vname = f"v{j}"
+            cols[vname] = child_s.to_arrow()
+            agg_list.append((vname, fname, opts))
+            plans.append((vname, fname, node, alias))
+        cols["__row__"] = pa.array(np.arange(n, dtype=np.int64))
+        agg_list.append(("__row__", "min", None))
+        try:
+            g = pa.table(cols).group_by(key_names, use_threads=True).aggregate(agg_list)
+        except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError):
+            return None
+        order = np.argsort(np.asarray(g.column("__row___min").combine_chunks()), kind="stable")
+        g = g.take(pa.array(order))
+        out_cols: List[Series] = []
+        out_fields: List[Field] = []
+        for i, f in enumerate(key_tbl.schema):
+            s = Series.from_arrow(g.column(f"k{i}").combine_chunks(), f.name)
+            if s.dtype != f.dtype:
+                s = s.cast(f.dtype)
+            out_cols.append(s)
+            out_fields.append(f)
+        for vname, fname, node, alias in plans:
+            expected_dt = node.to_field(self.schema).dtype
+            s = Series.from_arrow(g.column(f"{vname}_{fname}").combine_chunks(), alias)
+            if s.dtype != expected_dt:
+                s = s.cast(expected_dt)
+            out_cols.append(s.rename(alias))
             out_fields.append(Field(alias, expected_dt))
         return Table(Schema(out_fields), out_cols)
 
@@ -586,16 +717,83 @@ def _group_codes(key_tbl: Table) -> Tuple[np.ndarray, Table]:
             _, combined = np.unique(combined, return_inverse=True)
             combined = combined.astype(np.int64)
         combined = combined * np.int64(card) + codes
-    uniq_vals, first_idx, codes = np.unique(combined, return_index=True, return_inverse=True)
-    codes = codes.astype(np.int64)
-    # order groups by first occurrence for determinism
-    order = np.argsort(first_idx, kind="stable")
-    remap = np.empty(len(uniq_vals), dtype=np.int64)
-    remap[order] = np.arange(len(uniq_vals))
+    # Densify the combined codes without an O(n log n) sort: arrow's
+    # dictionary_encode is a C++ hash pass. Group order is then fixed to
+    # first-occurrence via a reversed fancy-assignment (last write wins, so a
+    # reversed index write leaves each slot holding its FIRST occurrence).
+    enc = pa.array(combined).dictionary_encode()
+    codes = np.asarray(enc.indices).astype(np.int64)
+    num = len(enc.dictionary)
+    first_per_code = np.empty(num, dtype=np.int64)
+    first_per_code[codes[::-1]] = np.arange(n - 1, -1, -1)
+    order = np.argsort(first_per_code, kind="stable")
+    remap = np.empty(num, dtype=np.int64)
+    remap[order] = np.arange(num)
     codes = remap[codes]
-    first_idx = first_idx[order]
+    first_idx = first_per_code[order]
     uniq = key_tbl.take(Series.from_arrow(pa.array(first_idx.astype(np.uint64)), "i"))
     return codes, uniq
+
+
+def _acero_agg_fn(node: AggExpr):
+    """AggExpr -> (acero hash-agg function name, options), or None."""
+    k = node.kind
+    if k in ("sum", "mean", "min", "max", "count_distinct", "list"):
+        return {"count_distinct": "count_distinct"}.get(k, k), None
+    if k == "count":
+        mode = node.extra.get("mode", "valid")
+        if mode not in ("valid", "null", "all"):
+            return None
+        return "count", pc.CountOptions(
+            mode={"valid": "only_valid", "null": "only_null", "all": "all"}[mode])
+    if k == "stddev":
+        return "stddev", pc.VarianceOptions(ddof=0)
+    if k == "any_value":
+        return "first", pc.ScalarAggregateOptions(
+            skip_nulls=bool(node.extra.get("ignore_nulls", False)))
+    return None
+
+
+def _bincount_agg_fast(node: AggExpr, child: Series, codes: np.ndarray,
+                       num_groups: int) -> Optional[Series]:
+    """O(n) grouped count/sum/mean via np.bincount (no hash pass, no sort).
+
+    Floats only for sum/mean (bincount accumulates in float64; integer sums
+    stay on the exact arrow hash-agg path to avoid 2^53 precision loss).
+    Matches arrow hash-agg null semantics: nulls skipped, all-null/empty
+    groups yield null, NaN propagates.
+    """
+    if child.is_python() or num_groups == 0 or len(codes) == 0:
+        return None
+    k = node.kind
+    arr = child.to_arrow()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if k == "count":
+        mode = node.extra.get("mode", "valid")
+        if mode == "all" or (mode == "valid" and arr.null_count == 0):
+            cnt = np.bincount(codes, minlength=num_groups)
+        elif mode == "valid":
+            cnt = np.bincount(codes[np.asarray(arr.is_valid())], minlength=num_groups)
+        elif mode == "null":
+            cnt = np.bincount(codes[np.asarray(arr.is_null())], minlength=num_groups)
+        else:
+            return None
+        return Series.from_arrow(pa.array(cnt.astype(np.uint64)), child.name)
+    if k not in ("sum", "mean") or not pa.types.is_floating(arr.type):
+        return None
+    if arr.null_count == 0:
+        vals = arr.to_numpy(zero_copy_only=False)
+        sums = np.bincount(codes, weights=vals, minlength=num_groups)
+        cnt = np.bincount(codes, minlength=num_groups)
+    else:
+        valid = np.asarray(arr.is_valid())
+        vals = np.where(valid, arr.to_numpy(zero_copy_only=False), 0.0)
+        sums = np.bincount(codes, weights=vals, minlength=num_groups)
+        cnt = np.bincount(codes[valid], minlength=num_groups)
+    empty = cnt == 0
+    out = sums if k == "sum" else np.divide(sums, cnt, out=np.zeros_like(sums), where=~empty)
+    return Series.from_arrow(pa.array(out, type=pa.float64(), mask=empty), child.name)
 
 
 def _hash_agg_fast(node: AggExpr, child: Series, codes: np.ndarray, num_groups: int) -> Optional[Series]:

@@ -194,3 +194,41 @@ class TestReshape:
         b = Table.from_pydict({"x": [3.5]})
         out = Table.concat([a, b])
         assert out.to_pydict()["x"] == [1.0, 2.0, 3.5]
+
+
+class TestGroupedAggPaths:
+    """The acero one-pass fast path and the generic codes-based path must agree
+    bit-for-bit (incl. group order = first occurrence, null keys, all-null groups)."""
+
+    def _both(self, t, to_agg, group_by):
+        fast = t._grouped_agg(to_agg, group_by)
+        with t._memo_scope():
+            generic = t._generic_grouped_agg(to_agg, t.eval_expression_list(group_by), len(t))
+        return fast.to_pydict(), generic.to_pydict()
+
+    def test_parity_nulls_and_order(self):
+        t = Table.from_pydict({
+            "k": ["b", "a", None, "b", "a", None, "c"],
+            "v": [1.5, None, 2.0, 2.5, None, 4.0, None],
+            "i": [1, 2, 3, 4, 5, 6, 7],
+        })
+        to_agg = [col("v").sum().alias("s"), col("v").mean().alias("m"),
+                  col("v").count().alias("c"), col("i").min().alias("lo"),
+                  col("i").max().alias("hi")]
+        fast, generic = self._both(t, to_agg, [col("k")])
+        assert fast == generic
+        assert fast["k"] == ["b", "a", None, "c"]  # first-occurrence order
+        assert fast["s"] == [4.0, None, 6.0, None]  # all-null group -> null sum
+
+    def test_parity_multikey(self):
+        t = Table.from_pydict({
+            "a": ["x", "x", "y", "y", "x"],
+            "b": [1, 2, 1, 1, 2],
+            "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+        })
+        to_agg = [col("v").sum().alias("s"), col("v").stddev().alias("sd")]
+        fast, generic = self._both(t, to_agg, [col("a"), col("b")])
+        assert fast["a"] == generic["a"] and fast["b"] == generic["b"]
+        assert fast["s"] == generic["s"]
+        for x, y in zip(fast["sd"], generic["sd"]):
+            assert (x is None and y is None) or abs(x - y) < 1e-12
