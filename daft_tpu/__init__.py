@@ -30,3 +30,7 @@ try:
 except ModuleNotFoundError as _e:  # only tolerate api.py itself being absent (bootstrap)
     if _e.name != f"{__name__}.api":
         raise
+
+# Registers the image.* / url.* kernels (SQL and Function("image.decode")-style
+# callers need them even before any expression namespace property is touched).
+from . import multimodal  # noqa: E402,F401

@@ -1,0 +1,532 @@
+"""Multimodal kernels + expression namespaces: images and URLs.
+
+Role-equivalent to the reference's image kernel set
+(src/daft-core/src/array/ops/image.rs, 1,032 LoC: decode/encode/resize/crop/
+to_mode over the Image/FixedShapeImage logical types) and the url functions
+(src/daft-functions/src/uri/download.rs, upload.rs: batched concurrent GET with
+on_error raise|null semantics).
+
+TPU-first split: codecs (jpeg/png decode/encode) are inherently host-side —
+PIL plays the role of the reference's `image` crate — while *fixed-shape*
+resize is a dense batched op routed through jax.image.resize so it runs on
+the accelerator (one (N,H,W,C) program, MXU/VPU friendly); variable-shape
+images fall back to per-row host resize exactly like the reference's
+per-element kernels.
+
+Storage matches datatypes.DataType.to_physical():
+  Image            -> struct{data: list<u8>, channel: u16, height: u32,
+                            width: u32, mode: u8}
+  FixedShapeImage  -> fixed_size_list<u8|u16|f32>[h*w*c]
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import io
+import os
+import urllib.request
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from .datatypes import _IMAGE_MODE_CHANNELS, IMAGE_MODES, DataType, TypeKind
+from .expressions import _Namespace
+from .functions import register
+from .series import Series
+
+# ---------------------------------------------------------------------------
+# mode helpers
+# ---------------------------------------------------------------------------
+
+MODE_TO_ID = {m: i for i, m in enumerate(IMAGE_MODES)}
+ID_TO_MODE = {i: m for i, m in enumerate(IMAGE_MODES)}
+
+_PIL_TO_MODE = {"L": "L", "LA": "LA", "RGB": "RGB", "RGBA": "RGBA",
+                "I;16": "L16", "F": "RGB32F"}
+_MODE_TO_PIL = {"L": "L", "LA": "LA", "RGB": "RGB", "RGBA": "RGBA", "L16": "I;16"}
+
+
+def _mode_np_dtype(mode: str):
+    if mode.endswith("32F"):
+        return np.float32
+    if mode.endswith("16"):
+        return np.uint16
+    return np.uint8
+
+
+def _mode_channels(mode: str) -> int:
+    return _IMAGE_MODE_CHANNELS[mode]
+
+
+# ---------------------------------------------------------------------------
+# Image series <-> numpy
+# ---------------------------------------------------------------------------
+
+def image_series_from_arrays(arrays: Sequence[Optional[np.ndarray]], name: str = "image",
+                             modes: Optional[Sequence[Optional[str]]] = None,
+                             dtype_mode: Optional[str] = None) -> Series:
+    """Build a variable-shape Image Series from HxWxC (or HxW) numpy arrays."""
+    data_chunks: List[np.ndarray] = []
+    offsets = [0]
+    channel, height, width, mode_ids, valid = [], [], [], [], []
+    total = 0
+    for i, a in enumerate(arrays):
+        if a is None:
+            valid.append(False)
+            channel.append(0); height.append(0); width.append(0); mode_ids.append(0)
+            offsets.append(total)
+            continue
+        if a.ndim == 2:
+            a = a[:, :, None]
+        m = modes[i] if modes is not None and modes[i] is not None else _default_mode(a)
+        a = a.astype(_mode_np_dtype(m), copy=False)
+        valid.append(True)
+        h, w, c = a.shape
+        flat = a.reshape(-1).view(np.uint8)
+        data_chunks.append(flat)
+        total += flat.size
+        offsets.append(total)
+        channel.append(c); height.append(h); width.append(w); mode_ids.append(MODE_TO_ID[m])
+    data = np.concatenate(data_chunks) if data_chunks else np.empty(0, np.uint8)
+    dt = DataType.image(dtype_mode)
+    storage_t = dt.to_arrow()
+    fields = {f.name: f.type for f in storage_t}
+    lst = pa.LargeListArray.from_arrays(pa.array(offsets, pa.int64()), pa.array(data, pa.uint8()))
+    if not pa.types.is_large_list(fields["data"]):
+        lst = lst.cast(fields["data"])
+    mask = pa.array([not v for v in valid], pa.bool_())
+    struct = pa.StructArray.from_arrays(
+        [lst,
+         pa.array(channel, fields["channel"]),
+         pa.array(height, fields["height"]),
+         pa.array(width, fields["width"]),
+         pa.array(mode_ids, fields["mode"])],
+        names=["data", "channel", "height", "width", "mode"],
+        mask=mask)
+    if struct.type != storage_t:
+        struct = struct.cast(storage_t)
+    return Series(name, dt, struct)
+
+
+def _default_mode(a: np.ndarray) -> str:
+    c = a.shape[2] if a.ndim == 3 else 1
+    base = {1: "L", 2: "LA", 3: "RGB", 4: "RGBA"}[c]
+    if a.dtype == np.uint16:
+        return base + "16"
+    if a.dtype in (np.float32, np.float64):
+        if base in ("RGB", "RGBA"):
+            return base + "32F"
+        raise ValueError(f"no float image mode for {base}")
+    return base
+
+
+def image_series_to_arrays(s: Series) -> List[Optional[np.ndarray]]:
+    """Image/FixedShapeImage Series -> list of HxWxC numpy arrays (None = null)."""
+    dt = s.dtype
+    if dt.kind == TypeKind.FIXED_SHAPE_IMAGE:
+        mode, h, w = dt.params
+        c = _mode_channels(mode)
+        npdt = _mode_np_dtype(mode)
+        arr = s.to_arrow()
+        per = h * w * c
+        # .values spans the whole child buffer; honor a sliced parent's offset
+        flat = np.asarray(arr.values.to_numpy(zero_copy_only=False))
+        flat = flat[arr.offset * per:(arr.offset + len(arr)) * per]
+        out: List[Optional[np.ndarray]] = []
+        valid = np.asarray(arr.is_valid())
+        for i in range(len(arr)):
+            if not valid[i]:
+                out.append(None)
+            else:
+                out.append(flat[i * per:(i + 1) * per].astype(npdt, copy=False).reshape(h, w, c))
+        return out
+    if dt.kind != TypeKind.IMAGE:
+        raise ValueError(f"expected an image series, got {dt}")
+    arr = s.to_arrow()
+    data = arr.field("data")
+    ch = arr.field("channel").to_numpy(zero_copy_only=False)
+    hh = arr.field("height").to_numpy(zero_copy_only=False)
+    ww = arr.field("width").to_numpy(zero_copy_only=False)
+    mm = arr.field("mode").to_numpy(zero_copy_only=False)
+    offs = np.asarray(data.offsets)
+    raw = np.asarray(data.values)
+    valid = np.asarray(arr.is_valid())
+    out = []
+    for i in range(len(arr)):
+        if not valid[i]:
+            out.append(None)
+            continue
+        m = ID_TO_MODE[int(mm[i])]
+        npdt = _mode_np_dtype(m)
+        seg = raw[offs[i]:offs[i + 1]].view(npdt)
+        out.append(seg.reshape(int(hh[i]), int(ww[i]), int(ch[i])))
+    return out
+
+
+def _to_pil(a: np.ndarray):
+    from PIL import Image as PILImage
+
+    if a.shape[2] == 1:
+        a = a[:, :, 0]
+    return PILImage.fromarray(a)
+
+
+def _pil_to_np(img) -> Tuple[np.ndarray, str]:
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    mode = _PIL_TO_MODE.get(img.mode)
+    if mode is None:
+        img = img.convert("RGB")
+        a = np.asarray(img)
+        mode = "RGB"
+    return a, mode
+
+
+# ---------------------------------------------------------------------------
+# image kernels
+# ---------------------------------------------------------------------------
+
+def image_decode(s: Series, mode: Optional[str] = None, on_error: str = "raise") -> Series:
+    """binary -> Image. Reference: image.rs decode + ImageMode conversion."""
+    from PIL import Image as PILImage
+
+    if mode is not None and mode not in IMAGE_MODES:
+        raise ValueError(f"unknown image mode {mode!r}")
+    vals = s.to_pylist()
+    arrays: List[Optional[np.ndarray]] = []
+    modes: List[Optional[str]] = []
+    for v in vals:
+        if v is None:
+            arrays.append(None); modes.append(None)
+            continue
+        try:
+            img = PILImage.open(io.BytesIO(v))
+            if mode is not None:
+                img = img.convert(_MODE_TO_PIL.get(mode, mode))
+            a, m = _pil_to_np(img)
+            arrays.append(a); modes.append(mode or m)
+        except Exception:
+            if on_error == "null":
+                arrays.append(None); modes.append(None)
+            else:
+                raise
+    return image_series_from_arrays(arrays, s.name, modes, dtype_mode=mode)
+
+
+def image_encode(s: Series, image_format: str) -> Series:
+    """Image -> binary in the requested codec (PNG/JPEG/TIFF/BMP/GIF)."""
+    fmt = image_format.upper()
+    if fmt == "JPG":
+        fmt = "JPEG"
+    arrays = image_series_to_arrays(s)
+    out: List[Optional[bytes]] = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+            continue
+        img = _to_pil(a)
+        if fmt == "JPEG" and img.mode in ("RGBA", "LA"):
+            img = img.convert("RGB")
+        buf = io.BytesIO()
+        img.save(buf, format=fmt)
+        out.append(buf.getvalue())
+    return Series.from_pylist(out, s.name, DataType.binary())
+
+
+def image_resize(s: Series, w: int, h: int) -> Series:
+    """Resize. Fixed-shape inputs run as ONE batched jax.image.resize program
+    (device path); variable-shape images resize per row on host via PIL."""
+    dt = s.dtype
+    if dt.kind == TypeKind.FIXED_SHAPE_IMAGE:
+        return _resize_fixed_device(s, w, h)
+    arrays = image_series_to_arrays(s)
+    modes: List[Optional[str]] = []
+    out: List[Optional[np.ndarray]] = []
+    for a in arrays:
+        if a is None:
+            out.append(None); modes.append(None)
+            continue
+        m = _default_mode(a)
+        img = _to_pil(a).resize((w, h), resample=_BILINEAR())
+        b = np.asarray(img)
+        if b.ndim == 2:
+            b = b[:, :, None]
+        out.append(b); modes.append(m)
+    return image_series_from_arrays(out, s.name, modes,
+                                    dtype_mode=dt.params[0] if dt.kind == TypeKind.IMAGE else None)
+
+
+def _BILINEAR():
+    from PIL import Image as PILImage
+
+    return PILImage.BILINEAR
+
+
+def _resize_fixed_device(s: Series, w: int, h: int) -> Series:
+    import jax
+    import jax.numpy as jnp
+
+    mode, oh, ow = s.dtype.params
+    c = _mode_channels(mode)
+    npdt = _mode_np_dtype(mode)
+    arr = s.to_arrow()
+    n = len(arr)
+    per = oh * ow * c
+    flat = np.asarray(arr.values.to_numpy(zero_copy_only=False)).astype(npdt, copy=False)
+    flat = flat[arr.offset * per:(arr.offset + n) * per]
+    batch = flat.reshape(n, oh, ow, c).astype(np.float32)
+    resized = jax.image.resize(jnp.asarray(batch), (n, h, w, c), method="bilinear")
+    resized = np.asarray(jax.device_get(resized))
+    if npdt != np.float32:
+        info = np.iinfo(npdt)
+        resized = np.clip(np.rint(resized), info.min, info.max)
+    resized = resized.astype(npdt)
+    out_dt = DataType.image(mode, h, w)
+    values = pa.array(resized.reshape(-1), out_dt.to_arrow().value_type)
+    fsl = pa.FixedSizeListArray.from_arrays(values, h * w * c)
+    if arr.null_count:
+        mask = np.asarray(arr.is_null())
+        fsl = pa.Array.from_pandas(  # re-apply validity
+            [None if mask[i] else fsl[i].values.to_pylist() for i in range(n)],
+            type=out_dt.to_arrow())
+    return Series(s.name, out_dt, fsl)
+
+
+def image_crop(s: Series, bbox) -> Series:
+    """Crop to (x, y, w, h). bbox is a python tuple or a per-row Series of
+    4-element lists. Always returns variable-shape Image (reference parity)."""
+    arrays = image_series_to_arrays(s)
+    n = len(arrays)
+    if isinstance(bbox, Series):
+        boxes = bbox.to_pylist()
+        if len(boxes) == 1:
+            boxes = boxes * n
+    else:
+        boxes = [tuple(bbox)] * n
+    out: List[Optional[np.ndarray]] = []
+    modes: List[Optional[str]] = []
+    for a, b in zip(arrays, boxes):
+        if a is None or b is None:
+            out.append(None); modes.append(None)
+            continue
+        x, y, w, h = (int(v) for v in b)
+        ih, iw = a.shape[0], a.shape[1]
+        crop = a[max(y, 0):min(y + h, ih), max(x, 0):min(x + w, iw)]
+        out.append(crop.copy())
+        modes.append(_default_mode(a))
+    return image_series_from_arrays(out, s.name, modes)
+
+
+def image_to_mode(s: Series, mode: str) -> Series:
+    if mode not in IMAGE_MODES:
+        raise ValueError(f"unknown image mode {mode!r}")
+    arrays = image_series_to_arrays(s)
+    out: List[Optional[np.ndarray]] = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+            continue
+        img = _to_pil(a).convert(_MODE_TO_PIL.get(mode, mode))
+        b = np.asarray(img)
+        if b.ndim == 2:
+            b = b[:, :, None]
+        out.append(b.astype(_mode_np_dtype(mode), copy=False))
+    dt = s.dtype
+    if dt.kind == TypeKind.FIXED_SHAPE_IMAGE:
+        _, h, w = dt.params
+        return _fixed_image_series(out, s.name, mode, h, w)
+    return image_series_from_arrays(out, s.name, [mode] * len(out), dtype_mode=mode)
+
+
+def _fixed_image_series(arrays: List[Optional[np.ndarray]], name: str, mode: str,
+                        h: int, w: int) -> Series:
+    dt = DataType.image(mode, h, w)
+    c = _mode_channels(mode)
+    t = dt.to_arrow()
+    rows = [None if a is None else a.reshape(-1).tolist() for a in arrays]
+    return Series(name, dt, pa.array(rows, type=t))
+
+
+# ---------------------------------------------------------------------------
+# url kernels
+# ---------------------------------------------------------------------------
+
+def _fetch_one(url: str, timeout: float) -> bytes:
+    if url.startswith(("http://", "https://")):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read()
+    if url.startswith("file://"):
+        path = url[len("file://"):]
+    else:
+        path = url
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def url_download(s: Series, max_connections: int = 32, on_error: str = "raise",
+                 timeout: float = 30.0) -> Series:
+    """string urls -> binary contents; concurrent like the reference's bulk GET
+    (download.rs: max_connections-wide async multiget, ordered results)."""
+    urls = s.to_pylist()
+    out: List[Optional[bytes]] = [None] * len(urls)
+    errs: List[Optional[Exception]] = [None] * len(urls)
+    workers = max(1, min(int(max_connections), 64))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+        futs = {}
+        for i, u in enumerate(urls):
+            if u is None:
+                continue
+            futs[ex.submit(_fetch_one, u, timeout)] = i
+        for f in concurrent.futures.as_completed(futs):
+            i = futs[f]
+            try:
+                out[i] = f.result()
+            except Exception as e:  # noqa: BLE001
+                errs[i] = e
+    first_err = next((e for e in errs if e is not None), None)
+    if first_err is not None and on_error != "null":
+        raise first_err
+    return Series.from_pylist(out, s.name, DataType.binary())
+
+
+def url_upload(s: Series, location, on_error: str = "raise",
+               max_connections: int = 32) -> Series:
+    """binary contents -> written file paths under `location` (local/file://)."""
+    if isinstance(location, Series):
+        locs = location.to_pylist()
+        if len(locs) == 1:
+            locs = locs * len(s)
+    else:
+        locs = [location] * len(s)
+    vals = s.to_pylist()
+    out: List[Optional[str]] = []
+    for i, (v, loc) in enumerate(zip(vals, locs)):
+        if v is None or loc is None:
+            out.append(None)
+            continue
+        if loc.startswith("file://"):
+            loc = loc[len("file://"):]
+        if loc.startswith(("s3://", "gs://", "az://")):
+            if on_error == "null":
+                out.append(None)
+                continue
+            raise NotImplementedError(f"remote upload target {loc!r} requires an object-store client")
+        try:
+            os.makedirs(loc, exist_ok=True)
+            path = os.path.join(loc, f"{i}-{abs(hash((id(s), i))) % 10**8}.bin")
+            with open(path, "wb") as f:
+                f.write(v if isinstance(v, (bytes, bytearray)) else str(v).encode())
+            out.append(path)
+        except Exception:
+            if on_error == "null":
+                out.append(None)
+            else:
+                raise
+    return Series.from_pylist(out, s.name, DataType.string())
+
+
+# ---------------------------------------------------------------------------
+# function registry entries
+# ---------------------------------------------------------------------------
+
+def _req_image(dt: DataType, what: str) -> None:
+    if dt.kind not in (TypeKind.IMAGE, TypeKind.FIXED_SHAPE_IMAGE):
+        raise ValueError(f"{what} expects an image column, got {dt}")
+
+
+def _res_decode(*dts, mode=None, on_error="raise"):
+    if not (dts[0].kind == TypeKind.BINARY or dts[0].is_null()):
+        raise ValueError(f"image.decode expects binary, got {dts[0]}")
+    return DataType.image(mode)
+
+
+def _res_encode(*dts, image_format="png"):
+    _req_image(dts[0], "image.encode")
+    return DataType.binary()
+
+
+def _res_resize(*dts, w=None, h=None):
+    _req_image(dts[0], "image.resize")
+    d = dts[0]
+    if d.kind == TypeKind.FIXED_SHAPE_IMAGE:
+        return DataType.image(d.params[0], h, w)
+    return d
+
+
+def _res_crop(*dts, bbox=None):
+    _req_image(dts[0], "image.crop")
+    d = dts[0]
+    mode = d.params[0] if d.kind != TypeKind.FIXED_SHAPE_IMAGE else None
+    return DataType.image(mode)
+
+
+def _res_to_mode(*dts, mode=None):
+    _req_image(dts[0], "image.to_mode")
+    d = dts[0]
+    if d.kind == TypeKind.FIXED_SHAPE_IMAGE:
+        return DataType.image(mode, d.params[1], d.params[2])
+    return DataType.image(mode)
+
+
+def _res_download(*dts, **_kw):
+    if not (dts[0].is_string() or dts[0].is_null()):
+        raise ValueError(f"url.download expects string urls, got {dts[0]}")
+    return DataType.binary()
+
+
+def _res_upload(*dts, **_kw):
+    return DataType.string()
+
+
+register("image.decode", _res_decode, image_decode)
+register("image.encode", _res_encode,
+         lambda s, image_format="png": image_encode(s, image_format))
+register("image.resize", _res_resize, lambda s, w=None, h=None: image_resize(s, w, h))
+register("image.crop", _res_crop,
+         lambda s, *args, bbox=None: image_crop(s, args[0] if args else bbox))
+register("image.to_mode", _res_to_mode, lambda s, mode=None: image_to_mode(s, mode))
+register("url.download", _res_download, url_download)
+register("url.upload", _res_upload,
+         lambda s, *args, location=None, **kw: url_upload(s, args[0] if args else location, **kw))
+
+
+# ---------------------------------------------------------------------------
+# expression namespaces (reference: ExpressionImageNamespace /
+# ExpressionUrlNamespace, daft/expressions/expressions.py:3110,1151)
+# ---------------------------------------------------------------------------
+
+class ExprImageNamespace(_Namespace):
+    def decode(self, on_error: str = "raise", mode: Optional[str] = None):
+        return self._fn("image.decode", mode=mode, on_error=on_error)
+
+    def encode(self, image_format: str):
+        return self._fn("image.encode", image_format=image_format)
+
+    def resize(self, w: int, h: int):
+        return self._fn("image.resize", w=w, h=h)
+
+    def crop(self, bbox):
+        from .expressions import Expression
+
+        if isinstance(bbox, Expression):
+            return self._fn("image.crop", bbox)
+        return self._fn("image.crop", bbox=tuple(bbox))
+
+    def to_mode(self, mode: str):
+        return self._fn("image.to_mode", mode=mode)
+
+
+class ExprUrlNamespace(_Namespace):
+    def download(self, max_connections: int = 32, on_error: str = "raise",
+                 io_config=None, use_native_downloader: bool = True):
+        return self._fn("url.download", max_connections=max_connections, on_error=on_error)
+
+    def upload(self, location, on_error: str = "raise", max_connections: int = 32,
+               io_config=None):
+        from .expressions import Expression
+
+        if isinstance(location, Expression):
+            return self._fn("url.upload", location, on_error=on_error)
+        return self._fn("url.upload", location=location, on_error=on_error)

@@ -204,6 +204,36 @@ class Series:
         try:
             out = pc.cast(src, options=opts)
         except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            if dtype.kind == TypeKind.FIXED_SHAPE_IMAGE and self._dtype.kind == TypeKind.IMAGE:
+                from .multimodal import (_fixed_image_series, _mode_channels,
+                                         _mode_np_dtype, image_series_to_arrays)
+
+                mode, h, w = dtype.params
+                want_c = _mode_channels(mode)
+                want_np = _mode_np_dtype(mode)
+                arrays = image_series_to_arrays(self)
+                for a in arrays:
+                    if a is None:
+                        continue
+                    if a.shape[:2] != (h, w):
+                        raise ValueError(
+                            f"cannot cast image of shape {a.shape} to fixed shape ({h}, {w})")
+                    if a.shape[2] != want_c:
+                        raise ValueError(
+                            f"cannot cast {a.shape[2]}-channel image to mode {mode!r} "
+                            f"({want_c} channels); convert with image.to_mode first")
+                    if a.dtype != want_np:
+                        raise ValueError(
+                            f"cannot cast {a.dtype} image pixels to mode {mode!r} "
+                            f"({np.dtype(want_np).name}); convert with image.to_mode first")
+                return _fixed_image_series(arrays, self._name, mode, h, w)
+            if dtype.kind == TypeKind.IMAGE and self._dtype.kind == TypeKind.FIXED_SHAPE_IMAGE:
+                from .multimodal import image_series_from_arrays, image_series_to_arrays
+
+                arrays = image_series_to_arrays(self)
+                m = self._dtype.params[0]
+                return image_series_from_arrays(arrays, self._name, [m] * len(arrays),
+                                                dtype_mode=dtype.params[0])
             if dtype.is_string():
                 out = pa.array([None if v is None else str(v) for v in src.to_pylist()], type=pa.large_string())
             elif dtype.is_temporal() and (self._dtype.is_integer() or self._dtype.is_floating()):
@@ -314,8 +344,6 @@ class Series:
                 raise ValueError(f"cannot compare {l._dtype} with {r._dtype}")
             l = l.cast(sup)
             r = r.cast(sup)
-        if len(l) != len(r) and len(l) != 1 and len(r) != 1:
-            raise ValueError(f"length mismatch: {len(l)} vs {len(r)}")
         return Series.from_arrow(fn(*_binary_args(l, r)), self._name, DataType.bool())
 
     def __eq__(self, other):  # type: ignore[override]

@@ -349,9 +349,11 @@ class Table:
         return self._grouped_agg(to_agg, group_by)
 
     def _grouped_agg(self, to_agg: List[Expression], group_by: List[Expression]) -> "Table":
-        key_tbl = self.eval_expression_list(group_by)
         n = len(self)
         with self._memo_scope():
+            # keys evaluated inside the scope so subtrees shared between the
+            # group-by keys and the agg children are computed once
+            key_tbl = self.eval_expression_list(group_by)
             fast = self._acero_grouped_agg(to_agg, key_tbl)
             if fast is not None:
                 return fast
@@ -439,7 +441,7 @@ class Table:
                 node = node.child
             if not isinstance(node, AggExpr):
                 raise ValueError(f"aggregation list contains non-aggregation {e!r}")
-            spec = _acero_agg_fn(node)
+            spec = _acero_agg_fn(node, threaded=True)
             if spec is None:
                 return None
             child_s = _broadcast_series(node.child.evaluate(self), n)
@@ -735,9 +737,15 @@ def _group_codes(key_tbl: Table) -> Tuple[np.ndarray, Table]:
     return codes, uniq
 
 
-def _acero_agg_fn(node: AggExpr):
-    """AggExpr -> (acero hash-agg function name, options), or None."""
+def _acero_agg_fn(node: AggExpr, threaded: bool = False):
+    """AggExpr -> (acero hash-agg function name, options), or None.
+
+    With threaded=True, order-dependent aggregates (list, any_value/first) are
+    rejected: pyarrow guarantees no stable ordering under a threaded exec plan,
+    which would break parity with the sequential path."""
     k = node.kind
+    if k in ("list", "any_value") and threaded:
+        return None
     if k in ("sum", "mean", "min", "max", "count_distinct", "list"):
         return {"count_distinct": "count_distinct"}.get(k, k), None
     if k == "count":
@@ -804,31 +812,10 @@ def _hash_agg_fast(node: AggExpr, child: Series, codes: np.ndarray, num_groups: 
     if child.is_python() or num_groups == 0:
         return None
     k = node.kind
-    opts = None
-    if k == "sum":
-        fname = "sum"
-    elif k == "mean":
-        fname = "mean"
-    elif k == "min":
-        fname = "min"
-    elif k == "max":
-        fname = "max"
-    elif k == "count":
-        mode = node.extra.get("mode", "valid")
-        fname = "count"
-        opts = pc.CountOptions(mode={"valid": "only_valid", "null": "only_null", "all": "all"}[mode])
-    elif k in ("count_distinct",):
-        fname = "count_distinct"
-    elif k == "stddev":
-        fname = "stddev"
-        opts = pc.VarianceOptions(ddof=0)
-    elif k == "list":
-        fname = "list"
-    elif k == "any_value":
-        fname = "first"
-        opts = pc.ScalarAggregateOptions(skip_nulls=bool(node.extra.get("ignore_nulls", False)))
-    else:
+    spec = _acero_agg_fn(node)  # sequential plan: order-dependent aggs allowed
+    if spec is None:
         return None
+    fname, opts = spec
     arr = child.to_arrow()
     if pa.types.is_nested(arr.type) and k in ("sum", "mean", "min", "max", "stddev", "count_distinct", "list"):
         return None

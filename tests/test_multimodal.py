@@ -1,0 +1,144 @@
+"""Image + url namespace tests (reference: tests/series/test_image.py,
+tests/table/table_io + url download tests)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import DataType, Series, col
+from daft_tpu.datatypes import TypeKind
+from daft_tpu.multimodal import (
+    image_series_from_arrays,
+    image_series_to_arrays,
+)
+
+
+def _png_bytes(arr: np.ndarray) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+@pytest.fixture
+def rgb_pngs():
+    rng = np.random.RandomState(0)
+    imgs = [rng.randint(0, 255, (h, w, 3), dtype=np.uint8) for h, w in [(4, 6), (8, 3)]]
+    return imgs, [_png_bytes(a) for a in imgs]
+
+
+class TestImageDecodeEncode:
+    def test_decode_roundtrip(self, rgb_pngs):
+        imgs, blobs = rgb_pngs
+        df = dt.from_pydict({"b": Series.from_pylist(blobs, "b", DataType.binary())})
+        out = df.select(col("b").image.decode().alias("img")).collect()
+        s = out.to_table().get_column("img")
+        assert s.dtype.kind == TypeKind.IMAGE
+        arrays = image_series_to_arrays(s)
+        for got, want in zip(arrays, imgs):
+            np.testing.assert_array_equal(got, want)
+
+    def test_decode_null_and_on_error(self, rgb_pngs):
+        _, blobs = rgb_pngs
+        df = dt.from_pydict({"b": Series.from_pylist(
+            [blobs[0], None, b"not an image"], "b", DataType.binary())})
+        with pytest.raises(Exception):
+            df.select(col("b").image.decode().alias("i")).collect().to_pydict()
+        out = df.select(col("b").image.decode(on_error="null").alias("i")).collect()
+        arrays = image_series_to_arrays(out.to_table().get_column("i"))
+        assert arrays[1] is None and arrays[2] is None and arrays[0] is not None
+
+    def test_encode_decode_identity(self, rgb_pngs):
+        imgs, blobs = rgb_pngs
+        df = dt.from_pydict({"b": Series.from_pylist(blobs, "b", DataType.binary())})
+        out = df.select(col("b").image.decode().image.encode("png").alias("b2")).collect()
+        blobs2 = out.to_pydict()["b2"]
+        from PIL import Image
+
+        for b2, want in zip(blobs2, imgs):
+            np.testing.assert_array_equal(np.asarray(Image.open(io.BytesIO(b2))), want)
+
+
+class TestImageOps:
+    def test_resize_variable(self, rgb_pngs):
+        imgs, blobs = rgb_pngs
+        df = dt.from_pydict({"b": Series.from_pylist(blobs, "b", DataType.binary())})
+        out = df.select(col("b").image.decode().image.resize(5, 7).alias("i")).collect()
+        arrays = image_series_to_arrays(out.to_table().get_column("i"))
+        assert all(a.shape == (7, 5, 3) for a in arrays)
+
+    def test_resize_fixed_shape_device_path(self):
+        rng = np.random.RandomState(1)
+        imgs = [rng.randint(0, 255, (4, 4, 3), dtype=np.uint8) for _ in range(3)]
+        s = image_series_from_arrays(imgs, "i")
+        fixed = s.cast(DataType.image("RGB", 4, 4))
+        assert fixed.dtype.kind == TypeKind.FIXED_SHAPE_IMAGE
+        from daft_tpu.multimodal import image_resize
+
+        out = image_resize(fixed, 2, 2)
+        assert out.dtype == DataType.image("RGB", 2, 2)
+        arrays = image_series_to_arrays(out)
+        assert all(a.shape == (2, 2, 3) for a in arrays)
+        # bilinear downscale of a constant image stays constant
+        const = image_series_from_arrays([np.full((4, 4, 3), 77, np.uint8)], "c")
+        cf = const.cast(DataType.image("RGB", 4, 4))
+        np.testing.assert_array_equal(image_series_to_arrays(image_resize(cf, 2, 2))[0],
+                                      np.full((2, 2, 3), 77, np.uint8))
+
+    def test_crop(self, rgb_pngs):
+        imgs, blobs = rgb_pngs
+        df = dt.from_pydict({"b": Series.from_pylist(blobs, "b", DataType.binary())})
+        out = df.select(col("b").image.decode().image.crop((1, 1, 3, 2)).alias("i")).collect()
+        arrays = image_series_to_arrays(out.to_table().get_column("i"))
+        np.testing.assert_array_equal(arrays[0], imgs[0][1:3, 1:4])
+
+    def test_to_mode(self, rgb_pngs):
+        imgs, blobs = rgb_pngs
+        df = dt.from_pydict({"b": Series.from_pylist(blobs, "b", DataType.binary())})
+        out = df.select(col("b").image.decode().image.to_mode("L").alias("i")).collect()
+        arrays = image_series_to_arrays(out.to_table().get_column("i"))
+        assert arrays[0].shape == (4, 6, 1)
+
+
+class TestUrl:
+    def test_download_local_files(self, tmp_path):
+        paths, contents = [], []
+        for i in range(5):
+            p = tmp_path / f"f{i}.bin"
+            c = os.urandom(64)
+            p.write_bytes(c)
+            paths.append(str(p))
+            contents.append(c)
+        paths.append(None)
+        df = dt.from_pydict({"p": paths})
+        out = df.select(col("p").url.download().alias("b")).to_pydict()
+        assert out["b"][:5] == contents and out["b"][5] is None
+
+    def test_download_on_error_null(self, tmp_path):
+        df = dt.from_pydict({"p": [str(tmp_path / "missing.bin")]})
+        with pytest.raises(Exception):
+            df.select(col("p").url.download().alias("b")).to_pydict()
+        out = df.select(col("p").url.download(on_error="null").alias("b")).to_pydict()
+        assert out["b"] == [None]
+
+    def test_upload_roundtrip(self, tmp_path):
+        blobs = [b"alpha", b"bravo", None]
+        df = dt.from_pydict({"b": Series.from_pylist(blobs, "b", DataType.binary())})
+        out = df.select(col("b").url.upload(str(tmp_path)).alias("p")).to_pydict()
+        assert out["p"][2] is None
+        for p, want in zip(out["p"][:2], blobs[:2]):
+            assert open(p, "rb").read() == want
+
+    def test_download_then_decode_pipeline(self, tmp_path, ):
+        rng = np.random.RandomState(2)
+        img = rng.randint(0, 255, (3, 3, 3), dtype=np.uint8)
+        p = tmp_path / "img.png"
+        p.write_bytes(_png_bytes(img))
+        df = dt.from_pydict({"u": [str(p)]})
+        out = df.select(col("u").url.download().image.decode().alias("i")).collect()
+        np.testing.assert_array_equal(
+            image_series_to_arrays(out.to_table().get_column("i"))[0], img)
