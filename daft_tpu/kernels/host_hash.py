@@ -115,6 +115,10 @@ def _hash_fixed(arr: pa.Array, seeds: np.ndarray) -> np.ndarray:
         bits = vals.view(np.uint64)
     else:
         bits = _values_np(arr).astype(np.int64, copy=False).view(np.uint64)
+    from .. import native
+
+    if native.available():
+        return native.hash_fixed64(bits, _valid_mask(arr), seeds)
     h = _splitmix64(bits ^ seeds)
     return _apply_null_mask(arr, h, seeds)
 
@@ -155,6 +159,10 @@ def _offsets_and_bytes(arr: pa.Array):
 def _hash_varlen(orig: pa.Array, seeds: np.ndarray) -> np.ndarray:
     n = len(orig)
     offs, data, filled = _offsets_and_bytes(orig if not isinstance(orig, pa.ChunkedArray) else orig.combine_chunks())
+    from .. import native
+
+    if native.available():
+        return native.hash_bytes(data, offs, _valid_mask(orig), seeds)
     lengths = offs[1:] - offs[:-1]
     start, end = offs[0], offs[-1]
     seg = data[start:end].astype(np.uint64)
@@ -212,6 +220,10 @@ def _hash_decimal128(arr: pa.Array, seeds: np.ndarray) -> np.ndarray:
 def _hash_segments_from_offsets(
     arr: pa.Array, offs: np.ndarray, inner_hashes: np.ndarray, seeds: np.ndarray, n: int
 ) -> np.ndarray:
+    from .. import native
+
+    if native.available():
+        return native.hash_segments(inner_hashes, offs, _valid_mask(arr), seeds)
     lengths = offs[1:] - offs[:-1]
     if len(inner_hashes):
         pos = np.arange(len(inner_hashes), dtype=np.int64) - np.repeat(offs[:-1], lengths)

@@ -91,6 +91,20 @@ def murmur3_32_arrow(arr: pa.Array) -> pa.Array:
     elif pa.types.is_timestamp(t) or pa.types.is_time(t):
         return murmur3_32_arrow(arr.cast(pa.int64()))
     elif pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        from .. import native
+
+        if native.available():
+            from .host_hash import _offsets_and_bytes
+
+            offs, data, _filled = _offsets_and_bytes(
+                arr if pa.types.is_binary(arr.type) or pa.types.is_large_binary(arr.type)
+                else arr.cast(pa.large_binary()))
+            valid = np.asarray(mask, dtype=bool) if mask is not None else None
+            out = native.murmur3_bytes(data, offs, valid, 0)
+            res = pa.array(out, type=pa.int32())
+            if mask is not None:
+                res = pc.if_else(mask, res, pa.nulls(len(res), pa.int32()))
+            return res
         vals = arr.to_pylist()
         out = [
             None if v is None else _mm3_scalar_bytes(v.encode() if isinstance(v, str) else bytes(v))

@@ -25,6 +25,7 @@ import concurrent.futures
 import io
 import os
 import urllib.request
+import uuid
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -42,9 +43,12 @@ from .series import Series
 MODE_TO_ID = {m: i for i, m in enumerate(IMAGE_MODES)}
 ID_TO_MODE = {i: m for i, m in enumerate(IMAGE_MODES)}
 
-_PIL_TO_MODE = {"L": "L", "LA": "LA", "RGB": "RGB", "RGBA": "RGBA",
-                "I;16": "L16", "F": "RGB32F"}
+# PIL modes with a faithful equivalent in IMAGE_MODES; anything else (e.g. the
+# single-channel float mode "F", palettes, CMYK) converts to RGB on decode.
+_PIL_TO_MODE = {"L": "L", "LA": "LA", "RGB": "RGB", "RGBA": "RGBA", "I;16": "L16"}
 _MODE_TO_PIL = {"L": "L", "LA": "LA", "RGB": "RGB", "RGBA": "RGBA", "L16": "I;16"}
+# modes PIL can round-trip through Image.fromarray; the rest use numpy/jax paths
+_PIL_SAFE_MODES = frozenset(["L", "LA", "RGB", "RGBA", "L16"])
 
 
 def _mode_np_dtype(mode: str):
@@ -226,6 +230,11 @@ def image_encode(s: Series, image_format: str) -> Series:
         if a is None:
             out.append(None)
             continue
+        m = _default_mode(a)
+        if m not in _PIL_SAFE_MODES:
+            raise ValueError(
+                f"cannot encode a {m} image to {fmt}; convert with "
+                "image.to_mode to an 8-bit mode (or L16) first")
         img = _to_pil(a)
         if fmt == "JPEG" and img.mode in ("RGBA", "LA"):
             img = img.convert("RGB")
@@ -249,10 +258,13 @@ def image_resize(s: Series, w: int, h: int) -> Series:
             out.append(None); modes.append(None)
             continue
         m = _default_mode(a)
-        img = _to_pil(a).resize((w, h), resample=_BILINEAR())
-        b = np.asarray(img)
-        if b.ndim == 2:
-            b = b[:, :, None]
+        if m in _PIL_SAFE_MODES:
+            img = _to_pil(a).resize((w, h), resample=_BILINEAR())
+            b = np.asarray(img)
+            if b.ndim == 2:
+                b = b[:, :, None]
+        else:  # 16-bit multichannel / float modes: PIL can't, jax can
+            b = _resize_one_jax(a, w, h)
         out.append(b); modes.append(m)
     return image_series_from_arrays(out, s.name, modes,
                                     dtype_mode=dt.params[0] if dt.kind == TypeKind.IMAGE else None)
@@ -262,6 +274,21 @@ def _BILINEAR():
     from PIL import Image as PILImage
 
     return PILImage.BILINEAR
+
+
+def _resize_one_jax(a: np.ndarray, w: int, h: int) -> np.ndarray:
+    """Bilinear resize of one HxWxC array via jax.image.resize (used for the
+    modes PIL's fromarray rejects: RGB16/RGBA16/LA16/RGB32F/RGBA32F)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = jax.image.resize(jnp.asarray(a.astype(np.float32)),
+                           (h, w, a.shape[2]), method="bilinear")
+    out = np.asarray(jax.device_get(out))
+    if a.dtype != np.float32 and not np.issubdtype(a.dtype, np.floating):
+        info = np.iinfo(a.dtype)
+        out = np.clip(np.rint(out), info.min, info.max)
+    return out.astype(a.dtype)
 
 
 def _resize_fixed_device(s: Series, w: int, h: int) -> Series:
@@ -284,13 +311,14 @@ def _resize_fixed_device(s: Series, w: int, h: int) -> Series:
         resized = np.clip(np.rint(resized), info.min, info.max)
     resized = resized.astype(npdt)
     out_dt = DataType.image(mode, h, w)
-    values = pa.array(resized.reshape(-1), out_dt.to_arrow().value_type)
+    storage_t = out_dt.to_arrow()
+    values = pa.array(resized.reshape(-1), storage_t.value_type)
     fsl = pa.FixedSizeListArray.from_arrays(values, h * w * c)
     if arr.null_count:
-        mask = np.asarray(arr.is_null())
-        fsl = pa.Array.from_pandas(  # re-apply validity
-            [None if mask[i] else fsl[i].values.to_pylist() for i in range(n)],
-            type=out_dt.to_arrow())
+        # reattach the null bitmap without leaving the flat buffer
+        validity = np.packbits(np.asarray(arr.is_valid()), bitorder="little")
+        fsl = pa.Array.from_buffers(storage_t, n, [pa.py_buffer(validity.tobytes())],
+                                    children=[values])
     return Series(s.name, out_dt, fsl)
 
 
@@ -328,16 +356,59 @@ def image_to_mode(s: Series, mode: str) -> Series:
         if a is None:
             out.append(None)
             continue
-        img = _to_pil(a).convert(_MODE_TO_PIL.get(mode, mode))
-        b = np.asarray(img)
-        if b.ndim == 2:
-            b = b[:, :, None]
-        out.append(b.astype(_mode_np_dtype(mode), copy=False))
+        src_mode = _default_mode(a)
+        if src_mode in _PIL_SAFE_MODES and mode in _PIL_SAFE_MODES:
+            img = _to_pil(a).convert(_MODE_TO_PIL.get(mode, mode))
+            b = np.asarray(img)
+            if b.ndim == 2:
+                b = b[:, :, None]
+            out.append(b.astype(_mode_np_dtype(mode), copy=False))
+        else:
+            out.append(_convert_mode_np(a, mode))
     dt = s.dtype
     if dt.kind == TypeKind.FIXED_SHAPE_IMAGE:
         _, h, w = dt.params
         return _fixed_image_series(out, s.name, mode, h, w)
     return image_series_from_arrays(out, s.name, [mode] * len(out), dtype_mode=mode)
+
+
+def _convert_mode_np(a: np.ndarray, mode: str) -> np.ndarray:
+    """Mode conversion through a normalized [0,1] float representation — covers
+    the 16-bit/float modes PIL's fromarray rejects. Luma uses ITU-R 601
+    (0.299/0.587/0.114), matching PIL's RGB->L."""
+    if np.issubdtype(a.dtype, np.floating):
+        f = np.clip(a.astype(np.float32), 0.0, 1.0)
+    else:
+        f = a.astype(np.float32) / float(np.iinfo(a.dtype).max)
+    c = f.shape[2]
+    # split into color + alpha in float
+    if c == 1:
+        rgb, alpha = np.repeat(f, 3, axis=2), None
+    elif c == 2:
+        rgb, alpha = np.repeat(f[:, :, :1], 3, axis=2), f[:, :, 1:2]
+    elif c == 3:
+        rgb, alpha = f, None
+    else:
+        rgb, alpha = f[:, :, :3], f[:, :, 3:4]
+    base = mode.rstrip("0123456789F") or mode  # L/LA/RGB/RGBA
+    if base in ("L", "LA"):
+        gray = (rgb @ np.array([0.299, 0.587, 0.114], np.float32))[:, :, None]
+        colors = gray
+    else:
+        colors = rgb
+    want_c = _mode_channels(mode)
+    if base in ("LA", "RGBA"):
+        if alpha is None:
+            alpha = np.ones(colors.shape[:2] + (1,), np.float32)
+        outf = np.concatenate([colors, alpha], axis=2)
+    else:
+        outf = colors
+    assert outf.shape[2] == want_c, (outf.shape, mode)
+    npdt = _mode_np_dtype(mode)
+    if np.issubdtype(npdt, np.floating):
+        return outf.astype(npdt)
+    mx = float(np.iinfo(npdt).max)
+    return np.clip(np.rint(outf * mx), 0, mx).astype(npdt)
 
 
 def _fixed_image_series(arrays: List[Optional[np.ndarray]], name: str, mode: str,
@@ -415,7 +486,7 @@ def url_upload(s: Series, location, on_error: str = "raise",
             raise NotImplementedError(f"remote upload target {loc!r} requires an object-store client")
         try:
             os.makedirs(loc, exist_ok=True)
-            path = os.path.join(loc, f"{i}-{abs(hash((id(s), i))) % 10**8}.bin")
+            path = os.path.join(loc, f"{i}-{uuid.uuid4().hex}.bin")
             with open(path, "wb") as f:
                 f.write(v if isinstance(v, (bytes, bytearray)) else str(v).encode())
             out.append(path)

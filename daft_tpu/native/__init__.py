@@ -1,0 +1,193 @@
+"""Native (C++) host kernel loader.
+
+The runtime pieces that the reference implements in Rust
+(src/daft-core/src/kernels/*) are C++ here, compiled once per machine into
+build/libdtkernels.so and loaded via ctypes (this image has no pybind11; the
+raw-buffer C ABI keeps the boundary dependency-free). Every entry point has a
+bit-identical numpy fallback in kernels/host_hash.py / kernels/murmur.py, so
+`available() == False` (no compiler, build failure, DAFT_TPU_NATIVE=0) only
+costs speed, never correctness.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "kernels.cc")
+_BUILD_DIR = os.path.join(_DIR, "build")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _so_path() -> str:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_BUILD_DIR, f"libdtkernels-{tag}.so")
+
+
+def _build(so: str) -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = so + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        sys.stderr.write(f"daft_tpu: native kernel build failed ({e}); using numpy fallbacks\n")
+        try:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+_SIGNATURES = {
+    "dt_hash_fixed64": (None, [_U64P, _U8P, ctypes.c_int64, _U64P, _U64P]),
+    "dt_hash_bytes": (None, [_U8P, _I64P, _U8P, ctypes.c_int64, _U64P, _U64P]),
+    "dt_hash_segments": (None, [_U64P, _I64P, _U8P, ctypes.c_int64, _U64P, _U64P]),
+    "dt_murmur3_bytes": (None, [_U8P, _I64P, _U8P, ctypes.c_int64, ctypes.c_uint32, _I32P]),
+    "dt_dense_codes": (ctypes.c_int64, [_I64P, ctypes.c_int64, _I64P, _I64P]),
+    "dt_bucket_stable_order": (None, [_I64P, ctypes.c_int64, ctypes.c_int64, _I64P, _I64P]),
+}
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DAFT_TPU_NATIVE", "1") in ("0", "false", "off"):
+            return None
+        so = _so_path()
+        if not os.path.exists(so) and not _build(so):
+            return None
+        try:
+            cdll = ctypes.CDLL(so)
+            for name, (restype, argtypes) in _SIGNATURES.items():
+                fn = getattr(cdll, name)
+                fn.restype = restype
+                fn.argtypes = argtypes
+            _lib = cdll
+        except OSError as e:
+            sys.stderr.write(f"daft_tpu: native kernel load failed ({e})\n")
+            return None
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _opt_mask(valid: Optional[np.ndarray]):
+    if valid is None:
+        return ctypes.cast(None, _U8P)
+    return _ptr(np.ascontiguousarray(valid, dtype=np.uint8), ctypes.c_uint8)
+
+
+# ---------------------------------------------------------------------------
+# typed wrappers (each asserts availability; callers gate on available())
+# ---------------------------------------------------------------------------
+
+def hash_fixed64(bits: np.ndarray, valid: Optional[np.ndarray], seeds: np.ndarray) -> np.ndarray:
+    n = len(bits)
+    out = np.empty(n, dtype=np.uint64)
+    bits = np.ascontiguousarray(bits, dtype=np.uint64)
+    seeds = np.ascontiguousarray(seeds, dtype=np.uint64)
+    lib().dt_hash_fixed64(_ptr(bits, ctypes.c_uint64), _opt_mask(valid), n,
+                          _ptr(seeds, ctypes.c_uint64), _ptr(out, ctypes.c_uint64))
+    return out
+
+
+def hash_bytes(data: np.ndarray, offsets: np.ndarray, valid: Optional[np.ndarray],
+               seeds: np.ndarray) -> np.ndarray:
+    n = len(offsets) - 1
+    out = np.empty(n, dtype=np.uint64)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    seeds = np.ascontiguousarray(seeds, dtype=np.uint64)
+    if data.size == 0:
+        data = np.zeros(1, dtype=np.uint8)  # valid pointer for the empty buffer
+    lib().dt_hash_bytes(_ptr(data, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+                        _opt_mask(valid), n, _ptr(seeds, ctypes.c_uint64),
+                        _ptr(out, ctypes.c_uint64))
+    return out
+
+
+def hash_segments(inner: np.ndarray, offsets: np.ndarray, valid: Optional[np.ndarray],
+                  seeds: np.ndarray) -> np.ndarray:
+    n = len(offsets) - 1
+    out = np.empty(n, dtype=np.uint64)
+    inner = np.ascontiguousarray(inner, dtype=np.uint64)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    seeds = np.ascontiguousarray(seeds, dtype=np.uint64)
+    if inner.size == 0:
+        inner = np.zeros(1, dtype=np.uint64)
+    lib().dt_hash_segments(_ptr(inner, ctypes.c_uint64), _ptr(offsets, ctypes.c_int64),
+                           _opt_mask(valid), n, _ptr(seeds, ctypes.c_uint64),
+                           _ptr(out, ctypes.c_uint64))
+    return out
+
+
+def murmur3_bytes(data: np.ndarray, offsets: np.ndarray, valid: Optional[np.ndarray],
+                  seed: int) -> np.ndarray:
+    n = len(offsets) - 1
+    out = np.empty(n, dtype=np.int32)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    if data.size == 0:
+        data = np.zeros(1, dtype=np.uint8)
+    lib().dt_murmur3_bytes(_ptr(data, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+                           _opt_mask(valid), n, ctypes.c_uint32(seed),
+                           _ptr(out, ctypes.c_int32))
+    return out
+
+
+def dense_codes(vals: np.ndarray):
+    """Exact dense group codes over int64 keys, first-occurrence order.
+    Returns (codes[n] int64, first_idx[num] int64)."""
+    n = len(vals)
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    codes = np.empty(n, dtype=np.int64)
+    first_idx = np.empty(n, dtype=np.int64)
+    num = lib().dt_dense_codes(_ptr(vals, ctypes.c_int64), n,
+                               _ptr(codes, ctypes.c_int64), _ptr(first_idx, ctypes.c_int64))
+    return codes, first_idx[:num].copy()
+
+
+def bucket_stable_order(buckets: np.ndarray, num_buckets: int):
+    """Counts + stable row ordering grouped by bucket (hash-shuffle fanout)."""
+    n = len(buckets)
+    buckets = np.ascontiguousarray(buckets, dtype=np.int64)
+    if n and (buckets.min() < 0 or buckets.max() >= num_buckets):
+        raise ValueError(f"bucket ids out of range [0, {num_buckets})")
+    counts = np.empty(num_buckets, dtype=np.int64)
+    order = np.empty(n, dtype=np.int64)
+    lib().dt_bucket_stable_order(_ptr(buckets, ctypes.c_int64), n, num_buckets,
+                                 _ptr(counts, ctypes.c_int64), _ptr(order, ctypes.c_int64))
+    return counts, order

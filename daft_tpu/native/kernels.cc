@@ -1,0 +1,189 @@
+// Native host kernels for daft_tpu (C ABI, loaded via ctypes).
+//
+// Role-equivalent to the reference's Rust kernel crates
+// (src/daft-core/src/kernels/hashing.rs, src/daft-core/src/array/ops/groups.rs):
+// single-pass byte hashing, segment hashing, murmur3, and open-addressing
+// dense group codes. Every function is BIT-IDENTICAL to the numpy fallback in
+// daft_tpu/kernels/host_hash.py — the Python layer may mix both freely
+// (e.g. hashes computed natively on one partition must match a numpy-hashed
+// partition for shuffles to line up).
+//
+// ABI notes: plain C functions over raw buffers; `valid` is an optional
+// per-row byte mask (1 = valid, NULL = all valid); offsets are int64 and
+// ABSOLUTE into `data`.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+static const uint64_t GOLDEN = 0x9E3779B97F4A7C15ULL;
+static const uint64_t MIX1 = 0xBF58476D1CE4E5B9ULL;
+static const uint64_t MIX2 = 0x94D049BB133111EBULL;
+static const uint64_t NULL_HASH = 0x7FB5D329728EA185ULL;
+static const uint64_t POLY_P = 0x100000001B3ULL;
+static const uint64_t LEN_K = 0xC2B2AE3D27D4EB4FULL;
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += GOLDEN;
+  x = (x ^ (x >> 30)) * MIX1;
+  x = (x ^ (x >> 27)) * MIX2;
+  return x ^ (x >> 31);
+}
+
+// fixed-width values already widened to u64 lanes by the caller
+void dt_hash_fixed64(const uint64_t* bits, const uint8_t* valid, int64_t n,
+                     const uint64_t* seeds, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid && !valid[i]) {
+      out[i] = splitmix64(NULL_HASH ^ seeds[i]);
+    } else {
+      out[i] = splitmix64(bits[i] ^ seeds[i]);
+    }
+  }
+}
+
+// var-len bytes: polynomial rolling hash, matches host_hash._hash_varlen
+void dt_hash_bytes(const uint8_t* data, const int64_t* offsets,
+                   const uint8_t* valid, int64_t n, const uint64_t* seeds,
+                   uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid && !valid[i]) {
+      out[i] = splitmix64(NULL_HASH ^ seeds[i]);
+      continue;
+    }
+    const int64_t lo = offsets[i], hi = offsets[i + 1];
+    uint64_t sum = 0, w = 1;
+    for (int64_t j = lo; j < hi; ++j) {
+      sum += ((uint64_t)data[j] + 1ULL) * w;
+      w *= POLY_P;
+    }
+    const uint64_t len = (uint64_t)(hi - lo);
+    out[i] = splitmix64(sum ^ (LEN_K * len) ^ seeds[i]);
+  }
+}
+
+// list-of-hashes segments: matches host_hash._hash_segments_from_offsets
+// (inner element hashes combined positionally; xor with plain length)
+void dt_hash_segments(const uint64_t* inner, const int64_t* offsets,
+                      const uint8_t* valid, int64_t n, const uint64_t* seeds,
+                      uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid && !valid[i]) {
+      out[i] = splitmix64(NULL_HASH ^ seeds[i]);
+      continue;
+    }
+    const int64_t lo = offsets[i], hi = offsets[i + 1];
+    uint64_t sum = 0, w = 1;
+    for (int64_t j = lo; j < hi; ++j) {
+      sum += inner[j] * w;
+      w *= POLY_P;
+    }
+    out[i] = splitmix64(sum ^ (uint64_t)(hi - lo) ^ seeds[i]);
+  }
+}
+
+// murmur3_32 over var-len rows (Iceberg-spec), matches kernels/murmur.py
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mm3_finalize(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6BU;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35U;
+  h ^= h >> 16;
+  return h;
+}
+
+void dt_murmur3_bytes(const uint8_t* data, const int64_t* offsets,
+                      const uint8_t* valid, int64_t n, uint32_t seed,
+                      int32_t* out) {
+  const uint32_t C1 = 0xCC9E2D51U, C2 = 0x1B873593U;
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid && !valid[i]) {
+      out[i] = 0;  // caller re-applies null mask
+      continue;
+    }
+    const uint8_t* p = data + offsets[i];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    uint32_t h = seed;
+    const int64_t nblocks = len / 4;
+    for (int64_t b = 0; b < nblocks; ++b) {
+      uint32_t k;
+      std::memcpy(&k, p + 4 * b, 4);
+      k *= C1;
+      k = rotl32(k, 15);
+      k *= C2;
+      h ^= k;
+      h = rotl32(h, 13);
+      h = h * 5 + 0xE6546B64U;
+    }
+    uint32_t k = 0;
+    const int64_t tail = len & 3;
+    if (tail >= 3) k ^= (uint32_t)p[4 * nblocks + 2] << 16;
+    if (tail >= 2) k ^= (uint32_t)p[4 * nblocks + 1] << 8;
+    if (tail >= 1) {
+      k ^= (uint32_t)p[4 * nblocks];
+      k *= C1;
+      k = rotl32(k, 15);
+      k *= C2;
+      h ^= k;
+    }
+    h ^= (uint32_t)len;
+    out[i] = (int32_t)mm3_finalize(h);
+  }
+}
+
+// Dense group codes over exact int64 keys (open addressing, linear probing).
+// Codes come out in first-occurrence order. Returns the group count.
+// first_idx must have capacity n.
+int64_t dt_dense_codes(const int64_t* vals, int64_t n, int64_t* codes,
+                       int64_t* first_idx) {
+  if (n == 0) return 0;
+  uint64_t cap = 16;
+  while (cap < (uint64_t)n * 2) cap <<= 1;
+  const uint64_t mask = cap - 1;
+  std::vector<int64_t> slot_key(cap);
+  std::vector<int64_t> slot_code(cap, -1);
+  int64_t num = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t v = vals[i];
+    uint64_t h = splitmix64((uint64_t)v) & mask;
+    for (;;) {
+      if (slot_code[h] == -1) {
+        slot_key[h] = v;
+        slot_code[h] = num;
+        first_idx[num] = i;
+        codes[i] = num;
+        ++num;
+        break;
+      }
+      if (slot_key[h] == v) {
+        codes[i] = slot_code[h];
+        break;
+      }
+      h = (h + 1) & mask;
+    }
+  }
+  return num;
+}
+
+// Bucketed partition counts + stable row order for hash shuffles:
+// given per-row bucket ids, produce counts[num_buckets] and row indices
+// grouped by bucket in stable (original) order — one pass, no sort.
+void dt_bucket_stable_order(const int64_t* buckets, int64_t n,
+                            int64_t num_buckets, int64_t* counts,
+                            int64_t* order) {
+  std::vector<int64_t> offs(num_buckets + 1, 0);
+  for (int64_t i = 0; i < n; ++i) ++offs[buckets[i] + 1];
+  for (int64_t b = 0; b < num_buckets; ++b) {
+    counts[b] = offs[b + 1];
+    offs[b + 1] += offs[b];
+  }
+  for (int64_t i = 0; i < n; ++i) order[offs[buckets[i]]++] = i;
+}
+
+}  // extern "C"

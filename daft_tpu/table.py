@@ -334,10 +334,17 @@ class Table:
     def _split_by_buckets(self, buckets: np.ndarray, num: int) -> List["Table"]:
         if len(self) == 0:
             return [self.slice(0, 0) for _ in range(num)]
-        order = np.argsort(buckets, kind="stable")
+        from . import native
+
+        if native.available():
+            # one O(n) counting pass instead of an O(n log n) argsort
+            counts, order = native.bucket_stable_order(buckets, num)
+            offs = np.concatenate([[0], np.cumsum(counts)])
+        else:
+            order = np.argsort(buckets, kind="stable")
+            counts = np.bincount(buckets, minlength=num)
+            offs = np.concatenate([[0], np.cumsum(counts)])
         sorted_tbl = self.take(Series.from_arrow(pa.array(order.astype(np.uint64)), "idx"))
-        counts = np.bincount(buckets, minlength=num)
-        offs = np.concatenate([[0], np.cumsum(counts)])
         return [sorted_tbl.slice(int(offs[i]), int(offs[i + 1])) for i in range(num)]
 
     # ------------------------------------------------------------------ aggregation
@@ -719,10 +726,18 @@ def _group_codes(key_tbl: Table) -> Tuple[np.ndarray, Table]:
             _, combined = np.unique(combined, return_inverse=True)
             combined = combined.astype(np.int64)
         combined = combined * np.int64(card) + codes
-    # Densify the combined codes without an O(n log n) sort: arrow's
-    # dictionary_encode is a C++ hash pass. Group order is then fixed to
-    # first-occurrence via a reversed fancy-assignment (last write wins, so a
-    # reversed index write leaves each slot holding its FIRST occurrence).
+    # Densify the combined codes without an O(n log n) sort. Preferred: the
+    # native open-addressing pass, which emits codes already in
+    # first-occurrence order. Fallback: arrow's dictionary_encode (C++ hash
+    # pass) + first-occurrence fixup via a reversed fancy-assignment (last
+    # write wins, so a reversed index write leaves each slot holding its
+    # FIRST occurrence).
+    from . import native
+
+    if native.available():
+        codes, first_idx = native.dense_codes(combined)
+        uniq = key_tbl.take(Series.from_arrow(pa.array(first_idx.astype(np.uint64)), "i"))
+        return codes, uniq
     enc = pa.array(combined).dictionary_encode()
     codes = np.asarray(enc.indices).astype(np.int64)
     num = len(enc.dictionary)

@@ -142,3 +142,50 @@ class TestUrl:
         out = df.select(col("u").url.download().image.decode().alias("i")).collect()
         np.testing.assert_array_equal(
             image_series_to_arrays(out.to_table().get_column("i"))[0], img)
+
+
+class TestHighBitModes:
+    """16-bit multichannel and float modes (PIL's fromarray rejects these)."""
+
+    def test_resize_rgb16(self):
+        from daft_tpu.multimodal import image_resize, image_series_to_arrays
+
+        a = np.full((4, 4, 3), 30000, np.uint16)
+        s = image_series_from_arrays([a], "i")
+        out = image_series_to_arrays(image_resize(s, 2, 2))[0]
+        assert out.dtype == np.uint16 and out.shape == (2, 2, 3)
+        np.testing.assert_array_equal(out, np.full((2, 2, 3), 30000, np.uint16))
+
+    def test_to_mode_rgb16_to_rgb(self):
+        from daft_tpu.multimodal import image_series_to_arrays, image_to_mode
+
+        a = np.full((2, 2, 3), 65535, np.uint16)
+        s = image_series_from_arrays([a], "i")
+        out = image_series_to_arrays(image_to_mode(s, "RGB"))[0]
+        np.testing.assert_array_equal(out, np.full((2, 2, 3), 255, np.uint8))
+
+    def test_to_mode_rgb32f_to_l(self):
+        from daft_tpu.multimodal import image_series_to_arrays, image_to_mode
+
+        a = np.ones((2, 2, 3), np.float32)
+        s = image_series_from_arrays([a], "i")
+        out = image_series_to_arrays(image_to_mode(s, "L"))[0]
+        np.testing.assert_array_equal(out, np.full((2, 2, 1), 255, np.uint8))
+
+    def test_encode_rgb16_clear_error(self):
+        from daft_tpu.multimodal import image_encode
+
+        s = image_series_from_arrays([np.zeros((2, 2, 3), np.uint16)], "i")
+        with pytest.raises(ValueError, match="to_mode"):
+            image_encode(s, "png")
+
+    def test_fixed_resize_with_nulls_fast(self):
+        from daft_tpu.multimodal import image_resize, image_series_to_arrays
+
+        imgs = [np.full((4, 4, 3), 9, np.uint8), None, np.full((4, 4, 3), 5, np.uint8)]
+        s = image_series_from_arrays(imgs, "i")
+        fixed = s.cast(DataType.image("RGB", 4, 4))
+        out = image_series_to_arrays(image_resize(fixed, 2, 2))
+        assert out[1] is None
+        np.testing.assert_array_equal(out[0], np.full((2, 2, 3), 9, np.uint8))
+        np.testing.assert_array_equal(out[2], np.full((2, 2, 3), 5, np.uint8))
