@@ -1,0 +1,105 @@
+"""Adaptive query execution (AQE): stage-wise re-planning with real stats.
+
+Role-equivalent to the reference's AdaptivePlanner
+(src/daft-plan/src/physical_planner/planner.rs:288-351): the plan is cut at
+materialization boundaries, each boundary stage is executed, and the remaining
+plan is re-optimized with the materialized stage substituted as an in-memory
+source carrying REAL row counts and byte sizes. Planner decisions that depend
+on size estimates then see the truth instead of propagated guesses:
+
+- join strategy selection (broadcast vs hash) uses actual side sizes — a
+  filter or aggregate that shrank a side below the broadcast threshold now
+  triggers a broadcast join even though the static estimate was too large;
+- tiny materialized stages collapse to one partition, letting the
+  DropRepartition rule elide now-pointless shuffles downstream.
+
+Stages are chosen as join children whose subtree can change cardinality
+(Filter/Aggregate/Limit/Join/Distinct/Sample) — a bare source's stats are
+already as good as materializing it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+from .logical import (
+    Aggregate,
+    Distinct,
+    Filter,
+    InMemorySource,
+    Join,
+    Limit,
+    LogicalPlan,
+    Sample,
+)
+from .micropartition import MicroPartition
+from .optimizer import optimize
+
+_SHRINKING = (Filter, Aggregate, Limit, Join, Distinct, Sample)
+_MAX_STAGES = 32  # safety valve; each stage strictly shrinks the plan
+
+
+def _subtree_can_shrink(p: LogicalPlan) -> bool:
+    if isinstance(p, _SHRINKING):
+        return True
+    return any(_subtree_can_shrink(c) for c in p.children())
+
+
+def _find_stage(p: LogicalPlan) -> Optional[LogicalPlan]:
+    """Deepest join child worth materializing before planning the join.
+
+    Returns the child subplan (not the join) — deepest-first so inner joins
+    resolve before the joins above them see their sizes."""
+    for c in p.children():
+        found = _find_stage(c)
+        if found is not None:
+            return found
+    if isinstance(p, Join) and p.strategy is None:
+        for side in (p.right, p.left):  # right first: the usual build side
+            if not isinstance(side, InMemorySource) and _subtree_can_shrink(side):
+                return side
+    return None
+
+
+def _substitute(p: LogicalPlan, target: LogicalPlan, repl: LogicalPlan) -> LogicalPlan:
+    if p is target:
+        return repl
+    kids = p.children()
+    if not kids:
+        return p
+    new_kids = [_substitute(c, target, repl) for c in kids]
+    if all(a is b for a, b in zip(kids, new_kids)):
+        return p
+    return p.with_children(new_kids)
+
+
+class AdaptivePlanner:
+    """Runs a logical plan stage-by-stage, re-optimizing between stages."""
+
+    def __init__(self, execute_subplan, stats=None):
+        # execute_subplan: LogicalPlan -> Iterator[MicroPartition]
+        # (the runner's non-adaptive path; AQE stays backend-agnostic)
+        self._execute = execute_subplan
+        self._stats = stats
+        self.stage_history: List[Tuple[int, int]] = []  # (rows, bytes) per stage
+
+    def run(self, plan: LogicalPlan) -> Iterator[MicroPartition]:
+        plan = optimize(plan)
+        for _ in range(_MAX_STAGES):
+            stage = _find_stage(plan)
+            if stage is None:
+                break
+            parts = list(self._execute(stage))
+            rows = sum(len(p) for p in parts)
+            size = sum(p.size_bytes() or 0 for p in parts)
+            self.stage_history.append((rows, size))
+            if self._stats is not None:
+                self._stats.bump("aqe_stages")
+            # collapse tiny stages to one partition so downstream shuffles
+            # (keyed on num_partitions) can be elided by DropRepartition
+            if len(parts) > 1 and size < (1 << 20):
+                merged = MicroPartition.concat(parts)
+                parts = [merged]
+            plan = _substitute(plan, stage, InMemorySource(stage.schema, parts))
+            plan = optimize(plan)
+        return self._execute(plan)

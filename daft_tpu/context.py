@@ -42,6 +42,9 @@ class ExecutionConfig:
     csv_inflation_factor: float = 0.5
     shuffle_aggregation_default_partitions: int = 200
     default_morsel_size: int = 128 * 1024
+    # adaptive query execution: materialize join-input stages and re-plan with
+    # real sizes (reference: AdaptivePlanner, planner.rs:288)
+    enable_aqe: bool = False
     # TPU-specific: route eligible projections/aggregations through the jax
     # device kernel layer (kernels/device.py); host pyarrow path otherwise.
     use_device_kernels: bool = False

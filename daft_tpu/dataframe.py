@@ -109,6 +109,29 @@ class DataFrame:
         print(text)
         return text
 
+    def explain_analyze(self) -> str:
+        """Execute (if needed) and render per-operator rows + wall-time.
+
+        Reference: the native executor's explain-analyze output
+        (DAFT_DEV_ENABLE_EXPLAIN_ANALYZE, run.rs:106-115) backed by per-node
+        RuntimeStatsContext counters (runtime_stats.rs:16-27)."""
+        self.collect()
+        snap = self.stats.snapshot()
+        rows, wall = snap["op_rows"], snap["op_wall_ns"]
+        names = sorted(set(rows) | set(wall), key=lambda k: -wall.get(k, 0))
+        w = max([len(n) for n in names] + [8])
+        lines = ["== Runtime Stats ==",
+                 f"{'operator':<{w}}  {'rows out':>12}  {'wall ms':>10}"]
+        for n in names:
+            lines.append(f"{n:<{w}}  {rows.get(n, 0):>12,}  {wall.get(n, 0) / 1e6:>10.2f}")
+        counters = snap["counters"]
+        if counters:
+            lines.append("")
+            lines.append("counters: " + ", ".join(f"{k}={v}" for k, v in sorted(counters.items())))
+        text = "\n".join(lines)
+        print(text)
+        return text
+
     # ------------------------------------------------------------------ projection
     def select(self, *columns: ColumnInput) -> "DataFrame":
         exprs = []

@@ -74,29 +74,57 @@ class ExecutionContext:
 
 
 def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
-                 trace: bool = False) -> Iterator[MicroPartition]:
-    """Wire up the generator tree and return the root partition stream."""
+                 trace: bool = True) -> Iterator[MicroPartition]:
+    """Wire up the generator tree and return the root partition stream.
+
+    Every op is wrapped with per-partition accounting (rows + wall time into
+    RuntimeStats, feeding explain_analyze) and, when a chrome trace is armed
+    (tracing.chrome_trace / DAFT_TPU_CHROME_TRACE), with duration events."""
+    tid_counter = [0]
 
     def build(op: PhysicalOp) -> Iterator[MicroPartition]:
         child_streams = [build(c) for c in op.children]
         stream = op.execute(child_streams, ctx)
         if trace:
-            return _traced(op, stream, ctx)
+            tid_counter[0] += 1
+            return _traced(op, stream, ctx, tid_counter[0])
         return stream
 
     return build(root)
 
 
+_tl = threading.local()
+
+
 def _traced(op: PhysicalOp, stream: Iterator[MicroPartition],
-            ctx: ExecutionContext) -> Iterator[MicroPartition]:
+            ctx: ExecutionContext, tid: int) -> Iterator[MicroPartition]:
+    from . import tracing
+
     name = op.name()
     while True:
+        # Self-time accounting: pulling next(stream) recursively runs the
+        # child wrappers on this same thread, so each wrapper pushes a frame,
+        # accumulates its INCLUSIVE time into the parent frame, and reports
+        # inclusive - children as its own wall time. explain_analyze then
+        # ranks operators by where time is actually spent, not by depth.
+        stack = getattr(_tl, "stack", None)
+        if stack is None:
+            stack = _tl.stack = []
+        stack.append(0)
         t0 = time.perf_counter_ns()
         try:
             part = next(stream)
         except StopIteration:
             return
-        dt = time.perf_counter_ns() - t0
+        finally:
+            dt = time.perf_counter_ns() - t0
+            child_ns = stack.pop()
+            if stack:
+                stack[-1] += dt
         n = part.num_rows_or_none()
-        ctx.stats.record_op(name, n if n is not None else 0, dt)
+        rows = n if n is not None else 0
+        ctx.stats.record_op(name, rows, max(dt - child_ns, 0))
+        if tracing.active():
+            tracing.add_event(name, t0 / 1000.0, dt / 1000.0, tid, {"rows": rows})
+        tracing.report_progress(name, rows)
         yield part

@@ -61,11 +61,23 @@ class Runner:
 
     def run_iter(self, plan: LogicalPlan,
                  stats: Optional[RuntimeStats] = None) -> Iterator[MicroPartition]:
+        """AQE dispatch lives here once; backends implement _run_plain."""
+        ctx = get_context()
+        if ctx.execution_config.enable_aqe:
+            from .adaptive import AdaptivePlanner
+
+            # AdaptivePlanner hands over already-optimized (sub)plans
+            return AdaptivePlanner(
+                lambda p: self._run_plain(p, stats, optimized=True), stats).run(plan)
+        return self._run_plain(plan, stats)
+
+    def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],
+                   optimized: bool = False) -> Iterator[MicroPartition]:
         raise NotImplementedError
 
-    def optimize_and_translate(self, plan: LogicalPlan):
+    def optimize_and_translate(self, plan: LogicalPlan, optimized: bool = False):
         ctx = get_context()
-        opt = optimize(plan)
+        opt = plan if optimized else optimize(plan)
         phys = translate(opt, ctx.execution_config)
         return opt, phys
 
@@ -73,10 +85,10 @@ class Runner:
 class NativeRunner(Runner):
     name = "native"
 
-    def run_iter(self, plan: LogicalPlan,
-                 stats: Optional[RuntimeStats] = None) -> Iterator[MicroPartition]:
+    def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],
+                   optimized: bool = False) -> Iterator[MicroPartition]:
         ctx = get_context()
-        _, phys = self.optimize_and_translate(plan)
+        _, phys = self.optimize_and_translate(plan, optimized)
         exec_ctx = ExecutionContext(ctx.execution_config, stats)
         return execute_plan(phys, exec_ctx)
 
@@ -90,10 +102,10 @@ class MeshRunner(Runner):
     def __init__(self, mesh=None):
         self.mesh = mesh
 
-    def run_iter(self, plan: LogicalPlan,
-                 stats: Optional[RuntimeStats] = None) -> Iterator[MicroPartition]:
+    def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],
+                   optimized: bool = False) -> Iterator[MicroPartition]:
         ctx = get_context()
-        _, phys = self.optimize_and_translate(plan)
+        _, phys = self.optimize_and_translate(plan, optimized)
         from .parallel.mesh_exec import MeshExecutionContext
 
         exec_ctx = MeshExecutionContext(ctx.execution_config, stats, mesh=self.mesh)

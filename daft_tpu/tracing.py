@@ -1,0 +1,119 @@
+"""Chrome-trace + progress instrumentation.
+
+Role-equivalent to the reference's chrome-trace layer
+(src/common/tracing/src/lib.rs:13-55, armed by DAFT_DEV_ENABLE_CHROME_TRACE
+and re-armed per query by the native executor) and its tqdm progress bars
+(daft/runners/progress_bar.py). Events are buffered in memory and flushed as
+one chrome://tracing-compatible JSON array; on TPU the same file can be opened
+alongside an xprof/xplane capture to line up host pipeline stages with device
+kernels.
+
+Enable with the env var DAFT_TPU_CHROME_TRACE=<path> (armed at import/query
+time) or programmatically:
+
+    with daft_tpu.tracing.chrome_trace("/tmp/q1.json"):
+        df.collect()
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, List, Optional
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_path: Optional[str] = None
+_t0_us: float = 0.0
+
+_progress_cb: Optional[Callable[[str, int], None]] = None
+
+
+def active() -> bool:
+    return _path is not None
+
+
+def _now_us() -> float:
+    return time.perf_counter_ns() / 1000.0
+
+
+def enable(path: str) -> None:
+    """Start buffering events; flush() writes them to `path`."""
+    global _path, _t0_us
+    with _lock:
+        _path = path
+        _t0_us = _now_us()
+        _events.clear()
+
+
+def add_event(name: str, start_us: float, dur_us: float, tid: int = 0,
+              args: Optional[dict] = None) -> None:
+    if _path is None:
+        return
+    ev = {"name": name, "ph": "X", "pid": os.getpid(), "tid": tid,
+          "ts": start_us - _t0_us, "dur": dur_us}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+
+
+def flush() -> Optional[str]:
+    """Write buffered events; returns the path written (None if disabled)."""
+    with _lock:
+        path = _path
+        if path is None:
+            return None
+        evs = list(_events)
+        _events.clear()
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+def disable() -> None:
+    global _path
+    with _lock:
+        _path = None
+        _events.clear()
+
+
+@contextmanager
+def chrome_trace(path: str):
+    """Trace every query run inside the block into one chrome-trace file."""
+    enable(path)
+    try:
+        yield
+    finally:
+        flush()
+        disable()
+
+
+# armed from the environment once, like the reference's DAFT_DEV_ENABLE_CHROME_TRACE;
+# the atexit hook guarantees the file is written even though no context manager
+# wraps the process, and bounds the buffer's lifetime to the process
+_env_path = os.environ.get("DAFT_TPU_CHROME_TRACE")
+if _env_path:
+    import atexit
+
+    enable(_env_path)
+    atexit.register(flush)
+
+
+# ---------------------------------------------------------------------------
+# progress
+# ---------------------------------------------------------------------------
+
+def set_progress_callback(cb: Optional[Callable[[str, int], None]]) -> None:
+    """cb(op_name, rows_emitted) fires per produced partition (None clears)."""
+    global _progress_cb
+    _progress_cb = cb
+
+
+def report_progress(op_name: str, rows: int) -> None:
+    cb = _progress_cb
+    if cb is not None:
+        cb(op_name, rows)

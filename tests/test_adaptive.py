@@ -1,0 +1,96 @@
+"""AQE tests (reference: AdaptivePlanner stage loop, planner.rs:288)."""
+
+import numpy as np
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.context import get_context, set_execution_config
+from daft_tpu.execution import RuntimeStats
+
+
+_THRESH = 50_000  # tight broadcast threshold so the test data can stay small
+
+
+@pytest.fixture(autouse=True)
+def tight_threshold():
+    old = get_context().execution_config.broadcast_join_size_bytes_threshold
+    set_execution_config(broadcast_join_size_bytes_threshold=_THRESH)
+    yield
+    set_execution_config(broadcast_join_size_bytes_threshold=old)
+
+
+@pytest.fixture
+def aqe():
+    set_execution_config(enable_aqe=True)
+    yield
+    set_execution_config(enable_aqe=False)
+
+
+def _big_small_join():
+    """Left: big. Right: a source well over the broadcast threshold that a
+    filter shrinks to 3 rows — the static size estimate (propagated from the
+    source) stays over the threshold, so only AQE can discover the join
+    should broadcast."""
+    rng = np.random.RandomState(0)
+    n = 50_000
+    left = dt.from_pydict({"k": rng.randint(0, 1000, n), "v": rng.randn(n)})
+    right_raw = dt.from_pydict({"k": np.arange(50_000), "w": rng.randn(50_000)})
+    right = right_raw.where(col("k") < 3)
+    return left.join(right, on="k"), left, right
+
+
+class TestAqeBroadcast:
+    def test_static_plan_uses_hash(self):
+        q, *_ = _big_small_join()
+        stats = RuntimeStats()
+        q.stats = stats
+        out = q.collect()
+        assert stats.snapshot()["counters"].get("broadcast_joins", 0) == 0
+        assert len(out) > 0
+
+    def test_aqe_switches_to_broadcast(self, aqe):
+        q, *_ = _big_small_join()
+        stats = RuntimeStats()
+        q.stats = stats
+        out = q.collect()
+        snap = stats.snapshot()["counters"]
+        assert snap.get("aqe_stages", 0) >= 1
+        assert snap.get("broadcast_joins", 0) >= 1
+        assert len(out) > 0
+
+    def test_aqe_result_parity(self, aqe):
+        q, *_ = _big_small_join()
+        with_aqe = q.collect().to_pydict()
+        set_execution_config(enable_aqe=False)
+        q2, *_ = _big_small_join()
+        without = q2.collect().to_pydict()
+        assert sorted(zip(with_aqe["k"], with_aqe["v"])) == sorted(zip(without["k"], without["v"]))
+
+
+class TestAqeShapes:
+    def test_no_join_no_stages(self, aqe):
+        stats = RuntimeStats()
+        df = dt.from_pydict({"a": [1, 2, 3]})
+        df = df.where(col("a") > 1)
+        df.stats = stats
+        assert df.collect().to_pydict() == {"a": [2, 3]}
+        assert stats.snapshot()["counters"].get("aqe_stages", 0) == 0
+
+    def test_nested_joins(self, aqe):
+        a = dt.from_pydict({"k": [1, 2, 3], "x": [10, 20, 30]})
+        b = dt.from_pydict({"k": [2, 3, 4], "y": [200, 300, 400]}).where(col("k") > 0)
+        c = dt.from_pydict({"k": [3, 4, 5], "z": [99, 98, 97]}).where(col("k") > 0)
+        out = a.join(b, on="k").join(c, on="k").sort("k").to_pydict()
+        assert out["k"] == [3]
+        assert out["x"] == [30] and out["y"] == [300] and out["z"] == [99]
+
+    def test_explicit_strategy_respected(self, aqe):
+        # user-pinned strategy must not be second-guessed by AQE
+        a = dt.from_pydict({"k": [1, 2], "x": [1, 2]})
+        b = dt.from_pydict({"k": [2, 3], "y": [5, 6]}).where(col("k") > 0)
+        stats = RuntimeStats()
+        q = a.join(b, on="k", strategy="hash")
+        q.stats = stats
+        assert q.to_pydict()["k"] == [2]
+        assert stats.snapshot()["counters"].get("aqe_stages", 0) == 0

@@ -1,0 +1,56 @@
+"""Tracing / explain-analyze / progress tests (reference: common/tracing
+chrome layer, runtime_stats.rs, progress_bar.py)."""
+
+import json
+
+import daft_tpu as dt
+from daft_tpu import col, tracing
+
+
+def _query():
+    df = dt.from_pydict({"k": ["a", "b", "a", "c"] * 25, "v": list(range(100))})
+    return df.where(col("v") > 10).groupby("k").agg(col("v").sum().alias("s")).sort("k")
+
+
+class TestChromeTrace:
+    def test_trace_file_written(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        with tracing.chrome_trace(path):
+            _query().collect()
+        data = json.load(open(path))
+        evs = data["traceEvents"]
+        assert evs, "no events captured"
+        names = {e["name"] for e in evs}
+        assert any("Aggregate" in n for n in names), names
+        for e in evs:
+            assert e["ph"] == "X" and "ts" in e and "dur" in e
+
+    def test_disabled_by_default(self, tmp_path):
+        assert not tracing.active()
+        _query().collect()  # must not raise or buffer
+
+
+class TestExplainAnalyze:
+    def test_reports_ops_and_rows(self, capsys):
+        q = _query()
+        text = q.explain_analyze()
+        assert "Runtime Stats" in text
+        assert "Aggregate" in text
+        assert "rows out" in text
+
+    def test_counters_section(self):
+        df = dt.from_pydict({"v": list(range(50))})
+        q = df.select((col("v") + 1).alias("w")).collect()
+        text = q.explain_analyze()
+        assert "counters:" in text and "projections" in text
+
+
+class TestProgress:
+    def test_progress_callback(self):
+        seen = []
+        tracing.set_progress_callback(lambda name, rows: seen.append((name, rows)))
+        try:
+            _query().collect()
+        finally:
+            tracing.set_progress_callback(None)
+        assert seen and any(rows > 0 for _, rows in seen)
