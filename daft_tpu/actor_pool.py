@@ -1,0 +1,120 @@
+"""Actor pools for stateful UDFs.
+
+Role-equivalent to the reference's actor-pool UDF machinery
+(ActorPoolProject logical/physical ops + the stateful-UDF concurrency knob,
+daft/udf.py:308, logical_ops/actor_pool_project.rs): a class UDF with
+`concurrency=k` gets k persistent workers, each owning ONE instance of the
+class (initialized once, reused for every batch it serves) — the pattern for
+`.embed()`-style model UDFs where instance construction loads weights.
+
+Execution model: worker threads with a shared task queue. Batches are
+dispatched as (index, slices) and results re-assembled in order, so output
+order is deterministic regardless of which worker served which batch. Threads
+(not processes) because model UDFs spend their time in jax/numpy/IO which
+release the GIL; this mirrors the reference's PyRunner-side actor pool rather
+than its Ray actors.
+
+Pools are keyed by (class, init_args, concurrency) and persist across queries
+— actors outlive a single plan by design. `shutdown_all()` tears them down.
+"""
+
+from __future__ import annotations
+
+import atexit
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_pools: Dict[Tuple, "ActorPool"] = {}
+_pools_lock = threading.Lock()
+
+
+class ActorPool:
+    def __init__(self, cls: type, init_args: Optional[tuple], concurrency: int):
+        self._cls = cls
+        self._init_args = init_args
+        self._n = max(1, concurrency)
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._init_errors: List[BaseException] = []
+        # no timeout: loading model weights in __init__ may legitimately take
+        # minutes; workers always reach the barrier (init is wrapped)
+        self._started = threading.Barrier(self._n + 1)
+        for i in range(self._n):
+            t = threading.Thread(target=self._worker, name=f"daft-actor-{cls.__name__}-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._started.wait()  # all instances constructed (or failed) before first dispatch
+        if self._init_errors:
+            self.shutdown()  # release the workers that DID init, with their instances
+            raise self._init_errors[0]
+
+    def _worker(self) -> None:
+        try:
+            a, kw = self._init_args or ((), {})
+            instance = self._cls(*a, **kw)
+        except BaseException as e:  # noqa: BLE001
+            self._init_errors.append(e)
+            try:
+                self._started.wait()
+            except threading.BrokenBarrierError:
+                pass
+            return
+        try:
+            self._started.wait()
+        except threading.BrokenBarrierError:
+            return
+        while True:
+            item = self._tasks.get()
+            if item is None:
+                return
+            idx, fn_args, results, errors, done = item
+            try:
+                results[idx] = instance(*fn_args)
+            except BaseException as e:  # noqa: BLE001
+                errors[idx] = e
+            finally:
+                done.release()
+
+    def map_batches(self, batches: List[tuple]) -> List[Any]:
+        """Run instance(*batch) for each batch across the pool; ordered results."""
+        k = len(batches)
+        results: List[Any] = [None] * k
+        errors: List[Optional[BaseException]] = [None] * k
+        done = threading.Semaphore(0)
+        for i, b in enumerate(batches):
+            self._tasks.put((i, b, results, errors, done))
+        for _ in range(k):
+            done.acquire()
+        for e in errors:
+            if e is not None:
+                raise e
+        return results
+
+    def shutdown(self) -> None:
+        for _ in self._threads:
+            self._tasks.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+def get_pool(cls: type, init_args: Optional[tuple], concurrency: int) -> ActorPool:
+    key = (cls, repr(init_args), concurrency)
+    with _pools_lock:
+        pool = _pools.get(key)
+        if pool is None:
+            pool = ActorPool(cls, init_args, concurrency)
+            _pools[key] = pool
+        return pool
+
+
+def shutdown_all() -> None:
+    with _pools_lock:
+        pools = list(_pools.values())
+        _pools.clear()
+    for p in pools:
+        p.shutdown()
+
+
+atexit.register(shutdown_all)

@@ -607,7 +607,7 @@ class PyUdf(ExprNode):
         args = [a.evaluate(table) for a in self.args]
         n = len(table)
         return run_udf(self.fn, args, self.return_dtype, n, self.batch_size,
-                       self.init_args).rename(self.name())
+                       self.init_args, self.concurrency).rename(self.name())
 
     def children(self):
         return list(self.args)

@@ -1,0 +1,111 @@
+"""Pallas TPU kernels for the aggregation hot path.
+
+The TPC-H-Q1-shaped pipeline (filter mask -> K weighted segment sums over
+small group cardinality) is one fused MXU program here: each grid step loads a
+row block into VMEM, forms the masked one-hot group matrix, and accumulates
+`one_hot.T @ values` into a (groups, K) VMEM accumulator — so ALL K aggregate
+columns ride a single data pass through the 128x128 systolic array, instead of
+K separate scatter-based `segment_sum` lowerings touching HBM K times.
+
+Counts come from an exact host bincount (float32 one-hot accumulation would
+silently stall at 2^24 rows per group); the kernel carries the K weighted
+sums, which is where the FLOPs are.
+
+Grid iteration on TPU is sequential per core, which makes the accumulate-into-
+out_ref pattern sound (out block index is constant across steps; step 0 zeroes
+it). Tests run `interpret=True` on CPU; on TPU the same call compiles to a
+Mosaic kernel.
+
+Reference role-equivalent: the grouped-aggregation kernels of
+src/daft-core/src/array/ops/groups.rs + agg.rs, redesigned as a dense MXU
+contraction rather than hash-bucket scatter (SURVEY.md §7 "Hard parts":
+groupby on device without pointer-chasing).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_BLOCK_ROWS = 1024
+
+
+def _kernel(codes_ref, mask_ref, vals_ref, out_ref, *, num_groups: int):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    codes = codes_ref[:]  # (B, 1) int32
+    mask = mask_ref[:]    # (B, 1) float32 (0/1)
+    group_ids = jax.lax.broadcasted_iota(jnp.int32, (1, num_groups), 1)
+    one_hot = (codes == group_ids).astype(jnp.float32) * mask  # (B, G)
+    # (G, B) @ (B, K) -> (G, K) on the MXU
+    out_ref[:] += jnp.dot(one_hot.T, vals_ref[:], preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
+def _masked_segment_sums_padded(codes, mask, vals, num_groups: int, interpret: bool):
+    n, k = vals.shape
+    grid = n // _BLOCK_ROWS
+    return pl.pallas_call(
+        functools.partial(_kernel, num_groups=num_groups),
+        out_shape=jax.ShapeDtypeStruct((num_groups, k), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((num_groups, k), lambda i: (0, 0)),
+        interpret=interpret,
+    )(codes, mask, vals)
+
+
+def masked_segment_sums(codes: np.ndarray, mask: Optional[np.ndarray],
+                        values: np.ndarray, num_groups: int,
+                        interpret: Optional[bool] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Fused sums + counts for K value columns grouped by `codes`.
+
+    codes: (n,) int group ids in [0, num_groups); mask: (n,) bool or None;
+    values: (n, K) float64/float32 (NaNs allowed where masked out).
+    Returns (sums (num_groups, K) float64, counts (num_groups,) int64).
+
+    float32 accumulation on the MXU — callers needing exact float64 sums
+    (the host parity path) should use the arrow/bincount route; this kernel
+    is the device-throughput path.
+    """
+    n = len(codes)
+    k = values.shape[1]
+    if n == 0:
+        # grid=(0,) would skip the kernel entirely, leaving out_ref unwritten
+        return np.zeros((num_groups, k)), np.zeros(num_groups, np.int64)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    m = np.ones(n, np.float32) if mask is None else mask.astype(np.float32)
+    # counts must be exact (float32 accumulation stalls at 2^24), so they come
+    # from a host bincount; the kernel carries only the K weighted sums
+    if mask is None:
+        counts = np.bincount(codes, minlength=num_groups).astype(np.int64)
+    else:
+        counts = np.bincount(codes[mask], minlength=num_groups).astype(np.int64)
+    # masked-out rows contribute nothing; also zero their values so NaN*0
+    # poisoning cannot leak through the matmul
+    vk = np.where(m[:, None] > 0, values, 0.0).astype(np.float32)
+    pad = (-n) % _BLOCK_ROWS
+    if pad:
+        codes = np.concatenate([codes, np.zeros(pad, codes.dtype)])
+        m = np.concatenate([m, np.zeros(pad, np.float32)])
+        vk = np.concatenate([vk, np.zeros((pad, k), np.float32)])
+    out = _masked_segment_sums_padded(
+        jnp.asarray(codes.astype(np.int32)[:, None]),
+        jnp.asarray(m[:, None]),
+        jnp.asarray(vk),
+        num_groups, interpret)
+    return np.asarray(jax.device_get(out)).astype(np.float64), counts

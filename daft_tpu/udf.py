@@ -47,9 +47,29 @@ def _coerce_result(out: Any, name: str, dtype: DataType, n: int) -> Series:
 
 
 def run_udf(fn: Callable, args: List[Series], return_dtype: DataType, n: int,
-            batch_size: Optional[int] = None, init_args: Optional[tuple] = None) -> Series:
-    """Evaluate a UDF over column batches (reference: daft/udf.py run_udf)."""
+            batch_size: Optional[int] = None, init_args: Optional[tuple] = None,
+            concurrency: Optional[int] = None) -> Series:
+    """Evaluate a UDF over column batches (reference: daft/udf.py run_udf).
+
+    Stateful (class) UDFs with concurrency>1 run on a persistent actor pool
+    (actor_pool.py): one instance per worker, batches dispatched across them,
+    results re-assembled in order."""
     from .series import _broadcast_to
+
+    name = args[0].name if args else "udf"
+    args = [_broadcast_to(a, n) if len(a) != n else a for a in args]
+
+    if inspect.isclass(fn) and concurrency and concurrency > 1:
+        from .actor_pool import get_pool
+
+        pool = get_pool(fn, init_args, concurrency)
+        bs = batch_size or max(1, -(-n // concurrency))  # ceil-split across actors
+        bounds = [(s, min(s + bs, n)) for s in range(0, n, bs)] or [(0, 0)]
+        batches = [tuple(a.slice(s, e) for a in args) for s, e in bounds]
+        outs = pool.map_batches(batches)
+        coerced = [_coerce_result(o, name, return_dtype, e - s)
+                   for o, (s, e) in zip(outs, bounds)]
+        return Series.concat(coerced) if len(coerced) > 1 else coerced[0]
 
     if inspect.isclass(fn):
         key = (fn, repr(init_args))
@@ -58,15 +78,13 @@ def run_udf(fn: Callable, args: List[Series], return_dtype: DataType, n: int,
             _STATEFUL_INSTANCES[key] = fn(*a, **kw)
         fn = _STATEFUL_INSTANCES[key].__call__
 
-    args = [_broadcast_to(a, n) if len(a) != n else a for a in args]
     if not batch_size or n <= batch_size:
-        return _coerce_result(fn(*args), args[0].name if args else "udf", return_dtype, n)
+        return _coerce_result(fn(*args), name, return_dtype, n)
     outs = []
     for start in range(0, n, batch_size):
         end = min(start + batch_size, n)
         chunk = [a.slice(start, end) for a in args]
-        outs.append(_coerce_result(fn(*chunk), args[0].name if args else "udf",
-                                   return_dtype, end - start))
+        outs.append(_coerce_result(fn(*chunk), name, return_dtype, end - start))
     return Series.concat(outs)
 
 

@@ -1,0 +1,88 @@
+"""Actor-pool stateful UDF tests (reference: stateful UDFs + actor pools,
+daft/udf.py:308, ActorPoolProject)."""
+
+import threading
+
+import daft_tpu as dt
+from daft_tpu import DataType, col
+from daft_tpu.actor_pool import _pools, shutdown_all
+
+_init_count = {"n": 0}
+_init_lock = threading.Lock()
+
+
+class Doubler:
+    def __init__(self, bias=0):
+        with _init_lock:
+            _init_count["n"] += 1
+        self.bias = bias
+        self.calls = 0
+
+    def __call__(self, s):
+        self.calls += 1
+        return [v * 2 + self.bias for v in s.to_pylist()]
+
+
+class TestActorPool:
+    def setup_method(self):
+        shutdown_all()
+        _init_count["n"] = 0
+
+    def test_one_instance_per_worker_and_order(self):
+        u = dt.udf(return_dtype=DataType.int64())(Doubler).with_concurrency(3)
+        df = dt.from_pydict({"x": list(range(100))})
+        out = df.select(u(col("x")).alias("y")).to_pydict()
+        assert out["y"] == [v * 2 for v in range(100)]  # order preserved
+        assert _init_count["n"] == 3  # exactly one init per worker
+
+    def test_pool_reused_across_queries(self):
+        u = dt.udf(return_dtype=DataType.int64())(Doubler).with_concurrency(2)
+        df = dt.from_pydict({"x": [1, 2, 3, 4]})
+        df.select(u(col("x")).alias("y")).to_pydict()
+        first = _init_count["n"]
+        df.select(u(col("x")).alias("y")).to_pydict()
+        assert _init_count["n"] == first  # no re-init on second query
+
+    def test_init_args_separate_pools(self):
+        u = dt.udf(return_dtype=DataType.int64())(Doubler)
+        u1 = u.with_init_args(bias=100).with_concurrency(2)
+        u2 = u.with_init_args(bias=200).with_concurrency(2)
+        df = dt.from_pydict({"x": [1, 2]})
+        o1 = df.select(u1(col("x")).alias("y")).to_pydict()["y"]
+        o2 = df.select(u2(col("x")).alias("y")).to_pydict()["y"]
+        assert o1 == [102, 104] and o2 == [202, 204]
+        assert len(_pools) == 2
+
+    def test_errors_propagate(self):
+        class Boom:
+            def __call__(self, s):
+                raise RuntimeError("actor failed")
+
+        u = dt.udf(return_dtype=DataType.int64())(Boom).with_concurrency(2)
+        df = dt.from_pydict({"x": [1, 2, 3]})
+        import pytest
+
+        with pytest.raises(RuntimeError, match="actor failed"):
+            df.select(u(col("x")).alias("y")).to_pydict()
+
+    def test_init_failure_raises(self):
+        class BadInit:
+            def __init__(self):
+                raise ValueError("no weights file")
+
+            def __call__(self, s):
+                return s
+
+        u = dt.udf(return_dtype=DataType.int64())(BadInit).with_concurrency(2)
+        df = dt.from_pydict({"x": [1]})
+        import pytest
+
+        with pytest.raises(ValueError, match="no weights file"):
+            df.select(u(col("x")).alias("y")).to_pydict()
+
+    def test_stateful_without_concurrency_single_instance(self):
+        u = dt.udf(return_dtype=DataType.int64())(Doubler)
+        df = dt.from_pydict({"x": [5, 6]})
+        out = df.select(u(col("x")).alias("y")).to_pydict()
+        assert out["y"] == [10, 12]
+        assert _init_count["n"] == 1
