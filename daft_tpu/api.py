@@ -174,11 +174,27 @@ def _catalog_stub(name: str):
     return reader
 
 
+def read_deltalake(table_uri: str) -> DataFrame:
+    """Read a local Delta Lake table by replaying its transaction log
+    (reference: daft/delta_lake/delta_lake_scan.py:26; no client library —
+    the _delta_log JSON actions are parsed natively)."""
+    from .io.catalogs import read_deltalake_scan
+
+    schema, tasks = read_deltalake_scan(table_uri)
+    return DataFrame(ScanSource(schema, tasks))
+
+
+def read_sql(sql: str, conn, params=None) -> DataFrame:
+    """Run a SQL query through a DB-API connection (or sqlite:// URL / path)
+    and load the result (reference: daft/sql/sql_scan.py:35)."""
+    from .io.catalogs import read_sql_arrow
+
+    return from_arrow(read_sql_arrow(sql, conn, params))
+
+
 read_iceberg = _catalog_stub("iceberg")
-read_deltalake = _catalog_stub("deltalake")
 read_hudi = _catalog_stub("hudi")
 read_lance = _catalog_stub("lance")
-read_sql = _catalog_stub("sql")
 
 
 # ---------------------------------------------------------------------------

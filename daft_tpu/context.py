@@ -45,6 +45,9 @@ class ExecutionConfig:
     # adaptive query execution: materialize join-input stages and re-plan with
     # real sizes (reference: AdaptivePlanner, planner.rs:288)
     enable_aqe: bool = False
+    # transient-IO retry at scan-task granularity (reference: s3_like.rs retry)
+    scan_retry_attempts: int = 3
+    scan_retry_backoff_s: float = 0.1
     # TPU-specific: route eligible projections/aggregations through the jax
     # device kernel layer (kernels/device.py); host pyarrow path otherwise.
     use_device_kernels: bool = False

@@ -393,10 +393,31 @@ class DataFrame:
         return DataFrame(Write(self._plan, root_dir, "json", None, pc)).collect()
 
     # ------------------------------------------------------------------ execution
+    def cancel(self) -> None:
+        """Stop this DataFrame's in-flight execution at the next partition
+        boundary (reference: stop_plan / MaterializedResult.cancel)."""
+        self.stats.cancel()
+
     def collect(self) -> "DataFrame":
         if self._result is None:
-            runner = get_context().runner()
-            self._result = runner.run(self._plan, stats=self.stats)
+            self.stats.reset_cancel()  # a cancelled DataFrame stays retryable
+            from .runners import partition_set_cache, plan_cache_key
+
+            cache = partition_set_cache()
+            key = plan_cache_key(self._plan)
+            hit = cache.get(key) if key is not None else None
+            if hit is not None:
+                self.stats.bump("result_cache_hits")
+                self._result = hit
+            else:
+                runner = get_context().runner()
+                self._result = runner.run(self._plan, stats=self.stats)
+                if key is not None:
+                    import weakref
+
+                    cache.put(key, self._result)
+                    # the entry lives exactly as long as some DataFrame owns it
+                    weakref.finalize(self, cache.release, key)
             self._plan = InMemorySource(self._result.schema, self._result.partitions)
         return self
 
@@ -404,6 +425,7 @@ class DataFrame:
         if self._result is not None:
             yield from self._result.partitions
             return
+        self.stats.reset_cancel()
         runner = get_context().runner()
         yield from runner.run_iter(self._plan, stats=self.stats)
 
@@ -444,6 +466,28 @@ class DataFrame:
         from .integrations.torch_data import IterDataset
 
         return IterDataset(self)
+
+    def to_ray_dataset(self):
+        """Reference: dataframe.py to_ray_dataset — needs the ray runtime,
+        which is not part of this image (the mesh runner is the distributed
+        backend here)."""
+        try:
+            import ray.data  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "to_ray_dataset requires ray, which is not installed; "
+                "distributed execution here runs on the jax mesh (MeshRunner)") from e
+        import ray.data as rd
+
+        return rd.from_arrow(self.to_arrow())
+
+    def to_dask_dataframe(self):
+        """Reference: dataframe.py to_dask_dataframe — needs dask."""
+        try:
+            import dask.dataframe as dd
+        except ImportError as e:
+            raise ImportError("to_dask_dataframe requires dask, which is not installed") from e
+        return dd.from_pandas(self.to_pandas(), npartitions=max(self.num_partitions(), 1))
 
     # ------------------------------------------------------------------ display
     def show(self, n: int = 8) -> None:

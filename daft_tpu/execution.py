@@ -23,15 +23,34 @@ from .micropartition import MicroPartition
 from .physical import PhysicalOp
 
 
+class QueryCancelledError(RuntimeError):
+    """Raised inside a running query after RuntimeStats.cancel()."""
+
+
 class RuntimeStats:
-    """Per-query counters (reference: runtime stats in daft-local-execution
-    and progress-bar accounting)."""
+    """Per-query counters + the cancellation handle (reference: runtime stats
+    in daft-local-execution, and driver-side stop_plan/MaterializedResult
+    .cancel() — ray_runner.py:489-502, partitioning.py:192)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = {}
         self.op_rows: Dict[str, int] = {}
         self.op_wall_ns: Dict[str, int] = {}
+        self._cancelled = threading.Event()
+
+    def cancel(self) -> None:
+        """Stop the query this handle is attached to at the next partition
+        boundary (safe from any thread)."""
+        self._cancelled.set()
+
+    def reset_cancel(self) -> None:
+        """Re-arm the handle for a fresh run (a cancelled query's DataFrame
+        stays usable: retrying clears the previous cancellation)."""
+        self._cancelled.clear()
+
+    def is_cancelled(self) -> bool:
+        return self._cancelled.is_set()
 
     def bump(self, key: str, n: int = 1) -> None:
         with self._lock:
@@ -102,6 +121,8 @@ def _traced(op: PhysicalOp, stream: Iterator[MicroPartition],
 
     name = op.name()
     while True:
+        if ctx.stats.is_cancelled():
+            raise QueryCancelledError(f"query cancelled (at {name})")
         # Self-time accounting: pulling next(stream) recursively runs the
         # child wrappers on this same thread, so each wrapper pushes a frame,
         # accumulates its INCLUSIVE time into the parent frame, and reports

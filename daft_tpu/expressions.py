@@ -248,26 +248,36 @@ class BinaryOp(ExprNode):
                 if not (f.dtype.is_boolean() or f.dtype.is_null() or f.dtype.is_integer()):
                     raise ValueError(f"logical op {op} needs bool/int, got {f.dtype}")
             if lf.dtype.is_integer() or rf.dtype.is_integer():
+                # bitwise form: both sides must be integers — mixing a bool
+                # with an int has no kernel (kleene ops are bool-only)
+                if lf.dtype.is_boolean() or rf.dtype.is_boolean():
+                    raise ValueError(f"cannot {op} {lf.dtype} with {rf.dtype}")
                 u = try_unify(lf.dtype, rf.dtype)
-                if u is None:
+                if u is None or not u.is_integer():
+                    # e.g. signed | uint64 unifies to float64 — no bitwise kernel
                     raise ValueError(f"cannot {op} {lf.dtype} with {rf.dtype}")
                 return Field(nm, u)
             return Field(nm, DataType.bool())
         # arithmetic
         if op == "+" and (lf.dtype.is_string() or rf.dtype.is_string()):
             return Field(nm, DataType.string())
-        if op == "/":
-            return Field(nm, DataType.float64())
-        if op == "**":
-            return Field(nm, DataType.float64())
-        # temporal arithmetic
+        # temporal arithmetic (must precede the '/' check: duration / numeric
+        # is legal and resolved by _temporal_arith_type)
         if lf.dtype.is_temporal() or rf.dtype.is_temporal():
             return Field(nm, _temporal_arith_type(op, lf.dtype, rf.dtype))
+        if op in ("/", "**"):
+            for f in (lf, rf):
+                if not (f.dtype.is_numeric() or f.dtype.is_boolean() or f.dtype.is_null()):
+                    raise ValueError(f"cannot apply {op} to {lf.dtype} and {rf.dtype}")
+            return Field(nm, DataType.float64())
         u = try_unify(lf.dtype, rf.dtype)
         if u is None or not (u.is_numeric() or u.is_boolean() or u.is_null()):
             raise ValueError(f"cannot apply {op} to {lf.dtype} and {rf.dtype}")
         if u.is_boolean():
-            u = DataType.int64() if op != "+" else u
+            # bool op numeric unifies to the numeric side (handled above by
+            # try_unify); bool op bool arithmetic is rejected like the
+            # reference (binary_ops.rs Add: only (Boolean, numeric) is legal)
+            raise ValueError(f"cannot apply {op} to {lf.dtype} and {rf.dtype}")
         return Field(nm, u)
 
     def _eval(self, table) -> Series:

@@ -1,0 +1,4 @@
+"""Framework interop: torch datasets (real), ray/dask bridges (gated).
+
+Reference: daft/dataframe/to_torch.py + to_ray_dataset/to_dask_dataframe
+(dataframe.py:2466-2742)."""

@@ -1,0 +1,37 @@
+"""torch.utils.data views over a DataFrame (reference: daft/dataframe/to_torch.py).
+
+MapDataset materializes once and serves random access (fits-in-memory path);
+IterDataset streams partitions without materializing the whole result — the
+input-pipeline shape for feeding host-side training loops.
+"""
+
+from __future__ import annotations
+
+try:
+    import torch.utils.data as _tud
+
+    _MapBase = _tud.Dataset
+    _IterBase = _tud.IterableDataset
+except ImportError:  # torch not installed: plain classes, same protocol
+    _MapBase = object
+    _IterBase = object
+
+
+class MapDataset(_MapBase):
+    def __init__(self, df):
+        self._rows = df.to_pylist()
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def __getitem__(self, i: int) -> dict:
+        return self._rows[i]
+
+
+class IterDataset(_IterBase):
+    def __init__(self, df):
+        self._df = df
+
+    def __iter__(self):
+        for part in self._df.iter_partitions():
+            yield from part.to_pylist()

@@ -1,0 +1,156 @@
+"""Catalog readers: Delta Lake (native log parser) and DB-API SQL scans.
+
+Reference role-equivalents:
+- `read_deltalake` (daft/delta_lake/delta_lake_scan.py:26): the reference uses
+  the deltalake client to list files; here the Delta transaction log is parsed
+  directly — `_delta_log/*.json` add/remove actions fold into the live file
+  set, which becomes parquet ScanTasks with per-file size + partition values,
+  so pushdowns and pruning ride the normal scan layer.
+- `read_sql` (daft/sql/sql_scan.py:35): executes a query through any DB-API
+  connection (or a sqlite:// / file path shortcut) and materializes the result
+  as arrow. Partitioning a SQL source by percentile bounds requires server
+  round-trips; this host path reads in one shot like the reference's
+  fallback (single ScanTask) mode.
+
+Iceberg/Hudi/Lance need their manifest codecs (avro etc.) which are not in
+this image; their entry points raise a clear error at api.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, List, Optional, Union
+
+import pyarrow as pa
+
+from ..schema import Schema
+from .scan import FileFormat, Pushdowns, ScanTask
+
+
+def _delta_live_files(table_uri: str) -> List[dict]:
+    """Fold the Delta transaction log into the set of live data files.
+
+    Honors checkpoints: when _delta_log/_last_checkpoint exists, the add/remove
+    state is seeded from the checkpoint parquet (single or multi-part) and only
+    commits AFTER the checkpoint version are replayed — required for tables
+    whose older JSON commits were vacuumed by log retention."""
+    log_dir = os.path.join(table_uri, "_delta_log")
+    if not os.path.isdir(log_dir):
+        raise FileNotFoundError(f"not a Delta table (no _delta_log): {table_uri}")
+    live: dict = {}
+    start_after = -1
+    lc_path = os.path.join(log_dir, "_last_checkpoint")
+    if os.path.exists(lc_path):
+        with open(lc_path) as f:
+            lc = json.load(f)
+        version = int(lc["version"])
+        parts = int(lc.get("parts", 0) or 0)
+        if parts:
+            cp_files = [os.path.join(
+                log_dir, f"{version:020d}.checkpoint.{i:010d}.{parts:010d}.parquet")
+                for i in range(1, parts + 1)]
+        else:
+            cp_files = [os.path.join(log_dir, f"{version:020d}.checkpoint.parquet")]
+        missing = [p for p in cp_files if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(
+                f"Delta checkpoint v{version} referenced by _last_checkpoint is "
+                f"missing files: {missing}")
+        import pyarrow.parquet as papq
+
+        for cp in cp_files:
+            t = papq.read_table(cp, columns=["add", "remove"])
+            for row in t.to_pylist():
+                a, r = row.get("add"), row.get("remove")
+                if a and a.get("path"):
+                    live[a["path"]] = a
+                elif r and r.get("path"):
+                    live.pop(r["path"], None)
+        start_after = version
+    commits = sorted(f for f in os.listdir(log_dir) if f.endswith(".json"))
+    commits = [c for c in commits if int(c.split(".")[0]) > start_after]
+    if not commits and start_after < 0:
+        raise FileNotFoundError(f"Delta table has no commits: {table_uri}")
+    for name in commits:
+        with open(os.path.join(log_dir, name)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                action = json.loads(line)
+                if "add" in action:
+                    a = action["add"]
+                    live[a["path"]] = a
+                elif "remove" in action:
+                    live.pop(action["remove"]["path"], None)
+    return [dict(v, path=os.path.join(table_uri, k)) for k, v in live.items()]
+
+
+def read_deltalake_scan(table_uri: str):
+    """-> (Schema, [ScanTask]) for a local Delta Lake table."""
+    import pyarrow.parquet as papq
+
+    files = _delta_live_files(table_uri)
+    if not files:
+        raise ValueError(f"Delta table {table_uri} has no live files")
+    from ..datatypes import DataType
+    from ..schema import Field
+
+    arrow_schema = papq.read_schema(files[0]["path"])
+    fields = [Field(n, DataType.from_arrow(arrow_schema.field(n).type))
+              for n in arrow_schema.names]
+    # hive-style partition columns live in the log's partitionValues, not the files
+    part_cols: List[str] = []
+    for f in files:
+        for k in (f.get("partitionValues") or {}):
+            if k not in part_cols:
+                part_cols.append(k)
+    for k in part_cols:
+        fields.append(Field(k, DataType.string()))
+    schema = Schema(fields)
+    tasks = []
+    for f in files:
+        tasks.append(ScanTask(
+            f["path"], FileFormat.PARQUET, schema, Pushdowns(),
+            size_bytes=f.get("size"),
+            partition_values={k: (f.get("partitionValues") or {}).get(k)
+                              for k in part_cols} or None,
+        ))
+    return schema, tasks
+
+
+def read_sql_arrow(sql: str, conn: Union[str, Callable[[], Any]],
+                   params: Optional[tuple] = None) -> pa.Table:
+    """Run `sql` through a DB-API connection and return an arrow table.
+
+    `conn` is a sqlite URL/path ("sqlite:///path/db.sqlite" or a .db path) or
+    a zero-arg callable returning a DB-API connection (the reference's
+    create_connection factory)."""
+    close_after = False
+    if hasattr(conn, "cursor"):  # a live DB-API connection: borrow, don't close
+        connection = conn
+    elif callable(conn):
+        connection = conn()
+        close_after = True
+    else:
+        import sqlite3
+
+        path = conn
+        if path.startswith("sqlite://"):
+            path = path[len("sqlite://"):]
+            while path.startswith("/") and not os.path.exists(path) and os.path.exists(path.lstrip("/")):
+                path = path.lstrip("/")
+        connection = sqlite3.connect(path)
+        close_after = True
+    try:
+        cur = connection.cursor()
+        cur.execute(sql, params or ())
+        names = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+    finally:
+        if close_after:
+            connection.close()
+    cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+    return pa.table(cols) if rows else pa.table(
+        {n: pa.array([], pa.null()) for n in names})

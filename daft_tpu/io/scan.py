@@ -105,14 +105,16 @@ class ScanTask:
     """
 
     __slots__ = ("path", "format", "schema", "pushdowns", "storage_options",
-                 "_num_rows", "_size_bytes", "stats", "row_group_ids")
+                 "_num_rows", "_size_bytes", "stats", "row_group_ids",
+                 "partition_values")
 
     def __init__(self, path: str, format: str, schema: Schema,
                  pushdowns: Optional[Pushdowns] = None,
                  storage_options: Optional[Dict[str, Any]] = None,
                  num_rows: Optional[int] = None, size_bytes: Optional[int] = None,
                  stats: Optional[TableStats] = None,
-                 row_group_ids: Optional[List[int]] = None):
+                 row_group_ids: Optional[List[int]] = None,
+                 partition_values: Optional[Dict[str, Any]] = None):
         self.path = path
         self.format = format
         self.schema = schema
@@ -122,6 +124,9 @@ class ScanTask:
         self._size_bytes = size_bytes
         self.stats = stats
         self.row_group_ids = row_group_ids
+        # hive/delta-style partition columns: constant per file, materialized
+        # as columns after the read (values live in the catalog, not the file)
+        self.partition_values = partition_values
 
     def __repr__(self) -> str:
         return f"ScanTask({self.format}:{self.path}, {self.pushdowns!r})"
@@ -148,7 +153,7 @@ class ScanTask:
     def with_pushdowns(self, pushdowns: Pushdowns) -> "ScanTask":
         return ScanTask(self.path, self.format, self.schema, pushdowns,
                         self.storage_options, self._num_rows, self._size_bytes,
-                        self.stats, self.row_group_ids)
+                        self.stats, self.row_group_ids, self.partition_values)
 
     def can_prune(self) -> bool:
         """True if file-level stats prove the pushdown filter matches no rows."""
@@ -157,18 +162,95 @@ class ScanTask:
         return not filter_may_match(self.pushdowns.filters, self.stats)
 
     def read(self):
-        """Materialize this scan task into a Table (applies pushdowns)."""
+        """Materialize this scan task into a Table (applies pushdowns).
+
+        Transient IO errors retry with exponential backoff (reference: the
+        IO-layer retry policies of daft-io s3_like.rs:452-468, applied here at
+        task granularity); permanent errors (missing file, permissions) raise
+        immediately."""
+        import time as _time
+
+        from ..context import get_context
+
+        cfg = get_context().execution_config
+        attempts = max(1, cfg.scan_retry_attempts)
+        for attempt in range(attempts):
+            try:
+                return self._read_with_partition_values()
+            except (FileNotFoundError, PermissionError, IsADirectoryError):
+                raise
+            except OSError:
+                if attempt == attempts - 1:
+                    raise
+                _time.sleep(cfg.scan_retry_backoff_s * (2 ** attempt))
+
+    def _read_with_partition_values(self):
+        """Catalog partition columns don't exist in the file, so a pushed-down
+        filter touching them must wait until they're appended — the file-level
+        reader would otherwise evaluate them against the reader's null fill."""
+        if not self.partition_values or self.pushdowns.filters is None:
+            return self._read_once()
+        from ..expressions import Expression
+        from ..logical import expr_input_columns
+
+        pred = Expression(self.pushdowns.filters)
+        need = expr_input_columns(pred)
+        if not set(need) & set(self.partition_values):
+            return self._read_once()
+        # the limit must also wait: a reader-side early-stop would truncate
+        # BEFORE the deferred filter, dropping matching rows in unread ranges
+        pd2 = self.pushdowns.with_filters(None).with_limit(None)
+        if pd2.columns is not None:
+            pd2 = pd2.with_columns(
+                list(pd2.columns) + [c for c in need
+                                     if c not in pd2.columns and c in self.schema])
+        tbl = self.with_pushdowns(pd2)._read_once().filter(pred)
+        want = self.materialized_schema
+        if tbl.schema.field_names() != want.field_names():
+            tbl = tbl.select_columns(want.field_names())
+        if self.pushdowns.limit is not None:
+            tbl = tbl.head(self.pushdowns.limit)
+        return tbl
+
+    def _read_once(self):
         from .readers import read_csv_table, read_json_table, read_parquet_table
 
         if self.format == FileFormat.PARQUET:
-            return read_parquet_table(self.path, self.pushdowns, schema=self.schema,
-                                      row_group_ids=self.row_group_ids)
-        if self.format == FileFormat.CSV:
-            return read_csv_table(self.path, self.pushdowns, schema=self.schema,
-                                  **self.storage_options)
-        if self.format == FileFormat.JSON:
-            return read_json_table(self.path, self.pushdowns, schema=self.schema)
-        raise ValueError(f"unknown scan format {self.format!r}")
+            tbl = read_parquet_table(self.path, self.pushdowns, schema=self.schema,
+                                     row_group_ids=self.row_group_ids)
+        elif self.format == FileFormat.CSV:
+            tbl = read_csv_table(self.path, self.pushdowns, schema=self.schema,
+                                 **self.storage_options)
+        elif self.format == FileFormat.JSON:
+            tbl = read_json_table(self.path, self.pushdowns, schema=self.schema)
+        else:
+            raise ValueError(f"unknown scan format {self.format!r}")
+        if self.partition_values:
+            tbl = self._append_partition_columns(tbl)
+        return tbl
+
+    def _append_partition_columns(self, tbl):
+        from ..series import Series
+        from ..table import Table
+
+        want = self.materialized_schema
+        cols = list(tbl.columns())
+        fields = [f for f in tbl.schema]
+        for name, value in self.partition_values.items():
+            if name not in want:
+                continue
+            f = want[name]
+            s = Series.from_pylist([value] * len(tbl), name, f.dtype)
+            if name in tbl.schema:
+                # the file reader fills catalog-only columns with nulls;
+                # overwrite with the partition value from the log
+                cols[tbl.schema.index(name)] = s
+            else:
+                cols.append(s)
+                fields.append(f)
+        from ..schema import Schema as _S
+
+        return Table(_S(fields), cols).cast_to_schema(want)
 
 
 def glob_paths(path) -> List[str]:

@@ -11,7 +11,8 @@ pyrunner.py:117 (local bulk runner), and ray_runner.py (distributed). Here:
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional
+import threading
+from typing import Dict, Iterator, List, Optional
 
 from .context import get_context
 from .execution import ExecutionContext, RuntimeStats, execute_plan
@@ -48,6 +49,120 @@ class PartitionSet:
 
     def size_bytes(self) -> int:
         return sum(p.size_bytes() or 0 for p in self.partitions)
+
+
+class PartitionSetCache:
+    """Process-wide cache of materialized results keyed by an entry id, with
+    explicit refcounts (reference: PartitionSetCache, partitioning.py:307-335
+    — keeps collect() results alive in the runner so later plans referencing
+    the same entry reuse them instead of re-executing)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, PartitionSet] = {}
+        self._refs: Dict[str, int] = {}
+
+    def put(self, key: str, pset: PartitionSet) -> str:
+        with self._lock:
+            self._entries[key] = pset
+            self._refs[key] = self._refs.get(key, 0) + 1
+        return key
+
+    def get(self, key: str) -> Optional[PartitionSet]:
+        with self._lock:
+            return self._entries.get(key)
+
+    def release(self, key: str) -> None:
+        with self._lock:
+            n = self._refs.get(key, 0) - 1
+            if n <= 0:
+                self._entries.pop(key, None)
+                self._refs.pop(key, None)
+            else:
+                self._refs[key] = n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._refs.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+_PARTITION_SET_CACHE = PartitionSetCache()
+
+
+def partition_set_cache() -> PartitionSetCache:
+    return _PARTITION_SET_CACHE
+
+
+class _Uncacheable(Exception):
+    pass
+
+
+def plan_cache_key(plan: LogicalPlan) -> Optional[str]:
+    """Structural cache key for a plan, or None when caching would be unsound:
+    side effects (writes), non-determinism (seedless sampling, UDFs), or any
+    attribute this walker can't prove collision-free."""
+    try:
+        return _plan_key(plan)
+    except _Uncacheable:
+        return None
+
+
+def _expr_has_udf(e) -> bool:
+    from .expressions import PyUdf
+
+    def rec(n):
+        return isinstance(n, PyUdf) or any(rec(c) for c in n.children())
+
+    return rec(e._node)
+
+
+_SCALARS = (str, int, float, bool, bytes, type(None))
+
+
+def _plan_key(p: LogicalPlan) -> str:
+    from .expressions import Expression
+    from .logical import InMemorySource, Sample, ScanSource, Write
+
+    if isinstance(p, Write):
+        raise _Uncacheable
+    if isinstance(p, Sample) and getattr(p, "seed", None) is None:
+        raise _Uncacheable
+    if isinstance(p, InMemorySource):
+        # identity of the materialized partition list IS the data identity
+        return f"mem#{id(p.partitions)}"
+    if isinstance(p, ScanSource):
+        return "scan#" + ";".join(
+            f"{t.path}|{t.format}|{t.pushdowns!r}|{t.row_group_ids}|{t.partition_values}"
+            for t in p.tasks)
+    items = []
+    for k, v in sorted(vars(p).items()):
+        # schemas are derived from children + expressions, already covered
+        if k.startswith("_") or isinstance(v, (LogicalPlan, Schema)):
+            continue
+        if isinstance(v, Expression):
+            if _expr_has_udf(v):
+                raise _Uncacheable
+            items.append(f"{k}={v._node._key()!r}")
+        elif isinstance(v, (list, tuple)):
+            if all(isinstance(e, Expression) for e in v):
+                if any(_expr_has_udf(e) for e in v):
+                    raise _Uncacheable
+                items.append(f"{k}=[{','.join(repr(e._node._key()) for e in v)}]")
+            elif all(isinstance(e, _SCALARS) for e in v):
+                items.append(f"{k}={v!r}")
+            else:
+                raise _Uncacheable
+        elif isinstance(v, _SCALARS):
+            items.append(f"{k}={v!r}")
+        else:
+            raise _Uncacheable  # unknown attribute type: refuse, don't collide
+    kids = ",".join(_plan_key(c) for c in p.children())
+    return f"{type(p).__name__}({';'.join(items)})[{kids}]"
 
 
 class Runner:

@@ -255,9 +255,12 @@ class Series:
         self._require_arrow("arithmetic")
         other._require_arrow("arithmetic")
         l, r = self, other
-        if unify and l._dtype != r._dtype and l._dtype.is_numeric() and r._dtype.is_numeric():
+        if unify and l._dtype != r._dtype and all(
+                d.is_numeric() or d.is_boolean() for d in (l._dtype, r._dtype)):
+            # bool operands unify to the numeric side (reference binary_ops.rs:
+            # (Boolean, numeric) -> numeric)
             u = try_unify(l._dtype, r._dtype)
-            if u is not None:
+            if u is not None and u.is_numeric():
                 l, r = l.cast(u), r.cast(u)
         out = fn(*_binary_args(l, r))
         s = Series.from_arrow(out, name or self._name)
@@ -274,10 +277,22 @@ class Series:
             return Series.from_arrow(pc.binary_join_element_wise(
                 l._arrow.cast(pa.large_string()), r._arrow.cast(pa.large_string()),
                 pa.scalar("", pa.large_string())), self._name)
+        self._check_temporal_arith("+", other)
         return self._binary_numeric(other, pc.add_checked)
 
     def __sub__(self, other):
-        return self._binary_numeric(_as_series(other), pc.subtract_checked)
+        other = _as_series(other)
+        self._check_temporal_arith("-", other)
+        return self._binary_numeric(other, pc.subtract_checked)
+
+    def _check_temporal_arith(self, op: str, other: "Series") -> None:
+        """Mirror the planner's temporal-pair rules (reference binary_ops.rs:
+        e.g. date - timestamp is illegal) — arrow's kernels are more
+        permissive than the type system allows."""
+        if self._dtype.is_temporal() or other._dtype.is_temporal():
+            from .expressions import _temporal_arith_type
+
+            _temporal_arith_type(op, self._dtype, other._dtype)  # raises if illegal
 
     def __mul__(self, other):
         return self._binary_numeric(_as_series(other), pc.multiply_checked)
@@ -372,20 +387,27 @@ class Series:
         return Series.from_arrow(pc.or_(eq, both_null), self._name, DataType.bool())
 
     # ------------------------------------------------------------------ logical
-    def __and__(self, other):
+    def _logical(self, other, kleene_fn, bit_fn) -> "Series":
+        """Kleene logic on bools; bitwise form when both sides are integers
+        (matching the planner: mixed bool/int pairs are rejected)."""
         other = _as_series(other)
-        l, r = _broadcast(self, other)
-        return Series.from_arrow(pc.and_kleene(l._arrow, r._arrow), self._name)
+        l, r = self, other
+        if l._dtype.is_integer() and r._dtype.is_integer():
+            if l._dtype != r._dtype:
+                u = try_unify(l._dtype, r._dtype)
+                if u is not None:
+                    l, r = l.cast(u), r.cast(u)
+            return Series.from_arrow(bit_fn(*_binary_args(l, r)), self._name)
+        return Series.from_arrow(kleene_fn(*_binary_args(l, r)), self._name)
+
+    def __and__(self, other):
+        return self._logical(other, pc.and_kleene, pc.bit_wise_and)
 
     def __or__(self, other):
-        other = _as_series(other)
-        l, r = _broadcast(self, other)
-        return Series.from_arrow(pc.or_kleene(l._arrow, r._arrow), self._name)
+        return self._logical(other, pc.or_kleene, pc.bit_wise_or)
 
     def __xor__(self, other):
-        other = _as_series(other)
-        l, r = _broadcast(self, other)
-        return Series.from_arrow(pc.xor(l._arrow, r._arrow), self._name)
+        return self._logical(other, pc.xor, pc.bit_wise_xor)
 
     def __invert__(self):
         return Series.from_arrow(pc.invert(self._arrow), self._name)

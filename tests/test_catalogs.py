@@ -1,0 +1,215 @@
+"""Delta Lake log reader + DB-API sql scan + retry/cancel tests."""
+
+import json
+import os
+import sqlite3
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.execution import QueryCancelledError
+
+
+def _write_delta(root, commits):
+    """commits: list of lists of (action, payload)."""
+    log = os.path.join(root, "_delta_log")
+    os.makedirs(log, exist_ok=True)
+    for i, actions in enumerate(commits):
+        with open(os.path.join(log, f"{i:020d}.json"), "w") as f:
+            for a in actions:
+                f.write(json.dumps(a) + "\n")
+
+
+class TestDeltaLake:
+    def test_read_add_remove(self, tmp_path):
+        root = str(tmp_path)
+        t1 = pa.table({"x": [1, 2], "y": ["a", "b"]})
+        t2 = pa.table({"x": [3], "y": ["c"]})
+        t3 = pa.table({"x": [9], "y": ["z"]})
+        for name, t in [("f1.parquet", t1), ("f2.parquet", t2), ("old.parquet", t3)]:
+            papq.write_table(t, os.path.join(root, name))
+        _write_delta(root, [
+            [{"add": {"path": "old.parquet", "size": 100, "partitionValues": {}}}],
+            [{"add": {"path": "f1.parquet", "size": 200, "partitionValues": {}}},
+             {"remove": {"path": "old.parquet"}}],
+            [{"add": {"path": "f2.parquet", "size": 80, "partitionValues": {}}}],
+        ])
+        df = dt.read_deltalake(root)
+        out = df.sort("x").to_pydict()
+        assert out == {"x": [1, 2, 3], "y": ["a", "b", "c"]}  # old.parquet removed
+
+    def test_partition_values(self, tmp_path):
+        root = str(tmp_path)
+        papq.write_table(pa.table({"v": [1, 2]}), os.path.join(root, "p0.parquet"))
+        papq.write_table(pa.table({"v": [3]}), os.path.join(root, "p1.parquet"))
+        _write_delta(root, [
+            [{"add": {"path": "p0.parquet", "size": 1, "partitionValues": {"day": "2024-01-01"}}},
+             {"add": {"path": "p1.parquet", "size": 1, "partitionValues": {"day": "2024-01-02"}}}],
+        ])
+        out = dt.read_deltalake(root).sort("v").to_pydict()
+        assert out["day"] == ["2024-01-01", "2024-01-01", "2024-01-02"]
+        # filter on the partition column flows through the engine
+        out2 = dt.read_deltalake(root).where(col("day") == "2024-01-02").to_pydict()
+        assert out2["v"] == [3]
+
+    def test_not_a_delta_table(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="_delta_log"):
+            dt.read_deltalake(str(tmp_path))
+
+
+class TestReadSql:
+    def test_sqlite_path(self, tmp_path):
+        db = str(tmp_path / "t.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE items (id INTEGER, name TEXT, price REAL)")
+        conn.executemany("INSERT INTO items VALUES (?, ?, ?)",
+                         [(1, "a", 1.5), (2, "b", 2.5), (3, None, 9.0)])
+        conn.commit()
+        conn.close()
+        df = dt.read_sql("SELECT * FROM items WHERE price < 5", db)
+        out = df.sort("id").to_pydict()
+        assert out == {"id": [1, 2], "name": ["a", "b"], "price": [1.5, 2.5]}
+
+    def test_connection_factory(self, tmp_path):
+        db = str(tmp_path / "t.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE t (a INTEGER)")
+        conn.executemany("INSERT INTO t VALUES (?)", [(i,) for i in range(10)])
+        conn.commit()
+        conn.close()
+        df = dt.read_sql("SELECT a FROM t", lambda: sqlite3.connect(db))
+        assert df.sum("a").to_pydict() == {"a": [45]}
+
+
+class TestRetryAndCancel:
+    def test_missing_file_fails_fast(self, tmp_path):
+        from daft_tpu.io.scan import FileFormat, Pushdowns, ScanTask
+        from daft_tpu.schema import Field, Schema
+        from daft_tpu.datatypes import DataType
+
+        task = ScanTask(str(tmp_path / "nope.parquet"), FileFormat.PARQUET,
+                        Schema([Field("a", DataType.int64())]), Pushdowns())
+        t0 = time.perf_counter()
+        with pytest.raises(FileNotFoundError):
+            task.read()
+        assert time.perf_counter() - t0 < 0.2  # no retries on permanent errors
+
+    def test_cancel_mid_query(self):
+        import numpy as np
+
+        n = 2_000_000
+        df = dt.from_pydict({"x": np.arange(n)})
+        df = df.repartition(64).select((col("x") * 2).alias("y"))
+        it = df.iter_partitions()
+        next(it)  # query running
+        df.cancel()
+        with pytest.raises(QueryCancelledError):
+            for _ in it:
+                pass
+
+
+class TestInterop:
+    def test_torch_datasets(self):
+        df = dt.from_pydict({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+        m = df.to_torch_map_dataset()
+        assert len(m) == 3 and m[1] == {"x": 2, "y": "b"}
+        it = df.to_torch_iter_dataset()
+        assert list(it) == [{"x": 1, "y": "a"}, {"x": 2, "y": "b"}, {"x": 3, "y": "c"}]
+        from torch.utils.data import DataLoader
+
+        batches = list(DataLoader(m, batch_size=2, shuffle=False))
+        assert [t.tolist() for t in batches[0]["x"]] == [1, 2] or batches[0]["x"].tolist() == [1, 2]
+
+    def test_partition_set_cache(self):
+        from daft_tpu.runners import PartitionSetCache
+
+        c = PartitionSetCache()
+        df = dt.from_pydict({"a": [1]}).collect()
+        c.put("k", df._result)
+        c.put("k", df._result)  # refcount 2
+        assert c.get("k") is df._result
+        c.release("k")
+        assert len(c) == 1
+        c.release("k")
+        assert len(c) == 0 and c.get("k") is None
+
+
+class TestReviewFixes:
+    def test_delta_checkpoint(self, tmp_path):
+        root = str(tmp_path)
+        log = os.path.join(root, "_delta_log")
+        os.makedirs(log)
+        papq.write_table(pa.table({"v": [1]}), os.path.join(root, "cp.parquet"))
+        papq.write_table(pa.table({"v": [2]}), os.path.join(root, "post.parquet"))
+        # checkpoint at version 5 holds cp.parquet; json commit 6 adds post.parquet
+        cp = pa.table({
+            "add": [{"path": "cp.parquet", "size": 1}, None],
+            "remove": [None, {"path": "gone.parquet"}],
+        })
+        papq.write_table(cp, os.path.join(log, f"{5:020d}.checkpoint.parquet"))
+        with open(os.path.join(log, "_last_checkpoint"), "w") as f:
+            json.dump({"version": 5, "size": 2}, f)
+        with open(os.path.join(log, f"{6:020d}.json"), "w") as f:
+            f.write(json.dumps({"add": {"path": "post.parquet", "size": 1,
+                                        "partitionValues": {}}}) + "\n")
+        out = dt.read_deltalake(root).sort("v").to_pydict()
+        assert out == {"v": [1, 2]}
+
+    def test_read_sql_live_connection(self, tmp_path):
+        conn = sqlite3.connect(str(tmp_path / "x.db"))
+        conn.execute("CREATE TABLE t (a INTEGER)")
+        conn.execute("INSERT INTO t VALUES (7)")
+        conn.commit()
+        df = dt.read_sql("SELECT a FROM t", conn)
+        assert df.to_pydict() == {"a": [7]}
+        conn.execute("SELECT 1")  # connection still usable (not closed)
+        conn.close()
+
+    def test_cancel_then_retry(self):
+        df = dt.from_pydict({"x": [1, 2, 3]}).select((col("x") + 1).alias("y"))
+        df.cancel()
+        assert df.to_pydict() == {"y": [2, 3, 4]}  # retry clears cancellation
+
+    def test_result_cache_reuse(self):
+        import numpy as np
+
+        base = dt.from_pydict({"k": np.arange(1000) % 5, "v": np.arange(1000.0)})
+        q1 = base.groupby("k").agg(col("v").sum().alias("s")).sort("k")
+        q2 = base.groupby("k").agg(col("v").sum().alias("s")).sort("k")
+        r1 = q1.collect().to_pydict()
+        r2 = q2.collect().to_pydict()
+        assert r1 == r2
+        assert q2.stats.snapshot()["counters"].get("result_cache_hits", 0) == 1
+
+    def test_udf_plans_not_cached(self):
+        from daft_tpu.runners import plan_cache_key
+
+        calls = {"n": 0}
+
+        @dt.udf(return_dtype=dt.DataType.int64())
+        def bump(s):
+            calls["n"] += 1
+            return s
+
+        base = dt.from_pydict({"x": [1, 2]})
+        q = base.select(bump(col("x")).alias("y"))
+        assert plan_cache_key(q._plan) is None
+        q.collect()
+        base.select(bump(col("x")).alias("y")).collect()
+        assert calls["n"] == 2  # ran twice: never served from cache
+
+    def test_limit_with_partition_filter(self, tmp_path):
+        root = str(tmp_path)
+        papq.write_table(pa.table({"v": list(range(100))}), os.path.join(root, "a.parquet"))
+        papq.write_table(pa.table({"v": list(range(100, 200))}), os.path.join(root, "b.parquet"))
+        _write_delta(root, [[
+            {"add": {"path": "a.parquet", "size": 1, "partitionValues": {"p": "x"}}},
+            {"add": {"path": "b.parquet", "size": 1, "partitionValues": {"p": "y"}}},
+        ]])
+        out = dt.read_deltalake(root).where(col("p") == "y").limit(3).to_pydict()
+        assert out["v"] == [100, 101, 102]

@@ -1,0 +1,97 @@
+"""Property-based sort invariants over random dtypes/values/nulls.
+
+Reference: tests/property_based_testing/test_sort.py (hypothesis total-order
+sort invariants, SURVEY.md §4)."""
+
+import math
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+import daft_tpu as dt
+from daft_tpu import col
+
+_scalar = st.one_of(
+    st.none(),
+    st.integers(min_value=-(2**31), max_value=2**31),
+    st.floats(allow_nan=False, width=64),
+    st.text(max_size=12),
+    st.booleans(),
+)
+
+
+def _column(draw, n):
+    kind = draw(st.sampled_from(["int", "float", "str", "bool"]))
+    elem = {
+        "int": st.one_of(st.none(), st.integers(min_value=-(2**31), max_value=2**31)),
+        "float": st.one_of(st.none(), st.floats(allow_nan=False, width=64)),
+        "str": st.one_of(st.none(), st.text(max_size=8)),
+        "bool": st.one_of(st.none(), st.booleans()),
+    }[kind]
+    return draw(st.lists(elem, min_size=n, max_size=n))
+
+
+@st.composite
+def _sort_case(draw):
+    n = draw(st.integers(min_value=0, max_value=40))
+    vals = _column(draw, n)
+    desc = draw(st.booleans())
+    nulls_first = draw(st.booleans())
+    return vals, desc, nulls_first
+
+
+def _key(v, desc):
+    # total order: None handled separately by split
+    if isinstance(v, bool):
+        return (not v) if desc else v
+    return v
+
+
+@given(_sort_case())
+@settings(max_examples=60, deadline=None)
+def test_sort_total_order(case):
+    vals, desc, nulls_first = case
+    df = dt.from_pydict({"x": dt.Series.from_pylist(vals, "x")})
+    out = df.sort("x", desc=desc, nulls_first=nulls_first).to_pydict()["x"]
+    # 1. permutation of the input
+    assert sorted(map(repr, out)) == sorted(map(repr, vals))
+    # 2. nulls grouped at the requested end
+    non_null = [v for v in out if v is not None]
+    k = len(out) - len(non_null)
+    if nulls_first:
+        assert all(v is None for v in out[:k])
+    else:
+        assert all(v is None for v in out[len(non_null):])
+    # 3. non-null run is monotonic
+    for a, b in zip(non_null, non_null[1:]):
+        if desc:
+            assert not (_cmp_lt(a, b)), (a, b)
+        else:
+            assert not (_cmp_lt(b, a)), (a, b)
+
+
+def _cmp_lt(a, b):
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) or math.isnan(b):
+            return False
+    return a < b
+
+
+@given(st.lists(st.one_of(st.none(), st.integers(min_value=-100, max_value=100)),
+                min_size=0, max_size=30),
+       st.lists(st.one_of(st.none(), st.text(max_size=4)), min_size=0, max_size=30))
+@settings(max_examples=30, deadline=None)
+def test_multi_key_sort_is_lexicographic(ints, strs):
+    n = min(len(ints), len(strs))
+    ints, strs = ints[:n], strs[:n]
+    df = dt.from_pydict({"a": dt.Series.from_pylist(strs, "a"),
+                         "b": dt.Series.from_pylist(ints, "b")})
+    out = df.sort(["a", "b"]).to_pydict()
+    rows = list(zip(out["a"], out["b"]))
+
+    def key(r):
+        a, b = r
+        return ((a is None, a if a is not None else ""),
+                (b is None, b if b is not None else 0))
+
+    assert rows == sorted(zip(strs, ints), key=key)

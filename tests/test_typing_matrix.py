@@ -1,0 +1,84 @@
+"""Exhaustive type-resolution matrix: for every (op, lhs-dtype, rhs-dtype)
+pair, the planner's resolved dtype must equal the kernel's actual output dtype
+— or both must reject the pair.
+
+Reference: tests/expressions/typing/conftest.py:16-33 (the resolver-vs-kernel
+agreement oracle, SURVEY.md §4)."""
+
+import datetime
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import DataType, col
+from daft_tpu.table import Table
+
+SAMPLES = {
+    DataType.bool(): [True, False, None],
+    DataType.int8(): [1, -2, None],
+    DataType.int16(): [100, -5, None],
+    DataType.int32(): [1000, -7, None],
+    DataType.int64(): [10_000, -11, None],
+    DataType.uint8(): [1, 20, None],
+    DataType.uint16(): [1, 300, None],
+    DataType.uint32(): [1, 70_000, None],
+    DataType.uint64(): [1, 2, None],
+    DataType.float32(): [1.5, -0.25, None],
+    DataType.float64(): [2.5, -0.125, None],
+    DataType.string(): ["a", "bb", None],
+    DataType.binary(): [b"x", b"yy", None],
+    DataType.date(): [datetime.date(2024, 1, 1), datetime.date(2020, 6, 5), None],
+    DataType.timestamp("us"): [datetime.datetime(2024, 1, 1, 12), None, None],
+}
+
+DTYPES = list(SAMPLES)
+BINARY_OPS = ["+", "-", "*", "/", "<", "<=", "==", "!=", ">", ">=", "&", "|"]
+
+
+def _table():
+    data = {}
+    for i, (dtype, vals) in enumerate(SAMPLES.items()):
+        data[f"c{i}"] = dt.Series.from_pylist(vals, f"c{i}", dtype)
+    return Table.from_pydict(data)
+
+
+_TBL = _table()
+_COLS = {d: f"c{i}" for i, d in enumerate(SAMPLES)}
+
+
+def _apply(op, l, r):
+    import operator
+
+    m = {"+": operator.add, "-": operator.sub, "*": operator.mul,
+         "/": operator.truediv, "<": operator.lt, "<=": operator.le,
+         "==": operator.eq, "!=": operator.ne, ">": operator.gt,
+         ">=": operator.ge, "&": operator.and_, "|": operator.or_}
+    return m[op](l, r)
+
+
+@pytest.mark.parametrize("op", BINARY_OPS)
+def test_resolver_matches_kernel(op):
+    mismatches = []
+    for ld in DTYPES:
+        for rd in DTYPES:
+            expr = _apply(op, col(_COLS[ld]), col(_COLS[rd]))
+            try:
+                planned = expr._node.to_field(_TBL.schema).dtype
+                plan_err = None
+            except Exception as e:  # noqa: BLE001
+                planned, plan_err = None, e
+            try:
+                actual = expr._node.evaluate(_TBL).dtype
+                kern_err = None
+            except Exception as e:  # noqa: BLE001
+                if "overflow" in str(e):
+                    continue  # checked-arithmetic VALUE error, not a typing issue
+                actual, kern_err = None, e
+            if plan_err is not None and kern_err is not None:
+                continue  # both reject: consistent
+            if plan_err is not None or kern_err is not None:
+                mismatches.append(f"{op}({ld},{rd}): planner={planned or plan_err!r} "
+                                  f"kernel={actual or kern_err!r}")
+            elif planned != actual:
+                mismatches.append(f"{op}({ld},{rd}): planner={planned} kernel={actual}")
+    assert not mismatches, "\n".join(mismatches[:25]) + f"\n... {len(mismatches)} total"
