@@ -48,10 +48,26 @@ class ExecutionConfig:
     # transient-IO retry at scan-task granularity (reference: s3_like.rs retry)
     scan_retry_attempts: int = 3
     scan_retry_backoff_s: float = 0.1
+    # morsel-parallel execution (reference: worker-per-core intermediate ops,
+    # intermediate_op.rs:71): 0 = auto (one worker per core when the host has
+    # >= 4 cores; sequential below that — oversubscription on tiny hosts
+    # costs more than it buys), 1 = sequential, N = exactly N workers
+    executor_threads: int = 0
     # TPU-specific: route eligible projections/aggregations through the jax
     # device kernel layer (kernels/device.py); host pyarrow path otherwise.
     use_device_kernels: bool = False
     device_min_rows: int = 4096
+
+
+def resolve_executor_threads(cfg: "ExecutionConfig") -> int:
+    n = cfg.executor_threads
+    if n == 0:
+        try:  # cgroup/affinity-aware, not raw host cores
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:
+            cores = os.cpu_count() or 1
+        n = cores if cores >= 4 else 1
+    return max(1, n)
 
 
 class DaftContext:

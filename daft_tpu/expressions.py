@@ -756,6 +756,14 @@ def _as_expr_node(v) -> ExprNode:
     return Literal(v)
 
 
+def expr_has_udf(e: "Expression") -> bool:
+    """True if any node of the expression tree is a user function call."""
+    def rec(n):
+        return isinstance(n, PyUdf) or any(rec(c) for c in n.children())
+
+    return rec(e._node)
+
+
 class Expression:
     """User-facing expression wrapper with operators and namespaces."""
 

@@ -18,7 +18,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .expressions import AggExpr, Alias, Expression, col, lit
+from .expressions import AggExpr, Alias, Expression, col, expr_has_udf, lit
 from .logical import (
     Aggregate,
     Concat,
@@ -46,7 +46,38 @@ PartStream = Iterator[MicroPartition]
 
 
 class PhysicalOp:
-    """Base: children + a generator-producing execute()."""
+    """Base: children + a generator-producing execute().
+
+    Ops that are pure per-partition maps set `map_partition` (a method
+    (part, ctx) -> part); the executor then runs them morsel-parallel across
+    a worker pool (reference: worker-per-core IntermediateOps,
+    intermediate_op.rs:71) instead of calling execute()."""
+
+    map_partition = None  # type: ignore[assignment]
+
+    def map_empty(self, ctx):
+        """Partitions to emit when the (parallel-mapped) input is empty."""
+        return iter(())
+
+    def parallel_safe(self) -> bool:
+        """Whether map_partition may run concurrently across morsels.
+        Function UDFs carry arbitrary user state with no thread-safety
+        contract, so any expression containing one forces sequential order
+        (class UDFs are safe: actor pools serialize per instance)."""
+        return not any(expr_has_udf(e) for e in self._map_exprs())
+
+    def _map_exprs(self):
+        return ()
+
+    def _map_execute(self, inputs, ctx):
+        """Sequential driver over map_partition — the single source of truth
+        shared with the parallel executor path."""
+        saw = False
+        for part in inputs[0]:
+            saw = True
+            yield self.map_partition(part, ctx)
+        if not saw:
+            yield from self.map_empty(ctx)
 
     def __init__(self, children: List["PhysicalOp"], schema: Schema, num_partitions: int):
         self.children = children
@@ -111,9 +142,14 @@ class ProjectOp(PhysicalOp):
         super().__init__([child], schema, child.num_partitions)
         self.exprs = exprs
 
+    def map_partition(self, part, ctx):
+        return ctx.eval_projection(part, self.exprs)
+
+    def _map_exprs(self):
+        return self.exprs
+
     def execute(self, inputs, ctx) -> PartStream:
-        for part in inputs[0]:
-            yield ctx.eval_projection(part, self.exprs)
+        return self._map_execute(inputs, ctx)
 
     def describe(self):
         return "Project: " + ", ".join(e._node.display() for e in self.exprs)
@@ -124,9 +160,14 @@ class FilterOp(PhysicalOp):
         super().__init__([child], child.schema, child.num_partitions)
         self.predicate = predicate
 
+    def map_partition(self, part, ctx):
+        return part.filter([self.predicate])
+
+    def _map_exprs(self):
+        return (self.predicate,)
+
     def execute(self, inputs, ctx) -> PartStream:
-        for part in inputs[0]:
-            yield part.filter([self.predicate])
+        return self._map_execute(inputs, ctx)
 
     def describe(self):
         return f"Filter: {self.predicate._node.display()}"
@@ -374,14 +415,19 @@ class AggregateOp(PhysicalOp):
         self.aggregations = aggregations
         self.groupby = groupby
 
-    def execute(self, inputs, ctx) -> PartStream:
-        empty = True
-        for part in inputs[0]:
-            empty = False
-            yield part.agg(self.aggregations, self.groupby or None)
-        if empty and not self.groupby:
-            # global agg over zero partitions still yields one row (count=0 etc.)
+    def map_partition(self, part, ctx):
+        return part.agg(self.aggregations, self.groupby or None)
+
+    def map_empty(self, ctx):
+        # global agg over zero partitions still yields one row (count=0 etc.)
+        if not self.groupby:
             yield MicroPartition.empty(self.children[0].schema).agg(self.aggregations, None)
+
+    def _map_exprs(self):
+        return list(self.aggregations) + list(self.groupby)
+
+    def execute(self, inputs, ctx) -> PartStream:
+        return self._map_execute(inputs, ctx)
 
     def describe(self):
         a = ", ".join(e._node.display() for e in self.aggregations)
@@ -636,22 +682,53 @@ def populate_aggregation_stages(
 # logical -> physical translation
 # ---------------------------------------------------------------------------
 
-def translate(plan: LogicalPlan, cfg) -> PhysicalOp:
+def _split_morsels(parts: List[MicroPartition], cfg) -> List[MicroPartition]:
+    """Split oversized in-memory partitions into morsels so the worker pool
+    has parallel units even for a single-partition source (reference: the
+    morsel size driving source chunking, default_morsel_size). Zero-copy
+    slices; partition count is fixed here at plan time so aggregate staging
+    sees the real parallelism."""
+    from .context import resolve_executor_threads
+
+    threads = resolve_executor_threads(cfg)
+    if threads <= 1:
+        return parts
+    morsel = max(int(cfg.default_morsel_size), 1)
+    out: List[MicroPartition] = []
+    for p in parts:
+        n = p.num_rows_or_none()
+        if n is None or n <= 2 * morsel:
+            out.append(p)
+            continue
+        k = min(-(-n // morsel), threads * 4)
+        step = -(-n // k)
+        for s in range(0, n, step):
+            out.append(p.slice(s, min(s + step, n)))
+    return out
+
+
+def translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:
     """Translate an (optimized) logical plan to a physical operator tree.
 
     cfg: ExecutionConfig (broadcast threshold, default partitions, etc.)
+    morsels: split oversized in-memory sources into parallel morsels; set
+    only under aggregate pipelines (where the two-stage decomposition turns
+    extra partitions into parallel stage-1 work) and propagated through the
+    transparent map ops (Project/Filter). Ops that would pay for higher
+    partition counts with extra shuffles (Sort/Distinct/Join) never see it.
     """
     if isinstance(plan, InMemorySource):
-        return InMemoryOp(plan.partitions, plan.schema)
+        parts = _split_morsels(plan.partitions, cfg) if morsels else plan.partitions
+        return InMemoryOp(parts, plan.schema)
 
     if isinstance(plan, ScanSource):
         return ScanOp(plan.tasks, plan.schema)
 
     if isinstance(plan, Project):
-        return ProjectOp(translate(plan.input, cfg), plan.exprs, plan.schema)
+        return ProjectOp(translate(plan.input, cfg, morsels), plan.exprs, plan.schema)
 
     if isinstance(plan, Filter):
-        return FilterOp(translate(plan.input, cfg), plan.predicate)
+        return FilterOp(translate(plan.input, cfg, morsels), plan.predicate)
 
     if isinstance(plan, Limit):
         return LimitOp(translate(plan.input, cfg), plan.limit)
@@ -723,7 +800,7 @@ def translate(plan: LogicalPlan, cfg) -> PhysicalOp:
 
 
 def _translate_aggregate(plan: Aggregate, cfg) -> PhysicalOp:
-    child = translate(plan.input, cfg)
+    child = translate(plan.input, cfg, morsels=True)
     nparts = child.num_partitions
 
     if nparts == 1:

@@ -102,6 +102,12 @@ class _Uncacheable(Exception):
     pass
 
 
+def _hasudf(e):
+    from .expressions import expr_has_udf
+
+    return expr_has_udf(e)
+
+
 def plan_cache_key(plan: LogicalPlan) -> Optional[str]:
     """Structural cache key for a plan, or None when caching would be unsound:
     side effects (writes), non-determinism (seedless sampling, UDFs), or any
@@ -110,15 +116,6 @@ def plan_cache_key(plan: LogicalPlan) -> Optional[str]:
         return _plan_key(plan)
     except _Uncacheable:
         return None
-
-
-def _expr_has_udf(e) -> bool:
-    from .expressions import PyUdf
-
-    def rec(n):
-        return isinstance(n, PyUdf) or any(rec(c) for c in n.children())
-
-    return rec(e._node)
 
 
 _SCALARS = (str, int, float, bool, bytes, type(None))
@@ -145,12 +142,12 @@ def _plan_key(p: LogicalPlan) -> str:
         if k.startswith("_") or isinstance(v, (LogicalPlan, Schema)):
             continue
         if isinstance(v, Expression):
-            if _expr_has_udf(v):
+            if _hasudf(v):
                 raise _Uncacheable
             items.append(f"{k}={v._node._key()!r}")
         elif isinstance(v, (list, tuple)):
             if all(isinstance(e, Expression) for e in v):
-                if any(_expr_has_udf(e) for e in v):
+                if any(_hasudf(e) for e in v):
                     raise _Uncacheable
                 items.append(f"{k}=[{','.join(repr(e._node._key()) for e in v)}]")
             elif all(isinstance(e, _SCALARS) for e in v):
