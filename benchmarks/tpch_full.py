@@ -1,0 +1,218 @@
+"""Full TPC-H schema: synthetic dbgen-shaped generator + SQLite oracle.
+
+Role-equivalent to the reference's benchmarking/tpch/data_generation.py
+(dbgen + gen_sqlite_db) and tests/integration/test_tpch.py's oracle strategy:
+run the official TPC-H SQL against SQLite over the same data and diff.
+
+Data is not dbgen-exact (zero egress — no dbgen binary) but follows the spec's
+value domains (brand/type/container wordlists, date ranges, comment vocabulary)
+so every query's filters select non-trivial subsets.
+"""
+
+from __future__ import annotations
+
+import datetime
+import sqlite3
+from typing import Dict
+
+import numpy as np
+import pyarrow as pa
+
+_EPOCH = datetime.date(1970, 1, 1)
+D = lambda y, m, d: (datetime.date(y, m, d) - _EPOCH).days  # noqa: E731
+_START, _END = D(1992, 1, 1), D(1998, 12, 1)
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [  # (name, regionkey) — the spec's 25 nations
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1), ("EGYPT", 4),
+    ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3), ("INDIA", 2), ("INDONESIA", 2),
+    ("IRAN", 4), ("IRAQ", 4), ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0),
+    ("MOROCCO", 0), ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3), ("UNITED KINGDOM", 3),
+    ("UNITED STATES", 1),
+]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+INSTRUCTIONS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+TYPE_1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINER_1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINER_2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+          "blanched", "blue", "blush", "brown", "burlywood", "burnished", "chartreuse",
+          "chiffon", "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan",
+          "dark", "deep", "dim", "dodger", "drab", "firebrick", "floral", "forest",
+          "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey", "honeydew",
+          "hot", "hotpink", "indian", "ivory", "khaki", "lace", "lavender", "lawn",
+          "lemon", "light", "lime", "linen", "magenta", "maroon", "medium", "metallic",
+          "midnight", "mint", "misty", "moccasin", "navajo", "navy", "olive", "orange",
+          "orchid", "pale", "papaya", "peach", "peru", "pink", "plum", "powder",
+          "puff", "purple", "red", "rose", "rosy", "royal", "saddle", "salmon",
+          "sandy", "seashell", "sienna", "sky", "slate", "smoke", "snow", "spring",
+          "steel", "tan", "thistle", "tomato", "turquoise", "violet", "wheat", "white",
+          "yellow"]
+WORDS = ["carefully", "quickly", "furiously", "slyly", "blithely", "special",
+         "pending", "final", "express", "regular", "ironic", "even", "bold",
+         "silent", "daring", "requests", "deposits", "packages", "accounts",
+         "instructions", "theodolites", "dependencies", "foxes", "pinto", "beans",
+         "complaints", "excuses", "platelets", "ideas", "asymptotes", "customer"]
+
+
+def _comments(rng, n, lo=4, hi=10):
+    words = np.array(WORDS)
+    return [" ".join(words[rng.randint(0, len(words), rng.randint(lo, hi))]) for _ in range(n)]
+
+
+def _dates_iso(days: np.ndarray):
+    return [(_EPOCH + datetime.timedelta(days=int(d))).isoformat() for d in days]
+
+
+def generate(scale: float = 0.01, seed: int = 7) -> Dict[str, pa.Table]:
+    """All 8 TPC-H tables at `scale` of SF1 row counts (lineitem ~6M at SF1)."""
+    rng = np.random.RandomState(seed)
+    n_part = max(int(200_000 * scale), 200)
+    n_supp = max(int(10_000 * scale), 20)
+    n_cust = max(int(150_000 * scale), 150)
+    n_ord = max(int(1_500_000 * scale), 1500)
+    n_li = max(int(6_000_000 * scale), 6000)
+    n_ps = n_part * 4
+
+    region = pa.table({
+        "r_regionkey": pa.array(np.arange(5), pa.int64()),
+        "r_name": pa.array(REGIONS),
+        "r_comment": pa.array(_comments(rng, 5)),
+    })
+    nation = pa.table({
+        "n_nationkey": pa.array(np.arange(25), pa.int64()),
+        "n_name": pa.array([n for n, _ in NATIONS]),
+        "n_regionkey": pa.array(np.array([r for _, r in NATIONS]), pa.int64()),
+        "n_comment": pa.array(_comments(rng, 25)),
+    })
+    p_key = np.arange(1, n_part + 1)
+    part = pa.table({
+        "p_partkey": pa.array(p_key, pa.int64()),
+        "p_name": pa.array([" ".join(rng.choice(COLORS, 5, replace=False))
+                            for _ in range(n_part)]),
+        "p_mfgr": pa.array([f"Manufacturer#{i}" for i in rng.randint(1, 6, n_part)]),
+        "p_brand": pa.array([f"Brand#{i}{j}" for i, j in
+                             zip(rng.randint(1, 6, n_part), rng.randint(1, 6, n_part))]),
+        "p_type": pa.array([f"{TYPE_1[a]} {TYPE_2[b]} {TYPE_3[c]}" for a, b, c in
+                            zip(rng.randint(0, 6, n_part), rng.randint(0, 5, n_part),
+                                rng.randint(0, 5, n_part))]),
+        "p_size": pa.array(rng.randint(1, 51, n_part), pa.int64()),
+        "p_container": pa.array([f"{CONTAINER_1[a]} {CONTAINER_2[b]}" for a, b in
+                                 zip(rng.randint(0, 5, n_part), rng.randint(0, 8, n_part))]),
+        "p_retailprice": pa.array(np.round(900 + (p_key % 1000) / 10 * 4 + (p_key % 10), 2)),
+        "p_comment": pa.array(_comments(rng, n_part, 2, 5)),
+    })
+    supplier = pa.table({
+        "s_suppkey": pa.array(np.arange(1, n_supp + 1), pa.int64()),
+        "s_name": pa.array([f"Supplier#{i:09d}" for i in range(1, n_supp + 1)]),
+        "s_address": pa.array(_comments(rng, n_supp, 2, 4)),
+        "s_nationkey": pa.array(rng.randint(0, 25, n_supp), pa.int64()),
+        "s_phone": pa.array([f"{rng.randint(10, 35)}-{rng.randint(100, 1000)}-"
+                             f"{rng.randint(100, 1000)}-{rng.randint(1000, 10000)}"
+                             for _ in range(n_supp)]),
+        "s_acctbal": pa.array(np.round(rng.uniform(-999.99, 9999.99, n_supp), 2)),
+        "s_comment": pa.array(
+            [c + (" Customer Complaints" if rng.rand() < 0.01 else "")
+             for c in _comments(rng, n_supp)]),
+    })
+    partsupp = pa.table({
+        "ps_partkey": pa.array(np.repeat(p_key, 4), pa.int64()),
+        "ps_suppkey": pa.array((np.tile(np.arange(4), n_part)
+                                + np.repeat(p_key, 4)) % n_supp + 1, pa.int64()),
+        "ps_availqty": pa.array(rng.randint(1, 10_000, n_ps), pa.int64()),
+        "ps_supplycost": pa.array(np.round(rng.uniform(1.0, 1000.0, n_ps), 2)),
+        "ps_comment": pa.array(_comments(rng, n_ps, 2, 5)),
+    })
+    c_key = np.arange(1, n_cust + 1)
+    c_phone_cc = rng.randint(10, 35, n_cust)
+    customer = pa.table({
+        "c_custkey": pa.array(c_key, pa.int64()),
+        "c_name": pa.array([f"Customer#{i:09d}" for i in c_key]),
+        "c_address": pa.array(_comments(rng, n_cust, 2, 4)),
+        "c_nationkey": pa.array(rng.randint(0, 25, n_cust), pa.int64()),
+        "c_phone": pa.array([f"{cc}-{rng.randint(100, 1000)}-{rng.randint(100, 1000)}-"
+                             f"{rng.randint(1000, 10000)}" for cc in c_phone_cc]),
+        "c_acctbal": pa.array(np.round(rng.uniform(-999.99, 9999.99, n_cust), 2)),
+        "c_mktsegment": pa.array([SEGMENTS[i] for i in rng.randint(0, 5, n_cust)]),
+        "c_comment": pa.array(
+            [("special requests " if rng.rand() < 0.1 else "") + c
+             for c in _comments(rng, n_cust)]),
+    })
+    o_key = np.arange(1, n_ord + 1)
+    o_custkey = rng.randint(1, n_cust + 1, n_ord)
+    o_orderdate = rng.randint(_START, _END - 151, n_ord)
+    orders = pa.table({
+        "o_orderkey": pa.array(o_key, pa.int64()),
+        "o_custkey": pa.array(o_custkey, pa.int64()),
+        "o_orderstatus": pa.array([("F", "O", "P")[i] for i in rng.randint(0, 3, n_ord)]),
+        "o_totalprice": pa.array(np.round(rng.uniform(850.0, 560_000.0, n_ord), 2)),
+        "o_orderdate": pa.array(o_orderdate.astype("datetime64[D]")),
+        "o_orderpriority": pa.array([PRIORITIES[i] for i in rng.randint(0, 5, n_ord)]),
+        "o_clerk": pa.array([f"Clerk#{i:09d}" for i in rng.randint(1, max(n_ord // 1000, 2), n_ord)]),
+        "o_shippriority": pa.array(np.zeros(n_ord, np.int64)),
+        "o_comment": pa.array(_comments(rng, n_ord, 3, 7)),
+    })
+    l_orderkey = rng.randint(1, n_ord + 1, n_li)
+    l_odate = o_orderdate[l_orderkey - 1]
+    l_ship = l_odate + rng.randint(1, 122, n_li)
+    l_commit = l_odate + rng.randint(30, 91, n_li)
+    l_receipt = l_ship + rng.randint(1, 31, n_li)
+    lineitem = pa.table({
+        "l_orderkey": pa.array(l_orderkey, pa.int64()),
+        "l_partkey": pa.array(rng.randint(1, n_part + 1, n_li), pa.int64()),
+        "l_suppkey": pa.array(rng.randint(1, n_supp + 1, n_li), pa.int64()),
+        "l_linenumber": pa.array(rng.randint(1, 8, n_li), pa.int64()),
+        "l_quantity": pa.array(rng.randint(1, 51, n_li).astype(np.float64)),
+        "l_extendedprice": pa.array(np.round(rng.uniform(900.0, 105_000.0, n_li), 2)),
+        "l_discount": pa.array(rng.randint(0, 11, n_li) / 100.0),
+        "l_tax": pa.array(rng.randint(0, 9, n_li) / 100.0),
+        "l_returnflag": pa.array([("A", "N", "R")[i] for i in rng.randint(0, 3, n_li)]),
+        "l_linestatus": pa.array([("F", "O")[i] for i in rng.randint(0, 2, n_li)]),
+        "l_shipdate": pa.array(l_ship.astype("datetime64[D]")),
+        "l_commitdate": pa.array(l_commit.astype("datetime64[D]")),
+        "l_receiptdate": pa.array(l_receipt.astype("datetime64[D]")),
+        "l_shipinstruct": pa.array([INSTRUCTIONS[i] for i in rng.randint(0, 4, n_li)]),
+        "l_shipmode": pa.array([SHIPMODES[i] for i in rng.randint(0, 7, n_li)]),
+        "l_comment": pa.array(_comments(rng, n_li, 2, 5)),
+    })
+    return {"region": region, "nation": nation, "part": part, "supplier": supplier,
+            "partsupp": partsupp, "customer": customer, "orders": orders,
+            "lineitem": lineitem}
+
+
+def load_sqlite(tables: Dict[str, pa.Table]) -> sqlite3.Connection:
+    """In-memory SQLite DB with all tables (dates stored as ISO text so the
+    official query texts' date comparisons work lexicographically)."""
+    conn = sqlite3.connect(":memory:")
+    conn.execute("PRAGMA case_sensitive_like = ON")  # SQL-spec LIKE semantics
+    for name, tbl in tables.items():
+        cols = tbl.column_names
+        decls = []
+        pyrows = []
+        for c in cols:
+            t = tbl.schema.field(c).type
+            if pa.types.is_integer(t):
+                decls.append(f"{c} INTEGER")
+            elif pa.types.is_floating(t):
+                decls.append(f"{c} REAL")
+            else:
+                decls.append(f"{c} TEXT")
+        conn.execute(f"CREATE TABLE {name} ({', '.join(decls)})")
+        data = {}
+        for c in cols:
+            t = tbl.schema.field(c).type
+            col = tbl.column(c)
+            if pa.types.is_date(t) or pa.types.is_timestamp(t):
+                data[c] = [v.isoformat() if v is not None else None for v in col.to_pylist()]
+            else:
+                data[c] = col.to_pylist()
+        pyrows = list(zip(*[data[c] for c in cols]))
+        conn.executemany(
+            f"INSERT INTO {name} VALUES ({', '.join('?' * len(cols))})", pyrows)
+    conn.commit()
+    return conn

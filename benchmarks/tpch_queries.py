@@ -1,0 +1,565 @@
+"""The 22 TPC-H queries: official SQL (SQLite dialect, validation parameters)
+plus daft_tpu DataFrame implementations.
+
+Role-equivalent to the reference's benchmarking/tpch/answers.py (DataFrame
+implementations used for distributed-correctness testing) — the semantics are
+the public TPC-H specification; the DataFrame formulations below are written
+against this engine's API.
+
+Each `qN(T)` takes `T`: dict of table-name -> daft_tpu DataFrame and returns a
+DataFrame. `SQL[N]` is the same query for the SQLite oracle (dates as ISO text;
+interval arithmetic pre-computed).
+"""
+
+from __future__ import annotations
+
+import datetime
+
+from daft_tpu import col, lit
+
+d = datetime.date
+
+SQL = {
+    1: """
+SELECT l_returnflag, l_linestatus, SUM(l_quantity) AS sum_qty,
+       SUM(l_extendedprice) AS sum_base_price,
+       SUM(l_extendedprice*(1-l_discount)) AS sum_disc_price,
+       SUM(l_extendedprice*(1-l_discount)*(1+l_tax)) AS sum_charge,
+       AVG(l_quantity) AS avg_qty, AVG(l_extendedprice) AS avg_price,
+       AVG(l_discount) AS avg_disc, COUNT(*) AS count_order
+FROM lineitem WHERE l_shipdate <= '1998-09-02'
+GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus""",
+    2: """
+SELECT s_acctbal, s_name, n_name, p_partkey, p_mfgr, s_address, s_phone, s_comment
+FROM part, supplier, partsupp, nation, region
+WHERE p_partkey = ps_partkey AND s_suppkey = ps_suppkey AND p_size = 15
+  AND p_type LIKE '%BRASS' AND s_nationkey = n_nationkey
+  AND n_regionkey = r_regionkey AND r_name = 'EUROPE'
+  AND ps_supplycost = (SELECT MIN(ps_supplycost) FROM partsupp, supplier, nation, region
+                       WHERE p_partkey = ps_partkey AND s_suppkey = ps_suppkey
+                         AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+                         AND r_name = 'EUROPE')
+ORDER BY s_acctbal DESC, n_name, s_name, p_partkey LIMIT 100""",
+    3: """
+SELECT l_orderkey, SUM(l_extendedprice*(1-l_discount)) AS revenue, o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey AND l_orderkey = o_orderkey
+  AND o_orderdate < '1995-03-15' AND l_shipdate > '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate LIMIT 10""",
+    4: """
+SELECT o_orderpriority, COUNT(*) AS order_count FROM orders
+WHERE o_orderdate >= '1993-07-01' AND o_orderdate < '1993-10-01'
+  AND EXISTS (SELECT * FROM lineitem WHERE l_orderkey = o_orderkey
+              AND l_commitdate < l_receiptdate)
+GROUP BY o_orderpriority ORDER BY o_orderpriority""",
+    5: """
+SELECT n_name, SUM(l_extendedprice*(1-l_discount)) AS revenue
+FROM customer, orders, lineitem, supplier, nation, region
+WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey AND l_suppkey = s_suppkey
+  AND c_nationkey = s_nationkey AND s_nationkey = n_nationkey
+  AND n_regionkey = r_regionkey AND r_name = 'ASIA'
+  AND o_orderdate >= '1994-01-01' AND o_orderdate < '1995-01-01'
+GROUP BY n_name ORDER BY revenue DESC""",
+    6: """
+SELECT SUM(l_extendedprice*l_discount) AS revenue FROM lineitem
+WHERE l_shipdate >= '1994-01-01' AND l_shipdate < '1995-01-01'
+  AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24""",
+    7: """
+SELECT supp_nation, cust_nation, l_year, SUM(volume) AS revenue FROM (
+  SELECT n1.n_name AS supp_nation, n2.n_name AS cust_nation,
+         CAST(SUBSTR(l_shipdate, 1, 4) AS INTEGER) AS l_year,
+         l_extendedprice*(1-l_discount) AS volume
+  FROM supplier, lineitem, orders, customer, nation n1, nation n2
+  WHERE s_suppkey = l_suppkey AND o_orderkey = l_orderkey AND c_custkey = o_custkey
+    AND s_nationkey = n1.n_nationkey AND c_nationkey = n2.n_nationkey
+    AND ((n1.n_name = 'FRANCE' AND n2.n_name = 'GERMANY')
+      OR (n1.n_name = 'GERMANY' AND n2.n_name = 'FRANCE'))
+    AND l_shipdate BETWEEN '1995-01-01' AND '1996-12-31') shipping
+GROUP BY supp_nation, cust_nation, l_year
+ORDER BY supp_nation, cust_nation, l_year""",
+    8: """
+SELECT o_year, SUM(CASE WHEN nation = 'BRAZIL' THEN volume ELSE 0 END) / SUM(volume) AS mkt_share
+FROM (SELECT CAST(SUBSTR(o_orderdate, 1, 4) AS INTEGER) AS o_year,
+             l_extendedprice*(1-l_discount) AS volume, n2.n_name AS nation
+      FROM part, supplier, lineitem, orders, customer, nation n1, nation n2, region
+      WHERE p_partkey = l_partkey AND s_suppkey = l_suppkey AND l_orderkey = o_orderkey
+        AND o_custkey = c_custkey AND c_nationkey = n1.n_nationkey
+        AND n1.n_regionkey = r_regionkey AND r_name = 'AMERICA'
+        AND s_nationkey = n2.n_nationkey
+        AND o_orderdate BETWEEN '1995-01-01' AND '1996-12-31'
+        AND p_type = 'ECONOMY ANODIZED STEEL') all_nations
+GROUP BY o_year ORDER BY o_year""",
+    9: """
+SELECT nation, o_year, SUM(amount) AS sum_profit FROM (
+  SELECT n_name AS nation, CAST(SUBSTR(o_orderdate, 1, 4) AS INTEGER) AS o_year,
+         l_extendedprice*(1-l_discount) - ps_supplycost*l_quantity AS amount
+  FROM part, supplier, lineitem, partsupp, orders, nation
+  WHERE s_suppkey = l_suppkey AND ps_suppkey = l_suppkey AND ps_partkey = l_partkey
+    AND p_partkey = l_partkey AND o_orderkey = l_orderkey AND s_nationkey = n_nationkey
+    AND p_name LIKE '%green%') profit
+GROUP BY nation, o_year ORDER BY nation, o_year DESC""",
+    10: """
+SELECT c_custkey, c_name, SUM(l_extendedprice*(1-l_discount)) AS revenue,
+       c_acctbal, n_name, c_address, c_phone, c_comment
+FROM customer, orders, lineitem, nation
+WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+  AND o_orderdate >= '1993-10-01' AND o_orderdate < '1994-01-01'
+  AND l_returnflag = 'R' AND c_nationkey = n_nationkey
+GROUP BY c_custkey, c_name, c_acctbal, c_phone, n_name, c_address, c_comment
+ORDER BY revenue DESC LIMIT 20""",
+    11: """
+SELECT ps_partkey, SUM(ps_supplycost*ps_availqty) AS value
+FROM partsupp, supplier, nation
+WHERE ps_suppkey = s_suppkey AND s_nationkey = n_nationkey AND n_name = 'GERMANY'
+GROUP BY ps_partkey
+HAVING SUM(ps_supplycost*ps_availqty) > (
+  SELECT SUM(ps_supplycost*ps_availqty) * 0.0001 FROM partsupp, supplier, nation
+  WHERE ps_suppkey = s_suppkey AND s_nationkey = n_nationkey AND n_name = 'GERMANY')
+ORDER BY value DESC""",
+    12: """
+SELECT l_shipmode,
+       SUM(CASE WHEN o_orderpriority = '1-URGENT' OR o_orderpriority = '2-HIGH'
+                THEN 1 ELSE 0 END) AS high_line_count,
+       SUM(CASE WHEN o_orderpriority <> '1-URGENT' AND o_orderpriority <> '2-HIGH'
+                THEN 1 ELSE 0 END) AS low_line_count
+FROM orders, lineitem
+WHERE o_orderkey = l_orderkey AND l_shipmode IN ('MAIL', 'SHIP')
+  AND l_commitdate < l_receiptdate AND l_shipdate < l_commitdate
+  AND l_receiptdate >= '1994-01-01' AND l_receiptdate < '1995-01-01'
+GROUP BY l_shipmode ORDER BY l_shipmode""",
+    13: """
+SELECT c_count, COUNT(*) AS custdist FROM (
+  SELECT c_custkey, COUNT(o_orderkey) AS c_count FROM customer
+  LEFT OUTER JOIN orders ON c_custkey = o_custkey
+    AND o_comment NOT LIKE '%special%requests%'
+  GROUP BY c_custkey) c_orders
+GROUP BY c_count ORDER BY custdist DESC, c_count DESC""",
+    14: """
+SELECT 100.00 * SUM(CASE WHEN p_type LIKE 'PROMO%' THEN l_extendedprice*(1-l_discount)
+                         ELSE 0 END) / SUM(l_extendedprice*(1-l_discount)) AS promo_revenue
+FROM lineitem, part
+WHERE l_partkey = p_partkey AND l_shipdate >= '1995-09-01' AND l_shipdate < '1995-10-01'""",
+    15: """
+WITH revenue AS (
+  SELECT l_suppkey AS supplier_no, SUM(l_extendedprice*(1-l_discount)) AS total_revenue
+  FROM lineitem WHERE l_shipdate >= '1996-01-01' AND l_shipdate < '1996-04-01'
+  GROUP BY l_suppkey)
+SELECT s_suppkey, s_name, s_address, s_phone, total_revenue
+FROM supplier, revenue
+WHERE s_suppkey = supplier_no
+  AND total_revenue = (SELECT MAX(total_revenue) FROM revenue)
+ORDER BY s_suppkey""",
+    16: """
+SELECT p_brand, p_type, p_size, COUNT(DISTINCT ps_suppkey) AS supplier_cnt
+FROM partsupp, part
+WHERE p_partkey = ps_partkey AND p_brand <> 'Brand#45'
+  AND p_type NOT LIKE 'MEDIUM POLISHED%'
+  AND p_size IN (49, 14, 23, 45, 19, 3, 36, 9)
+  AND ps_suppkey NOT IN (SELECT s_suppkey FROM supplier
+                         WHERE s_comment LIKE '%Customer%Complaints%')
+GROUP BY p_brand, p_type, p_size
+ORDER BY supplier_cnt DESC, p_brand, p_type, p_size""",
+    17: """
+SELECT SUM(l_extendedprice) / 7.0 AS avg_yearly FROM lineitem, part
+WHERE p_partkey = l_partkey AND p_brand = 'Brand#23' AND p_container = 'MED BOX'
+  AND l_quantity < (SELECT 0.2 * AVG(l_quantity) FROM lineitem
+                    WHERE l_partkey = p_partkey)""",
+    18: """
+SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice, SUM(l_quantity)
+FROM customer, orders, lineitem
+WHERE o_orderkey IN (SELECT l_orderkey FROM lineitem GROUP BY l_orderkey
+                     HAVING SUM(l_quantity) > 300)
+  AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+ORDER BY o_totalprice DESC, o_orderdate LIMIT 100""",
+    19: """
+SELECT SUM(l_extendedprice*(1-l_discount)) AS revenue FROM lineitem, part
+WHERE (p_partkey = l_partkey AND p_brand = 'Brand#12'
+       AND p_container IN ('SM CASE', 'SM BOX', 'SM PACK', 'SM PKG')
+       AND l_quantity >= 1 AND l_quantity <= 11 AND p_size BETWEEN 1 AND 5
+       AND l_shipmode IN ('AIR', 'AIR REG') AND l_shipinstruct = 'DELIVER IN PERSON')
+   OR (p_partkey = l_partkey AND p_brand = 'Brand#23'
+       AND p_container IN ('MED BAG', 'MED BOX', 'MED PKG', 'MED PACK')
+       AND l_quantity >= 10 AND l_quantity <= 20 AND p_size BETWEEN 1 AND 10
+       AND l_shipmode IN ('AIR', 'AIR REG') AND l_shipinstruct = 'DELIVER IN PERSON')
+   OR (p_partkey = l_partkey AND p_brand = 'Brand#34'
+       AND p_container IN ('LG CASE', 'LG BOX', 'LG PACK', 'LG PKG')
+       AND l_quantity >= 20 AND l_quantity <= 30 AND p_size BETWEEN 1 AND 15
+       AND l_shipmode IN ('AIR', 'AIR REG') AND l_shipinstruct = 'DELIVER IN PERSON')""",
+    20: """
+SELECT s_name, s_address FROM supplier, nation
+WHERE s_suppkey IN (
+  SELECT ps_suppkey FROM partsupp
+  WHERE ps_partkey IN (SELECT p_partkey FROM part WHERE p_name LIKE 'forest%')
+    AND ps_availqty > (SELECT 0.5 * SUM(l_quantity) FROM lineitem
+                       WHERE l_partkey = ps_partkey AND l_suppkey = ps_suppkey
+                         AND l_shipdate >= '1994-01-01' AND l_shipdate < '1995-01-01'))
+  AND s_nationkey = n_nationkey AND n_name = 'CANADA'
+ORDER BY s_name""",
+    21: """
+SELECT s_name, COUNT(*) AS numwait FROM supplier, lineitem l1, orders, nation
+WHERE s_suppkey = l1.l_suppkey AND o_orderkey = l1.l_orderkey AND o_orderstatus = 'F'
+  AND l1.l_receiptdate > l1.l_commitdate
+  AND EXISTS (SELECT * FROM lineitem l2 WHERE l2.l_orderkey = l1.l_orderkey
+              AND l2.l_suppkey <> l1.l_suppkey)
+  AND NOT EXISTS (SELECT * FROM lineitem l3 WHERE l3.l_orderkey = l1.l_orderkey
+                  AND l3.l_suppkey <> l1.l_suppkey
+                  AND l3.l_receiptdate > l3.l_commitdate)
+  AND s_nationkey = n_nationkey AND n_name = 'SAUDI ARABIA'
+GROUP BY s_name ORDER BY numwait DESC, s_name LIMIT 100""",
+    22: """
+SELECT cntrycode, COUNT(*) AS numcust, SUM(c_acctbal) AS totacctbal FROM (
+  SELECT SUBSTR(c_phone, 1, 2) AS cntrycode, c_acctbal FROM customer
+  WHERE SUBSTR(c_phone, 1, 2) IN ('13', '31', '23', '29', '30', '18', '17')
+    AND c_acctbal > (SELECT AVG(c_acctbal) FROM customer
+                     WHERE c_acctbal > 0.00
+                       AND SUBSTR(c_phone, 1, 2) IN ('13','31','23','29','30','18','17'))
+    AND NOT EXISTS (SELECT * FROM orders WHERE o_custkey = c_custkey)) custsale
+GROUP BY cntrycode ORDER BY cntrycode""",
+}
+
+
+def _rev():
+    return col("l_extendedprice") * (1 - col("l_discount"))
+
+
+def q1(T):
+    charge = _rev() * (1 + col("l_tax"))
+    return (T["lineitem"].where(col("l_shipdate") <= d(1998, 9, 2))
+            .groupby("l_returnflag", "l_linestatus")
+            .agg(col("l_quantity").sum().alias("sum_qty"),
+                 col("l_extendedprice").sum().alias("sum_base_price"),
+                 _rev().sum().alias("sum_disc_price"),
+                 charge.sum().alias("sum_charge"),
+                 col("l_quantity").mean().alias("avg_qty"),
+                 col("l_extendedprice").mean().alias("avg_price"),
+                 col("l_discount").mean().alias("avg_disc"),
+                 col("l_quantity").count().alias("count_order"))
+            .sort(["l_returnflag", "l_linestatus"]))
+
+
+def _europe_suppliers(T):
+    return (T["supplier"]
+            .join(T["nation"], left_on="s_nationkey", right_on="n_nationkey")
+            .join(T["region"].where(col("r_name") == "EUROPE"),
+                  left_on="n_regionkey", right_on="r_regionkey"))
+
+
+def q2(T):
+    sup = _europe_suppliers(T)
+    ps = T["partsupp"].join(sup, left_on="ps_suppkey", right_on="s_suppkey")
+    mins = (ps.groupby("ps_partkey")
+            .agg(col("ps_supplycost").min().alias("min_cost")))
+    parts = T["part"].where((col("p_size") == 15) & col("p_type").str.endswith("BRASS"))
+    out = (parts.join(ps, left_on="p_partkey", right_on="ps_partkey")
+           .join(mins, left_on="p_partkey", right_on="ps_partkey")
+           .where(col("ps_supplycost") == col("min_cost"))
+           .select("s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr",
+                   "s_address", "s_phone", "s_comment"))
+    return out.sort(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                    desc=[True, False, False, False]).limit(100)
+
+
+def q3(T):
+    cust = T["customer"].where(col("c_mktsegment") == "BUILDING")
+    orders = T["orders"].where(col("o_orderdate") < d(1995, 3, 15))
+    li = T["lineitem"].where(col("l_shipdate") > d(1995, 3, 15))
+    return (cust.join(orders, left_on="c_custkey", right_on="o_custkey")
+            .join(li, left_on="o_orderkey", right_on="l_orderkey")
+            .groupby("o_orderkey", "o_orderdate", "o_shippriority")
+            .agg(_rev().sum().alias("revenue"))
+            .select(col("o_orderkey").alias("l_orderkey"), col("revenue"),
+                    col("o_orderdate"), col("o_shippriority"))
+            .sort(["revenue", "o_orderdate"], desc=[True, False]).limit(10))
+
+
+def q4(T):
+    orders = T["orders"].where((col("o_orderdate") >= d(1993, 7, 1))
+                               & (col("o_orderdate") < d(1993, 10, 1)))
+    late = T["lineitem"].where(col("l_commitdate") < col("l_receiptdate"))
+    return (orders.join(late, left_on="o_orderkey", right_on="l_orderkey", how="semi")
+            .groupby("o_orderpriority")
+            .agg(col("o_orderpriority").count().alias("order_count"))
+            .sort("o_orderpriority"))
+
+
+def q5(T):
+    return (T["customer"]
+            .join(T["orders"].where((col("o_orderdate") >= d(1994, 1, 1))
+                                    & (col("o_orderdate") < d(1995, 1, 1))),
+                  left_on="c_custkey", right_on="o_custkey")
+            .join(T["lineitem"], left_on="o_orderkey", right_on="l_orderkey")
+            .join(T["supplier"], left_on=["l_suppkey", "c_nationkey"],
+                  right_on=["s_suppkey", "s_nationkey"])
+            .join(T["nation"], left_on="c_nationkey", right_on="n_nationkey")
+            .join(T["region"].where(col("r_name") == "ASIA"),
+                  left_on="n_regionkey", right_on="r_regionkey")
+            .groupby("n_name").agg(_rev().sum().alias("revenue"))
+            .sort("revenue", desc=True))
+
+
+def q6(T):
+    return (T["lineitem"]
+            .where((col("l_shipdate") >= d(1994, 1, 1)) & (col("l_shipdate") < d(1995, 1, 1))
+                   & (col("l_discount") >= 0.05) & (col("l_discount") <= 0.07)
+                   & (col("l_quantity") < 24))
+            .agg((col("l_extendedprice") * col("l_discount")).sum().alias("revenue")))
+
+
+def q7(T):
+    n1 = T["nation"].select(col("n_nationkey").alias("n1_key"), col("n_name").alias("supp_nation"))
+    n2 = T["nation"].select(col("n_nationkey").alias("n2_key"), col("n_name").alias("cust_nation"))
+    li = T["lineitem"].where((col("l_shipdate") >= d(1995, 1, 1))
+                             & (col("l_shipdate") <= d(1996, 12, 31)))
+    df = (T["supplier"].join(li, left_on="s_suppkey", right_on="l_suppkey")
+          .join(T["orders"], left_on="l_orderkey", right_on="o_orderkey")
+          .join(T["customer"], left_on="o_custkey", right_on="c_custkey")
+          .join(n1, left_on="s_nationkey", right_on="n1_key")
+          .join(n2, left_on="c_nationkey", right_on="n2_key")
+          .where(((col("supp_nation") == "FRANCE") & (col("cust_nation") == "GERMANY"))
+                 | ((col("supp_nation") == "GERMANY") & (col("cust_nation") == "FRANCE"))))
+    return (df.with_column("l_year", col("l_shipdate").dt.year())
+            .groupby("supp_nation", "cust_nation", "l_year")
+            .agg(_rev().sum().alias("revenue"))
+            .sort(["supp_nation", "cust_nation", "l_year"]))
+
+
+def q8(T):
+    n1 = T["nation"].select(col("n_nationkey").alias("n1_key"), col("n_regionkey").alias("n1_region"))
+    n2 = T["nation"].select(col("n_nationkey").alias("n2_key"), col("n_name").alias("nation"))
+    df = (T["part"].where(col("p_type") == "ECONOMY ANODIZED STEEL")
+          .join(T["lineitem"], left_on="p_partkey", right_on="l_partkey")
+          .join(T["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+          .join(T["orders"].where((col("o_orderdate") >= d(1995, 1, 1))
+                                  & (col("o_orderdate") <= d(1996, 12, 31))),
+                left_on="l_orderkey", right_on="o_orderkey")
+          .join(T["customer"], left_on="o_custkey", right_on="c_custkey")
+          .join(n1, left_on="c_nationkey", right_on="n1_key")
+          .join(T["region"].where(col("r_name") == "AMERICA"),
+                left_on="n1_region", right_on="r_regionkey")
+          .join(n2, left_on="s_nationkey", right_on="n2_key"))
+    df = (df.with_column("o_year", col("o_orderdate").dt.year())
+          .with_column("volume", _rev())
+          .with_column("brazil", (col("nation") == "BRAZIL")
+                       .if_else(col("volume"), lit(0.0))))
+    return (df.groupby("o_year")
+            .agg(col("brazil").sum().alias("nb"), col("volume").sum().alias("vol"))
+            .select(col("o_year"), (col("nb") / col("vol")).alias("mkt_share"))
+            .sort("o_year"))
+
+
+def q9(T):
+    df = (T["part"].where(col("p_name").str.contains("green"))
+          .join(T["lineitem"], left_on="p_partkey", right_on="l_partkey")
+          .join(T["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+          .join(T["partsupp"], left_on=["l_suppkey", "p_partkey"],
+                right_on=["ps_suppkey", "ps_partkey"])
+          .join(T["orders"], left_on="l_orderkey", right_on="o_orderkey")
+          .join(T["nation"], left_on="s_nationkey", right_on="n_nationkey"))
+    amount = _rev() - col("ps_supplycost") * col("l_quantity")
+    return (df.with_column("o_year", col("o_orderdate").dt.year())
+            .with_column("amount", amount)
+            .groupby(col("n_name").alias("nation"), col("o_year"))
+            .agg(col("amount").sum().alias("sum_profit"))
+            .sort(["nation", "o_year"], desc=[False, True]))
+
+
+def q10(T):
+    return (T["customer"]
+            .join(T["orders"].where((col("o_orderdate") >= d(1993, 10, 1))
+                                    & (col("o_orderdate") < d(1994, 1, 1))),
+                  left_on="c_custkey", right_on="o_custkey")
+            .join(T["lineitem"].where(col("l_returnflag") == "R"),
+                  left_on="o_orderkey", right_on="l_orderkey")
+            .join(T["nation"], left_on="c_nationkey", right_on="n_nationkey")
+            .groupby("c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+                     "c_address", "c_comment")
+            .agg(_rev().sum().alias("revenue"))
+            .select("c_custkey", "c_name", "revenue", "c_acctbal", "n_name",
+                    "c_address", "c_phone", "c_comment")
+            .sort("revenue", desc=True).limit(20))
+
+
+def q11(T):
+    german = (T["partsupp"]
+              .join(T["supplier"], left_on="ps_suppkey", right_on="s_suppkey")
+              .join(T["nation"].where(col("n_name") == "GERMANY"),
+                    left_on="s_nationkey", right_on="n_nationkey")
+              .with_column("value", col("ps_supplycost") * col("ps_availqty")))
+    total = german.agg(col("value").sum().alias("total")).to_pydict()["total"][0]
+    if total is None:  # no German suppliers: HAVING > NULL selects nothing
+        total = float("inf")
+    return (german.groupby("ps_partkey").agg(col("value").sum().alias("value"))
+            .where(col("value") > total * 0.0001)
+            .sort("value", desc=True))
+
+
+def q12(T):
+    hi = col("o_orderpriority").is_in(["1-URGENT", "2-HIGH"])
+    return (T["orders"]
+            .join(T["lineitem"]
+                  .where(col("l_shipmode").is_in(["MAIL", "SHIP"])
+                         & (col("l_commitdate") < col("l_receiptdate"))
+                         & (col("l_shipdate") < col("l_commitdate"))
+                         & (col("l_receiptdate") >= d(1994, 1, 1))
+                         & (col("l_receiptdate") < d(1995, 1, 1))),
+                  left_on="o_orderkey", right_on="l_orderkey")
+            .with_column("high", hi.if_else(lit(1), lit(0)))
+            .with_column("low", hi.if_else(lit(0), lit(1)))
+            .groupby("l_shipmode")
+            .agg(col("high").sum().alias("high_line_count"),
+                 col("low").sum().alias("low_line_count"))
+            .sort("l_shipmode"))
+
+
+def q13(T):
+    orders = T["orders"].where(~(col("o_comment").str.match(".*special.*requests.*")))
+    counts = (T["customer"]
+              .join(orders, left_on="c_custkey", right_on="o_custkey", how="left")
+              .groupby("c_custkey")
+              .agg(col("o_orderkey").count().alias("c_count")))
+    return (counts.groupby("c_count").agg(col("c_count").count().alias("custdist"))
+            .sort(["custdist", "c_count"], desc=[True, True]))
+
+
+def q14(T):
+    df = (T["lineitem"].where((col("l_shipdate") >= d(1995, 9, 1))
+                              & (col("l_shipdate") < d(1995, 10, 1)))
+          .join(T["part"], left_on="l_partkey", right_on="p_partkey")
+          .with_column("rev", _rev())
+          .with_column("promo", col("p_type").str.startswith("PROMO")
+                       .if_else(col("rev"), lit(0.0))))
+    return df.agg(col("promo").sum().alias("p"), col("rev").sum().alias("r")) \
+             .select((lit(100.0) * col("p") / col("r")).alias("promo_revenue"))
+
+
+def q15(T):
+    rev = (T["lineitem"].where((col("l_shipdate") >= d(1996, 1, 1))
+                               & (col("l_shipdate") < d(1996, 4, 1)))
+           .groupby(col("l_suppkey").alias("supplier_no"))
+           .agg(_rev().sum().alias("total_revenue")))
+    top = rev.agg(col("total_revenue").max().alias("m")).to_pydict()["m"][0]
+    return (T["supplier"].join(rev.where(col("total_revenue") == top),
+                               left_on="s_suppkey", right_on="supplier_no")
+            .select("s_suppkey", "s_name", "s_address", "s_phone", "total_revenue")
+            .sort("s_suppkey"))
+
+
+def q16(T):
+    bad_supp = T["supplier"].where(col("s_comment").str.match(".*Customer.*Complaints.*"))
+    parts = T["part"].where((col("p_brand") != "Brand#45")
+                            & ~col("p_type").str.startswith("MEDIUM POLISHED")
+                            & col("p_size").is_in([49, 14, 23, 45, 19, 3, 36, 9]))
+    ps = (T["partsupp"]
+          .join(bad_supp, left_on="ps_suppkey", right_on="s_suppkey", how="anti")
+          .join(parts, left_on="ps_partkey", right_on="p_partkey"))
+    return (ps.groupby("p_brand", "p_type", "p_size")
+            .agg(col("ps_suppkey").count_distinct().alias("supplier_cnt"))
+            .sort(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                  desc=[True, False, False, False]))
+
+
+def q17(T):
+    parts = T["part"].where((col("p_brand") == "Brand#23")
+                            & (col("p_container") == "MED BOX"))
+    li = T["lineitem"].join(parts, left_on="l_partkey", right_on="p_partkey")
+    avg_qty = (T["lineitem"].groupby("l_partkey")
+               .agg(col("l_quantity").mean().alias("aq"))
+               .select(col("l_partkey").alias("ap"), col("aq")))
+    return (li.join(avg_qty, left_on="l_partkey", right_on="ap")
+            .where(col("l_quantity") < 0.2 * col("aq"))
+            .agg((col("l_extendedprice").sum() / 7.0).alias("avg_yearly")))
+
+
+def q18(T):
+    big = (T["lineitem"].groupby("l_orderkey")
+           .agg(col("l_quantity").sum().alias("oq"))
+           .where(col("oq") > 300))
+    return (T["customer"]
+            .join(T["orders"], left_on="c_custkey", right_on="o_custkey")
+            .join(big.select(col("l_orderkey").alias("bk")),
+                  left_on="o_orderkey", right_on="bk", how="semi")
+            .join(T["lineitem"], left_on="o_orderkey", right_on="l_orderkey")
+            .groupby("c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice")
+            .agg(col("l_quantity").sum().alias("sum_qty"))
+            .sort(["o_totalprice", "o_orderdate"], desc=[True, False]).limit(100))
+
+
+def q19(T):
+    df = T["lineitem"].join(T["part"], left_on="l_partkey", right_on="p_partkey")
+    common = (col("l_shipmode").is_in(["AIR", "AIR REG"])
+              & (col("l_shipinstruct") == "DELIVER IN PERSON"))
+    b1 = ((col("p_brand") == "Brand#12")
+          & col("p_container").is_in(["SM CASE", "SM BOX", "SM PACK", "SM PKG"])
+          & (col("l_quantity") >= 1) & (col("l_quantity") <= 11)
+          & (col("p_size") >= 1) & (col("p_size") <= 5))
+    b2 = ((col("p_brand") == "Brand#23")
+          & col("p_container").is_in(["MED BAG", "MED BOX", "MED PKG", "MED PACK"])
+          & (col("l_quantity") >= 10) & (col("l_quantity") <= 20)
+          & (col("p_size") >= 1) & (col("p_size") <= 10))
+    b3 = ((col("p_brand") == "Brand#34")
+          & col("p_container").is_in(["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+          & (col("l_quantity") >= 20) & (col("l_quantity") <= 30)
+          & (col("p_size") >= 1) & (col("p_size") <= 15))
+    return df.where(common & (b1 | b2 | b3)).agg(_rev().sum().alias("revenue"))
+
+
+def q20(T):
+    forest = T["part"].where(col("p_name").str.startswith("forest"))
+    shipped = (T["lineitem"].where((col("l_shipdate") >= d(1994, 1, 1))
+                                   & (col("l_shipdate") < d(1995, 1, 1)))
+               .groupby("l_partkey", "l_suppkey")
+               .agg(col("l_quantity").sum().alias("sq")))
+    eligible = (T["partsupp"]
+                .join(forest, left_on="ps_partkey", right_on="p_partkey", how="semi")
+                .join(shipped, left_on=["ps_partkey", "ps_suppkey"],
+                      right_on=["l_partkey", "l_suppkey"])
+                .where(col("ps_availqty") > 0.5 * col("sq")))
+    return (T["supplier"]
+            .join(eligible.select(col("ps_suppkey").alias("ek")),
+                  left_on="s_suppkey", right_on="ek", how="semi")
+            .join(T["nation"].where(col("n_name") == "CANADA"),
+                  left_on="s_nationkey", right_on="n_nationkey")
+            .select("s_name", "s_address").sort("s_name"))
+
+
+def q21(T):
+    li = T["lineitem"]
+    late = li.where(col("l_receiptdate") > col("l_commitdate"))
+    # orders with >1 distinct supplier / with >1 distinct LATE supplier
+    multi = (li.groupby("l_orderkey")
+             .agg(col("l_suppkey").count_distinct().alias("ns")))
+    late_multi = (late.groupby("l_orderkey")
+                  .agg(col("l_suppkey").count_distinct().alias("nls")))
+    df = (late.join(T["orders"].where(col("o_orderstatus") == "F"),
+                    left_on="l_orderkey", right_on="o_orderkey")
+          .join(multi.where(col("ns") > 1).select(col("l_orderkey").alias("mk")),
+                left_on="l_orderkey", right_on="mk", how="semi")
+          .join(late_multi.select(col("l_orderkey").alias("lk"), col("nls")),
+                left_on="l_orderkey", right_on="lk")
+          .where(col("nls") == 1)  # this supplier is the ONLY late one
+          .join(T["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+          .join(T["nation"].where(col("n_name") == "SAUDI ARABIA"),
+                left_on="s_nationkey", right_on="n_nationkey"))
+    return (df.groupby("s_name").agg(col("s_name").count().alias("numwait"))
+            .sort(["numwait", "s_name"], desc=[True, False]).limit(100))
+
+
+def q22(T):
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cust = (T["customer"]
+            .with_column("cntrycode", col("c_phone").str.left(2))
+            .where(col("cntrycode").is_in(codes)))
+    avg_bal = (cust.where(col("c_acctbal") > 0.0)
+               .agg(col("c_acctbal").mean().alias("a")).to_pydict()["a"][0])
+    return (cust.where(col("c_acctbal") > avg_bal)
+            .join(T["orders"].select(col("o_custkey").alias("ok")),
+                  left_on="c_custkey", right_on="ok", how="anti")
+            .groupby("cntrycode")
+            .agg(col("c_acctbal").count().alias("numcust"),
+                 col("c_acctbal").sum().alias("totacctbal"))
+            .sort("cntrycode"))
+
+
+QUERIES = {i: globals()[f"q{i}"] for i in range(1, 23)}

@@ -280,9 +280,19 @@ def _utf8_substr(s: Series, start: Series, length: Optional[Series] = None) -> S
     return Series.from_arrow(pc.utf8_slice_codeunits(s.to_arrow(), st, stop), s.name, DataType.string())
 
 
-register("utf8.left", _req_string, _utf8_left)
-register("utf8.right", _req_string, _utf8_right)
-register("utf8.substr", _req_string, _utf8_substr)
+def _req_string_int_args(*arg_dtypes, **_kw):
+    """First arg string; remaining args integer (slice offsets/lengths)."""
+    if not (arg_dtypes[0].is_string() or arg_dtypes[0].is_null()):
+        raise ValueError(f"expected string input, got {arg_dtypes[0]}")
+    for dt in arg_dtypes[1:]:
+        if not (dt.is_integer() or dt.is_null()):
+            raise ValueError(f"expected integer argument, got {dt}")
+    return DataType.string()
+
+
+register("utf8.left", _req_string_int_args, _utf8_left)
+register("utf8.right", _req_string_int_args, _utf8_right)
+register("utf8.substr", _req_string_int_args, _utf8_substr)
 
 
 def _utf8_concat(*series: Series) -> Series:
@@ -349,9 +359,20 @@ def _utf8_repeat(s: Series, n: Series) -> Series:
     return Series.from_arrow(pc.binary_repeat(s.to_arrow(), nn), s.name, DataType.string())
 
 
-register("utf8.rpad", _req_string, _utf8_rpad)
-register("utf8.lpad", _req_string, _utf8_lpad)
-register("utf8.repeat", _req_string, _utf8_repeat)
+def _req_pad_args(*arg_dtypes, **_kw):
+    """string input, integer length, string pad char."""
+    if not (arg_dtypes[0].is_string() or arg_dtypes[0].is_null()):
+        raise ValueError(f"expected string input, got {arg_dtypes[0]}")
+    if len(arg_dtypes) > 1 and not (arg_dtypes[1].is_integer() or arg_dtypes[1].is_null()):
+        raise ValueError(f"expected integer pad length, got {arg_dtypes[1]}")
+    if len(arg_dtypes) > 2 and not (arg_dtypes[2].is_string() or arg_dtypes[2].is_null()):
+        raise ValueError(f"expected string pad character, got {arg_dtypes[2]}")
+    return DataType.string()
+
+
+register("utf8.rpad", _req_pad_args, _utf8_rpad)
+register("utf8.lpad", _req_pad_args, _utf8_lpad)
+register("utf8.repeat", _req_string_int_args, _utf8_repeat)
 
 
 def _utf8_count_matches(s: Series, patterns: Series, whole_words: bool = False,

@@ -1,0 +1,75 @@
+"""TPC-H Q1-Q22 correctness vs the SQLite oracle (reference strategy:
+tests/integration/test_tpch.py diffing against sqlite answers, parametrized
+over partition counts so shuffles are exercised)."""
+
+import datetime
+
+import pytest
+
+import daft_tpu as dt
+from benchmarks import tpch_full, tpch_queries
+
+SCALE = 0.002
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch_full.generate(scale=SCALE, seed=7)
+
+
+@pytest.fixture(scope="module")
+def oracle(data):
+    conn = tpch_full.load_sqlite(data)
+    yield conn
+    conn.close()
+
+
+def _norm(v):
+    if isinstance(v, float):
+        return round(v, 2)
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()[:10]
+    return v
+
+
+def _rows(cols_dict):
+    names = list(cols_dict)
+    return [tuple(_norm(v) for v in row) for row in zip(*cols_dict.values())], names
+
+
+def _sqlite_rows(conn, sql):
+    cur = conn.execute(sql)
+    return [tuple(_norm(v) for v in r) for r in cur.fetchall()]
+
+
+def _assert_match(got_rows, want_rows, qn):
+    def key(r):
+        return tuple((x is None, repr(type(x)), x if x is not None else 0) for x in r)
+
+    g, w = sorted(got_rows, key=key), sorted(want_rows, key=key)
+    assert len(g) == len(w), f"Q{qn}: {len(g)} rows vs oracle {len(w)}"
+    for i, (a, b) in enumerate(zip(g, w)):
+        assert len(a) == len(b), f"Q{qn} row {i}: arity {len(a)} vs {len(b)}"
+        for x, y in zip(a, b):
+            if isinstance(x, float) or isinstance(y, float):
+                xx = float(x) if x is not None else None
+                yy = float(y) if y is not None else None
+                assert xx is not None and yy is not None and \
+                    abs(xx - yy) <= max(1e-6 * abs(yy), 0.011), f"Q{qn} row {i}: {a} vs {b}"
+            else:
+                assert x == y, f"Q{qn} row {i}: {a} vs {b}"
+
+
+@pytest.mark.parametrize("num_parts", [1, 3])
+@pytest.mark.parametrize("qn", sorted(tpch_queries.QUERIES))
+def test_tpch_query(qn, num_parts, data, oracle):
+    T = {}
+    for name, tbl in data.items():
+        df = dt.from_arrow(tbl)
+        if num_parts > 1 and name in ("lineitem", "orders", "customer", "partsupp"):
+            df = df.into_partitions(num_parts)
+        T[name] = df
+    got = tpch_queries.QUERIES[qn](T).to_pydict()
+    got_rows, _ = _rows(got)
+    want_rows = _sqlite_rows(oracle, tpch_queries.SQL[qn])
+    _assert_match(got_rows, want_rows, qn)
