@@ -34,3 +34,11 @@ except ModuleNotFoundError as _e:  # only tolerate api.py itself being absent (b
 # Registers the image.* / url.* kernels (SQL and Function("image.decode")-style
 # callers need them even before any expression namespace property is touched).
 from . import multimodal  # noqa: E402,F401
+
+# The sql SUBMODULE shares its name with the sql() entry point: importing the
+# submodule (api.sql does it lazily) rebinds the package attribute to the
+# module, breaking daft_tpu.sql("SELECT ..."). Import the submodule eagerly,
+# then pin the attribute back to the function — later submodule imports no
+# longer touch the package attribute.
+from . import sql as _sql_module  # noqa: E402,F401
+from .api import sql  # noqa: E402,F401

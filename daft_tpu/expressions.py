@@ -238,9 +238,13 @@ class BinaryOp(ExprNode):
         op = self.op
         nm = self.name()
         if op in _CMP_OPS:
+            # ISO strings compare against temporal columns by parsing (SQL
+            # semantics: WHERE l_shipdate <= '1998-09-02')
+            str_vs_temporal = (lf.dtype.is_temporal() and rf.dtype.is_string()) or (
+                rf.dtype.is_temporal() and lf.dtype.is_string())
             if try_unify(lf.dtype, rf.dtype) is None and not (
                 lf.dtype.is_temporal() and rf.dtype.is_temporal()
-            ):
+            ) and not str_vs_temporal:
                 raise ValueError(f"cannot compare {lf.dtype} with {rf.dtype}")
             return Field(nm, DataType.bool())
         if op in _LOGIC_OPS:

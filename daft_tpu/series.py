@@ -354,6 +354,13 @@ class Series:
         other._require_arrow("comparison")
         l, r = self, other
         if l._arrow.type != r._arrow.type:
+            # ISO-string side of a temporal comparison parses to the temporal
+            # type (SQL semantics: date_col <= '1998-09-02')
+            if l._dtype.is_temporal() and r._dtype.is_string():
+                r = r.cast(l._dtype)
+            elif r._dtype.is_temporal() and l._dtype.is_string():
+                l = l.cast(r._dtype)
+        if l._arrow.type != r._arrow.type:
             sup = try_unify(l._dtype, r._dtype)
             if sup is None:
                 raise ValueError(f"cannot compare {l._dtype} with {r._dtype}")
