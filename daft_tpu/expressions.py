@@ -238,13 +238,31 @@ class BinaryOp(ExprNode):
         op = self.op
         nm = self.name()
         if op in _CMP_OPS:
-            # ISO strings compare against temporal columns by parsing (SQL
-            # semantics: WHERE l_shipdate <= '1998-09-02')
+            # A string *literal* compares against a temporal column by parsing
+            # at plan time (SQL semantics: WHERE l_shipdate <= '1998-09-02').
+            # String columns vs temporal columns are rejected, matching the
+            # reference which only coerces literals (src/daft-dsl/resolve_expr.rs).
             str_vs_temporal = (lf.dtype.is_temporal() and rf.dtype.is_string()) or (
                 rf.dtype.is_temporal() and lf.dtype.is_string())
+            if str_vs_temporal:
+                str_node = self.right if rf.dtype.is_string() else self.left
+                temporal_dt = lf.dtype if lf.dtype.is_temporal() else rf.dtype
+                litv = _unwrap_string_literal(str_node)
+                if litv is None:
+                    raise ValueError(
+                        f"cannot compare {lf.dtype} with {rf.dtype}: only string "
+                        f"literals coerce to temporal types")
+                try:
+                    import pyarrow as pa
+                    pa.scalar(litv).cast(temporal_dt.to_arrow())
+                except Exception as e:
+                    raise ValueError(
+                        f"string literal {litv!r} does not parse as {temporal_dt}: {e}"
+                    ) from e
+                return Field(nm, DataType.bool())
             if try_unify(lf.dtype, rf.dtype) is None and not (
                 lf.dtype.is_temporal() and rf.dtype.is_temporal()
-            ) and not str_vs_temporal:
+            ):
                 raise ValueError(f"cannot compare {lf.dtype} with {rf.dtype}")
             return Field(nm, DataType.bool())
         if op in _LOGIC_OPS:
@@ -313,6 +331,17 @@ class BinaryOp(ExprNode):
 
     def display(self) -> str:
         return f"({self.left.display()} {self.op} {self.right.display()})"
+
+
+def _unwrap_string_literal(node: "ExprNode"):
+    """Return the python string value if node is (an alias or string-cast of)
+    a string Literal, else None. Gates SQL-style string→temporal coercion."""
+    while isinstance(node, Alias) or (
+            isinstance(node, Cast) and node.dtype.is_string()):
+        node = node.child
+    if isinstance(node, Literal) and isinstance(node.value, str):
+        return node.value
+    return None
 
 
 def _temporal_arith_type(op: str, l: DataType, r: DataType) -> DataType:

@@ -147,10 +147,44 @@ def read_sql_arrow(sql: str, conn: Union[str, Callable[[], Any]],
         cur = connection.cursor()
         cur.execute(sql, params or ())
         names = [d[0] for d in cur.description]
+        descr = list(cur.description)
         rows = cur.fetchall()
     finally:
         if close_after:
             connection.close()
     cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
-    return pa.table(cols) if rows else pa.table(
-        {n: pa.array([], pa.null()) for n in names})
+    if rows:
+        return pa.table(cols)
+    # zero rows: recover column types from the DB-API description for drivers
+    # that expose type codes (psycopg, mysql connectors, ...). sqlite3 never
+    # fills description[1:] (only the name is set), so empty sqlite results
+    # are unavoidably null-typed — documented limitation.
+    return pa.table({d[0]: pa.array([], _dbapi_arrow_type(d)) for d in descr})
+
+
+# longest-match-first: DATETIME/TIMESTAMP must win over the DATE substring
+_SQL_TYPENAME_TO_ARROW = [
+    ("DATETIME", pa.timestamp("us")), ("TIMESTAMP", pa.timestamp("us")),
+    ("SMALLINT", pa.int64()), ("TINYINT", pa.int64()), ("BIGINT", pa.int64()),
+    ("INTEGER", pa.int64()), ("INT", pa.int64()),
+    ("VARBINARY", pa.binary()), ("BINARY", pa.binary()), ("BLOB", pa.binary()),
+    ("VARCHAR", pa.string()), ("CHAR", pa.string()), ("TEXT", pa.string()),
+    ("CLOB", pa.string()), ("STRING", pa.string()),
+    ("DOUBLE", pa.float64()), ("FLOAT", pa.float64()), ("REAL", pa.float64()),
+    ("NUMERIC", pa.float64()), ("DECIMAL", pa.float64()),
+    ("BOOLEAN", pa.bool_()), ("BOOL", pa.bool_()),
+    ("DATE", pa.date32()),
+]
+
+
+def _dbapi_arrow_type(descr_entry) -> pa.DataType:
+    """Best-effort arrow type from a DB-API cursor.description entry's type
+    code. Returns null for drivers that report no code (sqlite3)."""
+    code = descr_entry[1] if len(descr_entry) > 1 else None
+    if code is None:
+        return pa.null()
+    name = str(code).upper()
+    for decl, at in _SQL_TYPENAME_TO_ARROW:
+        if decl in name:
+            return at
+    return pa.null()

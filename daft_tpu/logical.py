@@ -142,8 +142,14 @@ class InMemorySource(LogicalPlan):
     Reference: logical_ops/source.rs InMemoryInfo."""
 
     def __init__(self, schema: Schema, partitions: List[Any]):
+        import uuid
+
         self.schema = schema
         self.partitions = partitions
+        # Unique data-identity token for the result cache. id(partitions) is
+        # unsound — CPython reuses ids after GC (a later frame with identical
+        # plan structure would hit a stale entry); uuids are never reused.
+        self._cache_token = uuid.uuid4().hex
 
     def with_children(self, children):
         assert not children

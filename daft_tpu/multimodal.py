@@ -301,7 +301,12 @@ def _resize_fixed_device(s: Series, w: int, h: int) -> Series:
     arr = s.to_arrow()
     n = len(arr)
     per = oh * ow * c
-    flat = np.asarray(arr.values.to_numpy(zero_copy_only=False)).astype(npdt, copy=False)
+    flat = np.asarray(arr.values.to_numpy(zero_copy_only=False))
+    if flat.dtype.kind == "f" and not np.issubdtype(npdt, np.floating):
+        # null rows surface as NaN in the float view; NaN→uint cast is UB and
+        # warns — zero the lanes (they're masked out by validity downstream)
+        flat = np.nan_to_num(flat, nan=0.0, posinf=0.0, neginf=0.0)
+    flat = flat.astype(npdt, copy=False)
     flat = flat[arr.offset * per:(arr.offset + n) * per]
     batch = flat.reshape(n, oh, ow, c).astype(np.float32)
     resized = jax.image.resize(jnp.asarray(batch), (n, h, w, c), method="bilinear")

@@ -121,6 +121,19 @@ def plan_cache_key(plan: LogicalPlan) -> Optional[str]:
 _SCALARS = (str, int, float, bool, bytes, type(None))
 
 
+def _file_fingerprint(path: str) -> str:
+    """mtime+size fingerprint so an overwritten file invalidates cached scan
+    results (the reference re-executes scans per query; we must not serve
+    stale bytes). Non-stat-able paths (object stores, http) are uncacheable."""
+    import os
+
+    try:
+        st = os.stat(path)
+    except OSError:
+        raise _Uncacheable from None
+    return f"{st.st_mtime_ns}:{st.st_size}"
+
+
 def _plan_key(p: LogicalPlan) -> str:
     from .expressions import Expression
     from .logical import InMemorySource, Sample, ScanSource, Write
@@ -130,11 +143,16 @@ def _plan_key(p: LogicalPlan) -> str:
     if isinstance(p, Sample) and getattr(p, "seed", None) is None:
         raise _Uncacheable
     if isinstance(p, InMemorySource):
-        # identity of the materialized partition list IS the data identity
-        return f"mem#{id(p.partitions)}"
+        # per-object uuid assigned at source creation — unlike id(), never
+        # reused after the source is GC'd (advisor: stale-hit repro)
+        tok = getattr(p, "_cache_token", None)
+        if tok is None:
+            raise _Uncacheable
+        return f"mem#{tok}"
     if isinstance(p, ScanSource):
         return "scan#" + ";".join(
-            f"{t.path}|{t.format}|{t.pushdowns!r}|{t.row_group_ids}|{t.partition_values}"
+            f"{t.path}|{_file_fingerprint(t.path)}|{t.format}|{t.pushdowns!r}"
+            f"|{t.row_group_ids}|{t.partition_values}"
             for t in p.tasks)
     items = []
     for k, v in sorted(vars(p).items()):

@@ -8,6 +8,7 @@ device-eligible pipelines through the jax kernel layer (kernels/device.py) inste
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
@@ -39,7 +40,7 @@ def _as_expressions(exprs) -> List[Expression]:
 
 
 class Table:
-    __slots__ = ("schema", "_columns", "_eval_memo", "_memo_depth")
+    __slots__ = ("schema", "_columns", "_memo_by_thread")
 
     def __init__(self, schema: Schema, columns: List[Series]):
         if len(schema) != len(columns):
@@ -50,26 +51,35 @@ class Table:
                 raise ValueError(f"column {f.name!r} length {len(c)} != {n}")
         self.schema = schema
         self._columns = columns
-        # cache of evaluated subexpressions, active only inside _memo_scope
-        # (tables are immutable, so hits are always sound; the scope bounds
-        # the lifetime of the cached column-sized intermediates)
-        self._eval_memo: Optional[Dict[Tuple, Series]] = None
-        self._memo_depth = 0
+        # per-thread cache of evaluated subexpressions, active only inside
+        # _memo_scope (tables are immutable, so hits are always sound; the
+        # scope bounds the lifetime of the cached column-sized intermediates).
+        # Keyed by thread ident: the same Table may be evaluated concurrently
+        # from different worker threads (shared InMemorySource partitions) and
+        # the depth counter must not race across them.
+        self._memo_by_thread: Dict[int, list] = {}
+
+    @property
+    def _eval_memo(self) -> Optional[Dict[Tuple, Series]]:
+        state = self._memo_by_thread.get(threading.get_ident())
+        return state[0] if state is not None else None
 
     @contextmanager
     def _memo_scope(self):
         """Share structurally-identical subexpression results across the
         evaluates of one logical pass; dropped when the outermost scope
         exits so intermediates are not pinned for the table's lifetime."""
-        if self._memo_depth == 0:
-            self._eval_memo = {}
-        self._memo_depth += 1
+        tid = threading.get_ident()
+        state = self._memo_by_thread.get(tid)
+        if state is None:
+            state = self._memo_by_thread[tid] = [{}, 0]
+        state[1] += 1
         try:
             yield
         finally:
-            self._memo_depth -= 1
-            if self._memo_depth == 0:
-                self._eval_memo = None
+            state[1] -= 1
+            if state[1] == 0:
+                self._memo_by_thread.pop(tid, None)
 
     # ------------------------------------------------------------------ ctors
     @staticmethod

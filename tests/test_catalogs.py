@@ -213,3 +213,26 @@ class TestReviewFixes:
         ]])
         out = dt.read_deltalake(root).where(col("p") == "y").limit(3).to_pydict()
         assert out["v"] == [100, 101, 102]
+
+    def test_no_stale_hit_after_gc_id_reuse(self):
+        # advisor repro: id(partitions) reuse after GC served wrong results.
+        # Distinct data through structurally-identical plans must never alias.
+        import gc
+
+        for i in range(30):
+            vals = [i * 10, i * 10 + 1, i * 10 + 2]
+            out = dt.from_pydict({"x": vals}).select((col("x") * 2).alias("y")).collect()
+            assert out.to_pydict() == {"y": [v * 2 for v in vals]}, f"iter {i}"
+            del out
+            gc.collect()
+
+    def test_scan_cache_invalidated_on_overwrite(self, tmp_path):
+        p = os.path.join(str(tmp_path), "f.parquet")
+        papq.write_table(pa.table({"a": [1, 2]}), p)
+        df1 = dt.read_parquet(p).collect()
+        assert df1.to_pydict() == {"a": [1, 2]}
+        papq.write_table(pa.table({"a": [9, 9, 9]}), p)
+        os.utime(p, ns=(1, 1))  # force distinct mtime even on coarse clocks
+        df2 = dt.read_parquet(p).collect()
+        assert df2.to_pydict() == {"a": [9, 9, 9]}
+        del df1, df2
