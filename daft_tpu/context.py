@@ -57,6 +57,14 @@ class ExecutionConfig:
     # device kernel layer (kernels/device.py); host pyarrow path otherwise.
     use_device_kernels: bool = False
     device_min_rows: int = 4096
+    # result cache (PartitionSetCache): off when benchmarking so repeated runs
+    # measure execution, not cache lookups
+    enable_result_cache: bool = True
+    # With x64 off (real TPUs are 32-bit), allow float64 data to execute as
+    # float32 on device. Sums stay accurate: per-partition partials are
+    # combined in float64 on the host. Set False to force exact float64
+    # expressions onto the host path.
+    device_reduced_precision: bool = True
 
 
 def resolve_executor_threads(cfg: "ExecutionConfig") -> int:

@@ -404,7 +404,8 @@ class DataFrame:
             from .runners import partition_set_cache, plan_cache_key
 
             cache = partition_set_cache()
-            key = plan_cache_key(self._plan)
+            key = (plan_cache_key(self._plan)
+                   if get_context().execution_config.enable_result_cache else None)
             hit = cache.get(key) if key is not None else None
             if hit is not None:
                 self.stats.bump("result_cache_hits")

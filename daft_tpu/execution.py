@@ -96,14 +96,19 @@ class ExecutionContext:
             self._pool.shutdown(wait=False)
             self._pool = None
 
+    def _device_eligible(self, part: MicroPartition) -> bool:
+        return (self.cfg.use_device_kernels
+                and (part.num_rows_or_none() or 0) >= self.cfg.device_min_rows)
+
     def eval_projection(self, part: MicroPartition, exprs) -> MicroPartition:
         """Route a projection through the device kernel layer when eligible,
         else the host path."""
-        if self.cfg.use_device_kernels and (part.num_rows_or_none() or 0) >= self.cfg.device_min_rows:
+        if self._device_eligible(part):
             try:
                 from .kernels.device import eval_projection_device
 
-                out = eval_projection_device(part.table(), list(exprs))
+                out = eval_projection_device(part.table(), list(exprs),
+                                             stage_cache=part.device_stage_cache())
             except Exception:
                 out = None
             if out is not None:
@@ -111,6 +116,48 @@ class ExecutionContext:
                 return MicroPartition.from_table(out)
         self.stats.bump("host_projections")
         return part.eval_expression_list(exprs)
+
+    def eval_agg(self, part: MicroPartition, aggregations, groupby,
+                 predicate=None) -> MicroPartition:
+        """Route a (optionally filter-fused) grouped aggregation through the
+        fused device kernel when eligible, else the host path (host applies
+        the predicate first when one was fused)."""
+        if self._device_eligible(part):
+            try:
+                from .kernels.device_agg import device_grouped_agg
+
+                out = device_grouped_agg(part.table(), list(aggregations),
+                                         list(groupby or []),
+                                         stage_cache=part.device_stage_cache(),
+                                         predicate=predicate)
+            except Exception:
+                out = None
+            if out is not None:
+                self.stats.bump("device_aggregations")
+                return MicroPartition.from_table(out)
+        self.stats.bump("host_aggregations")
+        if predicate is not None:
+            part = part.filter([predicate])
+        return part.agg(aggregations, groupby or None)
+
+    def eval_filter(self, part: MicroPartition, predicate) -> MicroPartition:
+        """Filter a partition: when eligible, the predicate mask is computed on
+        device and only the compaction happens on host."""
+        if self._device_eligible(part):
+            try:
+                from .kernels.device import eval_projection_device
+
+                out = eval_projection_device(part.table(), [predicate],
+                                             stage_cache=part.device_stage_cache())
+            except Exception:
+                out = None
+            if out is not None:
+                self.stats.bump("device_filters")
+                mask = out._columns[0]
+                return MicroPartition.from_table(
+                    part.table().filter_with_mask(mask))
+        self.stats.bump("host_filters")
+        return part.filter([predicate])
 
 
 def execute_plan(root: PhysicalOp, ctx: ExecutionContext,

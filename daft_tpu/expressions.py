@@ -139,6 +139,13 @@ class Literal(ExprNode):
             raise ValueError("lit() of an Expression; pass a plain value")
         self.value = value
         self.dtype = dtype or infer_datatype(value)
+        # A plain python int/float with no declared dtype is *weak-typed*
+        # (jax-style): in a binary context it adopts the other operand's
+        # dtype when the value fits, so `col_f32 * 2` stays float32 instead
+        # of promoting through int64 to float64 — which would knock the
+        # expression off the 32-bit device path on real TPUs (x64 off).
+        self.weak = dtype is None and isinstance(value, (int, float)) \
+            and not isinstance(value, bool)
 
     def name(self) -> str:
         return "literal"
@@ -154,7 +161,10 @@ class Literal(ExprNode):
         v = self.value
         if isinstance(v, (list, dict)):
             v = repr(v)
-        return ("lit", v, self.dtype)
+        # `weak` is typing-relevant: a weak lit(2) and a strong lit(2, int64)
+        # evaluate to different dtypes in binary contexts, so they must not
+        # alias in the eval memo / plan cache
+        return ("lit", v, self.dtype, self.weak)
 
     def display(self) -> str:
         return f"lit({self.value!r})"
@@ -235,6 +245,8 @@ class BinaryOp(ExprNode):
     def to_field(self, schema: Schema) -> Field:
         lf = self.left.to_field(schema)
         rf = self.right.to_field(schema)
+        _, _, ldt, rdt = effective_operands(self.left, self.right, lf.dtype, rf.dtype)
+        lf, rf = Field(lf.name, ldt), Field(rf.name, rdt)
         op = self.op
         nm = self.name()
         if op in _CMP_OPS:
@@ -305,6 +317,12 @@ class BinaryOp(ExprNode):
     def _eval(self, table) -> Series:
         l = self.left.evaluate(table)
         r = self.right.evaluate(table)
+        # weak-literal adoption must mirror to_field so planner and kernel agree
+        _, _, ldt, rdt = effective_operands(self.left, self.right, l.dtype, r.dtype)
+        if ldt != l.dtype:
+            l = l.cast(ldt)
+        if rdt != r.dtype:
+            r = r.cast(rdt)
         fn = {
             "+": lambda a, b: a + b, "-": lambda a, b: a - b, "*": lambda a, b: a * b,
             "/": lambda a, b: a / b, "//": lambda a, b: a // b, "%": lambda a, b: a % b,
@@ -342,6 +360,115 @@ def _unwrap_string_literal(node: "ExprNode"):
     if isinstance(node, Literal) and isinstance(node.value, str):
         return node.value
     return None
+
+
+def _weak_literal_node(node: "ExprNode") -> Optional[Literal]:
+    """Unwrap aliases; return the Literal if weak-typed, else None."""
+    while isinstance(node, Alias):
+        node = node.child
+    if isinstance(node, Literal) and getattr(node, "weak", False):
+        return node
+    return None
+
+
+_INT_KIND_RANGE = {
+    TypeKind.INT8: (-128, 127), TypeKind.INT16: (-32768, 32767),
+    TypeKind.INT32: (-2**31, 2**31 - 1), TypeKind.INT64: (-2**63, 2**63 - 1),
+    TypeKind.UINT8: (0, 255), TypeKind.UINT16: (0, 65535),
+    TypeKind.UINT32: (0, 2**32 - 1), TypeKind.UINT64: (0, 2**64 - 1),
+}
+
+
+def adopt_weak_literal_dtype(value, other: DataType) -> Optional[DataType]:
+    """jax-style weak typing: the dtype a plain int/float literal should take
+    next to an operand of dtype `other`, or None when normal supertype
+    promotion applies. int literals adopt any numeric dtype they fit; float
+    literals adopt float dtypes (a float literal next to an int column still
+    promotes to float64 like the host kernels do)."""
+    if not other.is_numeric():
+        return None
+    if isinstance(value, float):
+        return other if other.is_floating() else None
+    if other.is_floating():
+        return other
+    rng = _INT_KIND_RANGE.get(other.kind)
+    if rng is not None and rng[0] <= value <= rng[1]:
+        return other
+    return None
+
+
+def effective_operands(left: "ExprNode", right: "ExprNode",
+                       ldt: DataType, rdt: DataType):
+    """Apply weak-literal adoption to one binary context. Returns
+    (left_node, right_node, ldt, rdt) where an adopted literal is rewritten to
+    a strong Literal of the adopted dtype. Shared by the host planner
+    (BinaryOp.to_field), the host kernel (BinaryOp._eval) and the device
+    compiler (kernels/device.py) so all three agree on result types."""
+    lw, rw = _weak_literal_node(left), _weak_literal_node(right)
+    if lw is not None and rw is None:
+        ad = adopt_weak_literal_dtype(lw.value, rdt)
+        if ad is not None and ad != ldt:
+            return Literal(lw.value, ad), right, ad, rdt
+    elif rw is not None and lw is None:
+        ad = adopt_weak_literal_dtype(rw.value, ldt)
+        if ad is not None and ad != rdt:
+            return left, Literal(rw.value, ad), ldt, ad
+    return left, right, ldt, rdt
+
+
+def normalize_literals(node: "ExprNode", schema) -> "ExprNode":
+    """Rewrite context-dependent literals throughout a tree into strong
+    literals: weak int/float literals adopt their sibling operand's dtype and
+    string literals next to temporal operands are parsed to temporal literals.
+    The device compiler (kernels/device.py) runs this first so every Literal
+    carries the concrete dtype it executes at."""
+    kids = node.children()
+    if kids:
+        new_kids = [normalize_literals(c, schema) for c in kids]
+        if any(n is not o for n, o in zip(new_kids, kids)):
+            node = node.with_children(new_kids)
+    if isinstance(node, BinaryOp):
+        l, r = effective_binop_children(node.left, node.right, schema)
+        if l is not node.left or r is not node.right:
+            node = BinaryOp(node.op, l, r)
+    elif isinstance(node, Between):
+        _, lo = effective_binop_children(node.child, node.lower, schema)
+        _, hi = effective_binop_children(node.child, node.upper, schema)
+        if lo is not node.lower or hi is not node.upper:
+            node = Between(node.child, lo, hi)
+    elif isinstance(node, FillNull):
+        _, fill = effective_binop_children(node.child, node.fill, schema)
+        if fill is not node.fill:
+            node = FillNull(node.child, fill)
+    elif isinstance(node, IfElse):
+        t, f = effective_binop_children(node.if_true, node.if_false, schema)
+        if t is not node.if_true or f is not node.if_false:
+            node = IfElse(node.pred, t, f)
+    return node
+
+
+def effective_binop_children(left: "ExprNode", right: "ExprNode", schema):
+    """Resolve context-dependent literals for one BinaryOp against `schema`:
+    weak int/float literals adopt the other operand's dtype, and a string
+    literal next to a temporal column is parsed to a temporal literal at plan
+    time. Used by the device compiler so the staged expression tree carries
+    concrete device dtypes."""
+    import pyarrow as _pa
+
+    ldt = left.to_field(schema).dtype
+    rdt = right.to_field(schema).dtype
+    if ldt.is_temporal() and rdt.is_string():
+        v = _unwrap_string_literal(right)
+        if v is not None:
+            parsed = _pa.scalar(v).cast(ldt.to_arrow()).as_py()
+            return left, Literal(parsed, ldt)
+    if rdt.is_temporal() and ldt.is_string():
+        v = _unwrap_string_literal(left)
+        if v is not None:
+            parsed = _pa.scalar(v).cast(rdt.to_arrow()).as_py()
+            return Literal(parsed, rdt), right
+    l2, r2, _, _ = effective_operands(left, right, ldt, rdt)
+    return l2, r2
 
 
 def _temporal_arith_type(op: str, l: DataType, r: DataType) -> DataType:
@@ -448,7 +575,8 @@ class FillNull(ExprNode):
     def to_field(self, schema):
         f = self.child.to_field(schema)
         g = self.fill.to_field(schema)
-        u = try_unify(f.dtype, g.dtype)
+        _, _, cdt, fdt = effective_operands(self.child, self.fill, f.dtype, g.dtype)
+        u = try_unify(cdt, fdt)
         if u is None:
             raise ValueError(f"fill_null type mismatch: {f.dtype} vs {g.dtype}")
         return Field(f.name, u)
@@ -523,6 +651,14 @@ class Between(ExprNode):
         s = self.child.evaluate(table)
         lo = self.lower.evaluate(table)
         hi = self.upper.evaluate(table)
+        # weak-literal bounds adopt the tested expression's dtype, mirroring
+        # normalize_literals so host and device agree on comparison precision
+        _, _, _, lodt = effective_operands(self.child, self.lower, s.dtype, lo.dtype)
+        _, _, _, hidt = effective_operands(self.child, self.upper, s.dtype, hi.dtype)
+        if lodt != lo.dtype:
+            lo = lo.cast(lodt)
+        if hidt != hi.dtype:
+            hi = hi.cast(hidt)
         return s.between(lo, hi).rename(self.name())
 
     def children(self):
@@ -553,15 +689,21 @@ class IfElse(ExprNode):
             raise ValueError(f"if_else predicate must be bool, got {p.dtype}")
         t = self.if_true.to_field(schema)
         f = self.if_false.to_field(schema)
-        u = try_unify(t.dtype, f.dtype)
+        _, _, tdt, fdt = effective_operands(self.if_true, self.if_false, t.dtype, f.dtype)
+        u = try_unify(tdt, fdt)
         if u is None:
             raise ValueError(f"if_else branches incompatible: {t.dtype} vs {f.dtype}")
         return Field(t.name, u)
 
     def _eval(self, table):
+        out_dt = self.to_field(table.schema).dtype
         p = self.pred.evaluate(table)
         t = self.if_true.evaluate(table)
         f = self.if_false.evaluate(table)
+        if t.dtype != out_dt:
+            t = t.cast(out_dt)
+        if f.dtype != out_dt:
+            f = f.cast(out_dt)
         return p.if_else(t, f).rename(self.name())
 
     def children(self):

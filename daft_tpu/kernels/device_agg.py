@@ -1,0 +1,289 @@
+"""Fused device groupby-aggregation.
+
+ONE jitted program per plan shape evaluates every aggregation input projection
+and its masked segment reduction on device, with an optional fused filter
+predicate that stays a mask (no host compaction) — the TPU analog of the
+reference's fused streaming pipeline (src/daft-local-execution/src/pipeline.rs:141-211
+and the grouped-agg sinks in src/daft-table/src/ops/agg.rs).
+
+Division of labor (SURVEY §7): the host does the O(groups) bookkeeping —
+dictionary-encoded group codes via Table._group_codes — and the VPU does the
+O(rows) work: projections fused into masked `segment_sum/min/max` reductions
+with static segment counts (padded to a power of two so XLA compiles once per
+bucket, not once per cardinality).
+
+32-bit mode (real TPUs, x64 off): float64 inputs compute as float32; per-call
+partials return to the host which combines across partitions in float64, so
+multi-partition totals keep ~1e-7 relative accuracy. Integer sums narrow to
+int32 and are overflow-guarded: the kernel also returns max|v| and the masked
+row count, and the host re-runs that aggregate on the host path if
+n * max|v| could exceed int32 (rare; correctness over speed).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..datatypes import DataType
+from .device import (
+    compile_projection,
+    segment_reduce,
+    size_bucket,
+    stage_table_columns,
+    x64_enabled,
+)
+
+# agg kinds with a device segment reduction. mean decomposes to sum+count.
+_DEVICE_AGG_KINDS = {"sum", "count", "min", "max", "mean"}
+
+_AGG_CACHE: Dict = {}
+
+
+def _unwrap(expr):
+    from ..expressions import AggExpr, Alias
+
+    node = expr._node
+    while isinstance(node, Alias):
+        node = node.child
+    return node if isinstance(node, AggExpr) else None
+
+
+def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = None,
+                       predicate=None):
+    """Fused grouped aggregation for one partition on device.
+
+    `to_agg`: aggregation Expressions (kinds sum/count/min/max/mean);
+    `group_by`: key Expressions (evaluated on host — keys may be strings);
+    `predicate`: optional filter Expression fused as a device-side mask.
+
+    Returns a host Table (keys + aggregates, first-occurrence group order,
+    matching the host path) or None when ineligible.
+    """
+    from ..expressions import required_columns
+    from ..schema import Field, Schema
+    from ..table import Table, _group_codes
+
+    n = len(table)
+    if n == 0:
+        return None
+    schema = table.schema
+
+    from .device import normalize_and_check
+
+    # --- plan the aggregate list -----------------------------------------
+    specs = []  # (alias, kind, AggExpr node, count_mode)
+    child_exprs = []
+    for e in to_agg:
+        node = _unwrap(e)
+        if node is None or node.kind not in _DEVICE_AGG_KINDS:
+            return None
+        if node.kind == "count" and node.extra.get("mode", "valid") not in (
+                "valid", "all", "null"):
+            return None
+        specs.append((e.name(), node.kind, node, node.extra.get("mode", "valid")))
+        child_exprs.append(_ExprView(node.child))
+    child_nodes = normalize_and_check(child_exprs, schema)
+    if child_nodes is None:
+        return None
+
+    pred_nodes = None
+    if predicate is not None:
+        pred_nodes = normalize_and_check([predicate], schema)
+        if pred_nodes is None:
+            return None
+
+    # --- host bookkeeping: group codes (cached with the partition — the
+    # dictionary encode over string keys is the dominant per-query host cost
+    # on resident data) ----------------------------------------------------
+    b = size_bucket(n)
+    codes_key = ("groupcodes", tuple(e._node._key() for e in group_by), b)
+    cached = stage_cache.get(codes_key) if stage_cache is not None else None
+    if cached is None:
+        if group_by:
+            key_tbl = table.eval_expression_list(list(group_by))
+            codes_np, uniq = _group_codes(key_tbl)
+            num_groups = len(uniq)
+        else:
+            codes_np = np.zeros(n, dtype=np.int64)
+            uniq = None
+            num_groups = 1
+        codes_dev = jnp.asarray(np.pad(codes_np.astype(np.int32), (0, b - n)))
+        cached = (codes_dev, uniq, num_groups)
+        if stage_cache is not None:
+            stage_cache[codes_key] = cached
+    codes_dev, uniq, num_groups = cached
+    gb = max(16, 1 << (num_groups - 1).bit_length())  # static segment bucket
+
+    # --- stage inputs -----------------------------------------------------
+    needed = set()
+    for nd in child_nodes:
+        needed.update(required_columns(nd))
+    if pred_nodes is not None:
+        needed.update(required_columns(pred_nodes[0]))
+    env = stage_table_columns(table, sorted(needed), b, stage_cache)
+    if env is None:
+        return None
+
+    # --- compile + run ONE fused program ---------------------------------
+    kinds = tuple(s[1] for s in specs)
+    modes = tuple(s[3] for s in specs)
+    run = _compile_agg(tuple(child_nodes), pred_nodes[0] if pred_nodes else None,
+                       schema, tuple(sorted(needed)), kinds, modes, gb)
+    outs = run(env, codes_dev, jnp.int32(n))
+    outs = jax.device_get(outs)
+
+    # --- assemble host result --------------------------------------------
+    from ..series import Series
+
+    out_cols: List[Series] = list(uniq._columns) if uniq is not None else []
+    out_fields: List[Field] = list(uniq.schema) if uniq is not None else []
+    agg_outs = outs[:len(specs)]
+    for (alias, kind, agg_node, _mode), out in zip(specs, agg_outs):
+        expected_dt = agg_node.to_field(schema).dtype
+        merged = _finish_agg(kind, out, num_groups, expected_dt, n)
+        if merged is None:
+            return None  # overflow guard tripped: host path recomputes
+        out_cols.append(merged.rename(alias))
+        out_fields.append(Field(alias, expected_dt))
+    result = Table(Schema(out_fields), out_cols)
+    if pred_nodes is not None:
+        # prune filtered-away groups; order survivors like the host path
+        # (first occurrence within the filtered rows)
+        sel_cnt, first_idx = (np.asarray(a)[:num_groups] for a in outs[-1])
+        if group_by:
+            surv = np.nonzero(sel_cnt > 0)[0]
+            order = surv[np.argsort(first_idx[surv], kind="stable")]
+            if len(order) != num_groups or (order != np.arange(num_groups)).any():
+                import pyarrow as pa
+
+                result = result.take(Series.from_arrow(
+                    pa.array(order.astype(np.uint64)), "idx"))
+    return result
+
+
+class _ExprView:
+    """Minimal Expression-shaped wrapper so helper APIs taking Expressions
+    can accept bare nodes."""
+
+    __slots__ = ("_node",)
+
+    def __init__(self, node):
+        self._node = node
+
+    def name(self):
+        return self._node.name()
+
+
+def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb):
+    key = (tuple(n._key() for n in child_nodes),
+           pred_node._key() if pred_node is not None else None,
+           tuple((f.name, f.dtype) for f in schema), input_names, kinds, modes,
+           gb, x64_enabled())
+    if key in _AGG_CACHE:
+        return _AGG_CACHE[key]
+
+    child_run, _ = compile_projection(list(child_nodes), schema, input_names)
+    pred_run = None
+    if pred_node is not None:
+        pred_run, _ = compile_projection([pred_node], schema, input_names)
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=())
+    def run(env, codes, n):
+        inbounds = jnp.arange(codes.shape[0], dtype=jnp.int32) < n
+        if pred_run is not None:
+            (pv, pm), = pred_run(env)
+            sel = pv & pm & inbounds  # invalid predicate rows filter out (SQL WHERE)
+        else:
+            sel = inbounds
+        outs = []
+        for (v, m), kind, mode in zip(child_run(env), kinds, modes):
+            m = m & sel
+            if kind == "count":
+                if mode == "all":
+                    contrib = sel
+                elif mode == "null":
+                    contrib = sel & ~m
+                else:
+                    contrib = m
+                cnt, _ = segment_reduce(contrib, contrib, codes, gb, "count")
+                outs.append(cnt)
+                continue
+            if kind in ("sum", "mean"):
+                # accumulate in the widest same-class dtype (int8 inputs must
+                # not sum in int8)
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    acc = v.astype(jnp.float64 if x64_enabled() else jnp.float32)
+                elif v.dtype == jnp.bool_:
+                    acc = v.astype(jnp.int64 if x64_enabled() else jnp.int32)
+                elif jnp.issubdtype(v.dtype, jnp.unsignedinteger):
+                    acc = v.astype(jnp.uint64 if x64_enabled() else jnp.uint32)
+                else:
+                    acc = v.astype(jnp.int64 if x64_enabled() else jnp.int32)
+                vals, valid = segment_reduce(acc, m, codes, gb, "sum")
+                cnt, _ = segment_reduce(m, m, codes, gb, "count")
+                if jnp.issubdtype(acc.dtype, jnp.integer) and not x64_enabled():
+                    # overflow guard operands: masked max|v| for the host check
+                    absv = jnp.where(m, jnp.abs(v.astype(jnp.float32)), 0.0)
+                    outs.append((vals, valid, cnt, jnp.max(absv)))
+                else:
+                    outs.append((vals, valid, cnt, jnp.float32(0)))
+                continue
+            # min / max
+            vals, valid = segment_reduce(v, m, codes, gb, kind)
+            outs.append((vals, valid))
+        if pred_run is not None:
+            # group-survival data: codes/uniq were built from the UNFILTERED
+            # table, so the host must drop groups with no selected rows and
+            # reorder survivors by first selected row (host semantics:
+            # first-occurrence order of the filtered table)
+            sel_cnt, _ = segment_reduce(sel, sel, codes, gb, "count")
+            idx = jnp.arange(codes.shape[0], dtype=jnp.int32)
+            first_idx, _ = segment_reduce(idx, sel, codes, gb, "min")
+            outs.append((sel_cnt, first_idx))
+        return outs
+
+    _AGG_CACHE[key] = run
+    return run
+
+
+def _finish_agg(kind, out, num_groups, expected_dt: DataType, n):
+    """Device partials -> host Series of the expected dtype (or None when the
+    int32 overflow guard fired and the host must recompute)."""
+    import pyarrow as pa
+
+    from ..series import Series
+    from .device import DeviceColumn, unstage
+
+    if kind == "count":
+        vals = np.asarray(out)[:num_groups]
+        return Series.from_arrow(pa.array(vals.astype(np.uint64)), "o", expected_dt)
+    if kind in ("sum", "mean"):
+        vals, valid, cnt, max_abs = out
+        vals = np.asarray(vals)
+        valid = np.asarray(valid)
+        if np.issubdtype(vals.dtype, np.integer) and not x64_enabled():
+            # guards BOTH sum and mean — a wrapped int32 sum poisons either
+            if float(n) * float(max_abs) >= 2**31 - 1:
+                return None  # could have wrapped: recompute on host
+        if kind == "mean":
+            cnt = np.asarray(cnt)[:num_groups]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                mv = vals[:num_groups].astype(np.float64) / cnt.astype(np.float64)
+            arr = pa.array(mv, pa.float64())
+            if not valid[:num_groups].all():
+                arr = pa.compute.if_else(pa.array(valid[:num_groups]), arr,
+                                         pa.nulls(num_groups, pa.float64()))
+            return Series.from_arrow(arr, "o", expected_dt)
+        dc = DeviceColumn(vals, valid, num_groups, expected_dt)
+        return unstage(dc)
+    # min / max
+    vals, valid = out
+    dc = DeviceColumn(np.asarray(vals), np.asarray(valid), num_groups, expected_dt)
+    return unstage(dc)

@@ -19,7 +19,8 @@ from .table import Table
 
 
 class MicroPartition:
-    __slots__ = ("schema", "_state", "_tables", "_scan_task", "_stats", "_lock")
+    __slots__ = ("schema", "_state", "_tables", "_scan_task", "_stats", "_lock",
+                 "_device_cache")
 
     def __init__(self, schema: Schema, tables: Optional[List[Table]] = None,
                  scan_task=None, stats: Optional[TableStats] = None):
@@ -31,6 +32,14 @@ class MicroPartition:
         self._state = "loaded" if tables is not None else "unloaded"
         self._stats = stats
         self._lock = threading.Lock()
+        # HBM residency: staged DeviceColumns keyed by (col, bucket, x64 mode).
+        # The host->device link, not compute, bounds device-path throughput, so
+        # repeated queries over a cached/collected partition reuse staged
+        # columns instead of re-transferring (lifetime == partition lifetime).
+        self._device_cache: Dict[Any, Any] = {}
+
+    def device_stage_cache(self) -> Dict[Any, Any]:
+        return self._device_cache
 
     # ------------------------------------------------------------------ ctors
     @staticmethod

@@ -161,7 +161,7 @@ class FilterOp(PhysicalOp):
         self.predicate = predicate
 
     def map_partition(self, part, ctx):
-        return part.filter([self.predicate])
+        return ctx.eval_filter(part, self.predicate)
 
     def _map_exprs(self):
         return (self.predicate,)
@@ -416,7 +416,7 @@ class AggregateOp(PhysicalOp):
         self.groupby = groupby
 
     def map_partition(self, part, ctx):
-        return part.agg(self.aggregations, self.groupby or None)
+        return ctx.eval_agg(part, self.aggregations, self.groupby or None)
 
     def map_empty(self, ctx):
         # global agg over zero partitions still yields one row (count=0 etc.)
@@ -433,6 +433,42 @@ class AggregateOp(PhysicalOp):
         a = ", ".join(e._node.display() for e in self.aggregations)
         g = ", ".join(e._node.display() for e in self.groupby)
         return f"Aggregate: {a}" + (f" by [{g}]" if g else "")
+
+
+class FusedFilterAggOp(PhysicalOp):
+    """Filter fused into a grouped aggregation: on the device path the
+    predicate stays a mask feeding masked segment reductions — no host
+    compaction or intermediate materialization (the TPU analog of the
+    reference's fused streaming pipeline, pipeline.rs:141-211). The host
+    fallback applies filter-then-agg per partition."""
+
+    def __init__(self, child: PhysicalOp, predicate: Expression,
+                 aggregations: List[Expression], groupby: List[Expression],
+                 schema: Schema):
+        super().__init__([child], schema, child.num_partitions)
+        self.predicate = predicate
+        self.aggregations = aggregations
+        self.groupby = groupby
+
+    def map_partition(self, part, ctx):
+        return ctx.eval_agg(part, self.aggregations, self.groupby or None,
+                            predicate=self.predicate)
+
+    def map_empty(self, ctx):
+        if not self.groupby:
+            yield MicroPartition.empty(self.children[0].schema).agg(self.aggregations, None)
+
+    def _map_exprs(self):
+        return [self.predicate] + list(self.aggregations) + list(self.groupby)
+
+    def execute(self, inputs, ctx) -> PartStream:
+        return self._map_execute(inputs, ctx)
+
+    def describe(self):
+        a = ", ".join(e._node.display() for e in self.aggregations)
+        g = ", ".join(e._node.display() for e in self.groupby)
+        return (f"FusedFilterAggregate: where {self.predicate._node.display()} agg {a}"
+                + (f" by [{g}]" if g else ""))
 
 
 class GatherOp(PhysicalOp):
@@ -707,7 +743,46 @@ def _split_morsels(parts: List[MicroPartition], cfg) -> List[MicroPartition]:
     return out
 
 
+def fuse_for_device(op: PhysicalOp, cfg) -> PhysicalOp:
+    """Post-translation fusion for the device path: Aggregate directly over a
+    Filter becomes FusedFilterAggOp so the predicate runs as a device-side
+    mask feeding the segment reductions (no host compaction between them).
+    No-op unless device kernels are enabled — the host path keeps the simpler
+    two-op pipeline."""
+    if not getattr(cfg, "use_device_kernels", False):
+        return op
+    for i, c in enumerate(op.children):
+        op.children[i] = fuse_for_device(c, cfg)
+    if isinstance(op, AggregateOp):
+        child = op.children[0]
+        # see through the column-pruning Project the optimizer inserts after
+        # a filter (pure selection, no renames/compute): the agg only touches
+        # its own referenced columns, so skipping the prune is semantics-free
+        if isinstance(child, ProjectOp) and _is_pure_column_selection(child.exprs):
+            child = child.children[0]
+        if isinstance(child, FilterOp):
+            return FusedFilterAggOp(child.children[0], child.predicate,
+                                    op.aggregations, op.groupby, op.schema)
+    return op
+
+
+def _is_pure_column_selection(exprs) -> bool:
+    from .expressions import Column as ColNode
+
+    for e in exprs:
+        n = e._node
+        if not (isinstance(n, ColNode) and n.cname == e.name()):
+            return False
+    return True
+
+
 def translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:
+    """Public entry: recursive translation + device-path fusion, so every
+    caller (runners, explain, adaptive) sees the tree that actually runs."""
+    return fuse_for_device(_translate(plan, cfg, morsels), cfg)
+
+
+def _translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:
     """Translate an (optimized) logical plan to a physical operator tree.
 
     cfg: ExecutionConfig (broadcast threshold, default partitions, etc.)
@@ -725,41 +800,41 @@ def translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:
         return ScanOp(plan.tasks, plan.schema)
 
     if isinstance(plan, Project):
-        return ProjectOp(translate(plan.input, cfg, morsels), plan.exprs, plan.schema)
+        return ProjectOp(_translate(plan.input, cfg, morsels), plan.exprs, plan.schema)
 
     if isinstance(plan, Filter):
-        return FilterOp(translate(plan.input, cfg, morsels), plan.predicate)
+        return FilterOp(_translate(plan.input, cfg, morsels), plan.predicate)
 
     if isinstance(plan, Limit):
-        return LimitOp(translate(plan.input, cfg), plan.limit)
+        return LimitOp(_translate(plan.input, cfg), plan.limit)
 
     if isinstance(plan, Explode):
-        return ExplodeOp(translate(plan.input, cfg), plan.to_explode, plan.schema)
+        return ExplodeOp(_translate(plan.input, cfg), plan.to_explode, plan.schema)
 
     if isinstance(plan, Unpivot):
-        return UnpivotOp(translate(plan.input, cfg), plan.ids, plan.values,
+        return UnpivotOp(_translate(plan.input, cfg), plan.ids, plan.values,
                          plan.variable_name, plan.value_name, plan.schema)
 
     if isinstance(plan, Sample):
-        return SampleOp(translate(plan.input, cfg), plan.fraction,
+        return SampleOp(_translate(plan.input, cfg), plan.fraction,
                         plan.with_replacement, plan.seed)
 
     if isinstance(plan, MonotonicallyIncreasingId):
-        return MonotonicIdOp(translate(plan.input, cfg), plan.column_name, plan.schema)
+        return MonotonicIdOp(_translate(plan.input, cfg), plan.column_name, plan.schema)
 
     if isinstance(plan, Write):
-        return WriteOp(translate(plan.input, cfg), plan.root_dir, plan.format,
+        return WriteOp(_translate(plan.input, cfg), plan.root_dir, plan.format,
                        plan.compression, plan.partition_cols, plan.schema)
 
     if isinstance(plan, Sort):
-        child = translate(plan.input, cfg)
+        child = _translate(plan.input, cfg)
         if child.num_partitions > 1:
             child = ShuffleOp(child, "range", child.num_partitions, plan.sort_by,
                               plan.descending, plan.nulls_first)
         return SortOp(child, plan.sort_by, plan.descending, plan.nulls_first)
 
     if isinstance(plan, Repartition):
-        child = translate(plan.input, cfg)
+        child = _translate(plan.input, cfg)
         num = plan.num if plan.num is not None else child.num_partitions
         if plan.scheme == "into":
             if num == child.num_partitions:
@@ -772,7 +847,7 @@ def translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:
         return ShuffleOp(child, "random", num)
 
     if isinstance(plan, Distinct):
-        child = translate(plan.input, cfg)
+        child = _translate(plan.input, cfg)
         subset = plan.subset
         out = DistinctOp(child, subset)
         if child.num_partitions > 1:
@@ -784,13 +859,13 @@ def translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:
         return _translate_aggregate(plan, cfg)
 
     if isinstance(plan, Pivot):
-        child = translate(plan.input, cfg)
+        child = _translate(plan.input, cfg)
         return PivotOp(child, plan.groupby, plan.pivot_col, plan.value_col,
                        plan.agg_fn, plan.names, plan.schema)
 
     if isinstance(plan, Concat):
-        l = translate(plan.input, cfg)
-        r = translate(plan.other, cfg)
+        l = _translate(plan.input, cfg)
+        r = _translate(plan.other, cfg)
         return ConcatOp(l, r, plan.schema)
 
     if isinstance(plan, Join):
@@ -800,7 +875,7 @@ def translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:
 
 
 def _translate_aggregate(plan: Aggregate, cfg) -> PhysicalOp:
-    child = translate(plan.input, cfg, morsels=True)
+    child = _translate(plan.input, cfg, morsels=True)
     nparts = child.num_partitions
 
     if nparts == 1:
@@ -864,8 +939,8 @@ def _cast_to(op: PhysicalOp, schema: Schema) -> PhysicalOp:
 
 
 def _translate_join(plan: Join, cfg) -> PhysicalOp:
-    left = translate(plan.left, cfg)
-    right = translate(plan.right, cfg)
+    left = _translate(plan.left, cfg)
+    right = _translate(plan.right, cfg)
 
     if plan.how == "cross":
         return CrossJoinOp(left, right, plan.schema, plan.suffix)

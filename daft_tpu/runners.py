@@ -206,9 +206,12 @@ class Runner:
         raise NotImplementedError
 
     def optimize_and_translate(self, plan: LogicalPlan, optimized: bool = False):
+        from .physical import fuse_for_device
+
         ctx = get_context()
         opt = plan if optimized else optimize(plan)
         phys = translate(opt, ctx.execution_config)
+        phys = fuse_for_device(phys, ctx.execution_config)
         return opt, phys
 
 

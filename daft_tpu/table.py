@@ -213,6 +213,11 @@ class Table:
                 mask = s if mask is None else (mask & s)
         if mask is None:
             return self
+        return self.filter_with_mask(mask)
+
+    def filter_with_mask(self, mask: Series) -> "Table":
+        """Compact rows by a precomputed boolean mask (the device filter path
+        computes the predicate on the TPU and hands the mask back here)."""
         mask = _broadcast_series(mask, len(self))
         m = mask._arrow
         if m is None:
