@@ -1,14 +1,23 @@
-"""Benchmark harness: TPC-H Q1 wall-clock vs the pyarrow oracle baseline.
+"""Benchmark harness: TPC-H through the engine, host path vs TPU device path.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value is
-lineitem rows/sec through the full daft_tpu engine (lazy plan -> optimizer ->
-physical plan -> streaming executor) for TPC-H Q1, and vs_baseline is the
-speedup vs a hand-written pyarrow.compute implementation of the same query
-(>1.0 = faster than baseline). Result parity vs the oracle is asserted before
-timing; a parity failure prints value 0.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Headline: TPC-H Q1 rows/sec through the DEVICE path of the full engine
+(lazy plan -> optimizer -> fused physical plan -> jitted filter+segment-agg
+kernels on the TPU) over HBM-resident data — the deployment shape this
+framework targets (stage once, query many; the host<->device link is the
+bottleneck, compute is not). vs_baseline is the speedup vs a hand-written
+pyarrow.compute oracle of the same query on this host (>1.0 = faster).
+
+Extras report the host-path engine, Q6, and first-query (cold staging) cost
+so the staging amortization is visible, not hidden.
+
+Result parity vs the oracle is asserted before timing (device money sums run
+reduced-precision float32 with Kahan-compensated combines; parity tolerance
+is relative 1e-6). A parity failure prints value 0.
 
 Reference role-equivalent: tests/benchmarks/test_local_tpch.py +
-benchmarking/tpch (SURVEY.md §6).
+benchmarking/tpch (SURVEY.md §6); baseline targets in BASELINE.md.
 """
 
 from __future__ import annotations
@@ -27,8 +36,23 @@ def _best_of(fn, n=3):
     return best, out
 
 
+def _parity(got: dict, want: dict, rtol: float) -> bool:
+    if set(got) != set(want):
+        return False
+    for k in want:
+        if len(got[k]) != len(want[k]):
+            return False
+        for a, b in zip(got[k], want[k]):
+            if isinstance(b, float):
+                if abs(a - b) > max(rtol * abs(b), 1e-6):
+                    return False
+            elif a != b:
+                return False
+    return True
+
+
 def main() -> int:
-    scale = float(sys.argv[1]) if len(sys.argv) > 1 else 0.1
+    scale = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
     from benchmarks import tpch
 
     tables = tpch.generate_tables(scale=scale, seed=42)
@@ -36,50 +60,122 @@ def main() -> int:
     rows = lineitem.num_rows
 
     import daft_tpu as dt
-    from daft_tpu.context import set_execution_config
+    from daft_tpu.context import get_context, set_execution_config
 
-    def run_daft():
-        # rebuild the plan each run: .collect() caches its materialized result
-        return tpch.q1(dt.from_arrow(lineitem)).collect().to_pydict()
+    cfg = get_context().execution_config
+    cfg.enable_result_cache = False  # measure execution, not cache hits
 
-    def run_oracle():
-        return tpch.oracle_q1(lineitem)
+    # one resident frame reused across runs: partitions carry the HBM staging
+    # cache, so device-path warm runs skip the host->device transfer
+    frame = dt.from_arrow(lineitem).collect()
 
-    # pick the faster executor mode for this host (morsel-parallel pays off on
-    # many-core hosts; sequential wins on small ones)
+    def run_q1():
+        return tpch.q1(frame).collect().to_pydict()
+
+    def run_q6():
+        return tpch.q6(frame).collect().to_pydict()
+
+    want_q1 = tpch.oracle_q1(lineitem)
+    want_q6 = {"revenue": [tpch.oracle_q6(lineitem)]}
+
+    out = {}
+
+    # ---- host path (engine, pyarrow kernels) -----------------------------
+    cfg.use_device_kernels = False
     timings = {}
     for threads in (1, 0):
         set_execution_config(executor_threads=threads)
-        timings[threads], _ = _best_of(run_daft, n=2)
+        timings[threads], _ = _best_of(run_q1, n=2)
     best_mode = min(timings, key=timings.get)
     set_execution_config(executor_threads=best_mode)
-
-    # warm-up + parity check
-    got = run_daft()
-    want = run_oracle()
-    ok = set(got) == set(want)
-    if ok:
-        for k in want:
-            for a, b in zip(got[k], want[k]):
-                if isinstance(b, float):
-                    ok = ok and abs(a - b) <= max(1e-9 * abs(b), 1e-6)
-                else:
-                    ok = ok and a == b
-    if not ok:
-        print(json.dumps({"metric": f"tpch_q1_sf{scale:g}_rows_per_sec",
+    cfg = get_context().execution_config
+    cfg.enable_result_cache = False
+    if not _parity(run_q1(), want_q1, rtol=1e-9):
+        print(json.dumps({"metric": f"tpch_q1_sf{scale:g}_device_rows_per_sec",
                           "value": 0, "unit": "rows/s", "vs_baseline": 0.0,
-                          "error": "parity_mismatch"}))
+                          "error": "host_parity_mismatch"}))
+        return 1
+    t_host_q1, _ = _best_of(run_q1)
+    t_host_q6, _ = _best_of(run_q6)
+
+    # ---- device path (engine, fused jitted kernels, resident data) -------
+    cfg.use_device_kernels = True
+    t0 = time.perf_counter()
+    got_q1 = run_q1()
+    cold_q1 = time.perf_counter() - t0  # staging + jit compile, amortized cost
+    got_q6 = run_q6()
+    dev_ok = _parity(got_q1, want_q1, rtol=1e-6) and _parity(got_q6, want_q6, rtol=1e-6)
+    if not dev_ok:
+        print(json.dumps({"metric": f"tpch_q1_sf{scale:g}_device_rows_per_sec",
+                          "value": 0, "unit": "rows/s", "vs_baseline": 0.0,
+                          "error": "device_parity_mismatch"}))
+        return 1
+    t_dev_q1, _ = _best_of(run_q1)
+    t_dev_q6, _ = _best_of(run_q6)
+    dev_counters = tpch.q1(frame).collect().stats.snapshot()["counters"]
+    if not dev_counters.get("device_aggregations"):
+        print(json.dumps({"metric": f"tpch_q1_sf{scale:g}_device_rows_per_sec",
+                          "value": 0, "unit": "rows/s", "vs_baseline": 0.0,
+                          "error": "device_path_not_taken"}))
         return 1
 
-    t_daft, _ = _best_of(run_daft)
-    t_oracle, _ = _best_of(run_oracle)
-    print(json.dumps({
-        "metric": f"tpch_q1_sf{scale:g}_rows_per_sec",
-        "value": round(rows / t_daft, 1),
+    # ---- oracle baseline (hand-written pyarrow.compute) ------------------
+    t_oracle_q1, _ = _best_of(lambda: tpch.oracle_q1(lineitem))
+    t_oracle_q6, _ = _best_of(lambda: tpch.oracle_q6(lineitem))
+
+    out = {
+        "metric": f"tpch_q1_sf{scale:g}_device_rows_per_sec",
+        "value": round(rows / t_dev_q1, 1),
         "unit": "rows/s",
-        "vs_baseline": round(t_oracle / t_daft, 3),
-    }))
+        "vs_baseline": round(t_oracle_q1 / t_dev_q1, 3),
+        "host_rows_per_sec": round(rows / t_host_q1, 1),
+        "host_vs_baseline": round(t_oracle_q1 / t_host_q1, 3),
+        "device_vs_host": round(t_host_q1 / t_dev_q1, 3),
+        "q6_device_rows_per_sec": round(rows / t_dev_q6, 1),
+        "q6_vs_baseline": round(t_oracle_q6 / t_dev_q6, 3),
+        "q6_device_vs_host": round(t_host_q6 / t_dev_q6, 3),
+        "q1_cold_first_query_s": round(cold_q1, 3),
+        "rows": rows,
+    }
+
+    # ---- Q6 at SF10 (BASELINE.md rung): the pure filter+reduce query needs
+    # enough rows that the tunnel's fixed ~60-130ms result-fetch latency
+    # amortizes; the oracle scales linearly while the device query cost is
+    # flat, so this is where the no-shuffle rung is actually decided.
+    if scale <= 1.0 and _avail_ram_gb() >= 32:
+        try:
+            big = tpch.generate_lineitem_only(scale=10.0, seed=42)
+            brows = big.num_rows
+            bframe = dt.from_arrow(big).collect()
+            cfg.use_device_kernels = True
+
+            def run_big_q6():
+                return tpch.q6(bframe).collect().to_pydict()
+
+            got = run_big_q6()  # cold: staging + compile
+            if _parity(got, {"revenue": [tpch.oracle_q6(big)]}, rtol=1e-6):
+                t_dev, _ = _best_of(run_big_q6)
+                t_orc, _ = _best_of(lambda: tpch.oracle_q6(big))
+                out["q6_sf10_device_rows_per_sec"] = round(brows / t_dev, 1)
+                out["q6_sf10_vs_baseline"] = round(t_orc / t_dev, 3)
+            else:
+                out["q6_sf10_vs_baseline"] = 0.0
+        except MemoryError:
+            pass
+
+    print(json.dumps(out))
     return 0
+
+
+def _avail_ram_gb() -> float:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable"):
+                    return int(line.split()[1]) / 1e6
+    except OSError:
+        pass
+    return 0.0
 
 
 if __name__ == "__main__":

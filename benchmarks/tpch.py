@@ -98,6 +98,25 @@ def generate_tables(scale: float = 0.01, seed: int = 42) -> Dict[str, pa.Table]:
     return {"lineitem": lineitem, "orders": orders, "customer": customer, "nation": nation}
 
 
+def generate_lineitem_only(scale: float, seed: int = 42) -> pa.Table:
+    """Just the lineitem columns Q1/Q6 touch — lets bench.py run the SF10
+    no-shuffle rung without materializing the full star schema."""
+    rng = np.random.RandomState(seed)
+    n_li = max(int(LINEITEM_ROWS_PER_SF * scale), 100)
+    l_shipdate = rng.randint(_START, _END, n_li)
+    flags = np.array(["A", "N", "R"])
+    status = np.array(["F", "O"])
+    return pa.table({
+        "l_quantity": pa.array(rng.randint(1, 51, n_li).astype(np.float64)),
+        "l_extendedprice": pa.array(np.round(rng.uniform(900.0, 105000.0, n_li), 2)),
+        "l_discount": pa.array(rng.randint(0, 11, n_li) / 100.0),
+        "l_tax": pa.array(rng.randint(0, 9, n_li) / 100.0),
+        "l_returnflag": pa.array(flags[rng.randint(0, 3, n_li)]),
+        "l_linestatus": pa.array(status[rng.randint(0, 2, n_li)]),
+        "l_shipdate": pa.array(l_shipdate.astype("datetime64[D]")),
+    })
+
+
 # ---------------------------------------------------------------------------
 # daft_tpu query implementations
 # ---------------------------------------------------------------------------

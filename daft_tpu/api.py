@@ -21,6 +21,7 @@ from .context import (
 from .dataframe import DataFrame, GroupedDataFrame, from_partitions
 from .datatypes import DataType
 from .expressions import Expression, col, element, interval, lit
+from .io.readers import file_size
 from .io.scan import FileFormat, Pushdowns, ScanTask, glob_paths
 from .logical import InMemorySource, ScanSource
 from .micropartition import MicroPartition
@@ -68,7 +69,7 @@ def from_glob_path(path: str) -> DataFrame:
     """DataFrame of file metadata (path, size, num_rows) for a glob —
     reference: daft/io/_glob.py."""
     paths = glob_paths(path)
-    sizes = [os.path.getsize(p) for p in paths]
+    sizes = [file_size(p) for p in paths]
     return from_pydict({"path": paths, "size": sizes,
                         "num_rows": [None] * len(paths)})
 
@@ -89,15 +90,17 @@ def read_parquet(path, schema_hints: Optional[Dict[str, DataType]] = None,
     paths = glob_paths(path)
     if not paths:
         raise FileNotFoundError(f"no files for {path!r}")
-    pf0 = papq.ParquetFile(paths[0])
+    from .io.readers import file_size, open_parquet_file
+
+    pf0 = open_parquet_file(paths[0])
     schema = Schema.from_arrow(pf0.schema_arrow)
     if schema_hints:
         schema = schema.apply_hints(Schema([Field(k, v) for k, v in schema_hints.items()]))
     cfg = get_context().execution_config
     tasks: List[ScanTask] = []
     for p in paths:
-        md = pf0.metadata if p == paths[0] else papq.ParquetFile(p).metadata
-        fsize = os.path.getsize(p)
+        md = pf0.metadata if p == paths[0] else open_parquet_file(p).metadata
+        fsize = file_size(p)
         split = _split_row_groups
         if split is None:
             split = fsize > cfg.scan_tasks_max_size_bytes and md.num_row_groups > 1
@@ -147,7 +150,7 @@ def read_csv(path, delimiter: str = ",", has_headers: bool = True,
     opts = {"delimiter": delimiter, "has_headers": has_headers,
             "column_names": column_names, **kw}
     tasks = [ScanTask(p, FileFormat.CSV, schema, Pushdowns(), storage_options=opts,
-                      size_bytes=os.path.getsize(p)) for p in paths]
+                      size_bytes=file_size(p)) for p in paths]
     return DataFrame(ScanSource(schema, tasks))
 
 
@@ -159,7 +162,7 @@ def read_json(path, schema_hints: Optional[Dict[str, DataType]] = None) -> DataF
     if schema_hints:
         schema = schema.apply_hints(Schema([Field(k, v) for k, v in schema_hints.items()]))
     tasks = [ScanTask(p, FileFormat.JSON, schema, Pushdowns(),
-                      size_bytes=os.path.getsize(p)) for p in paths]
+                      size_bytes=file_size(p)) for p in paths]
     return DataFrame(ScanSource(schema, tasks))
 
 

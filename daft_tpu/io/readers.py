@@ -71,8 +71,58 @@ def _drop_filter_only_columns(tbl: Table, pushdowns: Pushdowns) -> Table:
 # Parquet
 # ---------------------------------------------------------------------------
 
+def open_parquet_file(path: str) -> "papq.ParquetFile":
+    """ParquetFile over a local path or a remote object: remote parquet reads
+    through ObjectFile range-reads (footer + selected row groups only — the
+    reference's native parquet path, read.rs:615 — never a full download)."""
+    from .object_store import default_io_client, is_remote_path
+
+    if is_remote_path(path):
+        return papq.ParquetFile(default_io_client().open(path))
+    return papq.ParquetFile(path)
+
+
+def open_input_bytes(path: str):
+    """Whole-object file handle for record-oriented formats (csv/json)."""
+    import io as _io
+
+    from .object_store import default_io_client, is_remote_path
+
+    if is_remote_path(path):
+        return _io.BytesIO(default_io_client().get(path))
+    return path
+
+
+def open_prefix_bytes(path: str, nbytes: int = 1 << 20):
+    """A record-aligned PREFIX of the object for schema inference — a remote
+    5GB csv must not be fully downloaded twice (once to infer, once to read).
+    The ranged fetch is trimmed to the last newline so the parser never sees
+    a truncated record; objects smaller than `nbytes` come back whole."""
+    import io as _io
+
+    from .object_store import default_io_client, is_remote_path
+
+    if not is_remote_path(path):
+        return path
+    client = default_io_client()
+    size = client.get_size(path)
+    if size <= nbytes:
+        return _io.BytesIO(client.get(path))
+    chunk = client.get(path, (0, nbytes))
+    head, nl, _tail = chunk.rpartition(b"\n")
+    return _io.BytesIO(head + nl if nl else chunk)
+
+
+def file_size(path: str) -> int:
+    from .object_store import default_io_client, is_remote_path
+
+    if is_remote_path(path):
+        return default_io_client().get_size(path)
+    return os.path.getsize(path)
+
+
 def parquet_metadata(path: str) -> "papq.FileMetaData":
-    return papq.ParquetFile(path).metadata
+    return open_parquet_file(path).metadata
 
 
 def row_group_stats(md, rg_idx: int, schema: Schema) -> TableStats:
@@ -101,7 +151,7 @@ def read_parquet_table(path: str, pushdowns: Optional[Pushdowns] = None,
     row-group pruning via footer stats, limit-aware early stop, residual filter
     on the decoded batch."""
     pushdowns = pushdowns or Pushdowns()
-    pf = papq.ParquetFile(path)
+    pf = open_parquet_file(path)
     md = pf.metadata
     IO_STATS.bump(files_opened=1)
     file_schema = Schema.from_arrow(pf.schema_arrow) if schema is None else schema
@@ -171,7 +221,7 @@ def read_csv_table(path: str, pushdowns: Optional[Pushdowns] = None,
     if schema is not None and pushdowns.columns is not None:
         columns = _project_columns(schema.field_names(), pushdowns)
         convert_opts.include_columns = columns
-    arrow_tbl = pacsv.read_csv(path, read_options=read_opts,
+    arrow_tbl = pacsv.read_csv(open_input_bytes(path), read_options=read_opts,
                                parse_options=parse_opts, convert_options=convert_opts)
     IO_STATS.bump(files_opened=1, bytes_read=arrow_tbl.nbytes, rows_read=arrow_tbl.num_rows,
                   columns_read=arrow_tbl.num_columns)
@@ -194,7 +244,7 @@ def infer_csv_schema(path: str, delimiter: str = ",", has_headers: bool = True,
         block_size=1 << 20,
     )
     parse_opts = pacsv.ParseOptions(delimiter=delimiter)
-    with pacsv.open_csv(path, read_options=read_opts, parse_options=parse_opts) as rd:
+    with pacsv.open_csv(open_prefix_bytes(path), read_options=read_opts, parse_options=parse_opts) as rd:
         batch = rd.read_next_batch()
     return Schema.from_arrow(batch.schema)
 
@@ -206,7 +256,7 @@ def infer_csv_schema(path: str, delimiter: str = ",", has_headers: bool = True,
 def read_json_table(path: str, pushdowns: Optional[Pushdowns] = None,
                     schema: Optional[Schema] = None, **_kw) -> Table:
     pushdowns = pushdowns or Pushdowns()
-    arrow_tbl = pajson.read_json(path)
+    arrow_tbl = pajson.read_json(open_input_bytes(path))
     IO_STATS.bump(files_opened=1, bytes_read=arrow_tbl.nbytes, rows_read=arrow_tbl.num_rows,
                   columns_read=arrow_tbl.num_columns)
     tbl = Table.from_arrow(arrow_tbl)
@@ -223,5 +273,5 @@ def read_json_table(path: str, pushdowns: Optional[Pushdowns] = None,
 
 def infer_json_schema(path: str, **_kw) -> Schema:
     # read a prefix block only
-    arrow_tbl = pajson.read_json(path, read_options=pajson.ReadOptions(block_size=1 << 20))
+    arrow_tbl = pajson.read_json(open_prefix_bytes(path), read_options=pajson.ReadOptions(block_size=1 << 20))
     return Schema.from_arrow(arrow_tbl.schema)

@@ -33,6 +33,7 @@ class IOStats:
             self.row_groups_read = 0
             self.row_groups_pruned = 0
             self.columns_read = 0
+            self.retries = 0
 
     def bump(self, **kw: int) -> None:
         with self._lock:
@@ -48,6 +49,7 @@ class IOStats:
                 "row_groups_read": self.row_groups_read,
                 "row_groups_pruned": self.row_groups_pruned,
                 "columns_read": self.columns_read,
+                "retries": self.retries,
             }
 
 
@@ -266,6 +268,13 @@ def glob_paths(path) -> List[str]:
             out.extend(glob_paths(p))
         return out
     p = str(path)
+    if p.startswith(("s3://", "http://", "https://")):
+        from .object_store import default_io_client
+
+        metas = default_io_client().glob(p)
+        if not metas:
+            raise FileNotFoundError(f"{p!r} matched no objects")
+        return [m.path for m in metas]
     if p.startswith("file://"):
         p = p[len("file://"):]
     if os.path.isdir(p):

@@ -430,15 +430,12 @@ def _fixed_image_series(arrays: List[Optional[np.ndarray]], name: str, mode: str
 # ---------------------------------------------------------------------------
 
 def _fetch_one(url: str, timeout: float) -> bytes:
-    if url.startswith(("http://", "https://")):
-        with urllib.request.urlopen(url, timeout=timeout) as r:
-            return r.read()
-    if url.startswith("file://"):
-        path = url[len("file://"):]
-    else:
-        path = url
-    with open(path, "rb") as f:
-        return f.read()
+    # every scheme (s3/http/file) rides the IOClient: retry with backoff,
+    # connection budget, IO counters (reference: uri/download.rs bulk GET
+    # through the IOClient rather than ad-hoc urllib)
+    from .io.object_store import default_io_client
+
+    return default_io_client().get(url, timeout=timeout)
 
 
 def url_download(s: Series, max_connections: int = 32, on_error: str = "raise",

@@ -726,6 +726,12 @@ def _split_morsels(parts: List[MicroPartition], cfg) -> List[MicroPartition]:
     sees the real parallelism."""
     from .context import resolve_executor_threads
 
+    if getattr(cfg, "use_device_kernels", False):
+        # the device path wants whole partitions: one fused kernel over one
+        # big resident buffer beats many small dispatches, and splitting
+        # would mint fresh MicroPartitions each plan — orphaning the HBM
+        # residency caches that make warm queries fast
+        return parts
     threads = resolve_executor_threads(cfg)
     if threads <= 1:
         return parts
@@ -754,15 +760,21 @@ def fuse_for_device(op: PhysicalOp, cfg) -> PhysicalOp:
     for i, c in enumerate(op.children):
         op.children[i] = fuse_for_device(c, cfg)
     if isinstance(op, AggregateOp):
+        # splice out column-pruning Projects (pure selection, no renames or
+        # compute) above or below the filter: the agg only touches its own
+        # referenced columns and device staging only transfers those, while a
+        # materialized prune would mint a fresh partition each query and
+        # orphan the HBM residency cache
         child = op.children[0]
-        # see through the column-pruning Project the optimizer inserts after
-        # a filter (pure selection, no renames/compute): the agg only touches
-        # its own referenced columns, so skipping the prune is semantics-free
         if isinstance(child, ProjectOp) and _is_pure_column_selection(child.exprs):
             child = child.children[0]
         if isinstance(child, FilterOp):
-            return FusedFilterAggOp(child.children[0], child.predicate,
+            fchild = child.children[0]
+            if isinstance(fchild, ProjectOp) and _is_pure_column_selection(fchild.exprs):
+                fchild = fchild.children[0]
+            return FusedFilterAggOp(fchild, child.predicate,
                                     op.aggregations, op.groupby, op.schema)
+        op.children[0] = child
     return op
 
 
