@@ -60,6 +60,11 @@ class ExecutionConfig:
     # result cache (PartitionSetCache): off when benchmarking so repeated runs
     # measure execution, not cache lookups
     enable_result_cache: bool = True
+    # bounded-memory execution: pipeline breakers (shuffle buckets, join
+    # builds) spill partitions to parquet past this engine-held byte budget;
+    # None = unbounded (reference: the 16x data-to-memory SF1000 single-node
+    # run, benchmarks.rst:111-124)
+    memory_budget_bytes: Optional[int] = None
     # With x64 off (real TPUs are 32-bit), allow float64 data to execute as
     # float32 on device. Sums stay accurate: per-partition partials are
     # combined in float64 on the host. Set False to force exact float64

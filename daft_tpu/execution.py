@@ -140,6 +140,57 @@ class ExecutionContext:
             part = part.filter([predicate])
         return part.agg(aggregations, groupby or None)
 
+    def eval_join(self, lpart: MicroPartition, rpart: MicroPartition,
+                  left_on, right_on, how: str, suffix: str) -> MicroPartition:
+        """Route a join through the device probe when eligible: single
+        integer/date key, PK-unique build side (kernels/device_join.py).
+        Host acero join otherwise."""
+        import numpy as np
+
+        eligible = (self.cfg.use_device_kernels
+                    and how in ("inner", "left", "semi", "anti")
+                    and len(left_on) == 1 and len(right_on) == 1
+                    and max(lpart.num_rows_or_none() or 0,
+                            rpart.num_rows_or_none() or 0) >= self.cfg.device_min_rows)
+        if eligible:
+            try:
+                from .kernels.device_join import device_join_indices
+
+                res = device_join_indices(
+                    lpart.table(), rpart.table(), left_on[0], right_on[0],
+                    lpart.device_stage_cache(), rpart.device_stage_cache(), how)
+            except Exception:
+                res = None
+            if res is not None:
+                from .series import Series
+
+                side, hit, bidx = res
+                ltbl, rtbl = lpart.table(), rpart.table()
+                self.stats.bump("device_join_probes")
+                if side == "right_build":
+                    if how == "semi":
+                        out = ltbl.filter_with_mask(Series.from_numpy(hit, "m"))
+                    elif how == "anti":
+                        out = ltbl.filter_with_mask(Series.from_numpy(~hit, "m"))
+                    elif how == "inner":
+                        lidx = np.nonzero(hit)[0]
+                        out = ltbl.join_from_indices(rtbl, lidx, bidx[hit],
+                                                     left_on, right_on, suffix)
+                    else:  # left outer: every left row, -1 -> null right
+                        lidx = np.arange(len(ltbl), dtype=np.int64)
+                        ridx = np.where(hit, bidx, -1)
+                        out = ltbl.join_from_indices(rtbl, lidx, ridx,
+                                                     left_on, right_on, suffix)
+                else:  # left_build (inner only): re-sort to host (lidx, ridx) order
+                    ridx = np.nonzero(hit)[0]
+                    lidx = bidx[hit]
+                    order = np.argsort(lidx, kind="stable")
+                    out = ltbl.join_from_indices(rtbl, lidx[order], ridx[order],
+                                                 left_on, right_on, suffix)
+                return MicroPartition.from_table(out)
+        self.stats.bump("host_joins")
+        return lpart.hash_join(rpart, left_on, right_on, how, suffix)
+
     def eval_filter(self, part: MicroPartition, predicate) -> MicroPartition:
         """Filter a partition: when eligible, the predicate mask is computed on
         device and only the compaction happens on host."""

@@ -1,0 +1,119 @@
+"""Device-side hash-join probe.
+
+Semantic spec: the reference's probe table
+(/root/reference/src/daft-table/src/probe_table/mod.rs:14-28 — build one
+side, stream the other, null keys never match) and hash_join
+(ops/joins/hash_join.rs). The TPU formulation avoids a hash table entirely:
+no data-dependent control flow fits XLA, so the build side is SORTED once
+(cached with the partition, like column staging) and every probe is a
+vectorized `searchsorted` — O(P log B) fully on the VPU with static shapes.
+
+Scope (the TPC-H star-join shape): single integer/date key, unique keys on
+the build side (primary-key side). Multiplicity >1 or multi-column keys fall
+back to the host acero join. Probe direction adapts:
+
+- build = RIGHT side (right keys unique): inner/left/semi/anti with probe
+  over the left rows — output already in host order (left idx, right idx).
+- build = LEFT side (left keys unique): inner — output re-sorted stably by
+  left idx to match the host join's deterministic order.
+
+The probe returns per-probe-row (hit, build_row_idx); the host assembles
+output columns with vectorized takes (strings and other host-only payload
+never stage)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .device import is_device_dtype, size_bucket, stage_table_columns
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _probe_kernel(build_vals, build_valid, probe_vals, probe_valid):
+    """(hit [P], build_idx [P], dup_flag) — sentinel-free via validity masks."""
+    big = jnp.iinfo(build_vals.dtype).max
+    k = jnp.where(build_valid, build_vals, big)  # nulls+padding sort to the end
+    # among equal keys, valid lanes first: a real key == INT_MAX must not be
+    # shadowed by a null-sentinel lane at the same value
+    perm = jnp.lexsort((~build_valid, k))
+    sk = k[perm]
+    sorted_valid = build_valid[perm]
+    # duplicate VALID keys anywhere -> not a PK side, host must handle
+    dup = jnp.any((sk[1:] == sk[:-1]) & sorted_valid[1:] & sorted_valid[:-1])
+    pos = jnp.clip(jnp.searchsorted(sk, probe_vals), 0, sk.shape[0] - 1)
+    bidx = perm[pos]
+    hit = (sk[pos] == probe_vals) & probe_valid & build_valid[bidx]
+    return hit, bidx.astype(jnp.int32), dup
+
+
+def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
+    """Stage one join-key column (post-normalization) -> (values, valid)."""
+    from .device import normalize_and_check
+
+    schema = table.schema
+    nodes = normalize_and_check([key_expr], schema)
+    if nodes is None:
+        return None
+    from ..expressions import required_columns
+
+    from ..datatypes import TypeKind
+
+    node = nodes[0]
+    dt = node.to_field(schema).dtype
+    if not (dt.is_integer() or dt.kind == TypeKind.DATE):
+        return None
+    cols = required_columns(node)
+    if not cols:
+        return None
+    b = size_bucket(len(table))
+    env = stage_table_columns(table, cols, b, cache)
+    if env is None:
+        return None
+    from .device import compile_projection
+
+    run, _ = compile_projection([node], schema, tuple(sorted(cols)))
+    (vals, valid), = run(env)
+    if not jnp.issubdtype(vals.dtype, jnp.integer):
+        return None
+    return vals, valid
+
+
+def device_join_indices(left_table, right_table, left_key, right_key,
+                        left_cache=None, right_cache=None, how: str = "inner"):
+    """Probe on device. Returns (side, hit, bidx):
+
+    - side == "right_build": hit/bidx are per LEFT row (bidx indexes right)
+    - side == "left_build": hit/bidx are per RIGHT row (bidx indexes left)
+    or None when ineligible (non-integer keys, duplicate build keys, ...).
+    """
+    ln, rn = len(left_table), len(right_table)
+    if ln == 0 or rn == 0:
+        return None
+    lk = _stage_key(left_table, left_key, left_cache)
+    rk = _stage_key(right_table, right_key, right_cache)
+    if lk is None or rk is None:
+        return None
+    lv, lm = lk
+    rv, rm = rk
+    if lv.dtype != rv.dtype:
+        return None
+    # try build=right first (probe order == host output order)
+    hit, bidx, dup = _probe_kernel(rv, rm, lv, lm)
+    if not bool(dup):
+        hit = np.asarray(hit)[:ln]
+        bidx = np.asarray(bidx)[:ln].astype(np.int64)
+        return "right_build", hit, bidx
+    if how != "inner":
+        return None
+    hit, bidx, dup = _probe_kernel(lv, lm, rv, rm)
+    if bool(dup):
+        return None  # N:M join: host
+    hit = np.asarray(hit)[:rn]
+    bidx = np.asarray(bidx)[:rn].astype(np.int64)
+    return "left_build", hit, bidx

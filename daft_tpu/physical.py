@@ -313,42 +313,59 @@ class ShuffleOp(PhysicalOp):
         self.nulls_first = nulls_first if nulls_first is not None else [None] * len(self.by)
 
     def execute(self, inputs, ctx) -> PartStream:
-        parts = [p for p in inputs[0]]
-        if not parts:
-            return
+        from .spill import PartitionBuffer
+
         n = self.num
+        budget = ctx.cfg.memory_budget_bytes
         # Mesh path: one all_to_all collective over ICI instead of host fanout
         # (parallel/mesh_exec.py); falls through to host on ineligibility.
         dev_shuffle = getattr(ctx, "try_device_shuffle", None)
         if dev_shuffle is not None and self.scheme in ("hash", "random"):
+            parts = [p for p in inputs[0]]
+            if not parts:
+                return
             out = dev_shuffle(parts, self.by, n, self.scheme)
             if out is not None:
                 yield from out
                 return
-        buckets: List[List[MicroPartition]] = [[] for _ in range(n)]
+            stream = iter(parts)
+        else:
+            stream = inputs[0]
+        buckets = [PartitionBuffer(budget, ctx.stats) for _ in range(n)]
+        saw = False
         if self.scheme == "range":
-            boundaries = sample_boundaries(parts, self.by, n, self.descending,
-                                           self.nulls_first,
-                                           ctx.cfg.sample_size_for_sort)
-            for p in parts:
+            # boundaries need all inputs; buffer them (spillable) first
+            in_buf = PartitionBuffer(budget, ctx.stats)
+            for p in stream:
+                in_buf.append(p)
+            saw = len(in_buf) > 0
+            boundaries = sample_boundaries(in_buf.parts(), self.by, n,
+                                           self.descending, self.nulls_first,
+                                           ctx.cfg.sample_size_for_sort) if saw else None
+            for p in in_buf:
                 for i, piece in enumerate(p.partition_by_range(self.by, boundaries,
                                                                self.descending,
                                                                self.nulls_first)):
                     buckets[min(i, n - 1)].append(piece)
+            in_buf.release()
         else:
-            for pi, p in enumerate(parts):
+            for pi, p in enumerate(stream):
+                saw = True
                 if self.scheme == "hash":
                     pieces = p.partition_by_hash(self.by, n)
                 else:
                     pieces = p.partition_by_random(n, seed=pi)
                 for i, piece in enumerate(pieces):
                     buckets[i].append(piece)
+        if not saw:
+            return
         ctx.stats.bump("shuffles")
         for i in range(n):
-            if buckets[i]:
-                yield MicroPartition.concat(buckets[i])
+            if len(buckets[i]):
+                yield MicroPartition.concat(buckets[i].parts())
             else:
                 yield MicroPartition.empty(self.schema)
+            buckets[i].release()
 
     def describe(self):
         by = ", ".join(e._node.display() for e in self.by)
@@ -385,6 +402,44 @@ def sample_boundaries(parts: List[MicroPartition], by: List[Expression], num: in
 
     from .series import Series
 
+    return allk.take(Series.from_arrow(pa.array(np.asarray(idxs, dtype=np.uint64)), "i"))
+
+
+def sample_aligned_boundaries(sides, num: int, sample_size: int = 20):
+    """Quantile boundaries over the COMBINED key samples of several inputs
+    (each `(parts, key_exprs)`), so all sides range-partition identically —
+    bucket i on every side covers the same key interval (reference:
+    Boundaries intersection, daft/runners/partitioning.py:110-166)."""
+    import pyarrow as pa
+
+    from .series import Series
+    from .table import Table
+
+    key_tables = []
+    first_empty = None
+    for parts, by in sides:
+        for p in parts:
+            t = p.table()
+            keys = t.eval_expression_list(by)
+            if first_empty is None:
+                first_empty = keys.slice(0, 0)
+            if len(keys) == 0:
+                continue
+            k = min(len(keys), max(sample_size, sample_size * num))
+            keys = keys.sample(size=k, seed=0) if k < len(keys) else keys
+            # align names AND dtypes to the first side so samples concat
+            if keys.schema != first_empty.schema:
+                keys = Table(first_empty.schema,
+                             [c.cast(f.dtype).rename(f.name)
+                              for c, f in zip(keys._columns, first_empty.schema)])
+            key_tables.append(keys)
+    if not key_tables:
+        return first_empty
+    allk = Table.concat(key_tables)
+    skeys = [col(n) for n in allk.column_names]
+    allk = allk.sort(skeys)
+    m = len(allk)
+    idxs = [min(max(int(np.floor(m * (i + 1) / num)), 0), m - 1) for i in range(num - 1)]
     return allk.take(Series.from_arrow(pa.array(np.asarray(idxs, dtype=np.uint64)), "i"))
 
 
@@ -539,15 +594,26 @@ class HashJoinOp(PhysicalOp):
         self.suffix = suffix
 
     def execute(self, inputs, ctx) -> PartStream:
-        lparts = [p for p in inputs[0]]
-        rparts = [p for p in inputs[1]]
+        from .spill import PartitionBuffer
+
+        budget = ctx.cfg.memory_budget_bytes
+        lbuf = PartitionBuffer(budget, ctx.stats)
+        rbuf = PartitionBuffer(budget, ctx.stats)
+        for p in inputs[0]:
+            lbuf.append(p)
+        for p in inputs[1]:
+            rbuf.append(p)
+        lparts = lbuf.parts()
+        rparts = rbuf.parts()
         n = max(len(lparts), len(rparts))
         lschema = self.children[0].schema
         rschema = self.children[1].schema
         for i in range(n):
             l = lparts[i] if i < len(lparts) else MicroPartition.empty(lschema)
             r = rparts[i] if i < len(rparts) else MicroPartition.empty(rschema)
-            yield l.hash_join(r, self.left_on, self.right_on, self.how, self.suffix)
+            yield ctx.eval_join(l, r, self.left_on, self.right_on, self.how, self.suffix)
+        lbuf.release()
+        rbuf.release()
 
     def describe(self):
         return f"HashJoin[{self.how}]"
@@ -573,34 +639,82 @@ class BroadcastJoinOp(PhysicalOp):
         ctx.stats.bump("broadcast_joins")
         for part in inputs[0]:
             if self.small_is_left:
-                yield small.hash_join(part, self.small_on, self.big_on, self.how, self.suffix)
+                yield ctx.eval_join(small, part, self.small_on, self.big_on,
+                                    self.how, self.suffix)
             else:
-                yield part.hash_join(small, self.big_on, self.small_on, self.how, self.suffix)
+                yield ctx.eval_join(part, small, self.big_on, self.small_on,
+                                    self.how, self.suffix)
 
     def describe(self):
         return f"BroadcastJoin[{self.how}]"
 
 
 class SortMergeJoinOp(PhysicalOp):
-    """Both sides gathered + merge-joined sorted (v1: single-partition merge;
-    range-partitioned merge arrives with the mesh runner)."""
+    """Distributed sort-merge join with ALIGNED range boundaries: both sides
+    sample their join keys into one combined quantile set, range-partition by
+    the same boundaries (bucket i of left joins exactly bucket i of right),
+    and merge per bucket — no single-partition gather. Reference:
+    physical_plan.py:860 (sort_merge_join_aligned_boundaries) + Boundaries
+    intersection (daft/runners/partitioning.py:110-166). Per-bucket sorted
+    outputs concatenate to a globally key-sorted result, preserving the
+    sort-merge contract."""
 
     def __init__(self, left: PhysicalOp, right: PhysicalOp, left_on, right_on,
                  how: str, schema: Schema, suffix: str = "right."):
-        super().__init__([left, right], schema, 1)
+        super().__init__([left, right], schema,
+                         max(left.num_partitions, right.num_partitions))
         self.left_on = left_on
         self.right_on = right_on
         self.how = how
         self.suffix = suffix
 
     def execute(self, inputs, ctx) -> PartStream:
-        lparts = [p for p in inputs[0]]
-        rparts = [p for p in inputs[1]]
-        l = MicroPartition.concat(lparts) if len(lparts) > 1 else (
-            lparts[0] if lparts else MicroPartition.empty(self.children[0].schema))
-        r = MicroPartition.concat(rparts) if len(rparts) > 1 else (
-            rparts[0] if rparts else MicroPartition.empty(self.children[1].schema))
-        yield l.sort_merge_join(r, self.left_on, self.right_on, self.how, self.suffix)
+        from .spill import PartitionBuffer
+
+        budget = ctx.cfg.memory_budget_bytes
+        lbuf = PartitionBuffer(budget, ctx.stats)
+        rbuf = PartitionBuffer(budget, ctx.stats)
+        for p in inputs[0]:
+            lbuf.append(p)
+        for p in inputs[1]:
+            rbuf.append(p)
+        lparts = lbuf.parts()
+        rparts = rbuf.parts()
+        lschema = self.children[0].schema
+        rschema = self.children[1].schema
+        n = self.num_partitions
+        if n <= 1 or (len(lparts) <= 1 and len(rparts) <= 1):
+            l = MicroPartition.concat(lparts) if len(lparts) > 1 else (
+                lparts[0] if lparts else MicroPartition.empty(lschema))
+            r = MicroPartition.concat(rparts) if len(rparts) > 1 else (
+                rparts[0] if rparts else MicroPartition.empty(rschema))
+            yield l.sort_merge_join(r, self.left_on, self.right_on, self.how, self.suffix)
+            lbuf.release()
+            rbuf.release()
+            return
+        k = len(self.left_on)
+        bnds = sample_aligned_boundaries(
+            [(lparts, self.left_on), (rparts, self.right_on)], n,
+            ctx.cfg.sample_size_for_sort)
+        ctx.stats.bump("aligned_boundary_shuffles")
+        lbuckets = [PartitionBuffer(budget, ctx.stats) for _ in range(n)]
+        rbuckets = [PartitionBuffer(budget, ctx.stats) for _ in range(n)]
+        for parts, on, buckets in ((lparts, self.left_on, lbuckets),
+                                   (rparts, self.right_on, rbuckets)):
+            for p in parts:
+                pieces = p.partition_by_range(on, bnds, [False] * k, [None] * k)
+                for i, piece in enumerate(pieces):
+                    buckets[min(i, n - 1)].append(piece)
+        lbuf.release()
+        rbuf.release()
+        for i in range(n):
+            l = (MicroPartition.concat(lbuckets[i].parts()) if len(lbuckets[i]) > 1
+                 else (lbuckets[i].parts()[0] if len(lbuckets[i]) else MicroPartition.empty(lschema)))
+            r = (MicroPartition.concat(rbuckets[i].parts()) if len(rbuckets[i]) > 1
+                 else (rbuckets[i].parts()[0] if len(rbuckets[i]) else MicroPartition.empty(rschema)))
+            yield l.sort_merge_join(r, self.left_on, self.right_on, self.how, self.suffix)
+            lbuckets[i].release()
+            rbuckets[i].release()
 
 
 class CrossJoinOp(PhysicalOp):
@@ -895,10 +1009,13 @@ def _translate_aggregate(plan: Aggregate, cfg) -> PhysicalOp:
 
     if not aggs_decomposable(plan.aggregations):
         # non-decomposable (count_distinct / percentiles / skew): shuffle raw
-        # rows by key, then full agg per partition (global: gather to one)
+        # rows by key, then full agg per partition
         if plan.groupby:
             shuffled = ShuffleOp(child, "hash", nparts, plan.groupby)
             return AggregateOp(shuffled, plan.aggregations, plan.groupby, plan.schema)
+        cd = _global_count_distinct_plan(plan, child, nparts)
+        if cd is not None:
+            return cd
         gathered = GatherOp(child)
         return AggregateOp(gathered, plan.aggregations, [], plan.schema)
 
@@ -917,6 +1034,35 @@ def _translate_aggregate(plan: Aggregate, cfg) -> PhysicalOp:
     out = ProjectOp(p2, final_exprs, plan.schema)
     # two-stage float results can drift in dtype (e.g. mean); align to plan schema
     return _cast_to(out, plan.schema)
+
+
+def _global_count_distinct_plan(plan: Aggregate, child: PhysicalOp,
+                                nparts: int) -> Optional[PhysicalOp]:
+    """Global count_distinct without gathering raw rows: hash-shuffle rows by
+    the counted VALUE (equal values co-locate), count distinct per partition,
+    sum the tiny per-partition partials. Applies when every aggregation in
+    the list is a count_distinct."""
+    from .expressions import Expression
+
+    specs = []
+    for e in plan.aggregations:
+        node = e._node
+        while isinstance(node, Alias):
+            node = node.child
+        if not (isinstance(node, AggExpr) and node.kind == "count_distinct"):
+            return None
+        specs.append((e, node))
+    if len(specs) != 1:
+        return None  # different value columns would need different shuffles
+    e, node = specs[0]
+    alias = e.name()
+    shuffled = ShuffleOp(child, "hash", nparts, [Expression(node.child)])
+    p1 = AggregateOp(shuffled, [e], [],
+                     _stage_schema(plan.input.schema, [e], []))
+    gathered = GatherOp(p1)  # nparts partial counts — rows, not raw data
+    p2 = AggregateOp(gathered, [col(alias).sum().alias(alias)], [],
+                     _stage_schema(p1.schema, [col(alias).sum().alias(alias)], []))
+    return _cast_to(p2, plan.schema)
 
 
 def _stage_schema(input_schema: Schema, aggs: List[Expression], groupby: List[Expression]) -> Schema:

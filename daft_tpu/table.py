@@ -580,6 +580,47 @@ class Table:
             out_fields.append(Field(name, s.dtype))
         return Table(Schema(out_fields), out_cols)
 
+    def join_from_indices(self, right: "Table", lidx: np.ndarray, ridx: np.ndarray,
+                          left_on, right_on, suffix: str = "right.") -> "Table":
+        """Assemble join output from precomputed row-index pairs (the device
+        probe path, kernels/device_join.py). `ridx` entries of -1 emit nulls
+        (left-outer misses). Output schema/naming matches hash_join exactly:
+        merged key columns named after the left keys, then left columns, then
+        right columns with `suffix` on collisions."""
+        left_on = _as_expressions(left_on)
+        right_on = _as_expressions(right_on)
+        lk_names = [e.name() for e in left_on]
+        rk_names = [e.name() for e in right_on]
+        l_take = Series.from_arrow(pa.array(lidx.astype(np.uint64)), "i")
+        r_has_null = (ridx < 0).any()
+        r_take_arr = pa.array(
+            np.where(ridx < 0, 0, ridx).astype(np.int64),
+            pa.int64()) if not r_has_null else pa.array(
+            [None if i < 0 else int(i) for i in ridx], pa.int64())
+        out_cols: List[Series] = []
+        out_fields: List[Field] = []
+        lkeys = self.eval_expression_list(left_on)
+        for i, kn in enumerate(lk_names):
+            s = lkeys._columns[i].take(l_take).rename(kn)
+            out_cols.append(s)
+            out_fields.append(Field(kn, s.dtype))
+        left_names = set(self.column_names)
+        for f in self.schema:
+            if f.name in lk_names:
+                continue
+            s = self.get_column(f.name).take(l_take)
+            out_cols.append(s)
+            out_fields.append(Field(f.name, s.dtype))
+        for f in right.schema:
+            if f.name in rk_names:
+                continue
+            name = f.name if f.name not in left_names else f"{suffix}{f.name}"
+            arr = right.get_column(f.name).to_arrow().take(r_take_arr)
+            s = Series.from_arrow(arr, name, right.get_column(f.name).dtype)
+            out_cols.append(s)
+            out_fields.append(Field(name, s.dtype))
+        return Table(Schema(out_fields), out_cols)
+
     def sort_merge_join(self, right: "Table", left_on, right_on, how: str = "inner",
                         suffix: str = "right.", is_sorted: bool = False) -> "Table":
         """Join pre-sorted (or sorted here) sides; host fallback delegates to hash_join

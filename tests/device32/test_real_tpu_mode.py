@@ -295,3 +295,88 @@ class TestFusedFilterGroupSemantics:
 
         dev, host = _run_both(q, host_mode)
         assert dev.to_pydict() == host.to_pydict()
+
+
+class TestDeviceJoin:
+    def _tables(self, n_left=12_000, n_right=3_000):
+        # right side is the PK side (unique keys); left is the FK side
+        rk = np.arange(n_right, dtype=np.int64) * 3
+        return (
+            {"fk": RNG.choice(rk, n_left),
+             "lv": RNG.rand(n_left)},
+            {"pk": rk, "rv": np.array(["s%d" % i for i in range(n_right)])},
+        )
+
+    def _join(self, how, ldata, rdata, **kw):
+        return (dt.from_pydict(ldata)
+                .join(dt.from_pydict(rdata), left_on="fk", right_on="pk",
+                      how=how, **kw))
+
+    @pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+    def test_pk_join_parity(self, how, host_mode):
+        ldata, rdata = self._tables()
+        if how == "anti":  # make some misses so anti is non-trivial
+            ldata["fk"] = ldata["fk"] + 1
+        dev = self._join(how, ldata, rdata).collect()
+        with host_mode():
+            host = self._join(how, ldata, rdata).collect()
+        assert _counters(dev).get("device_join_probes", 0) > 0, how
+        assert dev.to_pydict() == host.to_pydict(), how
+
+    def test_left_build_inner(self, host_mode):
+        # unique keys on the LEFT, duplicates on the right: probe flips sides
+        ldata = {"pk": np.arange(3000, dtype=np.int64), "lv": RNG.rand(3000)}
+        rdata = {"fk": RNG.randint(0, 3000, 12_000),
+                 "rv": RNG.rand(12_000)}
+        q = lambda: (dt.from_pydict(ldata)
+                     .join(dt.from_pydict(rdata), left_on="pk", right_on="fk"))
+        dev = q().collect()
+        with host_mode():
+            host = q().collect()
+        assert _counters(dev).get("device_join_probes", 0) > 0
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_nm_join_falls_back_to_host(self, host_mode):
+        # duplicates on BOTH sides: device refuses, host must produce it
+        ldata = {"k": np.array([1, 1, 2], dtype=np.int64).repeat(4000)}
+        rdata = {"k2": np.array([1, 2, 2], dtype=np.int64).repeat(4000)}
+        q = lambda: (dt.from_pydict(ldata)
+                     .join(dt.from_pydict(rdata), left_on="k", right_on="k2"))
+        dev = q().collect()
+        with host_mode():
+            host = q().collect()
+        assert _counters(dev).get("device_join_probes", 0) == 0
+        assert _counters(dev).get("host_joins", 0) > 0
+        assert len(dev.to_pydict()["k"]) == len(host.to_pydict()["k"])
+
+    def test_null_keys_never_match(self, host_mode):
+        ldata = {"fk": [1, None, 3] * 4000, "lv": list(range(12_000))}
+        rdata = {"pk": [1, 2, 3, None], "rv": ["a", "b", "c", "d"]}
+        q = lambda: (dt.from_pydict(ldata)
+                     .join(dt.from_pydict(rdata), left_on="fk", right_on="pk",
+                           how="left").sort("lv"))
+        dev = q().collect()
+        with host_mode():
+            host = q().collect()
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_q3_shape_on_device(self, host_mode):
+        # star join: (customer PK) ⋈ (orders FK) then agg
+        n_c, n_o = 3000, 12_000
+        cust = {"c_custkey": np.arange(n_c, dtype=np.int64),
+                "c_seg": np.array(["A", "B"])[RNG.randint(0, 2, n_c)]}
+        orders = {"o_custkey": RNG.randint(0, n_c, n_o),
+                  "o_total": RNG.rand(n_o) * 1000}
+        def q():
+            return (dt.from_pydict(cust).where(col("c_seg") == "A")
+                    .join(dt.from_pydict(orders), left_on="c_custkey",
+                          right_on="o_custkey")
+                    .groupby("c_seg").agg(col("o_total").sum().alias("s"),
+                                          col("o_total").count().alias("c")))
+        dev = q().collect()
+        with host_mode():
+            host = q().collect()
+        assert _counters(dev).get("device_join_probes", 0) > 0
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["c"] == h["c"]
+        np.testing.assert_allclose(d["s"], h["s"], rtol=1e-6)

@@ -1,0 +1,111 @@
+"""Distributed execution without single-partition chokepoints.
+
+Round-2 verdict items: SortMergeJoinOp gathered both sides to ONE partition
+(reference does aligned-boundary range partitioning, physical_plan.py:860);
+global count_distinct gathered all raw rows. Both now shuffle."""
+
+import numpy as np
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.runners import NativeRunner
+
+
+RNG = np.random.RandomState(3)
+
+
+def _smj(nl=5000, nr=3000, parts=4, how="inner"):
+    ldata = {"k": RNG.randint(0, 500, nl), "lv": RNG.rand(nl)}
+    rdata = {"k2": RNG.randint(0, 500, nr), "rv": RNG.rand(nr)}
+    l = dt.from_pydict(ldata).repartition(parts)
+    r = dt.from_pydict(rdata).repartition(parts)
+    return l.join(r, left_on="k", right_on="k2", how=how, strategy="sort_merge")
+
+
+class TestDistributedSortMergeJoin:
+    def test_multi_partition_no_gather(self):
+        q = _smj()
+        _, phys = NativeRunner().optimize_and_translate(q._plan)
+        tree = phys.display_tree()
+        assert "SortMergeJoin" in tree
+        # the join op itself runs at >1 partitions — not a gathered merge
+        from daft_tpu.physical import SortMergeJoinOp
+
+        def find(op):
+            if isinstance(op, SortMergeJoinOp):
+                return op
+            for c in op.children:
+                f = find(c)
+                if f is not None:
+                    return f
+            return None
+
+        smj = find(phys)
+        assert smj is not None and smj.num_partitions > 1
+
+    def test_parity_with_hash_join(self):
+        rng = np.random.RandomState(11)
+        ldata = {"k": rng.randint(0, 500, 5000), "lv": rng.rand(5000)}
+        rdata = {"k2": rng.randint(0, 500, 3000), "rv": rng.rand(3000)}
+        got = (dt.from_pydict(ldata).repartition(4)
+               .join(dt.from_pydict(rdata).repartition(4),
+                     left_on="k", right_on="k2", strategy="sort_merge")
+               .to_pydict())
+        hj = (dt.from_pydict(ldata)
+              .join(dt.from_pydict(rdata), left_on="k", right_on="k2")
+              .to_pydict())
+        # compare multisets of rows (orders differ by strategy)
+        rows_a = sorted(zip(got["k"], got["lv"], got["rv"]))
+        rows_b = sorted(zip(hj["k"], hj["lv"], hj["rv"]))
+        assert rows_a == rows_b
+
+    def test_output_globally_sorted_by_key(self):
+        got = _smj().to_pydict()
+        assert got["k"] == sorted(got["k"])
+
+    def test_aligned_boundaries_counter(self):
+        q = _smj()
+        q.collect()
+        assert q.stats.snapshot()["counters"].get("aligned_boundary_shuffles", 0) >= 1
+
+    @pytest.mark.parametrize("how", ["left", "semi", "anti"])
+    def test_other_join_types(self, how):
+        RNG.seed(7)
+        got = _smj(2000, 1000, 3, how).to_pydict()
+        RNG.seed(7)
+        ldata = {"k": RNG.randint(0, 500, 2000), "lv": RNG.rand(2000)}
+        rdata = {"k2": RNG.randint(0, 500, 1000), "rv": RNG.rand(1000)}
+        exp = (dt.from_pydict(ldata)
+               .join(dt.from_pydict(rdata), left_on="k", right_on="k2", how=how)
+               .to_pydict())
+        for c in got:
+            assert sorted(got[c], key=repr) == sorted(exp[c], key=repr), c
+
+
+class TestGlobalCountDistinct:
+    def test_shuffles_values_not_gather(self):
+        df = dt.from_pydict({"v": RNG.randint(0, 1000, 20_000)}).repartition(4)
+        q = df.agg(col("v").count_distinct().alias("n"))
+        _, phys = NativeRunner().optimize_and_translate(q._plan)
+        tree = phys.display_tree()
+        assert "Shuffle[hash]" in tree
+        # the only Gather is over tiny per-partition partials (after the agg)
+        lines = tree.splitlines()
+        gidx = [i for i, ln in enumerate(lines) if "GatherOp" in ln]
+        aidx = [i for i, ln in enumerate(lines) if "Aggregate" in ln]
+        assert gidx and min(gidx) > min(aidx)  # gather sits above a partial agg
+
+    def test_parity(self):
+        vals = RNG.randint(0, 777, 30_000)
+        df = dt.from_pydict({"v": vals}).repartition(5)
+        got = df.agg(col("v").count_distinct().alias("n")).to_pydict()
+        assert got == {"n": [len(set(vals.tolist()))]}
+
+    def test_with_nulls(self):
+        vals = [1, 2, None, 2, 3, None, 1] * 1000
+        df = dt.from_pydict({"v": vals}).repartition(3)
+        got = df.agg(col("v").count_distinct().alias("n")).to_pydict()
+        single = dt.from_pydict({"v": vals}).agg(
+            col("v").count_distinct().alias("n")).to_pydict()
+        assert got == single
