@@ -75,6 +75,37 @@ class ExecutionContext:
         self.cfg = cfg
         self.stats = stats or RuntimeStats()
         self._pool = None
+        self._spill_scope = None
+        self._buffers: List = []
+
+    @property
+    def spill_scope(self):
+        """Per-query spill directory (lazily created; removed at query end)."""
+        if self._spill_scope is None:
+            from .spill import SpillScope
+
+            self._spill_scope = SpillScope()
+        return self._spill_scope
+
+    def partition_buffer(self):
+        """A spillable PartitionBuffer bound to this query's budget, stats,
+        and spill directory. Tracked so abandoned queries (limit early-stop,
+        cancellation, errors) still return their held bytes to the ledger."""
+        from .spill import PartitionBuffer
+
+        buf = PartitionBuffer(self.cfg.memory_budget_bytes, self.stats,
+                              scope=self.spill_scope)
+        self._buffers.append(buf)
+        return buf
+
+    def finish_query(self) -> None:
+        """Release buffer accounting and delete this query's spill files."""
+        for b in self._buffers:
+            b.release()
+        self._buffers.clear()
+        if self._spill_scope is not None:
+            self._spill_scope.cleanup()
+            self._spill_scope = None
 
     @property
     def num_workers(self) -> int:
@@ -235,14 +266,13 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
         return stream
 
     built = build(root)
-    if not parallel:
-        return built
 
     def rooted():
         try:
             yield from built
         finally:
             ctx.shutdown_pool()
+            ctx.finish_query()
 
     return rooted()
 

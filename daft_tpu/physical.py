@@ -313,10 +313,7 @@ class ShuffleOp(PhysicalOp):
         self.nulls_first = nulls_first if nulls_first is not None else [None] * len(self.by)
 
     def execute(self, inputs, ctx) -> PartStream:
-        from .spill import PartitionBuffer
-
         n = self.num
-        budget = ctx.cfg.memory_budget_bytes
         # Mesh path: one all_to_all collective over ICI instead of host fanout
         # (parallel/mesh_exec.py); falls through to host on ineligibility.
         dev_shuffle = getattr(ctx, "try_device_shuffle", None)
@@ -331,11 +328,11 @@ class ShuffleOp(PhysicalOp):
             stream = iter(parts)
         else:
             stream = inputs[0]
-        buckets = [PartitionBuffer(budget, ctx.stats) for _ in range(n)]
+        buckets = [ctx.partition_buffer() for _ in range(n)]
         saw = False
         if self.scheme == "range":
             # boundaries need all inputs; buffer them (spillable) first
-            in_buf = PartitionBuffer(budget, ctx.stats)
+            in_buf = ctx.partition_buffer()
             for p in stream:
                 in_buf.append(p)
             saw = len(in_buf) > 0
@@ -594,11 +591,8 @@ class HashJoinOp(PhysicalOp):
         self.suffix = suffix
 
     def execute(self, inputs, ctx) -> PartStream:
-        from .spill import PartitionBuffer
-
-        budget = ctx.cfg.memory_budget_bytes
-        lbuf = PartitionBuffer(budget, ctx.stats)
-        rbuf = PartitionBuffer(budget, ctx.stats)
+        lbuf = ctx.partition_buffer()
+        rbuf = ctx.partition_buffer()
         for p in inputs[0]:
             lbuf.append(p)
         for p in inputs[1]:
@@ -669,11 +663,8 @@ class SortMergeJoinOp(PhysicalOp):
         self.suffix = suffix
 
     def execute(self, inputs, ctx) -> PartStream:
-        from .spill import PartitionBuffer
-
-        budget = ctx.cfg.memory_budget_bytes
-        lbuf = PartitionBuffer(budget, ctx.stats)
-        rbuf = PartitionBuffer(budget, ctx.stats)
+        lbuf = ctx.partition_buffer()
+        rbuf = ctx.partition_buffer()
         for p in inputs[0]:
             lbuf.append(p)
         for p in inputs[1]:
@@ -697,8 +688,8 @@ class SortMergeJoinOp(PhysicalOp):
             [(lparts, self.left_on), (rparts, self.right_on)], n,
             ctx.cfg.sample_size_for_sort)
         ctx.stats.bump("aligned_boundary_shuffles")
-        lbuckets = [PartitionBuffer(budget, ctx.stats) for _ in range(n)]
-        rbuckets = [PartitionBuffer(budget, ctx.stats) for _ in range(n)]
+        lbuckets = [ctx.partition_buffer() for _ in range(n)]
+        rbuckets = [ctx.partition_buffer() for _ in range(n)]
         for parts, on, buckets in ((lparts, self.left_on, lbuckets),
                                    (rparts, self.right_on, rbuckets)):
             for p in parts:
