@@ -168,6 +168,25 @@ class ExecutionContext:
                 return MicroPartition.from_table(out)
         self.stats.bump("host_aggregations")
         if predicate is not None:
+            tbl = part.table()
+            # acero single-pass pays off when the hash-agg subsumes the
+            # filtered-table materialization; ungrouped reductions are faster
+            # through the pruned filter+agg below (measured on TPC-H Q6)
+            out = tbl.acero_fused_agg(list(aggregations), list(groupby or []),
+                                      predicate) if groupby else None
+            if out is not None:
+                self.stats.bump("fused_host_aggregations")
+                return MicroPartition.from_table(out)
+            # unfused fallback: prune to referenced columns before filtering
+            # so the compaction doesn't copy payload the agg never reads
+            from .expressions import required_columns
+
+            need = set()
+            for e in list(aggregations) + list(groupby or []) + [predicate]:
+                need.update(required_columns(e))
+            if need and need < set(part.column_names):
+                keep = [n for n in part.column_names if n in need]
+                part = MicroPartition.from_table(tbl.select_columns(keep))
             part = part.filter([predicate])
         return part.agg(aggregations, groupby or None)
 

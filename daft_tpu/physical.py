@@ -331,20 +331,26 @@ class ShuffleOp(PhysicalOp):
         buckets = [ctx.partition_buffer() for _ in range(n)]
         saw = False
         if self.scheme == "range":
-            # boundaries need all inputs; buffer them (spillable) first
+            # Boundaries need all inputs, so partitions are buffered
+            # (spillable); keys are SAMPLED AS PARTITIONS STREAM IN so a
+            # spilled partition is never re-materialized for sampling, and
+            # drain() drops each ref after fanout — out-of-core inputs are
+            # resident once at a time.
             in_buf = ctx.partition_buffer()
+            samples = []
             for p in stream:
+                samples.append(sample_partition_keys(
+                    p, self.by, n, ctx.cfg.sample_size_for_sort))
                 in_buf.append(p)
             saw = len(in_buf) > 0
-            boundaries = sample_boundaries(in_buf.parts(), self.by, n,
-                                           self.descending, self.nulls_first,
-                                           ctx.cfg.sample_size_for_sort) if saw else None
-            for p in in_buf:
+            boundaries = boundaries_from_samples(
+                samples, self.by, n, self.descending,
+                self.nulls_first) if saw else None
+            for p in in_buf.drain():
                 for i, piece in enumerate(p.partition_by_range(self.by, boundaries,
                                                                self.descending,
                                                                self.nulls_first)):
                     buckets[min(i, n - 1)].append(piece)
-            in_buf.release()
         else:
             for pi, p in enumerate(stream):
                 saw = True
@@ -369,44 +375,57 @@ class ShuffleOp(PhysicalOp):
         return f"Shuffle[{self.scheme}] -> {self.num}" + (f" by [{by}]" if by else "")
 
 
-def sample_boundaries(parts: List[MicroPartition], by: List[Expression], num: int,
-                      descending: List[bool],
-                      nulls_first: Optional[List[Optional[bool]]] = None,
-                      sample_size: int = 20):
-    """Sample sort keys and pick num-1 quantile boundary rows (reference:
-    sort sampling in physical_plan.py:1414; sample size per partition scales
-    with ExecutionConfig.sample_size_for_sort)."""
+def sample_partition_keys(p: MicroPartition, by: List[Expression], num: int,
+                          sample_size: int = 20):
+    """Sampled sort-key rows of ONE partition (possibly an empty Table).
+    Called while partitions stream into a spillable buffer, so boundary
+    estimation never re-materializes a spilled partition (reference: sort
+    sampling in physical_plan.py:1414)."""
+    keys = p.table().eval_expression_list(by)
+    if len(keys) == 0:
+        return keys
+    k = min(len(keys), max(sample_size, sample_size * num))
+    return keys.sample(size=k, seed=0) if k < len(keys) else keys
+
+
+def boundaries_from_samples(samples, by: List[Expression], num: int,
+                            descending: List[bool],
+                            nulls_first: Optional[List[Optional[bool]]] = None):
+    """num-1 quantile boundary rows from per-partition key samples."""
+    import pyarrow as pa
+
+    from .series import Series
     from .table import Table
 
-    key_tables = []
-    for p in parts:
-        t = p.table()
-        if len(t) == 0:
-            continue
-        keys = t.eval_expression_list(by)
-        k = min(len(keys), max(sample_size, sample_size * num))
-        key_tables.append(keys.sample(size=k, seed=0) if k < len(keys) else keys)
+    key_tables = [s for s in samples if s is not None and len(s) > 0]
     if not key_tables:
-        empty = parts[0].table().eval_expression_list(by)
-        return empty.slice(0, 0)
+        return next(s for s in samples if s is not None).slice(0, 0)
     allk = Table.concat(key_tables)
     skeys = [col(n) for n in allk.column_names]
     allk = allk.sort(skeys, descending=descending, nulls_first=nulls_first)
     m = len(allk)
     idxs = [int(np.floor(m * (i + 1) / num)) for i in range(num - 1)]
     idxs = [min(max(i, 0), m - 1) for i in idxs]
-    import pyarrow as pa
-
-    from .series import Series
-
     return allk.take(Series.from_arrow(pa.array(np.asarray(idxs, dtype=np.uint64)), "i"))
 
 
-def sample_aligned_boundaries(sides, num: int, sample_size: int = 20):
-    """Quantile boundaries over the COMBINED key samples of several inputs
-    (each `(parts, key_exprs)`), so all sides range-partition identically —
-    bucket i on every side covers the same key interval (reference:
-    Boundaries intersection, daft/runners/partitioning.py:110-166)."""
+def sample_boundaries(parts: List[MicroPartition], by: List[Expression], num: int,
+                      descending: List[bool],
+                      nulls_first: Optional[List[Optional[bool]]] = None,
+                      sample_size: int = 20):
+    """Boundary rows for already-resident partitions (mesh/host sort paths
+    that never spill). Streaming consumers should sample incrementally via
+    sample_partition_keys + boundaries_from_samples instead."""
+    samples = [sample_partition_keys(p, by, num, sample_size) for p in parts]
+    return boundaries_from_samples(samples, by, num, descending, nulls_first)
+
+
+def aligned_boundaries_from_samples(sides_samples, num: int):
+    """Quantile boundaries over the COMBINED per-partition key samples of
+    several inputs, so all sides range-partition identically — bucket i on
+    every side covers the same key interval (reference: Boundaries
+    intersection, daft/runners/partitioning.py:110-166). Samples are
+    collected while partitions stream into their spillable buffers."""
     import pyarrow as pa
 
     from .series import Series
@@ -414,16 +433,14 @@ def sample_aligned_boundaries(sides, num: int, sample_size: int = 20):
 
     key_tables = []
     first_empty = None
-    for parts, by in sides:
-        for p in parts:
-            t = p.table()
-            keys = t.eval_expression_list(by)
+    for samples in sides_samples:
+        for keys in samples:
+            if keys is None:
+                continue
             if first_empty is None:
                 first_empty = keys.slice(0, 0)
             if len(keys) == 0:
                 continue
-            k = min(len(keys), max(sample_size, sample_size * num))
-            keys = keys.sample(size=k, seed=0) if k < len(keys) else keys
             # align names AND dtypes to the first side so samples concat
             if keys.schema != first_empty.schema:
                 keys = Table(first_empty.schema,
@@ -438,6 +455,14 @@ def sample_aligned_boundaries(sides, num: int, sample_size: int = 20):
     m = len(allk)
     idxs = [min(max(int(np.floor(m * (i + 1) / num)), 0), m - 1) for i in range(num - 1)]
     return allk.take(Series.from_arrow(pa.array(np.asarray(idxs, dtype=np.uint64)), "i"))
+
+
+def sample_aligned_boundaries(sides, num: int, sample_size: int = 20):
+    """Aligned boundaries for already-resident inputs (each `(parts,
+    key_exprs)`); streaming consumers sample incrementally instead."""
+    return aligned_boundaries_from_samples(
+        [[sample_partition_keys(p, by, num, sample_size) for p in parts]
+         for parts, by in sides], num)
 
 
 class SortOp(PhysicalOp):
@@ -487,7 +512,7 @@ class AggregateOp(PhysicalOp):
         return f"Aggregate: {a}" + (f" by [{g}]" if g else "")
 
 
-class FusedFilterAggOp(PhysicalOp):
+class FusedFilterAggregateOp(PhysicalOp):
     """Filter fused into a grouped aggregation: on the device path the
     predicate stays a mask feeding masked segment reductions — no host
     compaction or intermediate materialization (the TPU analog of the
@@ -597,17 +622,19 @@ class HashJoinOp(PhysicalOp):
             lbuf.append(p)
         for p in inputs[1]:
             rbuf.append(p)
-        lparts = lbuf.parts()
-        rparts = rbuf.parts()
+        lparts = list(lbuf.drain())
+        rparts = list(rbuf.drain())
         n = max(len(lparts), len(rparts))
         lschema = self.children[0].schema
         rschema = self.children[1].schema
         for i in range(n):
             l = lparts[i] if i < len(lparts) else MicroPartition.empty(lschema)
             r = rparts[i] if i < len(rparts) else MicroPartition.empty(rschema)
+            if i < len(lparts):
+                lparts[i] = None  # drop ref so a re-read spill stays transient
+            if i < len(rparts):
+                rparts[i] = None
             yield ctx.eval_join(l, r, self.left_on, self.right_on, self.how, self.suffix)
-        lbuf.release()
-        rbuf.release()
 
     def describe(self):
         return f"HashJoin[{self.how}]"
@@ -665,39 +692,39 @@ class SortMergeJoinOp(PhysicalOp):
     def execute(self, inputs, ctx) -> PartStream:
         lbuf = ctx.partition_buffer()
         rbuf = ctx.partition_buffer()
+        lsamples, rsamples = [], []
+        n = self.num_partitions
+        ssize = ctx.cfg.sample_size_for_sort
+        # keys sampled as partitions stream in: spilled inputs are never
+        # re-materialized for boundary estimation
         for p in inputs[0]:
+            lsamples.append(sample_partition_keys(p, self.left_on, n, ssize))
             lbuf.append(p)
         for p in inputs[1]:
+            rsamples.append(sample_partition_keys(p, self.right_on, n, ssize))
             rbuf.append(p)
-        lparts = lbuf.parts()
-        rparts = rbuf.parts()
         lschema = self.children[0].schema
         rschema = self.children[1].schema
-        n = self.num_partitions
-        if n <= 1 or (len(lparts) <= 1 and len(rparts) <= 1):
+        if n <= 1 or (len(lbuf) <= 1 and len(rbuf) <= 1):
+            lparts = list(lbuf.drain())
+            rparts = list(rbuf.drain())
             l = MicroPartition.concat(lparts) if len(lparts) > 1 else (
                 lparts[0] if lparts else MicroPartition.empty(lschema))
             r = MicroPartition.concat(rparts) if len(rparts) > 1 else (
                 rparts[0] if rparts else MicroPartition.empty(rschema))
             yield l.sort_merge_join(r, self.left_on, self.right_on, self.how, self.suffix)
-            lbuf.release()
-            rbuf.release()
             return
         k = len(self.left_on)
-        bnds = sample_aligned_boundaries(
-            [(lparts, self.left_on), (rparts, self.right_on)], n,
-            ctx.cfg.sample_size_for_sort)
+        bnds = aligned_boundaries_from_samples([lsamples, rsamples], n)
         ctx.stats.bump("aligned_boundary_shuffles")
         lbuckets = [ctx.partition_buffer() for _ in range(n)]
         rbuckets = [ctx.partition_buffer() for _ in range(n)]
-        for parts, on, buckets in ((lparts, self.left_on, lbuckets),
-                                   (rparts, self.right_on, rbuckets)):
-            for p in parts:
+        for buf, on, buckets in ((lbuf, self.left_on, lbuckets),
+                                 (rbuf, self.right_on, rbuckets)):
+            for p in buf.drain():
                 pieces = p.partition_by_range(on, bnds, [False] * k, [None] * k)
                 for i, piece in enumerate(pieces):
                     buckets[min(i, n - 1)].append(piece)
-        lbuf.release()
-        rbuf.release()
         for i in range(n):
             l = (MicroPartition.concat(lbuckets[i].parts()) if len(lbuckets[i]) > 1
                  else (lbuckets[i].parts()[0] if len(lbuckets[i]) else MicroPartition.empty(lschema)))
@@ -855,13 +882,13 @@ def _split_morsels(parts: List[MicroPartition], cfg) -> List[MicroPartition]:
 
 
 def fuse_for_device(op: PhysicalOp, cfg) -> PhysicalOp:
-    """Post-translation fusion for the device path: Aggregate directly over a
-    Filter becomes FusedFilterAggOp so the predicate runs as a device-side
-    mask feeding the segment reductions (no host compaction between them).
-    No-op unless device kernels are enabled — the host path keeps the simpler
-    two-op pipeline."""
-    if not getattr(cfg, "use_device_kernels", False):
-        return op
+    """Post-translation fusion: Aggregate directly over a Filter becomes
+    FusedFilterAggregateOp. On the device path the predicate runs as a device-side
+    mask feeding the segment reductions (no host compaction between them);
+    on the host path the fused op executes as ONE acero filter+project+agg
+    exec plan (Table.acero_fused_agg) so the filtered intermediate is never
+    materialized — both are the analog of the reference's fused streaming
+    pipeline (pipeline.rs:141-211)."""
     for i, c in enumerate(op.children):
         op.children[i] = fuse_for_device(c, cfg)
     if isinstance(op, AggregateOp):
@@ -877,7 +904,7 @@ def fuse_for_device(op: PhysicalOp, cfg) -> PhysicalOp:
             fchild = child.children[0]
             if isinstance(fchild, ProjectOp) and _is_pure_column_selection(fchild.exprs):
                 fchild = fchild.children[0]
-            return FusedFilterAggOp(fchild, child.predicate,
+            return FusedFilterAggregateOp(fchild, child.predicate,
                                     op.aggregations, op.groupby, op.schema)
         op.children[0] = child
     return op

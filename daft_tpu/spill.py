@@ -143,8 +143,22 @@ class PartitionBuffer:
     def parts(self) -> List[MicroPartition]:
         return list(self._items)
 
+    def drain(self):
+        """Yield partitions in append order, dropping each internal ref as it
+        is handed out, so a spilled partition's re-materialized table lives
+        only for the consumer's one iteration (out-of-core discipline: the
+        buffer never re-pins the whole input)."""
+        for i in range(len(self._items)):
+            part, self._items[i] = self._items[i], None
+            MEMORY_LEDGER.sub(self._held[i])
+            self._held[i] = 0
+            yield part
+        self._items = []
+        self._held = []
+
     def release(self) -> None:
-        """Return held bytes to the ledger (call when the buffer's contents
-        have been consumed downstream)."""
+        """Return held bytes to the ledger and drop partition refs (call when
+        the buffer's contents have been consumed downstream)."""
         MEMORY_LEDGER.sub(sum(self._held))
-        self._held = [0] * len(self._items)
+        self._items = []
+        self._held = []

@@ -474,7 +474,7 @@ class Table:
             cols[vname] = child_s.to_arrow()
             agg_list.append((vname, fname, opts))
             plans.append((vname, fname, node, alias))
-        cols["__row__"] = pa.array(np.arange(n, dtype=np.int64))
+        cols["__row__"] = _rowid_array(n)
         agg_list.append(("__row__", "min", None))
         try:
             g = pa.table(cols).group_by(key_names, use_threads=True).aggregate(agg_list)
@@ -482,22 +482,116 @@ class Table:
             return None
         order = np.argsort(np.asarray(g.column("__row___min").combine_chunks()), kind="stable")
         g = g.take(pa.array(order))
-        out_cols: List[Series] = []
-        out_fields: List[Field] = []
-        for i, f in enumerate(key_tbl.schema):
-            s = Series.from_arrow(g.column(f"k{i}").combine_chunks(), f.name)
-            if s.dtype != f.dtype:
-                s = s.cast(f.dtype)
-            out_cols.append(s)
-            out_fields.append(f)
-        for vname, fname, node, alias in plans:
-            expected_dt = node.to_field(self.schema).dtype
-            s = Series.from_arrow(g.column(f"{vname}_{fname}").combine_chunks(), alias)
-            if s.dtype != expected_dt:
-                s = s.cast(expected_dt)
-            out_cols.append(s.rename(alias))
-            out_fields.append(Field(alias, expected_dt))
-        return Table(Schema(out_fields), out_cols)
+        return _assemble_acero_agg_output(g, list(key_tbl.schema), plans, self.schema)
+
+    def acero_fused_agg(self, to_agg: List[Expression], group_by: List[Expression],
+                        predicate: Optional[Expression]) -> Optional["Table"]:
+        """Single-pass filter+project+aggregate through one acero Declaration
+        (C++ exec plan): the filtered intermediate table is never
+        materialized, which is the host-side analog of the reference's fused
+        streaming pipeline (src/daft-local-execution/src/pipeline.rs:141-211)
+        and of this engine's device-side FusedFilterAggregateOp. Returns None when
+        any expression falls outside the translated subset (_to_acero_expr) —
+        the caller then runs the unfused filter-then-agg path. Group output
+        order is first-occurrence (hash_min row-id side-aggregate), identical
+        to _acero_grouped_agg and the generic path."""
+        from pyarrow import acero
+
+        from .expressions import normalize_literals, required_columns
+
+        n = len(self)
+        if n == 0:
+            return None
+        grouped = bool(group_by)
+        exprs_all = list(group_by) + list(to_agg) + ([predicate] if predicate is not None else [])
+        refs = set()
+        for e in exprs_all:
+            refs.update(required_columns(e))
+        by_name = {f.name: s for f, s in zip(self.schema, self._columns)}
+        cols: Dict[str, Any] = {}
+        for name in refs:
+            s = by_name.get(name)
+            if s is None or s.is_python():
+                return None
+            arr = s.to_arrow()
+            if pa.types.is_nested(arr.type) or pa.types.is_dictionary(arr.type):
+                return None
+            cols[name] = arr
+        try:
+            pred_expr = None
+            if predicate is not None:
+                pred_expr = _to_acero_expr(
+                    normalize_literals(predicate._node, self.schema), self.schema)
+            proj_exprs, proj_names = [], []
+            key_fields: List[Field] = []
+            for i, e in enumerate(group_by):
+                kdt = e._node.to_field(self.schema).dtype
+                key_expr = _to_acero_expr(
+                    normalize_literals(e._node, self.schema), self.schema)
+                karrow = kdt.to_arrow()
+                # same large_string downcast as _acero_grouped_agg: acero's
+                # hash table is ~3x slower on 64-bit-offset keys. Offset
+                # width only shrinks safely under 2GiB, which is knowable
+                # here only for plain column keys.
+                knode = e._node
+                while isinstance(knode, Alias):
+                    knode = knode.child
+                src = cols.get(getattr(knode, "cname", None))
+                small = src is not None and src.nbytes < (1 << 31) - 1
+                if pa.types.is_large_string(karrow) and small:
+                    key_expr = key_expr.cast(pa.string())
+                elif pa.types.is_large_binary(karrow) and small:
+                    key_expr = key_expr.cast(pa.binary())
+                proj_exprs.append(key_expr)
+                proj_names.append(f"k{i}")
+                key_fields.append(Field(e.name(), kdt))
+            plans = []
+            agg_list = []
+            for j, e in enumerate(to_agg):
+                node = e._node
+                alias = e.name()
+                while isinstance(node, Alias):
+                    node = node.child
+                if not isinstance(node, AggExpr):
+                    raise _AceroUnsupported("non-aggregation in agg list")
+                spec = _acero_agg_fn(node, threaded=True)
+                if spec is None:
+                    raise _AceroUnsupported(f"agg kind {node.kind}")
+                fname, opts = spec
+                proj_exprs.append(_to_acero_expr(
+                    normalize_literals(node.child, self.schema), self.schema))
+                proj_names.append(f"v{j}")
+                agg_list.append((f"v{j}", ("hash_" if grouped else "") + fname,
+                                 opts, f"v{j}_{fname}"))
+                plans.append((f"v{j}", fname, node, alias))
+        except _AceroUnsupported:
+            return None
+        if grouped or not cols:
+            # row ids recover first-occurrence group order; ungrouped aggs
+            # (single output row) skip the extra column entirely
+            cols["__row__"] = _rowid_array(n)
+        decls = [acero.Declaration("table_source",
+                                   acero.TableSourceNodeOptions(pa.table(cols)))]
+        if pred_expr is not None:
+            decls.append(acero.Declaration("filter", acero.FilterNodeOptions(pred_expr)))
+        if grouped:
+            proj_exprs.append(pc.field("__row__"))
+            proj_names.append("__row__")
+            agg_list.append(("__row__", "hash_min", None, "__row___min"))
+        decls.append(acero.Declaration("project",
+                                       acero.ProjectNodeOptions(proj_exprs, proj_names)))
+        decls.append(acero.Declaration("aggregate", acero.AggregateNodeOptions(
+            agg_list, keys=[f"k{i}" for i in range(len(group_by))])))
+        try:
+            g = acero.Declaration.from_sequence(decls).to_table(use_threads=True)
+        except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError,
+                pa.ArrowKeyError):
+            return None
+        if grouped:
+            order = np.argsort(np.asarray(g.column("__row___min").combine_chunks()),
+                               kind="stable")
+            g = g.take(pa.array(order))
+        return _assemble_acero_agg_output(g, key_fields, plans, self.schema)
 
     def distinct(self, subset: Optional[Sequence[Expression]] = None) -> "Table":
         exprs = _as_expressions(subset) if subset else [col(n) for n in self.column_names]
@@ -806,6 +900,129 @@ def _group_codes(key_tbl: Table) -> Tuple[np.ndarray, Table]:
     first_idx = first_per_code[order]
     uniq = key_tbl.take(Series.from_arrow(pa.array(first_idx.astype(np.uint64)), "i"))
     return codes, uniq
+
+
+class _AceroUnsupported(Exception):
+    """Expression shape outside the acero-translated subset; callers fall
+    back to the per-op Series kernel path."""
+
+
+def _assemble_acero_agg_output(g: pa.Table, key_fields: List[Field], plans,
+                               schema: Schema) -> "Table":
+    """Shared output assembly for the TableGroupBy and fused-Declaration agg
+    paths: key columns (named k{i}) cast back to engine key dtypes, agg
+    outputs (named {vname}_{fname}) cast to the planner's expected dtypes."""
+    out_cols: List[Series] = []
+    out_fields: List[Field] = []
+    for i, f in enumerate(key_fields):
+        s = Series.from_arrow(g.column(f"k{i}").combine_chunks(), f.name)
+        if s.dtype != f.dtype:
+            s = s.cast(f.dtype)
+        out_cols.append(s)
+        out_fields.append(f)
+    for vname, fname, node, alias in plans:
+        expected_dt = node.to_field(schema).dtype
+        s = Series.from_arrow(g.column(f"{vname}_{fname}").combine_chunks(), alias)
+        if s.dtype != expected_dt:
+            s = s.cast(expected_dt)
+        out_cols.append(s.rename(alias))
+        out_fields.append(Field(alias, expected_dt))
+    return Table(Schema(out_fields), out_cols)
+
+
+_ROWID_CACHE: List[Optional[pa.Array]] = [None]
+_ROWID_CACHE_MAX = 1 << 26  # don't pin more than 512MB of arange
+
+
+def _rowid_array(n: int) -> pa.Array:
+    """Cached int64 arange (grow-only) for first-occurrence order recovery."""
+    cached = _ROWID_CACHE[0]
+    if cached is None or len(cached) < n:
+        cached = pa.array(np.arange(n, dtype=np.int64))
+        if n <= _ROWID_CACHE_MAX:
+            _ROWID_CACHE[0] = cached
+        return cached
+    return cached.slice(0, n)
+
+
+def _to_acero_expr(node, schema: Schema):
+    """ExprNode -> deferred pyarrow.compute Expression with the ENGINE's type
+    semantics: operands are cast to the dtypes the Series kernels would unify
+    to (series.py _binary_numeric/_cmp), so a fused acero plan computes
+    results identical to the per-op host path. The caller must run
+    normalize_literals first so weak literals already carry concrete dtypes.
+    Raises _AceroUnsupported for anything outside the translated subset."""
+    from .expressions import (Between, BinaryOp, Cast, Column, IsNull, Literal,
+                              Not)
+
+    if isinstance(node, Alias):
+        return _to_acero_expr(node.child, schema)
+    if isinstance(node, Column):
+        return pc.field(node.cname)
+    if isinstance(node, Literal):
+        if isinstance(node.value, (list, dict)) or node.dtype.kind == TypeKind.PYTHON:
+            raise _AceroUnsupported("complex literal")
+        try:
+            return pc.scalar(pa.scalar(node.value, node.dtype.to_arrow()))
+        except Exception as e:
+            raise _AceroUnsupported(f"literal: {e}")
+    if isinstance(node, Cast):
+        dt = node.dtype
+        if not (dt.is_numeric() or dt.is_temporal() or dt.is_boolean()):
+            raise _AceroUnsupported(f"cast to {dt}")
+        return _to_acero_expr(node.child, schema).cast(dt.to_arrow())
+    if isinstance(node, Not):
+        return pc.invert(_to_acero_expr(node.child, schema))
+    if isinstance(node, IsNull):
+        inner = _to_acero_expr(node.child, schema)
+        return pc.is_valid(inner) if node.negate else pc.is_null(inner)
+    if isinstance(node, Between):
+        # Series.between == (child >= lo) & (child <= hi), Kleene logic
+        lo = BinaryOp(">=", node.child, node.lower)
+        hi = BinaryOp("<=", node.child, node.upper)
+        return pc.and_kleene(_to_acero_expr(lo, schema), _to_acero_expr(hi, schema))
+    if isinstance(node, BinaryOp):
+        op = node.op
+        ldt = node.left.to_field(schema).dtype
+        rdt = node.right.to_field(schema).dtype
+        l = _to_acero_expr(node.left, schema)
+        r = _to_acero_expr(node.right, schema)
+        if op in ("&", "|"):
+            if not (ldt.is_boolean() and rdt.is_boolean()):
+                raise _AceroUnsupported("bitwise on non-bool")
+            return (pc.and_kleene if op == "&" else pc.or_kleene)(l, r)
+        if op in ("==", "!=", "<", "<=", ">", ">="):
+            if ldt != rdt:
+                sup = try_unify(ldt, rdt)
+                if sup is None:
+                    raise _AceroUnsupported(f"compare {ldt} vs {rdt}")
+                if ldt != sup:
+                    l = l.cast(sup.to_arrow())
+                if rdt != sup:
+                    r = r.cast(sup.to_arrow())
+            fn = {"==": pc.equal, "!=": pc.not_equal, "<": pc.less,
+                  "<=": pc.less_equal, ">": pc.greater, ">=": pc.greater_equal}[op]
+            return fn(l, r)
+        if op in ("+", "-", "*", "/"):
+            numericish = (ldt.is_numeric() or ldt.is_boolean()) and (
+                rdt.is_numeric() or rdt.is_boolean())
+            if not numericish:
+                raise _AceroUnsupported(f"{op} on {ldt}/{rdt}")
+            if op == "/":
+                # Series.__truediv__: both sides to float64, unchecked divide
+                return pc.divide(l.cast(pa.float64()), r.cast(pa.float64()))
+            u = try_unify(ldt, rdt) if ldt != rdt else ldt
+            if u is None or not u.is_numeric():
+                raise _AceroUnsupported(f"{op} unify {ldt}/{rdt}")
+            if ldt != u:
+                l = l.cast(u.to_arrow())
+            if rdt != u:
+                r = r.cast(u.to_arrow())
+            fn = {"+": pc.add_checked, "-": pc.subtract_checked,
+                  "*": pc.multiply_checked}[op]
+            return fn(l, r)
+        raise _AceroUnsupported(f"operator {op}")
+    raise _AceroUnsupported(type(node).__name__)
 
 
 def _acero_agg_fn(node: AggExpr, threaded: bool = False):
