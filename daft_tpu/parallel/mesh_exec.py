@@ -2,11 +2,23 @@
 
 Role-equivalent to the reference's RayRunner data plane
 (daft/runners/ray_runner.py:504-685 — dispatch loop + object-store transfer).
-Redesign for TPU: the N output partitions of a shuffle live one-per-device of a
-`jax.sharding.Mesh`; the fanout+reduce pair becomes ONE all_to_all collective
-(collectives.build_exchange). Host keeps the control plane: bucket assignment
-(host hash kernels work for every dtype incl. strings), capacity negotiation,
-and re-chunking partitions onto the mesh axis.
+Redesign for TPU: the fanout+reduce pair of a shuffle becomes ONE all_to_all
+collective (collectives.build_exchange) over a `jax.sharding.Mesh`. Host keeps
+the control plane: bucket assignment (host hash kernels work for every dtype
+incl. strings; range boundaries sampled host-side like the reference's
+ReduceToQuantiles, execution_step.py:878), capacity negotiation, and
+re-chunking partitions onto the mesh axis.
+
+Generality (round-3):
+- hash, random AND range schemes ship their payload over ICI (range buckets
+  come from the same aligned-boundary ranking the host path uses, so a
+  device range-shuffle + per-device sort is a global sort);
+- any fanout `num` works: num < n_devices leaves trailing devices idle,
+  num > n_devices packs bucket b onto device b % n and ships the bucket id
+  as an extra lane so receivers split their slab;
+- staging is per-device: each source shard is device_put straight onto its
+  mesh device and assembled with make_array_from_single_device_arrays — the
+  host never materializes the old dense [n_devices, R] global matrix.
 
 Columns whose dtype is not device-representable (strings, lists, ...) force a
 host-path shuffle for that exchange — the same Native-vs-Python storage split
@@ -20,11 +32,12 @@ from typing import List, Optional
 import numpy as np
 
 import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..execution import ExecutionContext, RuntimeStats
 from ..kernels.device import DeviceColumn, is_device_dtype, size_bucket, stage_np, unstage
 from ..micropartition import MicroPartition
-from .collectives import build_exchange, exchange_capacity, shard_to_mesh
+from .collectives import build_exchange, exchange_capacity
 
 
 def default_mesh(n: Optional[int] = None):
@@ -46,12 +59,25 @@ class MeshExecutionContext(ExecutionContext):
     def n_devices(self) -> int:
         return int(np.prod(list(self.mesh.shape.values())))
 
-    def try_device_shuffle(self, parts: List[MicroPartition], by, num: int,
-                           scheme: str) -> Optional[List[MicroPartition]]:
-        """All-to-all hash/random shuffle over the mesh; None if ineligible
-        (wrong fanout, non-device payload dtype, empty input)."""
+    def _shard_onto_devices(self, shards: List[jax.Array], trailing, r: int):
+        """Assemble n single-device [1, r, *trailing] buffers into one global
+        [n, r, *trailing] array laid out one-row-per-device — per-device
+        staging with no host-side global matrix."""
         n = self.n_devices
-        if num != n or scheme not in ("hash", "random"):
+        axis = self.mesh.axis_names[0]
+        shape = (n, r) + tuple(trailing)
+        sharding = NamedSharding(self.mesh, P(axis, *([None] * (len(shape) - 1))))
+        return jax.make_array_from_single_device_arrays(shape, sharding, shards)
+
+    def try_device_shuffle(self, parts: List[MicroPartition], by, num: int,
+                           scheme: str, descending=None, nulls_first=None,
+                           boundaries=None) -> Optional[List[MicroPartition]]:
+        """All-to-all shuffle over the mesh; None if ineligible (unsupported
+        scheme, non-device payload dtype, empty input, missing boundaries)."""
+        n = self.n_devices
+        if scheme not in ("hash", "random", "range"):
+            return None
+        if scheme == "range" and boundaries is None:
             return None
         schema = parts[0].schema
         if any(not is_device_dtype(f.dtype) for f in schema):
@@ -60,75 +86,107 @@ class MeshExecutionContext(ExecutionContext):
         total = sum(len(t) for t in tables)
         if total == 0:
             return None
-        # Re-chunk onto the mesh axis: exactly n equal-ish source shards.
-        from ..table import Table
+        from ..schema import Schema
+        from ..table import Table, _composite_rank
 
+        # Re-chunk onto the mesh axis: exactly n equal-ish source shards.
         merged = Table.concat(tables) if len(tables) != 1 else tables[0]
         step = -(-total // n)
         chunks = [merged.slice(min(i * step, total), min((i + 1) * step, total))
                   for i in range(n)]
-        # Control plane: per-row destination bucket, computed with the host
-        # hash kernels (identical assignment to the host shuffle path).
-        buckets_np, inbounds = [], []
+        # Control plane: per-row destination PARTITION, computed with the host
+        # kernels (identical assignment to the host shuffle path).
+        k = len(by or [])
+        desc = list(descending) if descending is not None else [False] * k
+        nf = list(nulls_first) if nulls_first is not None else [None] * k
+        part_buckets, dev_buckets, inbounds = [], [], []
         for ci, c in enumerate(chunks):
             if scheme == "hash":
                 h = c.hash_rows(by)
-                buckets_np.append((h % np.uint64(n)).astype(np.int32))
-            else:
+                b = (h % np.uint64(num)).astype(np.int32)
+            elif scheme == "random":
                 rng = np.random.RandomState(ci)
-                buckets_np.append(rng.randint(0, n, size=len(c)).astype(np.int32))
+                b = rng.randint(0, num, size=len(c)).astype(np.int32)
+            else:
+                bnds = boundaries._columns
+                if not bnds or len(bnds[0]) == 0:
+                    b = np.zeros(len(c), dtype=np.int32)
+                else:
+                    keys = c.eval_expression_list(by)._columns
+                    b = np.minimum(_composite_rank(keys, bnds, desc, nf),
+                                   num - 1).astype(np.int32)
+            part_buckets.append(b)
+            dev_buckets.append((b % n).astype(np.int32) if num > n else b)
             inbounds.append(np.ones(len(c), dtype=bool))
-        cap = exchange_capacity(buckets_np, inbounds, n)
+        cap = exchange_capacity(dev_buckets, inbounds, n)
         r = size_bucket(max((len(c) for c in chunks), default=1))
-        # Stage: stacked [n, R] global arrays, one row of the leading axis per
-        # device. Row validity (vmat) marks real vs padding rows; each column
-        # additionally ships its own null mask as an extra bool lane so nulls
-        # survive the exchange.
         names = [f.name for f in schema]
-        bmat = np.zeros((n, r), dtype=np.int32)
-        vmat = np.zeros((n, r), dtype=bool)
-        col_mats: List[Optional[np.ndarray]] = [None] * len(names)
-        null_lanes = [np.zeros((n, r), dtype=bool) for _ in names]
-        dtypes = []
+        ncols = len(names)
+        ship_lane = num > n  # receivers need the partition id to split
+        devs = list(self.mesh.devices.flat)
+        # Per-device staging: stage one source shard at a time and device_put
+        # it straight onto its mesh device.
+        b_shards, v_shards, lane_shards = [], [], []
+        col_shards = [[] for _ in range(ncols)]
+        null_shards = [[] for _ in range(ncols)]
+        col_trailing = [()] * ncols
+        col_dtypes = [None] * ncols
         for i, c in enumerate(chunks):
-            bmat[i, :len(c)] = buckets_np[i]
-            vmat[i, :len(c)] = True
+            bm = np.zeros(r, dtype=np.int32)
+            vm = np.zeros(r, dtype=bool)
+            bm[:len(c)] = dev_buckets[i]
+            vm[:len(c)] = True
+            b_shards.append(jax.device_put(bm[None], devs[i]))
+            v_shards.append(jax.device_put(vm[None], devs[i]))
+            if ship_lane:
+                lm = np.zeros(r, dtype=np.int32)
+                lm[:len(c)] = part_buckets[i]
+                lane_shards.append(jax.device_put(lm[None], devs[i]))
             for j, name in enumerate(names):
                 vals, valid, _ = stage_np(c.get_column(name), r)
-                if col_mats[j] is None:
-                    col_mats[j] = np.zeros((n,) + vals.shape, dtype=vals.dtype)
-                    dtypes.append(vals.dtype)
-                col_mats[j][i] = vals
-                null_lanes[j][i] = valid
-
-        trailing = tuple(tuple(m.shape[2:]) for m in col_mats) + tuple(
-            () for _ in null_lanes)
-        all_dtypes = tuple(dtypes) + tuple(np.dtype(bool) for _ in null_lanes)
+                col_trailing[j] = tuple(vals.shape[1:])
+                col_dtypes[j] = vals.dtype
+                col_shards[j].append(jax.device_put(vals[None], devs[i]))
+                null_shards[j].append(jax.device_put(valid[None], devs[i]))
+        lane_cols = ([np.dtype(np.int32)] if ship_lane else [])
+        all_dtypes = tuple(col_dtypes) + tuple(np.dtype(bool) for _ in names) + tuple(lane_cols)
+        trailing = tuple(col_trailing) + tuple(() for _ in names) + tuple(
+            () for _ in lane_cols)
         fn = build_exchange(self.mesh, cap, all_dtypes, trailing)
-        dev_args = [shard_to_mesh(bmat, self.mesh), shard_to_mesh(vmat, self.mesh)]
-        for m in list(col_mats) + null_lanes:
-            dev_args.append(shard_to_mesh(m, self.mesh))
+        dev_args = [self._shard_onto_devices(b_shards, (), r),
+                    self._shard_onto_devices(v_shards, (), r)]
+        for j in range(ncols):
+            dev_args.append(self._shard_onto_devices(col_shards[j], col_trailing[j], r))
+        for j in range(ncols):
+            dev_args.append(self._shard_onto_devices(null_shards[j], (), r))
+        if ship_lane:
+            dev_args.append(self._shard_onto_devices(lane_shards, (), r))
         out = fn(*dev_args)
         recv_valid = np.asarray(jax.device_get(out[0]))  # [n, n, cap]
-        ncols = len(col_mats)
         recv_cols = [np.asarray(jax.device_get(o)) for o in out[1:1 + ncols]]
-        recv_nulls = [np.asarray(jax.device_get(o)) for o in out[1 + ncols:]]
+        recv_nulls = [np.asarray(jax.device_get(o)) for o in out[1 + ncols:1 + 2 * ncols]]
+        recv_lane = (np.asarray(jax.device_get(out[1 + 2 * ncols]))
+                     if ship_lane else None)
         self.stats.bump("device_shuffles")
-        # Unstage: per destination device, mask-compact the received slabs.
-        results: List[MicroPartition] = []
-        from ..schema import Schema
-        from ..table import Table as T
 
-        for d in range(n):
-            mask = recv_valid[d].reshape(-1)
-            cnt = int(mask.sum())
+        # Unstage: per OUTPUT PARTITION, mask-compact the received slabs on
+        # the partition's owning device (b % n == device for num > n;
+        # b == device otherwise, trailing devices idle when num < n).
+        def compact(d: int, sel: np.ndarray) -> MicroPartition:
+            cnt = int(sel.sum())
             series_out = []
             for j, f in enumerate(schema):
                 flat = recv_cols[j][d].reshape((-1,) + recv_cols[j][d].shape[2:])
                 nulls = recv_nulls[j][d].reshape(-1)
-                vals = flat[mask]
-                col_valid = nulls[mask]
-                dc = DeviceColumn(vals, col_valid, cnt, f.dtype)
+                dc = DeviceColumn(flat[sel], nulls[sel], cnt, f.dtype)
                 series_out.append(unstage(dc).rename(f.name))
-            results.append(MicroPartition.from_table(T(Schema(list(schema)), series_out)))
+            return MicroPartition.from_table(Table(Schema(list(schema)), series_out))
+
+        results: List[MicroPartition] = []
+        for b in range(num):
+            d = b % n
+            mask = recv_valid[d].reshape(-1)
+            if ship_lane:
+                mask = mask & (recv_lane[d].reshape(-1) == b)
+            results.append(compact(d, mask))
         return results

@@ -316,12 +316,28 @@ class ShuffleOp(PhysicalOp):
         n = self.num
         # Mesh path: one all_to_all collective over ICI instead of host fanout
         # (parallel/mesh_exec.py); falls through to host on ineligibility.
+        # Range shuffles sample their boundaries host-side first (reference:
+        # ReduceToQuantiles, execution_step.py:878) — the payload still rides
+        # ICI, making device range-shuffle + per-device sort a global sort.
         dev_shuffle = getattr(ctx, "try_device_shuffle", None)
-        if dev_shuffle is not None and self.scheme in ("hash", "random"):
+        pre_boundaries = None
+        if dev_shuffle is not None and self.scheme in ("hash", "random", "range"):
             parts = [p for p in inputs[0]]
             if not parts:
                 return
-            out = dev_shuffle(parts, self.by, n, self.scheme)
+            if self.scheme == "range":
+                # cheap dtype-eligibility gate BEFORE the sampling work; the
+                # sampled boundaries are reused by the host fallback below
+                from .kernels.device import is_device_dtype
+
+                if all(is_device_dtype(f.dtype) for f in parts[0].schema):
+                    samples = [sample_partition_keys(p, self.by, n,
+                                                     ctx.cfg.sample_size_for_sort)
+                               for p in parts]
+                    pre_boundaries = boundaries_from_samples(
+                        samples, self.by, n, self.descending, self.nulls_first)
+            out = dev_shuffle(parts, self.by, n, self.scheme, self.descending,
+                              self.nulls_first, pre_boundaries)
             if out is not None:
                 yield from out
                 return
@@ -339,13 +355,18 @@ class ShuffleOp(PhysicalOp):
             in_buf = ctx.partition_buffer()
             samples = []
             for p in stream:
-                samples.append(sample_partition_keys(
-                    p, self.by, n, ctx.cfg.sample_size_for_sort))
+                if pre_boundaries is None:
+                    samples.append(sample_partition_keys(
+                        p, self.by, n, ctx.cfg.sample_size_for_sort))
                 in_buf.append(p)
             saw = len(in_buf) > 0
-            boundaries = boundaries_from_samples(
-                samples, self.by, n, self.descending,
-                self.nulls_first) if saw else None
+            if not saw:
+                boundaries = None
+            elif pre_boundaries is not None:
+                boundaries = pre_boundaries  # sampled for the device attempt
+            else:
+                boundaries = boundaries_from_samples(
+                    samples, self.by, n, self.descending, self.nulls_first)
             for p in in_buf.drain():
                 for i, piece in enumerate(p.partition_by_range(self.by, boundaries,
                                                                self.descending,
@@ -622,18 +643,20 @@ class HashJoinOp(PhysicalOp):
             lbuf.append(p)
         for p in inputs[1]:
             rbuf.append(p)
-        lparts = list(lbuf.drain())
-        rparts = list(rbuf.drain())
-        n = max(len(lparts), len(rparts))
+        n = max(len(lbuf), len(rbuf))
         lschema = self.children[0].schema
         rschema = self.children[1].schema
-        for i in range(n):
-            l = lparts[i] if i < len(lparts) else MicroPartition.empty(lschema)
-            r = rparts[i] if i < len(rparts) else MicroPartition.empty(rschema)
-            if i < len(lparts):
-                lparts[i] = None  # drop ref so a re-read spill stays transient
-            if i < len(rparts):
-                rparts[i] = None
+        # drain() is lazy: a partition's held bytes leave the ledger only when
+        # its pair is consumed, and the ref drops right after the join
+        liter = lbuf.drain()
+        riter = rbuf.drain()
+        for _ in range(n):
+            l = next(liter, None)
+            r = next(riter, None)
+            if l is None:
+                l = MicroPartition.empty(lschema)
+            if r is None:
+                r = MicroPartition.empty(rschema)
             yield ctx.eval_join(l, r, self.left_on, self.right_on, self.how, self.suffix)
 
     def describe(self):
@@ -706,12 +729,16 @@ class SortMergeJoinOp(PhysicalOp):
         lschema = self.children[0].schema
         rschema = self.children[1].schema
         if n <= 1 or (len(lbuf) <= 1 and len(rbuf) <= 1):
-            lparts = list(lbuf.drain())
-            rparts = list(rbuf.drain())
+            # concat needs every partition resident at once (the documented
+            # single-partition merge); keep ledger accounting until after
+            lparts = lbuf.parts()
+            rparts = rbuf.parts()
             l = MicroPartition.concat(lparts) if len(lparts) > 1 else (
                 lparts[0] if lparts else MicroPartition.empty(lschema))
             r = MicroPartition.concat(rparts) if len(rparts) > 1 else (
                 rparts[0] if rparts else MicroPartition.empty(rschema))
+            lbuf.release()
+            rbuf.release()
             yield l.sort_merge_join(r, self.left_on, self.right_on, self.how, self.suffix)
             return
         k = len(self.left_on)

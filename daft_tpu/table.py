@@ -500,13 +500,16 @@ class Table:
         from .expressions import normalize_literals, required_columns
 
         n = len(self)
-        if n == 0:
+        if n == 0 or not group_by:
+            # ungrouped reductions measure faster through the pruned
+            # filter-then-agg path (see eval_agg); no fused variant exists
             return None
-        grouped = bool(group_by)
         exprs_all = list(group_by) + list(to_agg) + ([predicate] if predicate is not None else [])
         refs = set()
         for e in exprs_all:
             refs.update(required_columns(e))
+        if "__row__" in refs:
+            return None  # would collide with the order-recovery column
         by_name = {f.name: s for f, s in zip(self.schema, self._columns)}
         cols: Dict[str, Any] = {}
         for name in refs:
@@ -561,23 +564,19 @@ class Table:
                 proj_exprs.append(_to_acero_expr(
                     normalize_literals(node.child, self.schema), self.schema))
                 proj_names.append(f"v{j}")
-                agg_list.append((f"v{j}", ("hash_" if grouped else "") + fname,
-                                 opts, f"v{j}_{fname}"))
+                agg_list.append((f"v{j}", "hash_" + fname, opts,
+                                 f"v{j}_{fname}"))
                 plans.append((f"v{j}", fname, node, alias))
         except _AceroUnsupported:
             return None
-        if grouped or not cols:
-            # row ids recover first-occurrence group order; ungrouped aggs
-            # (single output row) skip the extra column entirely
-            cols["__row__"] = _rowid_array(n)
+        cols["__row__"] = _rowid_array(n)  # recovers first-occurrence order
         decls = [acero.Declaration("table_source",
                                    acero.TableSourceNodeOptions(pa.table(cols)))]
         if pred_expr is not None:
             decls.append(acero.Declaration("filter", acero.FilterNodeOptions(pred_expr)))
-        if grouped:
-            proj_exprs.append(pc.field("__row__"))
-            proj_names.append("__row__")
-            agg_list.append(("__row__", "hash_min", None, "__row___min"))
+        proj_exprs.append(pc.field("__row__"))
+        proj_names.append("__row__")
+        agg_list.append(("__row__", "hash_min", None, "__row___min"))
         decls.append(acero.Declaration("project",
                                        acero.ProjectNodeOptions(proj_exprs, proj_names)))
         decls.append(acero.Declaration("aggregate", acero.AggregateNodeOptions(
@@ -587,10 +586,9 @@ class Table:
         except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError,
                 pa.ArrowKeyError):
             return None
-        if grouped:
-            order = np.argsort(np.asarray(g.column("__row___min").combine_chunks()),
-                               kind="stable")
-            g = g.take(pa.array(order))
+        order = np.argsort(np.asarray(g.column("__row___min").combine_chunks()),
+                           kind="stable")
+        g = g.take(pa.array(order))
         return _assemble_acero_agg_output(g, key_fields, plans, self.schema)
 
     def distinct(self, subset: Optional[Sequence[Expression]] = None) -> "Table":
