@@ -138,6 +138,37 @@ def main() -> int:
         "rows": rows,
     }
 
+    # ---- Q3 (3-way join + agg + top-k): the device join-probe rung --------
+    try:
+        cust = dt.from_arrow(tables["customer"]).collect()
+        orders = dt.from_arrow(tables["orders"]).collect()
+
+        def run_q3():
+            return tpch.q3(cust, orders, frame).collect().to_pydict()
+
+        cfg.use_device_kernels = True
+        got3 = run_q3()  # cold: staging + compile
+        want3 = tpch.oracle_q3(tables["customer"], tables["orders"], lineitem)
+        if _parity(got3, want3, rtol=1e-6):
+            q3q = tpch.q3(cust, orders, frame)
+            q3q.collect()
+            probes = q3q.stats.snapshot()["counters"].get("device_join_probes", 0)
+            t_dev_q3, _ = _best_of(run_q3, n=2)
+            t_orc_q3, _ = _best_of(
+                lambda: tpch.oracle_q3(tables["customer"], tables["orders"], lineitem),
+                n=2)
+            out["q3_device_s"] = round(t_dev_q3, 3)
+            out["q3_vs_baseline"] = round(t_orc_q3 / t_dev_q3, 3)
+            out["q3_device_join_probes"] = probes
+        else:
+            out["q3_vs_baseline"] = 0.0
+            out["q3_error"] = "parity_mismatch"
+    except Exception as e:  # a regression here must be visible, not silent
+        out["q3_vs_baseline"] = 0.0
+        out["q3_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        cfg.use_device_kernels = True
+
     # ---- Q6 at SF10 (BASELINE.md rung): the pure filter+reduce query needs
     # enough rows that the tunnel's fixed ~60-130ms result-fetch latency
     # amortizes; the oracle scales linearly while the device query cost is

@@ -40,6 +40,26 @@ from ..micropartition import MicroPartition
 from .collectives import build_exchange, exchange_capacity
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _pack_slab(vals, nulls, sel, out_rows: int):
+    """Pack a received slab's selected rows to the front (static shapes):
+    returns (values [out_rows, *trailing], null_validity [out_rows]) in the
+    DeviceColumn packed-prefix layout. Runs on whatever device holds `vals`."""
+    import jax.numpy as jnp
+
+    order = jnp.argsort(~sel, stable=True)
+    pv = jnp.take(vals, order, axis=0)
+    pn = nulls[order] & sel[order]
+    r = pv.shape[0]
+    if out_rows <= r:
+        return pv[:out_rows], pn[:out_rows]
+    pad = [(0, out_rows - r)] + [(0, 0)] * (pv.ndim - 1)
+    return jnp.pad(pv, pad), jnp.pad(pn, (0, out_rows - r))
+
+
 def default_mesh(n: Optional[int] = None):
     """A 1-D mesh over the first n (default: all) local devices, axis 'parts'."""
     devs = jax.devices()
@@ -131,23 +151,29 @@ class MeshExecutionContext(ExecutionContext):
         null_shards = [[] for _ in range(ncols)]
         col_trailing = [()] * ncols
         col_dtypes = [None] * ncols
-        for i, c in enumerate(chunks):
-            bm = np.zeros(r, dtype=np.int32)
-            vm = np.zeros(r, dtype=bool)
-            bm[:len(c)] = dev_buckets[i]
-            vm[:len(c)] = True
-            b_shards.append(jax.device_put(bm[None], devs[i]))
-            v_shards.append(jax.device_put(vm[None], devs[i]))
-            if ship_lane:
-                lm = np.zeros(r, dtype=np.int32)
-                lm[:len(c)] = part_buckets[i]
-                lane_shards.append(jax.device_put(lm[None], devs[i]))
-            for j, name in enumerate(names):
-                vals, valid, _ = stage_np(c.get_column(name), r)
-                col_trailing[j] = tuple(vals.shape[1:])
-                col_dtypes[j] = vals.dtype
-                col_shards[j].append(jax.device_put(vals[None], devs[i]))
-                null_shards[j].append(jax.device_put(valid[None], devs[i]))
+        try:
+            for i, c in enumerate(chunks):
+                bm = np.zeros(r, dtype=np.int32)
+                vm = np.zeros(r, dtype=bool)
+                bm[:len(c)] = dev_buckets[i]
+                vm[:len(c)] = True
+                b_shards.append(jax.device_put(bm[None], devs[i]))
+                v_shards.append(jax.device_put(vm[None], devs[i]))
+                if ship_lane:
+                    lm = np.zeros(r, dtype=np.int32)
+                    lm[:len(c)] = part_buckets[i]
+                    lane_shards.append(jax.device_put(lm[None], devs[i]))
+                for j, name in enumerate(names):
+                    vals, valid, _ = stage_np(c.get_column(name), r)
+                    col_trailing[j] = tuple(vals.shape[1:])
+                    col_dtypes[j] = vals.dtype
+                    col_shards[j].append(jax.device_put(vals[None], devs[i]))
+                    null_shards[j].append(jax.device_put(valid[None], devs[i]))
+        except ValueError:
+            # stage_np rejects e.g. int64 values outside int32 range when x64
+            # is off (real-TPU mode): fall back to the host shuffle, same as
+            # every other device route
+            return None
         lane_cols = ([np.dtype(np.int32)] if ship_lane else [])
         all_dtypes = tuple(col_dtypes) + tuple(np.dtype(bool) for _ in names) + tuple(lane_cols)
         trailing = tuple(col_trailing) + tuple(() for _ in names) + tuple(
@@ -162,31 +188,65 @@ class MeshExecutionContext(ExecutionContext):
         if ship_lane:
             dev_args.append(self._shard_onto_devices(lane_shards, (), r))
         out = fn(*dev_args)
-        recv_valid = np.asarray(jax.device_get(out[0]))  # [n, n, cap]
-        recv_cols = [np.asarray(jax.device_get(o)) for o in out[1:1 + ncols]]
-        recv_nulls = [np.asarray(jax.device_get(o)) for o in out[1 + ncols:1 + 2 * ncols]]
-        recv_lane = (np.asarray(jax.device_get(out[1 + 2 * ncols]))
-                     if ship_lane else None)
+        # Per-partition row counts computed ON DEVICE: one tiny [n(, num)]
+        # fetch instead of pulling the full [n, n, cap] valid/lane matrices
+        # through the host link (which the tunnel's fixed fetch latency makes
+        # the dominant cost of small shuffles).
+        import jax.numpy as jnp
+
+        if ship_lane:
+            def _cnts(v, l):
+                def per_dev(vv, ll):
+                    lanes = jnp.where(vv.reshape(-1), ll.reshape(-1), num)
+                    return jnp.bincount(lanes, length=num + 1)[:num]
+                return jax.vmap(per_dev)(v, l)
+
+            cnts = np.asarray(jax.device_get(
+                jax.jit(_cnts)(out[0], out[1 + 2 * ncols])))  # [n, num]
+        else:
+            cnts = np.asarray(jax.device_get(
+                jax.jit(lambda v: jnp.sum(v, axis=(1, 2)))(out[0])))  # [n]
+
+        def shards_by_dev(garr):
+            """device -> its [1, ...] shard of a mesh-sharded global array."""
+            m = {s.device: s.data for s in garr.addressable_shards}
+            return [m[d] for d in devs]
+
+        valid_shards = shards_by_dev(out[0])
+        col_dev = [shards_by_dev(out[1 + j]) for j in range(ncols)]
+        null_dev = [shards_by_dev(out[1 + ncols + j]) for j in range(ncols)]
+        lane_dev = shards_by_dev(out[1 + 2 * ncols]) if ship_lane else None
         self.stats.bump("device_shuffles")
 
-        # Unstage: per OUTPUT PARTITION, mask-compact the received slabs on
-        # the partition's owning device (b % n == device for num > n;
-        # b == device otherwise, trailing devices idle when num < n).
-        def compact(d: int, sel: np.ndarray) -> MicroPartition:
-            cnt = int(sel.sum())
-            series_out = []
-            for j, f in enumerate(schema):
-                flat = recv_cols[j][d].reshape((-1,) + recv_cols[j][d].shape[2:])
-                nulls = recv_nulls[j][d].reshape(-1)
-                dc = DeviceColumn(flat[sel], nulls[sel], cnt, f.dtype)
-                series_out.append(unstage(dc).rename(f.name))
-            return MicroPartition.from_table(Table(Schema(list(schema)), series_out))
+        # Unstage: per OUTPUT PARTITION, pack the received slab's real rows to
+        # the front ON ITS OWNING DEVICE (b % n for num > n; b otherwise,
+        # trailing devices idle when num < n), then SEED the new partition's
+        # HBM residency cache with the packed columns — downstream device ops
+        # (join probes, filters, segment aggs) on co-partitioned outputs run
+        # without re-staging anything through the host link.
+        from ..kernels.device import x64_enabled
 
         results: List[MicroPartition] = []
         for b in range(num):
             d = b % n
-            mask = recv_valid[d].reshape(-1)
+            cnt = int(cnts[d, b]) if ship_lane else int(cnts[b])
+            bucket = size_bucket(max(cnt, 1))
+            sel = valid_shards[d][0].reshape(-1)
             if ship_lane:
-                mask = mask & (recv_lane[d].reshape(-1) == b)
-            results.append(compact(d, mask))
+                sel = sel & (lane_dev[d][0].reshape(-1) == np.int32(b))
+            series_out = []
+            staged: List[DeviceColumn] = []
+            for j, f in enumerate(schema):
+                flat = col_dev[j][d][0].reshape(
+                    (-1,) + tuple(col_dev[j][d].shape[3:]))
+                nulls = null_dev[j][d][0].reshape(-1)
+                pv, pn = _pack_slab(flat, nulls, sel, bucket)
+                dc = DeviceColumn(pv, pn, cnt, f.dtype)
+                staged.append(dc)
+                series_out.append(unstage(dc).rename(f.name))
+            part = MicroPartition.from_table(Table(Schema(list(schema)), series_out))
+            cache = part.device_stage_cache()
+            for f, dc in zip(schema, staged):
+                cache[(f.name, bucket, x64_enabled())] = dc
+            results.append(part)
         return results

@@ -233,3 +233,112 @@ def test_mesh_range_shuffle_descending_nulls():
     host = NativeRunner().run(df._plan).to_table().to_pydict()
     mesh = MeshRunner(default_mesh(8)).run(df._plan).to_table().to_pydict()
     assert host["a"] == mesh["a"]
+
+def test_mesh_shuffle_seeds_device_residency_cache():
+    """Shuffle outputs keep their columns HBM-resident: the stage cache of
+    every output partition is pre-seeded with packed DeviceColumns."""
+    from daft_tpu.kernels.device import size_bucket, x64_enabled
+    from daft_tpu.micropartition import MicroPartition
+
+    rng = np.random.RandomState(2)
+    df_tbl = daft_tpu.table.Table.from_pydict({
+        "k": rng.randint(0, 100, 1024).astype(np.int64),
+        "v": rng.rand(1024)})
+    ctx = MeshExecutionContext(daft_tpu.context.get_context().execution_config,
+                               mesh=default_mesh(8))
+    out = ctx.try_device_shuffle([MicroPartition.from_table(df_tbl)],
+                                 [col("k")], 8, "hash")
+    assert out is not None
+    for p in out:
+        cache = p.device_stage_cache()
+        b = size_bucket(max(len(p), 1))
+        for name in ("k", "v"):
+            dc = cache.get((name, b, x64_enabled()))
+            assert dc is not None, (name, b, list(cache))
+            assert dc.length == len(p)
+            # packed prefix layout: validity beyond length is False
+            valid = np.asarray(jax.device_get(dc.valid))
+            assert not valid[dc.length:].any()
+
+
+def test_mesh_copartitioned_join_probes_from_cache(monkeypatch):
+    """After a mesh hash shuffle of both sides, the device join probe runs
+    entirely from the seeded caches — stage_series is never called."""
+    import daft_tpu.kernels.device as dev
+    from daft_tpu.kernels.device_join import device_join_indices
+    from daft_tpu.micropartition import MicroPartition
+    from daft_tpu.table import Table
+
+    rng = np.random.RandomState(4)
+    left = Table.from_pydict({"k": np.arange(2000, dtype=np.int64),
+                              "lv": rng.rand(2000)})
+    right = Table.from_pydict({"k2": rng.permutation(5000)[:1500].astype(np.int64),
+                               "rv": rng.rand(1500)})
+    ctx = MeshExecutionContext(daft_tpu.context.get_context().execution_config,
+                               mesh=default_mesh(8))
+    lout = ctx.try_device_shuffle([MicroPartition.from_table(left)], [col("k")], 8, "hash")
+    rout = ctx.try_device_shuffle([MicroPartition.from_table(right)], [col("k2")], 8, "hash")
+    assert lout is not None and rout is not None
+
+    calls = []
+    real = dev.stage_series
+    monkeypatch.setattr(dev, "stage_series", lambda *a, **kw: calls.append(a) or real(*a, **kw))
+    total = 0
+    for lp, rp in zip(lout, rout):
+        if len(lp) == 0 or len(rp) == 0:
+            continue
+        res = device_join_indices(lp.table(), rp.table(), col("k"), col("k2"),
+                                  lp.device_stage_cache(), rp.device_stage_cache(),
+                                  "inner")
+        assert res is not None
+        side, hit, bidx = res
+        total += int(np.asarray(hit).sum())
+    assert calls == [], f"join re-staged {len(calls)} columns through the host"
+    want = len(set(left.to_pydict()["k"]) & set(right.to_pydict()["k2"]))
+    assert total == want
+
+
+def test_mesh_join_query_device_probes_e2e():
+    """Full MeshRunner query: repartition both sides by key, join, agg — the
+    join probes run on device."""
+    cfg = daft_tpu.context.get_context().execution_config
+    old = cfg.use_device_kernels, cfg.device_min_rows
+    cfg.use_device_kernels = True
+    cfg.device_min_rows = 1
+    try:
+        rng = np.random.RandomState(9)
+        l = daft_tpu.from_pydict({"k": np.arange(4000, dtype=np.int64),
+                                  "lv": rng.rand(4000)}).repartition(8, col("k"))
+        r = daft_tpu.from_pydict({"k2": rng.permutation(8000)[:3000].astype(np.int64),
+                                  "rv": rng.rand(3000)}).repartition(8, col("k2"))
+        q = l.join(r, left_on="k", right_on="k2").agg(
+            col("lv").sum().alias("s"), col("k").count().alias("c"))
+        from daft_tpu.execution import execute_plan
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        ctx = MeshExecutionContext(cfg, mesh=default_mesh(8))
+        phys = translate(optimize(q._plan), cfg)
+        parts = list(execute_plan(phys, ctx))
+        got = pa.concat_tables([p.to_arrow() for p in parts]).to_pydict()
+        assert ctx.stats.counters.get("device_join_probes", 0) >= 1, ctx.stats.counters
+        cfg.use_device_kernels = False
+        host = NativeRunner().run(q._plan).to_table().to_pydict()
+        assert got["c"] == host["c"]
+        np.testing.assert_allclose(got["s"], host["s"], rtol=1e-9)
+    finally:
+        cfg.use_device_kernels, cfg.device_min_rows = old
+
+def test_mesh_shuffle_int64_overflow_falls_back_to_host(monkeypatch):
+    """Values outside int32 range with x64 off must fall back to the host
+    shuffle, not crash (stage_np raises ValueError on lossy narrowing)."""
+    import daft_tpu.kernels.device as dev
+    monkeypatch.setattr(dev, "x64_enabled", lambda: False)
+
+    big = np.array([2**40 + i for i in range(512)], dtype=np.int64)
+    df = daft_tpu.from_pydict({"k": big, "v": np.arange(512, dtype=np.float64)}
+                              ).repartition(8, col("k"))
+    mesh = MeshRunner(default_mesh(8)).run(df._plan)
+    got = mesh.to_table().to_arrow()
+    host = NativeRunner().run(df._plan).to_table().to_arrow()
+    assert got.sort_by("v").equals(host.sort_by("v"))

@@ -172,3 +172,18 @@ class TestEngineSpill:
         assert got["k"] == sorted(data["k"].tolist())[:5]
         assert MEMORY_LEDGER.current == 0
         assert _spill_dirs() == before
+
+    def test_abandoned_join_releases_ledger(self, budget):
+        # a limit above a join abandons the join generator mid-stream;
+        # finish_query must settle the lazily-drained buffers
+        budget(128 * 1024)
+        nl, nr = 60_000, 40_000
+        ldata = {"k": RNG.randint(0, 2000, nl), "lv": RNG.rand(nl)}
+        rdata = {"k2": RNG.randint(0, 2000, nr), "rv": RNG.rand(nr)}
+        q = (dt.from_pydict(ldata).repartition(6)
+             .join(dt.from_pydict(rdata).repartition(6),
+                   left_on="k", right_on="k2")
+             .limit(3))
+        got = q.to_pydict()
+        assert len(got["k"]) == 3
+        assert MEMORY_LEDGER.current == 0
