@@ -195,8 +195,26 @@ def read_sql(sql: str, conn, params=None) -> DataFrame:
     return from_arrow(read_sql_arrow(sql, conn, params))
 
 
-read_iceberg = _catalog_stub("iceberg")
-read_hudi = _catalog_stub("hudi")
+def read_iceberg(table_uri: str, snapshot_id=None) -> DataFrame:
+    """Read a local Iceberg v1/v2 table by replaying manifest list ->
+    manifests -> live data files (reference: daft/iceberg/iceberg_scan.py:84;
+    no client library — the avro manifests are decoded natively by
+    io/avro.py). Copy-on-write tables only."""
+    from .io.catalogs import read_iceberg_scan
+
+    schema, tasks = read_iceberg_scan(table_uri, snapshot_id)
+    return DataFrame(ScanSource(schema, tasks))
+
+
+def read_hudi(table_uri: str) -> DataFrame:
+    """Read a local Hudi copy-on-write table by replaying its .hoodie commit
+    timeline (reference: daft/hudi/hudi_scan.py:22)."""
+    from .io.catalogs import read_hudi_scan
+
+    schema, tasks = read_hudi_scan(table_uri)
+    return DataFrame(ScanSource(schema, tasks))
+
+
 read_lance = _catalog_stub("lance")
 
 

@@ -392,6 +392,21 @@ class DataFrame:
         pc = _to_exprs(partition_cols) if partition_cols else None
         return DataFrame(Write(self._plan, root_dir, "json", None, pc)).collect()
 
+    def write_deltalake(self, table_uri: str, mode: str = "append") -> "DataFrame":
+        """Write this DataFrame as a Delta Lake table commit (reference:
+        daft/dataframe/dataframe.py write_deltalake). mode: append |
+        overwrite | error. The commit is atomic: parquet data files land
+        first, then one put-if-absent JSON transaction publishes them.
+        Returns a DataFrame of the added file paths."""
+        from .io.catalogs import write_deltalake_table
+
+        self.collect()
+        arrow_tables = [p.to_arrow() for p in self._result.partitions]
+        added = write_deltalake_table(table_uri, arrow_tables, mode=mode)
+        from .api import from_pydict
+
+        return from_pydict({"path": added})
+
     # ------------------------------------------------------------------ execution
     def cancel(self) -> None:
         """Stop this DataFrame's in-flight execution at the next partition

@@ -236,3 +236,272 @@ class TestReviewFixes:
         df2 = dt.read_parquet(p).collect()
         assert df2.to_pydict() == {"a": [9, 9, 9]}
         del df1, df2
+
+
+# ---------------------------------------------------------------------------
+# round-3: avro codec, iceberg manifest replay, hudi timeline, delta writer
+# ---------------------------------------------------------------------------
+
+from daft_tpu.io.avro import read_avro_file, write_avro_file  # noqa: E402
+
+
+_MANIFEST_ENTRY_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"]},
+        {"name": "data_file", "type": {"type": "record", "name": "r2", "fields": [
+            {"name": "content", "type": "int"},
+            {"name": "file_path", "type": "string"},
+            {"name": "file_format", "type": "string"},
+            {"name": "partition", "type": {"type": "record", "name": "r102",
+                                           "fields": []}},
+            {"name": "record_count", "type": "long"},
+            {"name": "file_size_in_bytes", "type": "long"},
+        ]}},
+    ]}
+
+_MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+        {"name": "content", "type": "int"},
+        {"name": "added_snapshot_id", "type": "long"},
+    ]}
+
+
+def _entry(path, rows, size, status=1, content=0):
+    return {"status": status, "snapshot_id": 1,
+            "data_file": {"content": content, "file_path": path,
+                          "file_format": "PARQUET", "partition": {},
+                          "record_count": rows, "file_size_in_bytes": size}}
+
+
+def _build_iceberg(root, tables, deleted_paths=(), fmt_version=2,
+                   location=None, delete_file=False):
+    """Write a spec-shaped Iceberg table: data parquet + avro manifests +
+    metadata json + version-hint (hadoop catalog layout)."""
+    loc = location or root
+    os.makedirs(os.path.join(root, "metadata"), exist_ok=True)
+    os.makedirs(os.path.join(root, "data"), exist_ok=True)
+    entries = []
+    for i, t in enumerate(tables):
+        p = os.path.join(root, "data", f"f{i}.parquet")
+        papq.write_table(t, p)
+        entries.append(_entry(f"file://{loc}/data/f{i}.parquet", t.num_rows,
+                              os.path.getsize(p)))
+    for i, dp in enumerate(deleted_paths):
+        entries.append(_entry(f"file://{loc}/data/{dp}", 0, 0, status=2))
+    if delete_file:
+        entries.append(_entry(f"file://{loc}/data/del.parquet", 1, 10, content=1))
+    mpath = os.path.join(root, "metadata", "m0.avro")
+    write_avro_file(mpath, _MANIFEST_ENTRY_SCHEMA, entries)
+    snap = {"snapshot-id": 1, "timestamp-ms": 0}
+    if fmt_version == 2:
+        lpath = os.path.join(root, "metadata", "snap-1.avro")
+        write_avro_file(lpath, _MANIFEST_LIST_SCHEMA, [{
+            "manifest_path": f"file://{loc}/metadata/m0.avro",
+            "manifest_length": os.path.getsize(mpath),
+            "partition_spec_id": 0, "content": 0, "added_snapshot_id": 1}])
+        snap["manifest-list"] = f"file://{loc}/metadata/snap-1.avro"
+    else:
+        snap["manifests"] = [f"file://{loc}/metadata/m0.avro"]
+    meta = {
+        "format-version": fmt_version, "table-uuid": "0000", "location": loc,
+        "current-snapshot-id": 1, "snapshots": [snap],
+        "schemas": [{"schema-id": 0, "type": "struct", "fields": [
+            {"id": 1, "name": "x", "type": "long", "required": False},
+            {"id": 2, "name": "y", "type": "string", "required": False}]}],
+        "current-schema-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": []}],
+    }
+    with open(os.path.join(root, "metadata", "v1.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(root, "metadata", "version-hint.text"), "w") as f:
+        f.write("1")
+
+
+class TestAvro:
+    def test_round_trip_all_types(self, tmp_path):
+        schema = {"type": "record", "name": "t", "fields": [
+            {"name": "a", "type": "long"},
+            {"name": "s", "type": ["null", "string"]},
+            {"name": "arr", "type": {"type": "array", "items": "int"}},
+            {"name": "m", "type": {"type": "map", "values": "double"}},
+            {"name": "sub", "type": {"type": "record", "name": "sub", "fields": [
+                {"name": "x", "type": "boolean"}, {"name": "b", "type": "bytes"}]}},
+            {"name": "fx", "type": {"type": "fixed", "name": "f4", "size": 4}},
+            {"name": "e", "type": {"type": "enum", "name": "c",
+                                   "symbols": ["R", "G", "B"]}},
+        ]}
+        recs = [
+            {"a": -12345678901234, "s": None, "arr": [1, -2, 3], "m": {"pi": 3.14},
+             "sub": {"x": True, "b": b"\x00\xff"}, "fx": b"abcd", "e": "G"},
+            {"a": 2**62, "s": "héllo", "arr": [], "m": {},
+             "sub": {"x": False, "b": b""}, "fx": b"zzzz", "e": "B"},
+        ]
+        p = str(tmp_path / "t.avro")
+        write_avro_file(p, schema, recs)
+        _, got = read_avro_file(p)
+        assert got == recs
+
+    def test_deflate_codec(self, tmp_path):
+        import zlib
+
+        from daft_tpu.io import avro as A
+
+        schema = {"type": "record", "name": "t",
+                  "fields": [{"name": "a", "type": "long"}]}
+        recs = [{"a": i} for i in range(100)]
+        w = A._Writer()
+        w.write(A.MAGIC)
+        m = {"avro.schema": json.dumps(schema).encode(), "avro.codec": b"deflate"}
+        w.write_long(len(m))
+        for k, v in m.items():
+            w.write_utf8(k)
+            w.write_bytes(v)
+        w.write_long(0)
+        sync = b"\x01" * 16
+        w.write(sync)
+        body = A._Writer()
+        for rec in recs:
+            A._encode(body, schema, rec)
+        comp = zlib.compress(body.out.getvalue())[2:-4]  # raw deflate
+        w.write_long(len(recs))
+        w.write_long(len(comp))
+        w.write(comp)
+        w.write(sync)
+        p = str(tmp_path / "d.avro")
+        with open(p, "wb") as f:
+            f.write(w.out.getvalue())
+        _, got = read_avro_file(p)
+        assert got == recs
+
+
+class TestIceberg:
+    def test_read_v2_with_deletes_in_log(self, tmp_path):
+        root = str(tmp_path)
+        t1 = pa.table({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+        t2 = pa.table({"x": [4], "y": ["d"]})
+        _build_iceberg(root, [t1, t2], deleted_paths=["gone.parquet"])
+        df = dt.read_iceberg(root)
+        got = df.sort("x").to_pydict()
+        assert got == {"x": [1, 2, 3, 4], "y": ["a", "b", "c", "d"]}
+
+    def test_read_v1_inline_manifests(self, tmp_path):
+        root = str(tmp_path)
+        _build_iceberg(root, [pa.table({"x": [7], "y": ["q"]})], fmt_version=1)
+        assert dt.read_iceberg(root).to_pydict() == {"x": [7], "y": ["q"]}
+
+    def test_moved_table_paths_remap(self, tmp_path):
+        # metadata written against an old absolute location; the reader must
+        # remap by the /metadata/ /data/ tail
+        root = str(tmp_path / "tbl")
+        os.makedirs(root)
+        _build_iceberg(root, [pa.table({"x": [5], "y": ["m"]})],
+                       location="/nonexistent/old/location")
+        assert dt.read_iceberg(root).to_pydict() == {"x": [5], "y": ["m"]}
+
+    def test_merge_on_read_rejected(self, tmp_path):
+        root = str(tmp_path)
+        _build_iceberg(root, [pa.table({"x": [1], "y": ["a"]})], delete_file=True)
+        with pytest.raises(ValueError, match="merge-on-read"):
+            dt.read_iceberg(root)
+
+    def test_pushdown_prunes_scan(self, tmp_path):
+        root = str(tmp_path)
+        _build_iceberg(root, [pa.table({"x": [1, 2], "y": ["a", "b"]}),
+                              pa.table({"x": [100, 200], "y": ["c", "d"]})])
+        q = dt.read_iceberg(root).where(col("x") > 50).select(col("x"))
+        assert q.sort("x").to_pydict() == {"x": [100, 200]}
+
+
+class TestHudi:
+    def test_read_cow_timeline(self, tmp_path):
+        root = str(tmp_path)
+        os.makedirs(os.path.join(root, ".hoodie"))
+        t1 = pa.table({"x": [1, 2], "y": ["a", "b"]})
+        t2 = pa.table({"x": [3], "y": ["c"]})
+        papq.write_table(t1, os.path.join(root, "p1.parquet"))
+        papq.write_table(t2, os.path.join(root, "p2.parquet"))
+        with open(os.path.join(root, ".hoodie", "001.commit"), "w") as f:
+            json.dump({"partitionToWriteStats": {"": [
+                {"fileId": "f1", "path": "p1.parquet"}]}}, f)
+        with open(os.path.join(root, ".hoodie", "002.commit"), "w") as f:
+            json.dump({"partitionToWriteStats": {"": [
+                {"fileId": "f2", "path": "p2.parquet"}]}}, f)
+        got = dt.read_hudi(root).sort("x").to_pydict()
+        assert got == {"x": [1, 2, 3], "y": ["a", "b", "c"]}
+
+    def test_latest_file_slice_wins(self, tmp_path):
+        root = str(tmp_path)
+        os.makedirs(os.path.join(root, ".hoodie"))
+        old = pa.table({"x": [1], "y": ["old"]})
+        new = pa.table({"x": [1], "y": ["new"]})
+        papq.write_table(old, os.path.join(root, "s0.parquet"))
+        papq.write_table(new, os.path.join(root, "s1.parquet"))
+        for i, p in enumerate(["s0.parquet", "s1.parquet"]):
+            with open(os.path.join(root, ".hoodie", f"{i:03d}.commit"), "w") as f:
+                json.dump({"partitionToWriteStats": {"": [
+                    {"fileId": "g1", "path": p}]}}, f)
+        # same fileId in both commits: only the latest slice survives
+        assert dt.read_hudi(root).to_pydict() == {"x": [1], "y": ["new"]}
+
+
+class TestWriteDeltalake:
+    def test_write_then_read_round_trip(self, tmp_path):
+        root = str(tmp_path / "tbl")
+        df = dt.from_pydict({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+        out = df.write_deltalake(root)
+        assert len(out.to_pydict()["path"]) >= 1
+        got = dt.read_deltalake(root).sort("x").to_pydict()
+        assert got == {"x": [1, 2, 3], "y": ["a", "b", "c"]}
+
+    def test_append_and_overwrite(self, tmp_path):
+        root = str(tmp_path / "tbl")
+        dt.from_pydict({"x": [1], "y": ["a"]}).write_deltalake(root)
+        dt.from_pydict({"x": [2], "y": ["b"]}).write_deltalake(root, mode="append")
+        assert dt.read_deltalake(root).sort("x").to_pydict() == {
+            "x": [1, 2], "y": ["a", "b"]}
+        dt.from_pydict({"x": [9], "y": ["z"]}).write_deltalake(root, mode="overwrite")
+        assert dt.read_deltalake(root).to_pydict() == {"x": [9], "y": ["z"]}
+
+    def test_error_mode_and_commit_collision(self, tmp_path, monkeypatch):
+        root = str(tmp_path / "tbl")
+        dt.from_pydict({"x": [1]}).write_deltalake(root)
+        with pytest.raises(FileExistsError):
+            dt.from_pydict({"x": [2]}).write_deltalake(root, mode="error")
+        # a concurrent writer lands the next version BETWEEN this writer's
+        # log listing and its commit: the O_EXCL put-if-absent must raise
+        log = os.path.join(root, "_delta_log")
+        real_listdir = os.listdir
+
+        racer = f"{1:020d}.json"
+
+        def stale_then_race(path):
+            names = list(real_listdir(path))
+            if os.path.abspath(path) == os.path.abspath(log):
+                p = os.path.join(log, racer)
+                if not os.path.exists(p):
+                    open(p, "w").close()
+                names = [n for n in names if n != racer]  # stale view
+            return names
+
+        from daft_tpu.io import catalogs as cat
+
+        monkeypatch.setattr(cat.os, "listdir", stale_then_race)
+        with pytest.raises(FileExistsError):
+            dt.from_pydict({"x": [3]}).write_deltalake(root, mode="append")
+        monkeypatch.undo()
+        # after the race loser aborts, a clean retry commits as version 2
+        dt.from_pydict({"x": [3]}).write_deltalake(root, mode="append")
+        got = dt.read_deltalake(root).sort("x").to_pydict()
+        assert got["x"] == [1, 3]
+
+    def test_multi_partition_write(self, tmp_path):
+        root = str(tmp_path / "tbl")
+        df = dt.from_pydict({"x": list(range(100)),
+                             "y": [f"r{i}" for i in range(100)]}).repartition(4)
+        df.write_deltalake(root)
+        got = dt.read_deltalake(root).sort("x").to_pydict()
+        assert got["x"] == list(range(100))
