@@ -73,18 +73,71 @@ def _substitute(p: LogicalPlan, target: LogicalPlan, repl: LogicalPlan) -> Logic
     return p.with_children(new_kids)
 
 
+def _known_subtree_size(p: LogicalPlan) -> Optional[int]:
+    """Exact byte size of a subtree whose cardinality is already known:
+    materialized in-memory sources, optionally under size-preserving ops
+    (Project keeps row count; its size is approximated by the source's)."""
+    from .logical import Project
+
+    if isinstance(p, InMemorySource):
+        total = 0
+        for part in p.partitions:
+            s = part.size_bytes()
+            if s is None:
+                return None
+            total += s
+        return total
+    if isinstance(p, Project):
+        return _known_subtree_size(p.input)
+    return None
+
+
+def adapt_shuffle_counts(plan: LogicalPlan, cfg, stats=None) -> LogicalPlan:
+    """Shrink shuffle fanouts whose input size is KNOWN (reference: the
+    AdaptivePlanner re-plans every stage boundary with materialized stats,
+    planner.rs:288-351 — here the analog is re-sizing Repartition nodes to
+    ceil(bytes / shuffle_target_partition_bytes), shrink-only so an explicit
+    user fanout is never exceeded)."""
+    from .logical import Repartition
+
+    kids = plan.children()
+    if kids:
+        new_kids = [adapt_shuffle_counts(c, cfg, stats) for c in kids]
+        if any(a is not b for a, b in zip(kids, new_kids)):
+            plan = plan.with_children(new_kids)
+    if (isinstance(plan, Repartition) and plan.scheme != "into"
+            and plan.num and plan.num > 1):
+        size = _known_subtree_size(plan.input)
+        if size is not None:
+            target = max(int(cfg.shuffle_target_partition_bytes), 1)
+            ideal = max(1, -(-size // target))
+            if ideal < plan.num:
+                if stats is not None:
+                    stats.bump("aqe_shuffle_resizes")
+                return Repartition(plan.input, plan.scheme, ideal,
+                                   plan.by, plan.descending)
+    return plan
+
+
 class AdaptivePlanner:
     """Runs a logical plan stage-by-stage, re-optimizing between stages."""
 
-    def __init__(self, execute_subplan, stats=None):
+    def __init__(self, execute_subplan, stats=None, cfg=None):
         # execute_subplan: LogicalPlan -> Iterator[MicroPartition]
         # (the runner's non-adaptive path; AQE stays backend-agnostic)
         self._execute = execute_subplan
         self._stats = stats
+        self._cfg = cfg
         self.stage_history: List[Tuple[int, int]] = []  # (rows, bytes) per stage
 
-    def run(self, plan: LogicalPlan) -> Iterator[MicroPartition]:
+    def _post_optimize(self, plan: LogicalPlan) -> LogicalPlan:
         plan = optimize(plan)
+        if self._cfg is not None:
+            plan = adapt_shuffle_counts(plan, self._cfg, self._stats)
+        return plan
+
+    def run(self, plan: LogicalPlan) -> Iterator[MicroPartition]:
+        plan = self._post_optimize(plan)
         for _ in range(_MAX_STAGES):
             stage = _find_stage(plan)
             if stage is None:
@@ -101,5 +154,5 @@ class AdaptivePlanner:
                 merged = MicroPartition.concat(parts)
                 parts = [merged]
             plan = _substitute(plan, stage, InMemorySource(stage.schema, parts))
-            plan = optimize(plan)
+            plan = self._post_optimize(plan)
         return self._execute(plan)

@@ -45,6 +45,11 @@ class ExecutionConfig:
     # adaptive query execution: materialize join-input stages and re-plan with
     # real sizes (reference: AdaptivePlanner, planner.rs:288)
     enable_aqe: bool = False
+    # AQE shuffle-count adaptation: a shuffle over a source of KNOWN size is
+    # re-sized to ceil(bytes / this target) partitions (shrink-only), so a
+    # 2KB input never fans out 200 ways (reference: stage-boundary re-planning
+    # with materialized stats, planner.rs:288-351)
+    shuffle_target_partition_bytes: int = 64 * 1024 * 1024
     # transient-IO retry at scan-task granularity (reference: s3_like.rs retry)
     scan_retry_attempts: int = 3
     scan_retry_backoff_s: float = 0.1

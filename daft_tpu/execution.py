@@ -27,6 +27,125 @@ class QueryCancelledError(RuntimeError):
     """Raised inside a running query after RuntimeStats.cancel()."""
 
 
+class ResourceRequest:
+    """What one task needs while it runs (reference: ResourceRequest,
+    src/common/resource-request — num_cpus/num_gpus/memory)."""
+
+    __slots__ = ("num_cpus", "num_gpus", "memory_bytes")
+
+    def __init__(self, num_cpus: float = 0.0, num_gpus: float = 0.0,
+                 memory_bytes: int = 0):
+        self.num_cpus = num_cpus or 0.0
+        self.num_gpus = num_gpus or 0.0
+        self.memory_bytes = memory_bytes or 0
+
+    def __bool__(self) -> bool:
+        return bool(self.num_cpus or self.num_gpus or self.memory_bytes)
+
+    def __repr__(self):
+        return (f"ResourceRequest(cpus={self.num_cpus}, gpus={self.num_gpus}, "
+                f"memory={self.memory_bytes})")
+
+
+def op_resource_request(op) -> ResourceRequest:
+    """Sum the resource requests of every UDF an op evaluates (multiple UDFs
+    in one projection all run for the same task)."""
+    from .expressions import PyUdf
+
+    cpus = gpus = mem = 0
+
+    def walk(node):
+        nonlocal cpus, gpus, mem
+        if isinstance(node, PyUdf) and node.resource_request:
+            c, g, m = node.resource_request
+            cpus += c or 0
+            gpus += g or 0
+            mem += m or 0
+        for ch in node.children():
+            walk(ch)
+
+    for e in op._map_exprs():
+        walk(e._node)
+    return ResourceRequest(cpus, gpus, mem)
+
+
+class ResourceAccountant:
+    """Admission control for in-flight tasks (reference: the PyRunner
+    admission loop, daft/runners/pyrunner.py:352-370): a task dispatches only
+    when its declared cpus/accelerators/memory fit the remaining capacity; an
+    impossible request fails fast instead of deadlocking."""
+
+    def __init__(self, cpus: float, gpus, memory_bytes: Optional[int]):
+        """gpus may be a float or a zero-arg callable resolved on FIRST use —
+        counting accelerators touches the jax backend, which host-only
+        queries must never do (a wedged device link would hang them)."""
+        self.total_cpus = cpus
+        self._gpu_src = gpus
+        self._gpus_resolved: Optional[float] = (
+            float(gpus) if not callable(gpus) else None)
+        self.total_memory = memory_bytes
+        self._cpus = cpus
+        self._gpus_used = 0.0
+        self._memory = memory_bytes
+        self._cond = threading.Condition()
+
+    @property
+    def total_gpus(self) -> float:
+        if self._gpus_resolved is None:
+            self._gpus_resolved = float(self._gpu_src())
+        return self._gpus_resolved
+
+    def check(self, req: ResourceRequest) -> None:
+        """Raise if the request can NEVER be admitted on this host."""
+        if req.num_cpus > self.total_cpus:
+            raise RuntimeError(
+                f"task requests {req.num_cpus} CPUs but only "
+                f"{self.total_cpus} exist")
+        if req.num_gpus and req.num_gpus > self.total_gpus:
+            raise RuntimeError(
+                f"task requests {req.num_gpus} accelerator(s) but only "
+                f"{self.total_gpus} exist")
+        if self.total_memory is not None and req.memory_bytes > self.total_memory:
+            raise RuntimeError(
+                f"task requests {req.memory_bytes} bytes but the memory "
+                f"budget is {self.total_memory}")
+
+    def _fits(self, req: ResourceRequest) -> bool:
+        gpu_ok = (not req.num_gpus
+                  or req.num_gpus <= self.total_gpus - self._gpus_used + 1e-9)
+        return (req.num_cpus <= self._cpus + 1e-9 and gpu_ok
+                and (self._memory is None or req.memory_bytes <= self._memory))
+
+    def admit(self, req: ResourceRequest) -> None:
+        """Block until the request fits, then reserve it."""
+        self.check(req)
+        with self._cond:
+            while not self._fits(req):
+                self._cond.wait()
+            self._cpus -= req.num_cpus
+            self._gpus_used += req.num_gpus
+            if self._memory is not None:
+                self._memory -= req.memory_bytes
+
+    def release(self, req: ResourceRequest) -> None:
+        with self._cond:
+            self._cpus += req.num_cpus
+            self._gpus_used -= req.num_gpus
+            if self._memory is not None:
+                self._memory += req.memory_bytes
+            self._cond.notify_all()
+
+
+def _accelerator_count() -> int:
+    """Non-CPU jax devices on this host (0 on a CPU-only test mesh)."""
+    try:
+        import jax
+
+        return sum(1 for d in jax.devices() if d.platform != "cpu")
+    except Exception:
+        return 0
+
+
 class RuntimeStats:
     """Per-query counters + the cancellation handle (reference: runtime stats
     in daft-local-execution, and driver-side stop_plan/MaterializedResult
@@ -77,6 +196,7 @@ class ExecutionContext:
         self._pool = None
         self._spill_scope = None
         self._buffers: List = []
+        self._accountant: Optional[ResourceAccountant] = None
 
     @property
     def spill_scope(self):
@@ -97,6 +217,23 @@ class ExecutionContext:
                               scope=self.spill_scope)
         self._buffers.append(buf)
         return buf
+
+    @property
+    def accountant(self) -> ResourceAccountant:
+        """Per-query admission control, sized from host cores, accelerator
+        count, and the configured memory budget."""
+        if self._accountant is None:
+            import os as _os
+
+            try:
+                cores = len(_os.sched_getaffinity(0))
+            except AttributeError:
+                cores = _os.cpu_count() or 1
+            self._accountant = ResourceAccountant(
+                cpus=float(max(cores, self.num_workers)),
+                gpus=_accelerator_count,  # resolved only if a task asks
+                memory_bytes=self.cfg.memory_budget_bytes)
+        return self._accountant
 
     def finish_query(self) -> None:
         """Release buffer accounting and delete this query's spill files."""
@@ -315,9 +452,15 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
 
     name = op.name()
 
+    req = op_resource_request(op)
+
     def run_one(part):
         t0 = time.perf_counter_ns()
-        out = op.map_partition(part, ctx)
+        try:
+            out = op.map_partition(part, ctx)
+        finally:
+            if req:
+                ctx.accountant.release(req)
         dt = time.perf_counter_ns() - t0
         n = out.num_rows_or_none()
         rows = n if n is not None else 0
@@ -341,6 +484,11 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
             if ctx.stats.is_cancelled():
                 raise QueryCancelledError(f"query cancelled (at {name})")
             saw_any = True
+            if req:
+                # dispatch-loop admission (reference: pyrunner.py:352-370):
+                # block HERE, not on a worker thread, so admitted tasks
+                # always hold a thread and progress
+                ctx.accountant.admit(req)
             pending.append(pool.submit(run_one, part))
             while len(pending) >= window:
                 yield emit(pending.popleft().result())

@@ -765,7 +765,8 @@ class PyUdf(ExprNode):
 
     def __init__(self, fn: Callable, return_dtype: DataType, args: List[ExprNode],
                  fn_name: Optional[str] = None, batch_size: Optional[int] = None,
-                 concurrency: Optional[int] = None, init_args: Optional[tuple] = None):
+                 concurrency: Optional[int] = None, init_args: Optional[tuple] = None,
+                 resource_request: Optional[tuple] = None):
         self.fn = fn
         self.return_dtype = return_dtype
         self.args = args
@@ -773,6 +774,10 @@ class PyUdf(ExprNode):
         self.batch_size = batch_size
         self.concurrency = concurrency
         self.init_args = init_args
+        # (num_cpus, num_gpus, memory_bytes) — honored by the executor's
+        # admission gate (reference: ResourceRequest, common/resource-request,
+        # honored by PyRunner admission pyrunner.py:352-370)
+        self.resource_request = resource_request
 
     def name(self):
         return self.args[0].name() if self.args else self.fn_name
@@ -799,7 +804,7 @@ class PyUdf(ExprNode):
 
     def with_children(self, c):
         return PyUdf(self.fn, self.return_dtype, c, self.fn_name, self.batch_size,
-                     self.concurrency, self.init_args)
+                     self.concurrency, self.init_args, self.resource_request)
 
     def _key(self):
         return ("udf", id(self.fn), tuple(a._key() for a in self.args))
@@ -935,6 +940,22 @@ def expr_has_udf(e: "Expression") -> bool:
     """True if any node of the expression tree is a user function call."""
     def rec(n):
         return isinstance(n, PyUdf) or any(rec(c) for c in n.children())
+
+    return rec(e._node)
+
+
+def expr_udfs_parallel_safe(e: "Expression") -> bool:
+    """Whether morsels of this expression may evaluate concurrently. Plain
+    function UDFs (and bare class UDFs sharing one cached instance) carry
+    user state with no thread-safety contract; class UDFs running on an
+    actor pool (concurrency > 1) serialize calls per instance and are safe."""
+    import inspect
+
+    def rec(n):
+        if isinstance(n, PyUdf):
+            if not (inspect.isclass(n.fn) and (n.concurrency or 0) > 1):
+                return False
+        return all(rec(c) for c in n.children())
 
     return rec(e._node)
 

@@ -255,19 +255,59 @@ def infer_csv_schema(path: str, delimiter: str = ",", has_headers: bool = True,
 
 def read_json_table(path: str, pushdowns: Optional[Pushdowns] = None,
                     schema: Optional[Schema] = None, **_kw) -> Table:
+    """Streaming newline-delimited JSON reader (reference: the block-streamed
+    daft-json reader, src/daft-json/src/read.rs): parses fixed-size blocks,
+    DECODES ONLY the projected + filter columns (explicit_schema with
+    unexpected fields ignored), applies the residual filter per block, and
+    stops as soon as the limit is satisfied — a limit N query over a huge
+    file parses only its head."""
     pushdowns = pushdowns or Pushdowns()
-    arrow_tbl = pajson.read_json(open_input_bytes(path))
-    IO_STATS.bump(files_opened=1, bytes_read=arrow_tbl.nbytes, rows_read=arrow_tbl.num_rows,
-                  columns_read=arrow_tbl.num_columns)
-    tbl = Table.from_arrow(arrow_tbl)
     columns = None
-    if pushdowns.columns is not None:
-        columns = _project_columns(tbl.column_names, pushdowns)
-        tbl = tbl.select_columns([c for c in columns if c in tbl.schema])
+    parse_options = None
     if schema is not None:
-        want = schema.select([c for c in columns if c in schema]) if columns is not None else schema
-        tbl = tbl.cast_to_schema(want)
-    tbl = _residual_filter(tbl, pushdowns)
+        # decode exactly the known/projected fields: unexpected fields are
+        # ignored (fields appearing only in later blocks would otherwise be
+        # a parse error under block streaming)
+        if pushdowns.columns is not None:
+            columns = _project_columns(schema.field_names(), pushdowns)
+            want_names = [c for c in columns if c in schema]
+        else:
+            want_names = schema.field_names()
+        want_fields = [(c, schema[c].dtype.to_arrow()) for c in want_names]
+        if want_fields:
+            parse_options = pajson.ParseOptions(
+                explicit_schema=pa.schema(want_fields),
+                unexpected_field_behavior="ignore")
+    want = None
+    if schema is not None:
+        want = (schema.select([c for c in columns if c in schema])
+                if columns is not None else schema)
+    limit = pushdowns.limit
+    chunks = []
+    rows = 0
+    nbytes = 0
+    with pajson.open_json(open_input_bytes(path),
+                          parse_options=parse_options) as reader:
+        for batch in reader:
+            t = Table.from_arrow(pa.Table.from_batches([batch]))
+            nbytes += batch.nbytes
+            if want is not None:
+                t = t.cast_to_schema(want)
+            t = _residual_filter(t, pushdowns)
+            chunks.append(t)
+            rows += len(t)
+            if limit is not None and rows >= limit:
+                break
+    if not chunks:
+        tbl = Table.empty(want)
+    else:
+        tbl = Table.concat(chunks) if len(chunks) != 1 else chunks[0]
+    if limit is not None and len(tbl) > limit:
+        tbl = tbl.slice(0, limit)
+    IO_STATS.bump(files_opened=1, bytes_read=nbytes, rows_read=len(tbl),
+                  columns_read=tbl.num_columns())
+    if columns is not None:
+        tbl = tbl.select_columns([c for c in columns if c in tbl.schema])
     return _drop_filter_only_columns(tbl, pushdowns)
 
 

@@ -61,21 +61,37 @@ class PhysicalOp:
 
     def parallel_safe(self) -> bool:
         """Whether map_partition may run concurrently across morsels.
-        Function UDFs carry arbitrary user state with no thread-safety
-        contract, so any expression containing one forces sequential order
-        (class UDFs are safe: actor pools serialize per instance)."""
-        return not any(expr_has_udf(e) for e in self._map_exprs())
+        Function UDFs (and bare class UDFs sharing one instance) carry
+        arbitrary user state with no thread-safety contract, so they force
+        sequential order; class UDFs on an actor pool (concurrency > 1)
+        serialize per instance and stay morsel-parallel."""
+        from .expressions import expr_udfs_parallel_safe
+
+        return all(expr_udfs_parallel_safe(e) for e in self._map_exprs())
 
     def _map_exprs(self):
         return ()
 
     def _map_execute(self, inputs, ctx):
         """Sequential driver over map_partition — the single source of truth
-        shared with the parallel executor path."""
+        shared with the parallel executor path. Honors UDF resource requests
+        (fail-fast on impossible ones; reference: pyrunner.py:352-370)."""
+        from .execution import op_resource_request
+
+        req = op_resource_request(self)
+        if req:
+            ctx.accountant.check(req)
         saw = False
         for part in inputs[0]:
             saw = True
-            yield self.map_partition(part, ctx)
+            if req:
+                ctx.accountant.admit(req)
+            try:
+                out = self.map_partition(part, ctx)
+            finally:
+                if req:
+                    ctx.accountant.release(req)
+            yield out
         if not saw:
             yield from self.map_empty(ctx)
 

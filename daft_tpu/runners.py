@@ -198,7 +198,8 @@ class Runner:
 
             # AdaptivePlanner hands over already-optimized (sub)plans
             return AdaptivePlanner(
-                lambda p: self._run_plain(p, stats, optimized=True), stats).run(plan)
+                lambda p: self._run_plain(p, stats, optimized=True), stats,
+                cfg=ctx.execution_config).run(plan)
         return self._run_plain(plan, stats)
 
     def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],

@@ -109,9 +109,12 @@ class UDF:
         from .expressions import Expression, PyUdf, _as_expr_node
 
         nodes = [_as_expr_node(e) for e in exprs]
+        rr = None
+        if self.num_cpus or self.num_gpus or self.memory_bytes:
+            rr = (self.num_cpus, self.num_gpus, self.memory_bytes)
         return Expression(PyUdf(self.fn, self.return_dtype, nodes, fn_name=self.__name__,
                                 batch_size=self.batch_size, concurrency=self.concurrency,
-                                init_args=self.init_args))
+                                init_args=self.init_args, resource_request=rr))
 
     def with_init_args(self, *args, **kwargs) -> "UDF":
         return UDF(self.fn, self.return_dtype, self.batch_size, self.concurrency,

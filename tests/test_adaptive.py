@@ -94,3 +94,57 @@ class TestAqeShapes:
         q.stats = stats
         assert q.to_pydict()["k"] == [2]
         assert stats.snapshot()["counters"].get("aqe_stages", 0) == 0
+
+
+class TestShuffleCountAdaptation:
+    def test_tiny_input_shrinks_fanout(self, aqe):
+        # 100 tiny rows fanned out 50 ways: the adapted plan collapses the
+        # shuffle to 1 partition (shrink-only, based on KNOWN source size)
+        df = (dt.from_pydict({"g": list(range(100)), "v": [1.0] * 100})
+              .repartition(50, col("g"))
+              .groupby("g").agg(col("v").sum().alias("s")))
+        q = df.collect()
+        counters = q.stats.snapshot()["counters"]
+        assert counters.get("aqe_shuffle_resizes", 0) >= 1, counters
+        got = q.sort("g").to_pydict()
+        assert got["g"] == list(range(100))
+        assert got["s"] == [1.0] * 100
+
+    def test_large_input_keeps_fanout(self, aqe):
+        from daft_tpu.adaptive import adapt_shuffle_counts
+        from daft_tpu.context import get_context
+        from daft_tpu.logical import Repartition
+
+        cfg = get_context().execution_config
+        old = cfg.shuffle_target_partition_bytes
+        cfg.shuffle_target_partition_bytes = 64  # absurdly small target
+        try:
+            df = dt.from_pydict({"g": list(range(1000)),
+                                 "v": [1.0] * 1000}).repartition(4, col("g"))
+            plan = adapt_shuffle_counts(df._plan, cfg)
+
+            def find(p):
+                if isinstance(p, Repartition):
+                    return p
+                for c in p.children():
+                    f = find(c)
+                    if f is not None:
+                        return f
+                return None
+
+            rep = find(plan)
+            assert rep is not None and rep.num == 4  # never grows
+        finally:
+            cfg.shuffle_target_partition_bytes = old
+
+    def test_adaptation_is_shrink_only_and_parity(self, aqe):
+        rng = np.random.RandomState(0)
+        data = {"g": rng.randint(0, 30, 5000), "v": rng.rand(5000)}
+        q = (dt.from_pydict(data).repartition(40, col("g"))
+             .groupby("g").agg(col("v").sum().alias("s")).sort("g"))
+        got = q.to_pydict()
+        set_execution_config(enable_aqe=False)
+        want = (dt.from_pydict(data).repartition(40, col("g"))
+                .groupby("g").agg(col("v").sum().alias("s")).sort("g")).to_pydict()
+        assert got["g"] == want["g"]
+        np.testing.assert_allclose(got["s"], want["s"], rtol=1e-12)

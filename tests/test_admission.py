@@ -1,0 +1,211 @@
+"""Resource-aware admission: UDF ResourceRequests are honored by the
+executor (reference: ResourceRequest, src/common/resource-request, honored by
+the PyRunner admission loop, daft/runners/pyrunner.py:352-370)."""
+
+import threading
+import time
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import DataType, col, udf
+from daft_tpu.execution import (ResourceAccountant, ResourceRequest,
+                                op_resource_request)
+
+
+class TestAccountant:
+    def test_admit_release_cycle(self):
+        acc = ResourceAccountant(cpus=2.0, gpus=0.0, memory_bytes=1000)
+        r = ResourceRequest(num_cpus=1.0, memory_bytes=400)
+        acc.admit(r)
+        acc.admit(r)
+        assert not acc._fits(r)  # 0 cpus / 200 bytes left
+        acc.release(r)
+        assert acc._fits(r)
+
+    def test_impossible_requests_fail_fast(self):
+        acc = ResourceAccountant(cpus=4.0, gpus=1.0, memory_bytes=1000)
+        with pytest.raises(RuntimeError, match="CPUs"):
+            acc.admit(ResourceRequest(num_cpus=5.0))
+        with pytest.raises(RuntimeError, match="accelerator"):
+            acc.admit(ResourceRequest(num_gpus=2.0))
+        with pytest.raises(RuntimeError, match="memory budget"):
+            acc.admit(ResourceRequest(memory_bytes=2000))
+
+    def test_blocking_admission_unblocks_on_release(self):
+        acc = ResourceAccountant(cpus=1.0, gpus=0.0, memory_bytes=None)
+        r = ResourceRequest(num_cpus=1.0)
+        acc.admit(r)
+        admitted = threading.Event()
+
+        def waiter():
+            acc.admit(r)
+            admitted.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not admitted.is_set()  # still blocked
+        acc.release(r)
+        assert admitted.wait(timeout=2.0)
+        t.join(timeout=2.0)
+
+
+class TestRequestExtraction:
+    def test_udf_request_reaches_the_op(self):
+        @udf(return_dtype=DataType.int64(), num_cpus=2, memory_bytes=123)
+        def f(x):
+            return x
+
+        df = dt.from_pydict({"x": [1, 2, 3]}).select(f(col("x")))
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import ProjectOp, translate
+
+        phys = translate(optimize(df._plan), dt.context.get_context().execution_config)
+
+        def find(op):
+            if isinstance(op, ProjectOp):
+                return op
+            for c in op.children:
+                got = find(c)
+                if got is not None:
+                    return got
+            return None
+
+        proj = find(phys)
+        req = op_resource_request(proj)
+        assert req.num_cpus == 2 and req.memory_bytes == 123
+
+    def test_two_udfs_sum(self):
+        @udf(return_dtype=DataType.int64(), num_cpus=1)
+        def f(x):
+            return x
+
+        @udf(return_dtype=DataType.int64(), memory_bytes=50)
+        def g(x):
+            return x
+
+        df = dt.from_pydict({"x": [1]}).select(f(col("x")).alias("a"),
+                                               g(col("x")).alias("b"))
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        phys = translate(optimize(df._plan), dt.context.get_context().execution_config)
+        # walk to any op carrying both udfs
+        reqs = []
+
+        def walk(op):
+            reqs.append(op_resource_request(op))
+            for c in op.children:
+                walk(c)
+
+        walk(phys)
+        total = max(reqs, key=lambda r: (r.num_cpus, r.memory_bytes))
+        assert total.num_cpus == 1 and total.memory_bytes == 50
+
+
+class TestEndToEnd:
+    def test_impossible_cpu_request_raises(self):
+        @udf(return_dtype=DataType.int64(), num_cpus=10_000)
+        def f(x):
+            return x
+
+        with pytest.raises(RuntimeError, match="CPUs"):
+            dt.from_pydict({"x": [1, 2]}).select(f(col("x"))).collect()
+
+    def test_accelerator_request_on_cpu_host_raises(self):
+        # tests run on a CPU mesh: zero non-cpu jax devices exist
+        @udf(return_dtype=DataType.int64(), num_gpus=1)
+        def f(x):
+            return x
+
+        with pytest.raises(RuntimeError, match="accelerator"):
+            dt.from_pydict({"x": [1, 2]}).select(f(col("x"))).collect()
+
+    def test_memory_request_over_budget_raises(self):
+        cfg = dt.context.get_context().execution_config
+        old = cfg.memory_budget_bytes
+        cfg.memory_budget_bytes = 1024
+        try:
+            @udf(return_dtype=DataType.int64(), memory_bytes=10 * 1024)
+            def f(x):
+                return x
+
+            with pytest.raises(RuntimeError, match="memory budget"):
+                dt.from_pydict({"x": [1, 2]}).select(f(col("x"))).collect()
+        finally:
+            cfg.memory_budget_bytes = old
+
+    def test_satisfiable_request_runs(self):
+        @udf(return_dtype=DataType.int64(), num_cpus=1, memory_bytes=1024)
+        def double(x):
+            import pyarrow.compute as pc
+
+            return pc.multiply(x.to_arrow(), 2)
+
+        got = dt.from_pydict({"x": [1, 2, 3]}).select(double(col("x"))).to_pydict()
+        assert got == {"x": [2, 4, 6]}
+
+    def test_cpu_request_limits_task_concurrency(self, monkeypatch):
+        # actor-pool class UDF (morsel-parallel eligible) with num_cpus sized
+        # so at most 2 TASKS may be admitted at once despite 4 workers; the
+        # accountant is instrumented to observe in-flight admissions
+        cfg = dt.context.get_context().execution_config
+        old_threads = cfg.executor_threads
+        old_morsel = cfg.default_morsel_size
+        cfg.executor_threads = 4
+        cfg.default_morsel_size = 10
+        try:
+            import os
+
+            from daft_tpu.execution import ResourceAccountant
+
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except AttributeError:
+                cores = os.cpu_count() or 1
+            cpus_cap = float(max(cores, 4))
+            per_task = cpus_cap / 2  # exactly 2 concurrent tasks fit
+
+            lock = threading.Lock()
+            inflight = [0]
+            peak = [0]
+            admits = [0]
+            orig_admit = ResourceAccountant.admit
+            orig_release = ResourceAccountant.release
+
+            def admit(self, req):
+                orig_admit(self, req)
+                with lock:
+                    admits[0] += 1
+                    inflight[0] += 1
+                    peak[0] = max(peak[0], inflight[0])
+
+            def release(self, req):
+                with lock:
+                    inflight[0] -= 1
+                orig_release(self, req)
+
+            monkeypatch.setattr(ResourceAccountant, "admit", admit)
+            monkeypatch.setattr(ResourceAccountant, "release", release)
+
+            @udf(return_dtype=DataType.int64(), num_cpus=per_task,
+                 concurrency=4)  # actor pool -> morsel-parallel eligible
+            class Track:
+                def __init__(self):
+                    pass
+
+                def __call__(self, x):
+                    time.sleep(0.005)
+                    return x
+
+            df = (dt.from_pydict({"x": list(range(200))}).repartition(20)
+                  .select(Track(col("x"))))
+            got = df.to_pydict()
+            assert sorted(got["x"]) == list(range(200))
+            assert admits[0] >= 10, "admission gate was not exercised per task"
+            assert peak[0] <= 2, f"{peak[0]} tasks admitted concurrently"
+            assert peak[0] == 2, "parallel dispatch never had 2 tasks in flight"
+        finally:
+            cfg.executor_threads = old_threads
+            cfg.default_morsel_size = old_morsel
