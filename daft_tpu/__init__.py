@@ -7,6 +7,15 @@ partition parallelism mapped onto a jax.sharding Mesh (shuffles = all_to_all ove
 """
 
 from .datatypes import DataType, TypeKind
+from .errors import (
+    DaftError,
+    DaftIOError,
+    DaftNotFoundError,
+    DaftResourceError,
+    DaftSchemaError,
+    DaftTypeError,
+    DaftValueError,
+)
 from .schema import Field, Schema
 from .series import Series
 
@@ -18,6 +27,13 @@ __all__ = [
     "Field",
     "Schema",
     "Series",
+    "DaftError",
+    "DaftTypeError",
+    "DaftValueError",
+    "DaftSchemaError",
+    "DaftNotFoundError",
+    "DaftIOError",
+    "DaftResourceError",
 ]
 
 

@@ -99,6 +99,10 @@ class DaftContext:
         self.execution_config = ExecutionConfig()
         self._runner = None
         self._runner_name = os.environ.get("DAFT_TPU_RUNNER", "native")
+        if os.environ.get("DAFT_TPU_PROGRESS") == "1":
+            from . import tracing
+
+            tracing.progress_bars(True)
 
     @classmethod
     def get(cls) -> "DaftContext":

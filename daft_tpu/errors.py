@@ -1,0 +1,38 @@
+"""Engine error hierarchy (reference: DaftError, src/common/error/error.rs).
+
+Every class dual-inherits the builtin exception users would naturally catch,
+so `except ValueError` keeps working while `except DaftError` catches all
+engine-raised failures. Raise sites adopt these types incrementally; the
+public contract is the hierarchy itself."""
+
+from __future__ import annotations
+
+
+class DaftError(Exception):
+    """Base of every engine-raised error (reference: DaftError enum)."""
+
+
+class DaftTypeError(DaftError, TypeError):
+    """Expression/kernel type mismatch (reference: DaftError::TypeError)."""
+
+
+class DaftValueError(DaftError, ValueError):
+    """Invalid argument or value (reference: DaftError::ValueError)."""
+
+
+class DaftSchemaError(DaftError, ValueError):
+    """Schema resolution failure: unknown column, incompatible field
+    (reference: DaftError::SchemaMismatch / FieldNotFound)."""
+
+
+class DaftNotFoundError(DaftError, FileNotFoundError):
+    """Missing file/table/catalog object (reference: DaftError::FileNotFound)."""
+
+
+class DaftIOError(DaftError, IOError):
+    """IO failure after retries (reference: DaftError::External on IO)."""
+
+
+class DaftResourceError(DaftError, RuntimeError):
+    """Unsatisfiable resource request (reference: admission failure in
+    pyrunner.py:352-370)."""

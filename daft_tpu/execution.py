@@ -97,16 +97,18 @@ class ResourceAccountant:
 
     def check(self, req: ResourceRequest) -> None:
         """Raise if the request can NEVER be admitted on this host."""
+        from .errors import DaftResourceError
+
         if req.num_cpus > self.total_cpus:
-            raise RuntimeError(
+            raise DaftResourceError(
                 f"task requests {req.num_cpus} CPUs but only "
                 f"{self.total_cpus} exist")
         if req.num_gpus and req.num_gpus > self.total_gpus:
-            raise RuntimeError(
+            raise DaftResourceError(
                 f"task requests {req.num_gpus} accelerator(s) but only "
                 f"{self.total_gpus} exist")
         if self.total_memory is not None and req.memory_bytes > self.total_memory:
-            raise RuntimeError(
+            raise DaftResourceError(
                 f"task requests {req.memory_bytes} bytes but the memory "
                 f"budget is {self.total_memory}")
 
@@ -429,6 +431,9 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
         finally:
             ctx.shutdown_pool()
             ctx.finish_query()
+            from . import tracing
+
+            tracing.query_finished()
 
     return rooted()
 
@@ -496,7 +501,10 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
             yield emit(pending.popleft().result())
     finally:
         for f in pending:
-            f.cancel()
+            # a queued task that never ran still holds its admission
+            # reservation: return it, or a later admit() waits forever
+            if f.cancel() and req:
+                ctx.accountant.release(req)
     if not saw_any:
         yield from op.map_empty(ctx)
 

@@ -31,6 +31,7 @@ from typing import Any, Callable, List, Optional, Union
 import pyarrow as pa
 
 from ..datatypes import DataType
+from ..errors import DaftNotFoundError
 from ..schema import Field, Schema
 from .scan import FileFormat, Pushdowns, ScanTask
 
@@ -53,7 +54,7 @@ def _delta_live_files(table_uri: str) -> List[dict]:
     whose older JSON commits were vacuumed by log retention."""
     log_dir = os.path.join(table_uri, "_delta_log")
     if not os.path.isdir(log_dir):
-        raise FileNotFoundError(f"not a Delta table (no _delta_log): {table_uri}")
+        raise DaftNotFoundError(f"not a Delta table (no _delta_log): {table_uri}")
     live: dict = {}
     start_after = -1
     lc_path = os.path.join(log_dir, "_last_checkpoint")
@@ -87,7 +88,7 @@ def _delta_live_files(table_uri: str) -> List[dict]:
     commits = sorted(f for f in os.listdir(log_dir) if f.endswith(".json"))
     commits = [c for c in commits if int(c.split(".")[0]) > start_after]
     if not commits and start_after < 0:
-        raise FileNotFoundError(f"Delta table has no commits: {table_uri}")
+        raise DaftNotFoundError(f"Delta table has no commits: {table_uri}")
     for name in commits:
         with open(os.path.join(log_dir, name)) as f:
             for line in f:
@@ -147,7 +148,7 @@ def _iceberg_metadata_path(table_uri: str) -> str:
     version-hint.text, else the highest-versioned *.metadata.json."""
     mdir = os.path.join(table_uri, "metadata")
     if not os.path.isdir(mdir):
-        raise FileNotFoundError(f"not an Iceberg table (no metadata/): {table_uri}")
+        raise DaftNotFoundError(f"not an Iceberg table (no metadata/): {table_uri}")
     hint = os.path.join(mdir, "version-hint.text")
     if os.path.exists(hint):
         with open(hint) as f:
@@ -158,7 +159,7 @@ def _iceberg_metadata_path(table_uri: str) -> str:
                 return p
     metas = [f for f in os.listdir(mdir) if f.endswith(".metadata.json")]
     if not metas:
-        raise FileNotFoundError(f"Iceberg table has no metadata json: {table_uri}")
+        raise DaftNotFoundError(f"Iceberg table has no metadata json: {table_uri}")
 
     def version_of(name: str) -> int:
         stem = name.split(".metadata.json")[0].lstrip("v")
@@ -284,7 +285,7 @@ def read_hudi_scan(table_uri: str):
     (log files) are rejected."""
     hoodie = os.path.join(table_uri, ".hoodie")
     if not os.path.isdir(hoodie):
-        raise FileNotFoundError(f"not a Hudi table (no .hoodie): {table_uri}")
+        raise DaftNotFoundError(f"not a Hudi table (no .hoodie): {table_uri}")
     timeline = os.listdir(hoodie)
     if any(f.endswith(".deltacommit") or f.endswith(".deltacommit.requested")
            or f.endswith(".deltacommit.inflight") for f in timeline):
@@ -293,7 +294,7 @@ def read_hudi_scan(table_uri: str):
     commits = sorted(f for f in timeline
                      if f.endswith(".commit") or f.endswith(".replacecommit"))
     if not commits:
-        raise FileNotFoundError(f"Hudi table has no completed commits: {table_uri}")
+        raise DaftNotFoundError(f"Hudi table has no completed commits: {table_uri}")
     # latest slice per file group: walk data files, parse hudi names
     # <fileId>_<writeToken>_<instantTime>.parquet
     latest: dict = {}

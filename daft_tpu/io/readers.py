@@ -285,11 +285,13 @@ def read_json_table(path: str, pushdowns: Optional[Pushdowns] = None,
     limit = pushdowns.limit
     chunks = []
     rows = 0
+    parsed = 0
     nbytes = 0
     with pajson.open_json(open_input_bytes(path),
                           parse_options=parse_options) as reader:
         for batch in reader:
             t = Table.from_arrow(pa.Table.from_batches([batch]))
+            parsed += len(t)
             nbytes += batch.nbytes
             if want is not None:
                 t = t.cast_to_schema(want)
@@ -304,10 +306,9 @@ def read_json_table(path: str, pushdowns: Optional[Pushdowns] = None,
         tbl = Table.concat(chunks) if len(chunks) != 1 else chunks[0]
     if limit is not None and len(tbl) > limit:
         tbl = tbl.slice(0, limit)
-    IO_STATS.bump(files_opened=1, bytes_read=nbytes, rows_read=len(tbl),
+    # rows_read = rows PARSED (pre-filter), matching the CSV/parquet readers
+    IO_STATS.bump(files_opened=1, bytes_read=nbytes, rows_read=parsed,
                   columns_read=tbl.num_columns())
-    if columns is not None:
-        tbl = tbl.select_columns([c for c in columns if c in tbl.schema])
     return _drop_filter_only_columns(tbl, pushdowns)
 
 

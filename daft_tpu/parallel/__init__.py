@@ -11,10 +11,14 @@ plane on ICI, control plane (bucket assignment, capacity negotiation) on host.
 
 from .collectives import build_exchange, exchange_capacity
 from .mesh_exec import MeshExecutionContext, default_mesh
+from .multihost import global_mesh, init_distributed, process_local_slots
 
 __all__ = [
     "build_exchange",
     "exchange_capacity",
     "MeshExecutionContext",
     "default_mesh",
+    "global_mesh",
+    "init_distributed",
+    "process_local_slots",
 ]

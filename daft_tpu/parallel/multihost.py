@@ -1,0 +1,76 @@
+"""Multi-host runtime: the DCN story for scaling past one process.
+
+Role-equivalent to the reference's RayRunner control plane
+(daft/runners/ray_runner.py:504-685 — driver dispatch across nodes) —
+redesigned for TPU pods: jax's distributed runtime connects processes over
+DCN (one process per host, each owning its local chips), a single global
+`jax.sharding.Mesh` spans every chip, and the SAME collective exchange
+(collectives.build_exchange) moves shuffle payloads — XLA routes
+intra-slice traffic over ICI and cross-slice traffic over DCN, no NCCL/MPI
+and no object store.
+
+Topology contract (mirrors the single-process mesh runner):
+- partition i lives on global device i; a process stages shards only for
+  its ADDRESSABLE devices (jax.make_array_from_single_device_arrays
+  assembles the global array from per-process locals);
+- the control plane (bucket assignment, capacity negotiation) runs
+  identically on every process from the same host-side inputs, so no extra
+  coordination round is needed beyond the collective itself.
+
+Bootstrap: call `init_distributed()` on every process (or set
+DAFT_TPU_COORDINATOR / DAFT_TPU_NUM_PROCESSES / DAFT_TPU_PROCESS_ID and it
+is picked up automatically), then build `global_mesh()` and hand it to
+MeshRunner. On TPU pods jax infers everything from the TPU environment, so
+`init_distributed()` with no arguments is enough.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+_INITIALIZED = [False]
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Connect this process to the jax distributed runtime (idempotent).
+
+    Arguments default from DAFT_TPU_COORDINATOR / DAFT_TPU_NUM_PROCESSES /
+    DAFT_TPU_PROCESS_ID; on TPU pods all three may be omitted entirely
+    (jax reads the TPU topology). Returns True when the distributed runtime
+    is (now) initialized, False when no coordinator is configured."""
+    if _INITIALIZED[0]:
+        return True
+    coordinator = coordinator or os.environ.get("DAFT_TPU_COORDINATOR")
+    num_processes = num_processes if num_processes is not None else (
+        int(os.environ["DAFT_TPU_NUM_PROCESSES"])
+        if "DAFT_TPU_NUM_PROCESSES" in os.environ else None)
+    process_id = process_id if process_id is not None else (
+        int(os.environ["DAFT_TPU_PROCESS_ID"])
+        if "DAFT_TPU_PROCESS_ID" in os.environ else None)
+    if coordinator is None and num_processes is None:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _INITIALIZED[0] = True
+    return True
+
+
+def global_mesh(axis: str = "parts"):
+    """A 1-D mesh over every device of every connected process."""
+    return jax.sharding.Mesh(np.array(jax.devices()), (axis,))
+
+
+def process_local_slots(mesh) -> list:
+    """Global mesh slot indices whose device is addressable from this
+    process — the partitions this process is responsible for staging."""
+    devs = list(mesh.devices.flat)
+    local = set(jax.local_devices())
+    return [i for i, d in enumerate(devs) if d in local]

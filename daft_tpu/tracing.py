@@ -117,3 +117,64 @@ def report_progress(op_name: str, rows: int) -> None:
     cb = _progress_cb
     if cb is not None:
         cb(op_name, rows)
+
+
+class ProgressBar:
+    """Terminal progress UI (reference: daft/runners/progress_bar.py): one
+    tqdm bar per operator when tqdm is importable, a plain carriage-return
+    line otherwise. Enable with `progress_bars()` (or DAFT_TPU_PROGRESS=1,
+    wired in context.py); disable with `progress_bars(False)`."""
+
+    def __init__(self, use_tqdm: Optional[bool] = None):
+        if use_tqdm is None:
+            try:
+                import tqdm  # noqa: F401
+
+                use_tqdm = True
+            except ImportError:
+                use_tqdm = False
+        self._use_tqdm = use_tqdm
+        self._bars = {}
+        self._counts = {}
+
+    def __call__(self, op_name: str, rows: int) -> None:
+        if self._use_tqdm:
+            from tqdm import tqdm
+
+            bar = self._bars.get(op_name)
+            if bar is None:
+                bar = self._bars[op_name] = tqdm(
+                    desc=op_name, unit=" rows", position=len(self._bars),
+                    leave=False)
+            bar.update(rows)
+        else:
+            import sys
+
+            self._counts[op_name] = self._counts.get(op_name, 0) + rows
+            line = " | ".join(f"{k}: {v:,}" for k, v in self._counts.items())
+            print("\r" + line[:160], end="", file=sys.stderr, flush=True)
+
+    def close(self) -> None:
+        for bar in self._bars.values():
+            bar.close()
+        self._bars.clear()
+        if self._counts:
+            import sys
+
+            print("", file=sys.stderr)
+        self._counts.clear()
+
+
+def query_finished() -> None:
+    """Close per-query progress state (bars restart fresh next query)."""
+    cb = _progress_cb
+    if isinstance(cb, ProgressBar):
+        cb.close()
+
+
+def progress_bars(enable: bool = True) -> None:
+    """Toggle terminal progress reporting for subsequent queries."""
+    global _progress_cb
+    if isinstance(_progress_cb, ProgressBar):
+        _progress_cb.close()
+    set_progress_callback(ProgressBar() if enable else None)

@@ -1,0 +1,75 @@
+"""Worker for the multi-host test: one process of a 2-process jax cluster.
+
+Run: python multihost_worker.py <process_id> <num_processes> <port>
+Each process owns 4 virtual CPU devices; the global mesh spans 8. The
+shuffle exchange (collectives.build_exchange) runs across the distributed
+runtime — the CPU stand-in for ICI+DCN on a real pod."""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=4").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from daft_tpu.parallel.collectives import build_exchange, exchange_capacity  # noqa: E402
+from daft_tpu.parallel.multihost import (global_mesh, init_distributed,  # noqa: E402
+                                         process_local_slots)
+
+assert init_distributed(f"localhost:{port}", nproc, pid)
+n = len(jax.devices())
+assert n == 4 * nproc, f"expected {4 * nproc} global devices, got {n}"
+assert len(jax.local_devices()) == 4
+
+mesh = global_mesh()
+slots = process_local_slots(mesh)
+assert len(slots) == 4
+
+# identical control plane on every process (same seed)
+r = 64
+rng = np.random.RandomState(0)
+vals = rng.randint(0, 1000, size=(n, r)).astype(np.int64)
+bucket = (vals % n).astype(np.int32)
+valid = np.ones((n, r), dtype=bool)
+cap = exchange_capacity(list(bucket), [None] * n, n)
+fn = build_exchange(mesh, cap, (np.dtype(np.int64),), ((),))
+
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+devs = list(mesh.devices.flat)
+local = set(jax.local_devices())
+
+
+def put(arr):
+    sh = NamedSharding(mesh, P(mesh.axis_names[0], *([None] * (arr.ndim - 1))))
+    shards = [jax.device_put(arr[i:i + 1], d)
+              for i, d in enumerate(devs) if d in local]
+    return jax.make_array_from_single_device_arrays(arr.shape, sh, shards)
+
+
+rv, rc = fn(put(bucket), put(valid), put(vals))
+
+for sv, sc in zip(rv.addressable_shards, rc.addressable_shards):
+    d = devs.index(sv.device)
+    mask = np.asarray(sv.data)[0].reshape(-1)
+    rows = np.asarray(sc.data)[0].reshape(-1)[mask]
+    assert (rows % n == d).all(), f"device {d} received foreign rows"
+    want = np.sort(vals[bucket == d])
+    got = np.sort(rows)
+    assert np.array_equal(got, want), (
+        f"device {d}: got {len(got)} rows, want {len(want)}")
+
+print(f"MULTIHOST_OK {pid}", flush=True)
