@@ -392,6 +392,20 @@ class DataFrame:
         pc = _to_exprs(partition_cols) if partition_cols else None
         return DataFrame(Write(self._plan, root_dir, "json", None, pc)).collect()
 
+    def write_iceberg(self, table_uri: str, mode: str = "append") -> "DataFrame":
+        """Write this DataFrame as an Iceberg v2 snapshot commit (reference:
+        daft/dataframe/dataframe.py write_iceberg; no client library — the
+        avro manifests are encoded natively by io/avro.py). mode: append |
+        overwrite | error. Returns a DataFrame of the added file paths."""
+        from .io.catalogs import write_iceberg_table
+
+        self.collect()
+        arrow_tables = [p.to_arrow() for p in self._result.partitions]
+        added = write_iceberg_table(table_uri, arrow_tables, mode=mode)
+        from .api import from_pydict
+
+        return from_pydict({"path": added})
+
     def write_deltalake(self, table_uri: str, mode: str = "append") -> "DataFrame":
         """Write this DataFrame as a Delta Lake table commit (reference:
         daft/dataframe/dataframe.py write_deltalake). mode: append |

@@ -143,6 +143,18 @@ _ICEBERG_PRIMITIVES = {
 }
 
 
+def _metadata_version_of(name: str) -> int:
+    """Version number of a vN.metadata.json / N-uuid.metadata.json name
+    (shared by the metadata resolver and the writer's next-version pick)."""
+    stem = name.split(".metadata.json")[0].lstrip("v")
+    for tok in (stem, stem.split("-")[0]):
+        try:
+            return int(tok)
+        except ValueError:
+            continue
+    return -1
+
+
 def _iceberg_metadata_path(table_uri: str) -> str:
     """Resolve the current metadata json (hadoop-catalog layout): honor
     version-hint.text, else the highest-versioned *.metadata.json."""
@@ -160,17 +172,7 @@ def _iceberg_metadata_path(table_uri: str) -> str:
     metas = [f for f in os.listdir(mdir) if f.endswith(".metadata.json")]
     if not metas:
         raise DaftNotFoundError(f"Iceberg table has no metadata json: {table_uri}")
-
-    def version_of(name: str) -> int:
-        stem = name.split(".metadata.json")[0].lstrip("v")
-        for tok in (stem, stem.split("-")[0]):
-            try:
-                return int(tok)
-            except ValueError:
-                continue
-        return -1
-
-    return os.path.join(mdir, max(metas, key=version_of))
+    return os.path.join(mdir, max(metas, key=_metadata_version_of))
 
 
 def _iceberg_resolve(table_uri: str, uri: str) -> str:
@@ -322,6 +324,170 @@ def read_hudi_scan(table_uri: str):
     schema = _schema_from_parquet(files[0])
     tasks = [ScanTask(p, FileFormat.PARQUET, schema, Pushdowns()) for p in files]
     return schema, tasks
+
+
+# ---------------------------------------------------------------------------
+# Iceberg writer (native manifests via io/avro.py)
+# ---------------------------------------------------------------------------
+
+_ARROW_TO_ICEBERG = [
+    (pa.types.is_int64, "long"), (pa.types.is_int32, "int"),
+    (pa.types.is_float64, "double"), (pa.types.is_float32, "float"),
+    (pa.types.is_boolean, "boolean"), (pa.types.is_date, "date"),
+    (pa.types.is_binary, "binary"), (pa.types.is_large_binary, "binary"),
+    (pa.types.is_string, "string"), (pa.types.is_large_string, "string"),
+]
+
+_MANIFEST_ENTRY_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"]},
+        {"name": "data_file", "type": {"type": "record", "name": "r2", "fields": [
+            {"name": "content", "type": "int"},
+            {"name": "file_path", "type": "string"},
+            {"name": "file_format", "type": "string"},
+            {"name": "partition", "type": {"type": "record", "name": "r102",
+                                           "fields": []}},
+            {"name": "record_count", "type": "long"},
+            {"name": "file_size_in_bytes", "type": "long"},
+        ]}},
+    ]}
+
+_MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+        {"name": "content", "type": "int"},
+        {"name": "added_snapshot_id", "type": "long"},
+    ]}
+
+
+def _iceberg_type(t: pa.DataType) -> str:
+    if pa.types.is_timestamp(t):
+        return "timestamp"
+    for pred, name in _ARROW_TO_ICEBERG:
+        if pred(t):
+            return name
+    raise ValueError(f"no Iceberg type for arrow {t}")
+
+
+def write_iceberg_table(table_uri: str, arrow_tables: List[pa.Table],
+                        mode: str = "append") -> List[str]:
+    """Native Iceberg v2 commit: data parquet files, a manifest for the new
+    files, a manifest list (append keeps prior manifests), and a new
+    metadata json published put-if-absent (O_EXCL) with version-hint update —
+    the hadoop-catalog commit protocol. mode: append | overwrite | error.
+    Reference: the write path behind daft's write_iceberg
+    (daft/dataframe/dataframe.py), which delegates to pyiceberg; here the
+    manifests are encoded natively by io/avro.py."""
+    import time as _time
+    import uuid as _uuid
+
+    import pyarrow.parquet as papq
+
+    from .avro import read_avro_file, write_avro_file
+
+    if mode not in ("append", "overwrite", "error"):
+        raise ValueError(f"invalid mode {mode!r}")
+    if not arrow_tables:
+        raise ValueError("write_iceberg needs at least one partition")
+    mdir = os.path.join(table_uri, "metadata")
+    ddir = os.path.join(table_uri, "data")
+    exists = os.path.isdir(mdir) and any(
+        f.endswith(".metadata.json") for f in os.listdir(mdir))
+    if exists and mode == "error":
+        raise FileExistsError(f"Iceberg table already exists: {table_uri}")
+    os.makedirs(mdir, exist_ok=True)
+    os.makedirs(ddir, exist_ok=True)
+
+    prev_meta = None
+    prev_version = 0
+    prior_manifests: List[dict] = []
+    if exists:
+        with open(_iceberg_metadata_path(table_uri)) as f:
+            prev_meta = json.load(f)
+        prev_version = max(
+            (v for v in (_metadata_version_of(n) for n in os.listdir(mdir)
+                         if n.endswith(".metadata.json")) if v >= 0),
+            default=0)
+        if mode == "append":
+            sid = prev_meta.get("current-snapshot-id")
+            snap = next((s for s in (prev_meta.get("snapshots") or [])
+                         if s.get("snapshot-id") == sid), None)
+            if snap is not None and snap.get("manifest-list"):
+                _, prior_manifests = read_avro_file(
+                    _iceberg_resolve(table_uri, snap["manifest-list"]))
+            elif snap is not None and snap.get("manifests"):
+                # v1 inline manifest paths: lift into manifest_file records
+                # so the appended table's view keeps the existing data
+                for mp in snap["manifests"]:
+                    resolved = _iceberg_resolve(table_uri, mp)
+                    prior_manifests.append({
+                        "manifest_path": mp,
+                        "manifest_length": os.path.getsize(resolved),
+                        "partition_spec_id": 0, "content": 0,
+                        "added_snapshot_id": sid or 0})
+
+    # random 63-bit id (the spec's convention): same-millisecond commits and
+    # concurrent writers must never collide on snap-<id>.avro
+    snapshot_id = int.from_bytes(os.urandom(8), "big") >> 1
+    commit_ts = int(_time.time() * 1000)
+    added: List[str] = []
+    entries: List[dict] = []
+    for t in arrow_tables:
+        if t.num_rows == 0:
+            continue
+        rel = f"data/{_uuid.uuid4()}.parquet"
+        full = os.path.join(table_uri, rel)
+        papq.write_table(t, full)
+        added.append(full)
+        entries.append({"status": 1, "snapshot_id": snapshot_id,
+                        "data_file": {"content": 0,
+                                      "file_path": f"file://{table_uri}/{rel}",
+                                      "file_format": "PARQUET", "partition": {},
+                                      "record_count": t.num_rows,
+                                      "file_size_in_bytes": os.path.getsize(full)}})
+    manifest_rel = f"metadata/{_uuid.uuid4()}-m0.avro"
+    manifest_full = os.path.join(table_uri, manifest_rel)
+    write_avro_file(manifest_full, _MANIFEST_ENTRY_SCHEMA, entries)
+    mlist_records = list(prior_manifests) if mode == "append" else []
+    mlist_records.append({
+        "manifest_path": f"file://{table_uri}/{manifest_rel}",
+        "manifest_length": os.path.getsize(manifest_full),
+        "partition_spec_id": 0, "content": 0,
+        "added_snapshot_id": snapshot_id})
+    mlist_rel = f"metadata/snap-{snapshot_id}.avro"
+    write_avro_file(os.path.join(table_uri, mlist_rel),
+                    _MANIFEST_LIST_SCHEMA, mlist_records)
+
+    schema_src = next((t for t in arrow_tables if t.num_rows), arrow_tables[0])
+    fields = [{"id": i + 1, "name": f.name, "type": _iceberg_type(f.type),
+               "required": False} for i, f in enumerate(schema_src.schema)]
+    version = prev_version + 1
+    meta = {
+        "format-version": 2,
+        "table-uuid": (prev_meta or {}).get("table-uuid", str(_uuid.uuid4())),
+        "location": table_uri,
+        "current-snapshot-id": snapshot_id,
+        "snapshots": ((prev_meta or {}).get("snapshots") or []) + [{
+            "snapshot-id": snapshot_id,
+            "timestamp-ms": commit_ts,
+            "manifest-list": f"file://{table_uri}/{mlist_rel}"}],
+        "schemas": [{"schema-id": 0, "type": "struct", "fields": fields}],
+        "current-schema-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": []}],
+    }
+    meta_path = os.path.join(mdir, f"v{version}.metadata.json")
+    # put-if-absent commit: a concurrent writer racing to the same version loses
+    fd = os.open(meta_path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+    try:
+        os.write(fd, json.dumps(meta).encode())
+    finally:
+        os.close(fd)
+    with open(os.path.join(mdir, "version-hint.text"), "w") as f:
+        f.write(str(version))
+    return added
 
 
 # ---------------------------------------------------------------------------

@@ -55,7 +55,15 @@ def init_distributed(coordinator: Optional[str] = None,
         int(os.environ["DAFT_TPU_PROCESS_ID"])
         if "DAFT_TPU_PROCESS_ID" in os.environ else None)
     if coordinator is None and num_processes is None:
-        return False
+        # zero-config pod bootstrap: jax infers coordinator/topology from the
+        # TPU environment; on an unconfigured single host this fails and we
+        # report False rather than raising
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            return False
+        _INITIALIZED[0] = True
+        return True
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
