@@ -416,8 +416,18 @@ def write_iceberg_table(table_uri: str, arrow_tables: List[pa.Table],
             snap = next((s for s in (prev_meta.get("snapshots") or [])
                          if s.get("snapshot-id") == sid), None)
             if snap is not None and snap.get("manifest-list"):
-                _, prior_manifests = read_avro_file(
+                _, raw = read_avro_file(
                     _iceberg_resolve(table_uri, snap["manifest-list"]))
+                # v1 manifest_file records predate the 'content' field (and
+                # may omit others): normalize so re-encoding under the v2
+                # schema never sees None ints
+                prior_manifests = [{
+                    "manifest_path": r["manifest_path"],
+                    "manifest_length": r.get("manifest_length") or 0,
+                    "partition_spec_id": r.get("partition_spec_id") or 0,
+                    "content": r.get("content") or 0,
+                    "added_snapshot_id": r.get("added_snapshot_id") or 0,
+                } for r in raw]
             elif snap is not None and snap.get("manifests"):
                 # v1 inline manifest paths: lift into manifest_file records
                 # so the appended table's view keeps the existing data

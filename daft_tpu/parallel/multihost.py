@@ -34,6 +34,7 @@ import numpy as np
 import jax
 
 _INITIALIZED = [False]
+_BOOTSTRAP_FAILED = [False]
 
 
 def init_distributed(coordinator: Optional[str] = None,
@@ -56,11 +57,21 @@ def init_distributed(coordinator: Optional[str] = None,
         if "DAFT_TPU_PROCESS_ID" in os.environ else None)
     if coordinator is None and num_processes is None:
         # zero-config pod bootstrap: jax infers coordinator/topology from the
-        # TPU environment; on an unconfigured single host this fails and we
-        # report False rather than raising
+        # TPU environment. A failed attempt is WARNED and cached — silently
+        # degrading a pod to independent single-process meshes (or re-blocking
+        # on the coordinator connect timeout every call) would be worse.
+        if _BOOTSTRAP_FAILED[0]:
+            return False
         try:
             jax.distributed.initialize()
-        except Exception:
+        except Exception as e:
+            import warnings
+
+            _BOOTSTRAP_FAILED[0] = True
+            warnings.warn(
+                f"zero-config jax.distributed bootstrap failed ({e!r}); "
+                "proceeding single-process — pass coordinator/num_processes/"
+                "process_id explicitly for multi-host execution")
             return False
         _INITIALIZED[0] = True
         return True

@@ -548,3 +548,45 @@ class TestWriteIceberg:
         dt.from_pydict({"x": pa.array([], pa.int64()),
                         "y": pa.array([], pa.string())}).write_iceberg(root)
         assert dt.read_iceberg(root).to_pydict() == {"x": [], "y": []}
+
+    def test_append_onto_v1_manifest_list_table(self, tmp_path):
+        # v1 tables can ALSO use a manifest-list file whose manifest_file
+        # records predate the 'content' field; append must normalize them
+        root = str(tmp_path / "ice")
+        os.makedirs(os.path.join(root, "metadata"))
+        os.makedirs(os.path.join(root, "data"))
+        t = pa.table({"x": [1, 2], "y": ["a", "b"]})
+        papq.write_table(t, os.path.join(root, "data", "f0.parquet"))
+        entries = [{"status": 1, "snapshot_id": 7,
+                    "data_file": {"content": 0,
+                                  "file_path": f"file://{root}/data/f0.parquet",
+                                  "file_format": "PARQUET", "partition": {},
+                                  "record_count": 2, "file_size_in_bytes": 100}}]
+        mpath = os.path.join(root, "metadata", "m0.avro")
+        write_avro_file(mpath, _MANIFEST_ENTRY_SCHEMA, entries)
+        v1_mlist_schema = {  # no 'content' / 'added_snapshot_id' fields
+            "type": "record", "name": "manifest_file", "fields": [
+                {"name": "manifest_path", "type": "string"},
+                {"name": "manifest_length", "type": "long"},
+                {"name": "partition_spec_id", "type": "int"}]}
+        lpath = os.path.join(root, "metadata", "snap-7.avro")
+        write_avro_file(lpath, v1_mlist_schema, [{
+            "manifest_path": f"file://{root}/metadata/m0.avro",
+            "manifest_length": os.path.getsize(mpath),
+            "partition_spec_id": 0}])
+        meta = {"format-version": 1, "table-uuid": "0", "location": root,
+                "current-snapshot-id": 7,
+                "snapshots": [{"snapshot-id": 7, "timestamp-ms": 0,
+                               "manifest-list": f"file://{root}/metadata/snap-7.avro"}],
+                "schema": {"type": "struct", "fields": [
+                    {"id": 1, "name": "x", "type": "long"},
+                    {"id": 2, "name": "y", "type": "string"}]}}
+        with open(os.path.join(root, "metadata", "v1.metadata.json"), "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(root, "metadata", "version-hint.text"), "w") as f:
+            f.write("1")
+        assert dt.read_iceberg(root).sort("x").to_pydict() == {
+            "x": [1, 2], "y": ["a", "b"]}
+        dt.from_pydict({"x": [3], "y": ["c"]}).write_iceberg(root, mode="append")
+        got = dt.read_iceberg(root).sort("x").to_pydict()
+        assert got == {"x": [1, 2, 3], "y": ["a", "b", "c"]}
