@@ -124,17 +124,18 @@ def main() -> int:
     t_host_q6, _ = _best_of(run_q6)
 
     if not _tpu_alive():
-        # accelerator unreachable (tunnel wedged / no device): report the
-        # host-path number with an explicit marker instead of hanging
+        # accelerator unreachable (tunnel wedged / no device): fail like the
+        # other error branches (value 0, exit 1) so trackers never record a
+        # host number under the device metric; host throughput rides along
+        # as extras for the post-mortem
         t_oracle_q1, _ = _best_of(lambda: tpch.oracle_q1(lineitem))
         print(json.dumps({
             "metric": f"tpch_q1_sf{scale:g}_device_rows_per_sec",
-            "value": round(rows / t_host_q1, 1), "unit": "rows/s",
-            "vs_baseline": round(t_oracle_q1 / t_host_q1, 3),
+            "value": 0, "unit": "rows/s", "vs_baseline": 0.0,
             "host_rows_per_sec": round(rows / t_host_q1, 1),
             "host_vs_baseline": round(t_oracle_q1 / t_host_q1, 3),
-            "error": "tpu_unreachable_host_path_only", "rows": rows}))
-        return 0
+            "error": "tpu_unreachable", "rows": rows}))
+        return 1
 
     # ---- device path (engine, fused jitted kernels, resident data) -------
     cfg.use_device_kernels = True

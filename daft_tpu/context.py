@@ -75,6 +75,11 @@ class ExecutionConfig:
     # combined in float64 on the host. Set False to force exact float64
     # expressions onto the host path.
     device_reduced_precision: bool = True
+    # 32-bit mode only: batch all float segment-SUMS of a fused grouped agg
+    # through ONE pallas one-hot matmul on the MXU (kernels/pallas_ops.py)
+    # instead of K scatter-based segment_sum lowerings. Same float32
+    # accumulation contract as device_reduced_precision.
+    use_pallas_segment_sums: bool = True
 
 
 def resolve_executor_threads(cfg: "ExecutionConfig") -> int:

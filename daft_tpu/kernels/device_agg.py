@@ -130,10 +130,14 @@ def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = No
         return None
 
     # --- compile + run ONE fused program ---------------------------------
+    from ..context import get_context
+
     kinds = tuple(s[1] for s in specs)
     modes = tuple(s[3] for s in specs)
+    use_pallas = bool(get_context().execution_config.use_pallas_segment_sums)
     run = _compile_agg(tuple(child_nodes), pred_nodes[0] if pred_nodes else None,
-                       schema, tuple(sorted(needed)), kinds, modes, gb)
+                       schema, tuple(sorted(needed)), kinds, modes, gb,
+                       use_pallas)
     # the row-count scalar lives on device with the partition: every host->
     # device transfer pays the full link latency (~60ms through a tunneled
     # chip), so a warm query must make zero uploads and ONE result fetch
@@ -188,11 +192,12 @@ class _ExprView:
         return self._node.name()
 
 
-def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb):
+def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb,
+                 use_pallas: bool = False):
     key = (tuple(n._key() for n in child_nodes),
            pred_node._key() if pred_node is not None else None,
            tuple((f.name, f.dtype) for f in schema), input_names, kinds, modes,
-           gb, x64_enabled())
+           gb, x64_enabled(), use_pallas)
     if key in _AGG_CACHE:
         return _AGG_CACHE[key]
 
@@ -203,6 +208,9 @@ def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb):
 
     import functools
 
+    from .device import _ONEHOT_MAX_SEGMENTS
+    from .pallas_ops import _BLOCK_ROWS, _masked_segment_sums_padded
+
     @functools.partial(jax.jit, static_argnames=())
     def run(env, codes, n):
         inbounds = jnp.arange(codes.shape[0], dtype=jnp.int32) < n
@@ -211,6 +219,17 @@ def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb):
             sel = pv & pm & inbounds  # invalid predicate rows filter out (SQL WHERE)
         else:
             sel = inbounds
+        # In 32-bit mode every float sum accumulates in float32 anyway, so
+        # the batched pallas kernel (ALL float-sum columns in ONE one_hot.T @
+        # values MXU pass, pallas_ops.py) is bit-compatible with the
+        # segment_sum route; x64 mode keeps exact float64 segment sums.
+        # group-cardinality bound mirrors segment_reduce's one-hot cap: a
+        # (1024, gb) one-hot block past ~4k groups blows the VMEM budget
+        pallas_ok = (use_pallas and not x64_enabled()
+                     and codes.shape[0] >= _BLOCK_ROWS
+                     and codes.shape[0] % _BLOCK_ROWS == 0
+                     and gb <= _ONEHOT_MAX_SEGMENTS)
+        fused_sums = []  # (slot in outs, pre-masked float32 column, cnt)
         outs = []
         for (v, m), kind, mode in zip(child_run(env), kinds, modes):
             m = m & sel
@@ -235,8 +254,14 @@ def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb):
                     acc = v.astype(jnp.uint64 if x64_enabled() else jnp.uint32)
                 else:
                     acc = v.astype(jnp.int64 if x64_enabled() else jnp.int32)
-                vals, valid = segment_reduce(acc, m, codes, gb, "sum")
                 cnt, _ = segment_reduce(m, m, codes, gb, "count")
+                if pallas_ok and jnp.issubdtype(acc.dtype, jnp.floating):
+                    fused_sums.append((len(outs),
+                                       jnp.where(m, acc, 0.0).astype(jnp.float32),
+                                       cnt))
+                    outs.append(None)  # back-filled from the batched kernel
+                    continue
+                vals, valid = segment_reduce(acc, m, codes, gb, "sum")
                 if jnp.issubdtype(acc.dtype, jnp.integer) and not x64_enabled():
                     # overflow guard operands: masked max|v| for the host check
                     absv = jnp.where(m, jnp.abs(v.astype(jnp.float32)), 0.0)
@@ -247,6 +272,13 @@ def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb):
             # min / max
             vals, valid = segment_reduce(v, m, codes, gb, kind)
             outs.append((vals, valid))
+        if fused_sums:
+            vk = jnp.stack([col for _, col, _ in fused_sums], axis=1)
+            sums = _masked_segment_sums_padded(
+                codes[:, None], sel.astype(jnp.float32)[:, None], vk, gb,
+                jax.default_backend() == "cpu")
+            for j, (slot, _col, cnt) in enumerate(fused_sums):
+                outs[slot] = (sums[:, j], cnt > 0, cnt, jnp.float32(0))
         if pred_run is not None:
             # group-survival data: codes/uniq were built from the UNFILTERED
             # table, so the host must drop groups with no selected rows and

@@ -35,37 +35,48 @@ from jax.experimental import pallas as pl
 _BLOCK_ROWS = 1024
 
 
-def _kernel(codes_ref, mask_ref, vals_ref, out_ref, *, num_groups: int):
+def _kernel(codes_ref, mask_ref, vals_ref, out_ref, comp_ref, *, num_groups: int):
     step = pl.program_id(0)
 
     @pl.when(step == 0)
     def _zero():
         out_ref[:] = jnp.zeros_like(out_ref)
+        comp_ref[:] = jnp.zeros_like(comp_ref)
 
     codes = codes_ref[:]  # (B, 1) int32
     mask = mask_ref[:]    # (B, 1) float32 (0/1)
     group_ids = jax.lax.broadcasted_iota(jnp.int32, (1, num_groups), 1)
     one_hot = (codes == group_ids).astype(jnp.float32) * mask  # (B, G)
     # (G, B) @ (B, K) -> (G, K) on the MXU
-    out_ref[:] += jnp.dot(one_hot.T, vals_ref[:], preferred_element_type=jnp.float32)
+    block = jnp.dot(one_hot.T, vals_ref[:], preferred_element_type=jnp.float32)
+    # Kahan-compensated accumulation ACROSS grid steps: naive float32 adds
+    # drift past 1e-6 relative on TPC-H-scale money sums (the segment_sum
+    # route this kernel replaces compensates too, device.py _sum_kahan)
+    y = block - comp_ref[:]
+    t = out_ref[:] + y
+    comp_ref[:] = (t - out_ref[:]) - y
+    out_ref[:] = t
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
 def _masked_segment_sums_padded(codes, mask, vals, num_groups: int, interpret: bool):
     n, k = vals.shape
     grid = n // _BLOCK_ROWS
-    return pl.pallas_call(
+    sums, _comp = pl.pallas_call(
         functools.partial(_kernel, num_groups=num_groups),
-        out_shape=jax.ShapeDtypeStruct((num_groups, k), jnp.float32),
+        out_shape=(jax.ShapeDtypeStruct((num_groups, k), jnp.float32),
+                   jax.ShapeDtypeStruct((num_groups, k), jnp.float32)),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
             pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
             pl.BlockSpec((_BLOCK_ROWS, k), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((num_groups, k), lambda i: (0, 0)),
+        out_specs=(pl.BlockSpec((num_groups, k), lambda i: (0, 0)),
+                   pl.BlockSpec((num_groups, k), lambda i: (0, 0))),
         interpret=interpret,
     )(codes, mask, vals)
+    return sums
 
 
 def masked_segment_sums(codes: np.ndarray, mask: Optional[np.ndarray],
