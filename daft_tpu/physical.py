@@ -760,6 +760,43 @@ class SortMergeJoinOp(PhysicalOp):
         k = len(self.left_on)
         bnds = aligned_boundaries_from_samples([lsamples, rsamples], n)
         ctx.stats.bump("aligned_boundary_shuffles")
+        # Mesh path: BOTH sides ride the same aligned-boundary range exchange
+        # over ICI; bucket i of each side lands co-partitioned on device i % n
+        # with its columns left HBM-resident for the per-bucket merge.
+        dev_shuffle = getattr(ctx, "try_device_shuffle", None)
+        if dev_shuffle is not None:
+            from .kernels.device import is_device_dtype
+
+            lparts = lbuf.parts()
+            rparts = rbuf.parts()
+            lrows = sum(len(p) for p in lparts)
+            rrows = sum(len(p) for p in rparts)
+            eligible = (lrows > 0 and rrows > 0  # empty sides: host handles
+                        and all(p.is_loaded() for p in lparts + rparts)
+                        and all(is_device_dtype(f.dtype) for f in lschema)
+                        and all(is_device_dtype(f.dtype) for f in rschema))
+            if eligible:
+                zeros, nf = [False] * k, [None] * k
+                # exchange the SMALLER side first: a late ineligibility only
+                # detectable at staging (e.g. int64 beyond int32 range with
+                # x64 off) then wastes the cheaper collective, not both
+                small_left = lrows <= rrows
+                first = ((lparts, self.left_on) if small_left
+                         else (rparts, self.right_on))
+                second = ((rparts, self.right_on) if small_left
+                          else (lparts, self.left_on))
+                out1 = dev_shuffle(first[0], first[1], n, "range", zeros, nf, bnds)
+                out2 = (dev_shuffle(second[0], second[1], n, "range", zeros,
+                                    nf, bnds) if out1 is not None else None)
+                lout, rout = ((out1, out2) if small_left else (out2, out1))
+                if lout is not None and rout is not None:
+                    lbuf.release()
+                    rbuf.release()
+                    ctx.stats.bump("device_aligned_smj_exchanges")
+                    for l, r in zip(lout, rout):
+                        yield l.sort_merge_join(r, self.left_on, self.right_on,
+                                                self.how, self.suffix)
+                    return
         lbuckets = [ctx.partition_buffer() for _ in range(n)]
         rbuckets = [ctx.partition_buffer() for _ in range(n)]
         for buf, on, buckets in ((lbuf, self.left_on, lbuckets),
