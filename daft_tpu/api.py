@@ -22,7 +22,8 @@ from .dataframe import DataFrame, GroupedDataFrame, from_partitions
 from .datatypes import DataType
 from .expressions import Expression, col, element, interval, lit
 from .io.readers import file_size
-from .io.scan import FileFormat, Pushdowns, ScanTask, glob_paths
+from .io.scan import (FileFormat, Pushdowns, ScanTask, glob_paths,
+                      merge_scan_tasks_by_size)
 from .logical import InMemorySource, ScanSource
 from .micropartition import MicroPartition
 from .schema import Field, Schema
@@ -134,6 +135,8 @@ def read_parquet(path, schema_hints: Optional[Dict[str, DataType]] = None,
                     st = st.merge(row_group_stats(md, rg, schema))
             tasks.append(ScanTask(p, FileFormat.PARQUET, schema, Pushdowns(),
                                   num_rows=md.num_rows, size_bytes=fsize, stats=st))
+    tasks = merge_scan_tasks_by_size(tasks, cfg.scan_tasks_min_size_bytes,
+                                     cfg.scan_tasks_max_size_bytes)
     return DataFrame(ScanSource(schema, tasks))
 
 
@@ -151,6 +154,9 @@ def read_csv(path, delimiter: str = ",", has_headers: bool = True,
             "column_names": column_names, **kw}
     tasks = [ScanTask(p, FileFormat.CSV, schema, Pushdowns(), storage_options=opts,
                       size_bytes=file_size(p)) for p in paths]
+    cfg = get_context().execution_config
+    tasks = merge_scan_tasks_by_size(tasks, cfg.scan_tasks_min_size_bytes,
+                                     cfg.scan_tasks_max_size_bytes)
     return DataFrame(ScanSource(schema, tasks))
 
 
@@ -163,6 +169,9 @@ def read_json(path, schema_hints: Optional[Dict[str, DataType]] = None) -> DataF
         schema = schema.apply_hints(Schema([Field(k, v) for k, v in schema_hints.items()]))
     tasks = [ScanTask(p, FileFormat.JSON, schema, Pushdowns(),
                       size_bytes=file_size(p)) for p in paths]
+    cfg = get_context().execution_config
+    tasks = merge_scan_tasks_by_size(tasks, cfg.scan_tasks_min_size_bytes,
+                                     cfg.scan_tasks_max_size_bytes)
     return DataFrame(ScanSource(schema, tasks))
 
 

@@ -255,6 +255,101 @@ class ScanTask:
         return Table(_S(fields), cols).cast_to_schema(want)
 
 
+class MergedScanTask(ScanTask):
+    """Several small files read as ONE unit of scan work.
+
+    The reference merges adjacent small ScanTasks into one task up to a size
+    window so tiny files don't each become a partition (daft-scan
+    `scan_task_iters.rs:29` merge_by_sizes); this is the same idea with the
+    children kept whole so per-file pushdown narrowing and stats pruning
+    still apply file-by-file at read time.
+    """
+
+    __slots__ = ("children",)
+
+    def __init__(self, children: Sequence[ScanTask]):
+        first = children[0]
+        st: Optional[TableStats] = first.stats
+        for c in children[1:]:
+            st = st.merge(c.stats) if (st is not None and c.stats is not None) else None
+        nrows: Optional[int] = 0
+        for c in children:
+            if c._num_rows is None:
+                nrows = None
+                break
+            nrows += c._num_rows
+        sizes = [c._size_bytes for c in children]
+        size = sum(sizes) if all(s is not None for s in sizes) else None
+        super().__init__(first.path, first.format, first.schema, first.pushdowns,
+                         first.storage_options, nrows, size, st)
+        self.children = list(children)
+
+    def __repr__(self) -> str:
+        return (f"MergedScanTask({self.format}:{len(self.children)} files, "
+                f"{self.pushdowns!r})")
+
+    def with_pushdowns(self, pushdowns: Pushdowns) -> "MergedScanTask":
+        return MergedScanTask([c.with_pushdowns(pushdowns) for c in self.children])
+
+    def can_prune(self) -> bool:
+        return all(c.can_prune() for c in self.children)
+
+    def read(self):
+        from ..table import Table
+
+        tables = []
+        remaining = self.pushdowns.limit
+        for c in self.children:
+            if c.can_prune():
+                continue
+            if remaining is not None:
+                c = c.with_pushdowns(c.pushdowns.with_limit(remaining))
+            t = c.read()
+            tables.append(t)
+            if remaining is not None:
+                remaining -= len(t)
+                if remaining <= 0:
+                    break
+        if not tables:
+            return Table.empty(self.materialized_schema)
+        want = self.materialized_schema
+        return Table.concat([t.cast_to_schema(want) for t in tables])
+
+
+def merge_scan_tasks_by_size(tasks: Sequence[ScanTask],
+                             min_bytes: int, max_bytes: int) -> List[ScanTask]:
+    """Pack runs of adjacent small tasks into MergedScanTasks: accumulate while
+    below `min_bytes`, never exceeding `max_bytes` per merged task. Tasks of
+    unknown size or already at/above `min_bytes` pass through unmerged.
+    Reference: daft-scan `scan_task_iters.rs:29` (merge window 96-384MB)."""
+    out: List[ScanTask] = []
+    cur: List[ScanTask] = []
+    cur_bytes = 0
+
+    def flush():
+        nonlocal cur, cur_bytes
+        if len(cur) == 1:
+            out.append(cur[0])
+        elif cur:
+            out.append(MergedScanTask(cur))
+        cur, cur_bytes = [], 0
+
+    for t in tasks:
+        sz = t.size_bytes()
+        if sz is None or sz >= min_bytes:
+            flush()
+            out.append(t)
+            continue
+        if cur and cur_bytes + sz > max_bytes:
+            flush()
+        cur.append(t)
+        cur_bytes += sz
+        if cur_bytes >= min_bytes:
+            flush()
+    flush()
+    return out
+
+
 def glob_paths(path) -> List[str]:
     """Expand a path / glob / directory / list thereof into concrete file paths.
 

@@ -134,6 +134,21 @@ def _file_fingerprint(path: str) -> str:
     return f"{st.st_mtime_ns}:{st.st_size}"
 
 
+def _scan_task_key(t) -> str:
+    from .io.scan import MergedScanTask
+
+    if isinstance(t, MergedScanTask):
+        # fingerprint EVERY child file: the merged task's .path is only the
+        # first child, and an overwrite of any other must invalidate too
+        return "+".join(_scan_task_key(c) for c in t.children)
+    # storage_options and schema are part of task identity: the same file read
+    # with a different delimiter or schema_hints must not share a cache entry
+    opts = sorted((k, repr(v)) for k, v in t.storage_options.items())
+    sch = [(f.name, str(f.dtype)) for f in t.schema]
+    return (f"{t.path}|{_file_fingerprint(t.path)}|{t.format}|{t.pushdowns!r}"
+            f"|{t.row_group_ids}|{t.partition_values}|{opts}|{sch}")
+
+
 def _plan_key(p: LogicalPlan) -> str:
     from .expressions import Expression
     from .logical import InMemorySource, Sample, ScanSource, Write
@@ -150,10 +165,7 @@ def _plan_key(p: LogicalPlan) -> str:
             raise _Uncacheable
         return f"mem#{tok}"
     if isinstance(p, ScanSource):
-        return "scan#" + ";".join(
-            f"{t.path}|{_file_fingerprint(t.path)}|{t.format}|{t.pushdowns!r}"
-            f"|{t.row_group_ids}|{t.partition_values}"
-            for t in p.tasks)
+        return "scan#" + ";".join(_scan_task_key(t) for t in p.tasks)
     items = []
     for k, v in sorted(vars(p).items()):
         # schemas are derived from children + expressions, already covered

@@ -241,3 +241,108 @@ class TestJsonStreaming:
         assert got["a"] == list(range(60_000))
         assert set(got) == {"a", "b", "d"}  # schema inference sees the file
         assert got["d"][0] is None and got["d"][-1] == 59_999
+
+
+class TestMergedScanTasks:
+    """Small-file merging (reference: daft-scan scan_task_iters.rs:29
+    merge_by_sizes — adjacent small tasks pack into one up to a size window)."""
+
+    def _write_parts(self, tmp_path, n=6, rows=50):
+        import pyarrow as pa
+        import pyarrow.parquet as papq
+
+        paths = []
+        for i in range(n):
+            p = str(tmp_path / f"part{i}.parquet")
+            papq.write_table(pa.table({
+                "k": pa.array([i] * rows, pa.int64()),
+                "v": pa.array([float(j) for j in range(rows)]),
+            }), p)
+            paths.append(p)
+        return paths
+
+    def test_small_files_merge_into_one_task(self, tmp_path):
+        import daft_tpu as dt
+        from daft_tpu.logical import ScanSource
+
+        self._write_parts(tmp_path)
+        df = dt.read_parquet(str(tmp_path))
+        src = df._plan
+        while not isinstance(src, ScanSource):
+            src = src.children()[0]
+        assert len(src.tasks) == 1  # 6 tiny files, one scan task
+        got = df.sort(dt.col("k")).to_pydict()
+        assert got["k"] == sorted([i for i in range(6) for _ in range(50)])
+
+    def test_merge_respects_max_window(self, tmp_path):
+        from daft_tpu.io.scan import (FileFormat, Pushdowns, ScanTask,
+                                      merge_scan_tasks_by_size)
+        from daft_tpu.schema import Field, Schema
+        import daft_tpu as dt
+
+        sch = Schema([Field("a", dt.DataType.int64())])
+        tasks = [ScanTask(f"f{i}", FileFormat.PARQUET, sch, Pushdowns(),
+                          num_rows=10, size_bytes=40) for i in range(10)]
+        out = merge_scan_tasks_by_size(tasks, min_bytes=100, max_bytes=130)
+        # 40+40+40=120 >= 100 -> flush; 10 files -> 3+3+3+1
+        assert [len(getattr(t, "children", [t])) for t in out] == [3, 3, 3, 1]
+        assert sum(t.num_rows() for t in out) == 100
+        big = ScanTask("big", FileFormat.PARQUET, sch, Pushdowns(),
+                       num_rows=10, size_bytes=500)
+        out2 = merge_scan_tasks_by_size(tasks[:2] + [big] + tasks[2:4],
+                                        min_bytes=100, max_bytes=130)
+        # the large task passes through unmerged and splits the runs
+        assert [len(getattr(t, "children", [t])) for t in out2] == [2, 1, 2]
+
+    def test_merged_task_pushdowns_and_limit(self, tmp_path):
+        import daft_tpu as dt
+        from daft_tpu.io import IO_STATS
+
+        self._write_parts(tmp_path)
+        df = dt.read_parquet(str(tmp_path))
+        got = df.where(dt.col("k") == 3).select(dt.col("v")).to_pydict()
+        assert got["v"] == [float(j) for j in range(50)]
+        # limit early-stops across children: only the first file is opened
+        IO_STATS.reset()
+        got2 = dt.read_parquet(str(tmp_path)).limit(10).to_pydict()
+        assert len(got2["k"]) == 10
+        assert IO_STATS.snapshot()["files_opened"] <= 2
+
+    def test_merged_task_stats_prune_children(self, tmp_path):
+        import daft_tpu as dt
+        from daft_tpu.io import IO_STATS
+
+        self._write_parts(tmp_path)
+        IO_STATS.reset()
+        # k == 0 only lives in part0: row-group stats prune the other files
+        got = dt.read_parquet(str(tmp_path)).where(dt.col("k") == 0).to_pydict()
+        assert got["k"] == [0] * 50
+        assert IO_STATS.snapshot()["files_opened"] <= 2
+
+    def test_cache_invalidation_covers_all_children(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as papq
+
+        import daft_tpu as dt
+
+        self._write_parts(tmp_path, n=3)
+        q = dt.read_parquet(str(tmp_path)).agg(dt.col("k").sum().alias("s"))
+        s1 = q.to_pydict()["s"][0]
+        # overwrite a NON-first child; a stale cache would return s1 again
+        papq.write_table(pa.table({"k": pa.array([100] * 50, pa.int64()),
+                                   "v": pa.array([0.0] * 50)}),
+                         str(tmp_path / "part2.parquet"))
+        s2 = dt.read_parquet(str(tmp_path)).agg(dt.col("k").sum().alias("s")).to_pydict()["s"][0]
+        assert s2 == s1 - 2 * 50 + 100 * 50
+
+    def test_cache_distinguishes_reader_options(self, tmp_path):
+        # same file, different delimiter: must NOT share a result-cache entry
+        import daft_tpu as dt
+
+        p = str(tmp_path / "c.csv")
+        with open(p, "w") as f:
+            f.write("x;y\n5;6\n")
+        got_semi = dt.read_csv(p, delimiter=";").to_pydict()
+        got_comma = dt.read_csv(p, delimiter=",").to_pydict()
+        assert set(got_semi) == {"x", "y"}
+        assert set(got_comma) == {"x;y"}
