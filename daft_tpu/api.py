@@ -175,15 +175,6 @@ def read_json(path, schema_hints: Optional[Dict[str, DataType]] = None) -> DataF
     return DataFrame(ScanSource(schema, tasks))
 
 
-def _catalog_stub(name: str):
-    def reader(*_a, **_k):
-        raise ImportError(
-            f"read_{name} requires the {name} catalog client, which is not "
-            f"available in this environment (zero-egress). The scan-layer "
-            f"integration point is ScanTask/ScanSource (daft_tpu/io/scan.py)."
-        )
-
-    return reader
 
 
 def read_deltalake(table_uri: str) -> DataFrame:
@@ -224,7 +215,21 @@ def read_hudi(table_uri: str) -> DataFrame:
     return DataFrame(ScanSource(schema, tasks))
 
 
-read_lance = _catalog_stub("lance")
+def read_lance(url: str, storage_options=None) -> DataFrame:
+    """Read a LanceDB dataset, one scan task per lance fragment (reference:
+    daft/io/_lance.py:68 — like the reference, the lance data format is read
+    through the optional `lance` client package, which must be installed)."""
+    from .io.catalogs import read_lance_scan
+
+    return read_lance_scan(url, storage_options=storage_options)
+
+
+def from_scan_operator(op) -> DataFrame:
+    """Build a DataFrame over a user-defined ScanOperator (reference:
+    ScanOperatorHandle.from_python_scan_operator, daft/io/scan.py:20-50)."""
+    from .io.pyscan import from_scan_operator as _fso
+
+    return _fso(op)
 
 
 # ---------------------------------------------------------------------------
@@ -282,6 +287,7 @@ __all__ = [
     "read_deltalake",
     "read_hudi",
     "read_lance",
+    "from_scan_operator",
     "read_sql",
     "get_context",
     "set_execution_config",

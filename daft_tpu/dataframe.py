@@ -421,6 +421,20 @@ class DataFrame:
 
         return from_pydict({"path": added})
 
+    def write_lance(self, table_uri: str, mode: str = "append") -> "DataFrame":
+        """Write this DataFrame as a lance dataset (reference:
+        daft/dataframe/dataframe.py write_lance via lance.write_dataset —
+        requires the optional `lance` package, as in the reference). mode:
+        append | overwrite | error. Returns a DataFrame of data-file paths."""
+        from .io.catalogs import write_lance_table
+
+        self.collect()
+        arrow_tables = [p.to_arrow() for p in self._result.partitions]
+        added = write_lance_table(table_uri, arrow_tables, mode=mode)
+        from .api import from_pydict
+
+        return from_pydict({"path": added})
+
     # ------------------------------------------------------------------ execution
     def cancel(self) -> None:
         """Stop this DataFrame's in-flight execution at the next partition

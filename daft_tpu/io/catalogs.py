@@ -682,3 +682,82 @@ def _dbapi_arrow_type(descr_entry) -> pa.DataType:
         if decl in name:
             return at
     return pa.null()
+
+
+# ---------------------------------------------------------------------------
+# Lance (via the optional `lance` package, reference daft/io/_lance.py:68 —
+# the reference likewise delegates to the LanceDB client and raises when the
+# extra dependency is missing; the data format itself is lance-internal)
+# ---------------------------------------------------------------------------
+
+def _import_lance():
+    try:
+        import lance
+    except ImportError as e:
+        raise ImportError(
+            "read_lance/write_lance require the optional `lance` package "
+            "(the reference ships it as the getdaft[lance] extra); it is not "
+            "installed in this environment") from e
+    return lance
+
+
+def read_lance_scan(url: str, storage_options=None):
+    """DataFrame over a LanceDB dataset: one FactoryScanTask per lance
+    fragment, batches pulled through the fragment reader (reference:
+    LanceDBScanOperator.to_scan_tasks, daft/io/_lance.py:97+)."""
+    lance = _import_lance()
+
+    from ..schema import Schema
+    from .pyscan import FactoryScanTask, ScanOperator, from_scan_operator
+
+    ds = lance.dataset(url, storage_options=storage_options)
+    schema = Schema.from_arrow(ds.schema)
+
+    class _LanceScanOperator(ScanOperator):
+        def display_name(self):
+            return f"LanceScanOperator({url})"
+
+        def schema(self):
+            return schema
+
+        def can_absorb_select(self):
+            return True  # fragment.to_batches honors a column projection
+
+        def to_scan_tasks(self, pushdowns):
+            for frag in ds.get_fragments():
+                def factory(pd, _frag=frag):
+                    cols = pd.columns if pd.columns is not None else None
+                    return _frag.to_batches(columns=cols)
+
+                yield FactoryScanTask(
+                    factory, schema, pushdowns,
+                    label=f"{url}#fragment-{frag.fragment_id}",
+                    absorbs=("columns",))
+
+    return from_scan_operator(_LanceScanOperator())
+
+
+def write_lance_table(table_uri: str, arrow_tables, mode: str = "append"):
+    """Write arrow tables as a lance dataset (reference: daft writes lance via
+    `lance.write_dataset` in table_io.py). mode: append | overwrite | error."""
+    import pyarrow as pa
+
+    lance = _import_lance()
+    if mode not in ("append", "overwrite", "error"):
+        raise ValueError(f"unknown write_lance mode {mode!r}")
+    tbl = pa.concat_tables([t for t in arrow_tables if t.num_rows]) \
+        if any(t.num_rows for t in arrow_tables) else arrow_tables[0]
+    import os
+    exists = os.path.exists(table_uri)
+    if mode == "error" and exists:
+        raise FileExistsError(f"lance dataset already exists at {table_uri!r}")
+    # lance rejects append when no dataset exists yet; first write creates
+    lance_mode = {"append": "append" if exists else "create",
+                  "overwrite": "overwrite", "error": "create"}[mode]
+    ds = lance.write_dataset(tbl, table_uri, mode=lance_mode)
+    paths = []
+    for frag in ds.get_fragments():
+        for df_ in frag.data_files():
+            p = df_.path() if callable(getattr(df_, "path", None)) else df_.path
+            paths.append(str(p))
+    return paths

@@ -135,8 +135,13 @@ def _file_fingerprint(path: str) -> str:
 
 
 def _scan_task_key(t) -> str:
+    from .io.pyscan import FactoryScanTask
     from .io.scan import MergedScanTask
 
+    if isinstance(t, FactoryScanTask):
+        # a Python callable's identity can't be fingerprinted; two factories
+        # sharing a stat-able label must never collide in the result cache
+        raise _Uncacheable
     if isinstance(t, MergedScanTask):
         # fingerprint EVERY child file: the merged task's .path is only the
         # first child, and an overwrite of any other must invalidate too
