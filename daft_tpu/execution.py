@@ -329,6 +329,14 @@ class ExecutionContext:
             part = part.filter([predicate])
         return part.agg(aggregations, groupby or None)
 
+    def prepare_broadcast(self, part: MicroPartition, on_exprs,
+                          how: str = "inner") -> MicroPartition:
+        """Hook for runners with a device mesh: replicate a broadcast-join
+        build side into every device's HBM once, so per-partition probes use
+        a local replica instead of re-shipping the build keys. Single-host
+        base context: no-op."""
+        return part
+
     def eval_join(self, lpart: MicroPartition, rpart: MicroPartition,
                   left_on, right_on, how: str, suffix: str) -> MicroPartition:
         """Route a join through the device probe when eligible: single
@@ -343,11 +351,14 @@ class ExecutionContext:
                             rpart.num_rows_or_none() or 0) >= self.cfg.device_min_rows)
         if eligible:
             try:
-                from .kernels.device_join import device_join_indices
+                from .kernels.device_join import (device_join_indices,
+                                                  join_key_replicas)
 
                 res = device_join_indices(
                     lpart.table(), rpart.table(), left_on[0], right_on[0],
-                    lpart.device_stage_cache(), rpart.device_stage_cache(), how)
+                    lpart.device_stage_cache(), rpart.device_stage_cache(), how,
+                    left_replicas=join_key_replicas(lpart, left_on[0]),
+                    right_replicas=join_key_replicas(rpart, right_on[0]))
             except Exception:
                 res = None
             if res is not None:

@@ -84,22 +84,92 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     return vals, valid
 
 
+def _replica_cache_key(key_expr):
+    from .device import x64_enabled
+
+    return ("__join_key_replica__", key_expr._node._key(), x64_enabled())
+
+
+def replicate_join_key(part, key_expr, mesh) -> bool:
+    """Stage `key_expr` over `part` once and replicate it into every device of
+    `mesh` (one fully-replicated `jax.device_put` — an ICI broadcast, the TPU
+    form of the reference's broadcast-join small-side replication,
+    daft/execution/physical_plan.py:374). The per-device copies are cached on
+    the partition; `device_join_indices` then probes against the copy local
+    to the probe shard's device. Returns True when replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    tbl = part.table()
+    staged = _stage_key(tbl, key_expr, part.device_stage_cache())
+    if staged is None:
+        return False
+    vals, valid = staged
+    rep = NamedSharding(mesh, PartitionSpec(*([None] * vals.ndim)))
+    rep1 = NamedSharding(mesh, PartitionSpec(None))
+    gv = jax.device_put(vals, rep)
+    gm = jax.device_put(valid, rep1)
+    vmap = {s.device: s.data for s in gv.addressable_shards}
+    mmap = {s.device: s.data for s in gm.addressable_shards}
+    part.device_stage_cache()[_replica_cache_key(key_expr)] = {
+        d: (vmap[d], mmap[d]) for d in vmap}
+    return True
+
+
+def join_key_replicas(part, key_expr):
+    """The {device: (vals, valid)} replica map cached by replicate_join_key,
+    or None."""
+    if part is None:
+        return None
+    try:
+        return part.device_stage_cache().get(_replica_cache_key(key_expr))
+    except Exception:
+        return None
+
+
+def _device_of(arr):
+    try:
+        devs = arr.devices()
+        if len(devs) == 1:
+            return next(iter(devs))
+    except Exception:
+        pass
+    return None
+
+
 def device_join_indices(left_table, right_table, left_key, right_key,
-                        left_cache=None, right_cache=None, how: str = "inner"):
+                        left_cache=None, right_cache=None, how: str = "inner",
+                        left_replicas=None, right_replicas=None):
     """Probe on device. Returns (side, hit, bidx):
 
     - side == "right_build": hit/bidx are per LEFT row (bidx indexes right)
     - side == "left_build": hit/bidx are per RIGHT row (bidx indexes left)
     or None when ineligible (non-integer keys, duplicate build keys, ...).
+
+    When a side carries mesh replicas (replicate_join_key), the copy living on
+    the OTHER side's device is swapped in, keeping the probe device-local.
     """
     ln, rn = len(left_table), len(right_table)
     if ln == 0 or rn == 0:
         return None
     lk = _stage_key(left_table, left_key, left_cache)
-    rk = _stage_key(right_table, right_key, right_cache)
-    if lk is None or rk is None:
+    if lk is None:
         return None
     lv, lm = lk
+    rk = None
+    if right_replicas:
+        # replica hit: skip staging the build side entirely — its existence
+        # already proves the key passed the device-eligibility checks
+        d = _device_of(lv)
+        if d is not None and d in right_replicas:
+            rk = right_replicas[d]
+    if rk is None:
+        rk = _stage_key(right_table, right_key, right_cache)
+        if rk is None:
+            return None
+        if left_replicas:
+            d = _device_of(rk[0])
+            if d is not None and d in left_replicas:
+                lv, lm = left_replicas[d]
     rv, rm = rk
     if lv.dtype != rv.dtype:
         return None

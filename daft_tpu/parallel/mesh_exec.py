@@ -79,6 +79,26 @@ class MeshExecutionContext(ExecutionContext):
     def n_devices(self) -> int:
         return int(np.prod(list(self.mesh.shape.values())))
 
+    def prepare_broadcast(self, part: MicroPartition, on_exprs,
+                          how: str = "inner") -> MicroPartition:
+        """Replicate a broadcast-join build side's join keys into every mesh
+        device's HBM with ONE fully-replicated device_put (an ICI broadcast),
+        so each device probes its local replica instead of pulling the build
+        keys over the link per partition (reference role: broadcast_join's
+        small-side replication, daft/execution/physical_plan.py:374)."""
+        if (self.cfg.use_device_kernels and self.n_devices > 1
+                and how in ("inner", "left", "semi", "anti")  # eval_join's gate
+                and on_exprs and len(on_exprs) == 1
+                and (part.num_rows_or_none() or 0) > 0):
+            try:
+                from ..kernels.device_join import replicate_join_key
+
+                if replicate_join_key(part, on_exprs[0], self.mesh):
+                    self.stats.bump("broadcast_replications")
+            except Exception:
+                pass  # host path handles the join; replication is a fast path
+        return part
+
     def _shard_onto_devices(self, shards: List[jax.Array], trailing, r: int):
         """Assemble n single-device [1, r, *trailing] buffers into one global
         [n, r, *trailing] array laid out one-row-per-device — per-device

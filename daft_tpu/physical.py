@@ -696,6 +696,9 @@ class BroadcastJoinOp(PhysicalOp):
         small_parts = [p for p in inputs[1]]
         small = (MicroPartition.concat(small_parts) if len(small_parts) > 1
                  else (small_parts[0] if small_parts else MicroPartition.empty(self.children[1].schema)))
+        # mesh runners replicate the build keys into every device's HBM here
+        # (one ICI broadcast); per-partition probes then stay device-local
+        small = ctx.prepare_broadcast(small, self.small_on, self.how)
         ctx.stats.bump("broadcast_joins")
         for part in inputs[0]:
             if self.small_is_left:

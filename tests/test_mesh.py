@@ -390,3 +390,52 @@ def test_mesh_smj_empty_side_falls_back_to_host():
     parts = list(execute_plan(translate(optimize(q._plan), ctx.cfg), ctx))
     assert ctx.stats.counters.get("device_aligned_smj_exchanges", 0) == 0
     assert sum(len(p) for p in parts) == 0
+
+
+def test_mesh_broadcast_join_replicates_build_side():
+    """A broadcast join on the mesh replicates the small side's join keys
+    into every device's HBM once (ICI broadcast), then probes device-locally:
+    counter broadcast_replications fires and results match the host."""
+    cfg = daft_tpu.context.get_context().execution_config
+    old = (cfg.use_device_kernels, cfg.device_min_rows,
+           cfg.broadcast_join_size_bytes_threshold)
+    cfg.use_device_kernels = True
+    cfg.device_min_rows = 1
+    cfg.broadcast_join_size_bytes_threshold = 10 * 1024 * 1024
+    try:
+        rng = np.random.RandomState(3)
+        big = daft_tpu.from_pydict({
+            "k": rng.randint(0, 50, size=5000).astype(np.int64),
+            "v": rng.rand(5000)}).repartition(8, col("k"))
+        small = daft_tpu.from_pydict({
+            "k2": np.arange(0, 50, 2, dtype=np.int64),
+            "name": np.arange(25, dtype=np.int64) * 10})
+        q = big.join(small, left_on="k", right_on="k2").agg(
+            col("v").sum().alias("s"), col("name").count().alias("c"))
+        from daft_tpu.execution import execute_plan
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        ctx = MeshExecutionContext(cfg, mesh=default_mesh(8))
+        phys = translate(optimize(q._plan), cfg)
+        assert "BroadcastJoin" in " ".join(
+            op.describe() for op in _walk_ops(phys)), "expected broadcast strategy"
+        parts = list(execute_plan(phys, ctx))
+        got = pa.concat_tables([p.to_arrow() for p in parts]).to_pydict()
+        assert ctx.stats.counters.get("broadcast_replications", 0) >= 1, \
+            ctx.stats.counters
+        assert ctx.stats.counters.get("device_join_probes", 0) >= 1, \
+            ctx.stats.counters
+        cfg.use_device_kernels = False
+        host = NativeRunner().run(q._plan).to_table().to_pydict()
+        assert got["c"] == host["c"]
+        np.testing.assert_allclose(got["s"], host["s"], rtol=1e-9)
+    finally:
+        (cfg.use_device_kernels, cfg.device_min_rows,
+         cfg.broadcast_join_size_bytes_threshold) = old
+
+
+def _walk_ops(op):
+    yield op
+    for c in op.children:
+        yield from _walk_ops(c)
