@@ -58,3 +58,7 @@ from . import multimodal  # noqa: E402,F401
 # longer touch the package attribute.
 from . import sql as _sql_module  # noqa: E402,F401
 from .api import sql  # noqa: E402,F401
+
+from .viz import register_viz_hook  # noqa: E402,F401
+
+__all__ += ["register_viz_hook"]

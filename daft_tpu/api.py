@@ -66,6 +66,35 @@ def from_pandas(df) -> DataFrame:
     return from_arrow(pa.Table.from_pandas(df))
 
 
+def from_ray_dataset(ds) -> DataFrame:
+    """Build a DataFrame from a Ray Dataset (reference:
+    daft/dataframe/dataframe.py from_ray_dataset — gated on the optional
+    `ray` dependency exactly as the reference gates its Ray interop)."""
+    try:
+        import ray  # noqa: F401
+    except ImportError as e:
+        raise ImportError("from_ray_dataset requires the optional `ray` "
+                          "package, which is not installed") from e
+    import pyarrow as pa
+
+    tables = [ray.get(r) for r in ds.to_arrow_refs()]
+    if not tables:
+        return from_arrow(pa.table({}))
+    return from_arrow(pa.concat_tables(tables) if len(tables) != 1 else tables[0])
+
+
+def from_dask_dataframe(ddf) -> DataFrame:
+    """Build a DataFrame from a Dask DataFrame (reference:
+    daft/dataframe/dataframe.py from_dask_dataframe — gated on the optional
+    `dask` dependency exactly as the reference)."""
+    try:
+        import dask  # noqa: F401
+    except ImportError as e:
+        raise ImportError("from_dask_dataframe requires the optional `dask` "
+                          "package, which is not installed") from e
+    return from_pandas(ddf.compute())
+
+
 def from_glob_path(path: str) -> DataFrame:
     """DataFrame of file metadata (path, size, num_rows) for a glob —
     reference: daft/io/_glob.py."""
@@ -279,6 +308,8 @@ __all__ = [
     "from_arrow",
     "from_pandas",
     "from_glob_path",
+    "from_ray_dataset",
+    "from_dask_dataframe",
     "from_partitions",
     "read_parquet",
     "read_csv",

@@ -566,6 +566,26 @@ class DataFrame:
                 pass
         return f"DataFrame({self.schema!r})"
 
+    def _repr_html_(self) -> str:
+        """Notebook preview table with registered viz hooks applied to
+        Python-object cells (reference: daft/dataframe/display.py +
+        daft/viz/html_viz_hooks.py)."""
+        import html as _h
+
+        from .viz import html_table
+
+        n = get_context().execution_config.num_preview_rows
+        # same discipline as __repr__: never execute the plan at display time,
+        # never let a preview error break notebook rendering
+        if self._result is not None:
+            try:
+                total = sum(len(p) for p in self._result.partitions)
+                preview = self.limit(n).to_table()
+                return html_table(preview.schema, preview.to_pydict(), n, total)
+            except Exception:
+                pass
+        return f"<pre>DataFrame({_h.escape(repr(self.schema))})</pre>"
+
 
 def _cell(v) -> str:
     if v is None:

@@ -1,0 +1,98 @@
+"""HTML preview + user-registrable visualization hooks.
+
+Role-equivalent to the reference's `daft/viz/html_viz_hooks.py:17-27`
+(`register_viz_hook`: custom HTML renderers for Python objects in previews)
+and `daft/dataframe/display.py` (the `_repr_html_` notebook preview table).
+"""
+
+from __future__ import annotations
+
+import base64
+import html as _html
+import io
+from typing import Callable, Dict, Type
+
+_VIZ_HOOKS: Dict[Type, Callable[[object], str]] = {}
+
+
+def register_viz_hook(klass: Type, hook: Callable[[object], str]) -> None:
+    """Register an HTML renderer for values of `klass` in dataframe previews
+    (reference: daft/viz/html_viz_hooks.py register_viz_hook)."""
+    _VIZ_HOOKS[klass] = hook
+
+
+def get_viz_hook(obj):
+    _ensure_default_hooks()
+    for k in type(obj).__mro__:
+        if k in _VIZ_HOOKS:
+            return _VIZ_HOOKS[k]
+    for k, h in _VIZ_HOOKS.items():
+        if isinstance(obj, k):
+            return h
+    return None
+
+
+def _pil_image_hook(img) -> str:
+    """Default hook: PIL images inline as base64 <img> thumbnails (reference
+    registers the same default for PIL.Image.Image)."""
+    thumb = img.copy()
+    thumb.thumbnail((128, 128))
+    buf = io.BytesIO()
+    thumb.save(buf, format="PNG")
+    b64 = base64.b64encode(buf.getvalue()).decode("ascii")
+    return f'<img style="max-height:128px" src="data:image/png;base64,{b64}" />'
+
+
+_DEFAULTS_REGISTERED = False
+
+
+def _ensure_default_hooks() -> None:
+    """Register the PIL default on first preview, not at import — keeps
+    `import daft_tpu` free of PIL's import cost."""
+    global _DEFAULTS_REGISTERED
+    if _DEFAULTS_REGISTERED:
+        return
+    _DEFAULTS_REGISTERED = True
+    try:
+        from PIL import Image as _PILImage
+
+        _VIZ_HOOKS.setdefault(_PILImage.Image, _pil_image_hook)
+    except ImportError:
+        pass
+
+
+def html_cell(value) -> str:
+    """One preview cell: viz hook if registered, escaped str otherwise."""
+    if value is None:
+        return "<i>None</i>"
+    hook = get_viz_hook(value)
+    if hook is not None:
+        try:
+            return hook(value)
+        except Exception:
+            pass
+    s = str(value)
+    if len(s) > 80:
+        s = s[:77] + "..."
+    return _html.escape(s)
+
+
+def html_table(schema, pydict: dict, preview_rows: int, total_known) -> str:
+    """Render a schema-headed preview table (reference: display.py repr)."""
+    names = [f.name for f in schema]
+    head = "".join(
+        f'<th style="text-align:left">{_html.escape(f.name)}<br/>'
+        f'<small>{_html.escape(repr(f.dtype))}</small></th>'
+        for f in schema)
+    nrows = len(pydict[names[0]]) if names and names[0] in pydict else 0
+    body = []
+    for i in range(min(nrows, preview_rows)):
+        cells = "".join(f'<td style="text-align:left">'
+                        f'{html_cell(pydict[nm][i])}</td>' for nm in names)
+        body.append(f"<tr>{cells}</tr>")
+    foot = (f"<small>(Showing first {min(nrows, preview_rows)} of "
+            f"{total_known} rows)</small>" if total_known is not None
+            else f"<small>(Showing first {min(nrows, preview_rows)} rows)</small>")
+    return ('<div><table class="dataframe">'
+            f"<thead><tr>{head}</tr></thead>"
+            f'<tbody>{"".join(body)}</tbody></table>{foot}</div>')

@@ -54,3 +54,57 @@ class TestProgress:
         finally:
             tracing.set_progress_callback(None)
         assert seen and any(rows > 0 for _, rows in seen)
+
+
+class TestVizHooks:
+    """HTML previews + register_viz_hook (reference:
+    daft/viz/html_viz_hooks.py:17-27, dataframe/display.py)."""
+
+    def test_repr_html_basic(self):
+        import daft_tpu as dt
+
+        df = dt.from_pydict({"a": [1, 2, 3], "s": ["x", "<b>y</b>", None]})
+        h = df.collect()._repr_html_()
+        assert "<table" in h and "a" in h
+        assert "int64" in h.lower()
+        assert "&lt;b&gt;y&lt;/b&gt;" in h  # escaped, not injected
+        assert "<i>None</i>" in h
+        assert "3 rows" in h
+
+    def test_register_viz_hook_custom_type(self):
+        import daft_tpu as dt
+        from daft_tpu import DataType
+
+        class Blob:
+            def __init__(self, tag):
+                self.tag = tag
+
+        dt.register_viz_hook(Blob, lambda b: f'<span class="blob">{b.tag}</span>')
+        df = dt.from_pydict({"o": dt.Series.from_pylist(
+            [Blob("t1"), Blob("t2")], "o", DataType.python())})
+        h = df.collect()._repr_html_()
+        assert '<span class="blob">t1</span>' in h
+        assert '<span class="blob">t2</span>' in h
+
+    def test_pil_image_hook_renders_img(self):
+        import pytest
+
+        PIL = pytest.importorskip("PIL")
+        import numpy as np
+        from PIL import Image
+
+        import daft_tpu as dt
+        from daft_tpu import DataType
+
+        img = Image.fromarray(np.zeros((4, 4, 3), dtype=np.uint8))
+        df = dt.from_pydict({"im": dt.Series.from_pylist(
+            [img], "im", DataType.python())})
+        h = df.collect()._repr_html_()
+        assert "data:image/png;base64," in h
+
+    def test_repr_html_uncollected_shows_schema_only(self):
+        import daft_tpu as dt
+
+        df = dt.from_pydict({"a": [1, 2]}).where(dt.col("a") > 0)
+        h = df._repr_html_()  # NOT collected: must not execute the plan
+        assert h.startswith("<pre>DataFrame(") and "a" in h
