@@ -287,6 +287,40 @@ class ExecutionContext:
         self.stats.bump("host_projections")
         return part.eval_expression_list(exprs)
 
+    def eval_projection_dispatch(self, part: MicroPartition, exprs):
+        """Launch a device projection without blocking; returns a zero-arg
+        resolver yielding the output MicroPartition, or None when the device
+        path is ineligible (caller falls back to the synchronous
+        eval_projection). The resolver itself falls back to the host kernel
+        if the deferred device computation fails at materialization."""
+        if not self._device_eligible(part):
+            return None
+        try:
+            from .kernels.device import eval_projection_device_async
+
+            resolve = eval_projection_device_async(
+                part.table(), list(exprs), stage_cache=part.device_stage_cache())
+        except Exception:
+            return None
+        if resolve is None:
+            return None
+        self.stats.bump("device_projections")
+        self.stats.bump("device_projection_dispatches")
+
+        def finish() -> MicroPartition:
+            try:
+                return MicroPartition.from_table(resolve())
+            except Exception:
+                # the partition was NOT computed on device after all: keep
+                # the counters truthful (same attribution the synchronous
+                # path's fallback produces)
+                self.stats.bump("device_projections", -1)
+                self.stats.bump("device_projection_fallbacks")
+                self.stats.bump("host_projections")
+                return part.eval_expression_list(exprs)
+
+        return finish
+
     def eval_agg(self, part: MicroPartition, aggregations, groupby,
                  predicate=None) -> MicroPartition:
         """Route a (optionally filter-fused) grouped aggregation through the
@@ -424,7 +458,7 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
     def build(op: PhysicalOp) -> Iterator[MicroPartition]:
         child_streams = [build(c) for c in op.children]
         if (parallel and op.map_partition is not None and len(child_streams) == 1
-                and op.parallel_safe()):
+                and op.parallel_safe() and not op.device_pipelinable(ctx)):
             # instrumentation happens inside the workers (the consumer-side
             # wrapper would only measure blocked-wait time)
             return _parallel_map(op, child_streams[0], ctx,

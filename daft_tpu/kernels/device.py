@@ -617,14 +617,21 @@ def normalize_and_check(exprs, schema) -> Optional[list]:
     return nodes
 
 
-def eval_projection_device(table, exprs, stage_cache: Optional[dict] = None) -> Optional[object]:
-    """Evaluate a projection on device; returns a host Table or None if ineligible."""
+def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = None):
+    """Dispatch a device projection WITHOUT blocking: staging and the jitted
+    compute launch happen now (jax dispatch is asynchronous); the returned
+    zero-arg resolver materializes the host Table (device_get) when called.
+    This is what lets the executor double-buffer — stage morsel i+1 while the
+    device still computes morsel i (reference role: the pipelined channel
+    hand-off of daft-local-execution intermediate_op.rs:71+).
+    Returns None if ineligible."""
     from ..expressions import required_columns
     from ..schema import Field, Schema
     from ..table import Table
 
     schema = table.schema
-    if len(table) == 0:
+    n = len(table)
+    if n == 0:
         return None
     nodes = normalize_and_check(exprs, schema)
     if nodes is None:
@@ -634,20 +641,30 @@ def eval_projection_device(table, exprs, stage_cache: Optional[dict] = None) -> 
         needed.update(required_columns(nd))
     if not needed:
         return None
-    b = size_bucket(len(table))
+    b = size_bucket(n)
     env = stage_table_columns(table, needed, b, stage_cache)
     if env is None:
         return None
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))
-    outs = run(env)
-    cols = []
-    fields = []
-    for e, (v, m), dt in zip(exprs, outs, out_dts):
-        dc = DeviceColumn(v, m, len(table), dt)
-        s = unstage(dc).rename(e.name())
-        cols.append(s)
-        fields.append(Field(e.name(), s.dtype))
-    return Table(Schema(fields), cols)
+    outs = run(env)  # async: device computes while the host moves on
+
+    def resolve():
+        cols = []
+        fields = []
+        for e, (v, m), dt in zip(exprs, outs, out_dts):
+            dc = DeviceColumn(v, m, n, dt)
+            s = unstage(dc).rename(e.name())
+            cols.append(s)
+            fields.append(Field(e.name(), s.dtype))
+        return Table(Schema(fields), cols)
+
+    return resolve
+
+
+def eval_projection_device(table, exprs, stage_cache: Optional[dict] = None) -> Optional[object]:
+    """Evaluate a projection on device; returns a host Table or None if ineligible."""
+    resolve = eval_projection_device_async(table, exprs, stage_cache)
+    return None if resolve is None else resolve()
 
 
 # ---------------------------------------------------------------------------

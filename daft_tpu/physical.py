@@ -73,27 +73,60 @@ class PhysicalOp:
         return ()
 
     def _map_execute(self, inputs, ctx):
-        """Sequential driver over map_partition — the single source of truth
-        shared with the parallel executor path. Honors UDF resource requests
-        (fail-fast on impossible ones; reference: pyrunner.py:352-370)."""
+        """Sequential driver over map_partition (the parallel executor has its
+        own worker-pool driver over the same map_partition; device-pipelinable
+        ops are routed HERE instead — see execute_plan). Honors UDF resource
+        requests (fail-fast on impossible ones; reference: pyrunner.py:352-370).
+
+        Device double-buffering: ops that implement map_partition_dispatch
+        launch partition i+1's staging + compute BEFORE partition i's result
+        is pulled back from the device, overlapping host↔HBM transfer with
+        device compute (reference role: the channelled pipeline of
+        daft-local-execution intermediate_op.rs:71+). Output order is
+        preserved; a host-path partition first drains the pending device one.
+        """
         from .execution import op_resource_request
 
         req = op_resource_request(self)
         if req:
             ctx.accountant.check(req)
         saw = False
+        pending = None  # deferred resolver of the previous device partition
         for part in inputs[0]:
             saw = True
             if req:
                 ctx.accountant.admit(req)
             try:
+                dispatch = self.map_partition_dispatch(part, ctx)
+                if dispatch is not None:
+                    if pending is not None:
+                        yield pending()
+                    pending = dispatch
+                    continue
+                if pending is not None:
+                    yield pending()
+                    pending = None
                 out = self.map_partition(part, ctx)
             finally:
                 if req:
                     ctx.accountant.release(req)
             yield out
+        if pending is not None:
+            yield pending()
         if not saw:
             yield from self.map_empty(ctx)
+
+    def map_partition_dispatch(self, part, ctx):
+        """Optional non-blocking launch for map_partition: return a zero-arg
+        resolver, or None to take the synchronous path."""
+        return None
+
+    def device_pipelinable(self, ctx) -> bool:
+        """True when this op's kernels compile for the device against its
+        child schema — execute_plan then prefers the double-buffered
+        sequential driver over thread fan-out (device compute serializes on
+        one chip; the pipeline keeps the host link busy instead)."""
+        return False
 
     def __init__(self, children: List["PhysicalOp"], schema: Schema, num_partitions: int):
         self.children = children
@@ -160,6 +193,20 @@ class ProjectOp(PhysicalOp):
 
     def map_partition(self, part, ctx):
         return ctx.eval_projection(part, self.exprs)
+
+    def map_partition_dispatch(self, part, ctx):
+        return ctx.eval_projection_dispatch(part, self.exprs)
+
+    def device_pipelinable(self, ctx) -> bool:
+        if not ctx.cfg.use_device_kernels:
+            return False
+        try:
+            from .kernels.device import normalize_and_check
+
+            return normalize_and_check(self.exprs,
+                                       self.children[0].schema) is not None
+        except Exception:
+            return False
 
     def _map_exprs(self):
         return self.exprs
