@@ -178,6 +178,7 @@ def main() -> int:
     }
 
     # ---- Q3 (3-way join + agg + top-k): the device join-probe rung --------
+    cust = orders = None
     try:
         cust = dt.from_arrow(tables["customer"]).collect()
         orders = dt.from_arrow(tables["orders"]).collect()
@@ -205,6 +206,35 @@ def main() -> int:
     except Exception as e:  # a regression here must be visible, not silent
         out["q3_vs_baseline"] = 0.0
         out["q3_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        cfg.use_device_kernels = True
+
+    # ---- Q5 (4-way join + agg): the deepest BASELINE.md join rung ---------
+    try:
+        if cust is None or orders is None:
+            raise RuntimeError("q3 inputs unavailable")
+        nat = dt.from_arrow(tables["nation"]).collect()
+
+        def run_q5():
+            return tpch.q5(cust, orders, frame, nat).collect().to_pydict()
+
+        def run_oracle_q5():
+            return tpch.oracle_q5(tables["customer"], tables["orders"],
+                                  lineitem, tables["nation"])
+
+        cfg.use_device_kernels = True
+        got5 = run_q5()  # cold: staging + compile
+        if _parity(got5, run_oracle_q5(), rtol=1e-6):
+            t_dev_q5, _ = _best_of(run_q5, n=2)
+            t_orc_q5, _ = _best_of(run_oracle_q5, n=2)
+            out["q5_device_s"] = round(t_dev_q5, 3)
+            out["q5_vs_baseline"] = round(t_orc_q5 / t_dev_q5, 3)
+        else:
+            out["q5_vs_baseline"] = 0.0
+            out["q5_error"] = "parity_mismatch"
+    except Exception as e:
+        out["q5_vs_baseline"] = 0.0
+        out["q5_error"] = f"{type(e).__name__}: {e}"[:200]
     finally:
         cfg.use_device_kernels = True
 

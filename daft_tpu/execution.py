@@ -458,11 +458,16 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
     def build(op: PhysicalOp) -> Iterator[MicroPartition]:
         child_streams = [build(c) for c in op.children]
         if (parallel and op.map_partition is not None and len(child_streams) == 1
-                and op.parallel_safe() and not op.device_pipelinable(ctx)):
+                and op.parallel_safe()):
+            tid = _next_tid(tid_counter) if trace else 0
+            if op.device_pipelinable(ctx) and not op_resource_request(op):
+                # device compute serializes on one chip: prefer the
+                # double-buffered sequential driver — but fall back to thread
+                # fan-out if the first partition declines the device path
+                return _adaptive_device_map(op, child_streams[0], ctx, tid)
             # instrumentation happens inside the workers (the consumer-side
             # wrapper would only measure blocked-wait time)
-            return _parallel_map(op, child_streams[0], ctx,
-                                 tid=_next_tid(tid_counter) if trace else 0)
+            return _parallel_map(op, child_streams[0], ctx, tid=tid)
         stream = op.execute(child_streams, ctx)
         if trace:
             return _traced(op, stream, ctx, _next_tid(tid_counter))
@@ -486,6 +491,28 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
 def _next_tid(counter):
     counter[0] += 1
     return counter[0]
+
+
+def _adaptive_device_map(op: PhysicalOp, child: Iterator[MicroPartition],
+                         ctx: ExecutionContext, tid: int) -> Iterator[MicroPartition]:
+    """Peek at the first partition: if it accepts the device dispatch, run the
+    whole stream through the double-buffered sequential driver (the launched
+    resolver is handed over as `_primed`, nothing recomputes); if it declines
+    (below device_min_rows, staging failure, ...), thread fan-out would have
+    been the better strategy after all — delegate the stream, first partition
+    included, to the worker pool."""
+    import itertools
+
+    it = iter(child)
+    first = next(it, None)
+    if first is None:
+        yield from op.execute([iter(())], ctx)
+        return
+    dispatch = op.map_partition_dispatch(first, ctx)
+    if dispatch is None:
+        yield from _parallel_map(op, itertools.chain([first], it), ctx, tid)
+        return
+    yield from op._map_execute([it], ctx, _primed=dispatch)
 
 
 def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],

@@ -72,11 +72,13 @@ class PhysicalOp:
     def _map_exprs(self):
         return ()
 
-    def _map_execute(self, inputs, ctx):
+    def _map_execute(self, inputs, ctx, _primed=None):
         """Sequential driver over map_partition (the parallel executor has its
         own worker-pool driver over the same map_partition; device-pipelinable
         ops are routed HERE instead — see execute_plan). Honors UDF resource
         requests (fail-fast on impossible ones; reference: pyrunner.py:352-370).
+        `_primed` is the already-launched resolver of a first partition the
+        caller consumed while deciding the execution strategy.
 
         Device double-buffering: ops that implement map_partition_dispatch
         launch partition i+1's staging + compute BEFORE partition i's result
@@ -90,14 +92,16 @@ class PhysicalOp:
         req = op_resource_request(self)
         if req:
             ctx.accountant.check(req)
-        saw = False
-        pending = None  # deferred resolver of the previous device partition
+        saw = _primed is not None
+        pending = _primed  # deferred resolver of the previous device partition
         for part in inputs[0]:
             saw = True
             if req:
                 ctx.accountant.admit(req)
             try:
-                dispatch = self.map_partition_dispatch(part, ctx)
+                # resource-requested ops never defer: the resolver would run
+                # outside the accountant's admission window
+                dispatch = None if req else self.map_partition_dispatch(part, ctx)
                 if dispatch is not None:
                     if pending is not None:
                         yield pending()
@@ -106,7 +110,7 @@ class PhysicalOp:
                 if pending is not None:
                     yield pending()
                     pending = None
-                out = self.map_partition(part, ctx)
+                out = self.map_partition_declined(part, ctx)
             finally:
                 if req:
                     ctx.accountant.release(req)
@@ -120,6 +124,12 @@ class PhysicalOp:
         """Optional non-blocking launch for map_partition: return a zero-arg
         resolver, or None to take the synchronous path."""
         return None
+
+    def map_partition_declined(self, part, ctx):
+        """Synchronous evaluation AFTER map_partition_dispatch returned None.
+        Ops whose dispatch already proved the device path ineligible override
+        this to skip a doomed second device attempt."""
+        return self.map_partition(part, ctx)
 
     def device_pipelinable(self, ctx) -> bool:
         """True when this op's kernels compile for the device against its
@@ -196,6 +206,12 @@ class ProjectOp(PhysicalOp):
 
     def map_partition_dispatch(self, part, ctx):
         return ctx.eval_projection_dispatch(part, self.exprs)
+
+    def map_partition_declined(self, part, ctx):
+        # dispatch already proved this partition device-ineligible: go
+        # straight to the host kernel instead of re-staging a doomed attempt
+        ctx.stats.bump("host_projections")
+        return part.eval_expression_list(self.exprs)
 
     def device_pipelinable(self, ctx) -> bool:
         if not ctx.cfg.use_device_kernels:

@@ -445,3 +445,40 @@ class TestPallasFusedSums:
         device_agg._AGG_CACHE.clear()
         assert q.stats.snapshot()["counters"].get("device_aggregations", 0) >= 1
         assert calls and calls[0][1] == 2, calls  # both sums in ONE batch
+
+
+class TestTpchJoinRungs32:
+    """BASELINE.md's Q5/Q6 rungs in the real-TPU configuration (x64 off):
+    the exact query formulations bench.py times, at test scale."""
+
+    def test_q6_parity(self, host_mode):
+        from benchmarks import tpch
+
+        li = tpch.generate_lineitem_only(scale=0.05, seed=11)
+        frame = dt.from_arrow(li).collect()
+        got = tpch.q6(frame).collect()
+        assert _counters(got).get("device_aggregations", 0) >= 1
+        want = tpch.oracle_q6(li)
+        assert abs(got.to_pydict()["revenue"][0] - want) <= 1e-6 * abs(want)
+
+    def test_q5_parity(self, host_mode):
+        from benchmarks import tpch
+
+        tables = tpch.generate_tables(scale=0.05, seed=11)
+        frame = dt.from_arrow(tables["lineitem"]).collect()
+        cust = dt.from_arrow(tables["customer"]).collect()
+        orders = dt.from_arrow(tables["orders"]).collect()
+        nat = dt.from_arrow(tables["nation"]).collect()
+        q = tpch.q5(cust, orders, frame, nat)
+        qc = q.collect()
+        got = qc.to_pydict()
+        # the device must actually carry the work: silent host fallback is
+        # the regression this file exists to catch
+        counters = _counters(qc)
+        assert (counters.get("device_join_probes", 0) >= 1
+                or counters.get("device_aggregations", 0) >= 1), counters
+        with host_mode():
+            want = tpch.q5(cust, orders, frame, nat).collect().to_pydict()
+        assert got.keys() == want.keys()
+        assert got["n_name"] == want["n_name"]
+        np.testing.assert_allclose(got["revenue"], want["revenue"], rtol=1e-6)

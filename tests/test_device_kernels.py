@@ -247,3 +247,33 @@ class TestPipelinedDeviceProjection:
             assert ctx.stats.counters.get("host_projections", 0) == 2
         finally:
             cfg.use_device_kernels, cfg.device_min_rows = old
+
+    def test_adaptive_fallback_to_worker_pool_when_first_declines(self):
+        import numpy as np
+
+        import daft_tpu
+        from daft_tpu import col
+        from daft_tpu.execution import execute_plan, ExecutionContext, RuntimeStats
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        cfg = self._cfg()
+        old = (cfg.use_device_kernels, cfg.device_min_rows, cfg.executor_threads)
+        cfg.use_device_kernels = True
+        cfg.device_min_rows = 10_000  # every partition below -> all decline
+        cfg.executor_threads = 4
+        try:
+            df = daft_tpu.from_pydict({
+                "x": np.arange(2_000, dtype=np.int64),
+            }).into_partitions(8).select((col("x") * 5).alias("y"))
+            ctx = ExecutionContext(cfg, RuntimeStats())
+            parts = list(execute_plan(translate(optimize(df._plan), cfg), ctx))
+            got = sorted(v for p in parts for v in p.to_pydict()["y"])
+            assert got == [x * 5 for x in range(2_000)]
+            c = ctx.stats.counters
+            assert c.get("device_projection_dispatches", 0) == 0, c
+            assert c.get("device_projections", 0) == 0, c
+            assert c.get("host_projections", 0) == 8, c
+        finally:
+            (cfg.use_device_kernels, cfg.device_min_rows,
+             cfg.executor_threads) = old
