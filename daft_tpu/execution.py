@@ -380,7 +380,7 @@ class ExecutionContext:
 
         eligible = (self.cfg.use_device_kernels
                     and how in ("inner", "left", "semi", "anti")
-                    and len(left_on) == 1 and len(right_on) == 1
+                    and 1 <= len(left_on) == len(right_on) <= 4
                     and max(lpart.num_rows_or_none() or 0,
                             rpart.num_rows_or_none() or 0) >= self.cfg.device_min_rows)
         if eligible:
@@ -388,11 +388,14 @@ class ExecutionContext:
                 from .kernels.device_join import (device_join_indices,
                                                   join_key_replicas)
 
+                single = len(left_on) == 1
                 res = device_join_indices(
-                    lpart.table(), rpart.table(), left_on[0], right_on[0],
+                    lpart.table(), rpart.table(), list(left_on), list(right_on),
                     lpart.device_stage_cache(), rpart.device_stage_cache(), how,
-                    left_replicas=join_key_replicas(lpart, left_on[0]),
-                    right_replicas=join_key_replicas(rpart, right_on[0]))
+                    left_replicas=(join_key_replicas(lpart, left_on[0])
+                                   if single else None),
+                    right_replicas=(join_key_replicas(rpart, right_on[0])
+                                    if single else None))
             except Exception:
                 res = None
             if res is not None:
@@ -464,7 +467,8 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
                 # device compute serializes on one chip: prefer the
                 # double-buffered sequential driver — but fall back to thread
                 # fan-out if the first partition declines the device path
-                return _adaptive_device_map(op, child_streams[0], ctx, tid)
+                return _adaptive_device_map(op, child_streams[0], ctx, tid,
+                                            trace)
             # instrumentation happens inside the workers (the consumer-side
             # wrapper would only measure blocked-wait time)
             return _parallel_map(op, child_streams[0], ctx, tid=tid)
@@ -494,13 +498,18 @@ def _next_tid(counter):
 
 
 def _adaptive_device_map(op: PhysicalOp, child: Iterator[MicroPartition],
-                         ctx: ExecutionContext, tid: int) -> Iterator[MicroPartition]:
+                         ctx: ExecutionContext, tid: int,
+                         trace: bool) -> Iterator[MicroPartition]:
     """Peek at the first partition: if it accepts the device dispatch, run the
     whole stream through the double-buffered sequential driver (the launched
     resolver is handed over as `_primed`, nothing recomputes); if it declines
     (below device_min_rows, staging failure, ...), thread fan-out would have
     been the better strategy after all — delegate the stream, first partition
-    included, to the worker pool."""
+    included, to the worker pool.
+
+    The accepted branch wraps in _traced like every other sequential stream
+    (per-partition stats, chrome-trace events, cancellation checks); the
+    declined branch's _parallel_map instruments inside its workers."""
     import itertools
 
     it = iter(child)
@@ -512,7 +521,10 @@ def _adaptive_device_map(op: PhysicalOp, child: Iterator[MicroPartition],
     if dispatch is None:
         yield from _parallel_map(op, itertools.chain([first], it), ctx, tid)
         return
-    yield from op._map_execute([it], ctx, _primed=dispatch)
+    stream = op._map_execute([it], ctx, _primed=dispatch)
+    if trace:
+        stream = _traced(op, stream, ctx, tid)
+    yield from stream
 
 
 def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],

@@ -84,6 +84,87 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     return vals, valid
 
 
+@jax.jit
+def _masked_min_max_multi(vs, ms):
+    """Per-column masked min/max for a tuple of key columns, ONE fused call
+    (and so one host sync) per side."""
+    mins = jnp.stack([jnp.min(jnp.where(m, v, jnp.iinfo(v.dtype).max))
+                      for v, m in zip(vs, ms)])
+    maxs = jnp.stack([jnp.max(jnp.where(m, v, jnp.iinfo(v.dtype).min))
+                      for v, m in zip(vs, ms)])
+    return mins, maxs
+
+
+@functools.partial(jax.jit, static_argnames=("mins", "strides", "wide"))
+def _pack_kernel(vs, ms, mins, strides, wide):
+    """Mixed-radix composite-key packing. Module-level jit with static
+    mins/strides tuples: warm joins with the same shapes/strides reuse the
+    compiled program instead of retracing a per-call closure."""
+    out_dt = jnp.int64 if wide else jnp.int32
+    packed = jnp.zeros(vs[0].shape, out_dt)
+    valid = jnp.ones(ms[0].shape, bool)
+    for v, m, mn, st in zip(vs, ms, mins, strides):
+        packed = packed + (v.astype(out_dt) - out_dt(mn)) * out_dt(st)
+        valid = valid & m
+    # clamp invalid lanes so padding garbage stays in-range (matching is
+    # still decided by the validity masks in the probe kernel)
+    return jnp.where(valid, packed, 0), valid
+
+
+def _pack_composite_keys(sides):
+    """Pack N integer key columns into ONE surrogate key column per side so
+    the single-key sorted probe applies unchanged (reference semantic: the
+    reference's probe table hashes all key columns together,
+    src/daft-table/src/probe_table/mod.rs:14-28; the TPU formulation needs a
+    total order, so it uses exact mixed-radix packing instead of hashing —
+    collision-free by construction).
+
+    `sides` is a list of [(vals, valid), ...] per side, all of the same key
+    count. Offsets/strides come from the min/max over BOTH sides so equal
+    keys pack identically. Returns [(packed, valid), ...] per side, or None
+    when the combined key space overflows the lane dtype (host join then).
+    A row's composite key is valid only if every component is.
+    """
+    from .device import x64_enabled
+
+    nkeys = len(sides[0])
+    per_side = []
+    for side in sides:
+        vs = tuple(v for v, _ in side)
+        ms = tuple(m for _, m in side)
+        mns, mxs = _masked_min_max_multi(vs, ms)
+        per_side.append((np.asarray(mns), np.asarray(mxs)))  # one sync/side
+    mins = []
+    spans = []
+    for j in range(nkeys):
+        lo = min(int(mns[j]) for mns, _ in per_side)
+        hi = max(int(mxs[j]) for _, mxs in per_side)
+        if hi < lo:  # all-null column on both sides: nothing can match
+            lo, hi = 0, 0
+        mins.append(lo)
+        spans.append(hi - lo + 1)
+    wide = x64_enabled()
+    limit = (2 ** 63 - 1) if wide else (2 ** 31 - 1)
+    total = 1
+    for s in spans:
+        total *= s
+        if total > limit:
+            return None
+    strides = []
+    acc = 1
+    for s in reversed(spans):
+        strides.append(acc)
+        acc *= s
+    strides = tuple(reversed(strides))
+
+    out = []
+    for side in sides:
+        vs = tuple(v for v, _ in side)
+        ms = tuple(m for _, m in side)
+        out.append(_pack_kernel(vs, ms, tuple(mins), strides, wide))
+    return out
+
+
 def _replica_cache_key(key_expr):
     from .device import x64_enabled
 
@@ -136,7 +217,7 @@ def _device_of(arr):
     return None
 
 
-def device_join_indices(left_table, right_table, left_key, right_key,
+def device_join_indices(left_table, right_table, left_keys, right_keys,
                         left_cache=None, right_cache=None, how: str = "inner",
                         left_replicas=None, right_replicas=None):
     """Probe on device. Returns (side, hit, bidx):
@@ -145,12 +226,33 @@ def device_join_indices(left_table, right_table, left_key, right_key,
     - side == "left_build": hit/bidx are per RIGHT row (bidx indexes left)
     or None when ineligible (non-integer keys, duplicate build keys, ...).
 
+    Accepts a single key or a list of keys per side: multi-column keys pack
+    into one surrogate lane via exact mixed-radix packing
+    (_pack_composite_keys) and then take the same sorted probe.
+
     When a side carries mesh replicas (replicate_join_key), the copy living on
     the OTHER side's device is swapped in, keeping the probe device-local.
     """
+    if not isinstance(left_keys, (list, tuple)):
+        left_keys = [left_keys]
+    if not isinstance(right_keys, (list, tuple)):
+        right_keys = [right_keys]
+    if len(left_keys) != len(right_keys) or not left_keys:
+        return None
     ln, rn = len(left_table), len(right_table)
     if ln == 0 or rn == 0:
         return None
+    if len(left_keys) > 1:
+        lks = [_stage_key(left_table, k, left_cache) for k in left_keys]
+        rks = [_stage_key(right_table, k, right_cache) for k in right_keys]
+        if any(k is None for k in lks) or any(k is None for k in rks):
+            return None
+        packed = _pack_composite_keys([lks, rks])
+        if packed is None:
+            return None
+        (lv, lm), (rv, rm) = packed
+        return _probe_both_ways(lv, lm, rv, rm, ln, rn, how)
+    left_key, right_key = left_keys[0], right_keys[0]
     lk = _stage_key(left_table, left_key, left_cache)
     if lk is None:
         return None
@@ -173,6 +275,10 @@ def device_join_indices(left_table, right_table, left_key, right_key,
     rv, rm = rk
     if lv.dtype != rv.dtype:
         return None
+    return _probe_both_ways(lv, lm, rv, rm, ln, rn, how)
+
+
+def _probe_both_ways(lv, lm, rv, rm, ln: int, rn: int, how: str):
     # try build=right first (probe order == host output order)
     hit, bidx, dup = _probe_kernel(rv, rm, lv, lm)
     if not bool(dup):

@@ -482,3 +482,75 @@ class TestTpchJoinRungs32:
         assert got.keys() == want.keys()
         assert got["n_name"] == want["n_name"]
         np.testing.assert_allclose(got["revenue"], want["revenue"], rtol=1e-6)
+
+
+class TestMultiKeyDeviceJoin32:
+    """Composite join keys pack into one surrogate lane (mixed-radix, exact)
+    and take the single-key sorted probe — in the 32-bit real-TPU mode the
+    packed space must fit int32 or the join falls back to host."""
+
+    def _parts(self, n=3000, k1_card=50, k2_card=40):
+        rng = np.random.RandomState(5)
+        left = dt.from_pydict({
+            "a": rng.randint(0, k1_card, n).astype(np.int64),
+            "b": rng.randint(0, k2_card, n).astype(np.int64),
+            "v": rng.rand(n)})
+        pairs = [(i, j) for i in range(k1_card) for j in range(k2_card)][::3]
+        right = dt.from_pydict({
+            "a2": np.array([p[0] for p in pairs], dtype=np.int64),
+            "b2": np.array([p[1] for p in pairs], dtype=np.int64),
+            "w": np.arange(len(pairs), dtype=np.int64)})
+        return left, right
+
+    @pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+    def test_two_key_join_parity(self, how, host_mode):
+        left, right = self._parts()
+        q = lambda: left.join(right, left_on=["a", "b"],
+                              right_on=["a2", "b2"], how=how).sort(
+            ["a", "b", "v"]).collect()
+        dev = q()
+        assert _counters(dev).get("device_join_probes", 0) >= 1, _counters(dev)
+        with host_mode():
+            host = q()
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d.keys() == h.keys()
+        for k in d:
+            if k in ("v",):
+                np.testing.assert_allclose(d[k], h[k], rtol=1e-7)
+            else:
+                assert d[k] == h[k], k
+
+    def test_key_space_overflow_falls_back_to_host(self, host_mode):
+        n = 2000
+        rng = np.random.RandomState(6)
+        # spans ~2^20 each -> packed space ~2^40 overflows int32 (x64 off)
+        left = dt.from_pydict({
+            "a": rng.randint(0, 1 << 20, n).astype(np.int64),
+            "b": rng.randint(0, 1 << 20, n).astype(np.int64)})
+        right = dt.from_pydict({
+            "a2": rng.randint(0, 1 << 20, n).astype(np.int64),
+            "b2": rng.randint(0, 1 << 20, n).astype(np.int64)})
+        dev = left.join(right, left_on=["a", "b"], right_on=["a2", "b2"]).collect()
+        assert _counters(dev).get("device_join_probes", 0) == 0
+        assert _counters(dev).get("host_joins", 0) >= 1
+
+    def test_null_component_never_matches(self, host_mode):
+        left = dt.from_pydict({
+            "a": dt.Series.from_pylist([1, 1, None, 2] * 30, "a",
+                                       dt.DataType.int64()),
+            "b": dt.Series.from_pylist([7, None, 7, 8] * 30, "b",
+                                       dt.DataType.int64())})
+        right = dt.from_pydict({
+            "a2": dt.Series.from_pylist([1, 2, None] * 30, "a2",
+                                        dt.DataType.int64()),
+            "b2": dt.Series.from_pylist([7, 8, None] * 30, "b2",
+                                        dt.DataType.int64())})
+        q = lambda: left.join(right, left_on=["a", "b"],
+                              right_on=["a2", "b2"]).agg(
+            dt.col("a").count().alias("c")).collect()
+        dev = q().to_pydict()
+        with host_mode():
+            host = q().to_pydict()
+        assert dev["c"] == host["c"]
+        # (1,7) x 30 left rows x 30 right rows; null components match nothing
+        assert dev["c"] == [30 * 30 + 30 * 30]
