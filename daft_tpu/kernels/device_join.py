@@ -8,9 +8,11 @@ no data-dependent control flow fits XLA, so the build side is SORTED once
 (cached with the partition, like column staging) and every probe is a
 vectorized `searchsorted` — O(P log B) fully on the VPU with static shapes.
 
-Scope (the TPC-H star-join shape): single integer/date key, unique keys on
-the build side (primary-key side). Multiplicity >1 or multi-column keys fall
-back to the host acero join. Probe direction adapts:
+Scope (the TPC-H star-join shape): 1-4 integer/date keys (multi-column keys
+pack into one surrogate lane via exact mixed-radix packing), unique keys on
+the build side (primary-key side). Multiplicity >1, an overflowing composite
+key space, or non-integer keys fall back to the host acero join. Probe
+direction adapts:
 
 - build = RIGHT side (right keys unique): inner/left/semi/anti with probe
   over the left rows — output already in host order (left idx, right idx).
@@ -95,16 +97,18 @@ def _masked_min_max_multi(vs, ms):
     return mins, maxs
 
 
-@functools.partial(jax.jit, static_argnames=("mins", "strides", "wide"))
+@functools.partial(jax.jit, static_argnames=("wide",))
 def _pack_kernel(vs, ms, mins, strides, wide):
-    """Mixed-radix composite-key packing. Module-level jit with static
-    mins/strides tuples: warm joins with the same shapes/strides reuse the
-    compiled program instead of retracing a per-call closure."""
+    """Mixed-radix composite-key packing. mins/strides are TRACED arrays —
+    they vary per partition pair, so making them static would retrace and
+    recompile per call; with them traced, one compilation per (shape, nkeys,
+    wide) serves every partition."""
     out_dt = jnp.int64 if wide else jnp.int32
     packed = jnp.zeros(vs[0].shape, out_dt)
     valid = jnp.ones(ms[0].shape, bool)
-    for v, m, mn, st in zip(vs, ms, mins, strides):
-        packed = packed + (v.astype(out_dt) - out_dt(mn)) * out_dt(st)
+    for i, (v, m) in enumerate(zip(vs, ms)):
+        packed = packed + ((v.astype(out_dt) - mins[i].astype(out_dt))
+                           * strides[i].astype(out_dt))
         valid = valid & m
     # clamp invalid lanes so padding garbage stays in-range (matching is
     # still decided by the validity masks in the probe kernel)
@@ -157,11 +161,14 @@ def _pack_composite_keys(sides):
         acc *= s
     strides = tuple(reversed(strides))
 
+    lane_np = np.int64 if wide else np.int32
+    mins_arr = np.asarray(mins, dtype=lane_np)
+    strides_arr = np.asarray(strides, dtype=lane_np)
     out = []
     for side in sides:
         vs = tuple(v for v, _ in side)
         ms = tuple(m for _, m in side)
-        out.append(_pack_kernel(vs, ms, tuple(mins), strides, wide))
+        out.append(_pack_kernel(vs, ms, mins_arr, strides_arr, wide))
     return out
 
 

@@ -540,17 +540,23 @@ class TestMultiKeyDeviceJoin32:
                                        dt.DataType.int64()),
             "b": dt.Series.from_pylist([7, None, 7, 8] * 30, "b",
                                        dt.DataType.int64())})
+        # build side: UNIQUE valid composite keys (PK side), one null row —
+        # duplicated build keys would correctly decline the device probe
         right = dt.from_pydict({
-            "a2": dt.Series.from_pylist([1, 2, None] * 30, "a2",
+            "a2": dt.Series.from_pylist([1, 2, None], "a2",
                                         dt.DataType.int64()),
-            "b2": dt.Series.from_pylist([7, 8, None] * 30, "b2",
+            "b2": dt.Series.from_pylist([7, 8, None], "b2",
                                         dt.DataType.int64())})
         q = lambda: left.join(right, left_on=["a", "b"],
                               right_on=["a2", "b2"]).agg(
             dt.col("a").count().alias("c")).collect()
-        dev = q().to_pydict()
+        devdf = q()
+        assert _counters(devdf).get("device_join_probes", 0) >= 1, \
+            _counters(devdf)  # the packed device path must carry this join
+        dev = devdf.to_pydict()
         with host_mode():
             host = q().to_pydict()
         assert dev["c"] == host["c"]
-        # (1,7) x 30 left rows x 30 right rows; null components match nothing
-        assert dev["c"] == [30 * 30 + 30 * 30]
+        # (1,7) x 30 and (2,8) x 30 left rows match one build row each; rows
+        # with a null component match nothing
+        assert dev["c"] == [30 + 30]
