@@ -58,6 +58,10 @@ class ExecutionConfig:
     # >= 4 cores; sequential below that — oversubscription on tiny hosts
     # costs more than it buys), 1 = sequential, N = exactly N workers
     executor_threads: int = 0
+    # extra tasks queued beyond the worker count in the dispatch loop
+    # (reference: RayRunner's cores + max_task_backlog dynamic bound,
+    # ray_runner.py:504-685); -1 = auto (one backlog slot per worker)
+    max_task_backlog: int = -1
     # TPU-specific: route eligible projections/aggregations through the jax
     # device kernel layer (kernels/device.py); host pyarrow path otherwise.
     use_device_kernels: bool = False

@@ -535,21 +535,15 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
 
     Stats/trace events are recorded around the worker-side call, so
     explain_analyze sees real work time, not the consumer's blocked waits."""
-    from collections import deque
-
     from . import tracing
+    from .scheduler import PartitionTask, dispatch
 
     name = op.name()
-
     req = op_resource_request(op)
 
     def run_one(part):
         t0 = time.perf_counter_ns()
-        try:
-            out = op.map_partition(part, ctx)
-        finally:
-            if req:
-                ctx.accountant.release(req)
+        out = op.map_partition(part, ctx)
         dt = time.perf_counter_ns() - t0
         n = out.num_rows_or_none()
         rows = n if n is not None else 0
@@ -558,38 +552,18 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
             tracing.add_event(name, t0 / 1000.0, dt / 1000.0, tid, {"rows": rows})
         return out
 
-    pool = ctx.pool()
-    window = ctx.num_workers * 2
-    pending: "deque" = deque()
-    saw_any = False
+    saw_any = [False]
 
-    def emit(part):
-        n = part.num_rows_or_none()
+    def tasks():
+        for i, part in enumerate(child):
+            saw_any[0] = True
+            yield PartitionTask(part, run_one, req, name, i)
+
+    for out in dispatch(tasks(), ctx):
+        n = out.num_rows_or_none()
         tracing.report_progress(name, n if n is not None else 0)
-        return part
-
-    try:
-        for part in child:
-            if ctx.stats.is_cancelled():
-                raise QueryCancelledError(f"query cancelled (at {name})")
-            saw_any = True
-            if req:
-                # dispatch-loop admission (reference: pyrunner.py:352-370):
-                # block HERE, not on a worker thread, so admitted tasks
-                # always hold a thread and progress
-                ctx.accountant.admit(req)
-            pending.append(pool.submit(run_one, part))
-            while len(pending) >= window:
-                yield emit(pending.popleft().result())
-        while pending:
-            yield emit(pending.popleft().result())
-    finally:
-        for f in pending:
-            # a queued task that never ran still holds its admission
-            # reservation: return it, or a later admit() waits forever
-            if f.cancel() and req:
-                ctx.accountant.release(req)
-    if not saw_any:
+        yield out
+    if not saw_any[0]:
         yield from op.map_empty(ctx)
 
 

@@ -1,0 +1,95 @@
+"""Partition-task scheduling: explicit tasks + a bounded dispatch loop.
+
+Role-equivalent to the reference's task layer: `PartitionTask`
+(`daft/execution/execution_step.py:31-166` — one unit of per-partition work
+with its resource request), the PyRunner admission/dispatch loop
+(`daft/runners/pyrunner.py:352-370`), and the RayRunner's dynamic backlog of
+`cores + max_task_backlog` in-flight tasks (`ray_runner.py:504-685`). The TPU
+build keeps the same structure on one host: tasks are dispatched to a thread
+pool while the in-flight window has room, results are yielded in task order,
+and a task's resource request is admitted before dispatch and released when
+its work (or cancellation) finishes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterator, Optional
+
+from .micropartition import MicroPartition
+
+
+class PartitionTask:
+    """One unit of per-partition work: a partition, the function to run on
+    it, and the resource request the accountant must admit first."""
+
+    __slots__ = ("partition", "fn", "resource_request", "op_name", "seq")
+
+    def __init__(self, partition: MicroPartition, fn: Callable,
+                 resource_request=None, op_name: str = "task", seq: int = 0):
+        self.partition = partition
+        self.fn = fn
+        self.resource_request = resource_request
+        self.op_name = op_name
+        self.seq = seq
+
+    def run(self) -> MicroPartition:
+        return self.fn(self.partition)
+
+    def __repr__(self) -> str:
+        return f"PartitionTask({self.op_name}#{self.seq})"
+
+
+def dispatch(tasks: Iterator[PartitionTask], ctx,
+             window: Optional[int] = None) -> Iterator[MicroPartition]:
+    """Run tasks on the context's worker pool with a bounded in-flight window,
+    yielding results IN TASK ORDER.
+
+    - window defaults to `num_workers + max_task_backlog` (reference:
+      RayRunner's `cores + max_task_backlog` dynamic dispatch bound).
+    - a task's resource_request is admitted on the DISPATCH thread (so
+      admitted tasks always hold a worker and make progress) and released by
+      the worker when the task finishes — or by the dispatcher if a queued
+      task is cancelled before it ever ran.
+    - cancellation is honored between dispatches.
+    """
+    from .execution import QueryCancelledError
+
+    if window is None:
+        backlog = ctx.cfg.max_task_backlog
+        if backlog < 0:  # auto: one backlog slot per worker
+            backlog = ctx.num_workers
+        window = ctx.num_workers + backlog
+    window = max(1, window)
+    pool = ctx.pool()
+    pending: deque = deque()
+
+    def run_task(task: PartitionTask) -> MicroPartition:
+        try:
+            return task.run()
+        finally:
+            # drop the input partition as soon as the work is done — the
+            # result may wait in `pending` behind a slow head-of-line task,
+            # and holding input + output would double peak partition memory
+            task.partition = None
+            if task.resource_request:
+                ctx.accountant.release(task.resource_request)
+
+    try:
+        for task in tasks:
+            if ctx.stats.is_cancelled():
+                raise QueryCancelledError(
+                    f"query cancelled (at {task.op_name})")
+            if task.resource_request:
+                ctx.accountant.admit(task.resource_request)
+            pending.append((task, pool.submit(run_task, task)))
+            while len(pending) >= window:
+                yield pending.popleft()[1].result()
+        while pending:
+            yield pending.popleft()[1].result()
+    finally:
+        for task, fut in pending:
+            # a queued task that never ran still holds its admission
+            # reservation: return it, or a later admit() waits forever
+            if fut.cancel() and task.resource_request:
+                ctx.accountant.release(task.resource_request)

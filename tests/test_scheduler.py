@@ -1,0 +1,139 @@
+"""PartitionTask + dispatch-loop tests (reference: execution_step.py
+PartitionTask, pyrunner.py admission loop, ray_runner.py backlog bound)."""
+
+import threading
+import time
+
+import pytest
+
+import daft_tpu
+from daft_tpu.execution import ExecutionContext, QueryCancelledError, RuntimeStats
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.scheduler import PartitionTask, dispatch
+from daft_tpu.table import Table
+
+
+def _ctx(threads=4, backlog=-1):
+    cfg = daft_tpu.context.get_context().execution_config
+    import copy
+
+    c = copy.copy(cfg)
+    c.executor_threads = threads
+    c.max_task_backlog = backlog
+    return ExecutionContext(c, RuntimeStats())
+
+
+def _mp(i):
+    return MicroPartition.from_table(Table.from_pydict({"x": [i]}))
+
+
+def test_results_in_task_order():
+    ctx = _ctx()
+    delays = {0: 0.05, 1: 0.0, 2: 0.02, 3: 0.0}
+
+    def fn(part):
+        i = part.to_pydict()["x"][0]
+        time.sleep(delays[i % 4])
+        return part
+
+    tasks = (PartitionTask(_mp(i), fn, None, "t", i) for i in range(12))
+    out = [p.to_pydict()["x"][0] for p in dispatch(tasks, ctx)]
+    assert out == list(range(12))
+    ctx.shutdown_pool()
+
+
+def test_window_bounds_in_flight():
+    ctx = _ctx(threads=2, backlog=1)  # window = 3
+    live = []
+    peak = []
+    lock = threading.Lock()
+
+    def fn(part):
+        with lock:
+            live.append(1)
+            peak.append(len(live))
+        time.sleep(0.01)
+        with lock:
+            live.pop()
+        return part
+
+    tasks = (PartitionTask(_mp(i), fn, None, "t", i) for i in range(20))
+    list(dispatch(tasks, ctx))
+    assert max(peak) <= 2  # only `threads` run concurrently
+    ctx.shutdown_pool()
+
+
+def test_backlog_limits_task_pulls():
+    # the dispatcher must not drain the whole source into the queue: with
+    # window=2 it may hold at most 2 undelivered tasks at any time
+    ctx = _ctx(threads=1, backlog=1)
+    pulled = []
+
+    def src():
+        for i in range(10):
+            pulled.append(i)
+            yield PartitionTask(_mp(i), lambda p: p, None, "t", i)
+
+    g = dispatch(src(), ctx)
+    next(g)  # one result delivered
+    assert len(pulled) <= 3  # window 2 + the one being delivered
+    list(g)
+    ctx.shutdown_pool()
+
+
+def test_cancellation_raises_and_releases():
+    ctx = _ctx(threads=2)
+    ctx.stats.cancel()
+    tasks = (PartitionTask(_mp(i), lambda p: p, None, "t", i) for i in range(4))
+    with pytest.raises(QueryCancelledError):
+        list(dispatch(tasks, ctx))
+    ctx.shutdown_pool()
+
+
+def test_error_propagates_and_queue_drains():
+    ctx = _ctx(threads=2, backlog=0)
+
+    def fn(part):
+        i = part.to_pydict()["x"][0]
+        if i == 3:
+            raise ValueError("boom")
+        return part
+
+    tasks = (PartitionTask(_mp(i), fn, None, "t", i) for i in range(8))
+    got = []
+    with pytest.raises(ValueError, match="boom"):
+        for p in dispatch(tasks, ctx):
+            got.append(p.to_pydict()["x"][0])
+    assert got == [0, 1, 2]
+    ctx.shutdown_pool()
+
+
+def test_resource_release_on_early_exit():
+    # abandoning the dispatch generator (limit early-stop) must return every
+    # queued task's admission reservation to the ledger
+    ctx = _ctx(threads=1, backlog=2)
+    from daft_tpu.execution import ResourceRequest
+
+    req = ResourceRequest(num_cpus=1.0)
+
+    def slow(part):
+        time.sleep(0.01)
+        return part
+
+    tasks = (PartitionTask(_mp(i), slow, req, "t", i) for i in range(10))
+    g = dispatch(tasks, ctx)
+    next(g)
+    g.close()  # early exit
+    # ledger drained back to zero -> a fresh admit must not block
+    done = []
+
+    def try_admit():
+        ctx.accountant.admit(req)
+        ctx.accountant.release(req)
+        done.append(1)
+
+    t = threading.Thread(target=try_admit)
+    t.start()
+    t.join(timeout=5)
+    assert done, "admission ledger leaked reservations after early exit"
+    ctx.shutdown_pool()
