@@ -552,18 +552,19 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
             tracing.add_event(name, t0 / 1000.0, dt / 1000.0, tid, {"rows": rows})
         return out
 
-    saw_any = [False]
+    saw_any = False
 
     def tasks():
+        nonlocal saw_any
         for i, part in enumerate(child):
-            saw_any[0] = True
+            saw_any = True
             yield PartitionTask(part, run_one, req, name, i)
 
     for out in dispatch(tasks(), ctx):
         n = out.num_rows_or_none()
         tracing.report_progress(name, n if n is not None else 0)
         yield out
-    if not saw_any[0]:
+    if not saw_any:
         yield from op.map_empty(ctx)
 
 

@@ -114,7 +114,9 @@ def test_resource_release_on_early_exit():
     ctx = _ctx(threads=1, backlog=2)
     from daft_tpu.execution import ResourceRequest
 
-    req = ResourceRequest(num_cpus=1.0)
+    # request the ledger's FULL cpu budget so a single leaked reservation
+    # blocks the probe admit on any host, not just a 1-core machine
+    req = ResourceRequest(num_cpus=float(ctx.accountant.total_cpus))
 
     def slow(part):
         time.sleep(0.01)
