@@ -145,39 +145,25 @@ def main() -> int:
             cust = None
             out["host_rungs_error"] = f"{type(e).__name__}: {e}"[:120]
         if cust is not None:
-            try:  # parity gates timing, as everywhere else in this file
-                want3 = tpch.oracle_q3(tables["customer"], tables["orders"],
-                                       lineitem)
-                if _parity(tpch.q3(cust, orders, frame).collect().to_pydict(),
-                           want3, rtol=1e-6):
-                    t_q3, _ = _best_of(
-                        lambda: tpch.q3(cust, orders, frame).collect()
-                        .to_pydict(), n=2)
-                    t_o3, _ = _best_of(
-                        lambda: tpch.oracle_q3(tables["customer"],
-                                               tables["orders"], lineitem), n=2)
-                    out["q3_host_vs_baseline"] = round(t_o3 / t_q3, 3)
-                else:
-                    out["q3_host_vs_baseline"] = 0.0
-            except Exception as e:
-                out["q3_host_error"] = f"{type(e).__name__}: {e}"[:120]
-            try:
-                want5 = tpch.oracle_q5(tables["customer"], tables["orders"],
-                                       lineitem, tables["nation"])
-                if _parity(tpch.q5(cust, orders, frame, nat).collect()
-                           .to_pydict(), want5, rtol=1e-6):
-                    t_q5, _ = _best_of(
-                        lambda: tpch.q5(cust, orders, frame, nat).collect()
-                        .to_pydict(), n=2)
-                    t_o5, _ = _best_of(
-                        lambda: tpch.oracle_q5(tables["customer"],
-                                               tables["orders"], lineitem,
-                                               tables["nation"]), n=2)
-                    out["q5_host_vs_baseline"] = round(t_o5 / t_q5, 3)
-                else:
-                    out["q5_host_vs_baseline"] = 0.0
-            except Exception as e:
-                out["q5_host_error"] = f"{type(e).__name__}: {e}"[:120]
+            rungs = [
+                ("q3", lambda: tpch.q3(cust, orders, frame).collect().to_pydict(),
+                 lambda: tpch.oracle_q3(tables["customer"], tables["orders"],
+                                        lineitem)),
+                ("q5", lambda: tpch.q5(cust, orders, frame, nat).collect()
+                 .to_pydict(),
+                 lambda: tpch.oracle_q5(tables["customer"], tables["orders"],
+                                        lineitem, tables["nation"])),
+            ]
+            for name, engine_fn, oracle_fn in rungs:
+                try:  # parity gates timing, as everywhere else in this file
+                    if _parity(engine_fn(), oracle_fn(), rtol=1e-6):
+                        t_eng, _ = _best_of(engine_fn, n=2)
+                        t_orc, _ = _best_of(oracle_fn, n=2)
+                        out[f"{name}_host_vs_baseline"] = round(t_orc / t_eng, 3)
+                    else:
+                        out[f"{name}_host_vs_baseline"] = 0.0
+                except Exception as e:
+                    out[f"{name}_host_error"] = f"{type(e).__name__}: {e}"[:120]
         print(json.dumps(out))
         return 1
 

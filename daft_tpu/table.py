@@ -30,6 +30,18 @@ from .schema import Field, Schema
 from .series import Series
 
 
+def _downcast_key_offsets(arr):
+    """large_string/large_binary -> 32-bit-offset variant when the buffer fits
+    (< 2GiB): acero's hash table is ~3x slower on 64-bit-offset keys. Single
+    shared implementation for the join and both grouped-agg paths."""
+    if arr.nbytes < (1 << 31) - 1:
+        if pa.types.is_large_string(arr.type):
+            return arr.cast(pa.string())
+        if pa.types.is_large_binary(arr.type):
+            return arr.cast(pa.binary())
+    return arr
+
+
 def _as_expressions(exprs) -> List[Expression]:
     if isinstance(exprs, Expression):
         return [exprs]
@@ -445,14 +457,8 @@ class Table:
             arr = s.to_arrow()
             if pa.types.is_nested(arr.type) or pa.types.is_dictionary(arr.type):
                 return None
-            # acero's hash table is ~3x slower on large_string keys; the 32-bit
-            # offset downcast is safe whenever the buffer is < 2GiB
-            if arr.nbytes < (1 << 31) - 1:
-                if pa.types.is_large_string(arr.type):
-                    arr = arr.cast(pa.string())
-                elif pa.types.is_large_binary(arr.type):
-                    arr = arr.cast(pa.binary())
-            cols[f"k{i}"] = arr
+            # acero's hash table is ~3x slower on large_string keys
+            cols[f"k{i}"] = _downcast_key_offsets(arr)
             key_names.append(f"k{i}")
         plans = []  # (vname, fname, node, alias)
         agg_list = []
@@ -624,14 +630,26 @@ class Table:
             lkc.append(a.cast(u))
             rkc.append(b.cast(u))
 
+        # acero's hash table is ~3x slower on large_string keys (same effect
+        # as in _acero_grouped_agg). The downcast decision is made JOINTLY per
+        # key index: both sides must qualify, or acero would see mismatched
+        # string vs large_string key types and raise.
+        lka = [s.to_arrow() for s in lkc]
+        rka = [s.to_arrow() for s in rkc]
+        for i in range(len(lka)):
+            la = _downcast_key_offsets(lka[i])
+            ra = _downcast_key_offsets(rka[i])
+            if la.type == ra.type:
+                lka[i], rka[i] = la, ra
+
         key_names = [f"__k{i}" for i in range(len(lkc))]
         lt = pa.Table.from_arrays(
-            [s.to_arrow() for s in lkc] + [c.to_arrow() for c in self._columns]
+            lka + [c.to_arrow() for c in self._columns]
             + [pa.array(np.arange(len(self), dtype=np.int64))],
             names=key_names + [f"__l{i}" for i in range(len(self._columns))] + ["__lidx"],
         )
         rt = pa.Table.from_arrays(
-            [s.to_arrow() for s in rkc] + [c.to_arrow() for c in right._columns]
+            rka + [c.to_arrow() for c in right._columns]
             + [pa.array(np.arange(len(right), dtype=np.int64))],
             names=key_names + [f"__r{i}" for i in range(len(right._columns))] + ["__ridx"],
         )
