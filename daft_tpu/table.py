@@ -32,8 +32,10 @@ from .series import Series
 
 def _downcast_key_offsets(arr):
     """large_string/large_binary -> 32-bit-offset variant when the buffer fits
-    (< 2GiB): acero's hash table is ~3x slower on 64-bit-offset keys. Single
-    shared implementation for the join and both grouped-agg paths."""
+    (< 2GiB): acero's hash table is ~3x slower on 64-bit-offset keys. Shared
+    by the join and _acero_grouped_agg; the fused filter+agg path mirrors the
+    same rule at the acero-expression level (it casts expressions, not
+    arrays)."""
     if arr.nbytes < (1 << 31) - 1:
         if pa.types.is_large_string(arr.type):
             return arr.cast(pa.string())
