@@ -6,10 +6,12 @@ predicate that stays a mask (no host compaction) — the TPU analog of the
 reference's fused streaming pipeline (src/daft-local-execution/src/pipeline.rs:141-211
 and the grouped-agg sinks in src/daft-table/src/ops/agg.rs).
 
-Division of labor (SURVEY §7): the host does the O(groups) bookkeeping —
-dictionary-encoded group codes via Table._group_codes — and the VPU does the
-O(rows) work: projections fused into masked `segment_sum/min/max` reductions
-with static segment counts (padded to a power of two so XLA compiles once per
+Division of labor (SURVEY §7): single integer/date group keys compute their
+dense codes ON DEVICE (_group_codes_kernel: sort + boundary scan +
+first-occurrence remap); string and multi-column keys fall back to the host
+dictionary encode (Table._group_codes). Either way the VPU does the O(rows)
+work: projections fused into masked `segment_sum/min/max` reductions with
+static segment counts (padded to a power of two so XLA compiles once per
 bucket, not once per cardinality).
 
 32-bit mode (real TPUs, x64 off): float64 inputs compute as float32; per-call
@@ -22,6 +24,7 @@ n * max|v| could exceed int32 (rare; correctness over speed).
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -51,6 +54,68 @@ def _unwrap(expr):
     while isinstance(node, Alias):
         node = node.child
     return node if isinstance(node, AggExpr) else None
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _group_codes_kernel(vals, valid, n):
+    """Dense group codes for ONE integer key column, fully on device:
+    sort -> boundary detect -> scan -> scatter, then remap codes to
+    FIRST-OCCURRENCE order so the output group order matches the host
+    dictionary-encode exactly (including the SQL rule that null keys form
+    one group). Returns (codes [b] int32, num_groups, first_rows [b],
+    uniq_vals [b], uniq_valid [b]) — the uniq arrays are meaningful for the
+    first num_groups lanes, ordered by first occurrence."""
+    b = vals.shape[0]
+    idx = jnp.arange(b, dtype=jnp.int32)
+    oob = idx >= n                      # padding lanes beyond the real rows
+    isnull = (~valid) & (~oob)          # null KEYS group together (SQL)
+    big = jnp.iinfo(vals.dtype).max
+    k = jnp.where(valid, vals, big)
+    perm = jnp.lexsort((k, isnull.astype(jnp.int32), oob.astype(jnp.int32)))
+    sk = k[perm]
+    snull = isnull[perm]
+    soob = oob[perm]
+    prev_diff = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (sk[1:] != sk[:-1]) | (snull[1:] != snull[:-1])])
+    boundary = (~soob) & prev_diff
+    codes_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    codes_sorted = jnp.maximum(codes_sorted, 0)  # padding lanes -> group 0
+    codes = jnp.zeros(b, jnp.int32).at[perm].set(codes_sorted)
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    # first-occurrence row per group; padding contributes the sentinel b
+    first = jnp.full(b, b, jnp.int32).at[codes].min(jnp.where(oob, b, idx))
+    order = jnp.argsort(first)          # empty/sentinel groups sort last
+    inv = jnp.zeros(b, jnp.int32).at[order].set(jnp.arange(b, dtype=jnp.int32))
+    codes = inv[codes]
+    first_rows = first[order]
+    safe_rows = jnp.minimum(first_rows, b - 1)
+    return codes, num_groups, first_rows, vals[safe_rows], valid[safe_rows]
+
+
+def _try_device_group_codes(table, key_expr, stage_cache, n: int):
+    """(codes_dev, uniq Table, num_groups) via the device kernel, or None when
+    the key is not a single staged integer/date column. The host fallback
+    (_group_codes dictionary encode) handles strings and multi-key grouping."""
+    from ..schema import Field, Schema
+    from ..table import Table
+
+    from .device_join import _stage_key
+
+    staged = _stage_key(table, key_expr, stage_cache)
+    if staged is None:
+        return None
+    vals, valid = staged
+    codes, num_groups, _first, uvals, uvalid = _group_codes_kernel(
+        vals, valid, jnp.int32(n))
+    num_groups = int(num_groups)  # one tiny sync; bounds the segment bucket
+    from .device import DeviceColumn, unstage
+
+    kdt = key_expr._node.to_field(table.schema).dtype
+    uniq_col = unstage(DeviceColumn(uvals, uvalid, num_groups, kdt))
+    name = key_expr.name()
+    uniq = Table(Schema([Field(name, kdt)]), [uniq_col.rename(name)])
+    return codes, uniq, num_groups
 
 
 def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = None,
@@ -104,16 +169,25 @@ def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = No
     codes_key = ("groupcodes", tuple(e._node._key() for e in group_by), b)
     cached = stage_cache.get(codes_key) if stage_cache is not None else None
     if cached is None:
-        if group_by:
-            key_tbl = table.eval_expression_list(list(group_by))
-            codes_np, uniq = _group_codes(key_tbl)
-            num_groups = len(uniq)
-        else:
-            codes_np = np.zeros(n, dtype=np.int64)
-            uniq = None
-            num_groups = 1
-        codes_dev = jnp.asarray(np.pad(codes_np.astype(np.int32), (0, b - n)))
-        cached = (codes_dev, uniq, num_groups)
+        if len(group_by) == 1:
+            # single integer/date key: codes computed ON DEVICE (sort +
+            # boundary scan), keeping the O(rows) bookkeeping off the host
+            try:
+                cached = _try_device_group_codes(table, group_by[0],
+                                                 stage_cache, n)
+            except Exception:
+                cached = None
+        if cached is None:
+            if group_by:
+                key_tbl = table.eval_expression_list(list(group_by))
+                codes_np, uniq = _group_codes(key_tbl)
+                num_groups = len(uniq)
+            else:
+                codes_np = np.zeros(n, dtype=np.int64)
+                uniq = None
+                num_groups = 1
+            codes_dev = jnp.asarray(np.pad(codes_np.astype(np.int32), (0, b - n)))
+            cached = (codes_dev, uniq, num_groups)
         if stage_cache is not None:
             stage_cache[codes_key] = cached
     codes_dev, uniq, num_groups = cached

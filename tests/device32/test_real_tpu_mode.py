@@ -560,3 +560,53 @@ class TestMultiKeyDeviceJoin32:
         # (1,7) x 30 and (2,8) x 30 left rows match one build row each; rows
         # with a null component match nothing
         assert dev["c"] == [30 + 30]
+
+
+class TestDeviceGroupCodes32:
+    """Group codes computed ON DEVICE for single integer/date keys (sort +
+    boundary scan + first-occurrence remap) — the O(rows) bookkeeping leaves
+    the host; order and null-group semantics must match the host dictionary
+    encode exactly."""
+
+    def test_high_cardinality_parity_and_order(self, host_mode):
+        rng = np.random.RandomState(13)
+        data = {"k": rng.randint(0, 20_000, 60_000).astype(np.int64),
+                "v": rng.rand(60_000)}
+
+        def q():
+            return (dt.from_pydict(data).groupby("k").agg(
+                col("v").sum().alias("s"), col("v").count().alias("c")))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["k"] == h["k"]  # first-occurrence group order, exact
+        assert d["c"] == h["c"]
+        np.testing.assert_allclose(d["s"], h["s"], rtol=1e-5)
+
+    def test_null_keys_form_one_group(self, host_mode):
+        ks = [5, None, 5, 2, None, 9] * 2000
+
+        def q():
+            return (dt.from_pydict({
+                "k": dt.Series.from_pylist(ks, "k", dt.DataType.int64()),
+                "v": np.arange(len(ks), dtype=np.float64)})
+                .groupby("k").agg(col("v").count().alias("c")))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_date_keys_on_device(self, host_mode):
+        dates = _dates(20_000)
+        vals = RNG.rand(20_000)  # generated ONCE: q() is built twice
+
+        def q():
+            return (dt.from_pydict({"d": dates, "v": vals})
+                    .groupby("d").agg(col("v").sum().alias("s")))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["d"] == h["d"]
+        np.testing.assert_allclose(d["s"], h["s"], rtol=1e-5)
