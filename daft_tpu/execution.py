@@ -321,6 +321,32 @@ class ExecutionContext:
 
         return finish
 
+    def eval_sort(self, part: MicroPartition, sort_by, descending=None,
+                  nulls_first=None) -> MicroPartition:
+        """Route a per-partition sort through the device argsort when
+        eligible: keys compile + sort on device, only the payload take runs
+        on host. Host pyarrow sort otherwise."""
+        if self._device_eligible(part):
+            try:
+                from .kernels.device import device_table_argsort
+
+                idx = device_table_argsort(
+                    part.table(), sort_by, descending, nulls_first,
+                    stage_cache=part.device_stage_cache())
+            except Exception:
+                idx = None
+            if idx is not None:
+                import numpy as np
+
+                from .series import Series
+
+                self.stats.bump("device_sorts")
+                tbl = part.table().take(
+                    Series.from_numpy(idx.astype(np.uint64), "indices"))
+                return MicroPartition.from_table(tbl)
+        self.stats.bump("host_sorts")
+        return part.sort(sort_by, descending, nulls_first)
+
     def eval_agg(self, part: MicroPartition, aggregations, groupby,
                  predicate=None) -> MicroPartition:
         """Route a (optionally filter-fused) grouped aggregation through the

@@ -617,17 +617,12 @@ def normalize_and_check(exprs, schema) -> Optional[list]:
     return nodes
 
 
-def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = None):
-    """Dispatch a device projection WITHOUT blocking: staging and the jitted
-    compute launch happen now (jax dispatch is asynchronous); the returned
-    zero-arg resolver materializes the host Table (device_get) when called.
-    This is what lets the executor double-buffer — stage morsel i+1 while the
-    device still computes morsel i (reference role: the pipelined channel
-    hand-off of daft-local-execution intermediate_op.rs:71+).
-    Returns None if ineligible."""
+def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
+    """Shared device prologue: normalize + eligibility-check the expressions,
+    stage the input columns, compile and launch ONE jitted program. Returns
+    (outs, out_dts, nodes) with `outs` still on device (async), or None when
+    ineligible. Used by the projection and sort paths."""
     from ..expressions import required_columns
-    from ..schema import Field, Schema
-    from ..table import Table
 
     schema = table.schema
     n = len(table)
@@ -641,12 +636,29 @@ def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = Non
         needed.update(required_columns(nd))
     if not needed:
         return None
-    b = size_bucket(n)
-    env = stage_table_columns(table, needed, b, stage_cache)
+    env = stage_table_columns(table, needed, size_bucket(n), stage_cache)
     if env is None:
         return None
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))
-    outs = run(env)  # async: device computes while the host moves on
+    return run(env), out_dts, nodes
+
+
+def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = None):
+    """Dispatch a device projection WITHOUT blocking: staging and the jitted
+    compute launch happen now (jax dispatch is asynchronous); the returned
+    zero-arg resolver materializes the host Table (device_get) when called.
+    This is what lets the executor double-buffer — stage morsel i+1 while the
+    device still computes morsel i (reference role: the pipelined channel
+    hand-off of daft-local-execution intermediate_op.rs:71+).
+    Returns None if ineligible."""
+    from ..schema import Field, Schema
+    from ..table import Table
+
+    n = len(table)
+    staged = _stage_and_run(table, exprs, stage_cache)
+    if staged is None:
+        return None
+    outs, out_dts, _ = staged  # async: device computes while the host moves on
 
     def resolve():
         cols = []
@@ -814,7 +826,8 @@ def _scatter_sum_kahan(values, codes, num_segments):
 def _sortable_bits(values: jax.Array, valid: jax.Array, descending: bool,
                    nulls_first: bool) -> List[jax.Array]:
     """Map (values, valid) to one or two uint32 key lanes whose lexicographic
-    unsigned order equals the requested total order (nulls at extremes, NaN last).
+    unsigned order equals the requested total order (nulls at extremes; NaN
+    above every number, matching arrow).
 
     Works in both x64 and 32-bit-only (real TPU) modes: 64-bit inputs (only
     present under x64) are split into hi/lo uint32 lanes.
@@ -831,13 +844,18 @@ def _sortable_bits(values: jax.Array, valid: jax.Array, descending: bool,
         else:
             bits = jax.lax.bitcast_convert_type(v.astype(jnp.int32), jnp.uint32) ^ jnp.uint32(1 << 31)
     else:
+        # canonicalize every NaN to the POSITIVE quiet NaN: its bit pattern
+        # sits strictly above +inf, so NaN sorts after all numbers ascending
+        # (and first descending) — exactly arrow's NaN-greatest order. The
+        # old inf-substitution made NaN TIE with real +inf.
         if width64:
-            f = jnp.where(jnp.isnan(v), jnp.inf, v)
+            f = jnp.where(jnp.isnan(v), jnp.asarray(jnp.nan, v.dtype), v)
             b = jax.lax.bitcast_convert_type(f, jnp.int64)
             bits = jnp.where(b < 0, jax.lax.bitcast_convert_type(~b, jnp.uint64),
                              jax.lax.bitcast_convert_type(b, jnp.uint64) ^ jnp.uint64(1 << 63))
         else:
-            f = jnp.where(jnp.isnan(v.astype(jnp.float32)), jnp.inf, v.astype(jnp.float32))
+            v32 = v.astype(jnp.float32)
+            f = jnp.where(jnp.isnan(v32), jnp.asarray(jnp.nan, jnp.float32), v32)
             b = jax.lax.bitcast_convert_type(f, jnp.int32)
             bits = jnp.where(b < 0, jax.lax.bitcast_convert_type(~b, jnp.uint32),
                              jax.lax.bitcast_convert_type(b, jnp.uint32) ^ jnp.uint32(1 << 31))
@@ -852,6 +870,37 @@ def _sortable_bits(values: jax.Array, valid: jax.Array, descending: bool,
     # null handling: prepend a selector lane (0=null-first, 1=value, 2=null-last)
     null_sel = jnp.where(valid, jnp.uint32(1), jnp.uint32(0 if nulls_first else 2))
     return [null_sel] + [jnp.where(valid, l, jnp.uint32(0)) for l in lanes]
+
+
+def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
+                         stage_cache: Optional[dict] = None):
+    """Argsort indices for a Table computed ON DEVICE (keys staged/compiled
+    like projections, then one `jax.lax.sort` over the bit-transformed
+    lanes). Matches Table.argsort's ordering exactly, including the
+    nulls-follow-direction default. Returns np.ndarray[int] or None when any
+    key is device-ineligible."""
+    from ..datatypes import DataType
+    from ..table import _norm_flag
+
+    n = len(table)
+    keys = list(sort_keys)
+    k = len(keys)
+    desc = _norm_flag(descending, k, False)
+    nf = _norm_flag(nulls_first, k, None)
+    staged = _stage_and_run(table, keys, stage_cache)
+    if staged is None:
+        return None
+    outs, _, nodes = staged
+    if not x64_enabled():
+        # float64 keys would sort in float32: spurious ties reorder rows vs
+        # the host. Aggregations recover reduced precision via float64
+        # recombination; a sort cannot — reject, host path handles it.
+        for nd in nodes:
+            if nd.to_field(table.schema).dtype == DataType.float64():
+                return None
+    nf_resolved = [(f if f is not None else d) for f, d in zip(nf, desc)]
+    idx = device_argsort([(v, m) for v, m in outs], desc, nf_resolved, n)
+    return np.asarray(jax.device_get(idx))[:n]
 
 
 def device_argsort(key_cols: Sequence[Tuple[jax.Array, jax.Array]],

@@ -576,7 +576,8 @@ class SortOp(PhysicalOp):
 
     def execute(self, inputs, ctx) -> PartStream:
         for part in inputs[0]:
-            yield part.sort(self.sort_by, self.descending, self.nulls_first)
+            yield ctx.eval_sort(part, self.sort_by, self.descending,
+                                self.nulls_first)
 
     def describe(self):
         return "Sort: " + ", ".join(e._node.display() for e in self.sort_by)

@@ -610,3 +610,81 @@ class TestDeviceGroupCodes32:
         d, h = dev.to_pydict(), host.to_pydict()
         assert d["d"] == h["d"]
         np.testing.assert_allclose(d["s"], h["s"], rtol=1e-5)
+
+
+class TestDeviceSort32:
+    """SortOp routes through the device argsort (bit-transformed lanes +
+    lax.sort) when keys are device-eligible; ordering must match the host
+    pyarrow sort exactly, including nulls and descending flags."""
+
+    def test_sort_parity_with_nulls_and_desc(self, host_mode):
+        vals = [None if RNG.rand() < 0.05 else np.float32(v)
+                for v in RNG.randint(-500, 500, 20_000)]
+        tie = RNG.randint(0, 50, 20_000).astype(np.int64)
+
+        def q():
+            return (dt.from_pydict({
+                "v": dt.Series.from_pylist(vals, "v", dt.DataType.float32()),
+                "t": tie})
+                .sort(["t", "v"], desc=[False, True]))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_sorts", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_f64_sort_keys_fall_back_in_32bit_mode(self, host_mode):
+        # float64 keys staged as float32 could invent ties and reorder rows:
+        # the device sort must decline rather than diverge from the host
+        data = {"v": RNG.rand(10_000) * 1e6,
+                "t": RNG.randint(0, 9, 10_000).astype(np.int64)}
+
+        def q():
+            return dt.from_pydict(data).sort(["v", "t"])
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_sorts", 0) == 0, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_nan_sorts_after_inf_like_host(self, host_mode):
+        vals = ([np.float32(x) for x in (1.0, float("inf"), 5.0)] + [None]
+                + [np.float32(float("nan"))]) * 10  # > device_min_rows
+        ks = dt.Series.from_pylist(vals, "v", dt.DataType.float32())
+
+        def q():
+            return dt.from_pydict({"v": ks}).sort("v")
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_sorts", 0) >= 1
+        d, h = dev.to_pydict(), host.to_pydict()
+        import math
+        norm = [("nan" if isinstance(x, float) and math.isnan(x) else x)
+                for x in d["v"]]
+        normh = [("nan" if isinstance(x, float) and math.isnan(x) else x)
+                 for x in h["v"]]
+        assert norm == normh
+        # ascending: numbers < inf < nan < nulls (arrow order)
+        assert norm[:20] == [1.0] * 10 + [5.0] * 10
+        assert norm[20:30] == [float("inf")] * 10
+        assert norm[30:40] == ["nan"] * 10
+        assert norm[40:] == [None] * 10
+
+    def test_sort_expression_key_on_device(self, host_mode):
+        data = {"x": RNG.randint(-1000, 1000, 10_000).astype(np.int64)}
+
+        def q():
+            return dt.from_pydict(data).sort((col("x") * -1).alias("neg"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_sorts", 0) >= 1
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_string_sort_falls_back_to_host(self, host_mode):
+        data = {"s": np.array(["b", "a", "c"])[RNG.randint(0, 3, 5000)]}
+
+        def q():
+            return dt.from_pydict(data).sort("s")
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_sorts", 0) == 0
+        assert _counters(dev).get("host_sorts", 0) >= 1
+        assert dev.to_pydict() == host.to_pydict()
