@@ -347,6 +347,33 @@ class ExecutionContext:
         self.stats.bump("host_sorts")
         return part.sort(sort_by, descending, nulls_first)
 
+    def eval_distinct(self, part: MicroPartition, subset) -> MicroPartition:
+        """Route distinct through the device group-codes kernel when the keys
+        are device-eligible; host dictionary encode otherwise."""
+        if self._device_eligible(part):
+            try:
+                from .expressions import col
+                from .kernels.device_agg import device_distinct_indices
+
+                keys = list(subset) if subset else [
+                    col(n) for n in part.column_names]
+                idx = device_distinct_indices(
+                    part.table(), keys, part.device_stage_cache(),
+                    len(part.table()))
+            except Exception:
+                idx = None
+            if idx is not None:
+                import numpy as np
+
+                from .series import Series
+
+                self.stats.bump("device_distincts")
+                tbl = part.table().take(
+                    Series.from_numpy(idx.astype(np.uint64), "idx"))
+                return MicroPartition.from_table(tbl)
+        self.stats.bump("host_distincts")
+        return part.distinct(subset)
+
     def eval_agg(self, part: MicroPartition, aggregations, groupby,
                  predicate=None) -> MicroPartition:
         """Route a (optionally filter-fused) grouped aggregation through the

@@ -617,6 +617,98 @@ def normalize_and_check(exprs, schema) -> Optional[list]:
     return nodes
 
 
+_INT32_LO, _INT32_HI = -(2 ** 31), 2 ** 31 - 1
+
+
+def int64_wrap_safe(nodes, schema, env, stage_cache: Optional[dict],
+                    bucket: int) -> bool:
+    """32-bit mode guard: int64-typed arithmetic computes in int32 lanes and
+    can wrap silently (staging only range-checks the LEAF columns). Prove by
+    interval arithmetic over the STAGED data's actual min/max that no
+    int64-typed arithmetic node can leave the int32 range; anything unproven
+    declines to the host path (exact 64-bit semantics there). The per-column
+    ranges cost one fused reduction + sync each, cached with the partition.
+
+    Found live: `select((col_i64 * col_i64))` with values ~1e5 returned the
+    int32-wrapped product on the device path while the host returned 1e10.
+    """
+    if x64_enabled():
+        return True
+    from ..datatypes import DataType
+    from ..expressions import Alias, BinaryOp, Column, Function, Literal
+
+    risky_dts = (DataType.int64(), DataType.uint64())
+
+    def has_risky(n):
+        try:
+            if (isinstance(n, (BinaryOp, Function))
+                    and n.to_field(schema).dtype in risky_dts):
+                return True
+        except (ValueError, KeyError):
+            return True
+        return any(has_risky(c) for c in n.children())
+
+    if not any(has_risky(n) for n in nodes):
+        return True
+
+    def col_range(name):
+        key = ("__int_range__", name, bucket, x64_enabled())
+        r = stage_cache.get(key) if stage_cache is not None else None
+        if r is None:
+            if name not in env:
+                return None
+            v, m = env[name]
+            if not jnp.issubdtype(v.dtype, jnp.integer):
+                return None
+            lo = int(jax.device_get(
+                jnp.min(jnp.where(m, v, jnp.iinfo(v.dtype).max))))
+            hi = int(jax.device_get(
+                jnp.max(jnp.where(m, v, jnp.iinfo(v.dtype).min))))
+            if hi < lo:  # all-null column
+                lo = hi = 0
+            r = (lo, hi)
+            if stage_cache is not None:
+                stage_cache[key] = r
+        return r
+
+    def bounds(n):
+        """Exact integer interval of a node, or None = unknown."""
+        if isinstance(n, Alias):
+            return bounds(n.child)
+        if isinstance(n, Column):
+            return col_range(n.cname)
+        if isinstance(n, Literal):
+            v = n.value
+            return (v, v) if isinstance(v, int) and not isinstance(v, bool) \
+                else None
+        if isinstance(n, BinaryOp) and n.op in ("+", "-", "*"):
+            a = bounds(n.left)
+            b = bounds(n.right)
+            if a is None or b is None:
+                return None
+            if n.op == "+":
+                return (a[0] + b[0], a[1] + b[1])
+            if n.op == "-":
+                return (a[0] - b[1], a[1] - b[0])
+            prods = [x * y for x in a for y in b]
+            return (min(prods), max(prods))
+        return None
+
+    def safe(n):
+        if isinstance(n, (BinaryOp, Function)):
+            try:
+                dt_ = n.to_field(schema).dtype
+            except (ValueError, KeyError):
+                return False
+            if dt_ in risky_dts:
+                bd = bounds(n)
+                if bd is None or bd[0] < _INT32_LO or bd[1] > _INT32_HI:
+                    return False
+        return all(safe(c) for c in n.children())
+
+    return all(safe(n) for n in nodes)
+
+
 def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     """Shared device prologue: normalize + eligibility-check the expressions,
     stage the input columns, compile and launch ONE jitted program. Returns
@@ -636,8 +728,11 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
         needed.update(required_columns(nd))
     if not needed:
         return None
-    env = stage_table_columns(table, needed, size_bucket(n), stage_cache)
+    b = size_bucket(n)
+    env = stage_table_columns(table, needed, b, stage_cache)
     if env is None:
+        return None
+    if not int64_wrap_safe(nodes, schema, env, stage_cache, b):
         return None
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))
     return run(env), out_dts, nodes
@@ -887,17 +982,20 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
     k = len(keys)
     desc = _norm_flag(descending, k, False)
     nf = _norm_flag(nulls_first, k, None)
-    staged = _stage_and_run(table, keys, stage_cache)
-    if staged is None:
-        return None
-    outs, _, nodes = staged
     if not x64_enabled():
         # float64 keys would sort in float32: spurious ties reorder rows vs
         # the host. Aggregations recover reduced precision via float64
-        # recombination; a sort cannot — reject, host path handles it.
-        for nd in nodes:
+        # recombination; a sort cannot — reject BEFORE staging anything.
+        pre = normalize_and_check(keys, table.schema)
+        if pre is None:
+            return None
+        for nd in pre:
             if nd.to_field(table.schema).dtype == DataType.float64():
                 return None
+    staged = _stage_and_run(table, keys, stage_cache)
+    if staged is None:
+        return None
+    outs, _, _ = staged
     nf_resolved = [(f if f is not None else d) for f, d in zip(nf, desc)]
     idx = device_argsort([(v, m) for v, m in outs], desc, nf_resolved, n)
     return np.asarray(jax.device_get(idx))[:n]

@@ -118,6 +118,37 @@ def _try_device_group_codes(table, key_expr, stage_cache, n: int):
     return codes, uniq, num_groups
 
 
+def device_distinct_indices(table, keys, stage_cache, n: int):
+    """First-occurrence row indices of the distinct key tuples, computed on
+    device via _group_codes_kernel (row order preserved — same contract as
+    Table.distinct's host dictionary encode). Multi-column keys pack through
+    the join layer's mixed-radix packing, which is only null-faithful when
+    every component is null-free: a null component would collapse distinct
+    tuples like (1, null)/(2, null) into one packed-null group, so nullable
+    multi-key inputs decline to the host path. Returns np.ndarray or None."""
+    from .device_join import _pack_composite_keys, _stage_key
+
+    staged = [_stage_key(table, k, stage_cache) for k in keys]
+    if any(s is None for s in staged):
+        return None
+    if len(staged) == 1:
+        vals, valid = staged[0]
+    else:
+        # ONE fused reduction + sync for the nullability check, not one/key
+        all_valid = bool(jax.device_get(
+            jnp.all(jnp.stack([jnp.all(m[:n]) for _, m in staged]))))
+        if not all_valid:
+            return None
+        packed = _pack_composite_keys([staged])
+        if packed is None:
+            return None
+        (vals, valid), = packed
+    _, num_groups, first_rows, _, _ = _group_codes_kernel(
+        vals, valid, jnp.int32(n))
+    num_groups = int(num_groups)
+    return np.asarray(jax.device_get(first_rows))[:num_groups]
+
+
 def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = None,
                        predicate=None):
     """Fused grouped aggregation for one partition on device.
@@ -202,6 +233,11 @@ def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = No
     env = stage_table_columns(table, sorted(needed), b, stage_cache)
     if env is None:
         return None
+    from .device import int64_wrap_safe
+
+    check_nodes = list(child_nodes) + (list(pred_nodes) if pred_nodes else [])
+    if not int64_wrap_safe(check_nodes, schema, env, stage_cache, b):
+        return None  # int64 arithmetic could wrap in int32 lanes
 
     # --- compile + run ONE fused program ---------------------------------
     from ..context import get_context

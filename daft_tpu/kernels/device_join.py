@@ -77,8 +77,10 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     env = stage_table_columns(table, cols, b, cache)
     if env is None:
         return None
-    from .device import compile_projection
+    from .device import compile_projection, int64_wrap_safe
 
+    if not int64_wrap_safe([node], schema, env, cache, b):
+        return None  # computed int64 key could wrap in int32 lanes
     run, _ = compile_projection([node], schema, tuple(sorted(cols)))
     (vals, valid), = run(env)
     if not jnp.issubdtype(vals.dtype, jnp.integer):

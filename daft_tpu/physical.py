@@ -672,7 +672,7 @@ class DistinctOp(PhysicalOp):
 
     def execute(self, inputs, ctx) -> PartStream:
         for part in inputs[0]:
-            yield part.distinct(self.subset)
+            yield ctx.eval_distinct(part, self.subset)
 
 
 class PivotOp(PhysicalOp):

@@ -688,3 +688,117 @@ class TestDeviceSort32:
         assert _counters(dev).get("device_sorts", 0) == 0
         assert _counters(dev).get("host_sorts", 0) >= 1
         assert dev.to_pydict() == host.to_pydict()
+
+
+class TestDeviceDistinct32:
+    """Distinct routed through the device group-codes kernel: first-occurrence
+    rows, null-key semantics, multi-key packing (null-free only)."""
+
+    def test_single_key_distinct_with_nulls(self, host_mode):
+        ks = [5, None, 5, 2, None, 9, 2] * 3000
+
+        def q():
+            return dt.from_pydict({
+                "k": dt.Series.from_pylist(ks, "k", dt.DataType.int64()),
+                "v": np.arange(len(ks), dtype=np.int64)}).distinct("k")
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_distincts", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()  # first-occurrence rows
+
+    def test_multi_key_distinct_null_free(self, host_mode):
+        rng = np.random.RandomState(21)
+        data = {"a": rng.randint(0, 40, 30_000).astype(np.int64),
+                "b": rng.randint(0, 25, 30_000).astype(np.int64)}
+
+        def q():
+            return dt.from_pydict(data).distinct()
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_distincts", 0) >= 1
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_multi_key_with_nulls_falls_back(self, host_mode):
+        a = dt.Series.from_pylist([1, 2, None, 1] * 500, "a", dt.DataType.int64())
+        b = dt.Series.from_pylist([None, 7, 8, None] * 500, "b", dt.DataType.int64())
+
+        def q():
+            return dt.from_pydict({"a": a, "b": b}).distinct()
+
+        dev, host = _run_both(q, host_mode)
+        # (1,null) and (2,7) and (null,8) are distinct tuples: packing would
+        # collapse null components, so the device path must decline
+        assert _counters(dev).get("device_distincts", 0) == 0
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_string_distinct_falls_back(self, host_mode):
+        data = {"s": np.array(["x", "y", "z"])[RNG.randint(0, 3, 5000)]}
+
+        def q():
+            return dt.from_pydict(data).distinct()
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_distincts", 0) == 0
+        assert dev.to_pydict() == host.to_pydict()
+
+
+class TestInt64WrapGuard32:
+    """int64-typed arithmetic computes in int32 lanes with x64 off; interval
+    analysis over the staged data's real min/max must prove it cannot wrap,
+    else the work declines to the host (found live: col*col at ~1e5 returned
+    the int32-wrapped product on device)."""
+
+    def test_large_product_declines_to_host(self, host_mode):
+        x = np.full(1000, 100_000, dtype=np.int64)
+
+        def q():
+            return dt.from_pydict({"x": x}).select((col("x") * col("x")).alias("y"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) == 0, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict() == {"y": [10_000_000_000] * 1000}
+
+    def test_small_arithmetic_stays_on_device(self, host_mode):
+        x = RNG.randint(-1000, 1000, 10_000).astype(np.int64)
+
+        def q():
+            return dt.from_pydict({"x": x}).select(
+                (col("x") * col("x") + 7).alias("y"))
+
+        dev, host = _run_both(q, host_mode)
+        # |x| <= 1000 -> x*x+7 <= 1_000_007 fits int32: proven safe, device
+        assert _counters(dev).get("device_projections", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_sum_near_int32_edge_plus_literal_declines(self, host_mode):
+        x = np.full(1000, 2**31 - 5, dtype=np.int64)
+
+        def q():
+            return dt.from_pydict({"x": x}).select((col("x") + 100).alias("y"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) == 0
+        assert dev.to_pydict() == host.to_pydict() == {"y": [2**31 + 95] * 1000}
+
+    def test_computed_int64_sort_key_guarded(self, host_mode):
+        x = np.full(1000, 80_000, dtype=np.int64)
+        x[::2] = -80_000
+
+        def q():
+            return dt.from_pydict({"x": x}).sort((col("x") * col("x")).alias("k"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_sorts", 0) == 0  # 6.4e9 > int32
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_agg_child_expression_guarded(self, host_mode):
+        x = np.full(5000, 70_000, dtype=np.int64)
+        g = np.array(["a", "b"])[RNG.randint(0, 2, 5000)]
+
+        def q():
+            return (dt.from_pydict({"x": x, "g": g}).groupby("g")
+                    .agg((col("x") * col("x")).alias("xx").sum().alias("s"))
+                    .sort("g"))
+
+        dev, host = _run_both(q, host_mode)
+        assert dev.to_pydict() == host.to_pydict()
