@@ -206,12 +206,17 @@ def read_json(path, schema_hints: Optional[Dict[str, DataType]] = None) -> DataF
 
 
 
-def read_deltalake(table_uri: str) -> DataFrame:
-    """Read a local Delta Lake table by replaying its transaction log
-    (reference: daft/delta_lake/delta_lake_scan.py:26; no client library —
-    the _delta_log JSON actions are parsed natively)."""
+def read_deltalake(table_uri) -> DataFrame:
+    """Read a Delta Lake table by replaying its transaction log (reference:
+    daft/delta_lake/delta_lake_scan.py:26; no client library — the
+    _delta_log JSON actions are parsed natively). Accepts a path or a
+    UnityCatalogTable resolved by io.unity.UnityCatalog.load_table
+    (reference: read_deltalake(unity_table), daft/io/_deltalake.py)."""
     from .io.catalogs import read_deltalake_scan
+    from .io.unity import UnityCatalogTable
 
+    if isinstance(table_uri, UnityCatalogTable):
+        table_uri = table_uri.table_uri
     schema, tasks = read_deltalake_scan(table_uri)
     return DataFrame(ScanSource(schema, tasks))
 

@@ -660,10 +660,9 @@ def int64_wrap_safe(nodes, schema, env, stage_cache: Optional[dict],
             v, m = env[name]
             if not jnp.issubdtype(v.dtype, jnp.integer):
                 return None
-            lo = int(jax.device_get(
-                jnp.min(jnp.where(m, v, jnp.iinfo(v.dtype).max))))
-            hi = int(jax.device_get(
-                jnp.max(jnp.where(m, v, jnp.iinfo(v.dtype).min))))
+            lo_d = jnp.min(jnp.where(m, v, jnp.iinfo(v.dtype).max))
+            hi_d = jnp.max(jnp.where(m, v, jnp.iinfo(v.dtype).min))
+            lo, hi = (int(x) for x in jax.device_get((lo_d, hi_d)))  # 1 sync
             if hi < lo:  # all-null column
                 lo = hi = 0
             r = (lo, hi)
