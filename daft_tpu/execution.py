@@ -392,6 +392,10 @@ class ExecutionContext:
             if out is not None:
                 self.stats.bump("device_aggregations")
                 return MicroPartition.from_table(out)
+        return self._eval_agg_host(part, aggregations, groupby, predicate)
+
+    def _eval_agg_host(self, part: MicroPartition, aggregations, groupby,
+                       predicate=None) -> MicroPartition:
         self.stats.bump("host_aggregations")
         if predicate is not None:
             tbl = part.table()
@@ -415,6 +419,41 @@ class ExecutionContext:
                 part = MicroPartition.from_table(tbl.select_columns(keep))
             part = part.filter([predicate])
         return part.agg(aggregations, groupby or None)
+
+    def eval_agg_dispatch(self, part: MicroPartition, aggregations, groupby,
+                          predicate=None):
+        """Non-blocking launch of the fused device aggregation; returns a
+        zero-arg resolver (host-fallback inside, truthful counters) or None
+        when ineligible — same contract as eval_projection_dispatch."""
+        if not self._device_eligible(part):
+            return None
+        try:
+            from .kernels.device_agg import device_grouped_agg_async
+
+            resolve = device_grouped_agg_async(
+                part.table(), list(aggregations), list(groupby or []),
+                stage_cache=part.device_stage_cache(), predicate=predicate)
+        except Exception:
+            return None
+        if resolve is None:
+            return None
+        self.stats.bump("device_aggregations")
+        self.stats.bump("device_agg_dispatches")
+
+        def finish() -> MicroPartition:
+            try:
+                out = resolve()
+                if out is not None:
+                    return MicroPartition.from_table(out)
+            except Exception:
+                pass
+            # overflow guard or deferred failure: partition was NOT
+            # aggregated on device — keep the counters truthful
+            self.stats.bump("device_aggregations", -1)
+            self.stats.bump("device_agg_fallbacks")
+            return self._eval_agg_host(part, aggregations, groupby, predicate)
+
+        return finish
 
     def prepare_broadcast(self, part: MicroPartition, on_exprs,
                           how: str = "inner") -> MicroPartition:

@@ -151,27 +151,20 @@ def device_distinct_indices(table, keys, stage_cache, n: int):
 
 def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = None,
                        predicate=None):
-    """Fused grouped aggregation for one partition on device.
+    """Synchronous fused grouped aggregation on device: dispatch + resolve.
+    Returns a host Table or None when ineligible (see the async variant)."""
+    resolve = device_grouped_agg_async(table, to_agg, group_by, stage_cache,
+                                       predicate)
+    return None if resolve is None else resolve()
 
-    `to_agg`: aggregation Expressions (kinds sum/count/min/max/mean);
-    `group_by`: key Expressions (evaluated on host — keys may be strings);
-    `predicate`: optional filter Expression fused as a device-side mask.
 
-    Returns a host Table (keys + aggregates, first-occurrence group order,
-    matching the host path) or None when ineligible.
-    """
-    from ..expressions import required_columns
-    from ..schema import Field, Schema
-    from ..table import Table, _group_codes
-
-    n = len(table)
-    if n == 0:
-        return None
-    schema = table.schema
-
+def _plan_agg_specs(to_agg, schema, predicate=None):
+    """Shared eligibility prologue for the async kernel and the planner's
+    static check — ONE implementation so the two can never drift. Returns
+    (specs, child_nodes, pred_nodes) or None when any aggregation kind,
+    count mode, child expression, or predicate is device-ineligible."""
     from .device import normalize_and_check
 
-    # --- plan the aggregate list -----------------------------------------
     specs = []  # (alias, kind, AggExpr node, count_mode)
     child_exprs = []
     for e in to_agg:
@@ -186,12 +179,58 @@ def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = No
     child_nodes = normalize_and_check(child_exprs, schema)
     if child_nodes is None:
         return None
-
     pred_nodes = None
     if predicate is not None:
         pred_nodes = normalize_and_check([predicate], schema)
         if pred_nodes is None:
             return None
+    return specs, child_nodes, pred_nodes
+
+
+def agg_plan_device_compilable(to_agg, schema, predicate=None) -> bool:
+    """Static shape check (no data, no staging): used by the executor to
+    choose the double-buffered driver before any partition exists."""
+    try:
+        return _plan_agg_specs(to_agg, schema, predicate) is not None
+    except Exception:
+        return False
+
+
+def device_grouped_agg_async(table, to_agg, group_by,
+                             stage_cache: Optional[dict] = None,
+                             predicate=None):
+    """Fused grouped aggregation for one partition on device, split into a
+    dispatch (staging + the jitted launch happen now) and a deferred resolver
+    (ONE result fetch + host assembly when called) — the executor stages
+    partition i+1 while the device reduces partition i. Honest caveat: on a
+    COLD stage cache the dispatch itself still pays small device syncs (the
+    group-count fetch bounding the segment bucket, and the wrap-guard's
+    min/max when int64 arithmetic is present), which queue behind the
+    previous partition's compute; warm partitions dispatch sync-free.
+
+    `to_agg`: aggregation Expressions (kinds sum/count/min/max/mean);
+    `group_by`: key Expressions (single int/date keys code on device,
+    strings/multi-key on host); `predicate`: optional filter fused as a mask.
+
+    Returns a zero-arg resolver yielding a host Table (keys + aggregates,
+    first-occurrence group order, matching the host path) — the resolver
+    returns None if the int-sum overflow guard trips at materialization —
+    or None immediately when ineligible.
+    """
+    from ..expressions import required_columns
+    from ..schema import Field, Schema
+    from ..table import Table, _group_codes
+
+    n = len(table)
+    if n == 0:
+        return None
+    schema = table.schema
+
+    # --- plan the aggregate list (shared with the planner's static check) --
+    planned = _plan_agg_specs(to_agg, schema, predicate)
+    if planned is None:
+        return None
+    specs, child_nodes, pred_nodes = planned
 
     # --- host bookkeeping: group codes (cached with the partition — the
     # dictionary encode over string keys is the dominant per-query host cost
@@ -257,36 +296,40 @@ def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = No
         n_dev = jnp.int32(n)
         if stage_cache is not None:
             stage_cache[nkey] = n_dev
-    outs = run(env, codes_dev, n_dev)
-    outs = jax.device_get(outs)
+    outs_dev = run(env, codes_dev, n_dev)  # async: device computes from here
 
-    # --- assemble host result --------------------------------------------
-    from ..series import Series
+    def resolve():
+        outs = jax.device_get(outs_dev)
 
-    out_cols: List[Series] = list(uniq._columns) if uniq is not None else []
-    out_fields: List[Field] = list(uniq.schema) if uniq is not None else []
-    agg_outs = outs[:len(specs)]
-    for (alias, kind, agg_node, _mode), out in zip(specs, agg_outs):
-        expected_dt = agg_node.to_field(schema).dtype
-        merged = _finish_agg(kind, out, num_groups, expected_dt, n)
-        if merged is None:
-            return None  # overflow guard tripped: host path recomputes
-        out_cols.append(merged.rename(alias))
-        out_fields.append(Field(alias, expected_dt))
-    result = Table(Schema(out_fields), out_cols)
-    if pred_nodes is not None:
-        # prune filtered-away groups; order survivors like the host path
-        # (first occurrence within the filtered rows)
-        sel_cnt, first_idx = (np.asarray(a)[:num_groups] for a in outs[-1])
-        if group_by:
-            surv = np.nonzero(sel_cnt > 0)[0]
-            order = surv[np.argsort(first_idx[surv], kind="stable")]
-            if len(order) != num_groups or (order != np.arange(num_groups)).any():
-                import pyarrow as pa
+        # --- assemble host result ----------------------------------------
+        from ..series import Series
 
-                result = result.take(Series.from_arrow(
-                    pa.array(order.astype(np.uint64)), "idx"))
-    return result
+        out_cols: List[Series] = list(uniq._columns) if uniq is not None else []
+        out_fields: List[Field] = list(uniq.schema) if uniq is not None else []
+        agg_outs = outs[:len(specs)]
+        for (alias, kind, agg_node, _mode), out in zip(specs, agg_outs):
+            expected_dt = agg_node.to_field(schema).dtype
+            merged = _finish_agg(kind, out, num_groups, expected_dt, n)
+            if merged is None:
+                return None  # overflow guard tripped: host path recomputes
+            out_cols.append(merged.rename(alias))
+            out_fields.append(Field(alias, expected_dt))
+        result = Table(Schema(out_fields), out_cols)
+        if pred_nodes is not None:
+            # prune filtered-away groups; order survivors like the host path
+            # (first occurrence within the filtered rows)
+            sel_cnt, first_idx = (np.asarray(a)[:num_groups] for a in outs[-1])
+            if group_by:
+                surv = np.nonzero(sel_cnt > 0)[0]
+                order = surv[np.argsort(first_idx[surv], kind="stable")]
+                if len(order) != num_groups or (order != np.arange(num_groups)).any():
+                    import pyarrow as pa
+
+                    result = result.take(Series.from_arrow(
+                        pa.array(order.astype(np.uint64)), "idx"))
+        return result
+
+    return resolve
 
 
 class _ExprView:

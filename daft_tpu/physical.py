@@ -596,6 +596,22 @@ class AggregateOp(PhysicalOp):
     def map_partition(self, part, ctx):
         return ctx.eval_agg(part, self.aggregations, self.groupby or None)
 
+    def map_partition_dispatch(self, part, ctx):
+        return ctx.eval_agg_dispatch(part, self.aggregations,
+                                     self.groupby or None)
+
+    def device_pipelinable(self, ctx) -> bool:
+        if not ctx.cfg.use_device_kernels:
+            return False
+        from .kernels.device_agg import agg_plan_device_compilable
+
+        return agg_plan_device_compilable(self.aggregations,
+                                          self.children[0].schema)
+
+    def map_partition_declined(self, part, ctx):
+        # dispatch already proved this partition device-ineligible
+        return ctx._eval_agg_host(part, self.aggregations, self.groupby or None)
+
     def map_empty(self, ctx):
         # global agg over zero partitions still yields one row (count=0 etc.)
         if not self.groupby:
@@ -631,6 +647,24 @@ class FusedFilterAggregateOp(PhysicalOp):
     def map_partition(self, part, ctx):
         return ctx.eval_agg(part, self.aggregations, self.groupby or None,
                             predicate=self.predicate)
+
+    def map_partition_dispatch(self, part, ctx):
+        return ctx.eval_agg_dispatch(part, self.aggregations,
+                                     self.groupby or None,
+                                     predicate=self.predicate)
+
+    def device_pipelinable(self, ctx) -> bool:
+        if not ctx.cfg.use_device_kernels:
+            return False
+        from .kernels.device_agg import agg_plan_device_compilable
+
+        return agg_plan_device_compilable(self.aggregations,
+                                          self.children[0].schema,
+                                          predicate=self.predicate)
+
+    def map_partition_declined(self, part, ctx):
+        return ctx._eval_agg_host(part, self.aggregations, self.groupby or None,
+                                  predicate=self.predicate)
 
     def map_empty(self, ctx):
         if not self.groupby:

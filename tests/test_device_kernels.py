@@ -277,3 +277,93 @@ class TestPipelinedDeviceProjection:
         finally:
             (cfg.use_device_kernels, cfg.device_min_rows,
              cfg.executor_threads) = old
+
+
+class TestPipelinedDeviceAgg:
+    """Per-partition aggregations double-buffer like projections: dispatch
+    launches the fused kernel for partition i+1 before partition i's single
+    result fetch."""
+
+    def _cfg(self):
+        import daft_tpu
+
+        return daft_tpu.context.get_context().execution_config
+
+    def test_grouped_agg_dispatches_and_matches(self):
+        import numpy as np
+
+        import daft_tpu
+        from daft_tpu import col
+        from daft_tpu.execution import ExecutionContext, RuntimeStats, execute_plan
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        cfg = self._cfg()
+        old = cfg.use_device_kernels, cfg.device_min_rows
+        cfg.use_device_kernels = True
+        cfg.device_min_rows = 1
+        try:
+            rng = np.random.RandomState(3)
+            df = daft_tpu.from_pydict({
+                "k": rng.randint(0, 50, 60_000).astype(np.int64),
+                "v": rng.rand(60_000)}).into_partitions(6) \
+                .where(col("v") < 0.5) \
+                .groupby("k").agg(col("v").sum().alias("s"),
+                                  col("v").count().alias("c"))
+            ctx = ExecutionContext(cfg, RuntimeStats())
+            parts = list(execute_plan(translate(optimize(df._plan), cfg), ctx))
+            c = ctx.stats.counters
+            assert c.get("device_agg_dispatches", 0) >= 6, c
+            got = {}
+            for p in parts:
+                d = p.to_pydict()
+                for k, s, cnt in zip(d["k"], d["s"], d["c"]):
+                    a, b = got.get(k, (0.0, 0))
+                    got[k] = (a + s, b + cnt)
+        finally:
+            cfg.use_device_kernels, cfg.device_min_rows = old
+        # host oracle with numpy
+        rng = np.random.RandomState(3)
+        k = rng.randint(0, 50, 60_000).astype(np.int64)
+        v = rng.rand(60_000)
+        m = v < 0.5
+        for kk in range(50):
+            sel = m & (k == kk)
+            s, cnt = got[kk]
+            assert cnt == int(sel.sum())
+            assert abs(s - v[sel].sum()) < 1e-9 * max(1.0, abs(v[sel].sum()))
+
+    def test_overflow_guard_falls_back_at_resolve(self):
+        import numpy as np
+
+        import daft_tpu
+        from daft_tpu import col
+        from daft_tpu.execution import ExecutionContext, RuntimeStats, execute_plan
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+        import jax
+
+        cfg = self._cfg()
+        old = (cfg.use_device_kernels, cfg.device_min_rows)
+        x64_was = jax.config.jax_enable_x64
+        jax.config.update("jax_enable_x64", False)
+        cfg.use_device_kernels = True
+        cfg.device_min_rows = 1
+        try:
+            # values fit int32 but the per-group SUM cannot: the deferred
+            # resolver must detect it and recompute on host, counters truthful
+            df = daft_tpu.from_pydict({
+                "g": np.zeros(10_000, dtype=np.int64),
+                "v": np.full(10_000, 2**30, dtype=np.int64),
+            }).into_partitions(2).groupby("g").agg(col("v").sum().alias("s"))
+            ctx = ExecutionContext(cfg, RuntimeStats())
+            parts = list(execute_plan(translate(optimize(df._plan), cfg), ctx))
+            total = sum(s for p in parts for s in p.to_pydict()["s"])
+            assert total == 10_000 * 2**30
+            c = ctx.stats.counters
+            assert c.get("device_agg_fallbacks", 0) >= 1, c
+            assert c.get("device_aggregations", 0) == \
+                c.get("device_agg_dispatches", 0) - c.get("device_agg_fallbacks", 0), c
+        finally:
+            jax.config.update("jax_enable_x64", x64_was)
+            (cfg.use_device_kernels, cfg.device_min_rows) = old
