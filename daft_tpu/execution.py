@@ -539,6 +539,39 @@ class ExecutionContext:
         self.stats.bump("host_filters")
         return part.filter([predicate])
 
+    def eval_filter_dispatch(self, part: MicroPartition, predicate):
+        """Non-blocking launch of the device filter mask; the resolver pulls
+        the mask back and compacts on host — same contract as
+        eval_projection_dispatch."""
+        if not self._device_eligible(part):
+            return None
+        try:
+            from .kernels.device import eval_projection_device_async
+
+            resolve = eval_projection_device_async(
+                part.table(), [predicate],
+                stage_cache=part.device_stage_cache())
+        except Exception:
+            return None
+        if resolve is None:
+            return None
+        self.stats.bump("device_filters")
+        self.stats.bump("device_filter_dispatches")
+
+        def finish() -> MicroPartition:
+            try:
+                out = resolve()
+                mask = out._columns[0]
+                return MicroPartition.from_table(
+                    part.table().filter_with_mask(mask))
+            except Exception:
+                self.stats.bump("device_filters", -1)
+                self.stats.bump("device_filter_fallbacks")
+                self.stats.bump("host_filters")
+                return part.filter([predicate])
+
+        return finish
+
 
 def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
                  trace: bool = True) -> Iterator[MicroPartition]:

@@ -691,6 +691,21 @@ def int64_wrap_safe(nodes, schema, env, stage_cache: Optional[dict],
                 return (a[0] - b[1], a[1] - b[0])
             prods = [x * y for x in a for y in b]
             return (min(prods), max(prods))
+        if isinstance(n, BinaryOp) and n.op == "%":
+            b = bounds(n.right)
+            if b is None:
+                return None
+            m = max(abs(b[0]), abs(b[1]))
+            if m == 0:
+                return None
+            return (-(m - 1), m - 1)
+        if isinstance(n, BinaryOp) and n.op == "//":
+            a = bounds(n.left)
+            b = bounds(n.right)
+            if a is None or b is None or b[0] <= 0 <= b[1]:
+                return None  # divisor range crosses zero
+            cands = [a[0] // b[0], a[0] // b[1], a[1] // b[0], a[1] // b[1]]
+            return (min(cands), max(cands))
         return None
 
     def safe(n):

@@ -242,6 +242,25 @@ class FilterOp(PhysicalOp):
     def map_partition(self, part, ctx):
         return ctx.eval_filter(part, self.predicate)
 
+    def map_partition_dispatch(self, part, ctx):
+        return ctx.eval_filter_dispatch(part, self.predicate)
+
+    def map_partition_declined(self, part, ctx):
+        # dispatch already proved this partition device-ineligible
+        ctx.stats.bump("host_filters")
+        return part.filter([self.predicate])
+
+    def device_pipelinable(self, ctx) -> bool:
+        if not ctx.cfg.use_device_kernels:
+            return False
+        try:
+            from .kernels.device import normalize_and_check
+
+            return normalize_and_check([self.predicate],
+                                       self.children[0].schema) is not None
+        except Exception:
+            return False
+
     def _map_exprs(self):
         return (self.predicate,)
 
@@ -603,8 +622,10 @@ class AggregateOp(PhysicalOp):
     def device_pipelinable(self, ctx) -> bool:
         if not ctx.cfg.use_device_kernels:
             return False
-        from .kernels.device_agg import agg_plan_device_compilable
-
+        try:
+            from .kernels.device_agg import agg_plan_device_compilable
+        except Exception:
+            return False
         return agg_plan_device_compilable(self.aggregations,
                                           self.children[0].schema)
 
@@ -656,8 +677,10 @@ class FusedFilterAggregateOp(PhysicalOp):
     def device_pipelinable(self, ctx) -> bool:
         if not ctx.cfg.use_device_kernels:
             return False
-        from .kernels.device_agg import agg_plan_device_compilable
-
+        try:
+            from .kernels.device_agg import agg_plan_device_compilable
+        except Exception:
+            return False
         return agg_plan_device_compilable(self.aggregations,
                                           self.children[0].schema,
                                           predicate=self.predicate)

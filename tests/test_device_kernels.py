@@ -367,3 +367,42 @@ class TestPipelinedDeviceAgg:
         finally:
             jax.config.update("jax_enable_x64", x64_was)
             (cfg.use_device_kernels, cfg.device_min_rows) = old
+
+
+class TestPipelinedDeviceFilter:
+    def test_filter_dispatches_and_matches(self):
+        import numpy as np
+
+        import daft_tpu
+        from daft_tpu import col
+        from daft_tpu.execution import ExecutionContext, RuntimeStats, execute_plan
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        cfg = daft_tpu.context.get_context().execution_config
+        old = cfg.use_device_kernels, cfg.device_min_rows
+        cfg.use_device_kernels = True
+        cfg.device_min_rows = 1
+        try:
+            import pyarrow as pa
+
+            from daft_tpu.micropartition import MicroPartition
+
+            rng = np.random.RandomState(8)
+            x = rng.randint(0, 1000, 50_000).astype(np.int64)
+            # REAL pre-existing partitions (into_partitions would be planned
+            # after the filter); filter feeds a non-fusable op (sort) so
+            # FilterOp stays its own op
+            mps = [MicroPartition.from_arrow(pa.table({"x": pa.array(c)}))
+                   for c in np.array_split(x, 5)]
+            df = daft_tpu.from_partitions(mps, mps[0].schema) \
+                .where(col("x") % 7 == 0).sort("x")
+            ctx = ExecutionContext(cfg, RuntimeStats())
+            parts = list(execute_plan(translate(optimize(df._plan), cfg), ctx))
+            got = [v for p in parts for v in p.to_pydict()["x"]]
+            want = sorted(int(v) for v in x if v % 7 == 0)
+            assert got == want
+            c = ctx.stats.counters
+            assert c.get("device_filter_dispatches", 0) >= 5, c
+        finally:
+            cfg.use_device_kernels, cfg.device_min_rows = old
