@@ -802,3 +802,30 @@ class TestInt64WrapGuard32:
 
         dev, host = _run_both(q, host_mode)
         assert dev.to_pydict() == host.to_pydict()
+
+
+class TestPipelinedFilter32:
+    def test_filter_dispatch_chain_in_32bit_mode(self, host_mode):
+        """The pipelined filter dispatch in the real-TPU configuration: masks
+        launch per partition ahead of the previous partition's compaction,
+        including a modulo predicate the wrap guard must bound (not reject)."""
+        import pyarrow as pa
+
+        from daft_tpu.execution import (ExecutionContext, RuntimeStats,
+                                        execute_plan)
+        from daft_tpu.micropartition import MicroPartition
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        cfg = get_context().execution_config
+        x = RNG.randint(0, 500, 20_000).astype(np.int64)
+        mps = [MicroPartition.from_arrow(pa.table({"x": pa.array(c)}))
+               for c in np.array_split(x, 4)]
+        df = (dt.from_partitions(mps, mps[0].schema)
+              .where(col("x") % 3 == 1).sort("x"))
+        ctx = ExecutionContext(cfg, RuntimeStats())
+        parts = list(execute_plan(translate(optimize(df._plan), cfg), ctx))
+        got = [v for p in parts for v in p.to_pydict()["x"]]
+        assert got == sorted(int(v) for v in x if v % 3 == 1)
+        c = ctx.stats.counters
+        assert c.get("device_filter_dispatches", 0) >= 4, c
