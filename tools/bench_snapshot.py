@@ -1,0 +1,81 @@
+"""Opportunistic device-bench snapshotter.
+
+The accelerator tunnel is intermittent (wedged for all of round 3's bench
+window — BENCH_r03.json: error=tpu_unreachable). This tool decouples
+"when the TPU breathes" from "when the driver runs bench.py": run it
+periodically during the round; each time the tunnel is alive it executes the
+device rungs (same code path as bench.py: parity-gated, device counters
+checked) and writes a timestamped BENCH_device_snapshot.json at the repo
+root. bench.py falls back to the freshest snapshot when the tunnel is dead
+at bench time, so a wedge can no longer erase the whole perf axis.
+
+Usage: python tools/bench_snapshot.py [scale] [--probe-timeout N]
+Exit codes: 0 = snapshot written, 2 = tunnel unreachable (no file touched),
+1 = device rungs ran but failed (parity/dispatch error recorded in file).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SNAPSHOT = os.path.join(REPO, "BENCH_device_snapshot.json")
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    scale = float(args[0]) if args else 1.0
+    probe_timeout = 180
+    for a in sys.argv[1:]:
+        if a.startswith("--probe-timeout"):
+            probe_timeout = int(a.split("=", 1)[1])
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    if not bench._tpu_alive(timeout_s=probe_timeout):
+        print("tunnel unreachable; no snapshot", file=sys.stderr)
+        return 2
+
+    t_start = time.time()
+    out = bench.run_device_rungs(scale)
+    out["snapshot_unix_time"] = round(t_start, 1)
+    out["snapshot_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime(t_start))
+    out["snapshot_wall_s"] = round(time.time() - t_start, 1)
+
+    prev = None
+    if os.path.exists(SNAPSHOT):
+        try:
+            with open(SNAPSHOT) as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            prev = None
+
+    if not out.get("value") and prev and prev.get("value"):
+        # a failed run must never erase an earlier good measurement: keep
+        # the good snapshot as the file, annotate the failure on it
+        prev["last_failure_utc"] = out["snapshot_utc"]
+        prev["last_failure_error"] = out.get("error", "unknown")
+        to_write = prev
+    else:
+        # keep the best previous snapshot's value visible even if this run
+        # regressed (the driver wants the round's best honest number)
+        if prev and prev.get("value", 0) > out.get("value", 0):
+            out["prev_best_value"] = prev["value"]
+            out["prev_best_utc"] = prev.get("snapshot_utc")
+        to_write = out
+
+    tmp = SNAPSHOT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(to_write, f, indent=1)
+    os.replace(tmp, SNAPSHOT)
+    print(json.dumps(out))
+    return 0 if out.get("value", 0) > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
