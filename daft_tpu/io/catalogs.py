@@ -33,14 +33,17 @@ import pyarrow as pa
 from ..datatypes import DataType
 from ..errors import DaftNotFoundError
 from ..schema import Field, Schema
+from .object_store import STORAGE
 from .scan import FileFormat, Pushdowns, ScanTask
 
 
 def _schema_from_parquet(path: str) -> Schema:
-    """Engine Schema from a parquet footer (shared by the catalog readers)."""
+    """Engine Schema from a parquet footer (shared by the catalog readers).
+    Remote paths read the footer through ranged gets, not a full download."""
     import pyarrow.parquet as papq
 
-    arrow_schema = papq.read_schema(path)
+    src = STORAGE.open_input(path) if STORAGE.is_remote(path) else path
+    arrow_schema = papq.read_schema(src)
     return Schema([Field(n, DataType.from_arrow(arrow_schema.field(n).type))
                    for n in arrow_schema.names])
 
@@ -51,33 +54,35 @@ def _delta_live_files(table_uri: str) -> List[dict]:
     Honors checkpoints: when _delta_log/_last_checkpoint exists, the add/remove
     state is seeded from the checkpoint parquet (single or multi-part) and only
     commits AFTER the checkpoint version are replayed — required for tables
-    whose older JSON commits were vacuumed by log retention."""
-    log_dir = os.path.join(table_uri, "_delta_log")
-    if not os.path.isdir(log_dir):
+    whose older JSON commits were vacuumed by log retention.
+
+    All log IO goes through Storage, so s3:// table uris read exactly like
+    local ones (reference: delta_lake_scan.py over an fsspec filesystem)."""
+    log_dir = STORAGE.join(table_uri, "_delta_log")
+    log_names = set(STORAGE.list_names(log_dir))
+    if not log_names:
         raise DaftNotFoundError(f"not a Delta table (no _delta_log): {table_uri}")
     live: dict = {}
     start_after = -1
-    lc_path = os.path.join(log_dir, "_last_checkpoint")
-    if os.path.exists(lc_path):
-        with open(lc_path) as f:
-            lc = json.load(f)
+    if "_last_checkpoint" in log_names:
+        lc = json.loads(STORAGE.get(STORAGE.join(log_dir, "_last_checkpoint")))
         version = int(lc["version"])
         parts = int(lc.get("parts", 0) or 0)
         if parts:
-            cp_files = [os.path.join(
-                log_dir, f"{version:020d}.checkpoint.{i:010d}.{parts:010d}.parquet")
-                for i in range(1, parts + 1)]
+            cp_names = [f"{version:020d}.checkpoint.{i:010d}.{parts:010d}.parquet"
+                        for i in range(1, parts + 1)]
         else:
-            cp_files = [os.path.join(log_dir, f"{version:020d}.checkpoint.parquet")]
-        missing = [p for p in cp_files if not os.path.exists(p)]
+            cp_names = [f"{version:020d}.checkpoint.parquet"]
+        missing = [n for n in cp_names if n not in log_names]
         if missing:
             raise FileNotFoundError(
                 f"Delta checkpoint v{version} referenced by _last_checkpoint is "
                 f"missing files: {missing}")
         import pyarrow.parquet as papq
 
-        for cp in cp_files:
-            t = papq.read_table(cp, columns=["add", "remove"])
+        for cp in cp_names:
+            t = papq.read_table(STORAGE.open_input(STORAGE.join(log_dir, cp)),
+                                columns=["add", "remove"])
             for row in t.to_pylist():
                 a, r = row.get("add"), row.get("remove")
                 if a and a.get("path"):
@@ -85,23 +90,22 @@ def _delta_live_files(table_uri: str) -> List[dict]:
                 elif r and r.get("path"):
                     live.pop(r["path"], None)
         start_after = version
-    commits = sorted(f for f in os.listdir(log_dir) if f.endswith(".json"))
+    commits = sorted(f for f in log_names if f.endswith(".json"))
     commits = [c for c in commits if int(c.split(".")[0]) > start_after]
     if not commits and start_after < 0:
         raise DaftNotFoundError(f"Delta table has no commits: {table_uri}")
     for name in commits:
-        with open(os.path.join(log_dir, name)) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                action = json.loads(line)
-                if "add" in action:
-                    a = action["add"]
-                    live[a["path"]] = a
-                elif "remove" in action:
-                    live.pop(action["remove"]["path"], None)
-    return [dict(v, path=os.path.join(table_uri, k)) for k, v in live.items()]
+        for line in STORAGE.get(STORAGE.join(log_dir, name)).decode().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            action = json.loads(line)
+            if "add" in action:
+                a = action["add"]
+                live[a["path"]] = a
+            elif "remove" in action:
+                live.pop(action["remove"]["path"], None)
+    return [dict(v, path=STORAGE.join(table_uri, k)) for k, v in live.items()]
 
 
 def read_deltalake_scan(table_uri: str):
@@ -536,10 +540,13 @@ def write_deltalake_table(table_uri: str, arrow_tables: List[pa.Table],
     """Transactional Delta Lake write: data files + an atomic JSON commit.
 
     The commit uses the Delta protocol's put-if-absent contract on the next
-    version file (O_EXCL create — a concurrent writer loses and raises), the
-    same guarantee the reference gets from the deltalake client
-    (daft/dataframe/dataframe.py write_deltalake). mode: append | overwrite
-    | error. Returns the added file paths."""
+    version file (O_EXCL locally, `If-None-Match: *` on object stores — a
+    concurrent writer loses and raises), the same guarantee the reference
+    gets from the deltalake client (daft/dataframe/dataframe.py
+    write_deltalake). Works against local paths and s3:// uris alike; all
+    bytes ride Storage/IOClient. mode: append | overwrite | error. Returns
+    the added file paths."""
+    import io as _io
     import time as _time
     import uuid as _uuid
 
@@ -550,21 +557,19 @@ def write_deltalake_table(table_uri: str, arrow_tables: List[pa.Table],
     if not arrow_tables:
         raise ValueError("write_deltalake needs at least one (possibly "
                          "empty) partition to derive the table schema")
-    log_dir = os.path.join(table_uri, "_delta_log")
-    versions: List[int] = []
-    if os.path.isdir(log_dir):
-        versions = [int(f.split(".")[0]) for f in os.listdir(log_dir)
-                    if f.endswith(".json")]
-        # a checkpointed table whose older JSON commits were vacuumed is
-        # still an existing table: the checkpoint carries its version
-        lc = os.path.join(log_dir, "_last_checkpoint")
-        if os.path.exists(lc):
-            with open(lc) as f:
-                versions.append(int(json.load(f)["version"]))
+    log_dir = STORAGE.join(table_uri, "_delta_log")
+    log_names = STORAGE.list_names(log_dir)
+    versions: List[int] = [int(f.split(".")[0]) for f in log_names
+                           if f.endswith(".json")]
+    # a checkpointed table whose older JSON commits were vacuumed is
+    # still an existing table: the checkpoint carries its version
+    if "_last_checkpoint" in log_names:
+        lc = json.loads(STORAGE.get(STORAGE.join(log_dir, "_last_checkpoint")))
+        versions.append(int(lc["version"]))
     exists = bool(versions)
     if exists and mode == "error":
         raise FileExistsError(f"Delta table already exists: {table_uri}")
-    os.makedirs(log_dir, exist_ok=True)
+    STORAGE.makedirs(log_dir)
     schema_src = next((t for t in arrow_tables if t.num_rows), arrow_tables[0])
     now_ms = int(_time.time() * 1000)
     actions: List[dict] = []
@@ -572,8 +577,11 @@ def write_deltalake_table(table_uri: str, arrow_tables: List[pa.Table],
     if exists:
         version = max(versions) + 1
         if mode == "overwrite":
+            base = str(table_uri).rstrip("/") + "/"
             for f in _delta_live_files(table_uri):
-                rel = os.path.relpath(f["path"], table_uri)
+                p = f["path"]
+                rel = (p[len(base):] if str(p).startswith(base)
+                       else os.path.relpath(p, table_uri))
                 actions.append({"remove": {
                     "path": rel, "deletionTimestamp": now_ms,
                     "dataChange": True}})
@@ -593,24 +601,30 @@ def write_deltalake_table(table_uri: str, arrow_tables: List[pa.Table],
         if t.num_rows == 0:
             continue
         rel = f"part-{len(added):05d}-{_uuid.uuid4()}.parquet"
-        full = os.path.join(table_uri, rel)
-        papq.write_table(t, full)
+        full = STORAGE.join(table_uri, rel)
+        if STORAGE.is_remote(full):
+            buf = _io.BytesIO()
+            papq.write_table(t, buf)
+            view = buf.getbuffer()  # zero-copy; no second full-file copy
+            STORAGE.put(full, view)
+            size = len(view)
+        else:
+            lp = STORAGE._local(full)
+            os.makedirs(os.path.dirname(lp), exist_ok=True)
+            papq.write_table(t, lp)  # stream to disk, no RAM buffering
+            size = os.path.getsize(lp)
         actions.append({"add": {
             "path": rel, "partitionValues": {},
-            "size": os.path.getsize(full), "modificationTime": now_ms,
+            "size": size, "modificationTime": now_ms,
             "dataChange": True,
         }})
         added.append(full)
     actions.append({"commitInfo": {"timestamp": now_ms,
                                    "operation": "WRITE",
                                    "operationParameters": {"mode": mode.upper()}}})
-    commit_path = os.path.join(log_dir, f"{version:020d}.json")
+    commit_path = STORAGE.join(log_dir, f"{version:020d}.json")
     payload = "\n".join(json.dumps(a) for a in actions) + "\n"
-    fd = os.open(commit_path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
-    try:
-        os.write(fd, payload.encode())
-    finally:
-        os.close(fd)
+    STORAGE.put_if_absent(commit_path, payload.encode())
     return added
 
 

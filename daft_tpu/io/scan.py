@@ -34,6 +34,7 @@ class IOStats:
             self.row_groups_pruned = 0
             self.columns_read = 0
             self.retries = 0
+            self.bytes_written = 0
 
     def bump(self, **kw: int) -> None:
         with self._lock:
@@ -50,6 +51,7 @@ class IOStats:
                 "row_groups_pruned": self.row_groups_pruned,
                 "columns_read": self.columns_read,
                 "retries": self.retries,
+                "bytes_written": self.bytes_written,
             }
 
 
@@ -363,7 +365,9 @@ def glob_paths(path) -> List[str]:
             out.extend(glob_paths(p))
         return out
     p = str(path)
-    if p.startswith(("s3://", "http://", "https://")):
+    from .object_store import is_remote_path
+
+    if is_remote_path(p):
         from .object_store import default_io_client
 
         metas = default_io_client().glob(p)

@@ -4,11 +4,17 @@ Role-equivalent to the reference's daft/table/table_io.py:401 (write_tabular):
 writes one or more files per partition (splitting at a target file size),
 optionally hive-partitioned by key columns, and returns a manifest Table of
 written file paths (the reference's write result schema).
+
+Targets are local paths OR object-store urls (s3://...): every byte goes
+through io.object_store.Storage, so the same SigV4 client that serves reads
+serves writes (reference: the put path of s3_like.rs; cloud-target writes
+via daft/table/table_io.py:401+).
 """
 
 from __future__ import annotations
 
-import os
+import io
+import json
 import uuid
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -19,28 +25,46 @@ import pyarrow.parquet as papq
 from ..schema import Field, Schema
 from ..series import Series
 from ..table import Table
+from .object_store import STORAGE
 
 TARGET_FILE_SIZE_BYTES = 512 * 1024 * 1024
 
 
-def _write_one(arrow_tbl: pa.Table, root: str, format: str, compression: Optional[str],
-               idx: int) -> str:
-    name = f"{uuid.uuid4().hex[:16]}-{idx}.{format}"
-    path = os.path.join(root, name)
+def _encode_to(sink, arrow_tbl: pa.Table, format: str,
+               compression: Optional[str]) -> None:
+    """`sink` is a path (streams to disk) or a file-like (buffers)."""
     if format == "parquet":
-        papq.write_table(arrow_tbl, path, compression=compression or "snappy")
+        papq.write_table(arrow_tbl, sink, compression=compression or "snappy")
     elif format == "csv":
-        pacsv.write_csv(arrow_tbl, path)
+        pacsv.write_csv(arrow_tbl, sink)
     elif format == "json":
-        with open(path, "w") as f:
-            cols = arrow_tbl.to_pydict()
-            names = list(cols)
-            import json as _json
-
-            for row in zip(*cols.values()) if names else []:
-                f.write(_json.dumps(dict(zip(names, row)), default=str) + "\n")
+        cols = arrow_tbl.to_pydict()
+        names = list(cols)
+        text = "".join(json.dumps(dict(zip(names, row)), default=str) + "\n"
+                       for row in (zip(*cols.values()) if names else []))
+        data = text.encode()
+        if isinstance(sink, str):
+            with open(sink, "wb") as f:
+                f.write(data)
+        else:
+            sink.write(data)
     else:
         raise ValueError(f"unknown write format {format!r}")
+
+
+def _write_one(arrow_tbl: pa.Table, root: str, format: str,
+               compression: Optional[str], idx: int) -> str:
+    name = f"{uuid.uuid4().hex[:16]}-{idx}.{format}"
+    path = STORAGE.join(root, name)
+    if STORAGE.is_remote(path):
+        buf = io.BytesIO()
+        _encode_to(buf, arrow_tbl, format, compression)
+        # getbuffer(): zero-copy view; multipart slices of a memoryview are
+        # views too, so peak memory stays ~one encoded file, not two
+        STORAGE.put(path, buf.getbuffer())
+    else:
+        # stream straight to disk: no full-file RAM buffering locally
+        _encode_to(STORAGE._local(path), arrow_tbl, format, compression)
     return path
 
 
@@ -50,7 +74,7 @@ def write_tabular(tbl: Table, root_dir: str, format: str = "parquet",
                   target_file_size: int = TARGET_FILE_SIZE_BYTES) -> Table:
     """Write a table; returns a manifest table with a 'path' column (plus the
     partition key columns when hive-partitioning)."""
-    os.makedirs(root_dir, exist_ok=True)
+    STORAGE.makedirs(root_dir)
     paths: List[str] = []
     part_vals: List[Dict[str, Any]] = []
 
@@ -59,11 +83,11 @@ def write_tabular(tbl: Table, root_dir: str, format: str = "parquet",
         key_names = uniq.column_names
         uniq_rows = uniq.to_pylist()
         for part, keyrow in zip(parts, uniq_rows):
-            subdir = os.path.join(
+            subdir = STORAGE.join(
                 root_dir,
                 *[f"{k}={_hive_value(v)}" for k, v in keyrow.items()],
             )
-            os.makedirs(subdir, exist_ok=True)
+            STORAGE.makedirs(subdir)
             drop = [c for c in part.column_names if c not in key_names] or part.column_names
             body = part.select_columns(drop)
             for i, chunk in enumerate(_split_by_size(body, target_file_size)):

@@ -466,7 +466,14 @@ def url_download(s: Series, max_connections: int = 32, on_error: str = "raise",
 
 def url_upload(s: Series, location, on_error: str = "raise",
                max_connections: int = 32) -> Series:
-    """binary contents -> written file paths under `location` (local/file://)."""
+    """binary contents -> written file paths under `location`.
+
+    Remote targets (s3://, any scheme the object-store client routes) and
+    local paths alike; writes run max_connections-wide like the reference's
+    upload path (uri/upload.rs: async multi-put through IOClient), mirroring
+    url_download's concurrency."""
+    from .io.object_store import STORAGE
+
     if isinstance(location, Series):
         locs = location.to_pylist()
         if len(locs) == 1:
@@ -474,29 +481,36 @@ def url_upload(s: Series, location, on_error: str = "raise",
     else:
         locs = [location] * len(s)
     vals = s.to_pylist()
-    out: List[Optional[str]] = []
-    for i, (v, loc) in enumerate(zip(vals, locs)):
-        if v is None or loc is None:
-            out.append(None)
-            continue
+    n = len(vals)
+    out: List[Optional[str]] = [None] * n
+    errs: List[Optional[Exception]] = [None] * n
+
+    def _upload_one(i: int, v, loc: str) -> str:
+        data = v if isinstance(v, (bytes, bytearray)) else str(v).encode()
         if loc.startswith("file://"):
-            loc = loc[len("file://"):]
-        if loc.startswith(("s3://", "gs://", "az://")):
-            if on_error == "null":
-                out.append(None)
+            loc = loc[len("file://"):]  # return plain fs paths, as before
+        path = STORAGE.join(loc, f"{i}-{uuid.uuid4().hex}.bin")
+        if not STORAGE.is_remote(loc):
+            STORAGE.makedirs(loc)
+        STORAGE.put(path, bytes(data))
+        return path
+
+    workers = max(1, min(int(max_connections), 64))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+        futs = {}
+        for i, (v, loc) in enumerate(zip(vals, locs)):
+            if v is None or loc is None:
                 continue
-            raise NotImplementedError(f"remote upload target {loc!r} requires an object-store client")
-        try:
-            os.makedirs(loc, exist_ok=True)
-            path = os.path.join(loc, f"{i}-{uuid.uuid4().hex}.bin")
-            with open(path, "wb") as f:
-                f.write(v if isinstance(v, (bytes, bytearray)) else str(v).encode())
-            out.append(path)
-        except Exception:
-            if on_error == "null":
-                out.append(None)
-            else:
-                raise
+            futs[ex.submit(_upload_one, i, v, loc)] = i
+        for f in concurrent.futures.as_completed(futs):
+            i = futs[f]
+            try:
+                out[i] = f.result()
+            except Exception as e:  # noqa: BLE001
+                errs[i] = e
+    first_err = next((e for e in errs if e is not None), None)
+    if first_err is not None and on_error != "null":
+        raise first_err
     return Series.from_pylist(out, s.name, DataType.string())
 
 

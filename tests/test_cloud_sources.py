@@ -1,0 +1,403 @@
+"""GCS / Azure / HuggingFace object-store sources against mock servers.
+
+Reference role-equivalents: src/daft-io/src/google_cloud.rs (470 LoC),
+azure_blob.rs (656), huggingface.rs (633). The GCS XML API is S3-wire-
+compatible, so the GCS mock speaks the S3 dialect; Azure speaks the Blob
+REST dialect (x-ms-* headers, comp=list XML, NextMarker pagination); HF
+speaks the Hub's resolve/tree HTTP surface."""
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlsplit
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.io.object_store import (
+    AzureConfig,
+    AzureSource,
+    GCSConfig,
+    GCSSource,
+    HFConfig,
+    HuggingFaceSource,
+)
+
+
+def _parquet_bytes(tbl: pa.Table) -> bytes:
+    buf = io.BytesIO()
+    papq.write_table(tbl, buf)
+    return buf.getvalue()
+
+
+def _serve(handler_cls):
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, f"http://127.0.0.1:{server.server_port}"
+
+
+# ---------------------------------------------------------------------------
+# GCS (S3-dialect XML API)
+# ---------------------------------------------------------------------------
+
+class MockGCSHandler(BaseHTTPRequestHandler):
+    store = {}  # (bucket, key) -> bytes
+    auth_seen = []
+
+    def log_message(self, *a):
+        pass
+
+    def _parse(self):
+        u = urlsplit(self.path)
+        parts = unquote(u.path).lstrip("/").split("/", 1)
+        return parts[0], parts[1] if len(parts) > 1 else "", parse_qs(
+            u.query, keep_blank_values=True)
+
+    def do_GET(self):
+        bucket, key, q = self._parse()
+        MockGCSHandler.auth_seen.append(self.headers.get("Authorization"))
+        if "list-type" in q:
+            prefix = q.get("prefix", [""])[0]
+            keys = sorted(k for (b, k) in MockGCSHandler.store
+                          if b == bucket and k.startswith(prefix))
+            items = "".join(
+                f"<Contents><Key>{k}</Key>"
+                f"<Size>{len(MockGCSHandler.store[(bucket, k)])}</Size>"
+                f"</Contents>" for k in keys)
+            xml = (f"<?xml version='1.0'?><ListBucketResult>"
+                   f"<IsTruncated>false</IsTruncated>{items}"
+                   f"</ListBucketResult>").encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(xml)))
+            self.end_headers()
+            self.wfile.write(xml)
+            return
+        body = MockGCSHandler.store.get((bucket, key))
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        rng = self.headers.get("Range")
+        status = 200
+        if rng:
+            lo, hi = rng.split("=")[1].split("-")
+            body = body[int(lo):int(hi) + 1]
+            status = 206
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_HEAD(self):
+        bucket, key, _q = self._parse()
+        body = MockGCSHandler.store.get((bucket, key))
+        if body is None:
+            self.send_response(404)
+        else:
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+
+    def do_PUT(self):
+        bucket, key, _q = self._parse()
+        n = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(n)
+        # GCS put-if-absent dialect (S3's If-None-Match is NOT honored there)
+        if (self.headers.get("x-goog-if-generation-match") == "0"
+                and (bucket, key) in MockGCSHandler.store):
+            self.send_response(412)
+            self.end_headers()
+            return
+        MockGCSHandler.store[(bucket, key)] = body
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+@pytest.fixture(scope="module")
+def mock_gcs():
+    server, endpoint = _serve(MockGCSHandler)
+    yield endpoint
+    server.shutdown()
+
+
+class TestGCS:
+    def test_get_put_roundtrip(self, mock_gcs):
+        MockGCSHandler.store.clear()
+        src = GCSSource(GCSConfig(endpoint_url=mock_gcs, token="tok"))
+        src.put("gs://bkt/a/b.bin", b"payload")
+        assert src.get("gs://bkt/a/b.bin") == b"payload"
+        assert src.get("gs://bkt/a/b.bin", range=(1, 4)) == b"ayl"
+        assert src.get_size("gs://bkt/a/b.bin") == 7
+        # bearer token flows on every request
+        assert "Bearer tok" in MockGCSHandler.auth_seen
+
+    def test_put_if_absent_uses_generation_match(self, mock_gcs):
+        """GCS ignores S3's If-None-Match on uploads; the conditional must be
+        translated to x-goog-if-generation-match: 0 or Delta commits on gs://
+        would silently overwrite each other."""
+        MockGCSHandler.store.clear()
+        src = GCSSource(GCSConfig(endpoint_url=mock_gcs))
+        src.put("gs://bkt/commit/0.json", b"v0", if_none_match=True)
+        with pytest.raises(FileExistsError):
+            src.put("gs://bkt/commit/0.json", b"again", if_none_match=True)
+        assert MockGCSHandler.store[("bkt", "commit/0.json")] == b"v0"
+
+    def test_engine_read_parquet_gs(self, mock_gcs, monkeypatch):
+        MockGCSHandler.store.clear()
+        for i in range(2):
+            t = pa.table({"v": [i * 10 + j for j in range(3)]})
+            MockGCSHandler.store[("bkt", f"ds/p{i}.parquet")] = _parquet_bytes(t)
+        monkeypatch.setenv("GCS_ENDPOINT_URL", mock_gcs)
+        out = dt.read_parquet("gs://bkt/ds/p*.parquet").sort("v").to_pydict()
+        assert out == {"v": [0, 1, 2, 10, 11, 12]}
+
+
+# ---------------------------------------------------------------------------
+# Azure Blob
+# ---------------------------------------------------------------------------
+
+class MockAzureHandler(BaseHTTPRequestHandler):
+    """Blob REST dialect under /{account}/{container}/{blob}: GET/HEAD/PUT
+    (+If-None-Match), comp=list with forced NextMarker pagination."""
+
+    store = {}  # (container, blob) -> bytes
+    page_size = 2
+    saw_versions = []
+
+    def log_message(self, *a):
+        pass
+
+    def _parse(self):
+        u = urlsplit(self.path)
+        parts = unquote(u.path).lstrip("/").split("/", 2)
+        # account / container / blob
+        container = parts[1] if len(parts) > 1 else ""
+        blob = parts[2] if len(parts) > 2 else ""
+        return container, blob, parse_qs(u.query, keep_blank_values=True)
+
+    def do_GET(self):
+        container, blob, q = self._parse()
+        MockAzureHandler.saw_versions.append(self.headers.get("x-ms-version"))
+        if q.get("comp") == ["list"]:
+            prefix = q.get("prefix", [""])[0]
+            marker = int(q.get("marker", ["0"])[0] or 0)
+            names = sorted(b for (c, b) in MockAzureHandler.store
+                           if c == container and b.startswith(prefix))
+            page = names[marker:marker + MockAzureHandler.page_size]
+            nxt = (str(marker + len(page))
+                   if marker + len(page) < len(names) else "")
+            blobs = "".join(
+                f"<Blob><Name>{n}</Name><Properties><Content-Length>"
+                f"{len(MockAzureHandler.store[(container, n)])}"
+                f"</Content-Length></Properties></Blob>" for n in page)
+            xml = (f"<?xml version='1.0'?><EnumerationResults>"
+                   f"<Blobs>{blobs}</Blobs><NextMarker>{nxt}</NextMarker>"
+                   f"</EnumerationResults>").encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(xml)))
+            self.end_headers()
+            self.wfile.write(xml)
+            return
+        body = MockAzureHandler.store.get((container, blob))
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        rng = self.headers.get("x-ms-range") or self.headers.get("Range")
+        status = 200
+        if rng:
+            lo, hi = rng.split("=")[1].split("-")
+            body = body[int(lo):int(hi) + 1]
+            status = 206
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_HEAD(self):
+        container, blob, _q = self._parse()
+        body = MockAzureHandler.store.get((container, blob))
+        if body is None:
+            self.send_response(404)
+        else:
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+
+    def do_PUT(self):
+        container, blob, _q = self._parse()
+        n = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(n)
+        if (self.headers.get("If-None-Match") == "*"
+                and (container, blob) in MockAzureHandler.store):
+            self.send_response(412)
+            self.end_headers()
+            return
+        MockAzureHandler.store[(container, blob)] = body
+        self.send_response(201)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+@pytest.fixture(scope="module")
+def mock_azure():
+    server, endpoint = _serve(MockAzureHandler)
+    yield endpoint
+    server.shutdown()
+
+
+def _az_cfg(endpoint):
+    # shared-key signing exercised end-to-end (mock accepts any signature,
+    # but the signing path must not crash); key is base64 of 'secret'
+    return AzureConfig(account="acct", key="c2VjcmV0", endpoint_url=endpoint)
+
+
+class TestAzure:
+    def test_get_put_roundtrip(self, mock_azure):
+        MockAzureHandler.store.clear()
+        src = AzureSource(_az_cfg(mock_azure))
+        src.put("az://cont/dir/x.bin", b"hello azure")
+        assert src.get("az://cont/dir/x.bin") == b"hello azure"
+        assert src.get("az://cont/dir/x.bin", range=(0, 5)) == b"hello"
+        assert src.get_size("az://cont/dir/x.bin") == 11
+        assert "2021-08-06" in MockAzureHandler.saw_versions
+
+    def test_put_if_absent(self, mock_azure):
+        MockAzureHandler.store.clear()
+        src = AzureSource(_az_cfg(mock_azure))
+        src.put("az://cont/c.json", b"v0", if_none_match=True)
+        with pytest.raises(FileExistsError):
+            src.put("az://cont/c.json", b"again", if_none_match=True)
+
+    def test_ls_paginates_and_glob(self, mock_azure):
+        MockAzureHandler.store.clear()
+        src = AzureSource(_az_cfg(mock_azure))
+        for i in range(5):
+            MockAzureHandler.store[("cont", f"d/p{i}.parquet")] = b"x"
+        MockAzureHandler.store[("cont", "d/readme.txt")] = b"x"
+        # page_size 2 forces 3 list round-trips
+        assert len(src.ls("az://cont/d/")) == 6
+        got = [m.path for m in src.glob("az://cont/d/p*.parquet")]
+        assert got == [f"az://cont/d/p{i}.parquet" for i in range(5)]
+
+    def test_engine_read_parquet_az(self, mock_azure, monkeypatch):
+        MockAzureHandler.store.clear()
+        t = pa.table({"v": [5, 6]})
+        MockAzureHandler.store[("cont", "tbl/f.parquet")] = _parquet_bytes(t)
+        monkeypatch.setenv("AZURE_ENDPOINT_URL", mock_azure)
+        monkeypatch.setenv("AZURE_STORAGE_ACCOUNT", "acct")
+        monkeypatch.setenv("AZURE_STORAGE_KEY", "c2VjcmV0")
+        out = dt.read_parquet("az://cont/tbl/*.parquet").to_pydict()
+        assert out == {"v": [5, 6]}
+        # abfs:// routes to the same source
+        out2 = dt.read_parquet("abfs://cont/tbl/f.parquet").to_pydict()
+        assert out2 == {"v": [5, 6]}
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace Hub
+# ---------------------------------------------------------------------------
+
+class MockHFHandler(BaseHTTPRequestHandler):
+    files = {}  # "datasets/user/repo" -> {path: bytes}
+    tokens_seen = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        MockHFHandler.tokens_seen.append(self.headers.get("Authorization"))
+        u = urlsplit(self.path)
+        path = unquote(u.path)
+        if path.startswith("/api/"):
+            # /api/{kind}/{user}/{repo}/tree/main[/{dir}]
+            parts = path[len("/api/"):].split("/")
+            repo = "/".join(parts[0:3])
+            entries = [{"type": "file", "path": p, "size": len(b)}
+                       for p, b in MockHFHandler.files.get(repo, {}).items()]
+            data = json.dumps(entries).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        # /{kind}/{user}/{repo}/resolve/main/{path}
+        parts = path.lstrip("/").split("/resolve/main/")
+        if len(parts) == 2:
+            repo, inner = parts[0], parts[1]
+            body = MockHFHandler.files.get(repo, {}).get(inner)
+            if body is not None:
+                rng = self.headers.get("Range")
+                status = 200
+                if rng:
+                    lo, hi = rng.split("=")[1].split("-")
+                    body = body[int(lo):int(hi) + 1]
+                    status = 206
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+        self.send_response(404)
+        self.end_headers()
+
+    def do_HEAD(self):
+        u = urlsplit(self.path)
+        parts = unquote(u.path).lstrip("/").split("/resolve/main/")
+        body = None
+        if len(parts) == 2:
+            body = MockHFHandler.files.get(parts[0], {}).get(parts[1])
+        if body is None:
+            self.send_response(404)
+        else:
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+
+
+@pytest.fixture(scope="module")
+def mock_hf():
+    server, endpoint = _serve(MockHFHandler)
+    yield endpoint
+    server.shutdown()
+
+
+class TestHuggingFace:
+    def test_get_ls_glob(self, mock_hf):
+        MockHFHandler.files.clear()
+        MockHFHandler.files["datasets/u/r"] = {
+            "data/a.parquet": b"A", "data/b.parquet": b"B", "README.md": b"#"}
+        src = HuggingFaceSource(HFConfig(endpoint_url=mock_hf, token="hftok"))
+        assert src.get("hf://datasets/u/r/data/a.parquet") == b"A"
+        assert src.get_size("hf://datasets/u/r/README.md") == 1
+        names = sorted(m.path for m in src.ls("hf://datasets/u/r/"))
+        assert names == ["hf://datasets/u/r/README.md",
+                         "hf://datasets/u/r/data/a.parquet",
+                         "hf://datasets/u/r/data/b.parquet"]
+        got = sorted(m.path for m in src.glob("hf://datasets/u/r/data/*.parquet"))
+        assert got == ["hf://datasets/u/r/data/a.parquet",
+                       "hf://datasets/u/r/data/b.parquet"]
+        assert "Bearer hftok" in MockHFHandler.tokens_seen
+
+    def test_engine_read_parquet_hf(self, mock_hf, monkeypatch):
+        MockHFHandler.files.clear()
+        t = pa.table({"v": [7, 8, 9]})
+        MockHFHandler.files["datasets/u/r"] = {
+            "data/part0.parquet": _parquet_bytes(t)}
+        monkeypatch.setenv("HF_ENDPOINT", mock_hf)
+        out = dt.read_parquet("hf://datasets/u/r/data/*.parquet").to_pydict()
+        assert out == {"v": [7, 8, 9]}
+
+    def test_url_download_hf(self, mock_hf, monkeypatch):
+        MockHFHandler.files.clear()
+        MockHFHandler.files["datasets/u/r"] = {"img/x.jpg": b"JPG"}
+        monkeypatch.setenv("HF_ENDPOINT", mock_hf)
+        df = dt.from_pydict({"u": ["hf://datasets/u/r/img/x.jpg"]})
+        out = df.select(col("u").url.download().alias("d")).to_pydict()
+        assert out["d"] == [b"JPG"]
