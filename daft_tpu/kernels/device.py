@@ -113,15 +113,33 @@ def _physical_np(arr: pa.Array) -> np.ndarray:
 
 
 class DeviceColumn:
-    """values + validity on device, padded to `bucket` rows (valid[n:] == False)."""
+    """values + validity on device, padded to `bucket` rows (valid[n:] == False).
 
-    __slots__ = ("values", "valid", "length", "dtype")
+    String columns stage as int32 DICTIONARY CODES against a SORTED
+    per-partition dictionary (host-side pa.Array kept on `dictionary`):
+    sorted codes are order-isomorphic to the strings, so equality AND
+    ordering comparisons, sorts, and group codes all run on device over
+    plain int lanes; decode happens at unstage (reference semantics:
+    src/daft-core/src/array/ops/groups.rs dictionary grouping)."""
 
-    def __init__(self, values: jax.Array, valid: jax.Array, length: int, dtype: DataType):
+    __slots__ = ("values", "valid", "length", "dtype", "dictionary",
+                 "_dict_list")
+
+    def __init__(self, values: jax.Array, valid: jax.Array, length: int,
+                 dtype: DataType, dictionary=None):
         self.values = values
         self.valid = valid
         self.length = length
         self.dtype = dtype
+        self.dictionary = dictionary  # pa.Array of sorted uniques (strings)
+        self._dict_list = None
+
+    def dict_list(self):
+        """Python-list view of the dictionary (cached — bisected per query
+        for literal code bounds)."""
+        if self._dict_list is None and self.dictionary is not None:
+            self._dict_list = self.dictionary.to_pylist()
+        return self._dict_list
 
     @property
     def bucket(self) -> int:
@@ -180,8 +198,40 @@ def _narrow_staged(vals: np.ndarray, dt: DataType) -> np.ndarray:
     return vals.astype(target, copy=False)
 
 
+def stageable_dtype(dt: DataType) -> bool:
+    """Device-stageable: device-representable numerics OR strings (which
+    stage as dictionary codes)."""
+    return is_device_dtype(dt) or dt.is_string()
+
+
+def _stage_string_series(s, bucket: Optional[int]) -> DeviceColumn:
+    """Stage a string Series as sorted-dictionary codes.
+
+    The dictionary is sorted so code order == lexicographic order (UTF-8
+    byte order and codepoint order coincide), which is also pyarrow's
+    string ordering — host/device comparison and sort semantics agree."""
+    n = len(s)
+    b = bucket or size_bucket(n)
+    arr = s.to_arrow()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    uniq = pc.unique(arr.drop_null())
+    uniq = uniq.take(pc.sort_indices(uniq))
+    codes = pc.index_in(arr, value_set=uniq)  # null where arr is null
+    vals = np.asarray(pc.fill_null(codes, 0), dtype=np.int32)
+    if b > n:
+        vals = np.concatenate([vals, np.zeros(b - n, dtype=np.int32)])
+    valid = np.zeros(b, dtype=bool)
+    if n:
+        valid[:n] = np.asarray(pc.is_valid(arr)) if arr.null_count else True
+    return DeviceColumn(jnp.asarray(vals), jnp.asarray(valid), n, s.dtype,
+                        dictionary=uniq)
+
+
 def stage_series(s, bucket: Optional[int] = None) -> DeviceColumn:
     """Stage a host Series onto the device (values + validity, padded)."""
+    if s.dtype.is_string():
+        return _stage_string_series(s, bucket)
     vals, valid, n = stage_np(s, bucket)
     return DeviceColumn(jnp.asarray(vals), jnp.asarray(valid), n, s.dtype)
 
@@ -193,6 +243,17 @@ def unstage(col: DeviceColumn):
     vals = np.asarray(jax.device_get(col.values))[:col.length]
     valid = np.asarray(jax.device_get(col.valid))[:col.length]
     dt = col.dtype
+    if col.dictionary is not None:
+        uniq = col.dictionary
+        if len(uniq) == 0:
+            out = pa.nulls(col.length, pa.large_string())
+        else:
+            codes = np.clip(vals.astype(np.int64), 0, len(uniq) - 1)
+            out = uniq.take(pa.array(codes))
+            if not valid.all():
+                out = pc.if_else(pa.array(valid), out,
+                                 pa.nulls(col.length, out.type))
+        return Series.from_arrow(out, "device", dt)
     if dt.kind in (TypeKind.EMBEDDING, TypeKind.FIXED_SHAPE_TENSOR, TypeKind.FIXED_SHAPE_IMAGE):
         shape = (dt.params[1],) if dt.kind == TypeKind.EMBEDDING else dt.tensor_shape
         size = int(np.prod(shape))
@@ -271,6 +332,51 @@ def _literal_fits_device(lit) -> bool:
     return True
 
 
+_CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
+_CMP_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}
+
+
+def _plain_string_column(node, schema) -> Optional[str]:
+    """Column name if `node` is a bare string Column (through Aliases) —
+    the only string-VALUED shape the device supports (codes decode at
+    unstage against that column's dictionary)."""
+    from ..expressions import Alias, Column
+
+    while isinstance(node, Alias):
+        node = node.child
+    if isinstance(node, Column):
+        try:
+            if schema[node.cname].dtype.is_string():
+                return node.cname
+        except KeyError:
+            return None
+    return None
+
+
+def _string_cmp_shape(node, schema):
+    """(colname, literal_value, flipped) when `node` is a comparison between
+    a string Column and a string Literal (either side); else None. These
+    compile to dictionary-code comparisons with the literal's code bounds
+    injected per-partition at staging time."""
+    from ..expressions import BinaryOp, Literal
+
+    if not (isinstance(node, BinaryOp) and node.op in _CMP_OPS):
+        return None
+
+    def lit_str(n):
+        return (isinstance(n, Literal)
+                and (n.value is None or isinstance(n.value, str))
+                and (n.dtype.is_string() or n.dtype.is_null()))
+
+    lcol = _plain_string_column(node.left, schema)
+    rcol = _plain_string_column(node.right, schema)
+    if lcol is not None and lit_str(node.right):
+        return lcol, node.right.value, False
+    if rcol is not None and lit_str(node.left):
+        return rcol, node.left.value, True
+    return None
+
+
 def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
     """Can this expression tree run fully on device against `schema`?"""
     from ..expressions import (
@@ -293,20 +399,49 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
     except (ValueError, KeyError):
         return False
     if not (is_device_dtype(out_dt) or out_dt.is_null()):
+        # strings ride dictionary codes, but only as bare column passthrough
+        # (decoded at unstage) — any string-PRODUCING compute stays host
+        if out_dt.is_string():
+            return _plain_string_column(node, schema) is not None
         return False
     if isinstance(node, Column):
-        return is_device_dtype(schema[node.cname].dtype)
+        return stageable_dtype(schema[node.cname].dtype)
     if isinstance(node, Literal):
         return _literal_fits_device(node)
     if isinstance(node, (Alias, Not, IsNull)):
         return all(rec(c) for c in node.children())
+    def any_string_child(n) -> bool:
+        """True when any DIRECT child is string-typed (or untyped): its
+        device representation would be dictionary codes, which only the
+        string-comparison shape knows how to interpret."""
+        for c in n.children():
+            try:
+                if c.to_field(schema).dtype.is_string():
+                    return True
+            except (ValueError, KeyError):
+                return True
+        return False
+
     if isinstance(node, Cast):
+        # one level is enough here: casting dictionary CODES themselves is
+        # nonsense, but a cast OVER e.g. a bool from a legit string compare
+        # is fine — deeper strings are vetted where they are consumed
+        if any_string_child(node):
+            return False
         return is_device_dtype(node.dtype) and rec(node.child)
     if isinstance(node, BinaryOp):
         if node.op == "+" and out_dt.is_string():
             return False
+        if _string_cmp_shape(node, schema) is not None:
+            return True
+        # any OTHER op touching a string child (col vs col: codes come
+        # from different dictionaries) must stay host
+        if any_string_child(node):
+            return False
         return all(rec(c) for c in node.children())
     if isinstance(node, (FillNull, IfElse, Between)):
+        if any_string_child(node):
+            return False
         return all(rec(c) for c in node.children())
     if isinstance(node, Function):
         if node.fname in _DEVICE_FNS:
@@ -336,6 +471,66 @@ _DEVICE_FNS = {
 }
 
 
+def _strlit_keys(colname: str, lit: str) -> Tuple[str, str, str]:
+    """Deterministic env keys for a (column, literal) pair's injected code
+    bounds: eq code (-1 when absent), bisect-left pos, bisect-right pos."""
+    base = f"__strlit__\x00{colname}\x00{lit}"
+    return base + "\x00eq", base + "\x00lt", base + "\x00le"
+
+
+def _env_nrows(env) -> int:
+    """Bucket length from the first COLUMN entry (env also carries scalar
+    literal-code leaves, which have no row dimension)."""
+    for v in env.values():
+        if isinstance(v, tuple):
+            return v[0].shape[0]
+    raise AssertionError("projection env has no column entries")
+
+
+def collect_string_cmp_literals(nodes, schema):
+    """Every (colname, literal) string comparison in the trees (normalized)."""
+    from ..expressions import BinaryOp
+
+    out = []
+
+    def walk(n):
+        if isinstance(n, BinaryOp):
+            shape = _string_cmp_shape(n, schema)
+            if shape is not None and shape[1] is not None:
+                out.append((shape[0], shape[1]))
+        for c in n.children():
+            walk(c)
+
+    for nd in nodes:
+        walk(nd)
+    return out
+
+
+def string_literal_env(nodes, schema, dcs) -> Optional[Dict[str, jax.Array]]:
+    """Per-partition code bounds for every string-literal comparison:
+    {env_key: int32 scalar}. The compiled closure is shared across
+    partitions (the literal's CODE varies, the program does not). Returns
+    None when a needed dictionary is unavailable (caller falls back)."""
+    import bisect
+
+    add: Dict[str, jax.Array] = {}
+    for colname, lit in collect_string_cmp_literals(nodes, schema):
+        keq, klt, kle = _strlit_keys(colname, lit)
+        if keq in add:
+            continue
+        dc = dcs.get(colname)
+        if dc is None or dc.dictionary is None:
+            return None
+        uniq = dc.dict_list()
+        i = bisect.bisect_left(uniq, lit)
+        j = bisect.bisect_right(uniq, lit)
+        eq = i if i < len(uniq) and uniq[i] == lit else -1
+        add[keq] = jnp.int32(eq)
+        add[klt] = jnp.int32(i)
+        add[kle] = jnp.int32(j)
+    return add
+
+
 def _compile_node(node, schema) -> "Tuple[callable, DataType]":
     """Recursively build a python closure over {name: (values, valid)} env.
 
@@ -359,14 +554,14 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
     if isinstance(node, Literal):
         if node.value is None:
             def run(env, _dt=out_dt):
-                n = next(iter(env.values()))[0].shape[0]
+                n = _env_nrows(env)
                 return jnp.zeros(n, dtype=jnp.int32), jnp.zeros(n, dtype=bool)
         else:
             v = _literal_to_physical(node.value, node.dtype)
             jd = _jdt(node.dtype)
 
             def run(env, _v=v, _jd=jd):
-                n = next(iter(env.values()))[0].shape[0]
+                n = _env_nrows(env)
                 return jnp.full(n, _v, dtype=_jd), jnp.ones(n, dtype=bool)
 
         return run, out_dt
@@ -453,6 +648,37 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
         return run, out_dt
 
     if isinstance(node, BinaryOp):
+        shape = _string_cmp_shape(node, schema)
+        if shape is not None:
+            colname, lit, flipped = shape
+            cop = _CMP_FLIP[node.op] if flipped else node.op
+            if lit is None:
+                # comparison with a null literal: all-null result (SQL)
+                def run(env, _c=colname):
+                    _v, m = env[_c]
+                    z = jnp.zeros_like(m)
+                    return z, z
+
+                return run, out_dt
+            keq, klt, kle = _strlit_keys(colname, lit)
+
+            def run(env, _c=colname, _op=cop, _keq=keq, _klt=klt, _kle=kle):
+                codes, m = env[_c]
+                if _op == "==":
+                    out = codes == env[_keq]
+                elif _op == "!=":
+                    out = codes != env[_keq]
+                elif _op == "<":
+                    out = codes < env[_klt]
+                elif _op == ">=":
+                    out = codes >= env[_klt]
+                elif _op == "<=":
+                    out = codes < env[_kle]
+                else:  # ">"
+                    out = codes >= env[_kle]
+                return out, m
+
+            return run, out_dt
         lf, ldt = _compile_node(node.left, schema)
         rf, rdt = _compile_node(node.right, schema)
         op = node.op
@@ -582,24 +808,28 @@ def compile_projection(nodes, schema, input_names: Tuple[str, ...]):
 
 
 def stage_table_columns(table, names, bucket: int, stage_cache: Optional[dict] = None):
-    """Stage the named columns of a host Table as an env dict
-    {name: (values, valid)}, reusing HBM-resident columns from `stage_cache`
-    (the per-MicroPartition residency cache — staging, not compute, is the
-    bottleneck through the host link, so repeated queries over the same
-    partition must not re-transfer). Returns None if any column is ineligible."""
+    """Stage the named columns of a host Table: returns (env, dcs) where env
+    is {name: (values, valid)} for the jitted programs and dcs the backing
+    DeviceColumns (string dictionaries live there). HBM-resident columns are
+    reused from `stage_cache` (the per-MicroPartition residency cache —
+    staging, not compute, is the bottleneck through the host link, so
+    repeated queries over the same partition must not re-transfer).
+    Returns None if any column is ineligible."""
     env = {}
+    dcs = {}
     for name in names:
         ckey = (name, bucket, x64_enabled())
         dc = stage_cache.get(ckey) if stage_cache is not None else None
         if dc is None:
             s = table.get_column(name)
-            if not is_device_dtype(s.dtype):
+            if not stageable_dtype(s.dtype):
                 return None
             dc = stage_series(s, bucket)
             if stage_cache is not None:
                 stage_cache[ckey] = dc
         env[name] = (dc.values, dc.valid)
-    return env
+        dcs[name] = dc
+    return env, dcs
 
 
 def normalize_and_check(exprs, schema) -> Optional[list]:
@@ -726,8 +956,8 @@ def int64_wrap_safe(nodes, schema, env, stage_cache: Optional[dict],
 def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     """Shared device prologue: normalize + eligibility-check the expressions,
     stage the input columns, compile and launch ONE jitted program. Returns
-    (outs, out_dts, nodes) with `outs` still on device (async), or None when
-    ineligible. Used by the projection and sort paths."""
+    (outs, out_dts, nodes, dcs) with `outs` still on device (async), or None
+    when ineligible. Used by the projection and sort paths."""
     from ..expressions import required_columns
 
     schema = table.schema
@@ -743,13 +973,20 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     if not needed:
         return None
     b = size_bucket(n)
-    env = stage_table_columns(table, needed, b, stage_cache)
-    if env is None:
+    staged = stage_table_columns(table, needed, b, stage_cache)
+    if staged is None:
         return None
+    env, dcs = staged
     if not int64_wrap_safe(nodes, schema, env, stage_cache, b):
         return None
+    lit_env = string_literal_env(nodes, schema, dcs)
+    if lit_env is None:
+        return None
+    if lit_env:
+        env = dict(env)
+        env.update(lit_env)
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))
-    return run(env), out_dts, nodes
+    return run(env), out_dts, nodes, dcs
 
 
 def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = None):
@@ -767,13 +1004,24 @@ def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = Non
     staged = _stage_and_run(table, exprs, stage_cache)
     if staged is None:
         return None
-    outs, out_dts, _ = staged  # async: device computes while the host moves on
+    outs, out_dts, nodes, dcs = staged  # async: device computes from here
+    schema = table.schema
 
     def resolve():
         cols = []
         fields = []
-        for e, (v, m), dt in zip(exprs, outs, out_dts):
-            dc = DeviceColumn(v, m, n, dt)
+        for e, nd, (v, m), dt in zip(exprs, nodes, outs, out_dts):
+            dictionary = None
+            if dt.is_string():
+                # string outputs are bare column passthroughs (enforced by
+                # the compilability check): decode with that column's dict
+                cname = _plain_string_column(nd, schema)
+                src = dcs.get(cname) if cname else None
+                if src is None or src.dictionary is None:
+                    raise RuntimeError(
+                        f"string projection {e.name()!r} lost its dictionary")
+                dictionary = src.dictionary
+            dc = DeviceColumn(v, m, n, dt, dictionary=dictionary)
             s = unstage(dc).rename(e.name())
             cols.append(s)
             fields.append(Field(e.name(), s.dtype))
@@ -1009,7 +1257,7 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
     staged = _stage_and_run(table, keys, stage_cache)
     if staged is None:
         return None
-    outs, _, _ = staged
+    outs, _, _, _ = staged
     nf_resolved = [(f if f is not None else d) for f, d in zip(nf, desc)]
     idx = device_argsort([(v, m) for v, m in outs], desc, nf_resolved, n)
     return np.asarray(jax.device_get(idx))[:n]

@@ -269,14 +269,21 @@ def device_grouped_agg_async(table, to_agg, group_by,
         needed.update(required_columns(nd))
     if pred_nodes is not None:
         needed.update(required_columns(pred_nodes[0]))
-    env = stage_table_columns(table, sorted(needed), b, stage_cache)
-    if env is None:
+    staged = stage_table_columns(table, sorted(needed), b, stage_cache)
+    if staged is None:
         return None
-    from .device import int64_wrap_safe
+    env, dcs = staged
+    from .device import int64_wrap_safe, string_literal_env
 
     check_nodes = list(child_nodes) + (list(pred_nodes) if pred_nodes else [])
     if not int64_wrap_safe(check_nodes, schema, env, stage_cache, b):
         return None  # int64 arithmetic could wrap in int32 lanes
+    lit_env = string_literal_env(check_nodes, schema, dcs)
+    if lit_env is None:
+        return None  # a string comparison lost its dictionary
+    if lit_env:
+        env = dict(env)
+        env.update(lit_env)
 
     # --- compile + run ONE fused program ---------------------------------
     from ..context import get_context
@@ -307,9 +314,23 @@ def device_grouped_agg_async(table, to_agg, group_by,
         out_cols: List[Series] = list(uniq._columns) if uniq is not None else []
         out_fields: List[Field] = list(uniq.schema) if uniq is not None else []
         agg_outs = outs[:len(specs)]
-        for (alias, kind, agg_node, _mode), out in zip(specs, agg_outs):
+        for (alias, kind, agg_node, _mode), child_nd, out in zip(
+                specs, child_nodes, agg_outs):
             expected_dt = agg_node.to_field(schema).dtype
-            merged = _finish_agg(kind, out, num_groups, expected_dt, n)
+            dictionary = None
+            if expected_dt.is_string():
+                # string min/max reduce over sorted-dictionary CODES (order-
+                # isomorphic): the result must decode through the child
+                # column's dictionary or it would silently return code digits
+                from .device import _plain_string_column
+
+                cname = _plain_string_column(child_nd, schema)
+                src = dcs.get(cname) if cname else None
+                if src is None or src.dictionary is None:
+                    return None  # cannot decode: host path recomputes
+                dictionary = src.dictionary
+            merged = _finish_agg(kind, out, num_groups, expected_dt, n,
+                                 dictionary=dictionary)
             if merged is None:
                 return None  # overflow guard tripped: host path recomputes
             out_cols.append(merged.rename(alias))
@@ -447,9 +468,11 @@ def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb,
     return run
 
 
-def _finish_agg(kind, out, num_groups, expected_dt: DataType, n):
+def _finish_agg(kind, out, num_groups, expected_dt: DataType, n,
+                dictionary=None):
     """Device partials -> host Series of the expected dtype (or None when the
-    int32 overflow guard fired and the host must recompute)."""
+    int32 overflow guard fired and the host must recompute). `dictionary`
+    decodes string min/max code results."""
     import pyarrow as pa
 
     from ..series import Series
@@ -479,5 +502,6 @@ def _finish_agg(kind, out, num_groups, expected_dt: DataType, n):
         return unstage(dc)
     # min / max
     vals, valid = out
-    dc = DeviceColumn(np.asarray(vals), np.asarray(valid), num_groups, expected_dt)
+    dc = DeviceColumn(np.asarray(vals), np.asarray(valid), num_groups,
+                      expected_dt, dictionary=dictionary)
     return unstage(dc)

@@ -74,13 +74,23 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     if not cols:
         return None
     b = size_bucket(len(table))
-    env = stage_table_columns(table, cols, b, cache)
-    if env is None:
+    staged = stage_table_columns(table, cols, b, cache)
+    if staged is None:
         return None
-    from .device import compile_projection, int64_wrap_safe
+    env, dcs = staged
+    from .device import compile_projection, int64_wrap_safe, string_literal_env
 
     if not int64_wrap_safe([node], schema, env, cache, b):
         return None  # computed int64 key could wrap in int32 lanes
+    # an integer key expression may still embed a string-literal comparison
+    # (e.g. (col('s') == 'a').cast(int)): the compiled closure reads the
+    # literal's per-partition code bounds from the env
+    lit_env = string_literal_env([node], schema, dcs)
+    if lit_env is None:
+        return None
+    if lit_env:
+        env = dict(env)
+        env.update(lit_env)
     run, _ = compile_projection([node], schema, tuple(sorted(cols)))
     (vals, valid), = run(env)
     if not jnp.issubdtype(vals.dtype, jnp.integer):

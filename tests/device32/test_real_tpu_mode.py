@@ -678,15 +678,186 @@ class TestDeviceSort32:
         assert _counters(dev).get("device_sorts", 0) >= 1
         assert dev.to_pydict() == host.to_pydict()
 
-    def test_string_sort_falls_back_to_host(self, host_mode):
-        data = {"s": np.array(["b", "a", "c"])[RNG.randint(0, 3, 5000)]}
+    def test_string_sort_runs_on_device_via_dictionary_codes(self, host_mode):
+        """Strings stage as SORTED-dictionary codes, so code order ==
+        lexicographic order and string sort keys ride the device argsort
+        (round-3 verdict item: device-side strings)."""
+        data = {"s": np.array(["b", "a", "c"])[RNG.randint(0, 3, 5000)],
+                "v": np.arange(5000, dtype=np.int64)}
 
         def q():
             return dt.from_pydict(data).sort("s")
 
         dev, host = _run_both(q, host_mode)
-        assert _counters(dev).get("device_sorts", 0) == 0
-        assert _counters(dev).get("host_sorts", 0) >= 1
+        assert _counters(dev).get("device_sorts", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()  # incl. stable tie order
+
+
+class TestDeviceStrings32:
+    """String compute on device via per-partition SORTED dictionary codes
+    (round-3 verdict item 4): equality AND ordering filters against string
+    literals, passthrough projections (decoded at unstage), fused
+    filter+agg with string predicates — all with host parity and counters
+    proving the device path engaged. Reference semantics:
+    src/daft-core/src/array/ops/groups.rs dictionary grouping."""
+
+    def _sdata(self, n=20_000):
+        modes = np.array(["MAIL", "SHIP", "AIR", "RAIL", "TRUCK"])
+        vals = modes[RNG.randint(0, 5, n)].tolist()
+        # nulls sprinkled in: masks must thread through the code compare
+        for i in range(0, n, 97):
+            vals[i] = None
+        return {"m": dt.Series.from_pylist(vals, "m", dt.DataType.string()),
+                "v": RNG.rand(n) * 100}
+
+    def test_string_equality_filter_on_device(self, host_mode):
+        data = self._sdata()
+
+        def q():
+            return dt.from_pydict(data).where(col("m") == "MAIL")
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) >= 1, _counters(dev)
+        assert dev.to_pydict()["m"] == host.to_pydict()["m"]
+
+    def test_string_ordering_filters_on_device(self, host_mode):
+        data = self._sdata()
+        for opname, build in [
+            ("lt", lambda: dt.from_pydict(data).where(col("m") < "RAIL")),
+            ("le", lambda: dt.from_pydict(data).where(col("m") <= "RAIL")),
+            ("gt", lambda: dt.from_pydict(data).where(col("m") > "MAIL")),
+            ("ge", lambda: dt.from_pydict(data).where(col("m") >= "MAIL")),
+            ("ne", lambda: dt.from_pydict(data).where(col("m") != "SHIP")),
+        ]:
+            dev, host = _run_both(build, host_mode)
+            assert _counters(dev).get("device_filters", 0) >= 1, opname
+            assert dev.to_pydict()["m"] == host.to_pydict()["m"], opname
+
+    def test_literal_absent_from_partition(self, host_mode):
+        data = self._sdata()
+
+        def q():  # literal not in the dictionary: eq empty, lt well-defined
+            return dt.from_pydict(data).where(col("m") > "ZEBRA")
+
+        dev, host = _run_both(q, host_mode)
+        assert dev.to_pydict() == host.to_pydict()
+        assert len(dev.to_pydict()["m"]) == 0
+
+    def test_flipped_literal_side(self, host_mode):
+        data = self._sdata()
+
+        def q():  # lit < col compiles as col > lit
+            return dt.from_pydict(data).where(dt.lit("MAIL") < col("m"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) >= 1
+        assert dev.to_pydict()["m"] == host.to_pydict()["m"]
+
+    def test_string_passthrough_projection_decodes(self, host_mode):
+        data = self._sdata()
+
+        def q():
+            return dt.from_pydict(data).select(
+                col("m"), (col("v") * 2).alias("w"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) >= 1, _counters(dev)
+        assert dev.to_pydict()["m"] == host.to_pydict()["m"]
+
+    def test_fused_string_predicate_groupby_agg(self, host_mode):
+        data = self._sdata()
+
+        def q():
+            return (dt.from_pydict(data)
+                    .where(col("m") != "AIR")
+                    .groupby("m")
+                    .agg(col("v").sum().alias("sv"),
+                         col("v").count().alias("cv"))
+                    .sort("m"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1, _counters(dev)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["m"] == h["m"] and d["cv"] == h["cv"]
+        np.testing.assert_allclose(d["sv"], h["sv"], rtol=1e-5)
+
+    def test_string_min_max_agg_decodes(self, host_mode):
+        """min/max over string columns reduce on device as dictionary codes
+        and MUST decode back to strings (a silent code-digits result was the
+        failure mode here)."""
+        data = self._sdata()
+
+        def q():
+            return (dt.from_pydict(data).groupby("m")
+                    .agg(col("m").min().alias("lo"),
+                         col("m").max().alias("hi"),
+                         col("v").count().alias("c"))
+                    .sort("m"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1, _counters(dev)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d == h
+        assert all(isinstance(x, str) for x in d["lo"] if x is not None)
+
+    def test_global_string_min_max(self, host_mode):
+        data = self._sdata()
+
+        def q():
+            return dt.from_pydict(data).agg(col("m").min().alias("lo"),
+                                            col("m").max().alias("hi"))
+
+        dev, host = _run_both(q, host_mode)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_int_key_embedding_string_cmp(self, host_mode):
+        """A computed integer grouping key that embeds a string-literal
+        comparison must either run with injected literal codes or decline
+        cleanly — never KeyError inside the jitted closure."""
+        data = self._sdata()
+
+        def q():
+            flag = (col("m") == "MAIL").cast(dt.DataType.int32()).alias("is_mail")
+            return (dt.from_pydict(data).groupby(flag)
+                    .agg(col("v").count().alias("c")).sort("is_mail"))
+
+        dev, host = _run_both(q, host_mode)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_string_col_vs_col_falls_back(self, host_mode):
+        """Codes from two different dictionaries are incomparable: col-vs-col
+        string comparisons must decline to the host path."""
+        n = 5000
+        a = np.array(["x", "y", "z"])[RNG.randint(0, 3, n)]
+        b = np.array(["x", "y", "z"])[RNG.randint(0, 3, n)]
+
+        def q():
+            return dt.from_pydict({"a": a, "b": b}).where(col("a") == col("b"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) == 0, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_string_cast_falls_back(self, host_mode):
+        n = 5000
+        data = {"s": np.array(["1", "2", "3"])[RNG.randint(0, 3, n)]}
+
+        def q():
+            return dt.from_pydict(data).select(
+                col("s").cast(dt.DataType.int64()).alias("i"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) == 0
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_null_literal_comparison(self, host_mode):
+        data = self._sdata(3000)
+
+        def q():
+            return dt.from_pydict(data).where(
+                (col("m") == dt.lit(None)).fill_null(False))
+
+        dev, host = _run_both(q, host_mode)
         assert dev.to_pydict() == host.to_pydict()
 
 
