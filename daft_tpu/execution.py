@@ -496,7 +496,12 @@ class ExecutionContext:
                 side, hit, bidx = res
                 ltbl, rtbl = lpart.table(), rpart.table()
                 self.stats.bump("device_join_probes")
-                if side == "right_build":
+                if side == "expanded":
+                    # N:M range join: (lidx, ridx) pairs already expanded on
+                    # host from the device range probe (-1 = left-outer miss)
+                    out = ltbl.join_from_indices(rtbl, hit, bidx,
+                                                 left_on, right_on, suffix)
+                elif side == "right_build":
                     if how == "semi":
                         out = ltbl.filter_with_mask(Series.from_numpy(hit, "m"))
                     elif how == "anti":

@@ -173,10 +173,17 @@ def stage_np(s, bucket: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray, i
         vals = _narrow_staged(_physical_np(arr), dt)
         if b > n:
             vals = np.concatenate([vals, np.zeros(b - n, dtype=vals.dtype)])
+    return vals, _staged_validity(arr, n, b), n
+
+
+def _staged_validity(arr: pa.Array, n: int, b: int) -> np.ndarray:
+    """Validity lane of a staged column, padding lanes False — shared by the
+    numeric and string (dictionary-code) staging paths so null/padding
+    semantics live once."""
     valid = np.zeros(b, dtype=bool)
     if n:
         valid[:n] = np.asarray(pc.is_valid(arr)) if arr.null_count else True
-    return vals, valid, n
+    return valid
 
 
 _NARROW_NP = {TypeKind.INT64: np.int32, TypeKind.UINT64: np.uint32,
@@ -221,9 +228,7 @@ def _stage_string_series(s, bucket: Optional[int]) -> DeviceColumn:
     vals = np.asarray(pc.fill_null(codes, 0), dtype=np.int32)
     if b > n:
         vals = np.concatenate([vals, np.zeros(b - n, dtype=np.int32)])
-    valid = np.zeros(b, dtype=bool)
-    if n:
-        valid[:n] = np.asarray(pc.is_valid(arr)) if arr.null_count else True
+    valid = _staged_validity(arr, n, b)
     return DeviceColumn(jnp.asarray(vals), jnp.asarray(valid), n, s.dtype,
                         dictionary=uniq)
 
@@ -506,11 +511,12 @@ def collect_string_cmp_literals(nodes, schema):
     return out
 
 
-def string_literal_env(nodes, schema, dcs) -> Optional[Dict[str, jax.Array]]:
-    """Per-partition code bounds for every string-literal comparison:
-    {env_key: int32 scalar}. The compiled closure is shared across
-    partitions (the literal's CODE varies, the program does not). Returns
-    None when a needed dictionary is unavailable (caller falls back)."""
+def string_literal_env(nodes, schema, dcs, env) -> Optional[dict]:
+    """Merge the per-partition code bounds for every string-literal
+    comparison into `env` ({env_key: int32 scalar} entries). The compiled
+    closure is shared across partitions (the literal's CODE varies, the
+    program does not). Returns the (possibly unchanged) env, or None when a
+    needed dictionary is unavailable (caller falls back to host)."""
     import bisect
 
     add: Dict[str, jax.Array] = {}
@@ -528,7 +534,11 @@ def string_literal_env(nodes, schema, dcs) -> Optional[Dict[str, jax.Array]]:
         add[keq] = jnp.int32(eq)
         add[klt] = jnp.int32(i)
         add[kle] = jnp.int32(j)
-    return add
+    if not add:
+        return env
+    merged = dict(env)
+    merged.update(add)
+    return merged
 
 
 def _compile_node(node, schema) -> "Tuple[callable, DataType]":
@@ -979,12 +989,9 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     env, dcs = staged
     if not int64_wrap_safe(nodes, schema, env, stage_cache, b):
         return None
-    lit_env = string_literal_env(nodes, schema, dcs)
-    if lit_env is None:
+    env = string_literal_env(nodes, schema, dcs, env)
+    if env is None:
         return None
-    if lit_env:
-        env = dict(env)
-        env.update(lit_env)
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))
     return run(env), out_dts, nodes, dcs
 

@@ -278,12 +278,9 @@ def device_grouped_agg_async(table, to_agg, group_by,
     check_nodes = list(child_nodes) + (list(pred_nodes) if pred_nodes else [])
     if not int64_wrap_safe(check_nodes, schema, env, stage_cache, b):
         return None  # int64 arithmetic could wrap in int32 lanes
-    lit_env = string_literal_env(check_nodes, schema, dcs)
-    if lit_env is None:
+    env = string_literal_env(check_nodes, schema, dcs, env)
+    if env is None:
         return None  # a string comparison lost its dictionary
-    if lit_env:
-        env = dict(env)
-        env.update(lit_env)
 
     # --- compile + run ONE fused program ---------------------------------
     from ..context import get_context

@@ -9,17 +9,20 @@ no data-dependent control flow fits XLA, so the build side is SORTED once
 vectorized `searchsorted` — O(P log B) fully on the VPU with static shapes.
 
 Scope (the TPC-H star-join shape): 1-4 integer/date keys (multi-column keys
-pack into one surrogate lane via exact mixed-radix packing), unique keys on
-the build side (primary-key side). Multiplicity >1, an overflowing composite
-key space, or non-integer keys fall back to the host acero join. Probe
-direction adapts:
+pack into one surrogate lane via exact mixed-radix packing). An overflowing
+composite key space or non-integer keys fall back to the host acero join.
+Probe direction adapts:
 
 - build = RIGHT side (right keys unique): inner/left/semi/anti with probe
   over the left rows — output already in host order (left idx, right idx).
 - build = LEFT side (left keys unique): inner — output re-sorted stably by
   left idx to match the host join's deterministic order.
+- duplicate keys on BOTH sides (N:M): the RANGE probe computes each probe
+  row's span of matches over the sorted build keys on device
+  (_range_probe_kernel); the data-dependent expansion to (lidx, ridx)
+  pairs happens on host (_range_join, side tag "expanded").
 
-The probe returns per-probe-row (hit, build_row_idx); the host assembles
+The PK probe returns per-probe-row (hit, build_row_idx); the host assembles
 output columns with vectorized takes (strings and other host-only payload
 never stage)."""
 
@@ -34,6 +37,56 @@ import jax
 import jax.numpy as jnp
 
 from .device import is_device_dtype, size_bucket, stage_table_columns
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _range_probe_kernel(build_vals, build_valid, probe_vals, probe_valid):
+    """Per-probe-row match RANGE over the sorted build keys: (lo [P], counts
+    [P], perm [B]). Handles duplicate build keys (N:M joins) — the match set
+    of probe row i is perm[lo[i] : lo[i] + counts[i]], valid lanes only.
+
+    Valid lanes sort before null/padding lanes within an equal-key run
+    (lexsort secondary key), so each run's valid matches are a contiguous
+    prefix and the cumulative-valid counter turns [lo, hi) into an exact
+    valid-match count. The variable-size expansion happens on the HOST
+    (data-dependent shapes cannot live under XLA): reference semantic is the
+    multi-row probe of src/daft-table/src/probe_table/mod.rs."""
+    big = jnp.iinfo(build_vals.dtype).max
+    k = jnp.where(build_valid, build_vals, big)
+    perm = jnp.lexsort((~build_valid, k))
+    sk = k[perm]
+    sorted_valid = build_valid[perm]
+    vp = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                          jnp.cumsum(sorted_valid.astype(jnp.int32))])
+    lo = jnp.searchsorted(sk, probe_vals, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(sk, probe_vals, side="right").astype(jnp.int32)
+    counts = jnp.where(probe_valid, vp[hi] - vp[lo], 0)
+    return lo, counts, perm.astype(jnp.int32)
+
+
+def _range_join(rv, rm, lv, lm, ln: int, how: str):
+    """N:M join (duplicate build keys): device range probe + vectorized host
+    expansion. Returns the executor contract — ("right_build", hit, _) for
+    semi/anti (only the hit mask is consumed), or ("expanded", lidx, ridx)
+    index pairs for inner/left (ridx == -1 marks a left-outer miss)."""
+    lo_d, counts_d, perm_d = _range_probe_kernel(rv, rm, lv, lm)
+    lo = np.asarray(jax.device_get(lo_d))[:ln].astype(np.int64)
+    counts = np.asarray(jax.device_get(counts_d))[:ln].astype(np.int64)
+    perm = np.asarray(jax.device_get(perm_d)).astype(np.int64)
+    hit = counts > 0
+    if how in ("semi", "anti"):
+        return "right_build", hit, np.zeros(ln, dtype=np.int64)
+    # effective row multiplicity: misses keep one output row under left-outer
+    ce = counts if how == "inner" else np.where(hit, counts, 1)
+    total = int(ce.sum())
+    lidx = np.repeat(np.arange(ln, dtype=np.int64), ce)
+    starts = np.repeat(lo, ce)
+    offs = np.arange(total, dtype=np.int64) - np.repeat(np.cumsum(ce) - ce, ce)
+    pos = np.minimum(starts + offs, len(perm) - 1)
+    ridx = perm[pos]
+    if how != "inner":
+        ridx = np.where(np.repeat(hit, ce), ridx, -1)
+    return "expanded", lidx, ridx
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -85,12 +138,9 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     # an integer key expression may still embed a string-literal comparison
     # (e.g. (col('s') == 'a').cast(int)): the compiled closure reads the
     # literal's per-partition code bounds from the env
-    lit_env = string_literal_env([node], schema, dcs)
-    if lit_env is None:
+    env = string_literal_env([node], schema, dcs, env)
+    if env is None:
         return None
-    if lit_env:
-        env = dict(env)
-        env.update(lit_env)
     run, _ = compile_projection([node], schema, tuple(sorted(cols)))
     (vals, valid), = run(env)
     if not jnp.issubdtype(vals.dtype, jnp.integer):
@@ -243,7 +293,9 @@ def device_join_indices(left_table, right_table, left_keys, right_keys,
 
     - side == "right_build": hit/bidx are per LEFT row (bidx indexes right)
     - side == "left_build": hit/bidx are per RIGHT row (bidx indexes left)
-    or None when ineligible (non-integer keys, duplicate build keys, ...).
+    - side == "expanded": hit/bidx are pre-expanded (lidx, ridx) row-index
+      pairs from the N:M range join (ridx == -1 marks a left-outer miss)
+    or None when ineligible (non-integer keys, overflowing key space, ...).
 
     Accepts a single key or a list of keys per side: multi-column keys pack
     into one surrogate lane via exact mixed-radix packing
@@ -304,11 +356,11 @@ def _probe_both_ways(lv, lm, rv, rm, ln: int, rn: int, how: str):
         hit = np.asarray(hit)[:ln]
         bidx = np.asarray(bidx)[:ln].astype(np.int64)
         return "right_build", hit, bidx
-    if how != "inner":
-        return None
-    hit, bidx, dup = _probe_kernel(lv, lm, rv, rm)
-    if bool(dup):
-        return None  # N:M join: host
-    hit = np.asarray(hit)[:rn]
-    bidx = np.asarray(bidx)[:rn].astype(np.int64)
-    return "left_build", hit, bidx
+    if how == "inner":
+        hit, bidx, dup2 = _probe_kernel(lv, lm, rv, rm)
+        if not bool(dup2):
+            hit = np.asarray(hit)[:rn]
+            bidx = np.asarray(bidx)[:rn].astype(np.int64)
+            return "left_build", hit, bidx
+    # duplicate build keys on every usable orientation: N:M range join
+    return _range_join(rv, rm, lv, lm, ln, how)

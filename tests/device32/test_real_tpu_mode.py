@@ -336,18 +336,64 @@ class TestDeviceJoin:
         assert _counters(dev).get("device_join_probes", 0) > 0
         assert dev.to_pydict() == host.to_pydict()
 
-    def test_nm_join_falls_back_to_host(self, host_mode):
-        # duplicates on BOTH sides: device refuses, host must produce it
-        ldata = {"k": np.array([1, 1, 2], dtype=np.int64).repeat(4000)}
-        rdata = {"k2": np.array([1, 2, 2], dtype=np.int64).repeat(4000)}
+    @staticmethod
+    def _sorted_rows(df):
+        cols = df.to_pydict()
+        keys = sorted(cols)
+        return sorted(zip(*[cols[k] for k in keys]),
+                      key=lambda t: tuple((x is None, x) for x in t))
+
+    @pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+    def test_nm_join_runs_on_device(self, how, host_mode):
+        """Duplicate keys on BOTH sides (round-3 verdict item 7): the range
+        probe computes per-row match spans on device; the data-dependent
+        expansion happens on host."""
+        rng = np.random.RandomState(11)
+        ldata = {"k": rng.randint(0, 60, 5000).astype(np.int64),
+                 "lv": np.arange(5000, dtype=np.int64)}
+        rdata = {"k2": rng.randint(0, 80, 3000).astype(np.int64),
+                 "rv": np.arange(3000, dtype=np.int64)}
+        q = lambda: (dt.from_pydict(ldata)
+                     .join(dt.from_pydict(rdata), left_on="k", right_on="k2",
+                           how=how))
+        dev = q().collect()
+        with host_mode():
+            host = q().collect()
+        assert _counters(dev).get("device_join_probes", 0) > 0, how
+        assert self._sorted_rows(dev) == self._sorted_rows(host), how
+
+    def test_nm_join_null_keys_never_match(self, host_mode):
+        ks = [1, None, 2, 2, None, 1] * 800
+        rs = [2, 1, None, 1] * 700
+        q = lambda: (dt.from_pydict(
+            {"k": dt.Series.from_pylist(ks, "k", dt.DataType.int64())})
+            .join(dt.from_pydict(
+                {"k2": dt.Series.from_pylist(rs, "k2", dt.DataType.int64())}),
+                left_on="k", right_on="k2", how="left"))
+        dev = q().collect()
+        with host_mode():
+            host = q().collect()
+        assert _counters(dev).get("device_join_probes", 0) > 0
+        assert self._sorted_rows(dev) == self._sorted_rows(host)
+
+    def test_nm_join_100k_rows(self, host_mode):
+        """The verdict's scale criterion: two 100k-row frames joining on
+        device with device_join_probes > 0 (bounded multiplicity so the
+        output stays ~400k rows)."""
+        rng = np.random.RandomState(13)
+        n = 100_000
+        ldata = {"k": rng.randint(0, n // 4, n).astype(np.int64),
+                 "lv": np.arange(n, dtype=np.int64)}
+        rdata = {"k2": rng.randint(0, n // 4, n).astype(np.int64),
+                 "rv": np.arange(n, dtype=np.int64)}
         q = lambda: (dt.from_pydict(ldata)
                      .join(dt.from_pydict(rdata), left_on="k", right_on="k2"))
         dev = q().collect()
         with host_mode():
             host = q().collect()
-        assert _counters(dev).get("device_join_probes", 0) == 0
-        assert _counters(dev).get("host_joins", 0) > 0
-        assert len(dev.to_pydict()["k"]) == len(host.to_pydict()["k"])
+        assert _counters(dev).get("device_join_probes", 0) > 0
+        d, h = self._sorted_rows(dev), self._sorted_rows(host)
+        assert len(d) == len(h) and d == h
 
     def test_null_keys_never_match(self, host_mode):
         ldata = {"fk": [1, None, 3] * 4000, "lv": list(range(12_000))}
