@@ -1,0 +1,167 @@
+"""LAION-style multimodal benchmark rung (BASELINE.md config:
+url.download -> image.decode -> image.resize(224,224) -> tensor).
+
+Images are served by a local HTTP server (the zero-egress stand-in for the
+reference's S3-hosted LAION shards, mirroring tests' mock-server
+discipline); the engine pipeline downloads max_connections-wide, decodes on
+host (codecs are host-side, like the reference's `image` crate), then runs
+the resize as ONE batched (N,H,W,C) jax.image.resize program on the
+accelerator. The oracle is hand-written host code running the SAME
+algorithm (concurrent GET + PIL decode + batched jax resize), so
+vs_baseline isolates engine overhead rather than algorithm differences.
+
+Reference role-equivalents: src/daft-core/src/array/ops/image.rs (1,032
+LoC) + src/daft-functions/src/uri/download.rs.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import io
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Tuple
+
+import numpy as np
+
+
+def make_jpegs(n: int, size: int = 96, seed: int = 0) -> List[bytes]:
+    """n random RGB JPEGs of size x size (piecewise-constant blocks so JPEG
+    compresses realistically instead of as noise)."""
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        blocks = rng.randint(0, 256, (6, 6, 3), dtype=np.uint8)
+        a = np.kron(blocks, np.ones((size // 6 + 1, size // 6 + 1, 1),
+                                    dtype=np.uint8))[:size, :size]
+        buf = io.BytesIO()
+        Image.fromarray(a).save(buf, format="JPEG", quality=85)
+        out.append(buf.getvalue())
+    return out
+
+
+class _ImageHandler(BaseHTTPRequestHandler):
+    images: List[bytes] = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        try:
+            idx = int(self.path.strip("/").split(".")[0])
+            body = _ImageHandler.images[idx]
+        except (ValueError, IndexError):
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def serve(images: List[bytes]) -> Tuple[ThreadingHTTPServer, List[str]]:
+    """Serve `images` at /i.jpg; returns (server, urls). Caller shuts down."""
+    _ImageHandler.images = images
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _ImageHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    return server, [f"{base}/{i}.jpg" for i in range(len(images))]
+
+
+def run_pipeline(urls: List[str], src_size: int, out_size: int = 224,
+                 max_connections: int = 32):
+    """The engine pipeline under measurement; returns the collected frame."""
+    import daft_tpu as dt
+    from daft_tpu import col
+
+    df = dt.from_pydict({"url": urls})
+    q = (df.select(col("url").url.download(
+            max_connections=max_connections).alias("data"))
+         .select(col("data").image.decode(mode="RGB").alias("img"))
+         .select(col("img").cast(
+             dt.DataType.image("RGB", src_size, src_size)).alias("fimg"))
+         .select(col("fimg").image.resize(out_size, out_size).alias("r"))
+         .select(col("r").cast(dt.DataType.tensor(
+             dt.DataType.uint8(), (out_size, out_size, 3))).alias("t")))
+    return q.collect()
+
+
+def frame_tensors(collected, out_size: int = 224) -> np.ndarray:
+    """(N, out, out, 3) uint8 from the collected pipeline frame."""
+    rows = collected.to_pydict()["t"]
+    return np.asarray(rows, dtype=np.uint8).reshape(
+        len(rows), out_size, out_size, 3)
+
+
+def oracle(urls: List[str], out_size: int = 224,
+           max_connections: int = 32) -> np.ndarray:
+    """Hand-written same-algorithm baseline: concurrent urllib GET, PIL
+    decode to RGB, ONE batched jax.image.resize, round/clip to uint8."""
+    import urllib.request
+
+    from PIL import Image
+
+    import jax
+    import jax.numpy as jnp
+
+    raw: List[bytes] = [b""] * len(urls)
+    with concurrent.futures.ThreadPoolExecutor(max_connections) as ex:
+        futs = {ex.submit(lambda u: urllib.request.urlopen(u).read(), u): i
+                for i, u in enumerate(urls)}
+        for f in concurrent.futures.as_completed(futs):
+            raw[futs[f]] = f.result()
+    arrs = [np.asarray(Image.open(io.BytesIO(b)).convert("RGB")) for b in raw]
+    batch = np.stack(arrs).astype(np.float32)
+    r = jax.image.resize(jnp.asarray(batch),
+                         (len(arrs), out_size, out_size, 3), method="bilinear")
+    r = np.asarray(jax.device_get(r))
+    return np.clip(np.rint(r), 0, 255).astype(np.uint8)
+
+
+def run_rung(n: int = 1000, src_size: int = 96, out_size: int = 224,
+             best_of: int = 2) -> dict:
+    """Measure the pipeline; returns {laion_device_rows_per_sec,
+    laion_vs_baseline, ...} extras, parity-gated like every bench rung
+    (value keys are 0.0 on parity failure)."""
+    import time
+
+    images = make_jpegs(n, size=src_size)
+    server, urls = serve(images)
+    try:
+        got = frame_tensors(run_pipeline(urls, src_size, out_size), out_size)
+        want = oracle(urls, out_size)
+        # same algorithm on possibly different backends: allow rounding
+        # wobble of +-1 on a tiny fraction of pixels
+        diff = np.abs(got.astype(np.int16) - want.astype(np.int16))
+        if float(diff.mean()) > 0.5 or int(diff.max()) > 2:
+            return {"laion_device_rows_per_sec": 0.0,
+                    "laion_vs_baseline": 0.0,
+                    "laion_error": "parity_mismatch"}
+
+        def time_best(fn):
+            best = float("inf")
+            for _ in range(best_of):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t_eng = time_best(lambda: run_pipeline(urls, src_size, out_size))
+        t_orc = time_best(lambda: oracle(urls, out_size))
+        return {"laion_device_rows_per_sec": round(n / t_eng, 1),
+                "laion_vs_baseline": round(t_orc / t_eng, 3),
+                "laion_rows": n}
+    finally:
+        shutdown(server)
+
+
+def shutdown(server) -> None:
+    """Stop serving AND release the listening socket + pinned image bytes
+    (shutdown() alone leaks the fd and the served list for the rest of a
+    long-running bench process)."""
+    server.shutdown()
+    server.server_close()
+    _ImageHandler.images = []

@@ -42,8 +42,11 @@ from .device import is_device_dtype, size_bucket, stage_table_columns
 @functools.partial(jax.jit, static_argnames=())
 def _range_probe_kernel(build_vals, build_valid, probe_vals, probe_valid):
     """Per-probe-row match RANGE over the sorted build keys: (lo [P], counts
-    [P], perm [B]). Handles duplicate build keys (N:M joins) — the match set
-    of probe row i is perm[lo[i] : lo[i] + counts[i]], valid lanes only.
+    [P], perm [B], dup). The ONE sort serves both probe flavors — when dup
+    (duplicate valid build keys) is False every count is <= 1, so the PK
+    outputs are hit = counts > 0 and build row perm[lo] (_pk_outputs);
+    otherwise the match set of probe row i is perm[lo[i] : lo[i]+counts[i]],
+    valid lanes only, expanded on host.
 
     Valid lanes sort before null/padding lanes within an equal-key run
     (lexsort secondary key), so each run's valid matches are a contiguous
@@ -56,20 +59,30 @@ def _range_probe_kernel(build_vals, build_valid, probe_vals, probe_valid):
     perm = jnp.lexsort((~build_valid, k))
     sk = k[perm]
     sorted_valid = build_valid[perm]
+    dup = jnp.any((sk[1:] == sk[:-1]) & sorted_valid[1:] & sorted_valid[:-1])
     vp = jnp.concatenate([jnp.zeros(1, jnp.int32),
                           jnp.cumsum(sorted_valid.astype(jnp.int32))])
     lo = jnp.searchsorted(sk, probe_vals, side="left").astype(jnp.int32)
     hi = jnp.searchsorted(sk, probe_vals, side="right").astype(jnp.int32)
     counts = jnp.where(probe_valid, vp[hi] - vp[lo], 0)
-    return lo, counts, perm.astype(jnp.int32)
+    return lo, counts, perm.astype(jnp.int32), dup
 
 
-def _range_join(rv, rm, lv, lm, ln: int, how: str):
-    """N:M join (duplicate build keys): device range probe + vectorized host
-    expansion. Returns the executor contract — ("right_build", hit, _) for
-    semi/anti (only the hit mask is consumed), or ("expanded", lidx, ridx)
-    index pairs for inner/left (ridx == -1 marks a left-outer miss)."""
-    lo_d, counts_d, perm_d = _range_probe_kernel(rv, rm, lv, lm)
+@functools.partial(jax.jit, static_argnames=())
+def _pk_outputs(lo, counts, perm):
+    """PK-build view of the range probe (dup == False): per-probe-row
+    (hit, build_row_idx), computed on device so the host fetches the same
+    two probe-sized arrays the dedicated PK kernel used to produce."""
+    b = perm.shape[0]
+    return counts > 0, perm[jnp.minimum(lo, b - 1)]
+
+
+def _range_join(lo_d, counts_d, perm_d, ln: int, how: str):
+    """N:M join (duplicate build keys): vectorized host expansion of the
+    device range probe. Returns the executor contract — ("right_build",
+    hit, _) for semi/anti (only the hit mask is consumed), or ("expanded",
+    lidx, ridx) index pairs for inner/left (ridx == -1 marks a left-outer
+    miss)."""
     lo = np.asarray(jax.device_get(lo_d))[:ln].astype(np.int64)
     counts = np.asarray(jax.device_get(counts_d))[:ln].astype(np.int64)
     perm = np.asarray(jax.device_get(perm_d)).astype(np.int64)
@@ -87,24 +100,6 @@ def _range_join(rv, rm, lv, lm, ln: int, how: str):
     if how != "inner":
         ridx = np.where(np.repeat(hit, ce), ridx, -1)
     return "expanded", lidx, ridx
-
-
-@functools.partial(jax.jit, static_argnames=())
-def _probe_kernel(build_vals, build_valid, probe_vals, probe_valid):
-    """(hit [P], build_idx [P], dup_flag) — sentinel-free via validity masks."""
-    big = jnp.iinfo(build_vals.dtype).max
-    k = jnp.where(build_valid, build_vals, big)  # nulls+padding sort to the end
-    # among equal keys, valid lanes first: a real key == INT_MAX must not be
-    # shadowed by a null-sentinel lane at the same value
-    perm = jnp.lexsort((~build_valid, k))
-    sk = k[perm]
-    sorted_valid = build_valid[perm]
-    # duplicate VALID keys anywhere -> not a PK side, host must handle
-    dup = jnp.any((sk[1:] == sk[:-1]) & sorted_valid[1:] & sorted_valid[:-1])
-    pos = jnp.clip(jnp.searchsorted(sk, probe_vals), 0, sk.shape[0] - 1)
-    bidx = perm[pos]
-    hit = (sk[pos] == probe_vals) & probe_valid & build_valid[bidx]
-    return hit, bidx.astype(jnp.int32), dup
 
 
 def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
@@ -350,17 +345,21 @@ def device_join_indices(left_table, right_table, left_keys, right_keys,
 
 
 def _probe_both_ways(lv, lm, rv, rm, ln: int, rn: int, how: str):
-    # try build=right first (probe order == host output order)
-    hit, bidx, dup = _probe_kernel(rv, rm, lv, lm)
+    # build=right first (probe order == host output order); ONE sort serves
+    # whichever path the dup flag selects
+    lo, counts, perm, dup = _range_probe_kernel(rv, rm, lv, lm)
     if not bool(dup):
-        hit = np.asarray(hit)[:ln]
-        bidx = np.asarray(bidx)[:ln].astype(np.int64)
+        hit, bidx = _pk_outputs(lo, counts, perm)
+        hit = np.asarray(jax.device_get(hit))[:ln]
+        bidx = np.asarray(jax.device_get(bidx))[:ln].astype(np.int64)
         return "right_build", hit, bidx
     if how == "inner":
-        hit, bidx, dup2 = _probe_kernel(lv, lm, rv, rm)
+        lo2, counts2, perm2, dup2 = _range_probe_kernel(lv, lm, rv, rm)
         if not bool(dup2):
-            hit = np.asarray(hit)[:rn]
-            bidx = np.asarray(bidx)[:rn].astype(np.int64)
+            hit, bidx = _pk_outputs(lo2, counts2, perm2)
+            hit = np.asarray(jax.device_get(hit))[:rn]
+            bidx = np.asarray(jax.device_get(bidx))[:rn].astype(np.int64)
             return "left_build", hit, bidx
-    # duplicate build keys on every usable orientation: N:M range join
-    return _range_join(rv, rm, lv, lm, ln, how)
+    # duplicate build keys on every usable orientation: N:M range join,
+    # reusing the right-build probe already on device
+    return _range_join(lo, counts, perm, ln, how)

@@ -1,0 +1,43 @@
+"""The LAION multimodal bench rung (BASELINE.md config) — small-n smoke:
+pipeline runs end-to-end through the mock image server, parity vs the
+same-algorithm oracle holds, and the metric extras are well-formed."""
+
+import numpy as np
+
+from benchmarks import laion
+
+
+def test_rung_end_to_end():
+    out = laion.run_rung(n=24, src_size=48, out_size=64, best_of=1)
+    assert "laion_error" not in out, out
+    assert out["laion_device_rows_per_sec"] > 0
+    assert out["laion_vs_baseline"] > 0
+    assert out["laion_rows"] == 24
+
+
+def test_pipeline_tensors_match_oracle():
+    images = laion.make_jpegs(10, size=48, seed=3)
+    server, urls = laion.serve(images)
+    try:
+        got = laion.frame_tensors(
+            laion.run_pipeline(urls, 48, out_size=32), out_size=32)
+        want = laion.oracle(urls, out_size=32)
+        assert got.shape == want.shape == (10, 32, 32, 3)
+        diff = np.abs(got.astype(np.int16) - want.astype(np.int16))
+        assert float(diff.mean()) <= 0.5 and int(diff.max()) <= 2
+    finally:
+        laion.shutdown(server)
+
+
+def test_nonuniform_source_sizes_rejected_cleanly():
+    """A decode that yields a size different from the declared fixed shape
+    must raise (cast guard), not silently corrupt the batch."""
+    import pytest
+
+    images = laion.make_jpegs(4, size=48)
+    server, urls = laion.serve(images)
+    try:
+        with pytest.raises(Exception):
+            laion.run_pipeline(urls, src_size=64, out_size=32)
+    finally:
+        laion.shutdown(server)
