@@ -429,6 +429,19 @@ def _load_snapshot(metric: str) -> dict | None:
 def _host_fallback(scale: float) -> dict:
     """Accelerator unreachable for the whole round: honest value 0 with the
     full host-path rung set as extras for the post-mortem."""
+    import jax
+
+    # The tunnel is wedged by definition on this path: pin jax to CPU
+    # BEFORE anything can trigger backend init — the LAION rung's resize
+    # (and any stray jnp call) would otherwise block inside the PJRT
+    # client's C init where no Python signal can interrupt, losing the
+    # whole round's JSON line. (The image preloads jax pinned to
+    # 'axon,cpu'; the env var alone cannot override it.)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized: only possible if a device ran
+
     s = _Setup(scale)
     tpch = s.tpch
     tables, lineitem, frame, rows = s.tables, s.lineitem, s.frame, s.rows

@@ -164,6 +164,12 @@ class MeshExecutionContext(ExecutionContext):
         ncols = len(names)
         ship_lane = num > n  # receivers need the partition id to split
         devs = list(self.mesh.devices.flat)
+        # Multi-process (SPMD over DCN): every process runs this same control
+        # plane over the same data, but can only device_put onto its LOCAL
+        # devices — the global arrays assemble from addressable shards only
+        # (standard jax multihost staging).
+        my_proc = jax.process_index()
+        multiproc = any(d.process_index != my_proc for d in devs)
         # Per-device staging: stage one source shard at a time and device_put
         # it straight onto its mesh device.
         b_shards, v_shards, lane_shards = [], [], []
@@ -173,22 +179,25 @@ class MeshExecutionContext(ExecutionContext):
         col_dtypes = [None] * ncols
         try:
             for i, c in enumerate(chunks):
-                bm = np.zeros(r, dtype=np.int32)
-                vm = np.zeros(r, dtype=bool)
-                bm[:len(c)] = dev_buckets[i]
-                vm[:len(c)] = True
-                b_shards.append(jax.device_put(bm[None], devs[i]))
-                v_shards.append(jax.device_put(vm[None], devs[i]))
-                if ship_lane:
-                    lm = np.zeros(r, dtype=np.int32)
-                    lm[:len(c)] = part_buckets[i]
-                    lane_shards.append(jax.device_put(lm[None], devs[i]))
+                local = devs[i].process_index == my_proc
+                if local:
+                    bm = np.zeros(r, dtype=np.int32)
+                    vm = np.zeros(r, dtype=bool)
+                    bm[:len(c)] = dev_buckets[i]
+                    vm[:len(c)] = True
+                    b_shards.append(jax.device_put(bm[None], devs[i]))
+                    v_shards.append(jax.device_put(vm[None], devs[i]))
+                    if ship_lane:
+                        lm = np.zeros(r, dtype=np.int32)
+                        lm[:len(c)] = part_buckets[i]
+                        lane_shards.append(jax.device_put(lm[None], devs[i]))
                 for j, name in enumerate(names):
                     vals, valid, _ = stage_np(c.get_column(name), r)
                     col_trailing[j] = tuple(vals.shape[1:])
                     col_dtypes[j] = vals.dtype
-                    col_shards[j].append(jax.device_put(vals[None], devs[i]))
-                    null_shards[j].append(jax.device_put(valid[None], devs[i]))
+                    if local:
+                        col_shards[j].append(jax.device_put(vals[None], devs[i]))
+                        null_shards[j].append(jax.device_put(valid[None], devs[i]))
         except ValueError:
             # stage_np rejects e.g. int64 values outside int32 range when x64
             # is off (real-TPU mode): fall back to the host shuffle, same as
@@ -208,34 +217,55 @@ class MeshExecutionContext(ExecutionContext):
         if ship_lane:
             dev_args.append(self._shard_onto_devices(lane_shards, (), r))
         out = fn(*dev_args)
-        # Per-partition row counts computed ON DEVICE: one tiny [n(, num)]
-        # fetch instead of pulling the full [n, n, cap] valid/lane matrices
-        # through the host link (which the tunnel's fixed fetch latency makes
-        # the dominant cost of small shuffles).
         import jax.numpy as jnp
 
-        if ship_lane:
-            def _cnts(v, l):
-                def per_dev(vv, ll):
-                    lanes = jnp.where(vv.reshape(-1), ll.reshape(-1), num)
-                    return jnp.bincount(lanes, length=num + 1)[:num]
-                return jax.vmap(per_dev)(v, l)
+        if multiproc:
+            # SPMD materialization: every process needs every output
+            # partition to continue the (duplicated) host control plane, so
+            # the exchanged slabs allgather across processes — this IS the
+            # DCN data movement (jax.experimental.multihost_utils), the
+            # role the reference's Ray object store plays across nodes.
+            from jax.experimental import multihost_utils
 
-            cnts = np.asarray(jax.device_get(
-                jax.jit(_cnts)(out[0], out[1 + 2 * ncols])))  # [n, num]
+            gathered = [np.asarray(multihost_utils.process_allgather(
+                o, tiled=True)) for o in out]
+            valid_all = gathered[0]
+            lane_all = gathered[1 + 2 * ncols] if ship_lane else None
+            if ship_lane:
+                cnts = np.stack([
+                    np.bincount(lane_all[d].reshape(-1)[
+                        valid_all[d].reshape(-1)], minlength=num)[:num]
+                    for d in range(n)])
+            else:
+                cnts = valid_all.sum(axis=(1, 2))
+
+            def _slab(idx: int, d: int):
+                return gathered[idx][d]
         else:
-            cnts = np.asarray(jax.device_get(
-                jax.jit(lambda v: jnp.sum(v, axis=(1, 2)))(out[0])))  # [n]
+            # Per-partition row counts computed ON DEVICE: one tiny
+            # [n(, num)] fetch instead of pulling the full [n, n, cap]
+            # valid/lane matrices through the host link (which the tunnel's
+            # fixed fetch latency makes the dominant cost of small shuffles).
+            if ship_lane:
+                def _cnts(v, l):
+                    def per_dev(vv, ll):
+                        lanes = jnp.where(vv.reshape(-1), ll.reshape(-1), num)
+                        return jnp.bincount(lanes, length=num + 1)[:num]
+                    return jax.vmap(per_dev)(v, l)
 
-        def shards_by_dev(garr):
-            """device -> its [1, ...] shard of a mesh-sharded global array."""
-            m = {s.device: s.data for s in garr.addressable_shards}
-            return [m[d] for d in devs]
+                cnts = np.asarray(jax.device_get(
+                    jax.jit(_cnts)(out[0], out[1 + 2 * ncols])))  # [n, num]
+            else:
+                cnts = np.asarray(jax.device_get(
+                    jax.jit(lambda v: jnp.sum(v, axis=(1, 2)))(out[0])))  # [n]
 
-        valid_shards = shards_by_dev(out[0])
-        col_dev = [shards_by_dev(out[1 + j]) for j in range(ncols)]
-        null_dev = [shards_by_dev(out[1 + ncols + j]) for j in range(ncols)]
-        lane_dev = shards_by_dev(out[1 + 2 * ncols]) if ship_lane else None
+            shard_maps = [
+                {s.device: s.data for s in garr.addressable_shards}
+                for garr in out]
+
+            def _slab(idx: int, d: int):
+                return shard_maps[idx][devs[d]][0]
+
         self.stats.bump("device_shuffles")
 
         # Unstage: per OUTPUT PARTITION, pack the received slab's real rows to
@@ -251,15 +281,15 @@ class MeshExecutionContext(ExecutionContext):
             d = b % n
             cnt = int(cnts[d, b]) if ship_lane else int(cnts[b])
             bucket = size_bucket(max(cnt, 1))
-            sel = valid_shards[d][0].reshape(-1)
+            sel = _slab(0, d).reshape(-1)
             if ship_lane:
-                sel = sel & (lane_dev[d][0].reshape(-1) == np.int32(b))
+                sel = sel & (_slab(1 + 2 * ncols, d).reshape(-1) == np.int32(b))
             series_out = []
             staged: List[DeviceColumn] = []
             for j, f in enumerate(schema):
-                flat = col_dev[j][d][0].reshape(
-                    (-1,) + tuple(col_dev[j][d].shape[3:]))
-                nulls = null_dev[j][d][0].reshape(-1)
+                slab = _slab(1 + j, d)
+                flat = slab.reshape((-1,) + tuple(slab.shape[2:]))
+                nulls = _slab(1 + ncols + j, d).reshape(-1)
                 pv, pn = _pack_slab(flat, nulls, sel, bucket)
                 dc = DeviceColumn(pv, pn, cnt, f.dtype)
                 staged.append(dc)

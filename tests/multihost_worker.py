@@ -73,3 +73,50 @@ for sv, sc in zip(rv.addressable_shards, rc.addressable_shards):
         f"device {d}: got {len(got)} rows, want {len(want)}")
 
 print(f"MULTIHOST_OK {pid}", flush=True)
+
+# ---------------------------------------------------------------------------
+# Full-plan DCN proof: TPC-H Q5 through the engine's MeshRunner on the
+# GLOBAL 2-process mesh (round-3 verdict item 8). Every process runs the
+# identical control plane (SPMD); the device exchange moves rows between
+# devices owned by different processes and allgathers the slabs back.
+# ---------------------------------------------------------------------------
+from benchmarks import tpch  # noqa: E402
+
+import daft_tpu as dtp  # noqa: E402
+from daft_tpu import col  # noqa: E402
+from daft_tpu.context import get_context  # noqa: E402
+from daft_tpu.runners import MeshRunner  # noqa: E402
+
+ctx = get_context()
+ctx._runner = MeshRunner(mesh=mesh)
+cfg = ctx.execution_config
+cfg.use_device_kernels = True
+cfg.device_min_rows = 1
+cfg.enable_result_cache = False
+# collective issue order must be identical across processes: keep the
+# dispatch loop single-threaded (SPMD discipline)
+cfg.executor_threads = 1
+
+tables = tpch.generate_tables(scale=0.02, seed=42)
+cust = dtp.from_arrow(tables["customer"]).repartition(4, "c_custkey").collect()
+orders = dtp.from_arrow(tables["orders"]).repartition(4, "o_custkey").collect()
+nat = dtp.from_arrow(tables["nation"]).collect()
+# numeric-only projection so the lineitem repartition rides the DEVICE
+# exchange (string payloads take the host shuffle, the documented split)
+line = (dtp.from_arrow(tables["lineitem"])
+        .select(col("l_orderkey"), col("l_extendedprice"), col("l_discount"))
+        .repartition(4, "l_orderkey"))
+
+q5 = tpch.q5(cust, orders, line, nat)
+got = q5.collect()
+shuffles = got.stats.snapshot()["counters"].get("device_shuffles", 0)
+assert shuffles >= 1, f"device exchange never engaged: {got.stats.snapshot()}"
+gd = got.to_pydict()
+want = tpch.oracle_q5(tables["customer"], tables["orders"],
+                      tables["lineitem"], tables["nation"])
+assert list(gd) == list(want), (list(gd), list(want))
+assert gd["n_name"] == want["n_name"], (gd, want)
+for a, b in zip(gd["revenue"], want["revenue"]):
+    assert abs(a - b) <= max(1e-5 * abs(b), 1e-6), (a, b)
+
+print(f"MULTIHOST_Q5_OK {pid} shuffles={shuffles}", flush=True)
