@@ -341,21 +341,28 @@ _CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
 _CMP_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}
 
 
-def _plain_string_column(node, schema) -> Optional[str]:
-    """Column name if `node` is a bare string Column (through Aliases) —
-    the only string-VALUED shape the device supports (codes decode at
-    unstage against that column's dictionary)."""
+def _plain_column(node, schema, pred) -> Optional[str]:
+    """Column name when `node` is a bare Column (through Aliases) whose
+    schema dtype satisfies `pred` — shared by the string-dictionary and
+    f64-sort-lane paths so 'what counts as a plain column' lives once."""
     from ..expressions import Alias, Column
 
     while isinstance(node, Alias):
         node = node.child
     if isinstance(node, Column):
         try:
-            if schema[node.cname].dtype.is_string():
+            if pred(schema[node.cname].dtype):
                 return node.cname
         except KeyError:
             return None
     return None
+
+
+def _plain_string_column(node, schema) -> Optional[str]:
+    """Bare string Column (through Aliases) — the only string-VALUED shape
+    the device supports (codes decode at unstage against that column's
+    dictionary)."""
+    return _plain_column(node, schema, lambda dt: dt.is_string())
 
 
 def _string_cmp_shape(node, schema):
@@ -1214,12 +1221,14 @@ def _sortable_bits(values: jax.Array, valid: jax.Array, descending: bool,
         # old inf-substitution made NaN TIE with real +inf.
         if width64:
             f = jnp.where(jnp.isnan(v), jnp.asarray(jnp.nan, v.dtype), v)
+            f = jnp.where(f == 0.0, jnp.zeros_like(f), f)  # -0.0 ties +0.0
             b = jax.lax.bitcast_convert_type(f, jnp.int64)
             bits = jnp.where(b < 0, jax.lax.bitcast_convert_type(~b, jnp.uint64),
                              jax.lax.bitcast_convert_type(b, jnp.uint64) ^ jnp.uint64(1 << 63))
         else:
             v32 = v.astype(jnp.float32)
             f = jnp.where(jnp.isnan(v32), jnp.asarray(jnp.nan, jnp.float32), v32)
+            f = jnp.where(f == 0.0, jnp.zeros_like(f), f)  # -0.0 ties +0.0
             b = jax.lax.bitcast_convert_type(f, jnp.int32)
             bits = jnp.where(b < 0, jax.lax.bitcast_convert_type(~b, jnp.uint32),
                              jax.lax.bitcast_convert_type(b, jnp.uint32) ^ jnp.uint32(1 << 31))
@@ -1236,6 +1245,53 @@ def _sortable_bits(values: jax.Array, valid: jax.Array, descending: bool,
     return [null_sel] + [jnp.where(valid, l, jnp.uint32(0)) for l in lanes]
 
 
+def _plain_f64_column(node, schema) -> Optional[str]:
+    """Bare float64 Column (through Aliases)."""
+    from ..datatypes import DataType
+
+    return _plain_column(node, schema,
+                         lambda dt: dt == DataType.float64())
+
+
+def _stage_f64_sort_lanes(table, cname: str, bucket: int,
+                          stage_cache: Optional[dict]):
+    """EXACT float64 sort key in 32-bit mode: the order-preserving bit
+    transform (sign-magnitude -> total order, canonical NaN above +inf)
+    applied to the full 64-bit pattern ON HOST, then split into (hi, lo)
+    uint32 lanes the device sort consumes as two consecutive keys. No
+    precision is lost — this removes the Q1-style money-sort fallback.
+    Cached with the partition like every staged column."""
+    key = ("__f64lanes__", cname, bucket)
+    cached = stage_cache.get(key) if stage_cache is not None else None
+    if cached is not None:
+        return cached
+    s = table.get_column(cname)
+    n = len(s)
+    arr = s.to_arrow()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    vals = np.asarray(pc.fill_null(arr, 0.0), dtype=np.float64)
+    # canonical positive quiet NaN: bit pattern above +inf -> NaN-greatest,
+    # matching _sortable_bits and arrow; -0.0 canonicalizes to +0.0 (arrow
+    # ties signed zeros under the stable sort — distinct bit patterns would
+    # order them and break the tiebreak parity)
+    vals = np.where(np.isnan(vals), np.float64("nan"), vals)
+    vals = np.where(vals == 0.0, np.float64(0.0), vals)
+    bits = vals.view(np.uint64)
+    flipped = np.where((bits >> np.uint64(63)) == 1, ~bits,
+                       bits ^ np.uint64(1 << 63))
+    if bucket > n:
+        flipped = np.concatenate([flipped,
+                                  np.zeros(bucket - n, dtype=np.uint64)])
+    hi = (flipped >> np.uint64(32)).astype(np.uint32)
+    lo = (flipped & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    out = (jnp.asarray(hi), jnp.asarray(lo),
+           jnp.asarray(_staged_validity(arr, n, bucket)))
+    if stage_cache is not None:
+        stage_cache[key] = out
+    return out
+
+
 def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
                          stage_cache: Optional[dict] = None):
     """Argsort indices for a Table computed ON DEVICE (keys staged/compiled
@@ -1247,40 +1303,81 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
     from ..table import _norm_flag
 
     n = len(table)
+    if n == 0:
+        return None
     keys = list(sort_keys)
     k = len(keys)
     desc = _norm_flag(descending, k, False)
     nf = _norm_flag(nulls_first, k, None)
+    f64_lane_keys: Dict[int, str] = {}
     if not x64_enabled():
-        # float64 keys would sort in float32: spurious ties reorder rows vs
-        # the host. Aggregations recover reduced precision via float64
-        # recombination; a sort cannot — reject BEFORE staging anything.
-        pre = normalize_and_check(keys, table.schema)
-        if pre is None:
+        # float64 keys must not sort in float32 (spurious ties reorder rows
+        # vs the host). PLAIN f64 columns sort exactly via host-split 64-bit
+        # lanes (_stage_f64_sort_lanes) — lossless, so they bypass the
+        # reduced-precision eligibility gate entirely; COMPUTED f64 keys
+        # would evaluate in f32 on device, so they decline to the host
+        # before staging anything.
+        from ..expressions import normalize_literals
+
+        try:
+            pre = [normalize_literals(e._node, table.schema) for e in keys]
+        except (ValueError, KeyError):
             return None
-        for nd in pre:
-            if nd.to_field(table.schema).dtype == DataType.float64():
+        for i, nd in enumerate(pre):
+            try:
+                dt_ = nd.to_field(table.schema).dtype
+            except (ValueError, KeyError):
                 return None
-    staged = _stage_and_run(table, keys, stage_cache)
-    if staged is None:
-        return None
-    outs, _, _, _ = staged
+            if dt_ == DataType.float64():
+                cname = _plain_f64_column(nd, table.schema)
+                if cname is None:
+                    return None
+                f64_lane_keys[i] = cname
+            elif not expr_is_device_compilable(nd, table.schema,
+                                               _normalized=True):
+                return None
+    entries: List = [None] * k
+    non_lane = [(i, e) for i, e in enumerate(keys) if i not in f64_lane_keys]
+    if non_lane:
+        staged = _stage_and_run(table, [e for _, e in non_lane], stage_cache)
+        if staged is None:
+            return None
+        outs, _, _, _ = staged
+        for (i, _), vm in zip(non_lane, outs):
+            entries[i] = vm
+    b = size_bucket(n)
+    for i, cname in f64_lane_keys.items():
+        entries[i] = _stage_f64_sort_lanes(table, cname, b, stage_cache)
     nf_resolved = [(f if f is not None else d) for f, d in zip(nf, desc)]
-    idx = device_argsort([(v, m) for v, m in outs], desc, nf_resolved, n)
+    idx = device_argsort(entries, desc, nf_resolved, n)
     return np.asarray(jax.device_get(idx))[:n]
 
 
-def device_argsort(key_cols: Sequence[Tuple[jax.Array, jax.Array]],
+def device_argsort(key_cols: Sequence[Tuple],
                    descending: Sequence[bool], nulls_first: Sequence[bool],
                    length: int) -> jax.Array:
-    """Stable multi-key argsort on device; padding rows sort to the very end."""
+    """Stable multi-key argsort on device; padding rows sort to the very end.
+    Each key is (values, valid) — bit-transformed by _sortable_bits — or an
+    exact pre-split (hi_u32, lo_u32, valid) lane triple (64-bit keys staged
+    in 32-bit mode)."""
     b = key_cols[0][0].shape[0]
     operands: List[jax.Array] = []
     inbounds = jnp.arange(b) < length
     pad_sel = jnp.where(inbounds, jnp.uint32(0), jnp.uint32(1))
     operands.append(pad_sel)  # padding rows after all real rows
-    for (v, m), d, nf in zip(key_cols, descending, nulls_first):
-        for lane in _sortable_bits(v, m, d, nf):
+    for entry, d, nf in zip(key_cols, descending, nulls_first):
+        if len(entry) == 3:
+            hi, lo, m = entry
+            # bitwise-not of the 64-bit pattern distributes across the split
+            lanes_ = [~hi, ~lo] if d else [hi, lo]
+            null_sel = jnp.where(m, jnp.uint32(1),
+                                 jnp.uint32(0 if nf else 2))
+            ops = [null_sel] + [jnp.where(m, l, jnp.uint32(0))
+                                for l in lanes_]
+        else:
+            v, m = entry
+            ops = _sortable_bits(v, m, d, nf)
+        for lane in ops:
             operands.append(jnp.where(inbounds, lane, jnp.uint32(0)))
     idx = jnp.arange(b, dtype=jnp.int32)
     out = jax.lax.sort(tuple(operands) + (idx,), num_keys=len(operands), is_stable=True)

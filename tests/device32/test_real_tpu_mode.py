@@ -678,14 +678,69 @@ class TestDeviceSort32:
         assert _counters(dev).get("device_sorts", 0) >= 1, _counters(dev)
         assert dev.to_pydict() == host.to_pydict()
 
-    def test_f64_sort_keys_fall_back_in_32bit_mode(self, host_mode):
-        # float64 keys staged as float32 could invent ties and reorder rows:
-        # the device sort must decline rather than diverge from the host
-        data = {"v": RNG.rand(10_000) * 1e6,
+    def test_f64_column_sort_keys_exact_on_device(self, host_mode):
+        """Plain float64 sort keys stage as EXACT 64-bit order-preserving
+        (hi, lo) uint32 lanes — no f32 narrowing, no spurious ties — so the
+        money sorts that used to fall back run on device (r3 verdict weak
+        item 6). Values include ties-by-f32 (distinguishable only in f64),
+        nulls, and both directions."""
+        base = RNG.rand(5000) * 1e6
+        vals = np.repeat(base, 2)
+        vals[1::2] += 1e-9  # f32-invisible, f64-significant difference
+        ks = vals.tolist()
+        ks[17] = None
+        ks[4021] = None
+        data = {"v": dt.Series.from_pylist(ks, "v", dt.DataType.float64()),
                 "t": RNG.randint(0, 9, 10_000).astype(np.int64)}
+
+        for desc in (False, True):
+            def q():
+                return dt.from_pydict(data).sort(["v", "t"],
+                                                 desc=[desc, False])
+
+            dev, host = _run_both(q, host_mode)
+            assert _counters(dev).get("device_sorts", 0) >= 1, _counters(dev)
+            assert dev.to_pydict() == host.to_pydict(), f"desc={desc}"
+
+    def test_signed_zero_ties_like_host(self, host_mode):
+        """Arrow's stable sort ties -0.0 with +0.0; distinct bit patterns
+        would order them and break the tiebreak — both the f64 lane staging
+        and the on-device float lanes canonicalize -0.0."""
+        data = {"v": np.array([0.0, -0.0, 1.0, -0.0, 0.0] * 400),
+                "t": np.arange(2000, dtype=np.int64)}
 
         def q():
             return dt.from_pydict(data).sort(["v", "t"])
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_sorts", 0) >= 1
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_f64_lane_sort_without_reduced_precision(self, host_mode):
+        """The exact lane path is lossless, so it must run even when
+        device_reduced_precision is OFF (the precision-paranoid config is
+        exactly the one that wants the exact sort)."""
+        cfg = get_context().execution_config
+        saved = cfg.device_reduced_precision
+        cfg.device_reduced_precision = False
+        try:
+            data = {"v": RNG.rand(4000) * 1e6}
+
+            def q():
+                return dt.from_pydict(data).sort("v")
+
+            dev, host = _run_both(q, host_mode)
+            assert _counters(dev).get("device_sorts", 0) >= 1, _counters(dev)
+            assert dev.to_pydict() == host.to_pydict()
+        finally:
+            cfg.device_reduced_precision = saved
+
+    def test_computed_f64_sort_key_falls_back(self, host_mode):
+        # a COMPUTED f64 key would evaluate in f32 on device: must decline
+        data = {"v": RNG.rand(8000) * 1e6}
+
+        def q():
+            return dt.from_pydict(data).sort((col("v") * 1.0000001).alias("k"))
 
         dev, host = _run_both(q, host_mode)
         assert _counters(dev).get("device_sorts", 0) == 0, _counters(dev)
