@@ -293,9 +293,9 @@ def _matches(branch, value) -> bool:
     return True
 
 
-def write_avro_file(path: str, schema: dict, records: List[dict],
-                    meta: Optional[Dict[str, bytes]] = None) -> None:
-    """Write records as one null-codec OCF block (plenty for manifests)."""
+def encode_avro_bytes(schema: dict, records: List[dict],
+                      meta: Optional[Dict[str, bytes]] = None) -> bytes:
+    """Records as one null-codec OCF block (plenty for manifests)."""
     w = _Writer()
     w.write(MAGIC)
     m = {"avro.schema": json.dumps(schema).encode(), "avro.codec": b"null"}
@@ -315,5 +315,10 @@ def write_avro_file(path: str, schema: dict, records: List[dict],
     w.write_long(len(data))
     w.write(data)
     w.write(sync)
+    return w.out.getvalue()
+
+
+def write_avro_file(path: str, schema: dict, records: List[dict],
+                    meta: Optional[Dict[str, bytes]] = None) -> None:
     with open(path, "wb") as f:
-        f.write(w.out.getvalue())
+        f.write(encode_avro_bytes(schema, records, meta))

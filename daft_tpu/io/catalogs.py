@@ -161,22 +161,21 @@ def _metadata_version_of(name: str) -> int:
 
 def _iceberg_metadata_path(table_uri: str) -> str:
     """Resolve the current metadata json (hadoop-catalog layout): honor
-    version-hint.text, else the highest-versioned *.metadata.json."""
-    mdir = os.path.join(table_uri, "metadata")
-    if not os.path.isdir(mdir):
+    version-hint.text, else the highest-versioned *.metadata.json. Works
+    over local paths and object-store uris alike (Storage)."""
+    mdir = STORAGE.join(table_uri, "metadata")
+    names = STORAGE.list_names(mdir)
+    if not names:
         raise DaftNotFoundError(f"not an Iceberg table (no metadata/): {table_uri}")
-    hint = os.path.join(mdir, "version-hint.text")
-    if os.path.exists(hint):
-        with open(hint) as f:
-            v = f.read().strip()
+    if "version-hint.text" in names:
+        v = STORAGE.get(STORAGE.join(mdir, "version-hint.text")).decode().strip()
         for cand in (f"v{v}.metadata.json", f"{v}.metadata.json"):
-            p = os.path.join(mdir, cand)
-            if os.path.exists(p):
-                return p
-    metas = [f for f in os.listdir(mdir) if f.endswith(".metadata.json")]
+            if cand in names:
+                return STORAGE.join(mdir, cand)
+    metas = [f for f in names if f.endswith(".metadata.json")]
     if not metas:
         raise DaftNotFoundError(f"Iceberg table has no metadata json: {table_uri}")
-    return os.path.join(mdir, max(metas, key=_metadata_version_of))
+    return STORAGE.join(mdir, max(metas, key=_metadata_version_of))
 
 
 def _iceberg_resolve(table_uri: str, uri: str) -> str:
@@ -186,16 +185,30 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
     p = uri
     if p.startswith("file://"):
         p = p[len("file://"):]
-    if os.path.exists(p):
+    # in-place tables (the common case): manifest paths already live under
+    # the current location — skip the per-file existence probe, which on
+    # object stores would cost one HEAD round-trip per manifest/data file
+    if p.startswith(str(table_uri).rstrip("/") + "/"):
+        return p
+    if STORAGE.exists(p):
         return p
     # remap by the stable tail: .../metadata/<x> or .../data/<x>
     for anchor in ("/metadata/", "/data/"):
         if anchor in p:
             # rsplit: the table's ORIGINAL location may itself contain
             # /data/ or /metadata/ segments
-            return os.path.join(table_uri, anchor.strip("/"),
+            return STORAGE.join(table_uri, anchor.strip("/"),
                                 p.rsplit(anchor, 1)[1])
-    return os.path.join(table_uri, os.path.basename(p))
+    return STORAGE.join(table_uri, p.rsplit("/", 1)[-1])
+
+
+def _read_avro_any(path: str):
+    """read_avro_file over local paths AND object-store uris."""
+    from .avro import read_avro_bytes, read_avro_file
+
+    if STORAGE.is_remote(path):
+        return read_avro_bytes(STORAGE.get(path))
+    return read_avro_file(path)
 
 
 def read_iceberg_scan(table_uri: str, snapshot_id: Optional[int] = None):
@@ -204,11 +217,8 @@ def read_iceberg_scan(table_uri: str, snapshot_id: Optional[int] = None):
     daft/iceberg/iceberg_scan.py:84, which delegates to pyiceberg; here the
     avro manifests are decoded natively like catalogs.py's Delta log replay).
     Merge-on-read delete files are rejected (copy-on-write tables only)."""
-    from .avro import read_avro_file
-
     meta_path = _iceberg_metadata_path(table_uri)
-    with open(meta_path) as f:
-        meta = json.load(f)
+    meta = json.loads(STORAGE.get(meta_path))
     snaps = meta.get("snapshots") or []
     sid = snapshot_id if snapshot_id is not None else meta.get("current-snapshot-id")
     snap = next((s for s in snaps if s.get("snapshot-id") == sid), None)
@@ -222,12 +232,12 @@ def read_iceberg_scan(table_uri: str, snapshot_id: Optional[int] = None):
     data_files: List[dict] = []
     if snap is not None:
         if snap.get("manifest-list"):
-            _, mlist = read_avro_file(_iceberg_resolve(table_uri, snap["manifest-list"]))
+            _, mlist = _read_avro_any(_iceberg_resolve(table_uri, snap["manifest-list"]))
             manifest_paths = [m["manifest_path"] for m in mlist]
         else:  # v1 inline manifests
             manifest_paths = list(snap.get("manifests") or [])
         for mp in manifest_paths:
-            _, entries = read_avro_file(_iceberg_resolve(table_uri, mp))
+            _, entries = _read_avro_any(_iceberg_resolve(table_uri, mp))
             for e in entries:
                 if e.get("status") == 2:  # deleted
                     continue
@@ -388,31 +398,32 @@ def write_iceberg_table(table_uri: str, arrow_tables: List[pa.Table],
     import time as _time
     import uuid as _uuid
 
+    import io as _io
+
     import pyarrow.parquet as papq
 
-    from .avro import read_avro_file, write_avro_file
+    from .avro import encode_avro_bytes
 
     if mode not in ("append", "overwrite", "error"):
         raise ValueError(f"invalid mode {mode!r}")
     if not arrow_tables:
         raise ValueError("write_iceberg needs at least one partition")
-    mdir = os.path.join(table_uri, "metadata")
-    ddir = os.path.join(table_uri, "data")
-    exists = os.path.isdir(mdir) and any(
-        f.endswith(".metadata.json") for f in os.listdir(mdir))
+    mdir = STORAGE.join(table_uri, "metadata")
+    ddir = STORAGE.join(table_uri, "data")
+    mdir_names = STORAGE.list_names(mdir)
+    exists = any(f.endswith(".metadata.json") for f in mdir_names)
     if exists and mode == "error":
         raise FileExistsError(f"Iceberg table already exists: {table_uri}")
-    os.makedirs(mdir, exist_ok=True)
-    os.makedirs(ddir, exist_ok=True)
+    STORAGE.makedirs(mdir)
+    STORAGE.makedirs(ddir)
 
     prev_meta = None
     prev_version = 0
     prior_manifests: List[dict] = []
     if exists:
-        with open(_iceberg_metadata_path(table_uri)) as f:
-            prev_meta = json.load(f)
+        prev_meta = json.loads(STORAGE.get(_iceberg_metadata_path(table_uri)))
         prev_version = max(
-            (v for v in (_metadata_version_of(n) for n in os.listdir(mdir)
+            (v for v in (_metadata_version_of(n) for n in mdir_names
                          if n.endswith(".metadata.json")) if v >= 0),
             default=0)
         if mode == "append":
@@ -420,7 +431,7 @@ def write_iceberg_table(table_uri: str, arrow_tables: List[pa.Table],
             snap = next((s for s in (prev_meta.get("snapshots") or [])
                          if s.get("snapshot-id") == sid), None)
             if snap is not None and snap.get("manifest-list"):
-                _, raw = read_avro_file(
+                _, raw = _read_avro_any(
                     _iceberg_resolve(table_uri, snap["manifest-list"]))
                 # v1 manifest_file records predate the 'content' field (and
                 # may omit others): normalize so re-encoding under the v2
@@ -439,7 +450,7 @@ def write_iceberg_table(table_uri: str, arrow_tables: List[pa.Table],
                     resolved = _iceberg_resolve(table_uri, mp)
                     prior_manifests.append({
                         "manifest_path": mp,
-                        "manifest_length": os.path.getsize(resolved),
+                        "manifest_length": STORAGE.size(resolved),
                         "partition_spec_id": 0, "content": 0,
                         "added_snapshot_id": sid or 0})
 
@@ -449,31 +460,43 @@ def write_iceberg_table(table_uri: str, arrow_tables: List[pa.Table],
     commit_ts = int(_time.time() * 1000)
     added: List[str] = []
     entries: List[dict] = []
+    remote = STORAGE.is_remote(table_uri)
+    # written URIs carry the table's real scheme; local keeps the file://
+    # prefix the resolver strips (spec: absolute URIs in manifests)
+    uri_base = str(table_uri).rstrip("/") if remote else f"file://{table_uri}"
     for t in arrow_tables:
         if t.num_rows == 0:
             continue
         rel = f"data/{_uuid.uuid4()}.parquet"
-        full = os.path.join(table_uri, rel)
-        papq.write_table(t, full)
+        full = STORAGE.join(table_uri, rel)
+        if remote:
+            buf = _io.BytesIO()
+            papq.write_table(t, buf)
+            view = buf.getbuffer()
+            STORAGE.put(full, view)
+            size = len(view)
+        else:
+            papq.write_table(t, full)
+            size = os.path.getsize(full)
         added.append(full)
         entries.append({"status": 1, "snapshot_id": snapshot_id,
                         "data_file": {"content": 0,
-                                      "file_path": f"file://{table_uri}/{rel}",
+                                      "file_path": f"{uri_base}/{rel}",
                                       "file_format": "PARQUET", "partition": {},
                                       "record_count": t.num_rows,
-                                      "file_size_in_bytes": os.path.getsize(full)}})
+                                      "file_size_in_bytes": size}})
     manifest_rel = f"metadata/{_uuid.uuid4()}-m0.avro"
-    manifest_full = os.path.join(table_uri, manifest_rel)
-    write_avro_file(manifest_full, _MANIFEST_ENTRY_SCHEMA, entries)
+    manifest_bytes = encode_avro_bytes(_MANIFEST_ENTRY_SCHEMA, entries)
+    STORAGE.put(STORAGE.join(table_uri, manifest_rel), manifest_bytes)
     mlist_records = list(prior_manifests) if mode == "append" else []
     mlist_records.append({
-        "manifest_path": f"file://{table_uri}/{manifest_rel}",
-        "manifest_length": os.path.getsize(manifest_full),
+        "manifest_path": f"{uri_base}/{manifest_rel}",
+        "manifest_length": len(manifest_bytes),
         "partition_spec_id": 0, "content": 0,
         "added_snapshot_id": snapshot_id})
     mlist_rel = f"metadata/snap-{snapshot_id}.avro"
-    write_avro_file(os.path.join(table_uri, mlist_rel),
-                    _MANIFEST_LIST_SCHEMA, mlist_records)
+    STORAGE.put(STORAGE.join(table_uri, mlist_rel),
+                encode_avro_bytes(_MANIFEST_LIST_SCHEMA, mlist_records))
 
     schema_src = next((t for t in arrow_tables if t.num_rows), arrow_tables[0])
     fields = [{"id": i + 1, "name": f.name, "type": _iceberg_type(f.type),
@@ -487,20 +510,16 @@ def write_iceberg_table(table_uri: str, arrow_tables: List[pa.Table],
         "snapshots": ((prev_meta or {}).get("snapshots") or []) + [{
             "snapshot-id": snapshot_id,
             "timestamp-ms": commit_ts,
-            "manifest-list": f"file://{table_uri}/{mlist_rel}"}],
+            "manifest-list": f"{uri_base}/{mlist_rel}"}],
         "schemas": [{"schema-id": 0, "type": "struct", "fields": fields}],
         "current-schema-id": 0,
         "partition-specs": [{"spec-id": 0, "fields": []}],
     }
-    meta_path = os.path.join(mdir, f"v{version}.metadata.json")
-    # put-if-absent commit: a concurrent writer racing to the same version loses
-    fd = os.open(meta_path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
-    try:
-        os.write(fd, json.dumps(meta).encode())
-    finally:
-        os.close(fd)
-    with open(os.path.join(mdir, "version-hint.text"), "w") as f:
-        f.write(str(version))
+    meta_path = STORAGE.join(mdir, f"v{version}.metadata.json")
+    # put-if-absent commit: a concurrent writer racing to the same version
+    # loses (O_EXCL locally, conditional put on object stores)
+    STORAGE.put_if_absent(meta_path, json.dumps(meta).encode())
+    STORAGE.put(STORAGE.join(mdir, "version-hint.text"), str(version).encode())
     return added
 
 

@@ -1333,9 +1333,8 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
                 if cname is None:
                     return None
                 f64_lane_keys[i] = cname
-            elif not expr_is_device_compilable(nd, table.schema,
-                                               _normalized=True):
-                return None
+            # non-f64 keys are vetted by _stage_and_run below — checking
+            # compilability here too would walk every tree twice per sort
     entries: List = [None] * k
     non_lane = [(i, e) for i, e in enumerate(keys) if i not in f64_lane_keys]
     if non_lane:

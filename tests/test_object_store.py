@@ -436,6 +436,22 @@ class TestRemoteWrites:
             "delta_tbl/_delta_log/00000000000000000001.json",
             "delta_tbl/_delta_log/00000000000000000002.json"]
 
+    def test_write_iceberg_roundtrip(self, s3_client, monkeypatch, mock_s3):
+        monkeypatch.setenv("AWS_ENDPOINT_URL", mock_s3)
+        uri = "s3://bkt/ice_tbl"
+        dt.from_pydict({"v": [1, 2]}).write_iceberg(uri)
+        dt.from_pydict({"v": [3]}).write_iceberg(uri, mode="append")
+        back = dt.read_iceberg(uri).sort("v").to_pydict()
+        assert back == {"v": [1, 2, 3]}
+        dt.from_pydict({"v": [9]}).write_iceberg(uri, mode="overwrite")
+        assert dt.read_iceberg(uri).to_pydict() == {"v": [9]}
+        # snapshot-versioned metadata committed put-if-absent
+        metas = sorted(k for (_b, k) in MockS3Handler.store
+                       if k.startswith("ice_tbl/metadata/")
+                       and k.endswith(".metadata.json"))
+        assert [m.rsplit("/", 1)[1] for m in metas] == [
+            "v1.metadata.json", "v2.metadata.json", "v3.metadata.json"]
+
     def test_write_csv_remote(self, s3_client, monkeypatch, mock_s3):
         monkeypatch.setenv("AWS_ENDPOINT_URL", mock_s3)
         dt.from_pydict({"a": [1, 2]}).write_csv("s3://bkt/csvout")
