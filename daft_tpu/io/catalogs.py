@@ -34,6 +34,7 @@ from ..datatypes import DataType
 from ..errors import DaftNotFoundError
 from ..schema import Field, Schema
 from .object_store import STORAGE
+from .writer import write_parquet_any
 from .scan import FileFormat, Pushdowns, ScanTask
 
 
@@ -190,7 +191,11 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
     # object stores would cost one HEAD round-trip per manifest/data file
     if p.startswith(str(table_uri).rstrip("/") + "/"):
         return p
-    if STORAGE.exists(p):
+    # a REMOTE path outside the current location is the relocated-table
+    # case: probing it would pay retried HEADs against a possibly
+    # unreachable/credential-less store per file — remap immediately (this
+    # writer only ever emits paths under the table root, like the reference)
+    if not STORAGE.is_remote(p) and STORAGE.exists(p):
         return p
     # remap by the stable tail: .../metadata/<x> or .../data/<x>
     for anchor in ("/metadata/", "/data/"):
@@ -203,12 +208,11 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
 
 
 def _read_avro_any(path: str):
-    """read_avro_file over local paths AND object-store uris."""
-    from .avro import read_avro_bytes, read_avro_file
+    """Avro OCF over local paths AND object-store uris (Storage.get
+    handles both)."""
+    from .avro import read_avro_bytes
 
-    if STORAGE.is_remote(path):
-        return read_avro_bytes(STORAGE.get(path))
-    return read_avro_file(path)
+    return read_avro_bytes(STORAGE.get(path))
 
 
 def read_iceberg_scan(table_uri: str, snapshot_id: Optional[int] = None):
@@ -398,10 +402,6 @@ def write_iceberg_table(table_uri: str, arrow_tables: List[pa.Table],
     import time as _time
     import uuid as _uuid
 
-    import io as _io
-
-    import pyarrow.parquet as papq
-
     from .avro import encode_avro_bytes
 
     if mode not in ("append", "overwrite", "error"):
@@ -469,15 +469,7 @@ def write_iceberg_table(table_uri: str, arrow_tables: List[pa.Table],
             continue
         rel = f"data/{_uuid.uuid4()}.parquet"
         full = STORAGE.join(table_uri, rel)
-        if remote:
-            buf = _io.BytesIO()
-            papq.write_table(t, buf)
-            view = buf.getbuffer()
-            STORAGE.put(full, view)
-            size = len(view)
-        else:
-            papq.write_table(t, full)
-            size = os.path.getsize(full)
+        size = write_parquet_any(full, t)
         added.append(full)
         entries.append({"status": 1, "snapshot_id": snapshot_id,
                         "data_file": {"content": 0,
@@ -565,11 +557,8 @@ def write_deltalake_table(table_uri: str, arrow_tables: List[pa.Table],
     write_deltalake). Works against local paths and s3:// uris alike; all
     bytes ride Storage/IOClient. mode: append | overwrite | error. Returns
     the added file paths."""
-    import io as _io
     import time as _time
     import uuid as _uuid
-
-    import pyarrow.parquet as papq
 
     if mode not in ("append", "overwrite", "error"):
         raise ValueError(f"invalid mode {mode!r}")
@@ -621,17 +610,7 @@ def write_deltalake_table(table_uri: str, arrow_tables: List[pa.Table],
             continue
         rel = f"part-{len(added):05d}-{_uuid.uuid4()}.parquet"
         full = STORAGE.join(table_uri, rel)
-        if STORAGE.is_remote(full):
-            buf = _io.BytesIO()
-            papq.write_table(t, buf)
-            view = buf.getbuffer()  # zero-copy; no second full-file copy
-            STORAGE.put(full, view)
-            size = len(view)
-        else:
-            lp = STORAGE._local(full)
-            os.makedirs(os.path.dirname(lp), exist_ok=True)
-            papq.write_table(t, lp)  # stream to disk, no RAM buffering
-            size = os.path.getsize(lp)
+        size = write_parquet_any(full, t)
         actions.append({"add": {
             "path": rel, "partitionValues": {},
             "size": size, "modificationTime": now_ms,

@@ -30,6 +30,25 @@ from .object_store import STORAGE
 TARGET_FILE_SIZE_BYTES = 512 * 1024 * 1024
 
 
+def write_parquet_any(path: str, arrow_tbl: pa.Table) -> int:
+    """ONE parquet file to a local path (streamed to disk) or an
+    object-store url (buffered once, zero-copy put); returns the encoded
+    byte size. Shared with the Delta/Iceberg data-file writers so the
+    buffer-vs-stream dispatch lives once."""
+    import os
+
+    if STORAGE.is_remote(path):
+        buf = io.BytesIO()
+        papq.write_table(arrow_tbl, buf)
+        view = buf.getbuffer()
+        STORAGE.put(path, view)
+        return len(view)
+    lp = STORAGE._local(path)
+    os.makedirs(os.path.dirname(lp) or ".", exist_ok=True)
+    papq.write_table(arrow_tbl, lp)
+    return os.path.getsize(lp)
+
+
 def _encode_to(sink, arrow_tbl: pa.Table, format: str,
                compression: Optional[str]) -> None:
     """`sink` is a path (streams to disk) or a file-like (buffers)."""

@@ -365,6 +365,135 @@ def _plain_string_column(node, schema) -> Optional[str]:
     return _plain_column(node, schema, lambda dt: dt.is_string())
 
 
+def _plain_epoch_column(node, schema) -> Optional[str]:
+    """Bare timestamp/duration/time Column (through Aliases) — 64-bit epoch
+    kinds that cannot narrow to int32 but CAN compare/sort exactly via
+    order-preserving (hi, lo) uint32 lane splits in 32-bit mode."""
+    return _plain_column(node, schema, lambda dt: dt.kind in _EPOCH_KINDS)
+
+
+def _epoch_cmp_shape(node, schema):
+    """(colname, literal_value, flipped, col_dtype) when `node` compares a
+    plain epoch Column against a literal (either side) — compiled in 32-bit
+    mode as a two-lane unsigned comparison over split epoch bits; in x64
+    mode the generic int64 path handles epochs already."""
+    from ..expressions import BinaryOp, Literal
+
+    if not (isinstance(node, BinaryOp) and node.op in _CMP_OPS):
+        return None
+
+    def lit_node(n):
+        return isinstance(n, Literal)
+
+    lcol = _plain_epoch_column(node.left, schema)
+    rcol = _plain_epoch_column(node.right, schema)
+    if lcol is not None and lit_node(node.right):
+        return lcol, node.right, False, schema[lcol].dtype
+    if rcol is not None and lit_node(node.left):
+        return rcol, node.left, True, schema[rcol].dtype
+    return None
+
+
+def _epoch_lane_keys(colname: str) -> Tuple[str, str]:
+    return (f"__epochlane__\x00{colname}\x00hi",
+            f"__epochlane__\x00{colname}\x00lo")
+
+
+def _epoch_lit_keys(colname: str, node_key) -> Tuple[str, str]:
+    base = f"__epochlit__\x00{colname}\x00{node_key}"
+    return base + "\x00hi", base + "\x00lo"
+
+
+def _epoch_bits_np(vals_i64: np.ndarray) -> np.ndarray:
+    """Order-preserving uint64 view of int64 epochs (two's-complement ->
+    unsigned total order via sign-bit flip)."""
+    return vals_i64.astype(np.int64).view(np.uint64) ^ np.uint64(1 << 63)
+
+
+def _stage_epoch_lanes(table, cname: str, bucket: int,
+                       stage_cache: Optional[dict]):
+    """(hi u32, lo u32, valid) exact lanes of an epoch column for 32-bit
+    mode comparisons and sorts; cached with the partition."""
+    key = ("__epochlanes__", cname, bucket)
+    cached = stage_cache.get(key) if stage_cache is not None else None
+    if cached is not None:
+        return cached
+    s = table.get_column(cname)
+    n = len(s)
+    arr = s.to_arrow()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    vals = _physical_np(arr).astype(np.int64)
+    bits = _epoch_bits_np(vals)
+    if bucket > n:
+        bits = np.concatenate([bits, np.zeros(bucket - n, dtype=np.uint64)])
+    hi = (bits >> np.uint64(32)).astype(np.uint32)
+    lo = (bits & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    out = (jnp.asarray(hi), jnp.asarray(lo),
+           jnp.asarray(_staged_validity(arr, n, bucket)))
+    if stage_cache is not None:
+        stage_cache[key] = out
+    return out
+
+
+def collect_epoch_cmps(nodes, schema):
+    """Every epoch-comparison shape in the trees -> [(colname, lit_node)]."""
+    from ..expressions import BinaryOp
+
+    out = []
+
+    def walk(n):
+        if isinstance(n, BinaryOp):
+            shape = _epoch_cmp_shape(n, schema)
+            if shape is not None:
+                out.append((shape[0], shape[1]))
+        for c in n.children():
+            walk(c)
+
+    for nd in nodes:
+        walk(nd)
+    return out
+
+
+def epoch_cmp_env(nodes, schema, table, bucket: int,
+                  stage_cache: Optional[dict], env: dict) -> Optional[dict]:
+    """Merge epoch-comparison support into `env` (32-bit mode): the column
+    lane pairs and each literal's split bits. Returns the (possibly
+    unchanged) env, or None when a literal cannot convert."""
+    if x64_enabled():
+        return env
+    cmps = collect_epoch_cmps(nodes, schema)
+    if not cmps:
+        return env
+    merged = dict(env)
+    for colname, lit in cmps:
+        hi_k, lo_k = _epoch_lane_keys(colname)
+        if hi_k not in merged:
+            hi, lo, valid = _stage_epoch_lanes(table, colname, bucket,
+                                               stage_cache)
+            merged[hi_k] = (hi, valid)
+            merged[lo_k] = (lo, valid)
+        lhik, llok = _epoch_lit_keys(colname, lit._key())
+        if lhik in merged or lit.value is None:
+            continue
+        try:
+            epoch = _literal_to_physical(lit.value, schema[colname].dtype)
+        except (ValueError, TypeError, KeyError):
+            return None
+        bits = int(_epoch_bits_np(np.array([epoch]))[0])
+        merged[lhik] = jnp.uint32(bits >> 32)
+        merged[llok] = jnp.uint32(bits & 0xFFFFFFFF)
+    return merged
+
+
+def epoch_cmp_columns(nodes, schema) -> set:
+    """Column names consumed ONLY through epoch-comparison lanes — excluded
+    from normal staging (their dtypes cannot stage in 32-bit mode)."""
+    if x64_enabled():
+        return set()
+    return {c for c, _ in collect_epoch_cmps(nodes, schema)}
+
+
 def _string_cmp_shape(node, schema):
     """(colname, literal_value, flipped) when `node` is a comparison between
     a string Column and a string Literal (either side); else None. These
@@ -445,6 +574,10 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
         if node.op == "+" and out_dt.is_string():
             return False
         if _string_cmp_shape(node, schema) is not None:
+            return True
+        # epoch comparisons compile as two-lane splits only in 32-bit mode;
+        # under x64 the generic int64 path below handles them
+        if not x64_enabled() and _epoch_cmp_shape(node, schema) is not None:
             return True
         # any OTHER op touching a string child (col vs col: codes come
         # from different dictionaries) must stay host
@@ -693,6 +826,41 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
                     out = codes < env[_kle]
                 else:  # ">"
                     out = codes >= env[_kle]
+                return out, m
+
+            return run, out_dt
+        eshape = None if x64_enabled() else _epoch_cmp_shape(node, schema)
+        if eshape is not None:
+            colname, lit, flipped, _cdt = eshape
+            cop = _CMP_FLIP[node.op] if flipped else node.op
+            if lit.value is None:
+                def run(env, _hk=_epoch_lane_keys(colname)[0]):
+                    _v, m = env[_hk]
+                    z = jnp.zeros_like(m)
+                    return z, z
+
+                return run, out_dt
+            hi_k, lo_k = _epoch_lane_keys(colname)
+            lhik, llok = _epoch_lit_keys(colname, lit._key())
+
+            def run(env, _op=cop, _hk=hi_k, _lk=lo_k, _lh=lhik, _ll=llok):
+                hi, m = env[_hk]
+                lo, _m2 = env[_lk]
+                lh = env[_lh]
+                ll = env[_ll]
+                eq_hi = hi == lh
+                if _op == "==":
+                    out = eq_hi & (lo == ll)
+                elif _op == "!=":
+                    out = ~(eq_hi & (lo == ll))
+                elif _op == "<":
+                    out = (hi < lh) | (eq_hi & (lo < ll))
+                elif _op == "<=":
+                    out = (hi < lh) | (eq_hi & (lo <= ll))
+                elif _op == ">":
+                    out = (hi > lh) | (eq_hi & (lo > ll))
+                else:  # ">="
+                    out = (hi > lh) | (eq_hi & (lo >= ll))
                 return out, m
 
             return run, out_dt
@@ -987,7 +1155,11 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     needed = set()
     for nd in nodes:
         needed.update(required_columns(nd))
-    if not needed:
+    # epoch columns are consumed through lane pairs, never staged normally
+    # (their dtypes cannot narrow to int32)
+    epoch_cols = epoch_cmp_columns(nodes, schema)
+    needed -= epoch_cols
+    if not needed and not epoch_cols:
         return None
     b = size_bucket(n)
     staged = stage_table_columns(table, needed, b, stage_cache)
@@ -997,6 +1169,9 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     if not int64_wrap_safe(nodes, schema, env, stage_cache, b):
         return None
     env = string_literal_env(nodes, schema, dcs, env)
+    if env is None:
+        return None
+    env = epoch_cmp_env(nodes, schema, table, b, stage_cache, env)
     if env is None:
         return None
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))
@@ -1309,14 +1484,13 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
     k = len(keys)
     desc = _norm_flag(descending, k, False)
     nf = _norm_flag(nulls_first, k, None)
-    f64_lane_keys: Dict[int, str] = {}
+    f64_lane_keys: Dict[int, Tuple[str, str]] = {}
     if not x64_enabled():
         # float64 keys must not sort in float32 (spurious ties reorder rows
-        # vs the host). PLAIN f64 columns sort exactly via host-split 64-bit
-        # lanes (_stage_f64_sort_lanes) — lossless, so they bypass the
-        # reduced-precision eligibility gate entirely; COMPUTED f64 keys
-        # would evaluate in f32 on device, so they decline to the host
-        # before staging anything.
+        # vs the host), and epoch keys cannot narrow to int32 at all. PLAIN
+        # columns of either kind sort exactly via host-split 64-bit lanes —
+        # lossless, so they bypass the eligibility gates entirely; COMPUTED
+        # f64/epoch keys decline to the host before staging anything.
         from ..expressions import normalize_literals
 
         try:
@@ -1332,8 +1506,13 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
                 cname = _plain_f64_column(nd, table.schema)
                 if cname is None:
                     return None
-                f64_lane_keys[i] = cname
-            # non-f64 keys are vetted by _stage_and_run below — checking
+                f64_lane_keys[i] = ("f64", cname)
+            elif dt_.kind in _EPOCH_KINDS:
+                cname = _plain_epoch_column(nd, table.schema)
+                if cname is None:
+                    return None
+                f64_lane_keys[i] = ("epoch", cname)
+            # other keys are vetted by _stage_and_run below — checking
             # compilability here too would walk every tree twice per sort
     entries: List = [None] * k
     non_lane = [(i, e) for i, e in enumerate(keys) if i not in f64_lane_keys]
@@ -1345,8 +1524,11 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
         for (i, _), vm in zip(non_lane, outs):
             entries[i] = vm
     b = size_bucket(n)
-    for i, cname in f64_lane_keys.items():
-        entries[i] = _stage_f64_sort_lanes(table, cname, b, stage_cache)
+    for i, (kind, cname) in f64_lane_keys.items():
+        if kind == "f64":
+            entries[i] = _stage_f64_sort_lanes(table, cname, b, stage_cache)
+        else:
+            entries[i] = _stage_epoch_lanes(table, cname, b, stage_cache)
     nf_resolved = [(f if f is not None else d) for f, d in zip(nf, desc)]
     idx = device_argsort(entries, desc, nf_resolved, n)
     return np.asarray(jax.device_get(idx))[:n]

@@ -264,23 +264,28 @@ def device_grouped_agg_async(table, to_agg, group_by,
     gb = max(16, 1 << (num_groups - 1).bit_length())  # static segment bucket
 
     # --- stage inputs -----------------------------------------------------
+    from .device import (epoch_cmp_columns, epoch_cmp_env, int64_wrap_safe,
+                         string_literal_env)
+
+    check_nodes = list(child_nodes) + (list(pred_nodes) if pred_nodes else [])
     needed = set()
     for nd in child_nodes:
         needed.update(required_columns(nd))
     if pred_nodes is not None:
         needed.update(required_columns(pred_nodes[0]))
+    needed -= epoch_cmp_columns(check_nodes, schema)
     staged = stage_table_columns(table, sorted(needed), b, stage_cache)
     if staged is None:
         return None
     env, dcs = staged
-    from .device import int64_wrap_safe, string_literal_env
-
-    check_nodes = list(child_nodes) + (list(pred_nodes) if pred_nodes else [])
     if not int64_wrap_safe(check_nodes, schema, env, stage_cache, b):
         return None  # int64 arithmetic could wrap in int32 lanes
     env = string_literal_env(check_nodes, schema, dcs, env)
     if env is None:
         return None  # a string comparison lost its dictionary
+    env = epoch_cmp_env(check_nodes, schema, table, b, stage_cache, env)
+    if env is None:
+        return None  # an epoch literal failed to convert
 
     # --- compile + run ONE fused program ---------------------------------
     from ..context import get_context

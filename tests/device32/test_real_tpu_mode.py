@@ -962,6 +962,72 @@ class TestDeviceStrings32:
         assert dev.to_pydict() == host.to_pydict()
 
 
+class TestDeviceEpoch32:
+    """Epoch temporals (timestamp/duration) in 32-bit mode: comparisons
+    against literals compile as two-lane unsigned compares over split
+    64-bit epoch bits, and plain-column sort keys ride exact (hi, lo)
+    lanes — the r3-verdict 'epoch timestamps are host-only' exclusion is
+    gone for the compare/sort surface. Arithmetic stays host."""
+
+    def _tdata(self, n=8000):
+        base = datetime.datetime(2020, 1, 1)
+        rng = np.random.RandomState(31)
+        ts = [base + datetime.timedelta(seconds=int(s))
+              for s in rng.randint(0, 10**7, n)]
+        for i in range(0, n, 101):
+            ts[i] = None
+        return {"t": dt.Series.from_pylist(ts, "t", dt.DataType.timestamp("us")),
+                "v": rng.rand(n)}, base + datetime.timedelta(seconds=5 * 10**6)
+
+    def test_timestamp_filters_on_device(self, host_mode):
+        data, lit = self._tdata()
+        for opname, build in [
+            ("lt", lambda: dt.from_pydict(data).where(col("t") < lit)),
+            ("ge", lambda: dt.from_pydict(data).where(col("t") >= lit)),
+            ("eq", lambda: dt.from_pydict(data).where(col("t") == lit)),
+            ("ne", lambda: dt.from_pydict(data).where(col("t") != lit)),
+            ("flip", lambda: dt.from_pydict(data).where(dt.lit(lit) > col("t"))),
+        ]:
+            dev, host = _run_both(build, host_mode)
+            assert _counters(dev).get("device_filters", 0) >= 1, opname
+            assert dev.to_pydict()["v"] == host.to_pydict()["v"], opname
+
+    def test_timestamp_sort_exact_on_device(self, host_mode):
+        data, _ = self._tdata()
+
+        def q():
+            return dt.from_pydict(data).sort("t", desc=True)
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_sorts", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_fused_timestamp_predicate_agg(self, host_mode):
+        data, lit = self._tdata()
+
+        def q():
+            return (dt.from_pydict(data).where(col("t") < lit)
+                    .agg(col("v").sum().alias("s"),
+                         col("v").count().alias("c")))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1, _counters(dev)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["c"] == h["c"]
+        np.testing.assert_allclose(d["s"], h["s"], rtol=1e-5)
+
+    def test_timestamp_arithmetic_stays_host(self, host_mode):
+        data, _ = self._tdata(500)
+
+        def q():
+            return dt.from_pydict(data).select(
+                (col("t") + dt.interval(days=1)).alias("u"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) == 0
+        assert dev.to_pydict() == host.to_pydict()
+
+
 class TestDeviceDistinct32:
     """Distinct routed through the device group-codes kernel: first-occurrence
     rows, null-key semantics, multi-key packing (null-free only)."""
