@@ -191,11 +191,16 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
     # object stores would cost one HEAD round-trip per manifest/data file
     if p.startswith(str(table_uri).rstrip("/") + "/"):
         return p
-    # a REMOTE path outside the current location is the relocated-table
-    # case: probing it would pay retried HEADs against a possibly
-    # unreachable/credential-less store per file — remap immediately (this
-    # writer only ever emits paths under the table root, like the reference)
-    if not STORAGE.is_remote(p) and STORAGE.exists(p):
+    if STORAGE.is_remote(p):
+        # external data paths are spec-legal (write.data.path / add_files
+        # imports): probe with ONE non-retried HEAD — honoring them without
+        # paying a backoff loop per file against an unreachable store
+        try:
+            STORAGE.client.source_for(p).get_size(p)
+            return p
+        except Exception:
+            pass  # unreachable or absent: remap under the current root
+    elif STORAGE.exists(p):
         return p
     # remap by the stable tail: .../metadata/<x> or .../data/<x>
     for anchor in ("/metadata/", "/data/"):

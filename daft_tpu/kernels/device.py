@@ -455,14 +455,13 @@ def collect_epoch_cmps(nodes, schema):
     return out
 
 
-def epoch_cmp_env(nodes, schema, table, bucket: int,
+def epoch_cmp_env(cmps, schema, table, bucket: int,
                   stage_cache: Optional[dict], env: dict) -> Optional[dict]:
     """Merge epoch-comparison support into `env` (32-bit mode): the column
-    lane pairs and each literal's split bits. Returns the (possibly
+    lane pairs and each literal's split bits. `cmps` is the list from ONE
+    collect_epoch_cmps walk (shared with the needed-column subtraction so
+    trees are not walked twice per dispatch). Returns the (possibly
     unchanged) env, or None when a literal cannot convert."""
-    if x64_enabled():
-        return env
-    cmps = collect_epoch_cmps(nodes, schema)
     if not cmps:
         return env
     merged = dict(env)
@@ -486,12 +485,12 @@ def epoch_cmp_env(nodes, schema, table, bucket: int,
     return merged
 
 
-def epoch_cmp_columns(nodes, schema) -> set:
-    """Column names consumed ONLY through epoch-comparison lanes — excluded
-    from normal staging (their dtypes cannot stage in 32-bit mode)."""
+def epoch_cmps_for(nodes, schema):
+    """ONE walk: the epoch-comparison shapes of `nodes` (empty under x64,
+    where the generic int64 path applies)."""
     if x64_enabled():
-        return set()
-    return {c for c, _ in collect_epoch_cmps(nodes, schema)}
+        return []
+    return collect_epoch_cmps(nodes, schema)
 
 
 def _string_cmp_shape(node, schema):
@@ -518,11 +517,139 @@ def _string_cmp_shape(node, schema):
     return None
 
 
+_STR_PRED_FNS = {"utf8.contains": "contains", "utf8.startswith": "startswith",
+                 "utf8.endswith": "endswith"}
+
+
+def _string_lut_shape(node, schema):
+    """(colname, kind, payload, node_key) for predicates evaluable on the
+    per-partition DICTIONARY instead of the rows: utf8.contains/startswith/
+    endswith with a literal pattern, and is_in over string literals. The
+    host computes the predicate over the O(unique) dictionary values with
+    the SAME pyarrow kernels the host path uses (exact parity), producing a
+    bool lookup table the device gathers by code — O(rows) work stays on
+    the accelerator, O(unique) bookkeeping on the host (the division of
+    labor SURVEY §7 prescribes)."""
+    from ..expressions import Function, IsIn, Literal
+
+    if isinstance(node, Function) and node.fname in _STR_PRED_FNS:
+        if len(node.args) != 2 or node.kwargs:
+            return None
+        colname = _plain_string_column(node.args[0], schema)
+        pat = node.args[1]
+        if (colname is None or not isinstance(pat, Literal)
+                or not isinstance(pat.value, str)):
+            return None
+        return colname, _STR_PRED_FNS[node.fname], pat.value, node._key()
+    if isinstance(node, IsIn):
+        colname = _plain_string_column(node.child, schema)
+        items = node.items
+        if (colname is None or not isinstance(items, Literal)
+                or not isinstance(items.value, (list, tuple))):
+            return None
+        vals = [v for v in items.value if v is not None]
+        if not all(isinstance(v, str) for v in vals):
+            return None
+        return colname, "is_in", tuple(vals), node._key()
+    return None
+
+
+def _strlut_env_key(node_key) -> str:
+    return f"__strlut__\x00{node_key}"
+
+
+def _numeric_isin_items(node, schema):
+    """Static per-compile device item values for a numeric/date IsIn, or
+    None when ineligible. NaN items decline (arrow's is_in matches NaN,
+    jnp equality does not)."""
+    import math
+
+    from ..expressions import IsIn, Literal
+
+    if not isinstance(node, IsIn):
+        return None
+    items = node.items
+    if not isinstance(items, Literal) or not isinstance(items.value,
+                                                        (list, tuple)):
+        return None
+    try:
+        child_dt = node.child.to_field(schema).dtype
+    except (ValueError, KeyError):
+        return None
+    if not (child_dt.is_numeric() or child_dt.kind == TypeKind.DATE
+            or child_dt.kind == TypeKind.BOOL):
+        return None
+    out = []
+    for v in items.value:
+        if v is None:
+            continue  # null items never match (host: pc.is_in + fill_null)
+        if isinstance(v, float) and math.isnan(v):
+            return None
+        try:
+            out.append(_literal_to_physical(v, child_dt))
+        except (ValueError, TypeError):
+            return None
+    if not x64_enabled():
+        for v in out:
+            if isinstance(v, int) and not (-2**31 <= v <= 2**31 - 1):
+                return None
+    return tuple(out)
+
+
+def collect_string_luts(nodes, schema):
+    """Every LUT-predicate shape in the trees."""
+    out = []
+
+    def walk(n):
+        shape = _string_lut_shape(n, schema)
+        if shape is not None:
+            out.append(shape)
+        for c in n.children():
+            walk(c)
+
+    for nd in nodes:
+        walk(nd)
+    return out
+
+
+def string_lut_env(nodes, schema, dcs, env) -> Optional[dict]:
+    """Merge per-partition dictionary lookup tables into `env` for every
+    LUT-predicate. Returns the (possibly unchanged) env, or None when a
+    needed dictionary is unavailable."""
+    shapes = collect_string_luts(nodes, schema)
+    if not shapes:
+        return env
+    merged = dict(env)
+    for colname, kind, payload, node_key in shapes:
+        key = _strlut_env_key(node_key)
+        if key in merged:
+            continue
+        dc = dcs.get(colname)
+        if dc is None or dc.dictionary is None:
+            return None
+        uniq = dc.dictionary
+        if kind == "contains":
+            lut = pc.match_substring(uniq, payload)
+        elif kind == "startswith":
+            lut = pc.starts_with(uniq, payload)
+        elif kind == "endswith":
+            lut = pc.ends_with(uniq, payload)
+        else:  # is_in
+            lut = pc.is_in(uniq, value_set=pa.array(list(payload),
+                                                    type=uniq.type))
+        lut_np = np.asarray(pc.fill_null(lut, False), dtype=bool)
+        b = size_bucket(max(len(uniq), 1))
+        if b > len(lut_np):
+            lut_np = np.concatenate([lut_np, np.zeros(b - len(lut_np), bool)])
+        merged[key] = jnp.asarray(lut_np)
+    return merged
+
+
 def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
     """Can this expression tree run fully on device against `schema`?"""
     from ..expressions import (
-        Alias, Between, BinaryOp, Cast, Column, FillNull, Function, IfElse, IsNull,
-        Literal, Not, normalize_literals,
+        Alias, Between, BinaryOp, Cast, Column, FillNull, Function, IfElse, IsIn,
+        IsNull, Literal, Not, normalize_literals,
     )
 
     if not _normalized:
@@ -589,9 +716,16 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
             return False
         return all(rec(c) for c in node.children())
     if isinstance(node, Function):
+        if _string_lut_shape(node, schema) is not None:
+            return True  # dictionary-LUT predicate (contains/starts/ends)
         if node.fname in _DEVICE_FNS:
             return all(rec(c) for c in node.children())
         return False
+    if isinstance(node, IsIn):
+        if _string_lut_shape(node, schema) is not None:
+            return True  # string membership via the dictionary LUT
+        return (_numeric_isin_items(node, schema) is not None
+                and rec(node.child))
     return False
 
 
@@ -687,8 +821,8 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
     The closure is pure jax -> safe to jit; types resolved statically via schema.
     """
     from ..expressions import (
-        Alias, Between, BinaryOp, Cast, Column, FillNull, Function, IfElse, IsNull,
-        Literal, Not,
+        Alias, Between, BinaryOp, Cast, Column, FillNull, Function, IfElse, IsIn,
+        IsNull, Literal, Not,
     )
 
     out_dt = node.to_field(schema).dtype
@@ -951,6 +1085,35 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
 
         return run, out_dt
 
+    if isinstance(node, (Function, IsIn)):
+        lshape = _string_lut_shape(node, schema)
+        if lshape is not None:
+            colname, _kind, _payload, node_key = lshape
+            lut_k = _strlut_env_key(node_key)
+
+            def run(env, _c=colname, _lk=lut_k):
+                codes, m = env[_c]
+                return env[_lk][codes], m
+
+            return run, out_dt
+
+    if isinstance(node, IsIn):
+        items = _numeric_isin_items(node, schema)
+        if items is None:
+            raise ValueError("is_in not device-compilable here")
+        inner, _ = _compile_node(node.child, schema)
+
+        def run(env, _inner=inner, _items=items):
+            v, m = _inner(env)
+            if not _items:
+                return jnp.zeros_like(m), m
+            out = jnp.zeros_like(m)
+            for it in _items:  # small static lists: unrolled compares fuse
+                out = out | (v == it)
+            return out, m
+
+        return run, out_dt
+
     if isinstance(node, Function):
         if node.fname not in _DEVICE_FNS:
             raise ValueError(f"function {node.fname} not device-compilable")
@@ -1157,7 +1320,8 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
         needed.update(required_columns(nd))
     # epoch columns are consumed through lane pairs, never staged normally
     # (their dtypes cannot narrow to int32)
-    epoch_cols = epoch_cmp_columns(nodes, schema)
+    epoch_cmps = epoch_cmps_for(nodes, schema)
+    epoch_cols = {c for c, _ in epoch_cmps}
     needed -= epoch_cols
     if not needed and not epoch_cols:
         return None
@@ -1171,7 +1335,10 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     env = string_literal_env(nodes, schema, dcs, env)
     if env is None:
         return None
-    env = epoch_cmp_env(nodes, schema, table, b, stage_cache, env)
+    env = epoch_cmp_env(epoch_cmps, schema, table, b, stage_cache, env)
+    if env is None:
+        return None
+    env = string_lut_env(nodes, schema, dcs, env)
     if env is None:
         return None
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))

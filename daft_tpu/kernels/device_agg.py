@@ -264,8 +264,8 @@ def device_grouped_agg_async(table, to_agg, group_by,
     gb = max(16, 1 << (num_groups - 1).bit_length())  # static segment bucket
 
     # --- stage inputs -----------------------------------------------------
-    from .device import (epoch_cmp_columns, epoch_cmp_env, int64_wrap_safe,
-                         string_literal_env)
+    from .device import (epoch_cmp_env, epoch_cmps_for, int64_wrap_safe,
+                         string_literal_env, string_lut_env)
 
     check_nodes = list(child_nodes) + (list(pred_nodes) if pred_nodes else [])
     needed = set()
@@ -273,7 +273,8 @@ def device_grouped_agg_async(table, to_agg, group_by,
         needed.update(required_columns(nd))
     if pred_nodes is not None:
         needed.update(required_columns(pred_nodes[0]))
-    needed -= epoch_cmp_columns(check_nodes, schema)
+    epoch_cmps = epoch_cmps_for(check_nodes, schema)
+    needed -= {c for c, _ in epoch_cmps}
     staged = stage_table_columns(table, sorted(needed), b, stage_cache)
     if staged is None:
         return None
@@ -283,9 +284,12 @@ def device_grouped_agg_async(table, to_agg, group_by,
     env = string_literal_env(check_nodes, schema, dcs, env)
     if env is None:
         return None  # a string comparison lost its dictionary
-    env = epoch_cmp_env(check_nodes, schema, table, b, stage_cache, env)
+    env = epoch_cmp_env(epoch_cmps, schema, table, b, stage_cache, env)
     if env is None:
         return None  # an epoch literal failed to convert
+    env = string_lut_env(check_nodes, schema, dcs, env)
+    if env is None:
+        return None  # a LUT predicate lost its dictionary
 
     # --- compile + run ONE fused program ---------------------------------
     from ..context import get_context

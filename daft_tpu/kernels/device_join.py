@@ -126,7 +126,8 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     if staged is None:
         return None
     env, dcs = staged
-    from .device import compile_projection, int64_wrap_safe, string_literal_env
+    from .device import (compile_projection, int64_wrap_safe,
+                         string_literal_env, string_lut_env)
 
     if not int64_wrap_safe([node], schema, env, cache, b):
         return None  # computed int64 key could wrap in int32 lanes
@@ -134,6 +135,9 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     # (e.g. (col('s') == 'a').cast(int)): the compiled closure reads the
     # literal's per-partition code bounds from the env
     env = string_literal_env([node], schema, dcs, env)
+    if env is None:
+        return None
+    env = string_lut_env([node], schema, dcs, env)
     if env is None:
         return None
     run, _ = compile_projection([node], schema, tuple(sorted(cols)))

@@ -925,6 +925,51 @@ class TestDeviceStrings32:
         dev, host = _run_both(q, host_mode)
         assert dev.to_pydict() == host.to_pydict()
 
+    def test_string_lut_predicates_on_device(self, host_mode):
+        """contains/startswith/endswith/is_in evaluate over the O(unique)
+        DICTIONARY on host (same pyarrow kernels as the host path -> exact
+        parity) and become an O(rows) code-gather on device."""
+        data = self._sdata()
+        for name, build in [
+            ("contains", lambda: dt.from_pydict(data).where(
+                col("m").str.contains("AI"))),
+            ("startswith", lambda: dt.from_pydict(data).where(
+                col("m").str.startswith("R"))),
+            ("endswith", lambda: dt.from_pydict(data).where(
+                col("m").str.endswith("L"))),
+            ("is_in", lambda: dt.from_pydict(data).where(
+                col("m").is_in(["MAIL", "SHIP", "ABSENT"]))),
+            ("fused", lambda: dt.from_pydict(data).where(
+                col("m").str.contains("A") & (col("v") > 50.0))),
+        ]:
+            dev, host = _run_both(build, host_mode)
+            assert _counters(dev).get("device_filters", 0) >= 1, name
+            assert dev.to_pydict()["m"] == host.to_pydict()["m"], name
+
+    def test_numeric_isin_on_device(self, host_mode):
+        rng = np.random.RandomState(17)
+        data = {"k": rng.randint(0, 50, 10_000).astype(np.int64),
+                "v": rng.rand(10_000)}
+        for name, items in [("hits", [3, 7, 49]), ("miss", [999]),
+                            ("empty", [])]:
+            def q():
+                return dt.from_pydict(data).where(col("k").is_in(items))
+
+            dev, host = _run_both(q, host_mode)
+            assert _counters(dev).get("device_filters", 0) >= 1, name
+            assert dev.to_pydict() == host.to_pydict(), name
+
+    def test_isin_null_child_rows(self, host_mode):
+        ks = [1, None, 2, 3, None] * 600
+
+        def q():
+            return (dt.from_pydict(
+                {"k": dt.Series.from_pylist(ks, "k", dt.DataType.int64())})
+                .select(col("k").is_in([1, 2]).alias("hit")))
+
+        dev, host = _run_both(q, host_mode)
+        assert dev.to_pydict() == host.to_pydict()  # null rows -> null out
+
     def test_string_col_vs_col_falls_back(self, host_mode):
         """Codes from two different dictionaries are incomparable: col-vs-col
         string comparisons must decline to the host path."""
