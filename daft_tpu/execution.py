@@ -386,7 +386,8 @@ class ExecutionContext:
                 out = device_grouped_agg(part.table(), list(aggregations),
                                          list(groupby or []),
                                          stage_cache=part.device_stage_cache(),
-                                         predicate=predicate)
+                                         predicate=predicate,
+                                         stats=self.stats)
             except Exception:
                 out = None
             if out is not None:
@@ -432,7 +433,8 @@ class ExecutionContext:
 
             resolve = device_grouped_agg_async(
                 part.table(), list(aggregations), list(groupby or []),
-                stage_cache=part.device_stage_cache(), predicate=predicate)
+                stage_cache=part.device_stage_cache(), predicate=predicate,
+                stats=self.stats)
         except Exception:
             return None
         if resolve is None:

@@ -194,12 +194,21 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
     if STORAGE.is_remote(p):
         # external data paths are spec-legal (write.data.path / add_files
         # imports): probe with ONE non-retried HEAD — honoring them without
-        # paying a backoff loop per file against an unreachable store
-        try:
-            STORAGE.client.source_for(p).get_size(p)
-            return p
-        except Exception:
-            pass  # unreachable or absent: remap under the current root
+        # paying a backoff loop per file against an unreachable store. A
+        # store root that times out is remembered DEAD for this process so a
+        # relocated table with thousands of files pays one timeout, not one
+        # per file.
+        root = "/".join(p.split("/", 3)[:3])
+        if root not in _DEAD_EXTERNAL_ROOTS:
+            from .object_store import TransientIOError
+
+            try:
+                STORAGE.client.source_for(p).get_size(p)
+                return p
+            except TransientIOError:
+                _DEAD_EXTERNAL_ROOTS.add(root)
+            except Exception:
+                pass  # absent (404 etc.): remap this file, keep probing root
     elif STORAGE.exists(p):
         return p
     # remap by the stable tail: .../metadata/<x> or .../data/<x>
@@ -210,6 +219,9 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
             return STORAGE.join(table_uri, anchor.strip("/"),
                                 p.rsplit(anchor, 1)[1])
     return STORAGE.join(table_uri, p.rsplit("/", 1)[-1])
+
+
+_DEAD_EXTERNAL_ROOTS: set = set()
 
 
 def _read_avro_any(path: str):

@@ -6,11 +6,13 @@ predicate that stays a mask (no host compaction) — the TPU analog of the
 reference's fused streaming pipeline (src/daft-local-execution/src/pipeline.rs:141-211
 and the grouped-agg sinks in src/daft-table/src/ops/agg.rs).
 
-Division of labor (SURVEY §7): single integer/date group keys compute their
-dense codes ON DEVICE (_group_codes_kernel: sort + boundary scan +
-first-occurrence remap); string and multi-column keys fall back to the host
-dictionary encode (Table._group_codes). Either way the VPU does the O(rows)
-work: projections fused into masked `segment_sum/min/max` reductions with
+Division of labor (SURVEY §7): group keys compute their dense codes ON
+DEVICE (_group_codes_kernel: sort + boundary scan + first-occurrence
+remap) for 1-4 stageable keys — integer/date values, plain string columns
+via their sorted dictionary codes, multi-key via mixed-radix packing
+(null-free); anything else falls back to the host dictionary encode
+(Table._group_codes). Either way the VPU does the O(rows) work:
+projections fused into masked `segment_sum/min/max` reductions with
 static segment counts (padded to a power of two so XLA compiles once per
 bucket, not once per cardinality).
 
@@ -93,28 +95,71 @@ def _group_codes_kernel(vals, valid, n):
     return codes, num_groups, first_rows, vals[safe_rows], valid[safe_rows]
 
 
-def _try_device_group_codes(table, key_expr, stage_cache, n: int):
-    """(codes_dev, uniq Table, num_groups) via the device kernel, or None when
-    the key is not a single staged integer/date column. The host fallback
-    (_group_codes dictionary encode) handles strings and multi-key grouping."""
-    from ..schema import Field, Schema
-    from ..table import Table
-
+def _stage_group_key(table, key_expr, cache):
+    """(vals, valid) int lanes for ONE group key: integer/date expressions
+    via the join-key stager; plain STRING columns via their sorted
+    dictionary codes (dense ints already — the device kernel neither knows
+    nor cares that they decode to text)."""
+    from .device import _plain_string_column, normalize_and_check
     from .device_join import _stage_key
 
-    staged = _stage_key(table, key_expr, stage_cache)
-    if staged is None:
+    staged = _stage_key(table, key_expr, cache)
+    if staged is not None:
+        return staged
+    nodes = normalize_and_check([key_expr], table.schema)
+    if nodes is None:
         return None
-    vals, valid = staged
-    codes, num_groups, _first, uvals, uvalid = _group_codes_kernel(
+    cname = _plain_string_column(nodes[0], table.schema)
+    if cname is None:
+        return None
+    staged_cols = stage_table_columns(table, [cname],
+                                      size_bucket(len(table)), cache)
+    if staged_cols is None:
+        return None
+    _env, dcs = staged_cols
+    dc = dcs[cname]
+    if dc.dictionary is None:
+        return None
+    return dc.values, dc.valid
+
+
+def _try_device_group_codes(table, group_by, stage_cache, n: int):
+    """(codes_dev, uniq Table, num_groups) via the device kernel for 1-4
+    stageable keys — integer/date values, string dictionary codes, packed
+    mixed-radix for multi-key (null-free only: packing collapses null
+    components). Unique key ROWS are gathered on host by first-occurrence
+    index, so the group order matches the host dictionary encode exactly.
+    Returns None when ineligible (host _group_codes handles everything)."""
+    from ..series import Series
+
+    staged = [_stage_group_key(table, k, stage_cache) for k in group_by]
+    if any(s is None for s in staged):
+        return None
+    if len(staged) == 1:
+        vals, valid = staged[0]
+    else:
+        from .device_join import _pack_composite_keys
+
+        # ONE fused reduction + sync for the nullability check, not one/key
+        all_valid = bool(jax.device_get(
+            jnp.all(jnp.stack([jnp.all(m[:n]) for _, m in staged]))))
+        if not all_valid:
+            return None
+        packed = _pack_composite_keys([staged])
+        if packed is None:
+            return None
+        (vals, valid), = packed
+    codes, num_groups, first_rows, _uv, _um = _group_codes_kernel(
         vals, valid, jnp.int32(n))
     num_groups = int(num_groups)  # one tiny sync; bounds the segment bucket
-    from .device import DeviceColumn, unstage
+    first = np.asarray(jax.device_get(first_rows))[:num_groups]
+    import pyarrow as pa
 
-    kdt = key_expr._node.to_field(table.schema).dtype
-    uniq_col = unstage(DeviceColumn(uvals, uvalid, num_groups, kdt))
-    name = key_expr.name()
-    uniq = Table(Schema([Field(name, kdt)]), [uniq_col.rename(name)])
+    # gather the num_groups first-occurrence ROWS first, then evaluate the
+    # key expressions over just those — O(groups) host work, not O(rows)
+    first_tbl = table.take(Series.from_arrow(
+        pa.array(first.astype(np.uint64)), "idx"))
+    uniq = first_tbl.eval_expression_list(list(group_by))
     return codes, uniq, num_groups
 
 
@@ -126,9 +171,9 @@ def device_distinct_indices(table, keys, stage_cache, n: int):
     every component is null-free: a null component would collapse distinct
     tuples like (1, null)/(2, null) into one packed-null group, so nullable
     multi-key inputs decline to the host path. Returns np.ndarray or None."""
-    from .device_join import _pack_composite_keys, _stage_key
+    from .device_join import _pack_composite_keys
 
-    staged = [_stage_key(table, k, stage_cache) for k in keys]
+    staged = [_stage_group_key(table, k, stage_cache) for k in keys]
     if any(s is None for s in staged):
         return None
     if len(staged) == 1:
@@ -150,11 +195,11 @@ def device_distinct_indices(table, keys, stage_cache, n: int):
 
 
 def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = None,
-                       predicate=None):
+                       predicate=None, stats=None):
     """Synchronous fused grouped aggregation on device: dispatch + resolve.
     Returns a host Table or None when ineligible (see the async variant)."""
     resolve = device_grouped_agg_async(table, to_agg, group_by, stage_cache,
-                                       predicate)
+                                       predicate, stats=stats)
     return None if resolve is None else resolve()
 
 
@@ -198,7 +243,7 @@ def agg_plan_device_compilable(to_agg, schema, predicate=None) -> bool:
 
 def device_grouped_agg_async(table, to_agg, group_by,
                              stage_cache: Optional[dict] = None,
-                             predicate=None):
+                             predicate=None, stats=None):
     """Fused grouped aggregation for one partition on device, split into a
     dispatch (staging + the jitted launch happen now) and a deferred resolver
     (ONE result fetch + host assembly when called) — the executor stages
@@ -239,14 +284,17 @@ def device_grouped_agg_async(table, to_agg, group_by,
     codes_key = ("groupcodes", tuple(e._node._key() for e in group_by), b)
     cached = stage_cache.get(codes_key) if stage_cache is not None else None
     if cached is None:
-        if len(group_by) == 1:
-            # single integer/date key: codes computed ON DEVICE (sort +
+        if 1 <= len(group_by) <= 4:
+            # stageable keys (int/date values, string dictionary codes,
+            # packed for multi-key): codes computed ON DEVICE (sort +
             # boundary scan), keeping the O(rows) bookkeeping off the host
             try:
-                cached = _try_device_group_codes(table, group_by[0],
+                cached = _try_device_group_codes(table, group_by,
                                                  stage_cache, n)
             except Exception:
                 cached = None
+            if cached is not None and stats is not None:
+                stats.bump("device_group_codes")
         if cached is None:
             if group_by:
                 key_tbl = table.eval_expression_list(list(group_by))

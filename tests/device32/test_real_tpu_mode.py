@@ -959,6 +959,18 @@ class TestDeviceStrings32:
             assert _counters(dev).get("device_filters", 0) >= 1, name
             assert dev.to_pydict() == host.to_pydict(), name
 
+    def test_isin_float_items_on_int_child_fall_back(self, host_mode):
+        """Host compares int-vs-float items in float64; 32-bit devices
+        cannot reproduce that rounding — must decline, not diverge."""
+        data = {"k": np.arange(8000, dtype=np.int64)}
+
+        def q():
+            return dt.from_pydict(data).where(col("k").is_in([3.0, 7.5]))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) == 0, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
     def test_isin_null_child_rows(self, host_mode):
         ks = [1, None, 2, 3, None] * 600
 
@@ -1114,15 +1126,43 @@ class TestDeviceDistinct32:
         assert _counters(dev).get("device_distincts", 0) == 0
         assert dev.to_pydict() == host.to_pydict()
 
-    def test_string_distinct_falls_back(self, host_mode):
-        data = {"s": np.array(["x", "y", "z"])[RNG.randint(0, 3, 5000)]}
+    def test_string_distinct_on_device(self, host_mode):
+        """String keys distinct on device via dictionary codes (nulls form
+        one group like every key kind)."""
+        vals = np.array(["x", "y", "z"])[RNG.randint(0, 3, 5000)].tolist()
+        vals[3] = None
+        data = {"s": dt.Series.from_pylist(vals, "s", dt.DataType.string())}
 
         def q():
             return dt.from_pydict(data).distinct()
 
         dev, host = _run_both(q, host_mode)
-        assert _counters(dev).get("device_distincts", 0) == 0
+        assert _counters(dev).get("device_distincts", 0) >= 1, _counters(dev)
         assert dev.to_pydict() == host.to_pydict()
+
+    def test_two_string_key_groupby_codes_on_device(self, host_mode):
+        """Q1's shape: TWO string group keys pack their dictionary codes
+        mixed-radix and compute group codes on device (null-free)."""
+        rng = np.random.RandomState(23)
+        data = {"rf": np.array(["A", "N", "R"])[rng.randint(0, 3, 20_000)],
+                "ls": np.array(["F", "O"])[rng.randint(0, 2, 20_000)],
+                "q": rng.rand(20_000) * 50}
+
+        def q():
+            return (dt.from_pydict(data).groupby("rf", "ls")
+                    .agg(col("q").sum().alias("s"),
+                         col("q").count().alias("c"))
+                    .sort(["rf", "ls"]))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1
+        # the DISCRIMINATING counter: group codes really computed on device
+        # (a silent decline would still bump device_aggregations via the
+        # host-codes fallback)
+        assert _counters(dev).get("device_group_codes", 0) >= 1, _counters(dev)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["rf"] == h["rf"] and d["ls"] == h["ls"] and d["c"] == h["c"]
+        np.testing.assert_allclose(d["s"], h["s"], rtol=1e-5)
 
 
 class TestInt64WrapGuard32:
