@@ -151,12 +151,15 @@ register("float.fill_nan", _ret_same, lambda s, fill: s.float_fill_nan(fill))
 
 def _utf8_binary_bool(fn):
     def evaluate(s: Series, pat: Series) -> Series:
-        l, r = _broadcast(s, pat)
-        if len(r) == 1:
-            p = r.to_arrow()[0].as_py()
+        if len(pat) == 1:
+            # scalar pattern BEFORE broadcasting: the vectorized pc kernel
+            # applies however long `s` is (the LUT staging path feeds whole
+            # dictionaries through here)
+            p = pat.to_arrow()[0].as_py()
             if p is None:
-                return Series.full_null(s.name, DataType.bool(), len(l))
-            return Series.from_arrow(fn(l.to_arrow(), p), s.name, DataType.bool())
+                return Series.full_null(s.name, DataType.bool(), len(s))
+            return Series.from_arrow(fn(s.to_arrow(), p), s.name, DataType.bool())
+        l, r = _broadcast(s, pat)
         # elementwise pattern: per-row python fallback
         lv, rv = l.to_pylist(), r.to_pylist()
         pyfn = {"match_substring": lambda v, p: p in v,

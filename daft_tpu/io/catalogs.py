@@ -205,8 +205,13 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
             try:
                 STORAGE.client.source_for(p).get_size(p)
                 return p
-            except TransientIOError:
-                _DEAD_EXTERNAL_ROOTS.add(root)
+            except TransientIOError as e:
+                # only CONNECTION-level failures (timeout, refused, reset —
+                # surfaced as an OSError cause) condemn the root; a 429/5xx
+                # is the store talking to us, and must not silently remap
+                # 999 remaining files after one throttle
+                if isinstance(e.__cause__, OSError):
+                    _DEAD_EXTERNAL_ROOTS.add(root)
             except Exception:
                 pass  # absent (404 etc.): remap this file, keep probing root
     elif STORAGE.exists(p):

@@ -517,8 +517,11 @@ def _string_cmp_shape(node, schema):
     return None
 
 
-_STR_PRED_FNS = {"utf8.contains": "contains", "utf8.startswith": "startswith",
-                 "utf8.endswith": "endswith"}
+# LUT-evaluable predicate functions: the per-partition dictionary feeds the
+# REGISTERED host implementation, so parity is by construction — including
+# regex-backed like/ilike/match, which the device could never run itself
+_STR_PRED_FNS = ("utf8.contains", "utf8.startswith", "utf8.endswith",
+                 "utf8.like", "utf8.ilike", "utf8.match")
 
 
 def _string_lut_shape(node, schema):
@@ -540,7 +543,7 @@ def _string_lut_shape(node, schema):
         if (colname is None or not isinstance(pat, Literal)
                 or not isinstance(pat.value, str)):
             return None
-        return colname, _STR_PRED_FNS[node.fname], pat.value, node._key()
+        return colname, node.fname, pat.value, node._key()
     if isinstance(node, IsIn):
         colname = _plain_string_column(node.child, schema)
         items = node.items
@@ -634,15 +637,20 @@ def string_lut_env(nodes, schema, dcs, env) -> Optional[dict]:
         if dc is None or dc.dictionary is None:
             return None
         uniq = dc.dictionary
-        if kind == "contains":
-            lut = pc.match_substring(uniq, payload)
-        elif kind == "startswith":
-            lut = pc.starts_with(uniq, payload)
-        elif kind == "endswith":
-            lut = pc.ends_with(uniq, payload)
-        else:  # is_in
+        if kind == "is_in":
             lut = pc.is_in(uniq, value_set=pa.array(list(payload),
                                                     type=uniq.type))
+        else:
+            # run the REGISTERED host implementation over the dictionary:
+            # whatever semantics the host path has (incl. like's regex
+            # translation), the LUT has identically
+            from ..functions import get_function
+            from ..series import Series
+
+            got = get_function(kind).evaluate(
+                Series.from_arrow(uniq, "u"),
+                Series.from_pylist([payload], "p", DataType.string()))
+            lut = got.to_arrow()
         lut_np = np.asarray(pc.fill_null(lut, False), dtype=bool)
         b = size_bucket(max(len(uniq), 1))
         if b > len(lut_np):
@@ -1186,13 +1194,37 @@ def stage_table_columns(table, names, bucket: int, stage_cache: Optional[dict] =
     return env, dcs
 
 
+def _rewrite_between(node, schema):
+    """Between over string/epoch children rewrites to the conjunction of two
+    comparisons — exactly the host's implementation (Series.between is
+    (x >= lo) & (x <= hi)) — so the dictionary-code and epoch-lane compare
+    machinery applies. Numeric Between keeps its fused direct compile."""
+    from ..expressions import Between, BinaryOp
+
+    kids = node.children()
+    if kids:
+        node = node.with_children([_rewrite_between(c, schema) for c in kids])
+    if isinstance(node, Between):
+        try:
+            cdt = node.child.to_field(schema).dtype
+        except (ValueError, KeyError):
+            return node
+        if cdt.is_string() or cdt.kind in _EPOCH_KINDS:
+            return BinaryOp("&",
+                            BinaryOp(">=", node.child, node.lower),
+                            BinaryOp("<=", node.child, node.upper))
+    return node
+
+
 def normalize_and_check(exprs, schema) -> Optional[list]:
-    """Normalize each expression's literals against `schema` and verify device
-    compilability. Returns the normalized nodes, or None if any is ineligible."""
+    """Normalize each expression's literals against `schema`, apply device
+    rewrites, and verify device compilability. Returns the normalized
+    nodes, or None if any is ineligible."""
     from ..expressions import normalize_literals
 
     try:
-        nodes = [normalize_literals(e._node, schema) for e in exprs]
+        nodes = [_rewrite_between(normalize_literals(e._node, schema), schema)
+                 for e in exprs]
     except (ValueError, KeyError):
         return None
     for nd in nodes:

@@ -132,23 +132,10 @@ def _try_device_group_codes(table, group_by, stage_cache, n: int):
     Returns None when ineligible (host _group_codes handles everything)."""
     from ..series import Series
 
-    staged = [_stage_group_key(table, k, stage_cache) for k in group_by]
-    if any(s is None for s in staged):
+    lanes = _staged_group_lanes(table, group_by, stage_cache, n)
+    if lanes is None:
         return None
-    if len(staged) == 1:
-        vals, valid = staged[0]
-    else:
-        from .device_join import _pack_composite_keys
-
-        # ONE fused reduction + sync for the nullability check, not one/key
-        all_valid = bool(jax.device_get(
-            jnp.all(jnp.stack([jnp.all(m[:n]) for _, m in staged]))))
-        if not all_valid:
-            return None
-        packed = _pack_composite_keys([staged])
-        if packed is None:
-            return None
-        (vals, valid), = packed
+    vals, valid = lanes
     codes, num_groups, first_rows, _uv, _um = _group_codes_kernel(
         vals, valid, jnp.int32(n))
     num_groups = int(num_groups)  # one tiny sync; bounds the segment bucket
@@ -163,6 +150,32 @@ def _try_device_group_codes(table, group_by, stage_cache, n: int):
     return codes, uniq, num_groups
 
 
+def _staged_group_lanes(table, keys, stage_cache, n: int):
+    """ONE (vals, valid) int lane for 1-4 group/distinct keys: single keys
+    stage directly (nulls fine — the kernel groups them); multi-key packs
+    mixed-radix, which is only null-faithful when every component is
+    null-free (a null component would collapse distinct tuples like
+    (1, null)/(2, null) into one packed-null group), so nullable multi-key
+    inputs decline. Shared by the groupby and distinct paths."""
+    from .device_join import _pack_composite_keys
+
+    staged = [_stage_group_key(table, k, stage_cache) for k in keys]
+    if any(s is None for s in staged):
+        return None
+    if len(staged) == 1:
+        return staged[0]
+    # ONE fused reduction + sync for the nullability check, not one/key
+    all_valid = bool(jax.device_get(
+        jnp.all(jnp.stack([jnp.all(m[:n]) for _, m in staged]))))
+    if not all_valid:
+        return None
+    packed = _pack_composite_keys([staged])
+    if packed is None:
+        return None
+    (vals, valid), = packed
+    return vals, valid
+
+
 def device_distinct_indices(table, keys, stage_cache, n: int):
     """First-occurrence row indices of the distinct key tuples, computed on
     device via _group_codes_kernel (row order preserved — same contract as
@@ -171,23 +184,10 @@ def device_distinct_indices(table, keys, stage_cache, n: int):
     every component is null-free: a null component would collapse distinct
     tuples like (1, null)/(2, null) into one packed-null group, so nullable
     multi-key inputs decline to the host path. Returns np.ndarray or None."""
-    from .device_join import _pack_composite_keys
-
-    staged = [_stage_group_key(table, k, stage_cache) for k in keys]
-    if any(s is None for s in staged):
+    lanes = _staged_group_lanes(table, keys, stage_cache, n)
+    if lanes is None:
         return None
-    if len(staged) == 1:
-        vals, valid = staged[0]
-    else:
-        # ONE fused reduction + sync for the nullability check, not one/key
-        all_valid = bool(jax.device_get(
-            jnp.all(jnp.stack([jnp.all(m[:n]) for _, m in staged]))))
-        if not all_valid:
-            return None
-        packed = _pack_composite_keys([staged])
-        if packed is None:
-            return None
-        (vals, valid), = packed
+    vals, valid = lanes
     _, num_groups, first_rows, _, _ = _group_codes_kernel(
         vals, valid, jnp.int32(n))
     num_groups = int(num_groups)
@@ -254,8 +254,10 @@ def device_grouped_agg_async(table, to_agg, group_by,
     previous partition's compute; warm partitions dispatch sync-free.
 
     `to_agg`: aggregation Expressions (kinds sum/count/min/max/mean);
-    `group_by`: key Expressions (single int/date keys code on device,
-    strings/multi-key on host); `predicate`: optional filter fused as a mask.
+    `group_by`: key Expressions — 1-4 stageable keys (int/date values,
+    plain string columns via dictionary codes, multi-key packed null-free)
+    code on device, anything else on host; `predicate`: optional filter
+    fused as a mask.
 
     Returns a zero-arg resolver yielding a host Table (keys + aggregates,
     first-occurrence group order, matching the host path) — the resolver

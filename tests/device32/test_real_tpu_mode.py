@@ -982,6 +982,35 @@ class TestDeviceStrings32:
         dev, host = _run_both(q, host_mode)
         assert dev.to_pydict() == host.to_pydict()  # null rows -> null out
 
+    def test_like_ilike_match_on_device(self, host_mode):
+        """LIKE/ILIKE/regex match run their REGISTERED host implementation
+        over the dictionary (parity by construction), then gather by code
+        on device — SQL LIKE rides this too."""
+        data = self._sdata()
+        for name, build in [
+            ("like", lambda: dt.from_pydict(data).where(
+                col("m").str.like("%AI%"))),
+            ("like_underscore", lambda: dt.from_pydict(data).where(
+                col("m").str.like("R_IL"))),
+            ("ilike", lambda: dt.from_pydict(data).where(
+                col("m").str.ilike("mail"))),
+            ("match", lambda: dt.from_pydict(data).where(
+                col("m").str.match("^(MAIL|SHIP)$"))),
+        ]:
+            dev, host = _run_both(build, host_mode)
+            assert _counters(dev).get("device_filters", 0) >= 1, name
+            assert dev.to_pydict()["m"] == host.to_pydict()["m"], name
+
+    def test_string_between_on_device(self, host_mode):
+        data = self._sdata()
+
+        def q():
+            return dt.from_pydict(data).where(col("m").between("M", "S"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) >= 1, _counters(dev)
+        assert dev.to_pydict()["m"] == host.to_pydict()["m"]
+
     def test_string_col_vs_col_falls_back(self, host_mode):
         """Codes from two different dictionaries are incomparable: col-vs-col
         string comparisons must decline to the host path."""
@@ -1072,6 +1101,17 @@ class TestDeviceEpoch32:
         d, h = dev.to_pydict(), host.to_pydict()
         assert d["c"] == h["c"]
         np.testing.assert_allclose(d["s"], h["s"], rtol=1e-5)
+
+    def test_timestamp_between_on_device(self, host_mode):
+        data, lit = self._tdata()
+        lo = lit - datetime.timedelta(seconds=10**6)
+
+        def q():
+            return dt.from_pydict(data).where(col("t").between(lo, lit))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) >= 1, _counters(dev)
+        assert dev.to_pydict()["v"] == host.to_pydict()["v"]
 
     def test_timestamp_arithmetic_stays_host(self, host_mode):
         data, _ = self._tdata(500)
