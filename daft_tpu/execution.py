@@ -467,9 +467,10 @@ class ExecutionContext:
 
     def eval_join(self, lpart: MicroPartition, rpart: MicroPartition,
                   left_on, right_on, how: str, suffix: str) -> MicroPartition:
-        """Route a join through the device probe when eligible: 1-4
-        integer/date keys (composite keys pack into one lane), PK-unique
-        build side (kernels/device_join.py). Host acero join otherwise."""
+        """Route a join through the device probe when eligible: 1-4 keys
+        (integer/date values; plain string columns via joint-dictionary
+        recoding; composite keys pack into one lane), PK or N:M build
+        sides (kernels/device_join.py). Host acero join otherwise."""
         import numpy as np
 
         eligible = (self.cfg.use_device_kernels

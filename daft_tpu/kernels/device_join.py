@@ -8,10 +8,11 @@ no data-dependent control flow fits XLA, so the build side is SORTED once
 (cached with the partition, like column staging) and every probe is a
 vectorized `searchsorted` — O(P log B) fully on the VPU with static shapes.
 
-Scope (the TPC-H star-join shape): 1-4 integer/date keys (multi-column keys
-pack into one surrogate lane via exact mixed-radix packing). An overflowing
-composite key space or non-integer keys fall back to the host acero join.
-Probe direction adapts:
+Scope: 1-4 keys — integer/date values, and plain STRING columns via
+joint-dictionary recoding (_stage_key_pair) — with multi-column keys packed
+into one surrogate lane via exact mixed-radix packing. An overflowing
+composite key space or other key shapes (computed strings, floats) fall
+back to the host acero join. Probe direction adapts:
 
 - build = RIGHT side (right keys unique): inner/left/semi/anti with probe
   over the left rows — output already in host order (left idx, right idx).
@@ -145,6 +146,98 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     if not jnp.issubdtype(vals.dtype, jnp.integer):
         return None
     return vals, valid
+
+
+@jax.jit
+def _recode(codes, remap):
+    """Gather per-side dictionary codes into the JOINT dictionary's code
+    space (remap is the small per-dictionary index array)."""
+    return remap[codes]
+
+
+def _joint_remaps(ldc, rdc, cache):
+    """(lremap, rremap) device arrays mapping each side's dictionary codes
+    into their sorted JOINT dictionary's code space. Cached per dictionary
+    PAIR (the cache entry pins both pa.Arrays, keeping the id-keys valid),
+    so a broadcast-shaped join of one build side against P probe partitions
+    merges the dictionaries once, not P times. Remaps pad to a size bucket
+    so _recode compiles per bucket, not per dictionary length."""
+    key = ("__jointremap__", id(ldc.dictionary), id(rdc.dictionary))
+    cached = cache.get(key) if cache is not None else None
+    if cached is not None:
+        return cached[2], cached[3]
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    joint = pc.unique(pa.concat_arrays([
+        ldc.dictionary.cast(pa.large_string()),
+        rdc.dictionary.cast(pa.large_string())]))
+    joint = joint.take(pc.sort_indices(joint))
+
+    def remap_of(d):
+        if len(d) == 0:
+            # all-null side: codes are all 0/masked; remap needs 1 lane
+            arr = np.zeros(1, dtype=np.int32)
+        else:
+            idx = pc.index_in(d.cast(pa.large_string()), value_set=joint)
+            arr = np.asarray(idx, dtype=np.int32)
+        b = size_bucket(len(arr))
+        if b > len(arr):
+            arr = np.concatenate([arr, np.zeros(b - len(arr), np.int32)])
+        return jnp.asarray(arr)
+
+    lremap = remap_of(ldc.dictionary)
+    rremap = remap_of(rdc.dictionary)
+    if cache is not None:
+        cache[key] = (ldc.dictionary, rdc.dictionary, lremap, rremap)
+    return lremap, rremap
+
+
+def _stage_key_pair(ltable, rtable, lkey, rkey, lcache, rcache,
+                    ls=None, rs=None):
+    """((lv, lm), (rv, rm)) aligned int lanes for ONE key pair.
+
+    Numeric/date keys stage independently (_stage_key; pass pre-staged
+    sides via ls/rs to avoid re-dispatching). Plain STRING columns cannot:
+    per-partition dictionary codes are incomparable across tables — so
+    both sides' sorted dictionaries merge into one sorted JOINT dictionary
+    (host, O(u1+u2), cached per pair) and each side's codes gather through
+    a small remap array on device, giving equal strings equal ints across
+    tables. The probe then runs unchanged on int lanes. Reference
+    semantics: the probe table hashes raw key bytes so cross-table
+    equality is inherent (probe_table/mod.rs); the TPU formulation makes
+    it inherent by unifying the code space instead."""
+    if ls is None:
+        ls = _stage_key(ltable, lkey, lcache)
+    if rs is None:
+        rs = _stage_key(rtable, rkey, rcache)
+    if ls is not None and rs is not None:
+        return ls, rs
+    from .device import (_plain_string_column, normalize_and_check,
+                         stage_table_columns)
+
+    lnodes = normalize_and_check([lkey], ltable.schema)
+    rnodes = normalize_and_check([rkey], rtable.schema)
+    if lnodes is None or rnodes is None:
+        return None
+    lc = _plain_string_column(lnodes[0], ltable.schema)
+    rc = _plain_string_column(rnodes[0], rtable.schema)
+    if lc is None or rc is None:
+        return None
+    lstaged = stage_table_columns(ltable, [lc], size_bucket(len(ltable)),
+                                  lcache)
+    rstaged = stage_table_columns(rtable, [rc], size_bucket(len(rtable)),
+                                  rcache)
+    if lstaged is None or rstaged is None:
+        return None
+    ldc = lstaged[1][lc]
+    rdc = rstaged[1][rc]
+    if ldc.dictionary is None or rdc.dictionary is None:
+        return None
+    lremap, rremap = _joint_remaps(ldc, rdc, lcache)
+    lv = _recode(ldc.values, lremap)
+    rv = _recode(rdc.values, rremap)
+    return (lv, ldc.valid), (rv, rdc.valid)
 
 
 @jax.jit
@@ -313,10 +406,13 @@ def device_join_indices(left_table, right_table, left_keys, right_keys,
     if ln == 0 or rn == 0:
         return None
     if len(left_keys) > 1:
-        lks = [_stage_key(left_table, k, left_cache) for k in left_keys]
-        rks = [_stage_key(right_table, k, right_cache) for k in right_keys]
-        if any(k is None for k in lks) or any(k is None for k in rks):
+        pairs = [_stage_key_pair(left_table, right_table, lk_, rk_,
+                                 left_cache, right_cache)
+                 for lk_, rk_ in zip(left_keys, right_keys)]
+        if any(p is None for p in pairs):
             return None
+        lks = [p[0] for p in pairs]
+        rks = [p[1] for p in pairs]
         packed = _pack_composite_keys([lks, rks])
         if packed is None:
             return None
@@ -324,24 +420,35 @@ def device_join_indices(left_table, right_table, left_keys, right_keys,
         return _probe_both_ways(lv, lm, rv, rm, ln, rn, how)
     left_key, right_key = left_keys[0], right_keys[0]
     lk = _stage_key(left_table, left_key, left_cache)
-    if lk is None:
-        return None
-    lv, lm = lk
     rk = None
-    if right_replicas:
+    if lk is not None and right_replicas:
         # replica hit: skip staging the build side entirely — its existence
         # already proves the key passed the device-eligibility checks
-        d = _device_of(lv)
+        d = _device_of(lk[0])
         if d is not None and d in right_replicas:
             rk = right_replicas[d]
-    if rk is None:
-        rk = _stage_key(right_table, right_key, right_cache)
-        if rk is None:
-            return None
-        if left_replicas:
-            d = _device_of(rk[0])
-            if d is not None and d in left_replicas:
-                lv, lm = left_replicas[d]
+    if rk is not None:
+        lv, lm = lk
+    else:
+        rk0 = _stage_key(right_table, right_key, right_cache)
+        if lk is None or rk0 is None:
+            # string keys (or one string side): recode through the joint
+            # dictionary so equal strings get equal ints across tables
+            # (pre-staged sides pass through — no double dispatch)
+            pair = _stage_key_pair(left_table, right_table,
+                                   left_key, right_key,
+                                   left_cache, right_cache,
+                                   ls=lk, rs=rk0)
+            if pair is None:
+                return None
+            (lv, lm), rk = pair
+        else:
+            lv, lm = lk
+            rk = rk0
+            if left_replicas:
+                d = _device_of(rk[0])
+                if d is not None and d in left_replicas:
+                    lv, lm = left_replicas[d]
     rv, rm = rk
     if lv.dtype != rv.dtype:
         return None

@@ -376,6 +376,39 @@ class TestDeviceJoin:
         assert _counters(dev).get("device_join_probes", 0) > 0
         assert self._sorted_rows(dev) == self._sorted_rows(host)
 
+    @pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+    def test_string_key_join_on_device(self, how, host_mode):
+        """String join keys recode both sides' dictionary codes into their
+        sorted JOINT dictionary, so equal strings get equal ints across
+        tables and the int probe applies unchanged."""
+        rng = np.random.RandomState(29)
+        codes = [f"n{i:03d}" for i in range(40)]
+        lvals = np.array(codes)[rng.randint(0, 40, 4000)].tolist()
+        lvals[11] = None
+        ldata = {"nk": dt.Series.from_pylist(lvals, "nk",
+                                             dt.DataType.string()),
+                 "lv": np.arange(4000, dtype=np.int64)}
+        rdata = {"nk2": codes[5:], "rv": np.arange(35, dtype=np.int64)}
+        q = lambda: (dt.from_pydict(ldata)
+                     .join(dt.from_pydict(rdata), left_on="nk",
+                           right_on="nk2", how=how))
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_join_probes", 0) > 0, how
+        assert self._sorted_rows(dev) == self._sorted_rows(host), how
+
+    def test_mixed_int_string_multikey_join(self, host_mode):
+        rng = np.random.RandomState(31)
+        ldata = {"a": rng.randint(0, 20, 3000).astype(np.int64),
+                 "s": np.array(["x", "y", "z"])[rng.randint(0, 3, 3000)]}
+        rdata = {"a2": rng.randint(0, 20, 2000).astype(np.int64),
+                 "s2": np.array(["x", "y", "z"])[rng.randint(0, 3, 2000)]}
+        q = lambda: (dt.from_pydict(ldata)
+                     .join(dt.from_pydict(rdata), left_on=["a", "s"],
+                           right_on=["a2", "s2"]))
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_join_probes", 0) > 0
+        assert self._sorted_rows(dev) == self._sorted_rows(host)
+
     def test_nm_join_100k_rows(self, host_mode):
         """The verdict's scale criterion: two 100k-row frames joining on
         device with device_join_probes > 0 (bounded multiplicity so the
