@@ -271,6 +271,39 @@ def run_device_rungs(scale: float) -> dict:
     finally:
         cfg.use_device_kernels = True
 
+    # ---- Q12 (string is_in filter + string group key): the device
+    # dictionary-code surface end to end — LUT filter, device group codes,
+    # fused segment aggs ----------------------------------------------------
+    try:
+        def run_q12():
+            return tpch.q12(frame).collect().to_pydict()
+
+        cfg.use_device_kernels = True
+        got12 = run_q12()  # cold: staging + compile
+        if _parity(got12, tpch.oracle_q12(lineitem), rtol=1e-6):
+            q12q = tpch.q12(frame)
+            q12q.collect()
+            c12 = q12q.stats.snapshot()["counters"]
+            if not c12.get("device_aggregations"):
+                out["q12_vs_baseline"] = 0.0
+                out["q12_error"] = "device_path_not_taken"
+                raise StopIteration  # handled by the except below
+            t_dev_q12, _ = _best_of(run_q12, n=2)
+            t_orc_q12, _ = _best_of(lambda: tpch.oracle_q12(lineitem), n=2)
+            out["q12_device_rows_per_sec"] = round(rows / t_dev_q12, 1)
+            out["q12_vs_baseline"] = round(t_orc_q12 / t_dev_q12, 3)
+            out["q12_device_group_codes"] = c12.get("device_group_codes", 0)
+        else:
+            out["q12_vs_baseline"] = 0.0
+            out["q12_error"] = "parity_mismatch"
+    except StopIteration:
+        pass  # device_path_not_taken already recorded
+    except Exception as e:
+        out["q12_vs_baseline"] = 0.0
+        out["q12_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        cfg.use_device_kernels = True
+
     # ---- LAION multimodal rung (BASELINE.md config): url.download ->
     # image.decode -> device-batched resize(224,224) -> tensor, vs a
     # hand-written same-algorithm oracle. Exercises the upload/download
@@ -471,6 +504,8 @@ def _host_fallback(scale: float) -> dict:
          .to_pydict(),
          lambda: tpch.oracle_q5(tables["customer"], tables["orders"],
                                 lineitem, tables["nation"])),
+        ("q12", lambda: tpch.q12(frame).collect().to_pydict(),
+         lambda: tpch.oracle_q12(lineitem)),
     ]
     for name, engine_fn, oracle_fn in rungs:
         try:  # parity gates timing, as everywhere else in this file

@@ -95,6 +95,13 @@ def generate_tables(scale: float = 0.01, seed: int = 42) -> Dict[str, pa.Table]:
         "l_linestatus": pa.array(status[rng.randint(0, 2, n_li)]),
         "l_shipdate": pa.array(l_shipdate.astype("datetime64[D]")),
     })
+    # l_shipmode draws AFTER the table above so every earlier column keeps
+    # its exact values (the rng stream is consumed in order; recorded
+    # baselines must not shift)
+    shipmodes = np.array(["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL",
+                          "FOB"])
+    lineitem = lineitem.append_column(
+        "l_shipmode", pa.array(shipmodes[rng.randint(0, 7, n_li)]))
     return {"lineitem": lineitem, "orders": orders, "customer": customer, "nation": nation}
 
 
@@ -183,6 +190,47 @@ def q5(customer, orders, lineitem, nation) -> "object":
         .agg(col("revenue").sum().alias("revenue"))
         .sort("revenue", desc=True)
     )
+
+
+def q12(lineitem) -> "object":
+    """TPC-H Q12-shaped rung (adapted to the generated schema): string
+    is_in + date-range filters feeding a string-keyed grouped aggregation —
+    the device dictionary-code surface end to end (LUT filter, device group
+    codes, fused segment aggs)."""
+    from daft_tpu import col
+
+    lo = datetime.date(1994, 1, 1)
+    hi = datetime.date(1995, 1, 1)
+    return (
+        lineitem
+        .where(col("l_shipmode").is_in(["MAIL", "SHIP"])
+               & (col("l_shipdate") >= lo) & (col("l_shipdate") < hi))
+        .groupby("l_shipmode")
+        .agg(col("l_extendedprice").sum().alias("revenue"),
+             col("l_quantity").count().alias("line_count"))
+        .sort("l_shipmode")
+    )
+
+
+def oracle_q12(lineitem: pa.Table) -> dict:
+    import pyarrow.compute as pc
+
+    lo = datetime.date(1994, 1, 1)
+    hi = datetime.date(1995, 1, 1)
+    mask = pc.and_(
+        pc.and_(pc.is_in(lineitem["l_shipmode"],
+                         value_set=pa.array(["MAIL", "SHIP"])),
+                pc.greater_equal(lineitem["l_shipdate"], pa.scalar(lo))),
+        pc.less(lineitem["l_shipdate"], pa.scalar(hi)))
+    t = lineitem.filter(mask)
+    out = pa.TableGroupBy(t.select(["l_shipmode", "l_extendedprice",
+                                    "l_quantity"]), "l_shipmode").aggregate(
+        [("l_extendedprice", "sum"), ("l_quantity", "count")])
+    order = pc.sort_indices(out["l_shipmode"])
+    out = out.take(order)
+    return {"l_shipmode": out["l_shipmode"].to_pylist(),
+            "revenue": out["l_extendedprice_sum"].to_pylist(),
+            "line_count": out["l_quantity_count"].to_pylist()}
 
 
 def q6(lineitem) -> "object":

@@ -148,6 +148,16 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     return vals, valid
 
 
+def _is_plain_string_key(table, key_expr) -> bool:
+    """Cheap shape check (no staging): the key normalizes to a bare string
+    Column, i.e. the joint-dictionary path could apply."""
+    from .device import _plain_string_column, normalize_and_check
+
+    nodes = normalize_and_check([key_expr], table.schema)
+    return (nodes is not None
+            and _plain_string_column(nodes[0], table.schema) is not None)
+
+
 @jax.jit
 def _recode(codes, remap):
     """Gather per-side dictionary codes into the JOINT dictionary's code
@@ -155,17 +165,19 @@ def _recode(codes, remap):
     return remap[codes]
 
 
-def _joint_remaps(ldc, rdc, cache):
+def _joint_remaps(ldc, rdc, lcache, rcache):
     """(lremap, rremap) device arrays mapping each side's dictionary codes
     into their sorted JOINT dictionary's code space. Cached per dictionary
-    PAIR (the cache entry pins both pa.Arrays, keeping the id-keys valid),
-    so a broadcast-shaped join of one build side against P probe partitions
-    merges the dictionaries once, not P times. Remaps pad to a size bucket
-    so _recode compiles per bucket, not per dictionary length."""
+    PAIR in BOTH sides' caches (the entry pins both pa.Arrays, keeping the
+    id-keys valid): a broadcast-shaped join of one build side against P
+    probe partitions hits the build side's cache, merging the dictionaries
+    once, not P times. Remaps pad to a size bucket so _recode compiles per
+    bucket, not per dictionary length."""
     key = ("__jointremap__", id(ldc.dictionary), id(rdc.dictionary))
-    cached = cache.get(key) if cache is not None else None
-    if cached is not None:
-        return cached[2], cached[3]
+    for cache in (lcache, rcache):
+        cached = cache.get(key) if cache is not None else None
+        if cached is not None:
+            return cached[2], cached[3]
     import pyarrow as pa
     import pyarrow.compute as pc
 
@@ -188,8 +200,10 @@ def _joint_remaps(ldc, rdc, cache):
 
     lremap = remap_of(ldc.dictionary)
     rremap = remap_of(rdc.dictionary)
-    if cache is not None:
-        cache[key] = (ldc.dictionary, rdc.dictionary, lremap, rremap)
+    entry = (ldc.dictionary, rdc.dictionary, lremap, rremap)
+    for cache in (lcache, rcache):
+        if cache is not None:
+            cache[key] = entry
     return lremap, rremap
 
 
@@ -234,7 +248,7 @@ def _stage_key_pair(ltable, rtable, lkey, rkey, lcache, rcache,
     rdc = rstaged[1][rc]
     if ldc.dictionary is None or rdc.dictionary is None:
         return None
-    lremap, rremap = _joint_remaps(ldc, rdc, lcache)
+    lremap, rremap = _joint_remaps(ldc, rdc, lcache, rcache)
     lv = _recode(ldc.values, lremap)
     rv = _recode(rdc.values, rremap)
     return (lv, ldc.valid), (rv, rdc.valid)
@@ -430,11 +444,13 @@ def device_join_indices(left_table, right_table, left_keys, right_keys,
     if rk is not None:
         lv, lm = lk
     else:
+        if lk is None and not _is_plain_string_key(left_table, left_key):
+            return None  # ineligible left key: don't stage the right side
         rk0 = _stage_key(right_table, right_key, right_cache)
         if lk is None or rk0 is None:
             # string keys (or one string side): recode through the joint
             # dictionary so equal strings get equal ints across tables
-            # (pre-staged sides pass through — no double dispatch)
+            # (pre-staged non-None sides pass through)
             pair = _stage_key_pair(left_table, right_table,
                                    left_key, right_key,
                                    left_cache, right_cache,

@@ -70,6 +70,14 @@ def test_q5_parity(tables, source, tmp_path, num_partitions):
 
 
 @pytest.mark.parametrize("source", ["arrow", "parquet"])
+def test_q12_parity(tables, source, tmp_path, num_partitions):
+    dfs = _dfs(tables, source, tmp_path, num_partitions)
+    got = tpch.q12(dfs["lineitem"]).to_pydict()
+    want = tpch.oracle_q12(tables["lineitem"])
+    _approx_dict(got, want)
+
+
+@pytest.mark.parametrize("source", ["arrow", "parquet"])
 def test_q6_parity(tables, source, tmp_path, num_partitions):
     dfs = _dfs(tables, source, tmp_path, num_partitions)
     got = tpch.q6(dfs["lineitem"]).to_pydict()["revenue"][0]
