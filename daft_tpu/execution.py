@@ -471,14 +471,7 @@ class ExecutionContext:
         (integer/date values; plain string columns via joint-dictionary
         recoding; composite keys pack into one lane), PK or N:M build
         sides (kernels/device_join.py). Host acero join otherwise."""
-        import numpy as np
-
-        eligible = (self.cfg.use_device_kernels
-                    and how in ("inner", "left", "semi", "anti")
-                    and 1 <= len(left_on) == len(right_on) <= 4
-                    and max(lpart.num_rows_or_none() or 0,
-                            rpart.num_rows_or_none() or 0) >= self.cfg.device_min_rows)
-        if eligible:
+        if self._join_eligible(lpart, rpart, left_on, right_on, how):
             try:
                 from .kernels.device_join import (device_join_indices,
                                                   join_key_replicas)
@@ -494,37 +487,104 @@ class ExecutionContext:
             except Exception:
                 res = None
             if res is not None:
-                from .series import Series
-
-                side, hit, bidx = res
-                ltbl, rtbl = lpart.table(), rpart.table()
                 self.stats.bump("device_join_probes")
-                if side == "expanded":
-                    # N:M range join: (lidx, ridx) pairs already expanded on
-                    # host from the device range probe (-1 = left-outer miss)
-                    out = ltbl.join_from_indices(rtbl, hit, bidx,
-                                                 left_on, right_on, suffix)
-                elif side == "right_build":
-                    if how == "semi":
-                        out = ltbl.filter_with_mask(Series.from_numpy(hit, "m"))
-                    elif how == "anti":
-                        out = ltbl.filter_with_mask(Series.from_numpy(~hit, "m"))
-                    elif how == "inner":
-                        lidx = np.nonzero(hit)[0]
-                        out = ltbl.join_from_indices(rtbl, lidx, bidx[hit],
-                                                     left_on, right_on, suffix)
-                    else:  # left outer: every left row, -1 -> null right
-                        lidx = np.arange(len(ltbl), dtype=np.int64)
-                        ridx = np.where(hit, bidx, -1)
-                        out = ltbl.join_from_indices(rtbl, lidx, ridx,
-                                                     left_on, right_on, suffix)
-                else:  # left_build (inner only): re-sort to host (lidx, ridx) order
-                    ridx = np.nonzero(hit)[0]
-                    lidx = bidx[hit]
-                    order = np.argsort(lidx, kind="stable")
-                    out = ltbl.join_from_indices(rtbl, lidx[order], ridx[order],
-                                                 left_on, right_on, suffix)
-                return MicroPartition.from_table(out)
+                return self._assemble_join(res, lpart, rpart, left_on,
+                                           right_on, how, suffix)
+        self.stats.bump("host_joins")
+        return lpart.hash_join(rpart, left_on, right_on, how, suffix)
+
+    def _join_eligible(self, lpart, rpart, left_on, right_on, how) -> bool:
+        return (self.cfg.use_device_kernels
+                and how in ("inner", "left", "semi", "anti")
+                and 1 <= len(left_on) == len(right_on) <= 4
+                and max(lpart.num_rows_or_none() or 0,
+                        rpart.num_rows_or_none() or 0)
+                >= self.cfg.device_min_rows)
+
+    def _assemble_join(self, res, lpart, rpart, left_on, right_on, how,
+                       suffix) -> MicroPartition:
+        """(side, hit, bidx) probe result -> output partition (shared by the
+        blocking and pipelined join paths)."""
+        import numpy as np
+
+        from .series import Series
+
+        side, hit, bidx = res
+        ltbl, rtbl = lpart.table(), rpart.table()
+        if side == "expanded":
+            # N:M range join: (lidx, ridx) pairs already expanded on
+            # host from the device range probe (-1 = left-outer miss)
+            out = ltbl.join_from_indices(rtbl, hit, bidx,
+                                         left_on, right_on, suffix)
+        elif side == "right_build":
+            if how == "semi":
+                out = ltbl.filter_with_mask(Series.from_numpy(hit, "m"))
+            elif how == "anti":
+                out = ltbl.filter_with_mask(Series.from_numpy(~hit, "m"))
+            elif how == "inner":
+                lidx = np.nonzero(hit)[0]
+                out = ltbl.join_from_indices(rtbl, lidx, bidx[hit],
+                                             left_on, right_on, suffix)
+            else:  # left outer: every left row, -1 -> null right
+                lidx = np.arange(len(ltbl), dtype=np.int64)
+                ridx = np.where(hit, bidx, -1)
+                out = ltbl.join_from_indices(rtbl, lidx, ridx,
+                                             left_on, right_on, suffix)
+        else:  # left_build (inner only): re-sort to host (lidx, ridx) order
+            ridx = np.nonzero(hit)[0]
+            lidx = bidx[hit]
+            order = np.argsort(lidx, kind="stable")
+            out = ltbl.join_from_indices(rtbl, lidx[order], ridx[order],
+                                         left_on, right_on, suffix)
+        return MicroPartition.from_table(out)
+
+    def eval_join_dispatch(self, lpart: MicroPartition, rpart: MicroPartition,
+                           left_on, right_on, how: str, suffix: str):
+        """Non-blocking join launch: stage both sides' keys and dispatch the
+        right-build range probe now; the returned finisher resolves the
+        probe and assembles the output — the join op stages pair i+1 while
+        pair i probes (same contract as eval_projection_dispatch; PARITY
+        known-gap 36). Returns None when ineligible (caller joins
+        synchronously)."""
+        if not self._join_eligible(lpart, rpart, left_on, right_on, how):
+            return None
+        try:
+            from .kernels.device_join import (device_join_launch,
+                                              join_key_replicas)
+
+            single = len(left_on) == 1
+            launch = device_join_launch(
+                lpart.table(), rpart.table(), list(left_on), list(right_on),
+                lpart.device_stage_cache(), rpart.device_stage_cache(), how,
+                left_replicas=(join_key_replicas(lpart, left_on[0])
+                               if single else None),
+                right_replicas=(join_key_replicas(rpart, right_on[0])
+                                if single else None))
+        except Exception:
+            return None
+        if launch is None:
+            return None
+        self.stats.bump("device_join_dispatches")
+
+        def finish() -> MicroPartition:
+            try:
+                res = launch()
+                out = self._assemble_join(res, lpart, rpart, left_on,
+                                          right_on, how, suffix)
+                self.stats.bump("device_join_probes")
+                return out
+            except Exception:
+                self.stats.bump("device_join_fallbacks")
+                self.stats.bump("host_joins")
+                return lpart.hash_join(rpart, left_on, right_on, how, suffix)
+
+        return finish
+
+    def eval_join_declined(self, lpart, rpart, left_on, right_on, how,
+                           suffix) -> MicroPartition:
+        """Host join for a pair the dispatch already proved device-
+        ineligible — never re-stage a doomed attempt (the
+        map_partition_declined convention)."""
         self.stats.bump("host_joins")
         return lpart.hash_join(rpart, left_on, right_on, how, suffix)
 

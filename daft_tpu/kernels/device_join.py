@@ -395,7 +395,24 @@ def _device_of(arr):
 def device_join_indices(left_table, right_table, left_keys, right_keys,
                         left_cache=None, right_cache=None, how: str = "inner",
                         left_replicas=None, right_replicas=None):
-    """Probe on device. Returns (side, hit, bidx):
+    """Blocking device probe: launch + resolve in one call (see
+    device_join_launch for the pipelined split). Returns (side, hit, bidx)
+    or None when ineligible."""
+    launch = device_join_launch(left_table, right_table, left_keys,
+                                right_keys, left_cache, right_cache, how,
+                                left_replicas, right_replicas)
+    return None if launch is None else launch()
+
+
+def device_join_launch(left_table, right_table, left_keys, right_keys,
+                       left_cache=None, right_cache=None, how: str = "inner",
+                       left_replicas=None, right_replicas=None):
+    """Stage the keys and LAUNCH the right-build range probe WITHOUT
+    blocking (jax dispatch is asynchronous); the returned zero-arg resolver
+    makes the dup decision, runs any second-orientation probe, and returns
+    (side, hit, bidx) — the executor stages pair i+1 while pair i probes,
+    the join flavor of the double-buffered projection dispatch (PARITY
+    known-gap 36). Resolver contract, or None when ineligible:
 
     - side == "right_build": hit/bidx are per LEFT row (bidx indexes right)
     - side == "left_build": hit/bidx are per RIGHT row (bidx indexes left)
@@ -431,7 +448,7 @@ def device_join_indices(left_table, right_table, left_keys, right_keys,
         if packed is None:
             return None
         (lv, lm), (rv, rm) = packed
-        return _probe_both_ways(lv, lm, rv, rm, ln, rn, how)
+        return _launch_probe(lv, lm, rv, rm, ln, rn, how)
     left_key, right_key = left_keys[0], right_keys[0]
     lk = _stage_key(left_table, left_key, left_cache)
     rk = None
@@ -468,25 +485,31 @@ def device_join_indices(left_table, right_table, left_keys, right_keys,
     rv, rm = rk
     if lv.dtype != rv.dtype:
         return None
-    return _probe_both_ways(lv, lm, rv, rm, ln, rn, how)
+    return _launch_probe(lv, lm, rv, rm, ln, rn, how)
 
 
-def _probe_both_ways(lv, lm, rv, rm, ln: int, rn: int, how: str):
-    # build=right first (probe order == host output order); ONE sort serves
-    # whichever path the dup flag selects
+def _launch_probe(lv, lm, rv, rm, ln: int, rn: int, how: str):
+    """Dispatch the right-build range probe now (async); return the
+    resolver that makes the dup decision and finishes the probe."""
     lo, counts, perm, dup = _range_probe_kernel(rv, rm, lv, lm)
-    if not bool(dup):
-        hit, bidx = _pk_outputs(lo, counts, perm)
-        hit = np.asarray(jax.device_get(hit))[:ln]
-        bidx = np.asarray(jax.device_get(bidx))[:ln].astype(np.int64)
-        return "right_build", hit, bidx
-    if how == "inner":
-        lo2, counts2, perm2, dup2 = _range_probe_kernel(lv, lm, rv, rm)
-        if not bool(dup2):
-            hit, bidx = _pk_outputs(lo2, counts2, perm2)
-            hit = np.asarray(jax.device_get(hit))[:rn]
-            bidx = np.asarray(jax.device_get(bidx))[:rn].astype(np.int64)
-            return "left_build", hit, bidx
-    # duplicate build keys on every usable orientation: N:M range join,
-    # reusing the right-build probe already on device
-    return _range_join(lo, counts, perm, ln, how)
+
+    def resolve():
+        # build=right first (probe order == host output order); ONE sort
+        # serves whichever path the dup flag selects
+        if not bool(dup):
+            hit, bidx = _pk_outputs(lo, counts, perm)
+            hit = np.asarray(jax.device_get(hit))[:ln]
+            bidx = np.asarray(jax.device_get(bidx))[:ln].astype(np.int64)
+            return "right_build", hit, bidx
+        if how == "inner":
+            lo2, counts2, perm2, dup2 = _range_probe_kernel(lv, lm, rv, rm)
+            if not bool(dup2):
+                hit, bidx = _pk_outputs(lo2, counts2, perm2)
+                hit = np.asarray(jax.device_get(hit))[:rn]
+                bidx = np.asarray(jax.device_get(bidx))[:rn].astype(np.int64)
+                return "left_build", hit, bidx
+        # duplicate build keys on every usable orientation: N:M range join,
+        # reusing the right-build probe already on device
+        return _range_join(lo, counts, perm, ln, how)
+
+    return resolve

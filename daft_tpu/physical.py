@@ -761,6 +761,26 @@ class ConcatOp(PhysicalOp):
             yield part.cast_to_schema(self.schema)
 
 
+def _pipelined_join(ctx, pairs, how: str, suffix: str):
+    """Shared double-buffered join driver: for each (l, r, lon, ron) pair,
+    pair i+1's keys stage and its probe LAUNCHES while pair i's result
+    resolves (one pending slot bounds the extra HBM to one in-flight
+    pair). A declined dispatch goes straight to the host join — never
+    re-staging the attempt dispatch just proved doomed."""
+    pending = None
+    for l, r, lon, ron in pairs:
+        fin = ctx.eval_join_dispatch(l, r, lon, ron, how, suffix)
+        if pending is not None:
+            yield pending()
+            pending = None
+        if fin is not None:
+            pending = fin
+        else:
+            yield ctx.eval_join_declined(l, r, lon, ron, how, suffix)
+    if pending is not None:
+        yield pending()
+
+
 class HashJoinOp(PhysicalOp):
     """Partition-aligned join: bucket i of left joins bucket i of right.
     Upstream ShuffleOps co-partition both sides."""
@@ -784,17 +804,21 @@ class HashJoinOp(PhysicalOp):
         lschema = self.children[0].schema
         rschema = self.children[1].schema
         # drain() is lazy: a partition's held bytes leave the ledger only when
-        # its pair is consumed, and the ref drops right after the join
+        # its pair is consumed, and the ref drops right after the join.
         liter = lbuf.drain()
         riter = rbuf.drain()
-        for _ in range(n):
-            l = next(liter, None)
-            r = next(riter, None)
-            if l is None:
-                l = MicroPartition.empty(lschema)
-            if r is None:
-                r = MicroPartition.empty(rschema)
-            yield ctx.eval_join(l, r, self.left_on, self.right_on, self.how, self.suffix)
+
+        def pairs():
+            for _ in range(n):
+                l = next(liter, None)
+                r = next(riter, None)
+                if l is None:
+                    l = MicroPartition.empty(lschema)
+                if r is None:
+                    r = MicroPartition.empty(rschema)
+                yield l, r, self.left_on, self.right_on
+
+        yield from _pipelined_join(ctx, pairs(), self.how, self.suffix)
 
     def describe(self):
         return f"HashJoin[{self.how}]"
@@ -821,13 +845,15 @@ class BroadcastJoinOp(PhysicalOp):
         # (one ICI broadcast); per-partition probes then stay device-local
         small = ctx.prepare_broadcast(small, self.small_on, self.how)
         ctx.stats.bump("broadcast_joins")
-        for part in inputs[0]:
-            if self.small_is_left:
-                yield ctx.eval_join(small, part, self.small_on, self.big_on,
-                                    self.how, self.suffix)
-            else:
-                yield ctx.eval_join(part, small, self.big_on, self.small_on,
-                                    self.how, self.suffix)
+
+        def pairs():
+            for part in inputs[0]:
+                if self.small_is_left:
+                    yield small, part, self.small_on, self.big_on
+                else:
+                    yield part, small, self.big_on, self.small_on
+
+        yield from _pipelined_join(ctx, pairs(), self.how, self.suffix)
 
     def describe(self):
         return f"BroadcastJoin[{self.how}]"
