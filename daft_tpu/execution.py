@@ -467,31 +467,15 @@ class ExecutionContext:
 
     def eval_join(self, lpart: MicroPartition, rpart: MicroPartition,
                   left_on, right_on, how: str, suffix: str) -> MicroPartition:
-        """Route a join through the device probe when eligible: 1-4 keys
-        (integer/date values; plain string columns via joint-dictionary
-        recoding; composite keys pack into one lane), PK or N:M build
-        sides (kernels/device_join.py). Host acero join otherwise."""
-        if self._join_eligible(lpart, rpart, left_on, right_on, how):
-            try:
-                from .kernels.device_join import (device_join_indices,
-                                                  join_key_replicas)
-
-                single = len(left_on) == 1
-                res = device_join_indices(
-                    lpart.table(), rpart.table(), list(left_on), list(right_on),
-                    lpart.device_stage_cache(), rpart.device_stage_cache(), how,
-                    left_replicas=(join_key_replicas(lpart, left_on[0])
-                                   if single else None),
-                    right_replicas=(join_key_replicas(rpart, right_on[0])
-                                    if single else None))
-            except Exception:
-                res = None
-            if res is not None:
-                self.stats.bump("device_join_probes")
-                return self._assemble_join(res, lpart, rpart, left_on,
-                                           right_on, how, suffix)
-        self.stats.bump("host_joins")
-        return lpart.hash_join(rpart, left_on, right_on, how, suffix)
+        """Blocking join: the pipelined dispatch-or-declined pair in one
+        call, so there is exactly ONE join code path (kernels/device_join.py
+        when eligible, host acero otherwise)."""
+        fin = self.eval_join_dispatch(lpart, rpart, left_on, right_on, how,
+                                      suffix)
+        if fin is not None:
+            return fin()
+        return self.eval_join_declined(lpart, rpart, left_on, right_on, how,
+                                       suffix)
 
     def _join_eligible(self, lpart, rpart, left_on, right_on, how) -> bool:
         return (self.cfg.use_device_kernels
@@ -569,14 +553,17 @@ class ExecutionContext:
         def finish() -> MicroPartition:
             try:
                 res = launch()
-                out = self._assemble_join(res, lpart, rpart, left_on,
-                                          right_on, how, suffix)
-                self.stats.bump("device_join_probes")
-                return out
             except Exception:
                 self.stats.bump("device_join_fallbacks")
                 self.stats.bump("host_joins")
                 return lpart.hash_join(rpart, left_on, right_on, how, suffix)
+            # assembly runs OUTSIDE the catch-all: a defect there must crash
+            # loudly, not silently recompute on host (same error contract
+            # as the blocking path)
+            out = self._assemble_join(res, lpart, rpart, left_on,
+                                      right_on, how, suffix)
+            self.stats.bump("device_join_probes")
+            return out
 
         return finish
 
