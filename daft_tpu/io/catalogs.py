@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Callable, List, Optional, Union
 
 import pyarrow as pa
@@ -199,7 +200,15 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
         # relocated table with thousands of files pays one timeout, not one
         # per file.
         root = "/".join(p.split("/", 3)[:3])
-        if root not in _DEAD_EXTERNAL_ROOTS:
+        condemned_at = _DEAD_EXTERNAL_ROOTS.get(root)
+        if condemned_at is not None and \
+                time.monotonic() - condemned_at > _DEAD_ROOT_TTL_S:
+            # a blip must not remap paths for the process lifetime: after the
+            # TTL the next file re-probes the root and can resurrect it
+            # (pop: two threads may expire the same root concurrently)
+            _DEAD_EXTERNAL_ROOTS.pop(root, None)
+            condemned_at = None
+        if condemned_at is None:
             from .object_store import TransientIOError
 
             try:
@@ -211,7 +220,7 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
                 # is the store talking to us, and must not silently remap
                 # 999 remaining files after one throttle
                 if isinstance(e.__cause__, OSError):
-                    _DEAD_EXTERNAL_ROOTS.add(root)
+                    _DEAD_EXTERNAL_ROOTS[root] = time.monotonic()
             except Exception:
                 pass  # absent (404 etc.): remap this file, keep probing root
     elif STORAGE.exists(p):
@@ -226,7 +235,11 @@ def _iceberg_resolve(table_uri: str, uri: str) -> str:
     return STORAGE.join(table_uri, p.rsplit("/", 1)[-1])
 
 
-_DEAD_EXTERNAL_ROOTS: set = set()
+# store root -> monotonic time it was condemned; entries expire after
+# _DEAD_ROOT_TTL_S so one network blip cannot permanently redirect every
+# subsequent external path to the table location (advisor r4)
+_DEAD_EXTERNAL_ROOTS: dict = {}
+_DEAD_ROOT_TTL_S = 60.0
 
 
 def _read_avro_any(path: str):

@@ -175,6 +175,15 @@ def _http_request(url: str, method: str = "GET",
     return status, rheaders, data
 
 
+def _raise_http(op: str, path: str, status: int):
+    """Map a terminal HTTP status to the typed error discipline: 404 is the
+    distinguishable not-found (so exists() can answer False without
+    swallowing auth/transient failures); everything else stays IOError."""
+    if status == 404:
+        raise NotFoundIOError(f"{op} {path}: HTTP 404")
+    raise IOError(f"{op} {path}: HTTP {status}")
+
+
 class HttpSource(ObjectSource):
     """http(s) objects with Range reads and redirect following
     (reference: http.rs)."""
@@ -205,15 +214,19 @@ class HttpSource(ObjectSource):
             headers["Range"] = f"bytes={range[0]}-{range[1] - 1}"
         status, _h, data = self._request(path, headers=headers, timeout=timeout)
         if status not in (200, 206):
-            raise IOError(f"GET {path}: HTTP {status}")
+            _raise_http("GET", path, status)
         if range is not None and status == 200:
             return data[range[0]:range[1]]  # server ignored Range
         return data
 
     def get_size(self, path):
         status, h, _ = self._request(path, method="HEAD")
-        if status != 200 or "content-length" not in h:
-            raise IOError(f"HEAD {path}: HTTP {status}")
+        if status != 200:
+            _raise_http("HEAD", path, status)
+        if "content-length" not in h:
+            # 200 without Content-Length (chunked/dynamic HEAD): the object
+            # exists but its size is only learnable by reading it
+            return len(self.get(path))
         return int(h["content-length"])
 
     def put(self, path, data, if_none_match=False):
@@ -359,7 +372,7 @@ class S3Source(ObjectSource):
             url, headers=headers,
             timeout=timeout if timeout is not None else self.cfg.timeout)
         if status not in (200, 206):
-            raise IOError(f"GET {path}: HTTP {status}")
+            _raise_http("GET", path, status)
         if range is not None and status == 200:
             return data[range[0]:range[1]]  # endpoint ignored Range
         return data
@@ -371,7 +384,7 @@ class S3Source(ObjectSource):
                                      headers=self._headers("HEAD", url),
                                      timeout=self.cfg.timeout)
         if status != 200 or "content-length" not in h:
-            raise IOError(f"HEAD {path}: HTTP {status}")
+            _raise_http("HEAD", path, status)
         return int(h["content-length"])
 
     # Multipart kicks in above this size (instance attrs so tests can force
@@ -716,8 +729,12 @@ class AzureSource(ObjectSource):
             if k.lower().startswith("x-ms-"))
         # canonicalized resource: /account/path plus sorted query params
         path = u.path or "/"
-        # strip a test-endpoint's duplicated account segment so the signed
-        # resource matches what the service canonicalizes
+        # canonical resource = "/" + account + url-path. Against azurite-style
+        # test endpoints the url path itself already starts with /account (the
+        # emulator scopes urls by account), so the canonical string legitimately
+        # names the account twice — once from this prefix, once inside `path`.
+        # That matches what azurite canonicalizes server-side; do NOT "fix" it
+        # by stripping the duplicate or signing breaks.
         resource = f"/{self.cfg.account}{path}"
         if u.query:
             params = sorted(p.split("=", 1) for p in u.query.split("&"))
@@ -752,7 +769,7 @@ class AzureSource(ObjectSource):
             url, headers=headers,
             timeout=timeout if timeout is not None else self.cfg.timeout)
         if status not in (200, 206):
-            raise IOError(f"GET {path}: HTTP {status}")
+            _raise_http("GET", path, status)
         if range is not None and status == 200:
             return data[range[0]:range[1]]
         return data
@@ -764,7 +781,7 @@ class AzureSource(ObjectSource):
                                      headers=self._headers("HEAD", url),
                                      timeout=self.cfg.timeout)
         if status != 200 or "content-length" not in h:
-            raise IOError(f"HEAD {path}: HTTP {status}")
+            _raise_http("HEAD", path, status)
         return int(h["content-length"])
 
     def put(self, path, data, if_none_match=False):
@@ -902,7 +919,7 @@ class HuggingFaceSource(ObjectSource):
         status, _h, data = self._http._request(url, headers=headers,
                                                timeout=timeout)
         if status not in (200, 206):
-            raise IOError(f"GET {path}: HTTP {status}")
+            _raise_http("GET", path, status)
         if range is not None and status == 200:
             return data[range[0]:range[1]]
         return data
@@ -914,7 +931,7 @@ class HuggingFaceSource(ObjectSource):
         # the Hub reports the LFS object size in x-linked-size on redirects
         size = h.get("x-linked-size") or h.get("content-length")
         if status != 200 or not size:
-            raise IOError(f"HEAD {path}: HTTP {status}")
+            _raise_http("HEAD", path, status)
         return int(size)
 
     def ls(self, prefix):
@@ -1023,10 +1040,13 @@ class IOClient:
             self.retry.run(lambda: src.delete(path))
 
     def exists(self, path: str) -> bool:
+        """True/False only for genuine presence/absence. Auth failures and
+        exhausted-retry 5xx propagate — an outage must never read as
+        'object absent' (same discipline as Storage.list_names)."""
         try:
             self.get_size(path)
             return True
-        except (IOError, OSError):
+        except (NotFoundIOError, FileNotFoundError, NotADirectoryError):
             return False
 
     def ls(self, prefix: str) -> List[ObjectMeta]:

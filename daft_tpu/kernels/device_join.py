@@ -83,7 +83,14 @@ def _range_join(lo_d, counts_d, perm_d, ln: int, how: str):
     device range probe. Returns the executor contract — ("right_build",
     hit, _) for semi/anti (only the hit mask is consumed), or ("expanded",
     lidx, ridx) index pairs for inner/left (ridx == -1 marks a left-outer
-    miss)."""
+    miss).
+
+    Order contract: rows come out left-row-major with matches in
+    sorted-build-key (perm) order — which differs from the acero host
+    join's order. That is fine: join output order is UNSPECIFIED
+    engine-wide (see Table.hash_join), so a query flipping between device
+    and host paths may legitimately reorder rows; only the multiset is
+    guaranteed."""
     lo = np.asarray(jax.device_get(lo_d))[:ln].astype(np.int64)
     counts = np.asarray(jax.device_get(counts_d))[:ln].astype(np.int64)
     perm = np.asarray(jax.device_get(perm_d)).astype(np.int64)

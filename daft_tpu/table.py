@@ -612,7 +612,15 @@ class Table:
     def hash_join(self, right: "Table", left_on: Sequence[Expression],
                   right_on: Sequence[Expression], how: str = "inner",
                   suffix: str = "right.") -> "Table":
-        """Hash join with SQL null semantics (null keys never match)."""
+        """Hash join with SQL null semantics (null keys never match).
+
+        Output ROW ORDER IS UNSPECIFIED, as in the reference (Rust probe
+        tables emit in probe-visit x hash-bucket order, acero in its own
+        thread-interleaved order, and the device range probe in left-row-major
+        x sorted-build-key order). Callers needing determinism sort after the
+        join; tests compare sorted rows. This is the engine-wide join order
+        contract — the device/host paths are free to disagree on order while
+        agreeing on the multiset of rows."""
         how_map = {
             "inner": "inner", "left": "left outer", "right": "right outer",
             "outer": "full outer", "semi": "left semi", "anti": "left anti",
