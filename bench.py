@@ -510,8 +510,10 @@ def _host_fallback(scale: float) -> dict:
     for name, engine_fn, oracle_fn in rungs:
         try:  # parity gates timing, as everywhere else in this file
             if _parity(engine_fn(), oracle_fn(), rtol=1e-6):
-                t_eng, _ = _best_of(engine_fn, n=2)
-                t_orc, _ = _best_of(oracle_fn, n=2)
+                # sub-second rungs: best-of-3 rides out the host's drifting
+                # memory bandwidth (bench_env records it)
+                t_eng, _ = _best_of(engine_fn, n=3)
+                t_orc, _ = _best_of(oracle_fn, n=3)
                 out[f"{name}_host_vs_baseline"] = round(t_orc / t_eng, 3)
             else:
                 out[f"{name}_host_vs_baseline"] = 0.0
@@ -520,7 +522,14 @@ def _host_fallback(scale: float) -> dict:
     try:  # the multimodal rung still measures on host (resize runs on CPU)
         from benchmarks import laion
 
-        host_laion = laion.run_rung(n=400)
+        # n=10,000 approaches the BASELINE.md shape; the rung is long enough
+        # that best-of-1 timing noise is sub-1% (VERDICT r4 #3). Peak RSS is
+        # ~10 GB of float32 intermediates across engine+oracle — degrade n on
+        # a loaded host rather than risk an OOM kill that loses the whole
+        # JSON line (same discipline as the q1 RAM gate above).
+        avail = _avail_ram_gb()
+        laion_n = 10000 if avail >= 24 else (2000 if avail >= 8 else 500)
+        host_laion = laion.run_rung(n=laion_n, best_of=1)
         out["laion_host_rows_per_sec"] = host_laion.get(
             "laion_device_rows_per_sec", 0.0)
         out["laion_host_vs_baseline"] = host_laion.get("laion_vs_baseline", 0.0)
@@ -536,12 +545,41 @@ def _host_fallback(scale: float) -> dict:
     return out
 
 
+def _bench_env() -> dict:
+    """Machine-state fingerprint recorded with every artifact: the 1-CPU
+    build host's effective memory bandwidth drifts 3-4x with neighbor load
+    (observed r5: a 528 MB copy 0.14s..1.4s), so round-over-round host
+    deltas are only attributable with the load AND measured bandwidth
+    pinned next to the numbers (VERDICT r4 weak #3)."""
+    import numpy as np
+
+    try:
+        la1, la5, _ = os.getloadavg()
+    except OSError:
+        la1 = la5 = -1.0
+    try:
+        nproc = sum(1 for p in os.listdir("/proc") if p.isdigit())
+    except OSError:
+        nproc = -1
+    a = np.empty(256 * 1024 * 1024 // 8, dtype=np.float64)
+    a[::4096] = 1.0  # fault the pages in before timing
+    t0 = time.perf_counter()
+    a.copy()
+    dt = time.perf_counter() - t0
+    return {"cpu_count": os.cpu_count(), "load_1m": round(la1, 2),
+            "load_5m": round(la5, 2), "processes": nproc,
+            "mem_available_gb": round(_avail_ram_gb(), 1),
+            "memcpy_gbps": round(2 * a.nbytes / dt / 1e9, 2)}
+
+
 def main() -> int:
     scale = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
     metric = f"tpch_q1_sf{scale:g}_device_rows_per_sec"
+    env = _bench_env()
 
     if _tpu_alive():
         out = run_device_rungs(scale)
+        out["bench_env"] = env
         print(json.dumps(out))
         return 0 if out.get("value") else 1
 
@@ -554,10 +592,14 @@ def main() -> int:
         if snap.get("snapshot_unix_time"):
             snap["snapshot_age_s"] = round(
                 time.time() - snap["snapshot_unix_time"], 1)
+        # the snapshot's own bench_env describes the machine AT MEASUREMENT
+        # time — keep it; the replaying host's state goes under its own key
+        snap["bench_env_replay"] = env
         print(json.dumps(snap))
         return 0
 
     out = _host_fallback(scale)
+    out["bench_env"] = env
     print(json.dumps(out))
     return 1
 

@@ -312,6 +312,33 @@ def read_json_table(path: str, pushdowns: Optional[Pushdowns] = None,
     return _drop_filter_only_columns(tbl, pushdowns)
 
 
+def read_arrow_ipc_table(path: str, pushdowns: Optional[Pushdowns] = None,
+                         schema: Optional[Schema] = None, **_kw) -> Table:
+    """Arrow IPC (feather v2) reader — the engine's SPILL format. Spilled
+    partitions re-materialize at memcpy speed through a memory-mapped file:
+    no parquet decode, and the page cache serves repeated reads directly
+    (reference role: the reference streams spilled state back through arrow
+    buffers rather than re-encoding, daft-local-execution spill handling)."""
+    pushdowns = pushdowns or Pushdowns()
+    columns = None
+    if schema is not None and pushdowns.columns is not None:
+        columns = [c for c in _project_columns(schema.field_names(), pushdowns)
+                   if c in schema]
+    # NOT a context manager: the table's buffers are zero-copy views onto
+    # the map; the file stays open until the buffers drop their references
+    source = pa.memory_map(path)
+    arrow_tbl = pa.ipc.open_file(source).read_all()
+    if columns is not None:
+        arrow_tbl = arrow_tbl.select(columns)
+    tbl = Table.from_arrow(arrow_tbl)
+    tbl = _residual_filter(tbl, pushdowns)
+    if pushdowns.limit is not None and len(tbl) > pushdowns.limit:
+        tbl = tbl.slice(0, pushdowns.limit)
+    IO_STATS.bump(files_opened=1, bytes_read=arrow_tbl.nbytes,
+                  rows_read=len(arrow_tbl), columns_read=tbl.num_columns())
+    return _drop_filter_only_columns(tbl, pushdowns)
+
+
 def infer_json_schema(path: str, **_kw) -> Schema:
     # read a prefix block only
     arrow_tbl = pajson.read_json(open_prefix_bytes(path), read_options=pajson.ReadOptions(block_size=1 << 20))

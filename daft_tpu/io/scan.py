@@ -62,6 +62,9 @@ class FileFormat:
     PARQUET = "parquet"
     CSV = "csv"
     JSON = "json"
+    # engine-internal spill format: uncompressed arrow IPC re-materializes at
+    # memcpy speed where parquet would pay encode+decode per spilled partition
+    ARROW_IPC = "arrow_ipc"
 
 
 class Pushdowns:
@@ -227,6 +230,11 @@ class ScanTask:
                                  **self.storage_options)
         elif self.format == FileFormat.JSON:
             tbl = read_json_table(self.path, self.pushdowns, schema=self.schema)
+        elif self.format == FileFormat.ARROW_IPC:
+            from .readers import read_arrow_ipc_table
+
+            tbl = read_arrow_ipc_table(self.path, self.pushdowns,
+                                       schema=self.schema)
         else:
             raise ValueError(f"unknown scan format {self.format!r}")
         if self.partition_values:

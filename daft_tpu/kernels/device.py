@@ -561,6 +561,160 @@ def _strlut_env_key(node_key) -> str:
     return f"__strlut__\x00{node_key}"
 
 
+# ---------------------------------------------------------------------------
+# Joint-dictionary string groups: col-vs-col compares + string if_else/
+# fill_null. Per-column dictionary codes are incomparable across columns, so
+# every interacting group of string columns (+ literals) merges into ONE
+# sorted joint dictionary at staging time; each column gets a small remap
+# array injected into env and the closures compare/select JOINT codes on
+# device. Same technique as the cross-table join-key recoding
+# (device_join._joint_remaps); reference semantics: fully general utf8
+# kernels, src/daft-core/src/array/ops/{utf8.rs,if_else.rs}.
+# ---------------------------------------------------------------------------
+
+
+def _string_colcol_shape(node, schema):
+    """(lcol, rcol) when `node` compares two plain string Columns."""
+    from ..expressions import BinaryOp
+
+    if not (isinstance(node, BinaryOp) and node.op in _CMP_OPS + ("<=>",)):
+        return None
+    lcol = _plain_string_column(node.left, schema)
+    rcol = _plain_string_column(node.right, schema)
+    if lcol is not None and rcol is not None:
+        return lcol, rcol
+    return None
+
+
+class _StringChoice:
+    """Shape of a string-producing FillNull/IfElse over plain string columns
+    and string literals: `operands` is [('col', name) | ('lit', value) |
+    ('null', None)] in positional order (child, fill) / (if_true, if_false);
+    `pred` is the IfElse predicate node (None for FillNull)."""
+
+    __slots__ = ("kind", "pred", "operands", "cols", "lits")
+
+    def __init__(self, kind, pred, operands):
+        self.kind = kind
+        self.pred = pred
+        self.operands = operands
+        self.cols = tuple(sorted({v for k, v in operands if k == "col"}))
+        self.lits = tuple(sorted({v for k, v in operands if k == "lit"}))
+
+
+def _string_choice_shape(node, schema):
+    """_StringChoice for a string-typed FillNull/IfElse whose value operands
+    are plain string columns / string literals / null literals; else None."""
+    from ..expressions import Alias, FillNull, IfElse, Literal
+
+    while isinstance(node, Alias):
+        node = node.child
+    if isinstance(node, FillNull):
+        kind, pred, vals = "fill_null", None, (node.child, node.fill)
+    elif isinstance(node, IfElse):
+        kind, pred, vals = "if_else", node.pred, (node.if_true, node.if_false)
+    else:
+        return None
+    try:
+        if not node.to_field(schema).dtype.is_string():
+            return None
+    except (ValueError, KeyError):
+        return None
+    operands = []
+    for v in vals:
+        c = _plain_string_column(v, schema)
+        if c is not None:
+            operands.append(("col", c))
+        elif isinstance(v, Literal) and v.value is None:
+            operands.append(("null", None))
+        elif (isinstance(v, Literal) and isinstance(v.value, str)
+              and (v.dtype.is_string() or v.dtype.is_null())):
+            operands.append(("lit", v.value))
+        else:
+            return None
+    return _StringChoice(kind, pred, operands)
+
+
+def _joint_group_of(node, schema):
+    """(cols, lits) joint-dictionary group for a node, or None."""
+    cc = _string_colcol_shape(node, schema)
+    if cc is not None:
+        return tuple(sorted(set(cc))), ()
+    ch = _string_choice_shape(node, schema)
+    if ch is not None:
+        return ch.cols, ch.lits
+    return None
+
+
+def _joint_gkey(cols, lits) -> str:
+    return "\x1f".join(cols) + "\x1e" + "\x1f".join(lits)
+
+
+def _joint_map_key(gkey: str, col: str) -> str:
+    return f"__joint__\x00{gkey}\x00map\x00{col}"
+
+
+def _joint_lit_key(gkey: str, lit: str) -> str:
+    return f"__joint__\x00{gkey}\x00lit\x00{lit}"
+
+
+def collect_joint_groups(nodes, schema):
+    """Every joint-dictionary group in the trees."""
+    out = []
+
+    def walk(n):
+        g = _joint_group_of(n, schema)
+        if g is not None:
+            out.append(g)
+        for c in n.children():
+            walk(c)
+
+    for nd in nodes:
+        walk(nd)
+    return out
+
+
+def string_joint_env(nodes, schema, dcs, env, aux: dict):
+    """Merge per-group remap arrays + literal codes into `env`; record each
+    group's joint dictionary (pa.Array) into `aux[gkey]` so string-producing
+    nodes can decode at unstage. Returns env, or None when a needed
+    dictionary is unavailable (caller falls back to host)."""
+    groups = collect_joint_groups(nodes, schema)
+    if not groups:
+        return env
+    merged = dict(env)
+    for cols, lits in set(groups):
+        gkey = _joint_gkey(cols, lits)
+        if gkey in aux:
+            continue
+        parts = []
+        for c in cols:
+            dc = dcs.get(c)
+            if dc is None or dc.dictionary is None:
+                return None
+            parts.append(dc.dictionary.cast(pa.large_string()))
+        if lits:
+            parts.append(pa.array(list(lits), pa.large_string()))
+        joint = pc.unique(pa.concat_arrays(parts))
+        joint = joint.take(pc.sort_indices(joint))
+        for c in cols:
+            d = dcs[c].dictionary
+            if len(d) == 0:
+                arr = np.zeros(1, dtype=np.int32)
+            else:
+                arr = np.asarray(pc.index_in(d.cast(pa.large_string()),
+                                             value_set=joint), dtype=np.int32)
+            b = size_bucket(len(arr))
+            if b > len(arr):
+                arr = np.concatenate([arr, np.zeros(b - len(arr), np.int32)])
+            merged[_joint_map_key(gkey, c)] = jnp.asarray(arr)
+        for lit in lits:
+            code = pc.index(joint, pa.scalar(lit, pa.large_string())).as_py()
+            merged[_joint_lit_key(gkey, lit)] = jnp.int32(code)
+        aux[gkey] = joint
+    return merged
+
+
 def _numeric_isin_items(node, schema):
     """Static per-compile device item values for a numeric/date IsIn, or
     None when ineligible. NaN items decline (arrow's is_in matches NaN,
@@ -681,10 +835,17 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
     except (ValueError, KeyError):
         return False
     if not (is_device_dtype(out_dt) or out_dt.is_null()):
-        # strings ride dictionary codes, but only as bare column passthrough
-        # (decoded at unstage) — any string-PRODUCING compute stays host
+        # strings ride dictionary codes: bare column passthrough, or a
+        # fill_null/if_else over string columns/literals whose output codes
+        # live in a joint dictionary (decoded at unstage); any OTHER
+        # string-producing compute stays host
         if out_dt.is_string():
-            return _plain_string_column(node, schema) is not None
+            if _plain_string_column(node, schema) is not None:
+                return True
+            ch = _string_choice_shape(node, schema)
+            if ch is not None:
+                return ch.pred is None or rec(ch.pred)
+            return False
         return False
     if isinstance(node, Column):
         return stageable_dtype(schema[node.cname].dtype)
@@ -716,6 +877,8 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
             return False
         if _string_cmp_shape(node, schema) is not None:
             return True
+        if _string_colcol_shape(node, schema) is not None:
+            return True  # joint-dictionary recode, compared on device
         # epoch comparisons compile as two-lane splits only in 32-bit mode;
         # under x64 the generic int64 path below handles them
         if not x64_enabled() and _epoch_cmp_shape(node, schema) is not None:
@@ -898,6 +1061,53 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
 
         return run, out_dt
 
+    if isinstance(node, (FillNull, IfElse)) and out_dt.is_string():
+        ch = _string_choice_shape(node, schema)
+        if ch is None:
+            raise ValueError("string choice not device-compilable here")
+        gkey = _joint_gkey(ch.cols, ch.lits)
+
+        def operand_fn(kind, val, _gkey=gkey):
+            if kind == "col":
+                mk = _joint_map_key(_gkey, val)
+
+                def get(env, _c=val, _mk=mk):
+                    codes, m = env[_c]
+                    return env[_mk][codes], m
+            elif kind == "lit":
+                lk = _joint_lit_key(_gkey, val)
+
+                def get(env, _lk=lk):
+                    n = _env_nrows(env)
+                    return (jnp.full(n, env[_lk], dtype=jnp.int32),
+                            jnp.ones(n, dtype=bool))
+            else:  # null literal
+
+                def get(env):
+                    n = _env_nrows(env)
+                    return (jnp.zeros(n, dtype=jnp.int32),
+                            jnp.zeros(n, dtype=bool))
+            return get
+
+        a = operand_fn(*ch.operands[0])
+        b = operand_fn(*ch.operands[1])
+        if ch.kind == "fill_null":
+            def run(env, _a=a, _b=b):
+                av, am = _a(env)
+                bv, bm = _b(env)
+                return jnp.where(am, av, bv), am | bm
+        else:
+            p, _pdt = _compile_node(ch.pred, schema)
+
+            def run(env, _p=p, _a=a, _b=b):
+                pv, pm = _p(env)
+                av, am = _a(env)
+                bv, bm = _b(env)
+                out = jnp.where(pv, av, bv)
+                return out, pm & jnp.where(pv, am, bm)
+
+        return run, out_dt
+
     if isinstance(node, FillNull):
         a, adt = _compile_node(node.child, schema)
         b, bdt = _compile_node(node.fill, schema)
@@ -975,6 +1185,37 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
                 else:  # ">"
                     out = codes >= env[_kle]
                 return out, m
+
+            return run, out_dt
+        ccshape = _string_colcol_shape(node, schema)
+        if ccshape is not None:
+            lcol, rcol = ccshape
+            gkey = _joint_gkey(tuple(sorted({lcol, rcol})), ())
+            lmk = _joint_map_key(gkey, lcol)
+            rmk = _joint_map_key(gkey, rcol)
+            op = node.op
+
+            def run(env, _lc=lcol, _rc=rcol, _lmk=lmk, _rmk=rmk, _op=op):
+                lc, lm = env[_lc]
+                rc, rm = env[_rc]
+                lv = env[_lmk][lc]
+                rv = env[_rmk][rc]
+                if _op == "<=>":
+                    eq = (lv == rv) & lm & rm
+                    return eq | (~lm & ~rm), jnp.ones_like(lm)
+                if _op == "==":
+                    out = lv == rv
+                elif _op == "!=":
+                    out = lv != rv
+                elif _op == "<":
+                    out = lv < rv
+                elif _op == "<=":
+                    out = lv <= rv
+                elif _op == ">":
+                    out = lv > rv
+                else:
+                    out = lv >= rv
+                return out, lm & rm
 
             return run, out_dt
         eshape = None if x64_enabled() else _epoch_cmp_shape(node, schema)
@@ -1379,8 +1620,12 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     env = string_lut_env(nodes, schema, dcs, env)
     if env is None:
         return None
+    aux: dict = {}
+    env = string_joint_env(nodes, schema, dcs, env, aux)
+    if env is None:
+        return None
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))
-    return run(env), out_dts, nodes, dcs
+    return run(env), out_dts, nodes, dcs, aux
 
 
 def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = None):
@@ -1398,7 +1643,7 @@ def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = Non
     staged = _stage_and_run(table, exprs, stage_cache)
     if staged is None:
         return None
-    outs, out_dts, nodes, dcs = staged  # async: device computes from here
+    outs, out_dts, nodes, dcs, aux = staged  # async: device computes from here
     schema = table.schema
 
     def resolve():
@@ -1407,14 +1652,20 @@ def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = Non
         for e, nd, (v, m), dt in zip(exprs, nodes, outs, out_dts):
             dictionary = None
             if dt.is_string():
-                # string outputs are bare column passthroughs (enforced by
-                # the compilability check): decode with that column's dict
+                # string outputs are bare column passthroughs OR joint-coded
+                # fill_null/if_else results (enforced by the compilability
+                # check): decode with the matching dictionary
                 cname = _plain_string_column(nd, schema)
                 src = dcs.get(cname) if cname else None
-                if src is None or src.dictionary is None:
+                if src is not None and src.dictionary is not None:
+                    dictionary = src.dictionary
+                else:
+                    ch = _string_choice_shape(nd, schema)
+                    if ch is not None:
+                        dictionary = aux.get(_joint_gkey(ch.cols, ch.lits))
+                if dictionary is None:
                     raise RuntimeError(
                         f"string projection {e.name()!r} lost its dictionary")
-                dictionary = src.dictionary
             dc = DeviceColumn(v, m, n, dt, dictionary=dictionary)
             s = unstage(dc).rename(e.name())
             cols.append(s)
@@ -1725,7 +1976,7 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
         staged = _stage_and_run(table, [e for _, e in non_lane], stage_cache)
         if staged is None:
             return None
-        outs, _, _, _ = staged
+        outs = staged[0]
         for (i, _), vm in zip(non_lane, outs):
             entries[i] = vm
     b = size_bucket(n)

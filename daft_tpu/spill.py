@@ -112,25 +112,31 @@ class PartitionBuffer:
         self._held.append(size)
 
     def _try_spill(self, part: MicroPartition, size: int) -> Optional[MicroPartition]:
-        import pyarrow.parquet as papq
+        import pyarrow as pa
 
         from .io.scan import FileFormat, Pushdowns, ScanTask
 
         with _SPILL_LOCK:
             _SPILL_SEQ[0] += 1
             seq = _SPILL_SEQ[0]
-        path = os.path.join(self.scope.dir(), f"spill_{seq}.parquet")
+        path = os.path.join(self.scope.dir(), f"spill_{seq}.arrow")
         tbl = part.table()
         try:
-            papq.write_table(tbl.to_arrow(), path)
+            # uncompressed arrow IPC: spill write AND re-read are ~memcpy
+            # (parquet here paid an encode+decode round-trip per partition —
+            # the dominant cost of the out-of-core path on a 1-core host)
+            atbl = tbl.to_arrow()
+            with pa.OSFile(path, "wb") as f, \
+                    pa.ipc.new_file(f, atbl.schema) as w:
+                w.write_table(atbl)
         except Exception:
-            # python-object columns have no parquet representation: hold in
+            # python-object columns have no arrow representation: hold in
             # memory rather than fail the query
             return None
         MEMORY_LEDGER.spilled(size)
         if self.stats is not None:
             self.stats.bump("spilled_partitions")
-        task = ScanTask(path, FileFormat.PARQUET, tbl.schema, Pushdowns(),
+        task = ScanTask(path, FileFormat.ARROW_IPC, tbl.schema, Pushdowns(),
                         num_rows=len(tbl))
         return MicroPartition.from_scan_task(task)
 

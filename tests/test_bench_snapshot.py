@@ -113,6 +113,10 @@ def test_failed_run_does_not_erase_good_snapshot(tmp_path, monkeypatch):
         def run_device_rungs(scale):
             return {"metric": "m", "value": 0, "error": "device_parity_mismatch"}
 
+        @staticmethod
+        def _bench_env():
+            return {"cpu_count": 1}
+
     monkeypatch.setitem(sys.modules, "bench", FakeBench)
     monkeypatch.setattr(sys, "argv", ["bench_snapshot.py", "1"])
     rc = tool.main()

@@ -42,6 +42,7 @@ def main() -> int:
 
     t_start = time.time()
     out = bench.run_device_rungs(scale)
+    out["bench_env"] = bench._bench_env()
     out["snapshot_unix_time"] = round(t_start, 1)
     out["snapshot_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                         time.gmtime(t_start))
