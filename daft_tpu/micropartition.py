@@ -171,6 +171,12 @@ class MicroPartition:
         return self.table().argsort(sort_keys, descending, nulls_first)
 
     def agg(self, to_agg, group_by=None) -> "MicroPartition":
+        if group_by and self._state == "loaded" and len(self._tables) > 1:
+            # multi-piece partitions (shuffle buckets) aggregate through ONE
+            # chunked acero pass instead of concatenating the pieces first
+            out = Table.acero_grouped_agg_chunked(self._tables, to_agg, group_by)
+            if out is not None:
+                return MicroPartition.from_table(out)
         return self._wrap(self.table().agg(to_agg, group_by))
 
     def distinct(self, subset=None) -> "MicroPartition":

@@ -22,7 +22,6 @@ Storage matches datatypes.DataType.to_physical():
 from __future__ import annotations
 
 import concurrent.futures
-import functools
 import io
 import os
 import urllib.request
@@ -333,8 +332,12 @@ def _rs_jitted():
 
         @jax.jit
         def _rs(x, a, b):
-            t = jnp.einsum("os,nshc->nohc", a, x)
-            return jnp.einsum("ow,nhwc->nhoc", b, t)
+            # HIGHEST matches jax.image.resize (its internal einsums pin
+            # Precision.HIGHEST); the TPU default would run bf16 multiply
+            # passes whose ~0.4% error breaks the +-1-count parity gate
+            p = jax.lax.Precision.HIGHEST
+            t = jnp.einsum("os,nshc->nohc", a, x, precision=p)
+            return jnp.einsum("ow,nhwc->nhoc", b, t, precision=p)
 
         _RS_JIT = _rs
     return _RS_JIT

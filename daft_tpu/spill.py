@@ -62,6 +62,13 @@ MEMORY_LEDGER = MemoryLedger()
 
 _SPILL_LOCK = threading.Lock()
 _SPILL_SEQ = [0]
+# IPC body codec for spill files. None = uncompressed: writes land in the
+# page cache at memcpy speed and mmap re-reads are zero-copy; the kernel
+# writes dirty pages back asynchronously. "lz4" trades one-core compress
+# CPU for ~35% fewer dirty bytes — worth it only when spill volume outruns
+# RAM so the disk itself gates. A/B at SF10 on this host (r5, two
+# interleaved trials): uncompressed 34.8/32.2s vs lz4 46.4/34.3s.
+_SPILL_CODEC: Optional[str] = None
 
 
 class SpillScope:
@@ -122,12 +129,14 @@ class PartitionBuffer:
         path = os.path.join(self.scope.dir(), f"spill_{seq}.arrow")
         tbl = part.table()
         try:
-            # uncompressed arrow IPC: spill write AND re-read are ~memcpy
-            # (parquet here paid an encode+decode round-trip per partition —
-            # the dominant cost of the out-of-core path on a 1-core host)
+            # arrow IPC spills (codec per _SPILL_CODEC above): parquet spills
+            # paid a full encode+decode round-trip per partition; IPC writes
+            # land in the page cache at memcpy speed and re-reads are
+            # memory-mapped.
             atbl = tbl.to_arrow()
+            opts = pa.ipc.IpcWriteOptions(compression=_SPILL_CODEC)
             with pa.OSFile(path, "wb") as f, \
-                    pa.ipc.new_file(f, atbl.schema) as w:
+                    pa.ipc.new_file(f, atbl.schema, options=opts) as w:
                 w.write_table(atbl)
         except Exception:
             # python-object columns have no arrow representation: hold in

@@ -492,6 +492,87 @@ class Table:
         g = g.take(pa.array(order))
         return _assemble_acero_agg_output(g, list(key_tbl.schema), plans, self.schema)
 
+    @staticmethod
+    def acero_grouped_agg_chunked(tables: List["Table"], to_agg, group_by
+                                  ) -> Optional["Table"]:
+        """One C++ hash-agg over a MicroPartition's chunk Tables WITHOUT
+        concatenating them first: per-chunk expression evaluation feeds
+        ChunkedArrays into a single acero group_by, skipping the full-width
+        copy Table.concat would make (an 8-bucket SF10 shuffle concatenates
+        ~3 GB of pieces just to aggregate them). Semantics identical to
+        _acero_grouped_agg — same key-offset downcast, first-occurrence
+        order recovery (global row ids continue across chunks in chunk
+        order, exactly the concatenated order), same output casts. Returns
+        None when ineligible; the caller concats and falls back."""
+        tables = [t for t in tables if len(t)]
+        if not tables:
+            return None
+        group_by = _as_expressions(group_by)
+        to_agg = _as_expressions(to_agg)
+        if not group_by:
+            return None
+        plans, nodes, agg_list = [], [], []
+        for j, e in enumerate(to_agg):
+            node = e._node
+            alias = e.name()
+            while isinstance(node, Alias):
+                node = node.child
+            if not isinstance(node, AggExpr):
+                raise ValueError(f"aggregation list contains non-aggregation {e!r}")
+            spec = _acero_agg_fn(node, threaded=True)
+            if spec is None:
+                return None
+            fname, opts = spec
+            nodes.append(node)
+            agg_list.append((f"v{j}", fname, opts))
+            plans.append((f"v{j}", fname, node, alias))
+        nk = len(group_by)
+        key_chunks: List[List[pa.Array]] = [[] for _ in range(nk)]
+        val_chunks: List[List[pa.Array]] = [[] for _ in to_agg]
+        row_chunks: List[pa.Array] = []
+        key_fields = None
+        base = 0
+        for t in tables:
+            n = len(t)
+            with t._memo_scope():
+                kt = t.eval_expression_list(group_by)
+                if key_fields is None:
+                    key_fields = list(kt.schema)
+                for i, s in enumerate(kt._columns):
+                    if s.is_python():
+                        return None
+                    arr = s.to_arrow()
+                    if pa.types.is_nested(arr.type) or pa.types.is_dictionary(arr.type):
+                        return None
+                    key_chunks[i].append(arr)
+                for j, node in enumerate(nodes):
+                    child_s = _broadcast_series(node.child.evaluate(t), n)
+                    if child_s.is_python():
+                        return None
+                    val_chunks[j].append(child_s.to_arrow())
+            row_chunks.append(pa.array(np.arange(base, base + n, dtype=np.int64)))
+            base += n
+        cols: Dict[str, Any] = {}
+        for i in range(nk):
+            chunks = key_chunks[i]
+            # joint downcast decision: a ChunkedArray needs one uniform type
+            if all(a.nbytes < (1 << 31) - 1 for a in chunks):
+                chunks = [_downcast_key_offsets(a) for a in chunks]
+            cols[f"k{i}"] = pa.chunked_array(chunks)
+        for j in range(len(to_agg)):
+            cols[f"v{j}"] = pa.chunked_array(val_chunks[j])
+        cols["__row__"] = pa.chunked_array(row_chunks)
+        agg_list.append(("__row__", "min", None))
+        try:
+            g = pa.table(cols).group_by([f"k{i}" for i in range(nk)],
+                                        use_threads=True).aggregate(agg_list)
+        except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError):
+            return None
+        order = np.argsort(np.asarray(g.column("__row___min").combine_chunks()),
+                           kind="stable")
+        g = g.take(pa.array(order))
+        return _assemble_acero_agg_output(g, key_fields, plans, tables[0].schema)
+
     def acero_fused_agg(self, to_agg: List[Expression], group_by: List[Expression],
                         predicate: Optional[Expression]) -> Optional["Table"]:
         """Single-pass filter+project+aggregate through one acero Declaration
