@@ -122,18 +122,44 @@ class MeshExecutionContext(ExecutionContext):
         schema = parts[0].schema
         if any(not is_device_dtype(f.dtype) for f in schema):
             return None
-        tables = [p.table() for p in parts]
-        total = sum(len(t) for t in tables)
-        if total == 0:
-            return None
         from ..schema import Schema
         from ..table import Table, _composite_rank
 
-        # Re-chunk onto the mesh axis: exactly n equal-ish source shards.
-        merged = Table.concat(tables) if len(tables) != 1 else tables[0]
-        step = -(-total // n)
+        devs = list(self.mesh.devices.flat)
+        my_proc = jax.process_index()
+        multiproc = any(d.process_index != my_proc for d in devs)
+        if multiproc:
+            # Per-host scan locality (reference: per-node scan dispatch,
+            # ray_runner.py:504-685): the part list is globally consistent
+            # (SPMD control plane), so contribution ownership is assigned by
+            # part INDEX — process p materializes and stages only parts with
+            # i % nproc == p. An unloaded scan partition owned elsewhere is
+            # never table()'d, so each host READS only its share of the
+            # input files; every row is contributed exactly once whether the
+            # inputs are process-duplicated (in-memory SPMD) or disjoint
+            # (scan tasks). The post-exchange allgather below reconstitutes
+            # full outputs on every process, reconverging the control plane.
+            nproc = jax.process_count()
+            tables = [p.table() for i, p in enumerate(parts)
+                      if i % nproc == my_proc]
+        else:
+            tables = [p.table() for p in parts]
+        total = sum(len(t) for t in tables)
+        if not multiproc and total == 0:
+            return None
+
+        # Re-chunk onto the devices THIS process stages: all n in single
+        # process; the process-local devices in multi-process mode.
+        chunk_dev_idx = [i for i, d in enumerate(devs)
+                        if not multiproc or d.process_index == my_proc]
+        nchunks = len(chunk_dev_idx)
+        if tables:
+            merged = Table.concat(tables) if len(tables) != 1 else tables[0]
+        else:
+            merged = Table.empty(schema)
+        step = -(-total // nchunks) if total else 0
         chunks = [merged.slice(min(i * step, total), min((i + 1) * step, total))
-                  for i in range(n)]
+                  for i in range(nchunks)]
         # Control plane: per-row destination PARTITION, computed with the host
         # kernels (identical assignment to the host shuffle path).
         k = len(by or [])
@@ -145,7 +171,9 @@ class MeshExecutionContext(ExecutionContext):
                 h = c.hash_rows(by)
                 b = (h % np.uint64(num)).astype(np.int32)
             elif scheme == "random":
-                rng = np.random.RandomState(ci)
+                # seed by GLOBAL device index: local chunk indices repeat
+                # across processes and would correlate the bucket sequences
+                rng = np.random.RandomState(chunk_dev_idx[ci])
                 b = rng.randint(0, num, size=len(c)).astype(np.int32)
             else:
                 bnds = boundaries._columns
@@ -159,49 +187,71 @@ class MeshExecutionContext(ExecutionContext):
             dev_buckets.append((b % n).astype(np.int32) if num > n else b)
             inbounds.append(np.ones(len(c), dtype=bool))
         cap = exchange_capacity(dev_buckets, inbounds, n)
-        r = size_bucket(max((len(c) for c in chunks), default=1))
+        maxlen = max((len(c) for c in chunks), default=1)
+        if multiproc:
+            # Negotiate the exchange SHAPE globally: with disjoint
+            # contributions the local capacity/slab sizes differ per process,
+            # and shard_map needs every process to compile the same program.
+            # cap is a per-(src,dst) property so the global value is the max
+            # over all sources; a zero GLOBAL row count (not local) skips.
+            from jax.experimental import multihost_utils
+
+            agreed = np.asarray(multihost_utils.process_allgather(
+                np.array([cap, maxlen, total], dtype=np.int64)))
+            cap = int(agreed[:, 0].max())
+            maxlen = int(agreed[:, 1].max())
+            if int(agreed[:, 2].sum()) == 0:
+                return None
+        r = size_bucket(max(maxlen, 1))
         names = [f.name for f in schema]
         ncols = len(names)
         ship_lane = num > n  # receivers need the partition id to split
-        devs = list(self.mesh.devices.flat)
-        # Multi-process (SPMD over DCN): every process runs this same control
-        # plane over the same data, but can only device_put onto its LOCAL
-        # devices — the global arrays assemble from addressable shards only
-        # (standard jax multihost staging).
-        my_proc = jax.process_index()
-        multiproc = any(d.process_index != my_proc for d in devs)
         # Per-device staging: stage one source shard at a time and device_put
-        # it straight onto its mesh device.
+        # it straight onto its mesh device. Every chunk here is staged — in
+        # multi-process mode `chunks` already covers exactly the LOCAL
+        # devices (the global arrays assemble from addressable shards only,
+        # standard jax multihost staging).
         b_shards, v_shards, lane_shards = [], [], []
         col_shards = [[] for _ in range(ncols)]
         null_shards = [[] for _ in range(ncols)]
         col_trailing = [()] * ncols
         col_dtypes = [None] * ncols
+        ok = True
         try:
             for i, c in enumerate(chunks):
-                local = devs[i].process_index == my_proc
-                if local:
-                    bm = np.zeros(r, dtype=np.int32)
-                    vm = np.zeros(r, dtype=bool)
-                    bm[:len(c)] = dev_buckets[i]
-                    vm[:len(c)] = True
-                    b_shards.append(jax.device_put(bm[None], devs[i]))
-                    v_shards.append(jax.device_put(vm[None], devs[i]))
-                    if ship_lane:
-                        lm = np.zeros(r, dtype=np.int32)
-                        lm[:len(c)] = part_buckets[i]
-                        lane_shards.append(jax.device_put(lm[None], devs[i]))
+                dev = devs[chunk_dev_idx[i]]
+                bm = np.zeros(r, dtype=np.int32)
+                vm = np.zeros(r, dtype=bool)
+                bm[:len(c)] = dev_buckets[i]
+                vm[:len(c)] = True
+                b_shards.append(jax.device_put(bm[None], dev))
+                v_shards.append(jax.device_put(vm[None], dev))
+                if ship_lane:
+                    lm = np.zeros(r, dtype=np.int32)
+                    lm[:len(c)] = part_buckets[i]
+                    lane_shards.append(jax.device_put(lm[None], dev))
                 for j, name in enumerate(names):
                     vals, valid, _ = stage_np(c.get_column(name), r)
                     col_trailing[j] = tuple(vals.shape[1:])
                     col_dtypes[j] = vals.dtype
-                    if local:
-                        col_shards[j].append(jax.device_put(vals[None], devs[i]))
-                        null_shards[j].append(jax.device_put(valid[None], devs[i]))
+                    col_shards[j].append(jax.device_put(vals[None], dev))
+                    null_shards[j].append(jax.device_put(valid[None], dev))
         except ValueError:
             # stage_np rejects e.g. int64 values outside int32 range when x64
             # is off (real-TPU mode): fall back to the host shuffle, same as
             # every other device route
+            ok = False
+        if multiproc:
+            # staging failure is DATA-dependent and contributions are
+            # disjoint: one process declining while others proceed would
+            # deadlock the collective, so agree on the outcome first
+            from jax.experimental import multihost_utils
+
+            oks = np.asarray(multihost_utils.process_allgather(
+                np.array([1 if ok else 0], dtype=np.int64)))
+            if int(oks.min()) == 0:
+                return None
+        if not ok:
             return None
         lane_cols = ([np.dtype(np.int32)] if ship_lane else [])
         all_dtypes = tuple(col_dtypes) + tuple(np.dtype(bool) for _ in names) + tuple(lane_cols)

@@ -462,35 +462,18 @@ class Table:
             # acero's hash table is ~3x slower on large_string keys
             cols[f"k{i}"] = _downcast_key_offsets(arr)
             key_names.append(f"k{i}")
-        plans = []  # (vname, fname, node, alias)
-        agg_list = []
-        for j, e in enumerate(to_agg):
-            node = e._node
-            alias = e.name()
-            while isinstance(node, Alias):
-                node = node.child
-            if not isinstance(node, AggExpr):
-                raise ValueError(f"aggregation list contains non-aggregation {e!r}")
-            spec = _acero_agg_fn(node, threaded=True)
-            if spec is None:
-                return None
+        planned = _acero_agg_plans(to_agg)
+        if planned is None:
+            return None
+        plans, nodes, agg_list = planned
+        for j, node in enumerate(nodes):
             child_s = _broadcast_series(node.child.evaluate(self), n)
             if child_s.is_python():
                 return None
-            fname, opts = spec
-            vname = f"v{j}"
-            cols[vname] = child_s.to_arrow()
-            agg_list.append((vname, fname, opts))
-            plans.append((vname, fname, node, alias))
+            cols[f"v{j}"] = child_s.to_arrow()
         cols["__row__"] = _rowid_array(n)
-        agg_list.append(("__row__", "min", None))
-        try:
-            g = pa.table(cols).group_by(key_names, use_threads=True).aggregate(agg_list)
-        except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError):
-            return None
-        order = np.argsort(np.asarray(g.column("__row___min").combine_chunks()), kind="stable")
-        g = g.take(pa.array(order))
-        return _assemble_acero_agg_output(g, list(key_tbl.schema), plans, self.schema)
+        return _acero_run_group(cols, key_names, agg_list,
+                                list(key_tbl.schema), plans, self.schema)
 
     @staticmethod
     def acero_grouped_agg_chunked(tables: List["Table"], to_agg, group_by
@@ -511,21 +494,10 @@ class Table:
         to_agg = _as_expressions(to_agg)
         if not group_by:
             return None
-        plans, nodes, agg_list = [], [], []
-        for j, e in enumerate(to_agg):
-            node = e._node
-            alias = e.name()
-            while isinstance(node, Alias):
-                node = node.child
-            if not isinstance(node, AggExpr):
-                raise ValueError(f"aggregation list contains non-aggregation {e!r}")
-            spec = _acero_agg_fn(node, threaded=True)
-            if spec is None:
-                return None
-            fname, opts = spec
-            nodes.append(node)
-            agg_list.append((f"v{j}", fname, opts))
-            plans.append((f"v{j}", fname, node, alias))
+        planned = _acero_agg_plans(to_agg)
+        if planned is None:
+            return None
+        plans, nodes, agg_list = planned
         nk = len(group_by)
         key_chunks: List[List[pa.Array]] = [[] for _ in range(nk)]
         val_chunks: List[List[pa.Array]] = [[] for _ in to_agg]
@@ -562,16 +534,8 @@ class Table:
         for j in range(len(to_agg)):
             cols[f"v{j}"] = pa.chunked_array(val_chunks[j])
         cols["__row__"] = pa.chunked_array(row_chunks)
-        agg_list.append(("__row__", "min", None))
-        try:
-            g = pa.table(cols).group_by([f"k{i}" for i in range(nk)],
-                                        use_threads=True).aggregate(agg_list)
-        except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError):
-            return None
-        order = np.argsort(np.asarray(g.column("__row___min").combine_chunks()),
-                           kind="stable")
-        g = g.take(pa.array(order))
-        return _assemble_acero_agg_output(g, key_fields, plans, tables[0].schema)
+        return _acero_run_group(cols, [f"k{i}" for i in range(nk)], agg_list,
+                                key_fields, plans, tables[0].schema)
 
     def acero_fused_agg(self, to_agg: List[Expression], group_by: List[Expression],
                         predicate: Optional[Expression]) -> Optional["Table"]:
@@ -1025,6 +989,45 @@ def _group_codes(key_tbl: Table) -> Tuple[np.ndarray, Table]:
 class _AceroUnsupported(Exception):
     """Expression shape outside the acero-translated subset; callers fall
     back to the per-op Series kernel path."""
+
+
+def _acero_agg_plans(to_agg: List[Expression]):
+    """Shared agg-plan building for the single-chunk and chunked acero
+    paths: (plans [(vname, fname, node, alias)], nodes, agg_list) or None
+    when any aggregation has no acero mapping."""
+    plans, nodes, agg_list = [], [], []
+    for j, e in enumerate(to_agg):
+        node = e._node
+        alias = e.name()
+        while isinstance(node, Alias):
+            node = node.child
+        if not isinstance(node, AggExpr):
+            raise ValueError(f"aggregation list contains non-aggregation {e!r}")
+        spec = _acero_agg_fn(node, threaded=True)
+        if spec is None:
+            return None
+        fname, opts = spec
+        nodes.append(node)
+        agg_list.append((f"v{j}", fname, opts))
+        plans.append((f"v{j}", fname, node, alias))
+    return plans, nodes, agg_list
+
+
+def _acero_run_group(cols: Dict[str, Any], key_names: List[str], agg_list,
+                     key_fields: List[Field], plans, schema: Schema
+                     ) -> Optional["Table"]:
+    """Shared group_by execution + first-occurrence order recovery (min
+    row-id side-aggregate) + output assembly. `cols` must already contain
+    the `__row__` ids (global across chunks for chunked inputs)."""
+    agg_list = list(agg_list) + [("__row__", "min", None)]
+    try:
+        g = pa.table(cols).group_by(key_names, use_threads=True).aggregate(agg_list)
+    except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError):
+        return None
+    order = np.argsort(np.asarray(g.column("__row___min").combine_chunks()),
+                       kind="stable")
+    g = g.take(pa.array(order))
+    return _assemble_acero_agg_output(g, key_fields, plans, schema)
 
 
 def _assemble_acero_agg_output(g: pa.Table, key_fields: List[Field], plans,

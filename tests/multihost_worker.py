@@ -120,3 +120,63 @@ for a, b in zip(gd["revenue"], want["revenue"]):
     assert abs(a - b) <= max(1e-5 * abs(b), 1e-6), (a, b)
 
 print(f"MULTIHOST_Q5_OK {pid} shuffles={shuffles}", flush=True)
+
+# ---------------------------------------------------------------------------
+# Per-host scan locality (round-4 verdict item 2; reference: per-node scan
+# dispatch, ray_runner.py:504-685): the scan-task list is globally consistent,
+# contribution ownership is task_index % nproc, and a foreign-owned UNLOADED
+# partition is never materialized by the device exchange — so this process
+# must OPEN only ~half of the 8 input files. The exchange + allgather
+# reconstitute the global rows, so the groupby result still matches an exact
+# oracle computed from the full dataset.
+# ---------------------------------------------------------------------------
+import collections  # noqa: E402
+import tempfile  # noqa: E402
+
+import pyarrow as pa  # noqa: E402
+import pyarrow.parquet as papq  # noqa: E402
+
+from daft_tpu.io.scan import IO_STATS  # noqa: E402
+
+cfg.scan_tasks_min_size_bytes = 0  # keep the 8 files as 8 distinct tasks
+
+scan_dir = os.path.join(tempfile.gettempdir(), f"mh_scanloc_{port}_{pid}")
+os.makedirs(scan_dir, exist_ok=True)
+rng2 = np.random.RandomState(7)  # same seed -> identical files on both procs
+nfiles = 8
+key_parts, val_parts = [], []
+for i in range(nfiles):
+    kk = rng2.randint(0, 40, 5000).astype(np.int64)
+    vv = rng2.randint(0, 1000, 5000).astype(np.int64)
+    papq.write_table(pa.table({"k": kk, "v": vv}),
+                     os.path.join(scan_dir, f"f{i:02d}.parquet"))
+    key_parts.append(kk)
+    val_parts.append(vv)
+key_all = np.concatenate(key_parts)
+val_all = np.concatenate(val_parts)
+
+before_opened = IO_STATS.snapshot()["files_opened"]
+df2 = dtp.read_parquet(os.path.join(scan_dir, "*.parquet"))
+res2 = (df2.repartition(8, "k").groupby("k")
+        .agg(col("v").sum().alias("s")).sort("k"))
+coll2 = res2.collect()
+opened = IO_STATS.snapshot()["files_opened"] - before_opened
+shuffles2 = coll2.stats.snapshot()["counters"].get("device_shuffles", 0)
+assert shuffles2 >= 1, f"device exchange never engaged: {coll2.stats.snapshot()}"
+
+acc = collections.defaultdict(int)
+for kk, vv in zip(key_all.tolist(), val_all.tolist()):
+    acc[kk] += vv
+want_keys = sorted(acc)
+gd2 = coll2.to_pydict()
+assert gd2["k"] == want_keys, (gd2["k"][:5], want_keys[:5])
+assert gd2["s"] == [acc[kk] for kk in want_keys], "scan-locality parity broke"
+
+# the locality claim itself: this process read its share, not the whole input
+assert opened <= nfiles // nproc + 2, (
+    f"scan locality failed: process {pid} opened {opened} of {nfiles}")
+
+import shutil  # noqa: E402
+
+shutil.rmtree(scan_dir, ignore_errors=True)
+print(f"MULTIHOST_SCANLOC_OK {pid} opened={opened}", flush=True)
