@@ -21,7 +21,7 @@ This is the TPU-native replacement for the reference's Rust kernel library
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -410,15 +410,61 @@ def _epoch_bits_np(vals_i64: np.ndarray) -> np.ndarray:
     return vals_i64.astype(np.int64).view(np.uint64) ^ np.uint64(1 << 63)
 
 
-def _stage_epoch_lanes(table, cname: str, bucket: int,
-                       stage_cache: Optional[dict]):
-    """(hi u32, lo u32, valid) exact lanes of an epoch column for 32-bit
-    mode comparisons and sorts; cached with the partition."""
-    key = ("__epochlanes__", cname, bucket)
-    cached = stage_cache.get(key) if stage_cache is not None else None
+def _eval_lane_series(table, node):
+    """Host-evaluate a lane-staged sort key expression -> Series (length
+    broadcast), or None when evaluation fails / yields python storage —
+    the caller then declines to the host sort."""
+    from ..expressions import Column
+
+    try:
+        if isinstance(node, Column):
+            s = table.get_column(node.cname)
+        else:
+            from ..table import _broadcast_series
+
+            s = _broadcast_series(node.evaluate(table), len(table))
+    except Exception:
+        return None
+    if s.is_python():
+        return None
+    return s
+
+
+def _peel_alias(node):
+    from ..expressions import Alias
+
+    while isinstance(node, Alias):
+        node = node.child
+    return node
+
+
+def _stage_epoch_expr_lanes(table, node, bucket: int,
+                            stage_cache: Optional[dict]):
+    """Lane staging for ANY epoch-typed sort key expression (r4 verdict
+    item 6): plain (possibly aliased) columns reuse the shared column-lane
+    cache entry; computed epoch expressions (timestamp arithmetic) evaluate
+    once on host — exact int64 — and split lanes from the result. UDF-
+    containing keys never cache (Expression._memoizable rationale)."""
+    from ..expressions import Column
+
+    node = _peel_alias(node)
+    if isinstance(node, Column):
+        return _stage_epoch_lanes(table, node.cname, bucket, stage_cache)
+    cacheable = stage_cache is not None and node._memoizable()
+    key = ("__epochlanes__", node._key(), bucket)
+    cached = stage_cache.get(key) if cacheable else None
     if cached is not None:
         return cached
-    s = table.get_column(cname)
+    s = _eval_lane_series(table, node)
+    if s is None:
+        return None
+    out = _epoch_lanes_of_series(s, bucket)
+    if cacheable:
+        stage_cache[key] = out
+    return out
+
+
+def _epoch_lanes_of_series(s, bucket: int):
     n = len(s)
     arr = s.to_arrow()
     if isinstance(arr, pa.ChunkedArray):
@@ -429,8 +475,19 @@ def _stage_epoch_lanes(table, cname: str, bucket: int,
         bits = np.concatenate([bits, np.zeros(bucket - n, dtype=np.uint64)])
     hi = (bits >> np.uint64(32)).astype(np.uint32)
     lo = (bits & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    out = (jnp.asarray(hi), jnp.asarray(lo),
-           jnp.asarray(_staged_validity(arr, n, bucket)))
+    return (jnp.asarray(hi), jnp.asarray(lo),
+            jnp.asarray(_staged_validity(arr, n, bucket)))
+
+
+def _stage_epoch_lanes(table, cname: str, bucket: int,
+                       stage_cache: Optional[dict]):
+    """(hi u32, lo u32, valid) exact lanes of an epoch column for 32-bit
+    mode comparisons and sorts; cached with the partition."""
+    key = ("__epochlanes__", cname, bucket)
+    cached = stage_cache.get(key) if stage_cache is not None else None
+    if cached is not None:
+        return cached
+    out = _epoch_lanes_of_series(table.get_column(cname), bucket)
     if stage_cache is not None:
         stage_cache[key] = out
     return out
@@ -1876,27 +1933,31 @@ def _sortable_bits(values: jax.Array, valid: jax.Array, descending: bool,
     return [null_sel] + [jnp.where(valid, l, jnp.uint32(0)) for l in lanes]
 
 
-def _plain_f64_column(node, schema) -> Optional[str]:
-    """Bare float64 Column (through Aliases)."""
-    from ..datatypes import DataType
-
-    return _plain_column(node, schema,
-                         lambda dt: dt == DataType.float64())
-
-
-def _stage_f64_sort_lanes(table, cname: str, bucket: int,
+def _stage_f64_sort_lanes(table, node, bucket: int,
                           stage_cache: Optional[dict]):
     """EXACT float64 sort key in 32-bit mode: the order-preserving bit
     transform (sign-magnitude -> total order, canonical NaN above +inf)
     applied to the full 64-bit pattern ON HOST, then split into (hi, lo)
     uint32 lanes the device sort consumes as two consecutive keys. No
     precision is lost — this removes the Q1-style money-sort fallback.
-    Cached with the partition like every staged column."""
-    key = ("__f64lanes__", cname, bucket)
-    cached = stage_cache.get(key) if stage_cache is not None else None
+
+    `node` may be ANY f64-typed expression, not just a plain Column (r4
+    verdict item 6): the host evaluates the derived key ONCE in exact
+    float64 (e.g. Q1's price*(1-discount)), the lanes split from that, and
+    the sort itself stays on device. Cached with the partition under the
+    expression key."""
+    node = _peel_alias(node)
+    # UDF-containing keys never cache: a UDF may be non-deterministic and
+    # its _key uses id(fn), which CPython can reuse after GC — a stale hit
+    # would silently mis-sort (same rule as Expression._memoizable)
+    cacheable = stage_cache is not None and node._memoizable()
+    key = ("__f64lanes__", node._key(), bucket)
+    cached = stage_cache.get(key) if cacheable else None
     if cached is not None:
         return cached
-    s = table.get_column(cname)
+    s = _eval_lane_series(table, node)
+    if s is None:
+        return None
     n = len(s)
     arr = s.to_arrow()
     if isinstance(arr, pa.ChunkedArray):
@@ -1918,7 +1979,7 @@ def _stage_f64_sort_lanes(table, cname: str, bucket: int,
     lo = (flipped & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     out = (jnp.asarray(hi), jnp.asarray(lo),
            jnp.asarray(_staged_validity(arr, n, bucket)))
-    if stage_cache is not None:
+    if cacheable:
         stage_cache[key] = out
     return out
 
@@ -1940,13 +2001,13 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
     k = len(keys)
     desc = _norm_flag(descending, k, False)
     nf = _norm_flag(nulls_first, k, None)
-    f64_lane_keys: Dict[int, Tuple[str, str]] = {}
+    f64_lane_keys: Dict[int, Tuple[str, Any]] = {}
     if not x64_enabled():
         # float64 keys must not sort in float32 (spurious ties reorder rows
-        # vs the host), and epoch keys cannot narrow to int32 at all. PLAIN
-        # columns of either kind sort exactly via host-split 64-bit lanes —
-        # lossless, so they bypass the eligibility gates entirely; COMPUTED
-        # f64/epoch keys decline to the host before staging anything.
+        # vs the host), and epoch keys cannot narrow to int32 at all. ANY
+        # f64/epoch-typed key — plain column OR computed expression (Q1's
+        # price*(1-discount) money sorts) — evaluates once on host in exact
+        # 64-bit and sorts on device via host-split (hi, lo) lanes.
         from ..expressions import normalize_literals
 
         try:
@@ -1959,15 +2020,9 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
             except (ValueError, KeyError):
                 return None
             if dt_ == DataType.float64():
-                cname = _plain_f64_column(nd, table.schema)
-                if cname is None:
-                    return None
-                f64_lane_keys[i] = ("f64", cname)
+                f64_lane_keys[i] = ("f64", nd)
             elif dt_.kind in _EPOCH_KINDS:
-                cname = _plain_epoch_column(nd, table.schema)
-                if cname is None:
-                    return None
-                f64_lane_keys[i] = ("epoch", cname)
+                f64_lane_keys[i] = ("epoch", nd)
             # other keys are vetted by _stage_and_run below — checking
             # compilability here too would walk every tree twice per sort
     entries: List = [None] * k
@@ -1980,11 +2035,13 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
         for (i, _), vm in zip(non_lane, outs):
             entries[i] = vm
     b = size_bucket(n)
-    for i, (kind, cname) in f64_lane_keys.items():
-        if kind == "f64":
-            entries[i] = _stage_f64_sort_lanes(table, cname, b, stage_cache)
-        else:
-            entries[i] = _stage_epoch_lanes(table, cname, b, stage_cache)
+    for i, (kind, nd) in f64_lane_keys.items():
+        entry = (_stage_f64_sort_lanes(table, nd, b, stage_cache)
+                 if kind == "f64"
+                 else _stage_epoch_expr_lanes(table, nd, b, stage_cache))
+        if entry is None:
+            return None
+        entries[i] = entry
     nf_resolved = [(f if f is not None else d) for f, d in zip(nf, desc)]
     idx = device_argsort(entries, desc, nf_resolved, n)
     return np.asarray(jax.device_get(idx))[:n]
