@@ -1,0 +1,111 @@
+"""Device-join-at-scale rung: PK and N:M probes, >=100k build x 1M probe.
+
+Round-4 verdict weak #4: the device join had never been measured above toy
+sizes, and the N:M flavor's data-dependent expansion runs on host (the
+static-shape discipline) — so its cost must appear in the artifact, not
+stay theoretical. This rung times the ENGINE's full join path (device range
+probe + host payload gather + N:M expansion) against the same engine on the
+acero host path, parity-gated on the sorted row multiset (join output order
+is unspecified engine-wide — see Table.hash_join).
+
+Reference role-equivalents: src/daft-core/src/array/ops/arrow2/sort/.../
+probe_table/mod.rs hash-probe kernels + hash_join.rs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+
+def _sorted_rows(d: Dict[str, list]):
+    """Order-insensitive view: rows lexsorted by every column."""
+    cols = [np.asarray(d[k]) for k in sorted(d)]
+    order = np.lexsort(cols[::-1])
+    return [c[order] for c in cols]
+
+
+def _rows_equal(a: Dict[str, list], b: Dict[str, list]) -> bool:
+    if set(a) != set(b):
+        return False
+    sa, sb = _sorted_rows(a), _sorted_rows(b)
+    return all(len(x) == len(y) and np.array_equal(x, y)
+               for x, y in zip(sa, sb))
+
+
+def run_rung(build_rows: int = 100_000, probe_rows: int = 1_000_000,
+             seed: int = 0, best_of: int = 2) -> dict:
+    """{join_device_{pk,nm}_rows_per_sec, _vs_host, _probes, ...} extras.
+
+    PK: unique build keys (single-row matches, the device fast path).
+    N:M: every build key duplicated (match RANGES on device, expansion on
+    host) — the flavor whose host-side cost the verdict wanted measured.
+    Probe keys draw from [0, 1.25*build_rows): ~80% of probes hit in the PK
+    flavor and ~40% in N:M (its key domain is half as wide, but each hit
+    expands to two rows), so misses exercise the range probe in both.
+    """
+    import daft_tpu as dt
+    from daft_tpu.context import get_context
+
+    cfg = get_context().execution_config
+    rng = np.random.RandomState(seed)
+    out: dict = {}
+    flavors = (
+        ("pk", np.arange(build_rows, dtype=np.int64)),
+        ("nm", np.repeat(np.arange(build_rows // 2, dtype=np.int64), 2)),
+    )
+    prev = cfg.use_device_kernels
+    prev_cache = cfg.enable_result_cache
+    cfg.enable_result_cache = False  # time execution, not cache hits
+    try:
+        for flavor, bkeys in flavors:
+            bkeys = bkeys.copy()
+            rng.shuffle(bkeys)
+            bdf = dt.from_pydict({
+                "k": bkeys,
+                "bv": rng.randint(0, 1 << 30, len(bkeys)).astype(np.int64),
+            }).collect()
+            pdf = dt.from_pydict({
+                "k": rng.randint(0, int(build_rows * 1.25),
+                                 probe_rows).astype(np.int64),
+                "pv": rng.randint(0, 1 << 30, probe_rows).astype(np.int64),
+            }).collect()
+
+            def q():
+                return pdf.join(bdf, on="k", how="inner").collect()
+
+            cfg.use_device_kernels = True
+            got = q()  # cold: staging + compile
+            probes = got.stats.snapshot()["counters"].get(
+                "device_join_probes", 0)
+            if not probes:
+                out[f"join_device_{flavor}_error"] = "device_path_not_taken"
+                continue
+            t_dev = float("inf")
+            for _ in range(best_of):
+                t0 = time.perf_counter()
+                q()
+                t_dev = min(t_dev, time.perf_counter() - t0)
+            cfg.use_device_kernels = False
+            want = q().to_pydict()
+            t_host = float("inf")
+            for _ in range(best_of):
+                t0 = time.perf_counter()
+                q()
+                t_host = min(t_host, time.perf_counter() - t0)
+            if not _rows_equal(got.to_pydict(), want):
+                out[f"join_device_{flavor}_error"] = "parity_mismatch"
+                continue
+            out[f"join_device_{flavor}_rows_per_sec"] = round(
+                probe_rows / t_dev, 1)
+            out[f"join_device_{flavor}_vs_host"] = round(t_host / t_dev, 3)
+            out[f"join_device_{flavor}_probes"] = int(probes)
+            out[f"join_device_{flavor}_out_rows"] = len(want["k"])
+    finally:
+        cfg.use_device_kernels = prev
+        cfg.enable_result_cache = prev_cache
+    out["join_device_build_rows"] = build_rows
+    out["join_device_probe_rows"] = probe_rows
+    return out

@@ -2026,6 +2026,16 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
             # other keys are vetted by _stage_and_run below — checking
             # compilability here too would walk every tree twice per sort
     entries: List = [None] * k
+    b = size_bucket(n)
+    # lane keys stage FIRST (cheap host work that can decline) so a decline
+    # never wastes the device staging/compile of the other keys
+    for i, (kind, nd) in f64_lane_keys.items():
+        entry = (_stage_f64_sort_lanes(table, nd, b, stage_cache)
+                 if kind == "f64"
+                 else _stage_epoch_expr_lanes(table, nd, b, stage_cache))
+        if entry is None:
+            return None
+        entries[i] = entry
     non_lane = [(i, e) for i, e in enumerate(keys) if i not in f64_lane_keys]
     if non_lane:
         staged = _stage_and_run(table, [e for _, e in non_lane], stage_cache)
@@ -2034,14 +2044,6 @@ def device_table_argsort(table, sort_keys, descending=None, nulls_first=None,
         outs = staged[0]
         for (i, _), vm in zip(non_lane, outs):
             entries[i] = vm
-    b = size_bucket(n)
-    for i, (kind, nd) in f64_lane_keys.items():
-        entry = (_stage_f64_sort_lanes(table, nd, b, stage_cache)
-                 if kind == "f64"
-                 else _stage_epoch_expr_lanes(table, nd, b, stage_cache))
-        if entry is None:
-            return None
-        entries[i] = entry
     nf_resolved = [(f if f is not None else d) for f, d in zip(nf, desc)]
     idx = device_argsort(entries, desc, nf_resolved, n)
     return np.asarray(jax.device_get(idx))[:n]

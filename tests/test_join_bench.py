@@ -1,0 +1,35 @@
+"""The device-join-at-scale rung harness (benchmarks/join_bench.py) on the
+virtual CPU mesh: both flavors must take the device probe path, pass the
+sorted-multiset parity gate, and report the expected metric keys."""
+
+import numpy as np
+
+from benchmarks import join_bench
+
+
+def test_join_rung_small_pk_and_nm(monkeypatch):
+    from daft_tpu.context import get_context
+
+    cfg = get_context().execution_config
+    saved = (cfg.use_device_kernels, cfg.device_min_rows)
+    cfg.use_device_kernels = True
+    cfg.device_min_rows = 8
+    try:
+        out = join_bench.run_rung(build_rows=4_000, probe_rows=20_000,
+                                  best_of=1)
+    finally:
+        cfg.use_device_kernels, cfg.device_min_rows = saved
+    for flavor in ("pk", "nm"):
+        assert f"join_device_{flavor}_error" not in out, out
+        assert out[f"join_device_{flavor}_rows_per_sec"] > 0, out
+        assert out[f"join_device_{flavor}_probes"] >= 1, out
+        assert out[f"join_device_{flavor}_out_rows"] > 0, out
+
+
+def test_sorted_rows_equality_helper():
+    a = {"k": [1, 2, 2], "v": [5, 6, 7]}
+    b = {"k": [2, 1, 2], "v": [7, 5, 6]}
+    c = {"k": [2, 1, 2], "v": [7, 5, 5]}
+    assert join_bench._rows_equal(a, b)
+    assert not join_bench._rows_equal(a, c)
+    assert not join_bench._rows_equal(a, {"k": [1], "v": [5]})
