@@ -212,6 +212,34 @@ def run_device_rungs(scale: float) -> dict:
         "rows": rows,
     }
 
+    # ---- deep-fused pallas kernel A/B (r4 verdict weak #5): Q1 with the
+    # predicate + derived money columns evaluated INSIDE the pallas kernel
+    # vs the composed XLA + batched-kernel program. Ratio > 1 means the
+    # deep kernel wins; it stays opt-in until this number says otherwise.
+    try:
+        from daft_tpu.kernels import pallas_ops
+
+        cfg.use_pallas_deep_fusion = True
+        traces0 = pallas_ops.DEEP_FUSED_TRACES[0]
+        got_deep = run_q1()  # compile the deep variant
+        # a Mosaic compile failure at first EXECUTION silently recomputes
+        # on host (executor fallback): the device counter must confirm the
+        # aggregation actually ran on device, same gate as the main rung
+        deep_counters = tpch.q1(frame).collect().stats.snapshot()["counters"]
+        if (pallas_ops.DEEP_FUSED_TRACES[0] <= traces0
+                or not deep_counters.get("device_aggregations")):
+            out["q1_deep_pallas_error"] = "deep_kernel_not_engaged"
+        elif not _parity(got_deep, want_q1, rtol=1e-6):
+            out["q1_deep_pallas_error"] = "parity_mismatch"
+        else:
+            t_deep_q1, _ = _best_of(run_q1)
+            out["q1_deep_pallas_s"] = round(t_deep_q1, 4)
+            out["q1_deep_pallas_vs_composed"] = round(t_dev_q1 / t_deep_q1, 3)
+    except Exception as e:
+        out["q1_deep_pallas_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        cfg.use_pallas_deep_fusion = False
+
     # ---- Q3 (3-way join + agg + top-k): the device join-probe rung --------
     cust = orders = nat = None
     try:
@@ -326,8 +354,6 @@ def run_device_rungs(scale: float) -> dict:
         out.update(join_bench.run_rung())
     except Exception as e:
         out["join_rung_error"] = f"{type(e).__name__}: {e}"[:200]
-    finally:
-        cfg.use_device_kernels = True
 
     # ---- out-of-core rung: Q1 from parquet ON DISK with forced spill ------
     if scale <= 1.0:

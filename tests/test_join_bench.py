@@ -7,7 +7,7 @@ import numpy as np
 from benchmarks import join_bench
 
 
-def test_join_rung_small_pk_and_nm(monkeypatch):
+def test_join_rung_small_pk_and_nm():
     from daft_tpu.context import get_context
 
     cfg = get_context().execution_config
