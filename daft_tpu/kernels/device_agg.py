@@ -348,7 +348,7 @@ def device_grouped_agg_async(table, to_agg, group_by,
     modes = tuple(s[3] for s in specs)
     _cfg = get_context().execution_config
     use_pallas = bool(_cfg.use_pallas_segment_sums)
-    use_deep = bool(getattr(_cfg, "use_pallas_deep_fusion", False))
+    use_deep = bool(_cfg.use_pallas_deep_fusion)
     run = _compile_agg(tuple(child_nodes), pred_nodes[0] if pred_nodes else None,
                        schema, tuple(sorted(needed)), kinds, modes, gb,
                        use_pallas, use_deep)

@@ -1630,7 +1630,12 @@ class TestDeepFusedPallas32:
                         .agg(col("price").sum().alias("sp"))
                         .sort("g"))
 
+            t0 = pallas_ops.DEEP_FUSED_TRACES[0]
             dev = q().collect()
+            # the decline itself: env carries string-literal code bounds the
+            # kernel cannot take as refs, so no deep trace may happen
+            assert pallas_ops.DEEP_FUSED_TRACES[0] == t0, \
+                "deep kernel engaged on a string-env query"
             with host_mode():
                 host = q().collect().to_pydict()
         finally:

@@ -1,0 +1,120 @@
+"""Property-based device-vs-host parity for the joint-dictionary string
+surface (r5): col-vs-col compares, string if_else/fill_null, and derived
+string sort keys over randomized pools (unicode, empty strings, nulls,
+all-null columns, single-value dictionaries).
+
+Runs in the REAL-TPU configuration (x64 off, device kernels forced, low
+device_min_rows) inside each example so the device path actually engages;
+the host run of the same query is the oracle. Reference: hypothesis
+property tests of the reference's utf8/if_else kernels
+(tests/property_based_testing, SURVEY.md §4)."""
+
+from contextlib import contextmanager
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+import jax
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.context import get_context
+
+_POOL = st.sampled_from(
+    ["", "a", "aa", "ab", "z", "émé", "ZZ", "mail", "MAIL", "é", "0"])
+_elem = st.one_of(st.none(), _POOL)
+
+
+@contextmanager
+def _device32():
+    cfg = get_context().execution_config
+    saved = (cfg.use_device_kernels, cfg.device_min_rows,
+             cfg.device_reduced_precision)
+    x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    cfg.use_device_kernels = True
+    cfg.device_min_rows = 1
+    cfg.device_reduced_precision = True
+    try:
+        yield cfg
+    finally:
+        jax.config.update("jax_enable_x64", x64)
+        (cfg.use_device_kernels, cfg.device_min_rows,
+         cfg.device_reduced_precision) = saved
+
+
+def _frame(a, b):
+    return dt.from_pydict({
+        "a": dt.Series.from_pylist(list(a), "a", dt.DataType.string()),
+        "b": dt.Series.from_pylist(list(b), "b", dt.DataType.string()),
+    })
+
+
+def _run_device_and_host(build):
+    with _device32() as cfg:
+        got = build().to_pydict()
+        cfg.use_device_kernels = False
+        want = build().to_pydict()
+    return got, want
+
+
+@st.composite
+def _two_cols(draw):
+    n = draw(st.integers(min_value=1, max_value=24))
+    a = draw(st.lists(_elem, min_size=n, max_size=n))
+    b = draw(st.lists(_elem, min_size=n, max_size=n))
+    return a, b
+
+
+@given(_two_cols(), st.sampled_from(["==", "!=", "<", "<=", ">", ">="]))
+@settings(max_examples=60, deadline=None)
+def test_colcol_compare_parity(case, op):
+    a, b = case
+
+    def build():
+        l, r = col("a"), col("b")
+        pred = {"==": l == r, "!=": l != r, "<": l < r,
+                "<=": l <= r, ">": l > r, ">=": l >= r}[op]
+        return _frame(a, b).select(pred.alias("p"))
+
+    got, want = _run_device_and_host(build)
+    assert got == want
+
+
+@given(_two_cols())
+@settings(max_examples=40, deadline=None)
+def test_fill_null_with_column_parity(case):
+    a, b = case
+
+    def build():
+        return _frame(a, b).select(col("a").fill_null(col("b")).alias("f"))
+
+    got, want = _run_device_and_host(build)
+    assert got == want
+
+
+@given(_two_cols(), _POOL)
+@settings(max_examples=40, deadline=None)
+def test_if_else_with_literal_parity(case, lit):
+    a, b = case
+
+    def build():
+        return _frame(a, b).select(
+            (col("a") <= col("b")).if_else(col("a"), lit).alias("pick"))
+
+    got, want = _run_device_and_host(build)
+    assert got == want
+
+
+@given(_two_cols())
+@settings(max_examples=30, deadline=None)
+def test_sort_by_filled_key_parity(case):
+    a, b = case
+
+    def build():
+        return (_frame(a, b)
+                .select(col("a").fill_null(col("b")).alias("k"))
+                .sort("k"))
+
+    got, want = _run_device_and_host(build)
+    assert got == want
