@@ -13,20 +13,14 @@ import pytest
 
 @pytest.fixture(autouse=True)
 def real_tpu_mode():
-    from daft_tpu.context import get_context
+    import os
+    import sys
 
-    cfg = get_context().execution_config
-    saved = (cfg.use_device_kernels, cfg.device_min_rows, cfg.device_reduced_precision)
-    jax.config.update("jax_enable_x64", False)
-    cfg.use_device_kernels = True
-    cfg.device_min_rows = 8
-    cfg.device_reduced_precision = True
-    try:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from device_mode import real_tpu_mode_cfg
+
+    with real_tpu_mode_cfg(device_min_rows=8):
         yield
-    finally:
-        jax.config.update("jax_enable_x64", True)
-        (cfg.use_device_kernels, cfg.device_min_rows,
-         cfg.device_reduced_precision) = saved
 
 
 @pytest.fixture

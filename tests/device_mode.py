@@ -1,0 +1,29 @@
+"""THE real-TPU-mode configuration recipe, shared by the device32 suite's
+fixture and the property suites (which need per-example application, not a
+function-scoped fixture): x64 OFF, device kernels forced with a low
+engagement threshold, reduced precision on. When the real-TPU mode gains a
+flag, this is the only place it is declared."""
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def real_tpu_mode_cfg(device_min_rows: int = 8):
+    import jax
+
+    from daft_tpu.context import get_context
+
+    cfg = get_context().execution_config
+    saved = (cfg.use_device_kernels, cfg.device_min_rows,
+             cfg.device_reduced_precision)
+    x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    cfg.use_device_kernels = True
+    cfg.device_min_rows = device_min_rows
+    cfg.device_reduced_precision = True
+    try:
+        yield cfg
+    finally:
+        jax.config.update("jax_enable_x64", x64)
+        (cfg.use_device_kernels, cfg.device_min_rows,
+         cfg.device_reduced_precision) = saved

@@ -9,38 +9,21 @@ the host run of the same query is the oracle. Reference: hypothesis
 property tests of the reference's utf8/if_else kernels
 (tests/property_based_testing, SURVEY.md §4)."""
 
-from contextlib import contextmanager
-
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
-import jax
-
 import daft_tpu as dt
 from daft_tpu import col
-from daft_tpu.context import get_context
+
+from device_mode import real_tpu_mode_cfg
 
 _POOL = st.sampled_from(
     ["", "a", "aa", "ab", "z", "émé", "ZZ", "mail", "MAIL", "é", "0"])
 _elem = st.one_of(st.none(), _POOL)
 
 
-@contextmanager
 def _device32():
-    cfg = get_context().execution_config
-    saved = (cfg.use_device_kernels, cfg.device_min_rows,
-             cfg.device_reduced_precision)
-    x64 = jax.config.jax_enable_x64
-    jax.config.update("jax_enable_x64", False)
-    cfg.use_device_kernels = True
-    cfg.device_min_rows = 1
-    cfg.device_reduced_precision = True
-    try:
-        yield cfg
-    finally:
-        jax.config.update("jax_enable_x64", x64)
-        (cfg.use_device_kernels, cfg.device_min_rows,
-         cfg.device_reduced_precision) = saved
+    return real_tpu_mode_cfg(device_min_rows=1)
 
 
 def _frame(a, b):
