@@ -270,9 +270,28 @@ class ExecutionContext:
         return (self.cfg.use_device_kernels
                 and (part.num_rows_or_none() or 0) >= self.cfg.device_min_rows)
 
+    def foreign_owned(self, part: MicroPartition) -> bool:
+        """True when this process must not materialize `part` (another host
+        of a multi-process run owns its rows). Single-process: never."""
+        return False
+
+    def _defer_projection(self, part: MicroPartition, exprs):
+        """Foreign-owned unloaded partition: append the projection to the
+        partition's pending op chain instead of reading the file (per-host
+        scan locality through map chains; the owner evaluates for real)."""
+        from .schema import Schema
+
+        exprs = list(exprs)
+        schema = Schema([e._node.to_field(part.schema) for e in exprs])
+        return part.with_pending_op(
+            lambda t: t.eval_expression_list(exprs), schema,
+            count_preserving=True)
+
     def eval_projection(self, part: MicroPartition, exprs) -> MicroPartition:
         """Route a projection through the device kernel layer when eligible,
         else the host path."""
+        if self.foreign_owned(part) and not part.is_loaded():
+            return self._defer_projection(part, exprs)
         if self._device_eligible(part):
             try:
                 from .kernels.device import eval_projection_device
@@ -283,7 +302,7 @@ class ExecutionContext:
                 out = None
             if out is not None:
                 self.stats.bump("device_projections")
-                return MicroPartition.from_table(out)
+                return part._wrap(out)
         self.stats.bump("host_projections")
         return part.eval_expression_list(exprs)
 
@@ -293,6 +312,9 @@ class ExecutionContext:
         path is ineligible (caller falls back to the synchronous
         eval_projection). The resolver itself falls back to the host kernel
         if the deferred device computation fails at materialization."""
+        if self.foreign_owned(part) and not part.is_loaded():
+            deferred = self._defer_projection(part, exprs)
+            return lambda: deferred
         if not self._device_eligible(part):
             return None
         try:
@@ -309,7 +331,7 @@ class ExecutionContext:
 
         def finish() -> MicroPartition:
             try:
-                return MicroPartition.from_table(resolve())
+                return part._wrap(resolve())
             except Exception:
                 # the partition was NOT computed on device after all: keep
                 # the counters truthful (same attribution the synchronous
@@ -575,9 +597,16 @@ class ExecutionContext:
         self.stats.bump("host_joins")
         return lpart.hash_join(rpart, left_on, right_on, how, suffix)
 
+    def _defer_filter(self, part: MicroPartition, predicate):
+        return part.with_pending_op(
+            lambda t: t.filter([predicate]), part.schema,
+            count_preserving=False)
+
     def eval_filter(self, part: MicroPartition, predicate) -> MicroPartition:
         """Filter a partition: when eligible, the predicate mask is computed on
         device and only the compaction happens on host."""
+        if self.foreign_owned(part) and not part.is_loaded():
+            return self._defer_filter(part, predicate)
         if self._device_eligible(part):
             try:
                 from .kernels.device import eval_projection_device
@@ -589,8 +618,7 @@ class ExecutionContext:
             if out is not None:
                 self.stats.bump("device_filters")
                 mask = out._columns[0]
-                return MicroPartition.from_table(
-                    part.table().filter_with_mask(mask))
+                return part._wrap(part.table().filter_with_mask(mask))
         self.stats.bump("host_filters")
         return part.filter([predicate])
 
@@ -598,6 +626,9 @@ class ExecutionContext:
         """Non-blocking launch of the device filter mask; the resolver pulls
         the mask back and compacts on host — same contract as
         eval_projection_dispatch."""
+        if self.foreign_owned(part) and not part.is_loaded():
+            deferred = self._defer_filter(part, predicate)
+            return lambda: deferred
         if not self._device_eligible(part):
             return None
         try:
@@ -617,8 +648,7 @@ class ExecutionContext:
             try:
                 out = resolve()
                 mask = out._columns[0]
-                return MicroPartition.from_table(
-                    part.table().filter_with_mask(mask))
+                return part._wrap(part.table().filter_with_mask(mask))
             except Exception:
                 self.stats.bump("device_filters", -1)
                 self.stats.bump("device_filter_fallbacks")

@@ -20,7 +20,8 @@ from .table import Table
 
 class MicroPartition:
     __slots__ = ("schema", "_state", "_tables", "_scan_task", "_stats", "_lock",
-                 "_device_cache")
+                 "_device_cache", "owner_process", "_pending",
+                 "_count_preserving")
 
     def __init__(self, schema: Schema, tables: Optional[List[Table]] = None,
                  scan_task=None, stats: Optional[TableStats] = None):
@@ -37,9 +38,32 @@ class MicroPartition:
         # repeated queries over a cached/collected partition reuse staged
         # columns instead of re-transferring (lifetime == partition lifetime).
         self._device_cache: Dict[Any, Any] = {}
+        # Per-host scan locality (reference: per-node dispatch,
+        # ray_runner.py:504-685): owner_process marks a scan partition whose
+        # rows are CONTRIBUTED by exactly one process of a multi-host run;
+        # _pending defers map-op evaluation on foreign-owned unloaded
+        # partitions (Table -> Table transforms replayed at materialization)
+        # so a projection/filter chain between scan and exchange never forces
+        # a foreign read. Any consumer that DOES materialize gets the correct
+        # post-op rows — correctness never depends on ownership.
+        self.owner_process: Optional[int] = None
+        self._pending: Optional[List[Any]] = None
+        self._count_preserving = True
 
     def device_stage_cache(self) -> Dict[Any, Any]:
         return self._device_cache
+
+    def with_pending_op(self, fn, schema: Schema,
+                        count_preserving: bool) -> "MicroPartition":
+        """Deferred map op over an unloaded partition: same scan task, the
+        transform replays at table() time. Used only for foreign-owned
+        partitions in multi-host mode."""
+        out = MicroPartition(schema, scan_task=self._scan_task,
+                            stats=None)
+        out.owner_process = self.owner_process
+        out._pending = list(self._pending or []) + [fn]
+        out._count_preserving = self._count_preserving and count_preserving
+        return out
 
     # ------------------------------------------------------------------ ctors
     @staticmethod
@@ -81,6 +105,9 @@ class MicroPartition:
         with self._lock:
             if self._state == "unloaded":
                 tbl = self._scan_task.read()
+                for fn in self._pending or ():
+                    tbl = fn(tbl)
+                self._pending = None
                 self._tables = [tbl]
                 self._state = "loaded"
                 self._scan_task = None
@@ -98,11 +125,15 @@ class MicroPartition:
         """Row count without IO, if knowable (loaded, or exact scan metadata)."""
         if self._state == "loaded":
             return sum(len(t) for t in self._tables)
+        if not self._count_preserving:
+            return None  # a deferred filter changes the count
         return self._scan_task.num_rows()
 
     def size_bytes(self) -> Optional[int]:
         if self._state == "loaded":
             return sum(t.size_bytes() for t in self._tables)
+        if self._pending:
+            return None  # deferred ops change the width/count
         return self._scan_task.size_bytes()
 
     def statistics(self) -> Optional[TableStats]:
@@ -137,7 +168,12 @@ class MicroPartition:
     # Each materializes and delegates to Table, returning a Loaded partition.
 
     def _wrap(self, tbl: Table) -> "MicroPartition":
-        return MicroPartition.from_table(tbl)
+        out = MicroPartition.from_table(tbl)
+        # contribution ownership survives per-partition transforms so the
+        # multi-host exchange keeps exactly-once semantics by OWNER, not by
+        # a fragile stream-index coincidence
+        out.owner_process = self.owner_process
+        return out
 
     def eval_expression_list(self, exprs) -> "MicroPartition":
         return self._wrap(self.table().eval_expression_list(exprs))
@@ -153,12 +189,19 @@ class MicroPartition:
 
     def head(self, n: int) -> "MicroPartition":
         if self._state == "unloaded":
+            if self._pending:
+                # a limit must not push BELOW deferred ops (the deferred
+                # filter changes which rows the first n are): defer it too
+                return self.with_pending_op(lambda t: t.head(n), self.schema,
+                                            count_preserving=False)
             # narrow the scan's limit instead of reading everything
             task = self._scan_task
             pd = task.pushdowns
             new_limit = n if pd.limit is None else min(pd.limit, n)
             narrowed = task.with_pushdowns(pd.with_limit(new_limit))
-            return MicroPartition.from_scan_task(narrowed)
+            out = MicroPartition.from_scan_task(narrowed)
+            out.owner_process = self.owner_process
+            return out
         return self._wrap(self.table().head(n))
 
     def sample(self, fraction=None, size=None, with_replacement=False, seed=None) -> "MicroPartition":
@@ -176,7 +219,7 @@ class MicroPartition:
             # chunked acero pass instead of concatenating the pieces first
             out = Table.acero_grouped_agg_chunked(self._tables, to_agg, group_by)
             if out is not None:
-                return MicroPartition.from_table(out)
+                return self._wrap(out)
         return self._wrap(self.table().agg(to_agg, group_by))
 
     def distinct(self, subset=None) -> "MicroPartition":
@@ -205,11 +248,22 @@ class MicroPartition:
 
     def select_columns(self, names: List[str]) -> "MicroPartition":
         if self._state == "unloaded":
+            if self._pending:
+                # the names may only exist in a deferred projection's output:
+                # never push them into the file scan — defer the select
+                from .schema import Schema as _S
+
+                return self.with_pending_op(
+                    lambda t: t.select_columns(names),
+                    _S([self.schema[c] for c in names]),
+                    count_preserving=True)
             task = self._scan_task
             pd = task.pushdowns
             cols = [c for c in names]
             narrowed = task.with_pushdowns(pd.with_columns(cols))
-            return MicroPartition.from_scan_task(narrowed)
+            out = MicroPartition.from_scan_task(narrowed)
+            out.owner_process = self.owner_process
+            return out
         return self._wrap(self.table().select_columns(names))
 
     def rename_columns(self, mapping: Dict[str, str]) -> "MicroPartition":

@@ -79,6 +79,24 @@ class MeshExecutionContext(ExecutionContext):
     def n_devices(self) -> int:
         return int(np.prod(list(self.mesh.shape.values())))
 
+    @property
+    def _multiproc(self) -> bool:
+        me = jax.process_index()
+        return any(d.process_index != me for d in self.mesh.devices.flat)
+
+    def scan_owner(self, idx: int) -> Optional[int]:
+        """Owner process for scan task `idx` in multi-process mode — each
+        host materializes (and reads) only its share (reference: per-node
+        scan dispatch, ray_runner.py:504-685). None single-process."""
+        if not self._multiproc:
+            return None
+        return idx % jax.process_count()
+
+    def foreign_owned(self, part: MicroPartition) -> bool:
+        return (part.owner_process is not None
+                and self._multiproc
+                and part.owner_process != jax.process_index())
+
     def prepare_broadcast(self, part: MicroPartition, on_exprs,
                           how: str = "inner") -> MicroPartition:
         """Replicate a broadcast-join build side's join keys into every mesh
@@ -141,7 +159,8 @@ class MeshExecutionContext(ExecutionContext):
             # full outputs on every process, reconverging the control plane.
             nproc = jax.process_count()
             tables = [p.table() for i, p in enumerate(parts)
-                      if i % nproc == my_proc]
+                      if (p.owner_process if p.owner_process is not None
+                          else i % nproc) == my_proc]
         else:
             tables = [p.table() for p in parts]
         total = sum(len(t) for t in tables)

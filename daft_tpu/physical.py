@@ -172,12 +172,18 @@ class ScanOp(PhysicalOp):
         self.tasks = tasks
 
     def execute(self, inputs, ctx) -> PartStream:
-        for task in self.tasks:
+        scan_owner = getattr(ctx, "scan_owner", None)
+        for i, task in enumerate(self.tasks):
             if task.can_prune():
                 ctx.stats.bump("scan_tasks_pruned")
                 continue
             ctx.stats.bump("scan_tasks_emitted")
-            yield MicroPartition.from_scan_task(task)
+            part = MicroPartition.from_scan_task(task)
+            if scan_owner is not None:
+                # multi-host: the task index over the globally-consistent
+                # list assigns which process materializes (and READS) it
+                part.owner_process = scan_owner(i)
+            yield part
 
     def describe(self):
         return f"Scan [{len(self.tasks)} tasks]"

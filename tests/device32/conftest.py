@@ -7,18 +7,20 @@ re-runs the device-path surface in that exact configuration so the real-TPU
 mode has first-class coverage (round-2 verdict: it had none).
 """
 
-import jax
+import os
+import sys
+
 import pytest
+
+_TESTS_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _TESTS_DIR not in sys.path:
+    sys.path.insert(0, _TESTS_DIR)
+
+from device_mode import real_tpu_mode_cfg  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
 def real_tpu_mode():
-    import os
-    import sys
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from device_mode import real_tpu_mode_cfg
-
     with real_tpu_mode_cfg(device_min_rows=8):
         yield
 

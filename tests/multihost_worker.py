@@ -180,3 +180,48 @@ import shutil  # noqa: E402
 
 shutil.rmtree(scan_dir, ignore_errors=True)
 print(f"MULTIHOST_SCANLOC_OK {pid} opened={opened}", flush=True)
+
+# ---------------------------------------------------------------------------
+# Scan locality THROUGH a map chain (deferred op chains): a computed
+# projection (with_column, not foldable into the scan's column pushdown) and
+# a filter sit between the scan and the exchange. Foreign-owned partitions
+# defer both ops into a pending chain instead of reading the file — locality
+# must hold for the whole chain, with exact parity.
+# ---------------------------------------------------------------------------
+scan_dir2 = os.path.join(tempfile.gettempdir(), f"mh_scanloc2_{port}_{pid}")
+os.makedirs(scan_dir2, exist_ok=True)
+rng3 = np.random.RandomState(11)
+key_parts2, val_parts2 = [], []
+for i in range(nfiles):
+    kk = rng3.randint(0, 30, 4000).astype(np.int64)
+    vv = rng3.randint(0, 500, 4000).astype(np.int64)
+    papq.write_table(pa.table({"k": kk, "v": vv}),
+                     os.path.join(scan_dir2, f"f{i:02d}.parquet"))
+    key_parts2.append(kk)
+    val_parts2.append(vv)
+k2 = np.concatenate(key_parts2)
+v2 = np.concatenate(val_parts2)
+
+before_opened2 = IO_STATS.snapshot()["files_opened"]
+res3 = (dtp.read_parquet(os.path.join(scan_dir2, "*.parquet"))
+        .with_column("w", col("v") * 3 + 1)   # computed: stays a ProjectOp
+        .where(col("w") % 2 == 1)             # deferred filter on foreign parts
+        .repartition(8, "k")
+        .groupby("k").agg(col("w").sum().alias("sw"))
+        .sort("k"))
+coll3 = res3.collect()
+opened2 = IO_STATS.snapshot()["files_opened"] - before_opened2
+assert coll3.stats.snapshot()["counters"].get("device_shuffles", 0) >= 1
+
+w_all = k2 * 0 + v2 * 3 + 1
+keep = (w_all % 2) == 1
+acc2 = collections.defaultdict(int)
+for kk, ww in zip(k2[keep].tolist(), w_all[keep].tolist()):
+    acc2[kk] += ww
+gd3 = coll3.to_pydict()
+assert gd3["k"] == sorted(acc2), (gd3["k"][:5], sorted(acc2)[:5])
+assert gd3["sw"] == [acc2[kk] for kk in sorted(acc2)], "map-chain parity broke"
+assert opened2 <= nfiles // nproc + 2, (
+    f"map-chain locality failed: process {pid} opened {opened2} of {nfiles}")
+shutil.rmtree(scan_dir2, ignore_errors=True)
+print(f"MULTIHOST_MAPCHAIN_OK {pid} opened={opened2}", flush=True)

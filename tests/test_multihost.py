@@ -57,4 +57,9 @@ def test_two_process_cluster_exchange_and_q5():
         opened = int(line.split("opened=")[1])
         assert opened <= 6, line
         opened_total += opened
+        # locality must also hold THROUGH a computed projection + filter
+        # (deferred op chains on foreign-owned partitions)
+        line2 = next(l for l in out.splitlines()
+                     if l.startswith(f"MULTIHOST_MAPCHAIN_OK {i}"))
+        assert int(line2.split("opened=")[1]) <= 6, line2
     assert opened_total >= 8, f"workers together opened {opened_total} < 8"
