@@ -140,6 +140,16 @@ from daft_tpu.io.scan import IO_STATS  # noqa: E402
 
 cfg.scan_tasks_min_size_bytes = 0  # keep the 8 files as 8 distinct tasks
 
+def _assert_groupby_sum(coll, keys_np, vals_np, key_col, out_col, tag):
+    """Exact oracle for a groupby-sum over the full dataset."""
+    acc = collections.defaultdict(int)
+    for kk, vv in zip(keys_np.tolist(), vals_np.tolist()):
+        acc[kk] += vv
+    gd = coll.to_pydict()
+    assert gd[key_col] == sorted(acc), (tag, gd[key_col][:5], sorted(acc)[:5])
+    assert gd[out_col] == [acc[kk] for kk in sorted(acc)], f"{tag} parity broke"
+
+
 scan_dir = os.path.join(tempfile.gettempdir(), f"mh_scanloc_{port}_{pid}")
 os.makedirs(scan_dir, exist_ok=True)
 rng2 = np.random.RandomState(7)  # same seed -> identical files on both procs
@@ -164,13 +174,7 @@ opened = IO_STATS.snapshot()["files_opened"] - before_opened
 shuffles2 = coll2.stats.snapshot()["counters"].get("device_shuffles", 0)
 assert shuffles2 >= 1, f"device exchange never engaged: {coll2.stats.snapshot()}"
 
-acc = collections.defaultdict(int)
-for kk, vv in zip(key_all.tolist(), val_all.tolist()):
-    acc[kk] += vv
-want_keys = sorted(acc)
-gd2 = coll2.to_pydict()
-assert gd2["k"] == want_keys, (gd2["k"][:5], want_keys[:5])
-assert gd2["s"] == [acc[kk] for kk in want_keys], "scan-locality parity broke"
+_assert_groupby_sum(coll2, key_all, val_all, "k", "s", "scan-locality")
 
 # the locality claim itself: this process read its share, not the whole input
 assert opened <= nfiles // nproc + 2, (
@@ -215,13 +219,38 @@ assert coll3.stats.snapshot()["counters"].get("device_shuffles", 0) >= 1
 
 w_all = k2 * 0 + v2 * 3 + 1
 keep = (w_all % 2) == 1
-acc2 = collections.defaultdict(int)
-for kk, ww in zip(k2[keep].tolist(), w_all[keep].tolist()):
-    acc2[kk] += ww
-gd3 = coll3.to_pydict()
-assert gd3["k"] == sorted(acc2), (gd3["k"][:5], sorted(acc2)[:5])
-assert gd3["sw"] == [acc2[kk] for kk in sorted(acc2)], "map-chain parity broke"
+_assert_groupby_sum(coll3, k2[keep], w_all[keep], "k", "sw", "map-chain")
 assert opened2 <= nfiles // nproc + 2, (
     f"map-chain locality failed: process {pid} opened {opened2} of {nfiles}")
 shutil.rmtree(scan_dir2, ignore_errors=True)
 print(f"MULTIHOST_MAPCHAIN_OK {pid} opened={opened2}", flush=True)
+
+# ---------------------------------------------------------------------------
+# Degenerate ownership: ONE input file, owned by process 0 — process 1
+# contributes ZERO local rows to the exchange and must still stage empty
+# slabs, agree on the negotiated capacity, and reconstitute the full result
+# from the allgather. This is the empty-local-contribution path of the
+# global shape negotiation.
+# ---------------------------------------------------------------------------
+scan_dir3 = os.path.join(tempfile.gettempdir(), f"mh_scanloc3_{port}_{pid}")
+os.makedirs(scan_dir3, exist_ok=True)
+rng4 = np.random.RandomState(23)
+k3 = rng4.randint(0, 12, 3000).astype(np.int64)
+v3 = rng4.randint(0, 100, 3000).astype(np.int64)
+papq.write_table(pa.table({"k": k3, "v": v3}),
+                 os.path.join(scan_dir3, "only.parquet"))
+before_opened3 = IO_STATS.snapshot()["files_opened"]
+res4 = (dtp.read_parquet(os.path.join(scan_dir3, "*.parquet"))
+        .repartition(4, "k").groupby("k").agg(col("v").sum().alias("s"))
+        .sort("k"))
+coll4 = res4.collect()
+opened3 = IO_STATS.snapshot()["files_opened"] - before_opened3
+assert coll4.stats.snapshot()["counters"].get("device_shuffles", 0) >= 1
+# the path under test: ONLY the owner reads the single file (process 1
+# contributes zero rows yet completes the negotiated exchange); +1 slack
+# for the planner's schema-inference open
+assert opened3 <= (1 if pid == 0 else 0) + 1, (
+    f"process {pid} opened {opened3} files of the single-owner input")
+_assert_groupby_sum(coll4, k3, v3, "k", "s", "single-owner")
+shutil.rmtree(scan_dir3, ignore_errors=True)
+print(f"MULTIHOST_EMPTYLOCAL_OK {pid}", flush=True)

@@ -62,4 +62,7 @@ def test_two_process_cluster_exchange_and_q5():
         line2 = next(l for l in out.splitlines()
                      if l.startswith(f"MULTIHOST_MAPCHAIN_OK {i}"))
         assert int(line2.split("opened=")[1]) <= 6, line2
+        # one-file case: a process with zero local rows still participates
+        # in the negotiated exchange and reconstitutes the full result
+        assert f"MULTIHOST_EMPTYLOCAL_OK {i}" in out, out
     assert opened_total >= 8, f"workers together opened {opened_total} < 8"
