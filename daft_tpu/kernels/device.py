@@ -630,11 +630,14 @@ def _strlut_env_key(node_key) -> str:
 # ---------------------------------------------------------------------------
 
 
+_CMP_OPS_NULLSAFE = _CMP_OPS + ("<=>",)
+
+
 def _string_colcol_shape(node, schema):
     """(lcol, rcol) when `node` compares two plain string Columns."""
     from ..expressions import BinaryOp
 
-    if not (isinstance(node, BinaryOp) and node.op in _CMP_OPS + ("<=>",)):
+    if not (isinstance(node, BinaryOp) and node.op in _CMP_OPS_NULLSAFE):
         return None
     lcol = _plain_string_column(node.left, schema)
     rcol = _plain_string_column(node.right, schema)
@@ -662,10 +665,9 @@ class _StringChoice:
 def _string_choice_shape(node, schema):
     """_StringChoice for a string-typed FillNull/IfElse whose value operands
     are plain string columns / string literals / null literals; else None."""
-    from ..expressions import Alias, FillNull, IfElse, Literal
+    from ..expressions import FillNull, IfElse, Literal
 
-    while isinstance(node, Alias):
-        node = node.child
+    node = _peel_alias(node)
     if isinstance(node, FillNull):
         kind, pred, vals = "fill_null", None, (node.child, node.fill)
     elif isinstance(node, IfElse):
@@ -701,6 +703,23 @@ def _joint_group_of(node, schema):
     if ch is not None:
         return ch.cols, ch.lits
     return None
+
+
+def joint_remap(dictionary, joint):
+    """Device remap array taking one dictionary's codes into a sorted JOINT
+    dictionary's code space, padded to a size bucket so the consuming gather
+    compiles per bucket — shared by the in-table string groups here and the
+    cross-table join-key recoding (device_join._joint_remaps)."""
+    if len(dictionary) == 0:
+        # all-null side: codes are all 0/masked; remap needs 1 lane
+        arr = np.zeros(1, dtype=np.int32)
+    else:
+        arr = np.asarray(pc.index_in(dictionary.cast(pa.large_string()),
+                                     value_set=joint), dtype=np.int32)
+    b = size_bucket(len(arr))
+    if b > len(arr):
+        arr = np.concatenate([arr, np.zeros(b - len(arr), np.int32)])
+    return jnp.asarray(arr)
 
 
 def _joint_gkey(cols, lits) -> str:
@@ -755,16 +774,8 @@ def string_joint_env(nodes, schema, dcs, env, aux: dict):
         joint = pc.unique(pa.concat_arrays(parts))
         joint = joint.take(pc.sort_indices(joint))
         for c in cols:
-            d = dcs[c].dictionary
-            if len(d) == 0:
-                arr = np.zeros(1, dtype=np.int32)
-            else:
-                arr = np.asarray(pc.index_in(d.cast(pa.large_string()),
-                                             value_set=joint), dtype=np.int32)
-            b = size_bucket(len(arr))
-            if b > len(arr):
-                arr = np.concatenate([arr, np.zeros(b - len(arr), np.int32)])
-            merged[_joint_map_key(gkey, c)] = jnp.asarray(arr)
+            merged[_joint_map_key(gkey, c)] = joint_remap(dcs[c].dictionary,
+                                                          joint)
         for lit in lits:
             code = pc.index(joint, pa.scalar(lit, pa.large_string())).as_py()
             merged[_joint_lit_key(gkey, lit)] = jnp.int32(code)

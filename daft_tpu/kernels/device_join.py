@@ -188,25 +188,14 @@ def _joint_remaps(ldc, rdc, lcache, rcache):
     import pyarrow as pa
     import pyarrow.compute as pc
 
+    from .device import joint_remap
+
     joint = pc.unique(pa.concat_arrays([
         ldc.dictionary.cast(pa.large_string()),
         rdc.dictionary.cast(pa.large_string())]))
     joint = joint.take(pc.sort_indices(joint))
-
-    def remap_of(d):
-        if len(d) == 0:
-            # all-null side: codes are all 0/masked; remap needs 1 lane
-            arr = np.zeros(1, dtype=np.int32)
-        else:
-            idx = pc.index_in(d.cast(pa.large_string()), value_set=joint)
-            arr = np.asarray(idx, dtype=np.int32)
-        b = size_bucket(len(arr))
-        if b > len(arr):
-            arr = np.concatenate([arr, np.zeros(b - len(arr), np.int32)])
-        return jnp.asarray(arr)
-
-    lremap = remap_of(ldc.dictionary)
-    rremap = remap_of(rdc.dictionary)
+    lremap = joint_remap(ldc.dictionary, joint)
+    rremap = joint_remap(rdc.dictionary, joint)
     entry = (ldc.dictionary, rdc.dictionary, lremap, rremap)
     for cache in (lcache, rcache):
         if cache is not None:

@@ -359,12 +359,16 @@ def _resize_batch_separable(batch: np.ndarray, h: int, w: int) -> np.ndarray:
     wh = _resize_weight_mat(oh, h)
     ww = _resize_weight_mat(ow, w)
     if jax.default_backend() == "cpu":
-        outs = []
+        # write each chunk's second contraction straight into the
+        # preallocated result: np.concatenate would copy the full
+        # (n, h, w, c) float32 output once more (~6 GB at n=10k)
+        out = np.empty((n, h, w, c), np.float32)
         for i in range(0, n, _RESIZE_CHUNK):
             piece = batch[i:i + _RESIZE_CHUNK]
             t = np.einsum("os,nshc->nohc", wh, piece, optimize=True)
-            outs.append(np.einsum("ow,nhwc->nhoc", ww, t, optimize=True))
-        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+            np.einsum("ow,nhwc->nhoc", ww, t, optimize=True,
+                      out=out[i:i + len(piece)])
+        return out
 
     rs = _rs_jitted()
     jwh, jww = jnp.asarray(wh), jnp.asarray(ww)
