@@ -6,8 +6,8 @@ MicroPartitions lazy and spilling pipeline-breaker state. Here, every
 pipeline breaker that must hold many partitions (shuffle fanout buckets,
 join builds, sort-merge buckets) accumulates into a PartitionBuffer: once
 the process-wide in-memory budget (ExecutionConfig.memory_budget_bytes) is
-exceeded, further partitions are written to parquet in a per-query spill
-directory and handed back as UNLOADED MicroPartitions — the consumer
+exceeded, further partitions are written as arrow IPC files in a per-query
+spill directory and handed back as UNLOADED MicroPartitions — the consumer
 re-materializes them one at a time, so peak engine-held memory stays at
 (budget + one working partition).
 
@@ -93,8 +93,8 @@ class SpillScope:
 
 
 class PartitionBuffer:
-    """Append MicroPartitions; past the budget they spill to parquet and come
-    back lazy. Iterating yields partitions in append order (spilled ones as
+    """Append MicroPartitions; past the budget they spill to arrow IPC files
+    and come back lazy. Iterating yields partitions in append order (spilled ones as
     Unloaded MicroPartitions that re-read on demand)."""
 
     def __init__(self, budget_bytes: Optional[int], stats=None,
