@@ -633,17 +633,63 @@ def _strlut_env_key(node_key) -> str:
 _CMP_OPS_NULLSAFE = _CMP_OPS + ("<=>",)
 
 
+def _string_cmp_side(node, schema):
+    """One side of a general string compare: ('col', name) for a plain
+    string Column, ('choice', _StringChoice) for a string fill_null/if_else,
+    ('lit', value) / ('null', None) for string/null literals; else None."""
+    from ..expressions import Literal
+
+    c = _plain_string_column(node, schema)
+    if c is not None:
+        return ("col", c)
+    ch = _string_choice_shape(node, schema)
+    if ch is not None:
+        return ("choice", ch)
+    if isinstance(node, Literal):
+        if node.value is None:
+            return ("null", None)
+        if isinstance(node.value, str) and (node.dtype.is_string()
+                                            or node.dtype.is_null()):
+            return ("lit", node.value)
+    return None
+
+
+def _side_group(side):
+    """(cols, lits) a compare side contributes to the joint group."""
+    kind, v = side
+    if kind == "col":
+        return (v,), ()
+    if kind == "choice":
+        return v.cols, v.lits
+    if kind == "lit":
+        return (), (v,)
+    return (), ()
+
+
 def _string_colcol_shape(node, schema):
-    """(lcol, rcol) when `node` compares two plain string Columns."""
+    """(lside, rside) when `node` is a string compare whose sides are plain
+    columns, string choice shapes (fill_null/if_else), or literals — with at
+    least one non-literal side (pure literal-vs-column compares take the
+    cheaper per-column bisect path, _string_cmp_shape, tried first)."""
     from ..expressions import BinaryOp
 
     if not (isinstance(node, BinaryOp) and node.op in _CMP_OPS_NULLSAFE):
         return None
-    lcol = _plain_string_column(node.left, schema)
-    rcol = _plain_string_column(node.right, schema)
-    if lcol is not None and rcol is not None:
-        return lcol, rcol
-    return None
+    try:
+        ldt = node.left.to_field(schema).dtype
+        rdt = node.right.to_field(schema).dtype
+    except (ValueError, KeyError):
+        return None
+    if not ((ldt.is_string() or ldt.is_null())
+            and (rdt.is_string() or rdt.is_null())):
+        return None
+    lside = _string_cmp_side(node.left, schema)
+    rside = _string_cmp_side(node.right, schema)
+    if lside is None or rside is None:
+        return None
+    if lside[0] in ("lit", "null") and rside[0] in ("lit", "null"):
+        return None  # constant-folding territory, not worth a device shape
+    return lside, rside
 
 
 class _StringChoice:
@@ -695,10 +741,18 @@ def _string_choice_shape(node, schema):
 
 
 def _joint_group_of(node, schema):
-    """(cols, lits) joint-dictionary group for a node, or None."""
-    cc = _string_colcol_shape(node, schema)
-    if cc is not None:
-        return tuple(sorted(set(cc))), ()
+    """(cols, lits) joint-dictionary group for a node, or None. A general
+    string compare's group unions BOTH sides (a choice side's codes must be
+    comparable with the other side's), EXCEPT when the cheap per-column
+    literal-bisect shape handles the node — that path uses the column's own
+    dictionary, no joint group needed."""
+    if _string_cmp_shape(node, schema) is None:
+        cc = _string_colcol_shape(node, schema)
+        if cc is not None:
+            lc, ll = _side_group(cc[0])
+            rc, rl = _side_group(cc[1])
+            return (tuple(sorted(set(lc) | set(rc))),
+                    tuple(sorted(set(ll) | set(rl))))
     ch = _string_choice_shape(node, schema)
     if ch is not None:
         return ch.cols, ch.lits
@@ -734,14 +788,95 @@ def _joint_lit_key(gkey: str, lit: str) -> str:
     return f"__joint__\x00{gkey}\x00lit\x00{lit}"
 
 
+def _joint_operand_fn(kind, val, gkey):
+    """env -> (joint codes, valid) closure for a col/lit/null operand of a
+    joint-dictionary group."""
+    if kind == "col":
+        mk = _joint_map_key(gkey, val)
+
+        def get(env, _c=val, _mk=mk):
+            codes, m = env[_c]
+            return env[_mk][codes], m
+    elif kind == "lit":
+        lk = _joint_lit_key(gkey, val)
+
+        def get(env, _lk=lk):
+            n = _env_nrows(env)
+            return (jnp.full(n, env[_lk], dtype=jnp.int32),
+                    jnp.ones(n, dtype=bool))
+    else:  # null literal
+
+        def get(env):
+            n = _env_nrows(env)
+            return (jnp.zeros(n, dtype=jnp.int32),
+                    jnp.zeros(n, dtype=bool))
+    return get
+
+
+def _choice_code_fn(ch, gkey, schema):
+    """env -> (joint codes, valid) closure for a string fill_null/if_else,
+    emitting codes in the group keyed by `gkey` (the node's OWN group when
+    it is a projection output; the enclosing compare's bigger group when
+    nested as a compare side)."""
+    a = _joint_operand_fn(*ch.operands[0], gkey)
+    b = _joint_operand_fn(*ch.operands[1], gkey)
+    if ch.kind == "fill_null":
+        def run(env, _a=a, _b=b):
+            av, am = _a(env)
+            bv, bm = _b(env)
+            return jnp.where(am, av, bv), am | bm
+
+        return run
+    p, _pdt = _compile_node(ch.pred, schema)
+
+    def run(env, _p=p, _a=a, _b=b):
+        pv, pm = _p(env)
+        av, am = _a(env)
+        bv, bm = _b(env)
+        out = jnp.where(pv, av, bv)
+        return out, pm & jnp.where(pv, am, bm)
+
+    return run
+
+
+def _side_code_fn(side, gkey, schema):
+    """env -> (joint codes, valid) for one side of a general string compare."""
+    kind, v = side
+    if kind == "choice":
+        return _choice_code_fn(v, gkey, schema)
+    return _joint_operand_fn(kind, v, gkey)
+
+
+def _shape_choice_preds(node, schema):
+    """The choice-side PREDICATES of a matched joint shape — the only
+    subtrees under it that can contain further string shapes (its string
+    sides are owned by the shape itself)."""
+    ch = _string_choice_shape(node, schema)
+    if ch is not None:
+        return [ch.pred] if ch.pred is not None else []
+    cc = _string_colcol_shape(node, schema)
+    preds = []
+    if cc is not None:
+        for kind, v in cc:
+            if kind == "choice" and v.pred is not None:
+                preds.append(v.pred)
+    return preds
+
+
 def collect_joint_groups(nodes, schema):
-    """Every joint-dictionary group in the trees."""
+    """Every joint-dictionary group in the trees. A matched shape's string
+    sides are not re-walked (a choice nested under a compare emits codes in
+    the COMPARE's group; registering its standalone subset group too would
+    build a joint dictionary nothing reads) — only choice predicates recurse."""
     out = []
 
     def walk(n):
         g = _joint_group_of(n, schema)
         if g is not None:
             out.append(g)
+            for p in _shape_choice_preds(n, schema):
+                walk(p)
+            return
         for c in n.children():
             walk(c)
 
@@ -945,8 +1080,12 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
             return False
         if _string_cmp_shape(node, schema) is not None:
             return True
-        if _string_colcol_shape(node, schema) is not None:
-            return True  # joint-dictionary recode, compared on device
+        cc = _string_colcol_shape(node, schema)
+        if cc is not None:
+            # joint-dictionary recode, compared on device; a choice side's
+            # predicate must itself compile
+            return all(s[0] != "choice" or s[1].pred is None or rec(s[1].pred)
+                       for s in cc)
         # epoch comparisons compile as two-lane splits only in 32-bit mode;
         # under x64 the generic int64 path below handles them
         if not x64_enabled() and _epoch_cmp_shape(node, schema) is not None:
@@ -1133,48 +1272,8 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
         ch = _string_choice_shape(node, schema)
         if ch is None:
             raise ValueError("string choice not device-compilable here")
-        gkey = _joint_gkey(ch.cols, ch.lits)
-
-        def operand_fn(kind, val, _gkey=gkey):
-            if kind == "col":
-                mk = _joint_map_key(_gkey, val)
-
-                def get(env, _c=val, _mk=mk):
-                    codes, m = env[_c]
-                    return env[_mk][codes], m
-            elif kind == "lit":
-                lk = _joint_lit_key(_gkey, val)
-
-                def get(env, _lk=lk):
-                    n = _env_nrows(env)
-                    return (jnp.full(n, env[_lk], dtype=jnp.int32),
-                            jnp.ones(n, dtype=bool))
-            else:  # null literal
-
-                def get(env):
-                    n = _env_nrows(env)
-                    return (jnp.zeros(n, dtype=jnp.int32),
-                            jnp.zeros(n, dtype=bool))
-            return get
-
-        a = operand_fn(*ch.operands[0])
-        b = operand_fn(*ch.operands[1])
-        if ch.kind == "fill_null":
-            def run(env, _a=a, _b=b):
-                av, am = _a(env)
-                bv, bm = _b(env)
-                return jnp.where(am, av, bv), am | bm
-        else:
-            p, _pdt = _compile_node(ch.pred, schema)
-
-            def run(env, _p=p, _a=a, _b=b):
-                pv, pm = _p(env)
-                av, am = _a(env)
-                bv, bm = _b(env)
-                out = jnp.where(pv, av, bv)
-                return out, pm & jnp.where(pv, am, bm)
-
-        return run, out_dt
+        return _choice_code_fn(ch, _joint_gkey(ch.cols, ch.lits),
+                               schema), out_dt
 
     if isinstance(node, FillNull):
         a, adt = _compile_node(node.child, schema)
@@ -1257,17 +1356,18 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
             return run, out_dt
         ccshape = _string_colcol_shape(node, schema)
         if ccshape is not None:
-            lcol, rcol = ccshape
-            gkey = _joint_gkey(tuple(sorted({lcol, rcol})), ())
-            lmk = _joint_map_key(gkey, lcol)
-            rmk = _joint_map_key(gkey, rcol)
+            lside, rside = ccshape
+            lc, ll = _side_group(lside)
+            rc, rl = _side_group(rside)
+            gkey = _joint_gkey(tuple(sorted(set(lc) | set(rc))),
+                               tuple(sorted(set(ll) | set(rl))))
+            lf2 = _side_code_fn(lside, gkey, schema)
+            rf2 = _side_code_fn(rside, gkey, schema)
             op = node.op
 
-            def run(env, _lc=lcol, _rc=rcol, _lmk=lmk, _rmk=rmk, _op=op):
-                lc, lm = env[_lc]
-                rc, rm = env[_rc]
-                lv = env[_lmk][lc]
-                rv = env[_rmk][rc]
+            def run(env, _l=lf2, _r=rf2, _op=op):
+                lv, lm = _l(env)
+                rv, rm = _r(env)
                 if _op == "<=>":
                     eq = (lv == rv) & lm & rm
                     return eq | (~lm & ~rm), jnp.ones_like(lm)

@@ -315,7 +315,7 @@ def device_grouped_agg_async(table, to_agg, group_by,
 
     # --- stage inputs -----------------------------------------------------
     from .device import (epoch_cmp_env, epoch_cmps_for, int64_wrap_safe,
-                         string_literal_env, string_lut_env)
+                         string_joint_env, string_literal_env, string_lut_env)
 
     check_nodes = list(child_nodes) + (list(pred_nodes) if pred_nodes else [])
     needed = set()
@@ -340,6 +340,10 @@ def device_grouped_agg_async(table, to_agg, group_by,
     env = string_lut_env(check_nodes, schema, dcs, env)
     if env is None:
         return None  # a LUT predicate lost its dictionary
+    joint_aux: dict = {}
+    env = string_joint_env(check_nodes, schema, dcs, env, joint_aux)
+    if env is None:
+        return None  # a joint-group column lost its dictionary
 
     # --- compile + run ONE fused program ---------------------------------
     from ..context import get_context
@@ -379,14 +383,23 @@ def device_grouped_agg_async(table, to_agg, group_by,
             if expected_dt.is_string():
                 # string min/max reduce over sorted-dictionary CODES (order-
                 # isomorphic): the result must decode through the child
-                # column's dictionary or it would silently return code digits
-                from .device import _plain_string_column
+                # column's dictionary — or, for a fill_null/if_else child,
+                # its joint-group dictionary — or it would silently return
+                # code digits
+                from .device import (_joint_gkey, _plain_string_column,
+                                     _string_choice_shape)
 
                 cname = _plain_string_column(child_nd, schema)
                 src = dcs.get(cname) if cname else None
-                if src is None or src.dictionary is None:
+                if src is not None and src.dictionary is not None:
+                    dictionary = src.dictionary
+                else:
+                    ch = _string_choice_shape(child_nd, schema)
+                    if ch is not None:
+                        dictionary = joint_aux.get(
+                            _joint_gkey(ch.cols, ch.lits))
+                if dictionary is None:
                     return None  # cannot decode: host path recomputes
-                dictionary = src.dictionary
             merged = _finish_agg(kind, out, num_groups, expected_dt, n,
                                  dictionary=dictionary)
             if merged is None:

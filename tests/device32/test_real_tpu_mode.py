@@ -1698,3 +1698,108 @@ class TestRandomizedDeviceJoins32:
             host = q()
         assert _sorted_rows(dev) == _sorted_rows(host), (how, seed)
         assert c.get("device_join_probes", 0) >= 1, (how, seed, c)
+
+
+class TestStringChoiceCompare32:
+    """General string compares whose sides are fill_null/if_else results or
+    literals (r5 extension of the joint-dictionary groups): the choice
+    side's codes emit into the COMPARE's group so both sides share one code
+    space. Host parity on every op; counters prove device engagement."""
+
+    def _data(self, n=15_000):
+        a = np.array(["MAIL", "SHIP", "AIR", "RAIL"])[RNG.randint(0, 4, n)].tolist()
+        b = np.array(["MAIL", "TRUCK", "BARGE"])[RNG.randint(0, 3, n)].tolist()
+        for i in range(0, n, 37):
+            a[i] = None
+        for i in range(0, n, 53):
+            b[i] = None
+        return {"a": dt.Series.from_pylist(a, "a", dt.DataType.string()),
+                "b": dt.Series.from_pylist(b, "b", dt.DataType.string()),
+                "v": RNG.randint(0, 100, n).astype(np.int64)}
+
+    def test_fill_null_vs_column_compare(self, host_mode):
+        data = self._data()
+
+        def q():
+            return dt.from_pydict(data).where(
+                col("a").fill_null(col("b")) == col("b"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_if_else_vs_literal_compare(self, host_mode):
+        data = self._data()
+
+        def q():
+            return dt.from_pydict(data).select(
+                ((col("v") > 50).if_else(col("a"), col("b")) >= "MAIL")
+                .alias("m"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_choice_vs_choice_compare(self, host_mode):
+        data = self._data()
+
+        def q():
+            return dt.from_pydict(data).select(
+                (col("a").fill_null("zz") < col("b").fill_null("aa"))
+                .alias("c"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    @pytest.mark.parametrize("op", ["==", "!=", "<", "<=", ">", ">="])
+    def test_all_ops_choice_vs_column(self, op, host_mode):
+        data = self._data(6_000)
+
+        def q():
+            l = col("a").fill_null(col("b"))
+            r = col("b")
+            pred = {"==": l == r, "!=": l != r, "<": l < r,
+                    "<=": l <= r, ">": l > r, ">=": l >= r}[op]
+            return dt.from_pydict(data).select(pred.alias("p"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) >= 1, op
+        assert dev.to_pydict() == host.to_pydict(), op
+
+    def test_choice_compare_predicate_fuses_into_device_agg(self, host_mode):
+        """The planner fuses WHERE into the grouped agg; the fused device
+        path must build the joint-string env too (r5 regression: it declined
+        to host until string_joint_env was wired into
+        device_grouped_agg_async)."""
+        data = self._data()
+
+        def q():
+            return (dt.from_pydict(data)
+                    .where(col("a").fill_null(col("b")) >= col("b"))
+                    .groupby("b").agg(col("v").sum().alias("s"))
+                    .sort("b"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1, \
+            _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_string_min_max_over_choice_child(self, host_mode):
+        """min/max of a fill_null RESULT: the device agg reduces joint
+        codes and must decode through the joint-group dictionary (not the
+        raw column's) — previously this path could only decode plain
+        columns."""
+        data = self._data()
+
+        def q():
+            return (dt.from_pydict(data)
+                    .groupby("b")
+                    .agg(col("a").fill_null("zzz").min().alias("lo"),
+                         col("a").fill_null("zzz").max().alias("hi"))
+                    .sort("b"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1, \
+            _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()

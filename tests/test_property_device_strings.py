@@ -101,3 +101,21 @@ def test_sort_by_filled_key_parity(case):
 
     got, want = _run_device_and_host(build)
     assert got == want
+
+
+@given(_two_cols(), st.sampled_from(["==", "!=", "<", "<=", ">", ">="]), _POOL)
+@settings(max_examples=50, deadline=None)
+def test_choice_compare_parity(case, op, lit):
+    """Compares whose sides are fill_null/if_else results share one joint
+    code space with the other side (r5 generalization)."""
+    a, b = case
+
+    def build():
+        l = col("a").fill_null(col("b"))
+        r = (col("a") <= col("b")).if_else(col("b"), lit)
+        pred = {"==": l == r, "!=": l != r, "<": l < r,
+                "<=": l <= r, ">": l > r, ">=": l >= r}[op]
+        return _frame(a, b).select(pred.alias("p"))
+
+    got, want = _run_device_and_host(build)
+    assert got == want
