@@ -740,6 +740,32 @@ def _string_choice_shape(node, schema):
     return _StringChoice(kind, pred, operands)
 
 
+def string_output_dictionary(node, schema, dcs, aux):
+    """THE dictionary a string-producing device output decodes through:
+    the column's own dictionary for a bare passthrough, the joint-group
+    dictionary for a fill_null/if_else result, None when neither resolves
+    (caller declines/errs). Shared by the projection resolver and the
+    grouped-agg resolver so the decode rule lives once."""
+    cname = _plain_string_column(node, schema)
+    src = dcs.get(cname) if cname else None
+    if src is not None and src.dictionary is not None:
+        return src.dictionary
+    ch = _string_choice_shape(node, schema)
+    if ch is not None:
+        return aux.get(_joint_gkey(ch.cols, ch.lits))
+    return None
+
+
+def _cmp_union_group(lside, rside):
+    """The ONE definition of a general compare's joint group (union of both
+    sides) — group registration and closure compilation must agree on env
+    keys byte-for-byte, so both call this."""
+    lc, ll = _side_group(lside)
+    rc, rl = _side_group(rside)
+    return (tuple(sorted(set(lc) | set(rc))),
+            tuple(sorted(set(ll) | set(rl))))
+
+
 def _joint_group_of(node, schema):
     """(cols, lits) joint-dictionary group for a node, or None. A general
     string compare's group unions BOTH sides (a choice side's codes must be
@@ -749,10 +775,7 @@ def _joint_group_of(node, schema):
     if _string_cmp_shape(node, schema) is None:
         cc = _string_colcol_shape(node, schema)
         if cc is not None:
-            lc, ll = _side_group(cc[0])
-            rc, rl = _side_group(cc[1])
-            return (tuple(sorted(set(lc) | set(rc))),
-                    tuple(sorted(set(ll) | set(rl))))
+            return _cmp_union_group(*cc)
     ch = _string_choice_shape(node, schema)
     if ch is not None:
         return ch.cols, ch.lits
@@ -1357,10 +1380,7 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
         ccshape = _string_colcol_shape(node, schema)
         if ccshape is not None:
             lside, rside = ccshape
-            lc, ll = _side_group(lside)
-            rc, rl = _side_group(rside)
-            gkey = _joint_gkey(tuple(sorted(set(lc) | set(rc))),
-                               tuple(sorted(set(ll) | set(rl))))
+            gkey = _joint_gkey(*_cmp_union_group(lside, rside))
             lf2 = _side_code_fn(lside, gkey, schema)
             rf2 = _side_code_fn(rside, gkey, schema)
             op = node.op
@@ -1823,14 +1843,7 @@ def eval_projection_device_async(table, exprs, stage_cache: Optional[dict] = Non
                 # string outputs are bare column passthroughs OR joint-coded
                 # fill_null/if_else results (enforced by the compilability
                 # check): decode with the matching dictionary
-                cname = _plain_string_column(nd, schema)
-                src = dcs.get(cname) if cname else None
-                if src is not None and src.dictionary is not None:
-                    dictionary = src.dictionary
-                else:
-                    ch = _string_choice_shape(nd, schema)
-                    if ch is not None:
-                        dictionary = aux.get(_joint_gkey(ch.cols, ch.lits))
+                dictionary = string_output_dictionary(nd, schema, dcs, aux)
                 if dictionary is None:
                     raise RuntimeError(
                         f"string projection {e.name()!r} lost its dictionary")

@@ -386,18 +386,10 @@ def device_grouped_agg_async(table, to_agg, group_by,
                 # column's dictionary — or, for a fill_null/if_else child,
                 # its joint-group dictionary — or it would silently return
                 # code digits
-                from .device import (_joint_gkey, _plain_string_column,
-                                     _string_choice_shape)
+                from .device import string_output_dictionary
 
-                cname = _plain_string_column(child_nd, schema)
-                src = dcs.get(cname) if cname else None
-                if src is not None and src.dictionary is not None:
-                    dictionary = src.dictionary
-                else:
-                    ch = _string_choice_shape(child_nd, schema)
-                    if ch is not None:
-                        dictionary = joint_aux.get(
-                            _joint_gkey(ch.cols, ch.lits))
+                dictionary = string_output_dictionary(child_nd, schema, dcs,
+                                                      joint_aux)
                 if dictionary is None:
                     return None  # cannot decode: host path recomputes
             merged = _finish_agg(kind, out, num_groups, expected_dt, n,
