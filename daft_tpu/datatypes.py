@@ -590,6 +590,20 @@ def infer_datatype(value: Any) -> DataType:
         return DataType.date()
     if isinstance(value, datetime.timedelta):
         return DataType.duration("us")
+    if isinstance(value, np.generic):
+        # numpy SCALARS (np.int64, np.float32, np.datetime64, np.bool_, ...)
+        # are not python int/float/datetime subclasses; map through their
+        # dtype so a list of them infers like the equivalent python values
+        if isinstance(value, (np.datetime64, np.timedelta64)) \
+                and np.isnat(value):
+            # NaT is a null, whatever its unit — a unit-less NaT's dtype
+            # ('M8') has no arrow mapping and must not poison the column
+            return DataType.null()
+        try:
+            return _from_arrow(pa.from_numpy_dtype(value.dtype))
+        except (pa.ArrowNotImplementedError, ValueError, TypeError,
+                NotImplementedError):
+            return DataType.python()
     if isinstance(value, np.ndarray):
         if value.ndim == 1:
             return DataType.list(_from_arrow(pa.from_numpy_dtype(value.dtype)))
@@ -635,6 +649,10 @@ def try_unify(a: DataType, b: DataType) -> Optional[DataType]:
         tu = units[max(units.index(a.params[0]), units.index(b.params[0]))]
         tz = a.params[1] if a.params[1] == b.params[1] else None
         return DataType.timestamp(tu, tz)
+    if a.kind == TypeKind.DURATION and b.kind == TypeKind.DURATION:
+        units = ["s", "ms", "us", "ns"]
+        return DataType.duration(
+            units[max(units.index(a.params[0]), units.index(b.params[0]))])
     if a.kind == TypeKind.DATE and b.kind == TypeKind.TIMESTAMP:
         return b
     if b.kind == TypeKind.DATE and a.kind == TypeKind.TIMESTAMP:

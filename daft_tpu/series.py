@@ -54,7 +54,8 @@ class Series:
 
     @staticmethod
     def from_pylist(data: Sequence[Any], name: str = "list_series", dtype: Optional[DataType] = None) -> "Series":
-        if dtype is None:
+        inferred = dtype is None
+        if inferred:
             dt = DataType.null()
             for v in data:
                 nxt = infer_datatype(v)
@@ -65,17 +66,26 @@ class Series:
                 dt = u
             dtype = dt
         if dtype.is_python():
-            objs = np.empty(len(data), dtype=object)
-            for i, v in enumerate(data):
-                objs[i] = v
-            return Series(name, dtype, None, objs)
+            return _python_object_series(name, data)
         try:
             arr = pa.array(data, type=dtype.to_arrow())
-        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
-            objs = np.empty(len(data), dtype=object)
-            for i, v in enumerate(data):
-                objs[i] = v
-            return Series(name, DataType.python(), None, objs)
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError,
+                TypeError, OverflowError) as e:
+            # an EXPLICITLY requested dtype keeps the original contract:
+            # arrow conversion errors fall back to python storage, but
+            # python-level failures (overflow of the requested type, ...)
+            # propagate rather than silently ignoring the request
+            if not inferred and isinstance(e, (TypeError, OverflowError)):
+                raise
+            # numpy scalars can defeat arrow's sequence converter (e.g. a
+            # list holding np.datetime64[D] raises TypeError even with an
+            # explicit date32 type): normalize them to python values first
+            try:
+                cleaned = [v.item() if isinstance(v, np.generic) else v
+                           for v in data]
+                arr = pa.array(cleaned, type=dtype.to_arrow())
+            except Exception:
+                return _python_object_series(name, data)
         return Series(name, dtype, arr)
 
     @staticmethod
@@ -731,6 +741,14 @@ class Series:
             return Series(self._name, self._dtype, pa.concat_arrays([nulls, body]))
         body = self._arrow.slice(-periods)
         return Series(self._name, self._dtype, pa.concat_arrays([body, nulls]))
+
+
+def _python_object_series(name: str, data) -> "Series":
+    """Python-dtype fallback storage (object array; no arrow representation)."""
+    objs = np.empty(len(data), dtype=object)
+    for i, v in enumerate(data):
+        objs[i] = v
+    return Series(name, DataType.python(), None, objs)
 
 
 def _static_shape(dt: DataType):

@@ -203,3 +203,52 @@ def test_murmur3_iceberg_reference_values():
 def test_between():
     a = Series.from_pylist([1, 5, 10], "a")
     assert a.between(2, 9).to_pylist() == [False, True, False]
+
+
+def test_numpy_scalar_inference():
+    """Lists of numpy SCALARS (np.int64/np.float32/np.datetime64/...) infer
+    like the equivalent python values instead of degrading to python dtype
+    (np scalars are not python int/float/datetime subclasses)."""
+    import datetime
+
+    import numpy as np
+
+    import daft_tpu as dt
+
+    s = dt.Series.from_pylist([np.int64(5), np.int64(7), None], "i")
+    assert s.dtype == dt.DataType.int64()
+    assert s.to_pylist() == [5, 7, None]
+    assert dt.Series.from_pylist([np.float32(1.5)], "f").dtype == dt.DataType.float32()
+    assert dt.Series.from_pylist([np.bool_(True), None], "b").dtype == dt.DataType.bool()
+    ts = dt.Series.from_pylist([np.datetime64("2024-03-05T10:20:30")], "t")
+    assert ts.dtype.is_temporal()
+    d = dt.Series.from_pylist([np.datetime64("2024-01-02", "D"), None], "d")
+    assert d.dtype == dt.DataType.date()
+    assert d.to_pylist() == [datetime.date(2024, 1, 2), None]
+    td = dt.Series.from_pylist([np.timedelta64(5, "s"), None], "td")
+    assert td.to_pylist() == [datetime.timedelta(seconds=5), None]
+
+
+def test_numpy_scalar_edge_cases():
+    """Mixed-unit durations unify; NaT infers as null (not python); an
+    EXPLICITLY requested dtype still propagates conversion overflow."""
+    import datetime
+
+    import numpy as np
+    import pytest
+
+    import daft_tpu as dt
+
+    s = dt.Series.from_pylist([np.timedelta64(5, "s"), np.timedelta64(3, "ms")], "t")
+    assert s.dtype == dt.DataType.duration("ms")
+    s2 = dt.Series.from_pylist(
+        [np.timedelta64(5, "s"), datetime.timedelta(seconds=7)], "t2")
+    assert s2.dtype == dt.DataType.duration("us")
+    s3 = dt.Series.from_pylist(
+        [np.datetime64("2024-01-02", "s"), np.datetime64("NaT")], "t3")
+    assert s3.dtype == dt.DataType.timestamp("s")
+    assert s3.to_pylist()[1] is None
+    with pytest.raises(OverflowError):
+        dt.Series.from_pylist([2**100], "x", dt.DataType.int64())
+    # INFERRED oversized ints degrade to python storage, no crash
+    assert dt.Series.from_pylist([2**100], "big").dtype == dt.DataType.python()
