@@ -346,3 +346,37 @@ class TestMergedScanTasks:
         got_comma = dt.read_csv(p, delimiter=",").to_pydict()
         assert set(got_semi) == {"x", "y"}
         assert set(got_comma) == {"x;y"}
+
+
+def test_arrow_ipc_reader_pushdowns(tmp_path):
+    """The spill-format reader honors column projection, residual filters,
+    and limits like every other reader (spills are re-read through the
+    normal ScanTask machinery, so pushdowns can reach it)."""
+    import pyarrow as pa
+
+    import daft_tpu as dt
+    from daft_tpu import col
+    from daft_tpu.io.readers import read_arrow_ipc_table
+    from daft_tpu.io.scan import Pushdowns
+    from daft_tpu.schema import Schema
+
+    path = str(tmp_path / "t.arrow")
+    tbl = pa.table({"a": list(range(20)), "b": [f"s{i}" for i in range(20)],
+                    "c": [float(i) for i in range(20)]})
+    with pa.OSFile(path, "wb") as f, pa.ipc.new_file(f, tbl.schema) as w:
+        w.write_table(tbl)
+    schema = Schema.from_arrow(tbl.schema)
+
+    full = read_arrow_ipc_table(path, Pushdowns(), schema=schema)
+    assert len(full) == 20 and full.column_names == ["a", "b", "c"]
+
+    proj = read_arrow_ipc_table(path, Pushdowns(columns=["c", "a"]),
+                                schema=schema)
+    assert set(proj.column_names) == {"a", "c"}
+
+    filt = read_arrow_ipc_table(
+        path, Pushdowns(filters=(col("a") >= 15)._node), schema=schema)
+    assert filt.to_pydict()["a"] == [15, 16, 17, 18, 19]
+
+    lim = read_arrow_ipc_table(path, Pushdowns(limit=3), schema=schema)
+    assert len(lim) == 3
