@@ -1803,3 +1803,43 @@ class TestStringChoiceCompare32:
         assert _counters(dev).get("device_aggregations", 0) >= 1, \
             _counters(dev)
         assert dev.to_pydict() == host.to_pydict()
+
+
+class TestSpillWithDeviceKernels32:
+    def test_spilled_shuffle_feeds_device_agg(self, host_mode):
+        """Out-of-core + device path together in the real-TPU config: a
+        forced-spill hash shuffle re-materializes arrow-IPC partitions that
+        then stage to the device for the grouped agg — parity vs the host
+        path and vs the no-pressure run, with spills AND device aggs both
+        proven by counters."""
+        from daft_tpu.spill import MEMORY_LEDGER
+
+        cfg = get_context().execution_config
+        saved_budget = cfg.memory_budget_bytes
+        rng = np.random.RandomState(31)
+        n = 60_000
+        data = {"k": np.array(["aa", "bb", "cc", "dd", "ee"])[
+                    rng.randint(0, 5, n)],
+                "v": rng.randint(0, 1000, n).astype(np.int64)}
+
+        def q():
+            return (dt.from_pydict(data).into_partitions(6)
+                    .repartition(4, "k").groupby("k")
+                    .agg(col("v").sum().alias("s"),
+                         col("v").count().alias("c"))
+                    .sort("k"))
+
+        want = q().collect().to_pydict()  # device, no memory pressure
+        cfg.memory_budget_bytes = 64 * 1024
+        base = MEMORY_LEDGER.spilled_partitions
+        try:
+            dev = q().collect()
+            spilled = MEMORY_LEDGER.spilled_partitions - base
+            with host_mode():
+                host = q().collect().to_pydict()
+        finally:
+            cfg.memory_budget_bytes = saved_budget
+        assert spilled > 0, "no spill engaged"
+        c = _counters(dev)
+        assert c.get("device_aggregations", 0) >= 1, c
+        assert dev.to_pydict() == host == want
