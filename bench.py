@@ -435,11 +435,14 @@ def _parquet_spill_rung(out: dict, scale: float, rtol: float) -> None:
         cfg = get_context().execution_config
         old_budget = cfg.memory_budget_bytes
         # the out-of-core rung is IO-heavy: parquet decode, IPC spill writes
-        # and acero all release the GIL, so a few workers overlap disk waits
-        # with compute even on the 1-core host (measured r5: 30.2s at 4
-        # threads vs 33.6s at 1, same warm cache)
+        # and acero all release the GIL, so deep oversubscription overlaps
+        # their waits even on the 1-core host — including the dominant page-
+        # fault stalls (fresh pages fault at ~300 MB/s on this ballooned VM;
+        # faults inside GIL-released arrow calls let other workers run).
+        # Measured r5 at SF10: 1 thread 40s, 4 threads 28-42s, 8 threads
+        # 28-45s with the best runs at 8.
         old_threads = cfg.executor_threads
-        cfg.executor_threads = 4
+        cfg.executor_threads = 8
         # budget ~ a quarter of the on-disk bytes (arrow in-memory is ~4x
         # parquet): the shuffle buffers CANNOT fit, so spill must engage at
         # every scale — a fixed budget would silently stop spilling on

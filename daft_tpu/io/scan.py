@@ -307,6 +307,16 @@ class MergedScanTask(ScanTask):
     def read(self):
         from ..table import Table
 
+        chunks = self.read_chunks()
+        return chunks[0] if len(chunks) == 1 else Table.concat(chunks)
+
+    def read_chunks(self):
+        """Per-child tables, cast to the merged schema but NOT concatenated —
+        the chunk-preserving shuffle path (MicroPartition.chunk_tables) splits
+        each piece independently, so merged small files never pay the
+        O(task-bytes) concat on the map side."""
+        from ..table import Table
+
         tables = []
         remaining = self.pushdowns.limit
         for c in self.children:
@@ -321,9 +331,9 @@ class MergedScanTask(ScanTask):
                 if remaining <= 0:
                     break
         if not tables:
-            return Table.empty(self.materialized_schema)
+            return [Table.empty(self.materialized_schema)]
         want = self.materialized_schema
-        return Table.concat([t.cast_to_schema(want) for t in tables])
+        return [t.cast_to_schema(want) for t in tables]
 
 
 def merge_scan_tasks_by_size(tasks: Sequence[ScanTask],

@@ -115,6 +115,31 @@ class MicroPartition:
                 self._tables = [Table.concat(self._tables)]
             return self._tables[0]
 
+    def chunk_tables(self) -> List[Table]:
+        """Materialize preserving the reader's chunk structure (one Table per
+        file / reader chunk) instead of collapsing to a single Table. The map
+        side of a shuffle hashes and splits each chunk independently, so the
+        O(partition-bytes) memcpy that `table()`'s Table.concat pays never
+        happens (measured: the concat dominated the out-of-core rung's map
+        phase). Falls back to the collapsing path when deferred ops are
+        pending — a deferred limit/head chain is defined over the WHOLE
+        partition, not per chunk. Reference role: the reference MicroPartition
+        is a Vec<Table> whose ops iterate the pieces (micropartition.rs:35-78);
+        this surfaces that same contract to row-local consumers."""
+        with self._lock:
+            if self._state == "loaded":
+                return list(self._tables)
+            if not self._pending:
+                task = self._scan_task
+                read_chunks = getattr(task, "read_chunks", None)
+                tbls = list(read_chunks()) if read_chunks is not None else [task.read()]
+                tbls = [t for t in tbls if len(t)] or [Table.empty(self.schema)]
+                self._tables = tbls
+                self._state = "loaded"
+                self._scan_task = None
+                return list(self._tables)
+        return [self.table()]
+
     def __len__(self) -> int:
         n = self.num_rows_or_none()
         if n is not None:
@@ -273,15 +298,42 @@ class MicroPartition:
         return self._wrap(self.table().cast_to_schema(schema))
 
     def partition_by_hash(self, exprs, num_partitions: int) -> List["MicroPartition"]:
-        return [self._wrap(t) for t in self.table().partition_by_hash(exprs, num_partitions)]
+        return self._partition_chunkwise(
+            lambda t: t.partition_by_hash(exprs, num_partitions), num_partitions)
 
     def partition_by_random(self, num_partitions: int, seed: int = 0) -> List["MicroPartition"]:
+        # NOT chunk-wise: the assignment is a seeded permutation over row
+        # positions, so per-chunk application with the same seed would
+        # correlate buckets across chunks instead of matching the collapsed
+        # partition's assignment
         return [self._wrap(t) for t in self.table().partition_by_random(num_partitions, seed)]
 
     def partition_by_range(self, exprs, boundaries: Table, descending=None,
                            nulls_first=None) -> List["MicroPartition"]:
-        return [self._wrap(t) for t in
-                self.table().partition_by_range(exprs, boundaries, descending, nulls_first)]
+        return self._partition_chunkwise(
+            lambda t: t.partition_by_range(exprs, boundaries, descending, nulls_first),
+            len(boundaries) + 1)
+
+    def _partition_chunkwise(self, split, num: int) -> List["MicroPartition"]:
+        """Row-local partitioners (hash/range: a row's bucket depends only on
+        its own values) run per chunk; each bucket chains its per-chunk pieces
+        without copying, so a multi-chunk scan partition never pays the full
+        concat on the shuffle map side."""
+        tabs = self.chunk_tables()
+        if len(tabs) == 1:
+            return [self._wrap(t) for t in split(tabs[0])]
+        buckets: List[List[Table]] = [[] for _ in range(num)]
+        for t in tabs:
+            for i, bt in enumerate(split(t)):
+                if len(bt):
+                    buckets[i].append(bt)
+        out = []
+        for bs in buckets:
+            mp = (MicroPartition(self.schema, tables=bs) if bs
+                  else MicroPartition.empty(self.schema))
+            mp.owner_process = self.owner_process
+            out.append(mp)
+        return out
 
     def partition_by_value(self, exprs) -> Tuple[List["MicroPartition"], Table]:
         parts, uniq = self.table().partition_by_value(exprs)

@@ -54,7 +54,7 @@ def _as_expressions(exprs) -> List[Expression]:
 
 
 class Table:
-    __slots__ = ("schema", "_columns", "_memo_by_thread")
+    __slots__ = ("schema", "_columns", "_memo_by_thread", "__weakref__")
 
     def __init__(self, schema: Schema, columns: List[Series]):
         if len(schema) != len(columns):

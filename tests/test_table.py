@@ -163,6 +163,36 @@ class TestPartition:
         parts = t.partition_by_hash([col("k")], 3)
         assert len(parts) == 3 and all(len(p) == 0 for p in parts)
 
+    def test_chunkwise_hash_partition_matches_collapsed(self):
+        """A multi-chunk MicroPartition splits each chunk independently
+        (no concat on the map side); every bucket's content must equal the
+        collapsed partition's bucket exactly, row order included (the split
+        is stable within a chunk and chunks chain in order)."""
+        from daft_tpu.micropartition import MicroPartition
+
+        chunks = [Table.from_pydict({
+            "k": [(i * 37 + j) % 11 for j in range(200)],
+            "v": list(range(i * 200, i * 200 + 200))})
+            for i in range(4)]
+        chunked = MicroPartition.from_tables(chunks)
+        collapsed = MicroPartition.from_table(Table.concat(chunks))
+        for n in (1, 3, 8):
+            a = chunked.partition_by_hash([col("k")], n)
+            b = collapsed.partition_by_hash([col("k")], n)
+            assert [p.to_pydict() for p in a] == [p.to_pydict() for p in b]
+
+    def test_chunkwise_range_partition_matches_collapsed(self):
+        from daft_tpu.micropartition import MicroPartition
+
+        chunks = [Table.from_pydict({"v": [5, 1, 9]}),
+                  Table.from_pydict({"v": [3, 7, 4]})]
+        bounds = Table.from_pydict({"v": [4, 8]})
+        chunked = MicroPartition.from_tables(chunks)
+        collapsed = MicroPartition.from_table(Table.concat(chunks))
+        a = chunked.partition_by_range([col("v")], bounds)
+        b = collapsed.partition_by_range([col("v")], bounds)
+        assert [p.to_pydict() for p in a] == [p.to_pydict() for p in b]
+
 
 class TestReshape:
     def test_explode_with_empty_and_null(self):
