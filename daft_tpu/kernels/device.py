@@ -372,36 +372,83 @@ def _plain_epoch_column(node, schema) -> Optional[str]:
     return _plain_column(node, schema, lambda dt: dt.kind in _EPOCH_KINDS)
 
 
+def _epoch_lane_side(node, schema):
+    """(ident, dtype, side_node_or_None) when `node` is an epoch-typed
+    expression whose value can ride host-evaluated (hi, lo) lane pairs:
+    a plain Column (ident = colname, shares the column-lane cache;
+    side_node None) or ANY computed epoch expression — timestamp
+    arithmetic, date truncation — which evaluates once on host in exact
+    int64 and splits lanes from the result (ident = expression key)."""
+    cname = _plain_epoch_column(node, schema)
+    if cname is not None:
+        return cname, schema[cname].dtype, None
+    try:
+        dt = node.to_field(schema).dtype
+    except Exception:
+        return None
+    if dt.kind not in _EPOCH_KINDS:
+        return None
+    return f"\x00epochexpr\x00{node._key()}", dt, node
+
+
 def _epoch_cmp_shape(node, schema):
-    """(colname, literal_value, flipped, col_dtype) when `node` compares a
-    plain epoch Column against a literal (either side) — compiled in 32-bit
-    mode as a two-lane unsigned comparison over split epoch bits; in x64
-    mode the generic int64 path handles epochs already."""
+    """(lspec, rspec, op) when `node` is a comparison whose sides are epoch
+    lane sides and/or literals (at least one lane side) — compiled in
+    32-bit mode as a two-lane unsigned comparison over split epoch bits;
+    in x64 mode the generic int64 path handles epochs already. Each spec is
+    ("lane", ident, dtype, side_node_or_None) or ("lit", lit_node).
+    Lane-vs-lane requires identical dtypes (same epoch kind/unit/tz): the
+    raw int64 physicals of different units are not comparable."""
     from ..expressions import BinaryOp, Literal
 
     if not (isinstance(node, BinaryOp) and node.op in _CMP_OPS):
         return None
 
-    def lit_node(n):
-        return isinstance(n, Literal)
+    def spec(n):
+        if isinstance(n, Literal):
+            return ("lit", n)
+        side = _epoch_lane_side(n, schema)
+        if side is None:
+            return None
+        return ("lane", *side)
 
-    lcol = _plain_epoch_column(node.left, schema)
-    rcol = _plain_epoch_column(node.right, schema)
-    if lcol is not None and lit_node(node.right):
-        return lcol, node.right, False, schema[lcol].dtype
-    if rcol is not None and lit_node(node.left):
-        return rcol, node.left, True, schema[rcol].dtype
-    return None
+    ls, rs = spec(node.left), spec(node.right)
+    if ls is None or rs is None:
+        return None
+    if ls[0] == "lit" and rs[0] == "lit":
+        return None
+    if ls[0] == "lane" and rs[0] == "lane" and ls[2] != rs[2]:
+        return None
+    # a literal compares against the lane side's dtype; reject non-epoch
+    # literal-vs-lane pairings where conversion has no target
+    return ls, rs, node.op
 
 
-def _epoch_lane_keys(colname: str) -> Tuple[str, str]:
-    return (f"__epochlane__\x00{colname}\x00hi",
-            f"__epochlane__\x00{colname}\x00lo")
+def _epoch_lane_keys(ident: str) -> Tuple[str, str]:
+    return (f"__epochlane__\x00{ident}\x00hi",
+            f"__epochlane__\x00{ident}\x00lo")
 
 
-def _epoch_lit_keys(colname: str, node_key) -> Tuple[str, str]:
-    base = f"__epochlit__\x00{colname}\x00{node_key}"
+def _epoch_lit_keys(ident: str, node_key) -> Tuple[str, str]:
+    base = f"__epochlit__\x00{ident}\x00{node_key}"
     return base + "\x00hi", base + "\x00lo"
+
+
+def _two_lane_cmp(op: str, hi, lo, rhi, rlo):
+    """Elementwise comparison of (hi, lo) uint32 lane pairs under the
+    order-preserving epoch bit encoding (unsigned lexicographic)."""
+    eq_hi = hi == rhi
+    if op == "==":
+        return eq_hi & (lo == rlo)
+    if op == "!=":
+        return ~(eq_hi & (lo == rlo))
+    if op == "<":
+        return (hi < rhi) | (eq_hi & (lo < rlo))
+    if op == "<=":
+        return (hi < rhi) | (eq_hi & (lo <= rlo))
+    if op == ">":
+        return (hi > rhi) | (eq_hi & (lo > rlo))
+    return (hi > rhi) | (eq_hi & (lo >= rlo))  # ">="
 
 
 def _epoch_bits_np(vals_i64: np.ndarray) -> np.ndarray:
@@ -494,7 +541,7 @@ def _stage_epoch_lanes(table, cname: str, bucket: int,
 
 
 def collect_epoch_cmps(nodes, schema):
-    """Every epoch-comparison shape in the trees -> [(colname, lit_node)]."""
+    """Every epoch-comparison shape in the trees -> [(lspec, rspec, op)]."""
     from ..expressions import BinaryOp
 
     out = []
@@ -503,7 +550,8 @@ def collect_epoch_cmps(nodes, schema):
         if isinstance(n, BinaryOp):
             shape = _epoch_cmp_shape(n, schema)
             if shape is not None:
-                out.append((shape[0], shape[1]))
+                out.append(shape)
+                return  # the whole subtree rides lanes; nothing below stages
         for c in n.children():
             walk(c)
 
@@ -514,26 +562,42 @@ def collect_epoch_cmps(nodes, schema):
 
 def epoch_cmp_env(cmps, schema, table, bucket: int,
                   stage_cache: Optional[dict], env: dict) -> Optional[dict]:
-    """Merge epoch-comparison support into `env` (32-bit mode): the column
-    lane pairs and each literal's split bits. `cmps` is the list from ONE
-    collect_epoch_cmps walk (shared with the needed-column subtraction so
-    trees are not walked twice per dispatch). Returns the (possibly
-    unchanged) env, or None when a literal cannot convert."""
+    """Merge epoch-comparison support into `env` (32-bit mode): each lane
+    side's (hi, lo) pair — plain columns through the shared column-lane
+    cache, computed sides host-evaluated once in exact int64 — and each
+    literal's split bits keyed against its lane side. `cmps` is the list
+    from ONE collect_epoch_cmps walk. Returns the (possibly unchanged)
+    env, or None when a literal cannot convert or a computed side fails
+    host evaluation."""
     if not cmps:
         return env
     merged = dict(env)
-    for colname, lit in cmps:
-        hi_k, lo_k = _epoch_lane_keys(colname)
-        if hi_k not in merged:
-            hi, lo, valid = _stage_epoch_lanes(table, colname, bucket,
-                                               stage_cache)
+    for lspec, rspec, _op in cmps:
+        lane_specs = [s for s in (lspec, rspec) if s[0] == "lane"]
+        for _tag, ident, _dt, side_node in lane_specs:
+            hi_k, lo_k = _epoch_lane_keys(ident)
+            if hi_k in merged:
+                continue
+            if side_node is None:
+                lanes = _stage_epoch_lanes(table, ident, bucket, stage_cache)
+            else:
+                lanes = _stage_epoch_expr_lanes(table, side_node, bucket,
+                                                stage_cache)
+            if lanes is None:
+                return None
+            hi, lo, valid = lanes
             merged[hi_k] = (hi, valid)
             merged[lo_k] = (lo, valid)
-        lhik, llok = _epoch_lit_keys(colname, lit._key())
+        lit = lspec[1] if lspec[0] == "lit" else (
+            rspec[1] if rspec[0] == "lit" else None)
+        if lit is None:
+            continue
+        _tag, ident, lane_dt, _sn = lane_specs[0]
+        lhik, llok = _epoch_lit_keys(ident, lit._key())
         if lhik in merged or lit.value is None:
             continue
         try:
-            epoch = _literal_to_physical(lit.value, schema[colname].dtype)
+            epoch = _literal_to_physical(lit.value, lane_dt)
         except (ValueError, TypeError, KeyError):
             return None
         bits = int(_epoch_bits_np(np.array([epoch]))[0])
@@ -548,6 +612,31 @@ def epoch_cmps_for(nodes, schema):
     if x64_enabled():
         return []
     return collect_epoch_cmps(nodes, schema)
+
+
+def device_required_columns(nodes, schema) -> set:
+    """Columns that must stage NORMALLY on device: the plain required-column
+    union, minus subtrees that ride host-evaluated epoch lane pairs (their
+    inputs never reach the device; staging an epoch column normally would
+    fail since 64-bit epochs cannot narrow to int32). A column referenced
+    both inside a lane compare and elsewhere still stages."""
+    from ..expressions import BinaryOp, Column
+
+    out: set = set()
+    in32 = not x64_enabled()
+
+    def walk(n):
+        if in32 and isinstance(n, BinaryOp) \
+                and _epoch_cmp_shape(n, schema) is not None:
+            return
+        if isinstance(n, Column):
+            out.add(n.cname)
+        for c in n.children():
+            walk(c)
+
+    for nd in nodes:
+        walk(nd)
+    return out
 
 
 def _string_cmp_shape(node, schema):
@@ -616,6 +705,82 @@ def _string_lut_shape(node, schema):
 
 def _strlut_env_key(node_key) -> str:
     return f"__strlut__\x00{node_key}"
+
+
+# per-row (row-local) string functions: a predicate built from these over ONE
+# string column depends only on that row's value, so it can evaluate over the
+# partition dictionary instead of the rows (utf8.tokenize_* excluded: list-
+# valued results have no boolean-LUT use and pull in tokenizer state)
+_ROWLOCAL_STR_FNS = frozenset(
+    f"utf8.{n}" for n in (
+        "capitalize", "concat", "contains", "count_matches", "endswith",
+        "extract", "find", "ilike", "left", "length", "length_bytes",
+        "like", "lower", "lpad", "lstrip", "match", "normalize", "repeat",
+        "replace", "reverse", "right", "rpad", "rstrip", "startswith",
+        "substr", "upper",
+    ))
+
+
+def _string_dict_pred_shape(node, schema):
+    """(colname, node, node_key) when `node` is a BOOLEAN-valued, row-local
+    expression whose only column input is ONE plain string column — e.g.
+    `upper(s) == "X"`, `strip(s).startswith(p)`, `length(s) > 3`,
+    `(s + "-suffix").is_in([...])`. Each row's result depends only on that
+    row's string value, so the host evaluates the WHOLE predicate over the
+    O(unique) dictionary (+ one null slot for exact null semantics) with
+    the registered host kernels, and the device gathers by code —
+    generalizing the fixed contains/startswith/endswith LUT shapes to
+    arbitrary predicate trees over string transforms. Reference semantics:
+    fully general utf8 kernels, src/daft-core/src/array/ops/utf8.rs."""
+    from ..expressions import (
+        Alias, Between, BinaryOp, Cast, Column, FillNull, IfElse, IsIn,
+        IsNull, Literal, Not, Function,
+    )
+
+    try:
+        if not node.to_field(schema).dtype.is_boolean():
+            return None
+    except (ValueError, KeyError):
+        return None
+    cols: set = set()
+
+    def rowlocal(n):
+        if isinstance(n, (Literal, Column)):
+            if isinstance(n, Column):
+                cols.add(n.cname)
+            return True
+        if isinstance(n, (Alias, Not, IsNull, Cast, Between, FillNull,
+                          IfElse, BinaryOp)):
+            return all(rowlocal(c) for c in n.children())
+        if isinstance(n, IsIn):
+            return isinstance(n.items, Literal) and rowlocal(n.child)
+        if isinstance(n, Function):
+            # kwargs are static python config (regex=, index=), never columns
+            if n.fname not in _ROWLOCAL_STR_FNS:
+                return False
+            return all(rowlocal(c) for c in n.args)
+        return False
+
+    if not rowlocal(node):
+        return None
+    if len(cols) != 1:
+        return None
+    colname = next(iter(cols))
+    if _plain_string_column_named(colname, schema) is None:
+        return None
+    return colname, node, node._key()
+
+
+def _plain_string_column_named(colname, schema):
+    try:
+        return colname if schema[colname].dtype.is_string() else None
+    except KeyError:
+        return None
+
+
+def _strdictpred_env_keys(node_key) -> Tuple[str, str, str]:
+    base = f"__strdictpred__\x00{node_key}"
+    return base + "\x00vals", base + "\x00valid", base + "\x00nullslot"
 
 
 # ---------------------------------------------------------------------------
@@ -985,20 +1150,95 @@ def _numeric_isin_items(node, schema):
     return tuple(out)
 
 
+def _string_dict_pred_applies(node, schema):
+    """The general dictionary predicate shape, ONLY where no cheaper
+    specific shape already handles the node — the precedence must match
+    _compile_node's dispatch order exactly, or the env builder and the
+    compiled closure would disagree about which path owns a node. Boolean
+    connectives and plain pass-throughs are also excluded: each side below
+    them gets its own best shape (a bisect compare beats an O(unique)
+    dictionary evaluation on high-cardinality columns)."""
+    from ..expressions import Alias, BinaryOp, Column, Literal, Not
+
+    if isinstance(node, (Alias, Column, Literal, Not)):
+        return None
+    if isinstance(node, BinaryOp):
+        if node.op in ("&", "|", "^"):
+            return None
+        if _string_cmp_shape(node, schema) is not None:
+            return None
+        if _string_colcol_shape(node, schema) is not None:
+            return None
+        if _epoch_cmp_shape(node, schema) is not None:
+            return None
+    if _string_lut_shape(node, schema) is not None:
+        return None
+    return _string_dict_pred_shape(node, schema)
+
+
 def collect_string_luts(nodes, schema):
-    """Every LUT-predicate shape in the trees."""
+    """Every LUT-predicate shape in the trees: the fixed single-function
+    shapes, plus general dictionary predicates (tagged "hostpred"); a
+    matched general predicate's subtree is skipped — its children evaluate
+    on host over the dictionary, never separately on device."""
     out = []
 
     def walk(n):
         shape = _string_lut_shape(n, schema)
         if shape is not None:
             out.append(shape)
+        else:
+            gshape = _string_dict_pred_applies(n, schema)
+            if gshape is not None:
+                out.append((gshape[0], "hostpred", gshape[1], gshape[2]))
+                return
         for c in n.children():
             walk(c)
 
     for nd in nodes:
         walk(nd)
     return out
+
+
+def _merge_dict_pred(merged: dict, colname: str, node, node_key, dcs) -> bool:
+    """Evaluate a general dictionary predicate over the column's dictionary
+    values PLUS one null slot (exact null semantics: whatever the host path
+    produces for a null input — is_null, fill_null chains — the gather
+    produces identically), through the host evaluator itself so parity is
+    by construction. False = decline to the host path."""
+    from ..table import Table
+
+    vals_k, valid_k, null_k = _strdictpred_env_keys(node_key)
+    if vals_k in merged:
+        return True
+    dc = dcs.get(colname)
+    if dc is None or dc.dictionary is None:
+        return False
+    uniq = dc.dictionary
+    try:
+        with_null = pa.concat_arrays(
+            [uniq, pa.array([None], type=uniq.type)])
+        tbl = Table.from_arrow(pa.table({colname: with_null}))
+        got = node.evaluate(tbl)
+        arr = got.to_arrow()
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if len(arr) == 1 and len(with_null) > 1:  # scalar broadcast
+            arr = pa.concat_arrays([arr] * len(with_null))
+    except Exception:
+        return False
+    vals_np = np.asarray(pc.fill_null(arr, False), dtype=bool)
+    valid_np = np.asarray(pc.is_valid(arr), dtype=bool)
+    u1 = len(uniq) + 1
+    b = size_bucket(u1)
+    if b > u1:
+        pad = np.zeros(b - u1, dtype=bool)
+        vals_np = np.concatenate([vals_np, pad])
+        valid_np = np.concatenate([valid_np, pad])
+    merged[vals_k] = jnp.asarray(vals_np)
+    merged[valid_k] = jnp.asarray(valid_np)
+    merged[null_k] = jnp.int32(len(uniq))
+    return True
 
 
 def string_lut_env(nodes, schema, dcs, env) -> Optional[dict]:
@@ -1010,6 +1250,10 @@ def string_lut_env(nodes, schema, dcs, env) -> Optional[dict]:
         return env
     merged = dict(env)
     for colname, kind, payload, node_key in shapes:
+        if kind == "hostpred":
+            if not _merge_dict_pred(merged, colname, payload, node_key, dcs):
+                return None
+            continue
         key = _strlut_env_key(node_key)
         if key in merged:
             continue
@@ -1060,6 +1304,10 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
         out_dt = node.to_field(schema).dtype
     except (ValueError, KeyError):
         return False
+    if _string_dict_pred_applies(node, schema) is not None:
+        # the whole boolean subtree evaluates over the dictionary on host;
+        # nothing below it needs to compile on device
+        return True
     if not (is_device_dtype(out_dt) or out_dt.is_null()):
         # strings ride dictionary codes: bare column passthrough, or a
         # fill_null/if_else over string columns/literals whose output codes
@@ -1233,6 +1481,21 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
     )
 
     out_dt = node.to_field(schema).dtype
+
+    gshape = _string_dict_pred_applies(node, schema)
+    if gshape is not None:
+        # general dictionary predicate: the WHOLE boolean subtree was
+        # host-evaluated over the column's dictionary (+ null slot); the
+        # device gathers (value, validity) by code
+        colname, _pred, node_key = gshape
+        vals_k, valid_k, null_k = _strdictpred_env_keys(node_key)
+
+        def run(env, _c=colname, _vk=vals_k, _mk=valid_k, _nk=null_k):
+            codes, m = env[_c]
+            idx = jnp.where(m, codes, env[_nk])
+            return env[_vk][idx], env[_mk][idx]
+
+        return run, out_dt
 
     if isinstance(node, Column):
         name = node.cname
@@ -1408,37 +1671,40 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
             return run, out_dt
         eshape = None if x64_enabled() else _epoch_cmp_shape(node, schema)
         if eshape is not None:
-            colname, lit, flipped, _cdt = eshape
-            cop = _CMP_FLIP[node.op] if flipped else node.op
-            if lit.value is None:
-                def run(env, _hk=_epoch_lane_keys(colname)[0]):
-                    _v, m = env[_hk]
-                    z = jnp.zeros_like(m)
-                    return z, z
+            lspec, rspec, cop = eshape
+            if lspec[0] == "lit":
+                # normalize to lane-op-lit / lane-op-lane with the lane side
+                # on the left, flipping the comparison when the literal led
+                lspec, rspec, cop = rspec, lspec, _CMP_FLIP[cop]
+            _tag, lident, _ldt, _lsn = lspec
+            hi_k, lo_k = _epoch_lane_keys(lident)
+            if rspec[0] == "lit":
+                lit = rspec[1]
+                if lit.value is None:
+                    def run(env, _hk=hi_k):
+                        _v, m = env[_hk]
+                        z = jnp.zeros_like(m)
+                        return z, z
+
+                    return run, out_dt
+                lhik, llok = _epoch_lit_keys(lident, lit._key())
+
+                def run(env, _op=cop, _hk=hi_k, _lk=lo_k, _lh=lhik,
+                        _ll=llok):
+                    hi, m = env[_hk]
+                    lo, _m2 = env[_lk]
+                    return _two_lane_cmp(_op, hi, lo, env[_lh], env[_ll]), m
 
                 return run, out_dt
-            hi_k, lo_k = _epoch_lane_keys(colname)
-            lhik, llok = _epoch_lit_keys(colname, lit._key())
+            rhi_k, rlo_k = _epoch_lane_keys(rspec[1])
 
-            def run(env, _op=cop, _hk=hi_k, _lk=lo_k, _lh=lhik, _ll=llok):
-                hi, m = env[_hk]
+            def run(env, _op=cop, _hk=hi_k, _lk=lo_k, _rhk=rhi_k,
+                    _rlk=rlo_k):
+                hi, lm = env[_hk]
                 lo, _m2 = env[_lk]
-                lh = env[_lh]
-                ll = env[_ll]
-                eq_hi = hi == lh
-                if _op == "==":
-                    out = eq_hi & (lo == ll)
-                elif _op == "!=":
-                    out = ~(eq_hi & (lo == ll))
-                elif _op == "<":
-                    out = (hi < lh) | (eq_hi & (lo < ll))
-                elif _op == "<=":
-                    out = (hi < lh) | (eq_hi & (lo <= ll))
-                elif _op == ">":
-                    out = (hi > lh) | (eq_hi & (lo > ll))
-                else:  # ">="
-                    out = (hi > lh) | (eq_hi & (lo >= ll))
-                return out, m
+                rhi, rm = env[_rhk]
+                rlo, _m4 = env[_rlk]
+                return _two_lane_cmp(_op, hi, lo, rhi, rlo), lm & rm
 
             return run, out_dt
         lf, ldt = _compile_node(node.left, schema)
@@ -1684,7 +1950,26 @@ def int64_wrap_safe(nodes, schema, env, stage_cache: Optional[dict],
 
     risky_dts = (DataType.int64(), DataType.uint64())
 
+    _lanes_memo: dict = {}
+
+    def rides_lanes(n):
+        # an epoch-compare subtree is host-evaluated in exact int64 and
+        # reaches the device only as (hi, lo) lane pairs, and a dictionary-
+        # predicate subtree is host-evaluated over the dictionary: int32
+        # wrap safety is irrelevant below either. Memoized by node identity:
+        # has_risky and safe both probe every node, and each probe walks
+        # the subtree.
+        r = _lanes_memo.get(id(n))
+        if r is None:
+            r = ((isinstance(n, BinaryOp)
+                  and _epoch_cmp_shape(n, schema) is not None)
+                 or _string_dict_pred_applies(n, schema) is not None)
+            _lanes_memo[id(n)] = r
+        return r
+
     def has_risky(n):
+        if rides_lanes(n):
+            return False
         try:
             if (isinstance(n, (BinaryOp, Function))
                     and n.to_field(schema).dtype in risky_dts):
@@ -1754,6 +2039,8 @@ def int64_wrap_safe(nodes, schema, env, stage_cache: Optional[dict],
         return None
 
     def safe(n):
+        if rides_lanes(n):
+            return True
         if isinstance(n, (BinaryOp, Function)):
             try:
                 dt_ = n.to_field(schema).dtype
@@ -1773,7 +2060,6 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     stage the input columns, compile and launch ONE jitted program. Returns
     (outs, out_dts, nodes, dcs) with `outs` still on device (async), or None
     when ineligible. Used by the projection and sort paths."""
-    from ..expressions import required_columns
 
     schema = table.schema
     n = len(table)
@@ -1782,15 +2068,11 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     nodes = normalize_and_check(exprs, schema)
     if nodes is None:
         return None
-    needed = set()
-    for nd in nodes:
-        needed.update(required_columns(nd))
-    # epoch columns are consumed through lane pairs, never staged normally
-    # (their dtypes cannot narrow to int32)
+    # epoch-compare subtrees are consumed through host-evaluated lane
+    # pairs, never staged normally (their dtypes cannot narrow to int32)
     epoch_cmps = epoch_cmps_for(nodes, schema)
-    epoch_cols = {c for c, _ in epoch_cmps}
-    needed -= epoch_cols
-    if not needed and not epoch_cols:
+    needed = device_required_columns(nodes, schema)
+    if not needed and not epoch_cmps:
         return None
     b = size_bucket(n)
     staged = stage_table_columns(table, needed, b, stage_cache)

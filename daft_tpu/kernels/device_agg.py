@@ -264,7 +264,6 @@ def device_grouped_agg_async(table, to_agg, group_by,
     returns None if the int-sum overflow guard trips at materialization —
     or None immediately when ineligible.
     """
-    from ..expressions import required_columns
     from ..schema import Field, Schema
     from ..table import Table, _group_codes
 
@@ -314,17 +313,13 @@ def device_grouped_agg_async(table, to_agg, group_by,
     gb = max(16, 1 << (num_groups - 1).bit_length())  # static segment bucket
 
     # --- stage inputs -----------------------------------------------------
-    from .device import (epoch_cmp_env, epoch_cmps_for, int64_wrap_safe,
-                         string_joint_env, string_literal_env, string_lut_env)
+    from .device import (device_required_columns, epoch_cmp_env,
+                         epoch_cmps_for, int64_wrap_safe, string_joint_env,
+                         string_literal_env, string_lut_env)
 
     check_nodes = list(child_nodes) + (list(pred_nodes) if pred_nodes else [])
-    needed = set()
-    for nd in child_nodes:
-        needed.update(required_columns(nd))
-    if pred_nodes is not None:
-        needed.update(required_columns(pred_nodes[0]))
     epoch_cmps = epoch_cmps_for(check_nodes, schema)
-    needed -= {c for c, _ in epoch_cmps}
+    needed = device_required_columns(check_nodes, schema)
     staged = stage_table_columns(table, sorted(needed), b, stage_cache)
     if staged is None:
         return None

@@ -102,7 +102,10 @@ class SpillScope:
 
     def recycle(self, path: str) -> None:
         with self._lock:
-            self._free_slots.append(path)
+            # drop paths from a cleaned/rotated directory: a late task GC
+            # after cleanup() must not feed dead paths to the next query
+            if self._dir is not None and path.startswith(self._dir + os.sep):
+                self._free_slots.append(path)
 
     def generation(self, path: str) -> int:
         with self._lock:
@@ -120,6 +123,7 @@ class SpillScope:
                 shutil.rmtree(self._dir, ignore_errors=True)
                 self._dir = None
             self._free_slots.clear()
+            self._slot_gen.clear()
 
 
 class _SpillSlotTask:
@@ -128,17 +132,17 @@ class _SpillSlotTask:
     mmap) so no live buffer can alias the slot, then the path returns to
     the scope's free-list for the next spill to overwrite.
 
-    Forked references (e.g. `p.head(n)` narrows the task while `p` still
-    points at it) stay correct without pinning memory: the read result is
-    held by WEAKREF — alive exactly as long as some consumer holds the
-    returned table, so the spill budget is never silently defeated by a
-    hidden strong cache. If the weakref has died, re-reading the file is
-    still safe while the slot sits untouched on the free-list (generation
-    unchanged); once another spill has re-taken the slot, a re-read is a
-    loud error rather than silently another partition's bytes. The normal
-    single-consumer flow (spilled shuffle/join state streams back exactly
-    once) never triggers any of this: the consuming MicroPartition drops
-    its task reference at load."""
+    The slot returns to the free-list when the TASK is garbage-collected
+    (weakref.finalize in _try_spill), i.e. when no MicroPartition can
+    reach it anymore — so a live reference always implies an un-reused
+    slot, and re-reads are always safe. In the normal single-consumer
+    flow the consuming MicroPartition drops its task reference at load,
+    which recycles at exactly the hand-off point; forked references
+    (e.g. `p.head(n)` narrows the task while `p` still points at it)
+    keep the slot pinned until the last of them loads or dies. The read
+    result is additionally held by WEAKREF so forked consumers share one
+    file read without the cache pinning memory past its consumers (the
+    spill budget is never silently defeated by a hidden strong cache)."""
 
     def __init__(self, path: str, schema, num_rows: int, size_bytes: int,
                  scope: SpillScope):
@@ -151,7 +155,10 @@ class _SpillSlotTask:
         self.stats = None
         self._scope = scope
         self._cached_ref = None
-        self._slot_gen: Optional[int] = None
+        # generation observed when the slot was taken for THIS partition:
+        # read() asserts it is unchanged (a re-take while we are alive
+        # would mean the free-list violated the GC-recycle invariant)
+        self._slot_gen: int = scope.generation(path)
         self._read_lock = threading.Lock()
 
     # --- ScanTask metadata surface used by MicroPartition ----------------
@@ -177,24 +184,22 @@ class _SpillSlotTask:
                 tbl = self._cached_ref()
                 if tbl is not None:
                     return tbl
-                # cache died; the file is only trustworthy if no later spill
-                # has re-taken the slot since we recycled it
-                if self._scope.generation(self.path) != self._slot_gen:
-                    raise RuntimeError(
-                        f"spill slot {self.path} re-read after it was "
-                        "recycled and overwritten by a later spill — the "
-                        "forked reference outlived both the cached table "
-                        "and the slot; this is an engine bug")
+            # invariant: this task is alive (we are in its method), so its
+            # slot has NOT been recycled — recycling happens only at task
+            # GC (weakref.finalize in _try_spill). A generation mismatch
+            # means the free-list handed the path out while a reference
+            # still existed; make that loud, never silently another
+            # partition's bytes.
+            if self._scope.generation(self.path) != self._slot_gen:
+                raise RuntimeError(
+                    f"spill slot {self.path} was re-taken while a live "
+                    "reference could still read it; this is an engine bug")
             with pa.OSFile(self.path) as f:
                 arrow_tbl = pa.ipc.open_file(f).read_all()
             IO_STATS.bump(files_opened=1, bytes_read=arrow_tbl.nbytes,
                           rows_read=arrow_tbl.num_rows,
                           columns_read=arrow_tbl.num_columns)
             tbl = Table.from_arrow(arrow_tbl)
-            if self._cached_ref is None:
-                # first read: bytes are copied out — the slot may be reused
-                self._scope.recycle(self.path)
-                self._slot_gen = self._scope.generation(self.path)
             self._cached_ref = weakref.ref(tbl)
             return tbl
 
@@ -333,6 +338,11 @@ class PartitionBuffer:
             file_bytes = size
         task = _SpillSlotTask(path, tbls[0].schema, nrows, file_bytes,
                               self.scope)
+        # the slot recycles when nothing can read it anymore: task GC, not
+        # first-read, so forked references never race the free-list
+        import weakref
+
+        weakref.finalize(task, self.scope.recycle, path)
         return MicroPartition.from_scan_task(task)
 
     def __len__(self) -> int:

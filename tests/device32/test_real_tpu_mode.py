@@ -1183,6 +1183,102 @@ class TestDeviceEpoch32:
         assert dev.to_pydict() == host.to_pydict()
 
 
+class TestComputedEpochCompare32:
+    """Computed 64-bit epoch expressions in COMPARES run on device (the
+    r4-verdict residual beyond sorts): the computed side host-evaluates
+    once in exact int64, splits order-preserving (hi, lo) uint32 lanes,
+    and the comparison compiles as a two-lane unsigned compare. Covers
+    computed-vs-literal, column-vs-column, and computed-vs-computed."""
+
+    def _tdata(self, n=8000):
+        base = datetime.datetime(2020, 1, 1)
+        rng = np.random.RandomState(57)
+        ts = [base + datetime.timedelta(seconds=int(s))
+              for s in rng.randint(0, 10**7, n)]
+        t2 = [base + datetime.timedelta(seconds=int(s))
+              for s in rng.randint(0, 10**7, n)]
+        for i in range(0, n, 97):
+            ts[i] = None
+        for i in range(0, n, 113):
+            t2[i] = None
+        return ({"t": dt.Series.from_pylist(ts, "t", dt.DataType.timestamp("us")),
+                 "t2": dt.Series.from_pylist(t2, "t2", dt.DataType.timestamp("us")),
+                 "v": rng.rand(n)},
+                base + datetime.timedelta(seconds=5 * 10**6))
+
+    def test_computed_epoch_vs_literal_filter_on_device(self, host_mode):
+        data, lit = self._tdata()
+
+        def q():
+            return dt.from_pydict(data).where(
+                (col("t") + dt.interval(days=3)) < lit)
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) >= 1, _counters(dev)
+        assert dev.to_pydict()["v"] == host.to_pydict()["v"]
+
+    def test_epoch_col_vs_col_filter_on_device(self, host_mode):
+        data, _ = self._tdata()
+
+        def q():
+            return dt.from_pydict(data).where(col("t") < col("t2"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) >= 1, _counters(dev)
+        assert dev.to_pydict()["v"] == host.to_pydict()["v"]
+
+    def test_computed_vs_computed_epoch_filter_on_device(self, host_mode):
+        data, _ = self._tdata()
+
+        def q():
+            return dt.from_pydict(data).where(
+                (col("t") + dt.interval(hours=6)) >= (col("t2") - dt.interval(days=1)))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) >= 1, _counters(dev)
+        assert dev.to_pydict()["v"] == host.to_pydict()["v"]
+
+    def test_computed_epoch_pred_fused_agg_on_device(self, host_mode):
+        data, lit = self._tdata()
+
+        def q():
+            return (dt.from_pydict(data)
+                    .where((col("t") + dt.interval(days=2)) <= lit)
+                    .agg(col("v").sum().alias("s"),
+                         col("v").count().alias("c")))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1, _counters(dev)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["c"] == h["c"]
+        np.testing.assert_allclose(d["s"], h["s"], rtol=1e-5)
+
+    def test_epoch_compare_projection_on_device(self, host_mode):
+        data, lit = self._tdata()
+
+        def q():
+            return dt.from_pydict(data).select(
+                ((col("t") + dt.interval(days=1)) > lit).alias("late"),
+                col("v"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) >= 1, _counters(dev)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["late"] == h["late"]  # lane compare is EXACT
+        np.testing.assert_allclose(d["v"], h["v"], rtol=1e-6)  # f32 passthrough
+
+    def test_null_literal_epoch_compare_all_null(self, host_mode):
+        data, _ = self._tdata(1000)
+
+        def q():
+            return dt.from_pydict(data).select(
+                (col("t") == dt.lit(None).cast(dt.DataType.timestamp("us")))
+                .alias("eq"), col("v"))
+
+        dev, host = _run_both(q, host_mode)
+        assert dev.to_pydict()["eq"] == host.to_pydict()["eq"]
+
+
 class TestDeviceDistinct32:
     """Distinct routed through the device group-codes kernel: first-occurrence
     rows, null-key semantics, multi-key packing (null-free only)."""
@@ -1350,6 +1446,91 @@ class TestPipelinedFilter32:
         assert got == sorted(int(v) for v in x if v % 3 == 1)
         c = ctx.stats.counters
         assert c.get("device_filter_dispatches", 0) >= 4, c
+
+
+class TestStringDictPred32:
+    """General dictionary predicates: ANY row-local boolean expression over
+    ONE string column (+ literals) — string transforms included — evaluates
+    on host over the O(unique) dictionary PLUS a null slot (exact null
+    semantics by construction) and gathers by code on device. Generalizes
+    the fixed contains/startswith LUT shapes to computed-string predicates,
+    the r4 'computed-string producers stay host' residual for the boolean
+    surface. Reference: fully general utf8 kernels,
+    src/daft-core/src/array/ops/utf8.rs."""
+
+    def _sdata(self, n=20_000):
+        modes = np.array(["  Mail ", "ship", "AIR", "rail", "TRUCK-X"])
+        vals = modes[RNG.randint(0, 5, n)].tolist()
+        for i in range(0, n, 89):
+            vals[i] = None
+        return {"m": dt.Series.from_pylist(vals, "m", dt.DataType.string()),
+                "v": RNG.rand(n) * 100}
+
+    def test_transformed_string_predicates_on_device(self, host_mode):
+        data = self._sdata()
+        for name, build in [
+            ("upper_eq", lambda: dt.from_pydict(data).where(
+                col("m").str.upper() == "SHIP")),
+            ("strip_lower_startswith", lambda: dt.from_pydict(data).where(
+                col("m").str.lstrip().str.rstrip().str.lower()
+                .str.startswith("mail"))),
+            ("length_gt", lambda: dt.from_pydict(data).where(
+                col("m").str.length() > 4)),
+            ("concat_isin", lambda: dt.from_pydict(data).where(
+                (col("m") + "!").is_in(["AIR!", "rail!"]))),
+            ("replace_contains", lambda: dt.from_pydict(data).where(
+                col("m").str.replace("-X", "").str.contains("RUCK"))),
+        ]:
+            dev, host = _run_both(build, host_mode)
+            assert _counters(dev).get("device_filters", 0) >= 1, name
+            assert dev.to_pydict()["m"] == host.to_pydict()["m"], name
+
+    def test_null_slot_semantics_exact(self, host_mode):
+        """Predicates DEFINED on null inputs (is_null over a transform,
+        fill_null chains) must match the host exactly — the null slot
+        carries whatever the host evaluator produces for a null row."""
+        data = self._sdata()
+        for name, build in [
+            ("transform_is_null", lambda: dt.from_pydict(data).select(
+                col("m").str.upper().is_null().alias("b"), col("v"))),
+            ("fillnull_eq", lambda: dt.from_pydict(data).where(
+                col("m").str.lower().fill_null("ship") == "ship")),
+        ]:
+            dev, host = _run_both(build, host_mode)
+            d, h = dev.to_pydict(), host.to_pydict()
+            if "b" in d:
+                assert d["b"] == h["b"], name
+            else:
+                assert d["m"] == h["m"], name
+
+    def test_transformed_pred_fused_agg_on_device(self, host_mode):
+        data = self._sdata()
+
+        def q():
+            return (dt.from_pydict(data)
+                    .where(col("m").str.lower().str.contains("a"))
+                    .agg(col("v").sum().alias("s"),
+                         col("v").count().alias("c")))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1, _counters(dev)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["c"] == h["c"]
+        np.testing.assert_allclose(d["s"], h["s"], rtol=1e-5)
+
+    def test_int64_arithmetic_inside_dict_pred_allowed(self, host_mode):
+        """length()+1 is int64-typed arithmetic, but it evaluates on HOST
+        over the dictionary — the int32 wrap-safety guard must not veto
+        the lane-ridden subtree."""
+        data = self._sdata()
+
+        def q():
+            return dt.from_pydict(data).where(
+                (col("m").str.length() + 1) > 5)
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_filters", 0) >= 1, _counters(dev)
+        assert dev.to_pydict()["m"] == host.to_pydict()["m"]
 
 
 class TestDeviceStringColCol32:

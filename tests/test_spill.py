@@ -78,33 +78,38 @@ class TestPartitionBuffer:
         assert MEMORY_LEDGER.current == 0
         scope.cleanup()
 
-    def test_spill_slots_recycle_after_consumption(self):
-        """A consumed spill file's path returns to the scope free-list and
-        the next spill overwrites it (page-reuse: fresh file pages fault at
-        a fraction of warm-page speed on ballooned hosts)."""
+    def test_spill_slots_recycle_after_task_gc(self):
+        """A spill file's path returns to the scope free-list when nothing
+        can read it anymore (task GC), and the next spill overwrites it
+        (page-reuse: fresh file pages fault at a fraction of warm-page
+        speed on ballooned hosts). While ANY reference is alive — even
+        after a load — the slot stays pinned and re-reads stay safe."""
         MEMORY_LEDGER.reset()
         scope = SpillScope()
         buf = PartitionBuffer(budget_bytes=1, scope=scope)  # everything spills
-        p1 = MicroPartition.from_pydict({"x": list(range(4000))})
-        buf.append(p1)
+        buf.append(MicroPartition.from_pydict({"x": list(range(4000))}))
         (s1,) = buf.parts()
         assert not s1.is_loaded()
         task1 = s1.scan_task()
         path1 = task1.path
-        got = s1.to_pydict()
-        assert got["x"] == list(range(4000))
-        # consumption recycled the slot: the next spill lands on the same path
+        assert s1.to_pydict()["x"] == list(range(4000))
+        # task1 is still referenced: the slot must NOT be reused yet
         buf2 = PartitionBuffer(budget_bytes=1, scope=scope)
         buf2.append(MicroPartition.from_pydict({"y": [1.5] * 1000}))
         (s2,) = buf2.parts()
-        assert s2.scan_task().path == path1
-        assert s2.to_pydict() == {"y": [1.5] * 1000}
-        # forked-reference safety: a second materialization of the SAME
-        # spill task serves the cached bytes — never whichever spill owns
-        # the (already overwritten) slot by now
+        assert s2.scan_task().path != path1
+        # a re-read through the live reference still serves the original
         assert task1.read().to_pydict() == {"x": list(range(4000))}
+        # drop the last reference -> finalize recycles -> next spill reuses
+        del task1
+        buf3 = PartitionBuffer(budget_bytes=1, scope=scope)
+        buf3.append(MicroPartition.from_pydict({"z": [7] * 500}))
+        (s3,) = buf3.parts()
+        assert s3.scan_task().path == path1
+        assert s3.to_pydict() == {"z": [7] * 500}
         buf.release()
         buf2.release()
+        buf3.release()
         scope.cleanup()
 
     def test_spilled_partition_head_keeps_original_readable(self):
@@ -131,28 +136,24 @@ class TestPartitionBuffer:
         buf.release()
         scope.cleanup()
 
-    def test_overwritten_slot_reread_is_loud(self):
-        """If a forked reference outlives both the cached table AND the
-        slot (another spill re-took the path), materializing it raises —
-        never silently serves the new occupant's bytes."""
+    def test_retaken_slot_read_is_loud(self):
+        """GC-recycle invariant: the free-list may never hand out a slot
+        while a live reference could still read it. If that is ever
+        violated (engine bug), the read raises rather than silently
+        serving whichever spill owns the path by then."""
         MEMORY_LEDGER.reset()
         scope = SpillScope()
         buf = PartitionBuffer(budget_bytes=1, scope=scope)
         buf.append(MicroPartition.from_pydict({"x": list(range(2000))}))
         (s,) = buf.parts()
         task = s.scan_task()
-        # consume via a fork whose result we immediately drop: the weakref
-        # cache dies, the slot recycles
-        task.with_pushdowns(task.pushdowns.with_limit(3)).read()
-        # a later spill re-takes the slot
-        buf2 = PartitionBuffer(budget_bytes=1, scope=scope)
-        buf2.append(MicroPartition.from_pydict({"z": [9] * 500}))
-        (s2,) = buf2.parts()
-        assert s2.scan_task().path == task.path
-        with pytest.raises(RuntimeError, match="overwritten"):
+        # simulate the bug: force the live task's slot back onto the
+        # free-list and re-take it (take_slot bumps the generation)
+        scope.recycle(task.path)
+        assert scope.take_slot() == task.path
+        with pytest.raises(RuntimeError, match="re-taken"):
             task.read()
         buf.release()
-        buf2.release()
         scope.cleanup()
 
     def test_multi_chunk_bucket_spills_and_restores(self):
