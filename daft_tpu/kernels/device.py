@@ -732,16 +732,30 @@ def _string_dict_pred_shape(node, schema):
     generalizing the fixed contains/startswith/endswith LUT shapes to
     arbitrary predicate trees over string transforms. Reference semantics:
     fully general utf8 kernels, src/daft-core/src/array/ops/utf8.rs."""
-    from ..expressions import (
-        Alias, Between, BinaryOp, Cast, Column, FillNull, IfElse, IsIn,
-        IsNull, Literal, Not, Function,
-    )
-
     try:
         if not node.to_field(schema).dtype.is_boolean():
             return None
     except (ValueError, KeyError):
         return None
+    colname = _single_string_col_rowlocal(node, schema)
+    if colname is None:
+        return None
+    return colname, node, node._key()
+
+
+def _single_string_col_rowlocal(node, schema) -> Optional[str]:
+    """The one plain string column `node` row-locally depends on, or None.
+    Row-local: every applied operation is per-row (whitelisted utf8 fns,
+    compares, choices, casts), so a row's result depends only on that
+    row's string value — the property that lets the whole subtree evaluate
+    over the O(unique) dictionary instead of the rows. Shared by the
+    boolean dictionary-predicate shape and the transformed group-key
+    lane."""
+    from ..expressions import (
+        Alias, Between, BinaryOp, Cast, Column, FillNull, IfElse, IsIn,
+        IsNull, Literal, Not, Function,
+    )
+
     cols: set = set()
 
     def rowlocal(n):
@@ -765,10 +779,7 @@ def _string_dict_pred_shape(node, schema):
         return None
     if len(cols) != 1:
         return None
-    colname = next(iter(cols))
-    if _plain_string_column_named(colname, schema) is None:
-        return None
-    return colname, node, node._key()
+    return _plain_string_column_named(next(iter(cols)), schema)
 
 
 def _plain_string_column_named(colname, schema):
@@ -781,6 +792,65 @@ def _plain_string_column_named(colname, schema):
 def _strdictpred_env_keys(node_key) -> Tuple[str, str, str]:
     base = f"__strdictpred__\x00{node_key}"
     return base + "\x00vals", base + "\x00valid", base + "\x00nullslot"
+
+
+def _string_dict_value_shape(node, schema):
+    """(colname, node, node_key) when `node` is a row-local COMPUTED
+    expression of ONE plain string column used as a VALUE (group/distinct
+    key): `upper(s)`, `s.substr(0, 2)`, `length(s)`, fill_null chains.
+    Equal source strings produce equal results, so the value set computes
+    over the dictionary (+ null slot) and each row's dense result code is
+    a gather. Plain columns are excluded — the existing dictionary-code
+    path already handles them without the host evaluation."""
+    if _plain_string_column(node, schema) is not None:
+        return None
+    colname = _single_string_col_rowlocal(node, schema)
+    if colname is None:
+        return None
+    return colname, node, node._key()
+
+
+def dict_transform_group_lane(table, shape, bucket: int,
+                              stage_cache: Optional[dict]):
+    """(vals, valid) int32 device lanes for a transformed-string group key:
+    host evaluates the transform over the dictionary values + one null
+    slot (exact null semantics — a fill_null can turn the null row into a
+    real group), dictionary-encodes the transformed values into dense ids
+    (equal results — 'a' and 'A' under lower() — share an id), and the
+    device gathers ids by source code. O(unique) host work, O(rows) on
+    device; group identity is all the codes kernel needs, and the unique
+    key ROWS are re-evaluated on host from first-occurrence indices so
+    the decoded output is exact. Returns None -> caller declines."""
+    colname, node, node_key = shape
+    cache_key = ("__dicttranslane__", node_key, bucket)
+    cached = stage_cache.get(cache_key) if stage_cache is not None else None
+    if cached is not None:
+        return cached
+    staged = stage_table_columns(table, [colname], bucket, stage_cache)
+    if staged is None:
+        return None
+    _env, dcs = staged
+    dc = dcs.get(colname)
+    if dc is None or dc.dictionary is None:
+        return None
+    uniq = dc.dictionary
+    arr = _eval_over_dictionary(colname, node, uniq)
+    if arr is None:
+        return None
+    try:
+        enc = pc.dictionary_encode(arr)
+    except Exception:
+        return None
+    ids = np.asarray(pc.fill_null(enc.indices, 0), dtype=np.int32)
+    tvalid = np.asarray(pc.is_valid(enc.indices), dtype=bool)
+    u = len(uniq)
+    idx = jnp.where(dc.valid, dc.values, u).astype(jnp.int32)
+    vals = jnp.asarray(ids)[idx]
+    valid = jnp.asarray(tvalid)[idx]
+    out = (vals, valid)
+    if stage_cache is not None:
+        stage_cache[cache_key] = out
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1158,9 +1228,14 @@ def _string_dict_pred_applies(node, schema):
     connectives and plain pass-throughs are also excluded: each side below
     them gets its own best shape (a bisect compare beats an O(unique)
     dictionary evaluation on high-cardinality columns)."""
-    from ..expressions import Alias, BinaryOp, Column, Literal, Not
+    from ..expressions import Alias, BinaryOp, Column, IsNull, Literal, Not
 
     if isinstance(node, (Alias, Column, Literal, Not)):
+        return None
+    if isinstance(node, IsNull) and \
+            _plain_string_column(node.child, schema) is not None:
+        # is_null over a plain column is a native validity-mask op on
+        # device; the dictionary evaluation would only add host work
         return None
     if isinstance(node, BinaryOp):
         if node.op in ("&", "|", "^"):
@@ -1200,21 +1275,14 @@ def collect_string_luts(nodes, schema):
     return out
 
 
-def _merge_dict_pred(merged: dict, colname: str, node, node_key, dcs) -> bool:
-    """Evaluate a general dictionary predicate over the column's dictionary
-    values PLUS one null slot (exact null semantics: whatever the host path
-    produces for a null input — is_null, fill_null chains — the gather
-    produces identically), through the host evaluator itself so parity is
-    by construction. False = decline to the host path."""
+def _eval_over_dictionary(colname: str, node, uniq):
+    """Host-evaluate `node` over the dictionary values PLUS one null slot
+    (index len(uniq)) — THE one definition of dictionary-level evaluation,
+    shared by the boolean predicate LUT and the transformed group-key lane
+    so their null semantics can never diverge. Returns the arrow result
+    array of length len(uniq)+1, or None (caller declines to host)."""
     from ..table import Table
 
-    vals_k, valid_k, null_k = _strdictpred_env_keys(node_key)
-    if vals_k in merged:
-        return True
-    dc = dcs.get(colname)
-    if dc is None or dc.dictionary is None:
-        return False
-    uniq = dc.dictionary
     try:
         with_null = pa.concat_arrays(
             [uniq, pa.array([None], type=uniq.type)])
@@ -1225,7 +1293,26 @@ def _merge_dict_pred(merged: dict, colname: str, node, node_key, dcs) -> bool:
             arr = arr.combine_chunks()
         if len(arr) == 1 and len(with_null) > 1:  # scalar broadcast
             arr = pa.concat_arrays([arr] * len(with_null))
+        return arr
     except Exception:
+        return None
+
+
+def _merge_dict_pred(merged: dict, colname: str, node, node_key, dcs) -> bool:
+    """Evaluate a general dictionary predicate over the column's dictionary
+    values PLUS one null slot (exact null semantics: whatever the host path
+    produces for a null input — is_null, fill_null chains — the gather
+    produces identically), through the host evaluator itself so parity is
+    by construction. False = decline to the host path."""
+    vals_k, valid_k, null_k = _strdictpred_env_keys(node_key)
+    if vals_k in merged:
+        return True
+    dc = dcs.get(colname)
+    if dc is None or dc.dictionary is None:
+        return False
+    uniq = dc.dictionary
+    arr = _eval_over_dictionary(colname, node, uniq)
+    if arr is None:
         return False
     vals_np = np.asarray(pc.fill_null(arr, False), dtype=bool)
     valid_np = np.asarray(pc.is_valid(arr), dtype=bool)

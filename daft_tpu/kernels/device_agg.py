@@ -99,28 +99,45 @@ def _stage_group_key(table, key_expr, cache):
     """(vals, valid) int lanes for ONE group key: integer/date expressions
     via the join-key stager; plain STRING columns via their sorted
     dictionary codes (dense ints already — the device kernel neither knows
-    nor cares that they decode to text)."""
-    from .device import _plain_string_column, normalize_and_check
+    nor cares that they decode to text); transformed-string keys
+    (upper/substr/length/fill_null chains over one string column) via a
+    host transform of the dictionary gathered by code
+    (device.dict_transform_group_lane)."""
+    from .device import (_plain_string_column, _string_dict_value_shape,
+                         dict_transform_group_lane, normalize_and_check,
+                         size_bucket)
     from .device_join import _stage_key
 
     staged = _stage_key(table, key_expr, cache)
     if staged is not None:
         return staged
     nodes = normalize_and_check([key_expr], table.schema)
-    if nodes is None:
+    if nodes is not None:
+        cname = _plain_string_column(nodes[0], table.schema)
+        if cname is not None:
+            staged_cols = stage_table_columns(table, [cname],
+                                              size_bucket(len(table)), cache)
+            if staged_cols is None:
+                return None
+            _env, dcs = staged_cols
+            dc = dcs[cname]
+            if dc.dictionary is None:
+                return None
+            return dc.values, dc.valid
+    # transformed-string keys: normalized WITHOUT the projection-
+    # compilability gate — the transform evaluates on host over the
+    # dictionary, so it need not compile on device
+    from ..expressions import normalize_literals
+
+    try:
+        node = normalize_literals(key_expr._node, table.schema)
+    except (ValueError, KeyError):
         return None
-    cname = _plain_string_column(nodes[0], table.schema)
-    if cname is None:
+    shape = _string_dict_value_shape(node, table.schema)
+    if shape is None:
         return None
-    staged_cols = stage_table_columns(table, [cname],
-                                      size_bucket(len(table)), cache)
-    if staged_cols is None:
-        return None
-    _env, dcs = staged_cols
-    dc = dcs[cname]
-    if dc.dictionary is None:
-        return None
-    return dc.values, dc.valid
+    return dict_transform_group_lane(table, shape,
+                                     size_bucket(len(table)), cache)
 
 
 def _try_device_group_codes(table, group_by, stage_cache, n: int):

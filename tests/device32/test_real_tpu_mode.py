@@ -1532,6 +1532,75 @@ class TestStringDictPred32:
         assert _counters(dev).get("device_filters", 0) >= 1, _counters(dev)
         assert dev.to_pydict()["m"] == host.to_pydict()["m"]
 
+    def test_groupby_transformed_string_key_on_device(self, host_mode):
+        """group by upper(s): distinct source strings collapsing to the
+        same transformed value ('ship'/'SHIP') must share a group — dense
+        transformed ids, not source dictionary codes."""
+        data = self._sdata()
+        extra = list(data["m"].to_pylist())
+        extra[1] = "MAIL"  # collides with '  Mail ' only AFTER the chain
+        data = dict(data, m=dt.Series.from_pylist(
+            extra, "m", dt.DataType.string()))
+
+        def q():
+            return (dt.from_pydict(data)
+                    .groupby(col("m").str.lstrip().str.rstrip().str.upper()
+                             .alias("k"))
+                    .agg(col("v").sum().alias("s"),
+                         col("v").count().alias("c"))
+                    .sort("k"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_group_codes", 0) >= 1, _counters(dev)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["k"] == h["k"] and d["c"] == h["c"]
+        np.testing.assert_allclose(d["s"], h["s"], rtol=1e-5)
+
+    def test_groupby_fillnull_string_key_groups_nulls(self, host_mode):
+        """fill_null makes the null rows a REAL group — the null slot in
+        the transformed dictionary carries the fill value's id."""
+        data = self._sdata()
+
+        def q():
+            return (dt.from_pydict(data)
+                    .groupby(col("m").fill_null("<none>").alias("k"))
+                    .agg(col("v").count().alias("c"))
+                    .sort("k"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_group_codes", 0) >= 1, _counters(dev)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["k"] == h["k"] and d["c"] == h["c"]
+        assert "<none>" in d["k"]
+
+    def test_distinct_on_transformed_string_on_device(self, host_mode):
+        data = self._sdata()
+
+        def q():
+            return dt.from_pydict(data).select(
+                col("m").str.lower().alias("k"), col("v")).distinct("k")
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_distincts", 0) >= 1, _counters(dev)
+        d = sorted((x is None, x) for x in dev.to_pydict()["k"])
+        h = sorted((x is None, x) for x in host.to_pydict()["k"])
+        assert d == h
+
+    def test_groupby_transformed_plus_int_multikey(self, host_mode):
+        data = self._sdata()
+        data = dict(data, i=RNG.randint(0, 3, len(data["v"])))
+
+        def q():
+            return (dt.from_pydict(data)
+                    .where(col("m").is_null() == False)  # noqa: E712
+                    .groupby(col("m").str.upper().alias("k"), col("i"))
+                    .agg(col("v").count().alias("c"))
+                    .sort(["k", "i"]))
+
+        dev, host = _run_both(q, host_mode)
+        d, h = dev.to_pydict(), host.to_pydict()
+        assert d["k"] == h["k"] and d["i"] == h["i"] and d["c"] == h["c"]
+
 
 class TestDeviceStringColCol32:
     """Col-vs-col string compute on device via JOINT-dictionary recoding
