@@ -797,11 +797,12 @@ def _strdictpred_env_keys(node_key) -> Tuple[str, str, str]:
 def _string_dict_value_shape(node, schema):
     """(colname, node, node_key) when `node` is a row-local COMPUTED
     expression of ONE plain string column used as a VALUE (group/distinct
-    key): `upper(s)`, `s.substr(0, 2)`, `length(s)`, fill_null chains.
-    Equal source strings produce equal results, so the value set computes
-    over the dictionary (+ null slot) and each row's dense result code is
-    a gather. Plain columns are excluded — the existing dictionary-code
-    path already handles them without the host evaluation."""
+    key, sort key, projection output): `upper(s)`, `s.substr(0, 2)`,
+    fill_null chains. Equal source strings produce equal results, so the
+    value set computes over the dictionary (+ null slot) and each row's
+    dense sorted-order id is a gather. Plain columns are excluded — the
+    existing dictionary-code path already handles them without the host
+    evaluation."""
     if _plain_string_column(node, schema) is not None:
         return None
     colname = _single_string_col_rowlocal(node, schema)
@@ -810,17 +811,80 @@ def _string_dict_value_shape(node, schema):
     return colname, node, node._key()
 
 
-def dict_transform_group_lane(table, shape, bucket: int,
-                              stage_cache: Optional[dict]):
-    """(vals, valid) int32 device lanes for a transformed-string group key:
-    host evaluates the transform over the dictionary values + one null
-    slot (exact null semantics — a fill_null can turn the null row into a
-    real group), dictionary-encodes the transformed values into dense ids
-    (equal results — 'a' and 'A' under lower() — share an id), and the
-    device gathers ids by source code. O(unique) host work, O(rows) on
-    device; group identity is all the codes kernel needs, and the unique
-    key ROWS are re-evaluated on host from first-occurrence indices so
-    the decoded output is exact. Returns None -> caller declines."""
+def _string_value_applies(node, schema):
+    """The transformed-string VALUE shape at a compile-claim point: the
+    node must be string-VALUED, not a plain column (native codes path) and
+    not a choice over plain columns/literals (joint-dictionary path) —
+    precedence must match _compile_node's dispatch order."""
+    try:
+        if not node.to_field(schema).dtype.is_string():
+            return None
+    except (ValueError, KeyError):
+        return None
+    if _string_choice_shape(node, schema) is not None:
+        return None
+    return _string_dict_value_shape(node, schema)
+
+
+def _strtransval_env_keys(node_key) -> Tuple[str, str]:
+    base = f"__strtransval__\x00{node_key}"
+    return base + "\x00vals", base + "\x00valid"
+
+
+def _stroutdict_aux_key(node_key):
+    return ("__stroutdict__", node_key)
+
+
+def string_transform_env(nodes, schema, table, bucket: int,
+                         stage_cache: Optional[dict], env: dict,
+                         aux: dict) -> Optional[dict]:
+    """Stage transformed-string VALUE lanes (sorted-order ids + validity)
+    into env and their transformed dictionaries into aux for decode at
+    unstage. Walks each tree; predicate-LUT subtrees are skipped (their
+    env entries come from string_lut_env), and a claimed value subtree is
+    not descended (its children evaluate on host over the dictionary).
+    Returns env (possibly unchanged), or None when a lane cannot stage —
+    the caller declines to the host path."""
+    merged = env
+
+    def walk(n):
+        nonlocal merged
+        if (_string_lut_shape(n, schema) is not None
+                or _string_dict_pred_applies(n, schema) is not None):
+            return True  # the LUT env owns this subtree
+        vs = _string_value_applies(n, schema)
+        if vs is not None:
+            lane = dict_transform_lane(table, vs, bucket, stage_cache)
+            if lane is None:
+                return False
+            vals, valid, tuniq = lane
+            if merged is env:
+                merged = dict(env)
+            vk, mk = _strtransval_env_keys(vs[2])
+            merged[vk] = vals
+            merged[mk] = valid
+            aux[_stroutdict_aux_key(vs[2])] = tuniq
+            return True
+        return all(walk(c) for c in n.children())
+
+    for nd in nodes:
+        if not walk(nd):
+            return None
+    return merged
+
+
+def dict_transform_lane(table, shape, bucket: int,
+                        stage_cache: Optional[dict]):
+    """(vals, valid, transformed_dictionary) for a transformed-string
+    expression: host evaluates the transform over the dictionary values +
+    one null slot (exact null semantics — a fill_null can turn the null
+    row into a real group), recodes the results through their SORTED
+    distinct values (order-preserving: equal results — 'a' and 'A' under
+    lower() — share an id, and id order == value order, so the same lane
+    serves group identity AND sorts), and the device gathers ids by source
+    code. O(unique log unique) host work, O(rows) on device. The
+    transformed dictionary decodes ids back to values for projection
+    outputs. Returns None -> caller declines."""
     colname, node, node_key = shape
     cache_key = ("__dicttranslane__", node_key, bucket)
     cached = stage_cache.get(cache_key) if stage_cache is not None else None
@@ -838,16 +902,18 @@ def dict_transform_group_lane(table, shape, bucket: int,
     if arr is None:
         return None
     try:
-        enc = pc.dictionary_encode(arr)
+        distinct = pc.unique(arr.drop_null())
+        tuniq = distinct.take(pc.sort_indices(distinct))
+        ids_arr = pc.index_in(arr, value_set=tuniq)  # null -> null id
     except Exception:
         return None
-    ids = np.asarray(pc.fill_null(enc.indices, 0), dtype=np.int32)
-    tvalid = np.asarray(pc.is_valid(enc.indices), dtype=bool)
+    ids = np.asarray(pc.fill_null(ids_arr, 0), dtype=np.int32)
+    tvalid = np.asarray(pc.is_valid(ids_arr), dtype=bool)
     u = len(uniq)
     idx = jnp.where(dc.valid, dc.values, u).astype(jnp.int32)
     vals = jnp.asarray(ids)[idx]
     valid = jnp.asarray(tvalid)[idx]
-    out = (vals, valid)
+    out = (vals, valid, tuniq)
     if stage_cache is not None:
         stage_cache[cache_key] = out
     return out
@@ -988,6 +1054,9 @@ def string_output_dictionary(node, schema, dcs, aux):
     ch = _string_choice_shape(node, schema)
     if ch is not None:
         return aux.get(_joint_gkey(ch.cols, ch.lits))
+    vs = _string_dict_value_shape(node, schema)
+    if vs is not None:
+        return aux.get(_stroutdict_aux_key(vs[2]))
     return None
 
 
@@ -1396,9 +1465,11 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
         # nothing below it needs to compile on device
         return True
     if not (is_device_dtype(out_dt) or out_dt.is_null()):
-        # strings ride dictionary codes: bare column passthrough, or a
+        # strings ride dictionary codes: bare column passthrough, a
         # fill_null/if_else over string columns/literals whose output codes
-        # live in a joint dictionary (decoded at unstage); any OTHER
+        # live in a joint dictionary, or a row-local transform of ONE
+        # string column whose sorted-order ids come from a host transform
+        # of the dictionary (all decoded at unstage); any OTHER
         # string-producing compute stays host
         if out_dt.is_string():
             if _plain_string_column(node, schema) is not None:
@@ -1406,6 +1477,8 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
             ch = _string_choice_shape(node, schema)
             if ch is not None:
                 return ch.pred is None or rec(ch.pred)
+            if _string_dict_value_shape(node, schema) is not None:
+                return True
             return False
         return False
     if isinstance(node, Column):
@@ -1581,6 +1654,18 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
             codes, m = env[_c]
             idx = jnp.where(m, codes, env[_nk])
             return env[_vk][idx], env[_mk][idx]
+
+        return run, out_dt
+
+    vshape = _string_value_applies(node, schema)
+    if vshape is not None:
+        # transformed-string value: the lane (sorted-order ids + validity)
+        # was staged by string_transform_env; decode at unstage goes
+        # through the transformed dictionary (string_output_dictionary)
+        vk, mk = _strtransval_env_keys(vshape[2])
+
+        def run(env, _vk=vk, _mk=mk):
+            return env[_vk], env[_mk]
 
         return run, out_dt
 
@@ -2050,7 +2135,8 @@ def int64_wrap_safe(nodes, schema, env, stage_cache: Optional[dict],
         if r is None:
             r = ((isinstance(n, BinaryOp)
                   and _epoch_cmp_shape(n, schema) is not None)
-                 or _string_dict_pred_applies(n, schema) is not None)
+                 or _string_dict_pred_applies(n, schema) is not None
+                 or _string_value_applies(n, schema) is not None)
             _lanes_memo[id(n)] = r
         return r
 
@@ -2179,6 +2265,9 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
         return None
     aux: dict = {}
     env = string_joint_env(nodes, schema, dcs, env, aux)
+    if env is None:
+        return None
+    env = string_transform_env(nodes, schema, table, b, stage_cache, env, aux)
     if env is None:
         return None
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))

@@ -102,42 +102,45 @@ def _stage_group_key(table, key_expr, cache):
     nor cares that they decode to text); transformed-string keys
     (upper/substr/length/fill_null chains over one string column) via a
     host transform of the dictionary gathered by code
-    (device.dict_transform_group_lane)."""
-    from .device import (_plain_string_column, _string_dict_value_shape,
-                         dict_transform_group_lane, normalize_and_check,
+    (device.dict_transform_lane)."""
+    from ..expressions import normalize_literals
+    from .device import (_plain_string_column, _rewrite_between,
+                         _string_dict_value_shape, dict_transform_lane,
                          size_bucket)
     from .device_join import _stage_key
 
     staged = _stage_key(table, key_expr, cache)
     if staged is not None:
         return staged
-    nodes = normalize_and_check([key_expr], table.schema)
-    if nodes is not None:
-        cname = _plain_string_column(nodes[0], table.schema)
-        if cname is not None:
-            staged_cols = stage_table_columns(table, [cname],
-                                              size_bucket(len(table)), cache)
-            if staged_cols is None:
-                return None
-            _env, dcs = staged_cols
-            dc = dcs[cname]
-            if dc.dictionary is None:
-                return None
-            return dc.values, dc.valid
-    # transformed-string keys: normalized WITHOUT the projection-
-    # compilability gate — the transform evaluates on host over the
-    # dictionary, so it need not compile on device
-    from ..expressions import normalize_literals
-
+    # normalize ONCE, with the same rewrites normalize_and_check applies
+    # (a Between inside a row-local tree must produce the SAME node key
+    # string_transform_env caches under, or the lane stages twice)
     try:
-        node = normalize_literals(key_expr._node, table.schema)
+        node = _rewrite_between(
+            normalize_literals(key_expr._node, table.schema), table.schema)
     except (ValueError, KeyError):
         return None
+    cname = _plain_string_column(node, table.schema)
+    if cname is not None:
+        staged_cols = stage_table_columns(table, [cname],
+                                          size_bucket(len(table)), cache)
+        if staged_cols is None:
+            return None
+        _env, dcs = staged_cols
+        dc = dcs[cname]
+        if dc.dictionary is None:
+            return None
+        return dc.values, dc.valid
+    # transformed-string keys: no projection-compilability gate — the
+    # transform evaluates on host over the dictionary
     shape = _string_dict_value_shape(node, table.schema)
     if shape is None:
         return None
-    return dict_transform_group_lane(table, shape,
-                                     size_bucket(len(table)), cache)
+    lane = dict_transform_lane(table, shape, size_bucket(len(table)), cache)
+    if lane is None:
+        return None
+    vals, valid, _tuniq = lane
+    return vals, valid
 
 
 def _try_device_group_codes(table, group_by, stage_cache, n: int):
@@ -356,6 +359,12 @@ def device_grouped_agg_async(table, to_agg, group_by,
     env = string_joint_env(check_nodes, schema, dcs, env, joint_aux)
     if env is None:
         return None  # a joint-group column lost its dictionary
+    from .device import string_transform_env
+
+    env = string_transform_env(check_nodes, schema, table, b, stage_cache,
+                               env, joint_aux)
+    if env is None:
+        return None  # a transformed-string lane failed to stage
 
     # --- compile + run ONE fused program ---------------------------------
     from ..context import get_context

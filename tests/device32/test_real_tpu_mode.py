@@ -1586,20 +1586,71 @@ class TestStringDictPred32:
         h = sorted((x is None, x) for x in host.to_pydict()["k"])
         assert d == h
 
-    def test_groupby_transformed_plus_int_multikey(self, host_mode):
+    def test_transformed_string_projection_on_device(self, host_mode):
+        """select(upper(strip(s))) produces the transformed VALUES on
+        device: sorted-order ids gather by code and decode through the
+        transformed dictionary at unstage — exact, including nulls."""
         data = self._sdata()
-        data = dict(data, i=RNG.randint(0, 3, len(data["v"])))
+
+        def q():
+            return dt.from_pydict(data).select(
+                col("m").str.lstrip().str.rstrip().str.upper().alias("u"),
+                col("m").fill_null("?").str.lower().alias("l"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_sort_by_transformed_string_on_device(self, host_mode):
+        """Sorted-order ids make sort-by-transform exact on device (id
+        order == transformed value order), nulls following direction."""
+        data = self._sdata()
+
+        def q():
+            return dt.from_pydict(data).select(col("m")).sort(
+                col("m").str.lower(), desc=True)
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_sorts", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_minmax_of_transformed_string_on_device(self, host_mode):
+        data = self._sdata()
 
         def q():
             return (dt.from_pydict(data)
-                    .where(col("m").is_null() == False)  # noqa: E712
-                    .groupby(col("m").str.upper().alias("k"), col("i"))
+                    .groupby(col("m").is_null().alias("g"))
+                    .agg(col("m").str.upper().min().alias("lo"),
+                         col("m").str.upper().max().alias("hi"))
+                    .sort("g"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_groupby_transformed_plus_int_multikey(self, host_mode):
+        """Null-free inputs so the mixed-radix multi-key packing engages:
+        the transformed lane + int lane pack into ONE device lane and the
+        device group-codes counter must prove it."""
+        n = 12_000
+        vals = np.array(["  Foo ", "foo", "BAR", "bar "])[
+            RNG.randint(0, 4, n)].tolist()
+        data = {"m": dt.Series.from_pylist(vals, "m", dt.DataType.string()),
+                "i": RNG.randint(0, 3, n),
+                "v": RNG.rand(n)}
+
+        def q():
+            return (dt.from_pydict(data)
+                    .groupby(col("m").str.lstrip().str.rstrip().str.lower()
+                             .alias("k"), col("i"))
                     .agg(col("v").count().alias("c"))
                     .sort(["k", "i"]))
 
         dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_group_codes", 0) >= 1, _counters(dev)
         d, h = dev.to_pydict(), host.to_pydict()
         assert d["k"] == h["k"] and d["i"] == h["i"] and d["c"] == h["c"]
+        assert d["k"][0] == "bar" and len(set(d["k"])) == 2  # merged groups
 
 
 class TestDeviceStringColCol32:

@@ -55,9 +55,19 @@ class TestDeviceProjection:
         for k in hd:
             assert hd[k] == dd[k], k
 
-    def test_string_exprs_ineligible(self, table):
-        t = Table.from_pydict({"s": ["a", "b"]})
-        assert dev.eval_projection_device(t, [col("s").str.upper()]) is None
+    def test_single_column_string_transform_now_eligible(self, table):
+        # upper(s) rides the transformed-dictionary lane (sorted-order ids
+        # gathered by code, decoded at unstage) — exact host parity
+        t = Table.from_pydict({"s": ["a", "B", None, "c"]})
+        out = dev.eval_projection_device(t, [col("s").str.upper()])
+        assert out is not None
+        assert out.to_pydict() == {"s": ["A", "B", None, "C"]}
+
+    def test_two_column_string_compute_ineligible(self, table):
+        # a string producer over TWO columns has no single source
+        # dictionary to transform: stays host
+        t = Table.from_pydict({"s": ["a", "b"], "t": ["x", "y"]})
+        assert dev.eval_projection_device(t, [col("s") + col("t")]) is None
 
     def test_float_division_by_zero_matches_host(self):
         t = Table.from_pydict({"a": [1.0, 2.0], "z": [0, 2]})
