@@ -335,7 +335,8 @@ def device_grouped_agg_async(table, to_agg, group_by,
     # --- stage inputs -----------------------------------------------------
     from .device import (device_required_columns, epoch_cmp_env,
                          epoch_cmps_for, int64_wrap_safe, string_joint_env,
-                         string_literal_env, string_lut_env)
+                         string_literal_env, string_lut_env,
+                         string_transform_env)
 
     check_nodes = list(child_nodes) + (list(pred_nodes) if pred_nodes else [])
     epoch_cmps = epoch_cmps_for(check_nodes, schema)
@@ -359,8 +360,6 @@ def device_grouped_agg_async(table, to_agg, group_by,
     env = string_joint_env(check_nodes, schema, dcs, env, joint_aux)
     if env is None:
         return None  # a joint-group column lost its dictionary
-    from .device import string_transform_env
-
     env = string_transform_env(check_nodes, schema, table, b, stage_cache,
                                env, joint_aux)
     if env is None:
