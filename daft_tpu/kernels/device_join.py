@@ -157,12 +157,96 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
 
 def _is_plain_string_key(table, key_expr) -> bool:
     """Cheap shape check (no staging): the key normalizes to a bare string
-    Column, i.e. the joint-dictionary path could apply."""
-    from .device import _plain_string_column, normalize_and_check
+    Column OR a row-local transform of one, i.e. the joint-dictionary path
+    could apply."""
+    node = _normalized_key_node(table, key_expr)
+    if node is None:
+        return False
+    from .device import _plain_string_column
 
-    nodes = normalize_and_check([key_expr], table.schema)
-    return (nodes is not None
-            and _plain_string_column(nodes[0], table.schema) is not None)
+    return (_plain_string_column(node, table.schema) is not None
+            or _string_valued_transform_shape(node, table.schema) is not None)
+
+
+def _string_valued_transform_shape(node, schema):
+    """The transform shape ONLY when the node is string-VALUED: a join key
+    like length(s) (int) or s=="x" (bool) must not reach the joint
+    dictionary, whose merge casts to large_string and would silently join
+    ints against their string representations."""
+    try:
+        if not node.to_field(schema).dtype.is_string():
+            return None
+    except (ValueError, KeyError):
+        return None
+    from .device import _string_dict_value_shape
+
+    return _string_dict_value_shape(node, schema)
+
+
+def _normalized_key_node(table, key_expr):
+    """Literal-normalized + Between-rewritten key node (the same
+    normalization every dictionary cache key uses), or None."""
+    from ..expressions import normalize_literals
+    from .device import _rewrite_between
+
+    try:
+        return _rewrite_between(
+            normalize_literals(key_expr._node, table.schema), table.schema)
+    except (ValueError, KeyError):
+        return None
+
+
+class _CodeSide:
+    """(values, valid, dictionary) triple for one string join-key side —
+    a plain column's dictionary codes, or a TRANSFORMED key's sorted-recode
+    lane with its transformed dictionary. Duck-typed like DeviceColumn for
+    _joint_remaps (which reads .values/.valid/.dictionary only)."""
+
+    __slots__ = ("values", "valid", "dictionary")
+
+    def __init__(self, values, valid, dictionary):
+        self.values = values
+        self.valid = valid
+        self.dictionary = dictionary
+
+
+def _string_code_side(table, key_expr, cache) -> Optional[_CodeSide]:
+    """Stage one string-key side into code space: plain columns via their
+    sorted dictionary, row-local transforms (upper/substr/fill_null chains,
+    r5) via the sorted-recode transform lane — both yield (codes, valid,
+    dictionary) and merge through the same joint dictionary."""
+    from .device import (_plain_string_column, dict_transform_lane,
+                         size_bucket, stage_table_columns)
+
+    node = _normalized_key_node(table, key_expr)
+    if node is None:
+        return None
+    cname = _plain_string_column(node, table.schema)
+    if cname is not None:
+        staged = stage_table_columns(table, [cname],
+                                     size_bucket(len(table)), cache)
+        if staged is None:
+            return None
+        dc = staged[1][cname]
+        if dc.dictionary is None:
+            return None
+        return _CodeSide(dc.values, dc.valid, dc.dictionary)
+    shape = _string_valued_transform_shape(node, table.schema)
+    if shape is None:
+        return None
+    lane = dict_transform_lane(table, shape, size_bucket(len(table)), cache)
+    if lane is None:
+        return None
+    vals, valid, tuniq = lane
+    # a null-reviving transform (fill_null chain) marks the size-bucket
+    # PADDING lanes valid (they gather through the null slot); the probe
+    # kernels mask by validity, not row count, so phantom build rows would
+    # match — force padding back invalid here
+    n = len(table)
+    b = int(valid.shape[0])
+    if b > n:
+        valid = valid & (jnp.arange(b, dtype=jnp.int32) < n)
+    return _CodeSide(vals, valid, tuniq)
 
 
 @jax.jit
@@ -223,26 +307,9 @@ def _stage_key_pair(ltable, rtable, lkey, rkey, lcache, rcache,
         rs = _stage_key(rtable, rkey, rcache)
     if ls is not None and rs is not None:
         return ls, rs
-    from .device import (_plain_string_column, normalize_and_check,
-                         stage_table_columns)
-
-    lnodes = normalize_and_check([lkey], ltable.schema)
-    rnodes = normalize_and_check([rkey], rtable.schema)
-    if lnodes is None or rnodes is None:
-        return None
-    lc = _plain_string_column(lnodes[0], ltable.schema)
-    rc = _plain_string_column(rnodes[0], rtable.schema)
-    if lc is None or rc is None:
-        return None
-    lstaged = stage_table_columns(ltable, [lc], size_bucket(len(ltable)),
-                                  lcache)
-    rstaged = stage_table_columns(rtable, [rc], size_bucket(len(rtable)),
-                                  rcache)
-    if lstaged is None or rstaged is None:
-        return None
-    ldc = lstaged[1][lc]
-    rdc = rstaged[1][rc]
-    if ldc.dictionary is None or rdc.dictionary is None:
+    ldc = _string_code_side(ltable, lkey, lcache)
+    rdc = _string_code_side(rtable, rkey, rcache)
+    if ldc is None or rdc is None:
         return None
     lremap, rremap = _joint_remaps(ldc, rdc, lcache, rcache)
     lv = _recode(ldc.values, lremap)

@@ -401,6 +401,75 @@ class TestDeviceJoin:
         assert _counters(dev).get("device_join_probes", 0) > 0, how
         assert self._sorted_rows(dev) == self._sorted_rows(host), how
 
+    def test_transformed_string_key_join_on_device(self, host_mode):
+        """A join key that is a row-local TRANSFORM of a string column
+        (strip+upper) rides the same joint-dictionary probe: the transform
+        lane's sorted-recode dictionary merges with the other side's, so
+        '  mail ' joins 'MAIL' exactly as the host path does."""
+        rng = np.random.RandomState(37)
+        base = ["mail", "ship", "air", "rail", "truck"]
+        lvals = [f"  {base[i]} " if i % 2 else base[i].upper()
+                 for i in rng.randint(0, 5, 3000)]
+        lvals[7] = None
+        ldata = {"nk": dt.Series.from_pylist(lvals, "nk",
+                                             dt.DataType.string()),
+                 "lv": np.arange(3000, dtype=np.int64)}
+        rdata = {"nk2": [b.upper() for b in base[:4]],
+                 "rv": np.arange(4, dtype=np.int64)}
+
+        def q():
+            return (dt.from_pydict(ldata)
+                    .join(dt.from_pydict(rdata),
+                          left_on=col("nk").str.lstrip().str.rstrip()
+                          .str.upper(),
+                          right_on="nk2"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_join_probes", 0) > 0, _counters(dev)
+        assert self._sorted_rows(dev) == self._sorted_rows(host)
+
+    def test_fillnull_transform_key_join_no_phantom_padding(self, host_mode):
+        """A null-reviving transform key (fill_null chain) must NOT turn the
+        build side's size-bucket padding lanes into valid rows: a build
+        table below its bucket with a 'zz' key row must match exactly once
+        per real row, never against phantom padding."""
+        rng = np.random.RandomState(43)
+        lvals = (["zz"] * 50
+                 + np.array(["aa", "bb"])[rng.randint(0, 2, 400)].tolist())
+        rvals = ["aa", None, "bb"]  # 3 rows, far below any size bucket
+        ldata = {"k": dt.Series.from_pylist(lvals, "k", dt.DataType.string()),
+                 "lv": np.arange(len(lvals), dtype=np.int64)}
+        rdata = {"s": dt.Series.from_pylist(rvals, "s", dt.DataType.string()),
+                 "rv": np.arange(3, dtype=np.int64)}
+
+        def q():
+            return (dt.from_pydict(ldata)
+                    .join(dt.from_pydict(rdata), left_on="k",
+                          right_on=col("s").fill_null("zz")))
+
+        dev, host = _run_both(q, host_mode)
+        assert self._sorted_rows(dev) == self._sorted_rows(host)
+        # exactly 50 'zz' matches (one real build row) — phantom padding
+        # would inflate this
+        assert len(dev.to_pydict()["lv"]) == len(host.to_pydict()["lv"])
+
+    def test_nonstring_transform_key_declines_device(self, host_mode):
+        """length(s) as a join key is INT-valued: it must not reach the
+        joint string dictionary (which would join 4 against '4') — the
+        device declines, host parity holds."""
+        ldata = {"s": ["a", "bb", "ccc", "dddd"] * 100,
+                 "lv": np.arange(400, dtype=np.int64)}
+        rdata = {"n": np.array([1, 2, 3], dtype=np.int64),
+                 "rv": np.array([10, 20, 30], dtype=np.int64)}
+
+        def q():
+            return (dt.from_pydict(ldata)
+                    .join(dt.from_pydict(rdata),
+                          left_on=col("s").str.length(), right_on="n"))
+
+        dev, host = _run_both(q, host_mode)
+        assert self._sorted_rows(dev) == self._sorted_rows(host)
+
     def test_mixed_int_string_multikey_join(self, host_mode):
         rng = np.random.RandomState(31)
         ldata = {"a": rng.randint(0, 20, 3000).astype(np.int64),

@@ -49,6 +49,59 @@ def _two_cols(draw):
     return a, b
 
 
+_TRANSFORMS = {
+    "upper": lambda e: e.str.upper(),
+    "lower": lambda e: e.str.lower(),
+    "lstrip": lambda e: e.str.lstrip(),
+    "reverse": lambda e: e.str.reverse(),
+    "left2": lambda e: e.str.left(2),
+    "concat_lit": lambda e: e + "_x",
+    "fill_then_upper": lambda e: e.fill_null("zz").str.upper(),
+}
+
+
+@given(_two_cols(), st.sampled_from(sorted(_TRANSFORMS)))
+@settings(max_examples=60, deadline=None)
+def test_transform_producer_parity(case, tname):
+    """Row-local transform producers (r5 sorted-recode lanes): projected
+    VALUES, including null slots and collapsing sources, must match the
+    host exactly."""
+    a, b = case
+
+    def build():
+        return _frame(a, b).select(_TRANSFORMS[tname](col("a")).alias("t"))
+
+    got, want = _run_device_and_host(build)
+    assert got == want
+
+
+@given(_two_cols(), st.sampled_from(sorted(_TRANSFORMS)))
+@settings(max_examples=40, deadline=None)
+def test_transform_groupby_count_parity(case, tname):
+    a, b = case
+
+    def build():
+        return (_frame(a, b)
+                .groupby(_TRANSFORMS[tname](col("a")).alias("k"))
+                .agg(col("b").count().alias("c"))
+                .sort("k"))
+
+    got, want = _run_device_and_host(build)
+    assert got == want
+
+
+@given(_two_cols(), st.sampled_from(sorted(_TRANSFORMS)))
+@settings(max_examples=40, deadline=None)
+def test_transform_sort_parity(case, tname):
+    a, b = case
+
+    def build():
+        return _frame(a, b).sort([_TRANSFORMS[tname](col("a")), col("b")])
+
+    got, want = _run_device_and_host(build)
+    assert got == want
+
+
 @given(_two_cols(), st.sampled_from(["==", "!=", "<", "<=", ">", ">="]))
 @settings(max_examples=60, deadline=None)
 def test_colcol_compare_parity(case, op):
