@@ -826,6 +826,75 @@ def _string_value_applies(node, schema):
     return _string_dict_value_shape(node, schema)
 
 
+def _int_transform_applies(node, schema):
+    """(colname, node, node_key) when `node` is an INTEGER-valued row-local
+    expression of ONE string column — `length(s)`, `find(s, p)`,
+    `count_matches` — whose values (not recoded ids) gather by source code.
+    A bare Function is required at the root: integer ARITHMETIC above the
+    transform composes on device through the generic compiler once the
+    transform itself is claimed."""
+    from ..expressions import Function
+
+    if not isinstance(node, Function):
+        return None
+    try:
+        if not node.to_field(schema).dtype.is_integer():
+            return None
+    except (ValueError, KeyError):
+        return None
+    colname = _single_string_col_rowlocal(node, schema)
+    if colname is None:
+        return None
+    return colname, node, node._key()
+
+
+def _inttrans_env_keys(node_key) -> Tuple[str, str]:
+    base = f"__inttransval__\x00{node_key}"
+    return base + "\x00vals", base + "\x00valid"
+
+
+def dict_int_transform_lane(table, shape, bucket: int,
+                            stage_cache: Optional[dict]):
+    """(vals, valid) integer lanes for an int-valued string transform:
+    host evaluates over the dictionary + null slot (shared
+    _eval_over_dictionary), the device gathers VALUES by source code. In
+    32-bit mode the dictionary values are range-checked exactly on host —
+    int64 results that cannot narrow to int32 decline (the wrap-safety
+    rule applied at O(unique) cost instead of a device reduction).
+    Returns None -> caller declines."""
+    colname, node, node_key = shape
+    cache_key = ("__inttranslane__", node_key, bucket, x64_enabled())
+    cached = stage_cache.get(cache_key) if stage_cache is not None else None
+    if cached is not None:
+        return cached
+    staged = stage_table_columns(table, [colname], bucket, stage_cache)
+    if staged is None:
+        return None
+    _env, dcs = staged
+    dc = dcs.get(colname)
+    if dc is None or dc.dictionary is None:
+        return None
+    uniq = dc.dictionary
+    arr = _eval_over_dictionary(colname, node, uniq)
+    if arr is None:
+        return None
+    vals_np = np.asarray(pc.fill_null(arr, 0)).astype(np.int64)
+    tvalid = np.asarray(pc.is_valid(arr), dtype=bool)
+    if not x64_enabled():
+        live = vals_np[tvalid]
+        if live.size and (live.min() < _INT32_LO or live.max() > _INT32_HI):
+            return None
+        vals_np = vals_np.astype(np.int32)
+    u = len(uniq)
+    idx = jnp.where(dc.valid, dc.values, u).astype(jnp.int32)
+    vals = jnp.asarray(vals_np)[idx]
+    valid = jnp.asarray(tvalid)[idx]
+    out = (vals, valid)
+    if stage_cache is not None:
+        stage_cache[cache_key] = out
+    return out
+
+
 def _strtransval_env_keys(node_key) -> Tuple[str, str]:
     base = f"__strtransval__\x00{node_key}"
     return base + "\x00vals", base + "\x00valid"
@@ -864,6 +933,16 @@ def string_transform_env(nodes, schema, table, bucket: int,
             merged[vk] = vals
             merged[mk] = valid
             aux[_stroutdict_aux_key(vs[2])] = tuniq
+            return True
+        ivs = _int_transform_applies(n, schema)
+        if ivs is not None:
+            lane = dict_int_transform_lane(table, ivs, bucket, stage_cache)
+            if lane is None:
+                return False
+            if merged is env:
+                merged = dict(env)
+            vk, mk = _inttrans_env_keys(ivs[2])
+            merged[vk], merged[mk] = lane
             return True
         return all(walk(c) for c in n.children())
 
@@ -1464,6 +1543,10 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
         # the whole boolean subtree evaluates over the dictionary on host;
         # nothing below it needs to compile on device
         return True
+    if _int_transform_applies(node, schema) is not None:
+        # int-valued string transform: values come from a host dictionary
+        # evaluation, gathered by code
+        return True
     if not (is_device_dtype(out_dt) or out_dt.is_null()):
         # strings ride dictionary codes: bare column passthrough, a
         # fill_null/if_else over string columns/literals whose output codes
@@ -1663,6 +1746,17 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
         # was staged by string_transform_env; decode at unstage goes
         # through the transformed dictionary (string_output_dictionary)
         vk, mk = _strtransval_env_keys(vshape[2])
+
+        def run(env, _vk=vk, _mk=mk):
+            return env[_vk], env[_mk]
+
+        return run, out_dt
+
+    ishape = _int_transform_applies(node, schema)
+    if ishape is not None:
+        # int-valued string transform (length/find/count_matches): the
+        # lane carries VALUES gathered by code, no decode needed
+        vk, mk = _inttrans_env_keys(ishape[2])
 
         def run(env, _vk=vk, _mk=mk):
             return env[_vk], env[_mk]
@@ -2136,7 +2230,8 @@ def int64_wrap_safe(nodes, schema, env, stage_cache: Optional[dict],
             r = ((isinstance(n, BinaryOp)
                   and _epoch_cmp_shape(n, schema) is not None)
                  or _string_dict_pred_applies(n, schema) is not None
-                 or _string_value_applies(n, schema) is not None)
+                 or _string_value_applies(n, schema) is not None
+                 or _int_transform_applies(n, schema) is not None)
             _lanes_memo[id(n)] = r
         return r
 

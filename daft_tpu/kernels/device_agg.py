@@ -132,7 +132,9 @@ def _stage_group_key(table, key_expr, cache):
             return None
         return dc.values, dc.valid
     # transformed-string keys: no projection-compilability gate — the
-    # transform evaluates on host over the dictionary
+    # transform evaluates on host over the dictionary. (INT-valued
+    # transforms — length/find — never reach here: _stage_key stages them
+    # as compiled int expressions through the same transform lane.)
     shape = _string_dict_value_shape(node, table.schema)
     if shape is None:
         return None

@@ -148,10 +148,25 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     env = string_lut_env([node], schema, dcs, env)
     if env is None:
         return None
+    # int-valued string transforms (length/find) inside the key compile
+    # against host dictionary-evaluated lanes
+    from .device import string_transform_env
+
+    env = string_transform_env([node], schema, table, b, cache, env, {})
+    if env is None:
+        return None
     run, _ = compile_projection([node], schema, tuple(sorted(cols)))
     (vals, valid), = run(env)
     if not jnp.issubdtype(vals.dtype, jnp.integer):
         return None
+    # a null-reviving key expression (fill_null, int transforms through the
+    # null slot) marks size-bucket PADDING lanes valid; the probe kernels
+    # mask by validity, not row count, so phantom build rows would match —
+    # force padding back invalid at THIS staging boundary (covers every
+    # compiled key shape)
+    n = len(table)
+    if int(valid.shape[0]) > n:
+        valid = valid & (jnp.arange(int(valid.shape[0]), dtype=jnp.int32) < n)
     return vals, valid
 
 

@@ -448,15 +448,38 @@ class TestDeviceJoin:
                           right_on=col("s").fill_null("zz")))
 
         dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_join_probes", 0) >= 1, _counters(dev)
         assert self._sorted_rows(dev) == self._sorted_rows(host)
         # exactly 50 'zz' matches (one real build row) — phantom padding
         # would inflate this
         assert len(dev.to_pydict()["lv"]) == len(host.to_pydict()["lv"])
 
-    def test_nonstring_transform_key_declines_device(self, host_mode):
-        """length(s) as a join key is INT-valued: it must not reach the
-        joint string dictionary (which would join 4 against '4') — the
-        device declines, host parity holds."""
+    def test_fillnull_int_key_join_no_phantom_padding(self, host_mode):
+        """Pre-existing hole the transform work surfaced: a compiled
+        fill_null INT key also revives padding validity; the _stage_key
+        boundary mask must keep phantom build rows out for every compiled
+        key shape, not just string transforms."""
+        rng = np.random.RandomState(47)
+        ldata = {"k": rng.randint(0, 3, 300).astype(np.int64),
+                 "lv": np.arange(300, dtype=np.int64)}
+        ivals = [1, None, 2]  # 3 build rows, far below any size bucket
+        rdata = {"i": dt.Series.from_pylist(ivals, "i", dt.DataType.int64()),
+                 "rv": np.arange(3, dtype=np.int64)}
+
+        def q():
+            return (dt.from_pydict(ldata)
+                    .join(dt.from_pydict(rdata), left_on="k",
+                          right_on=col("i").fill_null(0)))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_join_probes", 0) >= 1, _counters(dev)
+        assert self._sorted_rows(dev) == self._sorted_rows(host)
+
+    def test_int_transform_key_joins_as_ints_never_strings(self, host_mode):
+        """length(s) as a join key is INT-valued: it must never reach the
+        joint STRING dictionary (which would join 4 against '4'). It rides
+        the int-transform VALUE lane instead — joined against a plain int
+        column on device, with exact host parity."""
         ldata = {"s": ["a", "bb", "ccc", "dddd"] * 100,
                  "lv": np.arange(400, dtype=np.int64)}
         rdata = {"n": np.array([1, 2, 3], dtype=np.int64),
@@ -468,7 +491,10 @@ class TestDeviceJoin:
                           left_on=col("s").str.length(), right_on="n"))
 
         dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_join_probes", 0) >= 1, _counters(dev)
         assert self._sorted_rows(dev) == self._sorted_rows(host)
+        # 300 matches (lengths 1,2,3 each 100 times; length 4 unmatched)
+        assert len(dev.to_pydict()["lv"]) == 300
 
     def test_mixed_int_string_multikey_join(self, host_mode):
         rng = np.random.RandomState(31)
@@ -1691,6 +1717,47 @@ class TestStringDictPred32:
                     .groupby(col("m").is_null().alias("g"))
                     .agg(col("m").str.upper().min().alias("lo"),
                          col("m").str.upper().max().alias("hi"))
+                    .sort("g"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_aggregations", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_int_transform_projection_and_sort_on_device(self, host_mode):
+        """length(s) projects and sorts as VALUES gathered by code (no
+        recode — the lane carries the integers themselves)."""
+        data = self._sdata()
+
+        def q():
+            return (dt.from_pydict(data)
+                    .select(col("m").str.length().alias("n"), col("m"))
+                    .sort([col("m").str.length(), col("m")]))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_projections", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_int_transform_group_key_on_device(self, host_mode):
+        data = self._sdata()
+
+        def q():
+            return (dt.from_pydict(data)
+                    .groupby(col("m").str.length().alias("n"))
+                    .agg(col("v").count().alias("c"))
+                    .sort("n"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_group_codes", 0) >= 1, _counters(dev)
+        assert dev.to_pydict() == host.to_pydict()
+
+    def test_int_transform_sum_agg_on_device(self, host_mode):
+        data = self._sdata()
+
+        def q():
+            return (dt.from_pydict(data)
+                    .groupby(col("m").is_null().alias("g"))
+                    .agg(col("m").str.length().sum().alias("tot"),
+                         col("v").count().alias("c"))
                     .sort("g"))
 
         dev, host = _run_both(q, host_mode)
