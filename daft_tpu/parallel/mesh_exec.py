@@ -20,9 +20,18 @@ Generality (round-3):
   mesh device and assembled with make_array_from_single_device_arrays — the
   host never materializes the old dense [n_devices, R] global matrix.
 
-Columns whose dtype is not device-representable (strings, lists, ...) force a
-host-path shuffle for that exchange — the same Native-vs-Python storage split
-the reference keeps (SURVEY.md §7 step 1).
+STRING columns ride the exchange as int32 codes against a GLOBAL sorted
+dictionary (r5): every process contributes its local distinct values, the
+dictionaries allgather as one packed byte buffer over the jax multihost
+runtime (the DCN control channel), and every process merges them into the
+same sorted global dictionary — codes are then exchange-able ints and
+receivers decode (or keep the codes resident for downstream device string
+ops, which expect exactly this sorted-dictionary shape). High-cardinality
+columns (dictionary above _STRING_DICT_CAP values / _STRING_DICT_BYTES_CAP
+bytes globally) decline to the host shuffle — past that point shipping raw
+bytes beats syncing dictionaries. Columns that are neither device dtypes
+nor strings (lists, python objects) still force the host path — the same
+Native-vs-Python storage split the reference keeps (SURVEY.md §7 step 1).
 """
 
 from __future__ import annotations
@@ -41,6 +50,104 @@ from .collectives import build_exchange, exchange_capacity
 
 
 import functools
+
+# Global-dictionary caps for string exchange columns: above these the
+# dictionary sync would rival shipping the raw bytes, so the host shuffle
+# takes over (both sides of every process agree — the caps evaluate on
+# allgathered totals).
+_STRING_DICT_CAP = 1 << 18
+_STRING_DICT_BYTES_CAP = 16 << 20
+
+
+def _gather_global_dictionaries(local_dicts, multiproc: bool):
+    """One sorted GLOBAL dictionary (pa.Array, large_string) per string
+    column, or None when a cap trips. Single-process: sort the local
+    distincts. Multi-process: pack every column's distinct values into one
+    byte buffer + length/count arrays, allgather (2 size-agreement rounds +
+    3 data rounds over the jax multihost runtime), and merge identically on
+    every process — UTF-8 byte order equals code-point order, so python
+    sorted() and pyarrow's binary sort agree."""
+    import pyarrow as pa
+
+    if not multiproc:
+        out = []
+        total_vals = 0
+        total_bytes = 0
+        for d in local_dicts:
+            import pyarrow.compute as pc
+
+            srt = d.take(pc.sort_indices(d)) if len(d) else d
+            total_vals += len(srt)
+            total_bytes += srt.nbytes
+            out.append(srt)
+        if total_vals > _STRING_DICT_CAP or total_bytes > _STRING_DICT_BYTES_CAP:
+            return None
+        return out
+
+    from jax.experimental import multihost_utils
+
+    enc: List[bytes] = []
+    counts = []
+    for d in local_dicts:
+        vals = d.to_pylist()
+        counts.append(len(vals))
+        enc.extend(v.encode("utf-8") for v in vals)
+    lens = np.array([len(b) for b in enc], dtype=np.int64)
+    buf = (np.frombuffer(b"".join(enc), dtype=np.uint8)
+           if enc else np.zeros(0, np.uint8))
+    header = np.array([len(buf), len(lens)], dtype=np.int64)
+    sizes = np.asarray(multihost_utils.process_allgather(header))  # [P, 2]
+    if (int(sizes[:, 1].sum()) > _STRING_DICT_CAP
+            or int(sizes[:, 0].sum()) > _STRING_DICT_BYTES_CAP):
+        return None  # agreed on every process: sizes are global
+    maxb = max(int(sizes[:, 0].max()), 1)
+    maxn = max(int(sizes[:, 1].max()), 1)
+    pb = np.zeros(maxb, np.uint8)
+    pb[:len(buf)] = buf
+    pl = np.full(maxn, -1, np.int64)
+    pl[:len(lens)] = lens
+    gb = np.asarray(multihost_utils.process_allgather(pb))
+    gl = np.asarray(multihost_utils.process_allgather(pl))
+    gc = np.asarray(multihost_utils.process_allgather(
+        np.array(counts, dtype=np.int64)))
+    ncols = len(local_dicts)
+    per_col = [set() for _ in range(ncols)]
+    for p in range(gb.shape[0]):
+        pos = 0
+        item = 0
+        pbuf = gb[p].tobytes()
+        for cidx in range(ncols):
+            for _ in range(int(gc[p, cidx])):
+                ln = int(gl[p, item])
+                item += 1
+                per_col[cidx].add(pbuf[pos:pos + ln].decode("utf-8"))
+                pos += ln
+    return [pa.array(sorted(s), type=pa.large_string()) for s in per_col]
+
+
+def exchangeable_dtype(dt) -> bool:
+    """Dtypes the device exchange can ship: native device dtypes, plus
+    strings (as codes against a global sorted dictionary)."""
+    return is_device_dtype(dt) or dt.is_string()
+
+
+def _stage_global_codes(series, global_dict, r: int):
+    """(vals int32 [r], valid bool [r]) for a string column as codes into
+    the GLOBAL sorted dictionary (every value is present by construction —
+    the dictionary is the union of all contributions)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    arr = series.to_arrow()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    codes = pc.index_in(arr.cast(pa.large_string()), value_set=global_dict)
+    vals = np.zeros(r, dtype=np.int32)
+    valid = np.zeros(r, dtype=bool)
+    n = len(arr)
+    vals[:n] = np.asarray(pc.fill_null(codes, 0), dtype=np.int32)
+    valid[:n] = np.asarray(pc.is_valid(codes), dtype=bool)
+    return vals, valid
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -138,8 +245,10 @@ class MeshExecutionContext(ExecutionContext):
         if scheme == "range" and boundaries is None:
             return None
         schema = parts[0].schema
-        if any(not is_device_dtype(f.dtype) for f in schema):
+        if any(not (is_device_dtype(f.dtype) or f.dtype.is_string())
+               for f in schema):
             return None
+        str_idx = [j for j, f in enumerate(schema) if f.dtype.is_string()]
         from ..schema import Schema
         from ..table import Table, _composite_rank
 
@@ -179,6 +288,27 @@ class MeshExecutionContext(ExecutionContext):
         step = -(-total // nchunks) if total else 0
         chunks = [merged.slice(min(i * step, total), min((i + 1) * step, total))
                   for i in range(nchunks)]
+        # String columns exchange as codes against GLOBAL sorted
+        # dictionaries agreed across every process (see module docstring);
+        # the agreement must run on every process in the same order even
+        # when this process's contribution is empty.
+        global_dicts = {}
+        if str_idx:
+            import pyarrow as pa
+            import pyarrow.compute as pc
+
+            fields = list(schema)
+            local_dicts = []
+            for j in str_idx:
+                arr = merged.get_column(fields[j].name).to_arrow()
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+                local_dicts.append(
+                    pc.unique(arr.drop_null()).cast(pa.large_string()))
+            gds = _gather_global_dictionaries(local_dicts, multiproc)
+            if gds is None:
+                return None  # cap tripped (agreed globally)
+            global_dicts = dict(zip(str_idx, gds))
         # Control plane: per-row destination PARTITION, computed with the host
         # kernels (identical assignment to the host shuffle path).
         k = len(by or [])
@@ -250,7 +380,11 @@ class MeshExecutionContext(ExecutionContext):
                     lm[:len(c)] = part_buckets[i]
                     lane_shards.append(jax.device_put(lm[None], dev))
                 for j, name in enumerate(names):
-                    vals, valid, _ = stage_np(c.get_column(name), r)
+                    if j in global_dicts:
+                        vals, valid = _stage_global_codes(
+                            c.get_column(name), global_dicts[j], r)
+                    else:
+                        vals, valid, _ = stage_np(c.get_column(name), r)
                     col_trailing[j] = tuple(vals.shape[1:])
                     col_dtypes[j] = vals.dtype
                     col_shards[j].append(jax.device_put(vals[None], dev))
@@ -360,7 +494,12 @@ class MeshExecutionContext(ExecutionContext):
                 flat = slab.reshape((-1,) + tuple(slab.shape[2:]))
                 nulls = _slab(1 + ncols + j, d).reshape(-1)
                 pv, pn = _pack_slab(flat, nulls, sel, bucket)
-                dc = DeviceColumn(pv, pn, cnt, f.dtype)
+                # string columns arrive as codes into the GLOBAL sorted
+                # dictionary — decode at unstage, and the seeded residency
+                # below hands downstream device string ops exactly the
+                # sorted-dictionary shape they expect
+                dc = DeviceColumn(pv, pn, cnt, f.dtype,
+                                  dictionary=global_dicts.get(j))
                 staged.append(dc)
                 series_out.append(unstage(dc).rename(f.name))
             part = MicroPartition.from_table(Table(Schema(list(schema)), series_out))

@@ -432,9 +432,9 @@ class ShuffleOp(PhysicalOp):
             if self.scheme == "range":
                 # cheap dtype-eligibility gate BEFORE the sampling work; the
                 # sampled boundaries are reused by the host fallback below
-                from .kernels.device import is_device_dtype
+                from .parallel.mesh_exec import exchangeable_dtype
 
-                if all(is_device_dtype(f.dtype) for f in parts[0].schema):
+                if all(exchangeable_dtype(f.dtype) for f in parts[0].schema):
                     samples = [sample_partition_keys(p, self.by, n,
                                                      ctx.cfg.sample_size_for_sort)
                                for p in parts]
@@ -921,7 +921,7 @@ class SortMergeJoinOp(PhysicalOp):
         # with its columns left HBM-resident for the per-bucket merge.
         dev_shuffle = getattr(ctx, "try_device_shuffle", None)
         if dev_shuffle is not None:
-            from .kernels.device import is_device_dtype
+            from .parallel.mesh_exec import exchangeable_dtype
 
             lparts = lbuf.parts()
             rparts = rbuf.parts()
@@ -929,8 +929,8 @@ class SortMergeJoinOp(PhysicalOp):
             rrows = sum(len(p) for p in rparts)
             eligible = (lrows > 0 and rrows > 0  # empty sides: host handles
                         and all(p.is_loaded() for p in lparts + rparts)
-                        and all(is_device_dtype(f.dtype) for f in lschema)
-                        and all(is_device_dtype(f.dtype) for f in rschema))
+                        and all(exchangeable_dtype(f.dtype) for f in lschema)
+                        and all(exchangeable_dtype(f.dtype) for f in rschema))
             if eligible:
                 zeros, nf = [False] * k, [None] * k
                 # exchange the SMALLER side first: a late ineligibility only

@@ -101,8 +101,9 @@ tables = tpch.generate_tables(scale=0.02, seed=42)
 cust = dtp.from_arrow(tables["customer"]).repartition(4, "c_custkey").collect()
 orders = dtp.from_arrow(tables["orders"]).repartition(4, "o_custkey").collect()
 nat = dtp.from_arrow(tables["nation"]).collect()
-# numeric-only projection so the lineitem repartition rides the DEVICE
-# exchange (string payloads take the host shuffle, the documented split)
+# numeric-only projection keeps this phase focused on the pure-int lane
+# path (string payloads also ride the device exchange since r5 — the
+# dedicated STRINGPAYLOAD phase below covers that route)
 line = (dtp.from_arrow(tables["lineitem"])
         .select(col("l_orderkey"), col("l_extendedprice"), col("l_discount"))
         .repartition(4, "l_orderkey"))
@@ -254,3 +255,31 @@ assert opened3 <= (1 if pid == 0 else 0) + 1, (
 _assert_groupby_sum(coll4, k3, v3, "k", "s", "single-owner")
 shutil.rmtree(scan_dir3, ignore_errors=True)
 print(f"MULTIHOST_EMPTYLOCAL_OK {pid}", flush=True)
+
+# ---------------------------------------------------------------------------
+# String payloads over DCN (r5): the string column rides the exchange as
+# int32 codes against a GLOBAL dictionary allgathered across the two
+# processes; nulls survive, and every process reconstitutes the full rows.
+# ---------------------------------------------------------------------------
+rng5 = np.random.RandomState(31)
+svals = [None if i % 19 == 0 else f"name-{i % 23}" for i in range(4000)]
+sk = rng5.randint(0, 16, 4000).astype(np.int64)
+sdf = (dtp.from_pydict({
+    "g": dtp.Series.from_pylist(svals, "g", dtp.DataType.string()),
+    "k": sk}).repartition(8, "k"))
+scoll = (sdf.groupby("g").agg(col("k").count().alias("c")).sort("g")).collect()
+assert scoll.stats.snapshot()["counters"].get("device_shuffles", 0) >= 1, (
+    f"string payload fell back to host shuffle: {scoll.stats.snapshot()}")
+acc5 = collections.defaultdict(int)
+for g in svals:
+    acc5[g] += 1
+sd = scoll.to_pydict()
+want_keys = sorted(k for k in acc5 if k is not None)
+got_nonnull = [k for k in sd["g"] if k is not None]
+assert got_nonnull == want_keys, (got_nonnull[:5], want_keys[:5])
+want_counts = [acc5[k] for k in want_keys]
+got_counts = [c for k, c in zip(sd["g"], sd["c"]) if k is not None]
+assert got_counts == want_counts
+if None in sd["g"]:
+    assert sd["c"][sd["g"].index(None)] == acc5[None]
+print(f"MULTIHOST_STRINGPAYLOAD_OK {pid}", flush=True)

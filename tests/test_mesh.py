@@ -84,15 +84,52 @@ def test_mesh_groupby_agg_parity():
     assert host["c"] == mesh["c"]
 
 
-def test_mesh_shuffle_with_nulls_and_strings_falls_back():
-    # string payload is not device-representable -> host fallback, same result
+def test_mesh_shuffle_string_payload_rides_device_exchange():
+    # r5: string payloads exchange as codes against a GLOBAL sorted
+    # dictionary — no host fallback, identical rows (nulls in keys AND the
+    # string column itself)
+    svals = [None if i % 31 == 0 else f"row{i % 97}" for i in range(400)]
     df = daft_tpu.from_pydict({
         "k": [1, 2, None, 4, 5, None, 7, 8] * 50,
-        "s": [f"row{i}" for i in range(400)],
+        "s": dt_series(svals),
     }).repartition(8, col("k"))
     host = NativeRunner().run(df._plan).to_table().to_arrow()
-    mesh = MeshRunner(default_mesh(8)).run(df._plan).to_table().to_arrow()
-    assert mesh.sort_by("s").equals(host.sort_by("s"))
+    stats_ctx = MeshExecutionContext(daft_tpu.context.get_context().execution_config,
+                                     mesh=default_mesh(8))
+    from daft_tpu.execution import execute_plan
+    from daft_tpu.optimizer import optimize
+    from daft_tpu.physical import translate
+
+    parts = list(execute_plan(translate(optimize(df._plan), stats_ctx.cfg),
+                              stats_ctx))
+    assert stats_ctx.stats.counters.get("device_shuffles", 0) >= 1
+    allrows = pa.concat_tables([p.to_arrow() for p in parts])
+    assert (allrows.sort_by([("k", "ascending"), ("s", "ascending")])
+            .equals(host.sort_by([("k", "ascending"), ("s", "ascending")])))
+
+
+def dt_series(vals):
+    return daft_tpu.Series.from_pylist(vals, "s", daft_tpu.DataType.string())
+
+
+def test_mesh_shuffle_high_cardinality_string_falls_back():
+    # dictionary cap: a column with unique-per-row strings above the cap
+    # would cost more to sync than to ship; the host path takes it (parity
+    # preserved). Cap check is monkeypatched low to keep the test small.
+    import daft_tpu.parallel.mesh_exec as me
+
+    old = me._STRING_DICT_CAP
+    me._STRING_DICT_CAP = 16
+    try:
+        df = daft_tpu.from_pydict({
+            "k": [1, 2, 3, 4] * 50,
+            "s": [f"unique-{i}" for i in range(200)],
+        }).repartition(8, col("k"))
+        host = NativeRunner().run(df._plan).to_table().to_arrow()
+        mesh = MeshRunner(default_mesh(8)).run(df._plan).to_table().to_arrow()
+        assert mesh.sort_by("s").equals(host.sort_by("s"))
+    finally:
+        me._STRING_DICT_CAP = old
 
 
 def test_mesh_shuffle_null_keys_device_path():

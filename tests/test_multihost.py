@@ -65,4 +65,5 @@ def test_two_process_cluster_exchange_and_q5():
         # one-file case: a process with zero local rows still participates
         # in the negotiated exchange and reconstitutes the full result
         assert f"MULTIHOST_EMPTYLOCAL_OK {i}" in out, out
+        assert f"MULTIHOST_STRINGPAYLOAD_OK {i}" in out, out
     assert opened_total >= 8, f"workers together opened {opened_total} < 8"
