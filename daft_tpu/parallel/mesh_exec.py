@@ -70,15 +70,20 @@ def _gather_global_dictionaries(local_dicts, multiproc: bool):
     import pyarrow as pa
 
     if not multiproc:
+        import pyarrow.compute as pc
+
         out = []
         total_vals = 0
         total_bytes = 0
         for d in local_dicts:
-            import pyarrow.compute as pc
-
             srt = d.take(pc.sort_indices(d)) if len(d) else d
             total_vals += len(srt)
-            total_bytes += srt.nbytes
+            # value bytes only — the SAME measure the multi-process branch
+            # sums (encoded payload), so cap eligibility cannot differ
+            # between one host and a cluster on identical data
+            total_bytes += int(pc.binary_length(srt.cast(pa.large_binary()))
+                               .cast(pa.int64()).sum().as_py() or 0) \
+                if len(srt) else 0
             out.append(srt)
         if total_vals > _STRING_DICT_CAP or total_bytes > _STRING_DICT_BYTES_CAP:
             return None
@@ -127,8 +132,11 @@ def _gather_global_dictionaries(local_dicts, multiproc: bool):
 
 def exchangeable_dtype(dt) -> bool:
     """Dtypes the device exchange can ship: native device dtypes, plus
-    strings (as codes against a global sorted dictionary)."""
-    return is_device_dtype(dt) or dt.is_string()
+    strings (as codes against a global sorted dictionary) — the same rule
+    as per-partition staging, defined once."""
+    from ..kernels.device import stageable_dtype
+
+    return stageable_dtype(dt)
 
 
 def _stage_global_codes(series, global_dict, r: int):
@@ -245,8 +253,7 @@ class MeshExecutionContext(ExecutionContext):
         if scheme == "range" and boundaries is None:
             return None
         schema = parts[0].schema
-        if any(not (is_device_dtype(f.dtype) or f.dtype.is_string())
-               for f in schema):
+        if any(not exchangeable_dtype(f.dtype) for f in schema):
             return None
         str_idx = [j for j, f in enumerate(schema) if f.dtype.is_string()]
         from ..schema import Schema

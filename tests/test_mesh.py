@@ -162,6 +162,32 @@ def test_mesh_sort_parity():
     assert host == mesh
 
 
+def test_mesh_global_sort_string_key_device_path():
+    # r5: a STRING sort key rides the range exchange — boundaries sample
+    # host-side, codes against the global dictionary ship over the mesh,
+    # per-device sorts concatenate to the exact global order (nulls incl.)
+    rng = np.random.RandomState(13)
+    words = [None if i % 29 == 0 else f"w{rng.randint(0, 200):03d}"
+             for i in range(1500)]
+    df = (daft_tpu.from_pydict({
+            "s": dt_series(words),
+            "v": np.arange(1500, dtype=np.int64)})
+          .repartition(4)
+          .sort([col("s"), col("v")]))
+    stats_ctx = MeshExecutionContext(daft_tpu.context.get_context().execution_config,
+                                     mesh=default_mesh(8))
+    from daft_tpu.execution import execute_plan
+    from daft_tpu.optimizer import optimize
+    from daft_tpu.physical import translate
+
+    parts = list(execute_plan(translate(optimize(df._plan), stats_ctx.cfg),
+                              stats_ctx))
+    assert stats_ctx.stats.counters.get("device_shuffles", 0) >= 1
+    got = [r for p in parts for r in p.to_pydict()["v"]]
+    want = NativeRunner().run(df._plan).to_table().to_pydict()["v"]
+    assert got == want
+
+
 def test_mesh_shuffle_fewer_rows_than_devices():
     # regression: re-chunk slice must clamp start when rows < n_devices
     df = daft_tpu.from_pydict({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]}).repartition(8, col("k"))
