@@ -78,9 +78,11 @@ def _gather_global_dictionaries(local_dicts, multiproc: bool):
         for d in local_dicts:
             srt = d.take(pc.sort_indices(d)) if len(d) else d
             total_vals += len(srt)
-            # value bytes only — the SAME measure the multi-process branch
-            # sums (encoded payload), so cap eligibility cannot differ
-            # between one host and a cluster on identical data
+            # value bytes only — the same UNIT the multi-process branch
+            # sums (encoded payload). Note the multiproc branch sums
+            # pre-merge per-process distincts, so a value present on all P
+            # processes counts P times there: near the caps a cluster can
+            # decline where one host proceeds (conservative, never unsound)
             total_bytes += int(pc.binary_length(srt.cast(pa.large_binary()))
                                .cast(pa.int64()).sum().as_py() or 0) \
                 if len(srt) else 0

@@ -1,0 +1,84 @@
+"""Slim worker for the 4-process cluster test: one process of a 4-process
+jax distributed cluster, 2 virtual CPU devices each (8 global). Proves the
+DCN story scales past 2 processes: the full engine shuffle (device
+exchange + allgather reconvergence) with a STRING payload and a grouped
+aggregation, against an exact oracle computed from the full dataset.
+
+Run: python multihost_worker4.py <process_id> <num_processes> <port>
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import collections  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from daft_tpu.parallel.multihost import global_mesh, init_distributed  # noqa: E402
+
+assert init_distributed(f"localhost:{port}", nproc, pid)
+assert len(jax.devices()) == 2 * nproc
+assert len(jax.local_devices()) == 2
+
+mesh = global_mesh()
+
+import daft_tpu as dtp  # noqa: E402
+from daft_tpu import col  # noqa: E402
+from daft_tpu.context import get_context  # noqa: E402
+from daft_tpu.runners import MeshRunner  # noqa: E402
+
+ctx = get_context()
+ctx._runner = MeshRunner(mesh=mesh)
+cfg = ctx.execution_config
+cfg.use_device_kernels = True
+cfg.device_min_rows = 1
+cfg.enable_result_cache = False
+cfg.executor_threads = 1  # SPMD discipline: identical collective order
+
+# identical control plane on every process (same seed)
+rng = np.random.RandomState(5)
+svals = [None if i % 17 == 0 else f"g{i % 29:02d}" for i in range(6000)]
+keys = rng.randint(0, 32, 6000).astype(np.int64)
+vals = rng.rand(6000)
+
+df = (dtp.from_pydict({
+    "g": dtp.Series.from_pylist(svals, "g", dtp.DataType.string()),
+    "k": keys, "v": vals})
+    .repartition(8, "k")
+    .groupby("g").agg(col("v").sum().alias("s"), col("v").count().alias("c"))
+    .sort("g"))
+coll = df.collect()
+shuffles = coll.stats.snapshot()["counters"].get("device_shuffles", 0)
+assert shuffles >= 1, f"device exchange never engaged: {coll.stats.snapshot()}"
+
+acc_s = collections.defaultdict(float)
+acc_c = collections.defaultdict(int)
+for g, v in zip(svals, vals):
+    acc_s[g] += v
+    acc_c[g] += 1
+gd = coll.to_pydict()
+want_keys = sorted(k for k in acc_c if k is not None)
+got_nonnull = [k for k in gd["g"] if k is not None]
+assert got_nonnull == want_keys, (got_nonnull[:5], want_keys[:5])
+for g, s, c in zip(gd["g"], gd["s"], gd["c"]):
+    assert c == acc_c[g], (g, c, acc_c[g])
+    # x64 off in this worker (real-TPU config): f64 sums compute as f32
+    assert abs(s - acc_s[g]) <= max(1e-5 * abs(acc_s[g]), 1e-6), (g, s)
+
+print(f"MULTIHOST4_OK {pid} shuffles={shuffles}", flush=True)

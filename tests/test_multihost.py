@@ -67,3 +67,31 @@ def test_two_process_cluster_exchange_and_q5():
         assert f"MULTIHOST_EMPTYLOCAL_OK {i}" in out, out
         assert f"MULTIHOST_STRINGPAYLOAD_OK {i}" in out, out
     assert opened_total >= 8, f"workers together opened {opened_total} < 8"
+
+
+def test_four_process_cluster_string_shuffle():
+    """The DCN story past two processes: a 4-process cluster (2 devices
+    each, 8 global) runs the full engine shuffle with a string payload —
+    global-dictionary allgather across four contributors — plus grouped
+    aggregation, against an exact oracle."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker4.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), "4", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for i in range(4)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("4-process worker timed out")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"MULTIHOST4_OK {i}" in out, out
