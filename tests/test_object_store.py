@@ -480,24 +480,43 @@ class TestUrlUpload:
     def test_upload_respects_connection_budget(self, monkeypatch, mock_s3):
         from daft_tpu.io import object_store as osm
 
-        MockS3Handler.max_inflight = 0
-        MockS3Handler.inflight = 0
         budget = osm.IOClient(
             s3_config=osm.S3Config(endpoint_url=mock_s3, anonymous=True),
             max_connections=2)
         # pin the injected client: default_io_client() would rebuild from
         # env and silently bypass the budget under test
         monkeypatch.setattr(osm, "default_io_client", lambda: budget)
+        # measure concurrency INSIDE the client's semaphore section: the
+        # server-side inflight high-water is inherently racy (its window
+        # outlives the semaphore hold by the response-teardown interval,
+        # a reproducible flake on the 1-core host). Wrapping the cached
+        # source's put is deterministic — and proves url_upload routes
+        # through the budgeted client at all.
+        src = budget.source_for("s3://bkt/budget")
+        orig_put = src.put
+        lk = threading.Lock()
+        state = {"cur": 0, "peak": 0, "calls": 0}
+
+        def counted_put(*a, **k):
+            with lk:
+                state["cur"] += 1
+                state["calls"] += 1
+                state["peak"] = max(state["peak"], state["cur"])
+            try:
+                return orig_put(*a, **k)
+            finally:
+                with lk:
+                    state["cur"] -= 1
+
+        monkeypatch.setattr(src, "put", counted_put)
         from daft_tpu.multimodal import url_upload
         from daft_tpu.series import Series
 
         s = Series.from_pylist([b"x" * 100] * 12, "data")
         out = url_upload(s, "s3://bkt/budget", max_connections=8)
         assert all(p is not None for p in out.to_pylist())
-        # +1 teardown slack, same rationale as test_connection_budget
-        assert MockS3Handler.max_inflight <= 3
-        # the mock tracks PUT traffic, so the assertion is not vacuous
-        assert MockS3Handler.put_count >= 12
+        assert state["calls"] >= 12  # every row went through the client
+        assert 1 <= state["peak"] <= 2  # the budget held, non-vacuously
 
     def test_upload_local_is_concurrent_capable(self, tmp_path):
         from daft_tpu.multimodal import url_upload
