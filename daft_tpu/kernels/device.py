@@ -339,6 +339,11 @@ def _literal_fits_device(lit) -> bool:
 
 _CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
 _CMP_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}
+_CMP_FNS = {
+    "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+}
 
 
 def _plain_column(node, schema, pred) -> Optional[str]:
@@ -902,6 +907,101 @@ def _strtransval_env_keys(node_key) -> Tuple[str, str]:
 
 def _stroutdict_aux_key(node_key):
     return ("__stroutdict__", node_key)
+
+
+def _transform_cmp_shape(node, schema):
+    """(lside, rside, op) for a comparison whose sides are string-valued
+    and column-backed over TWO DIFFERENT columns with at least one side a
+    row-local TRANSFORM — `upper(s1) == s2`, `lstrip(a) < rstrip(b)`.
+    Plain-vs-plain belongs to the col-vs-col joint-group machinery and
+    single-column trees (incl. vs-literal) to the dictionary predicate, so
+    this shape claims exactly the residual. Each side is
+    ("col", colname, None) or ("trans", colname, side_node); the sides
+    recode through a PAIRWISE sorted joint dictionary (transform side: its
+    transformed dictionary) and compare as ints — sorted joint codes are
+    order-isomorphic, so inequalities hold too."""
+    from ..expressions import BinaryOp
+
+    if not (isinstance(node, BinaryOp) and node.op in _CMP_OPS):
+        return None
+
+    def side(n):
+        c = _plain_string_column(n, schema)
+        if c is not None:
+            return ("col", c, None)
+        vs = _string_value_applies(n, schema)
+        if vs is not None:
+            return ("trans", vs[0], n)
+        return None
+
+    ls, rs = side(node.left), side(node.right)
+    if ls is None or rs is None:
+        return None
+    if ls[0] == "col" and rs[0] == "col":
+        return None  # the existing col-vs-col joint group owns this
+    if ls[1] == rs[1]:
+        return None  # one column: the dictionary predicate owns this
+    return ls, rs, node.op
+
+
+def _transcmp_env_keys(node_key) -> Tuple[str, str]:
+    base = f"__transcmp__\x00{node_key}"
+    return base + "\x00lremap", base + "\x00rremap"
+
+
+def transform_cmp_env(nodes, schema, table, bucket: int,
+                      stage_cache: Optional[dict], dcs, env: dict,
+                      aux: dict) -> Optional[dict]:
+    """Merge pairwise joint-dictionary remaps for every cross-column
+    transform compare. Runs AFTER string_transform_env: a transform side's
+    lane and transformed dictionary are already staged (env/aux); a plain
+    side's codes and dictionary are in dcs. Returns env (possibly
+    unchanged) or None -> decline to host."""
+    from ..expressions import BinaryOp
+
+    merged = env
+
+    def side_dict(s):
+        kind, colname, n = s
+        if kind == "col":
+            dc = dcs.get(colname)
+            return None if dc is None or dc.dictionary is None \
+                else dc.dictionary
+        return aux.get(_stroutdict_aux_key(n._key()))
+
+    def walk(n):
+        nonlocal merged
+        if isinstance(n, BinaryOp):
+            shape = _transform_cmp_shape(n, schema)
+            if shape is not None:
+                ls, rs, _op = shape
+                lk, rk = _transcmp_env_keys(n._key())
+                if lk in merged:
+                    return True
+                cache_key = ("__transcmp__", n._key(), bucket)
+                cached = (stage_cache.get(cache_key)
+                          if stage_cache is not None else None)
+                if cached is None:
+                    ld, rd = side_dict(ls), side_dict(rs)
+                    if ld is None or rd is None:
+                        return False
+                    joint = pc.unique(pa.concat_arrays(
+                        [ld.cast(pa.large_string()),
+                         rd.cast(pa.large_string())]))
+                    joint = joint.take(pc.sort_indices(joint))
+                    cached = (joint_remap(ld, joint), joint_remap(rd, joint))
+                    if stage_cache is not None:
+                        stage_cache[cache_key] = cached
+                if merged is env:
+                    merged = dict(env)
+                merged[lk], merged[rk] = cached
+                return True
+        return all(walk(c) for c in n.children())
+
+    for nd in nodes:
+        if not walk(nd):
+            return None
+    return merged
 
 
 def string_transform_env(nodes, schema, table, bucket: int,
@@ -1604,6 +1704,10 @@ def expr_is_device_compilable(node, schema, _normalized: bool = False) -> bool:
         # under x64 the generic int64 path below handles them
         if not x64_enabled() and _epoch_cmp_shape(node, schema) is not None:
             return True
+        # cross-column transform compares recode through a pairwise joint
+        # dictionary (transform_cmp_env)
+        if _transform_cmp_shape(node, schema) is not None:
+            return True
         # any OTHER op touching a string child (col vs col: codes come
         # from different dictionaries) must stay host
         if any_string_child(node):
@@ -1973,6 +2077,34 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
                 return _two_lane_cmp(_op, hi, lo, rhi, rlo), lm & rm
 
             return run, out_dt
+        tshape = _transform_cmp_shape(node, schema)
+        if tshape is not None:
+            ls, rs, cop = tshape
+            lk, rk = _transcmp_env_keys(node._key())
+
+            def _lane_reader(s):
+                kind, colname, n = s
+                if kind == "col":
+                    def read(env, _c=colname):
+                        return env[_c]
+                else:
+                    vk, mk = _strtransval_env_keys(n._key())
+
+                    def read(env, _vk=vk, _mk=mk):
+                        return env[_vk], env[_mk]
+                return read
+
+            lread, rread = _lane_reader(ls), _lane_reader(rs)
+            cmp_fn = _CMP_FNS[cop]
+
+            def run(env, _lr=lread, _rr=rread, _lk=lk, _rk=rk, _f=cmp_fn):
+                lv, lm = _lr(env)
+                rv, rm = _rr(env)
+                lj = env[_lk][lv]
+                rj = env[_rk][rv]
+                return _f(lj, rj), lm & rm
+
+            return run, out_dt
         lf, ldt = _compile_node(node.left, schema)
         rf, rdt = _compile_node(node.right, schema)
         op = node.op
@@ -1998,13 +2130,8 @@ def _compile_node(node, schema) -> "Tuple[callable, DataType]":
 
             return run, out_dt
 
-        cmp_fns = {
-            "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
-            "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
-            ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
-        }
-        if op in cmp_fns:
-            fn = cmp_fns[op]
+        if op in _CMP_FNS:
+            fn = _CMP_FNS[op]
 
             def run(env, _l=lf, _r=rf, _fn=fn):
                 lv, lm = _l(env)
@@ -2363,6 +2490,10 @@ def _stage_and_run(table, exprs, stage_cache: Optional[dict]):
     if env is None:
         return None
     env = string_transform_env(nodes, schema, table, b, stage_cache, env, aux)
+    if env is None:
+        return None
+    env = transform_cmp_env(nodes, schema, table, b, stage_cache, dcs, env,
+                            aux)
     if env is None:
         return None
     run, out_dts = compile_projection(nodes, schema, tuple(sorted(needed)))

@@ -366,6 +366,12 @@ def device_grouped_agg_async(table, to_agg, group_by,
                                env, joint_aux)
     if env is None:
         return None  # a transformed-string lane failed to stage
+    from .device import transform_cmp_env
+
+    env = transform_cmp_env(check_nodes, schema, table, b, stage_cache, dcs,
+                            env, joint_aux)
+    if env is None:
+        return None  # a cross-column transform compare lost a dictionary
 
     # --- compile + run ONE fused program ---------------------------------
     from ..context import get_context

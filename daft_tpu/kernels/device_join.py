@@ -149,10 +149,17 @@ def _stage_key(table, key_expr, cache) -> Optional[Tuple]:
     if env is None:
         return None
     # int-valued string transforms (length/find) inside the key compile
-    # against host dictionary-evaluated lanes
-    from .device import string_transform_env
+    # against host dictionary-evaluated lanes; cross-column transform
+    # compares (e.g. (upper(a) == b).cast(int) keys) need their pairwise
+    # joint remaps too — aux is SHARED so the compare env can see the
+    # transform sides' dictionaries
+    from .device import string_transform_env, transform_cmp_env
 
-    env = string_transform_env([node], schema, table, b, cache, env, {})
+    aux: dict = {}
+    env = string_transform_env([node], schema, table, b, cache, env, aux)
+    if env is None:
+        return None
+    env = transform_cmp_env([node], schema, table, b, cache, dcs, env, aux)
     if env is None:
         return None
     run, _ = compile_projection([node], schema, tuple(sorted(cols)))

@@ -496,6 +496,30 @@ class TestDeviceJoin:
         # 300 matches (lengths 1,2,3 each 100 times; length 4 unmatched)
         assert len(dev.to_pydict()["lv"]) == 300
 
+    def test_join_key_embedding_cross_column_compare(self, host_mode):
+        """An int join key whose expression embeds a cross-column transform
+        compare — (upper(a) == b).cast(int) — compiles against the pairwise
+        joint remaps inside _stage_key (the compare env is wired there too)
+        and takes the device probe with host parity."""
+        rng = np.random.RandomState(53)
+        n = 2000
+        a = np.array(["x", "X", "y", "z"])[rng.randint(0, 4, n)].tolist()
+        b = np.array(["X", "Y", "Z"])[rng.randint(0, 3, n)].tolist()
+        ldata = {"a": dt.Series.from_pylist(a, "a", dt.DataType.string()),
+                 "b": dt.Series.from_pylist(b, "b", dt.DataType.string()),
+                 "lv": np.arange(n, dtype=np.int64)}
+        rdata = {"m": np.array([0, 1], dtype=np.int64),
+                 "tag": ["miss", "hit"]}
+        key = (col("a").str.upper() == col("b")).if_else(1, 0)
+
+        def q():
+            return (dt.from_pydict(ldata)
+                    .join(dt.from_pydict(rdata), left_on=key, right_on="m"))
+
+        dev, host = _run_both(q, host_mode)
+        assert _counters(dev).get("device_join_probes", 0) >= 1, _counters(dev)
+        assert self._sorted_rows(dev) == self._sorted_rows(host)
+
     def test_mixed_int_string_multikey_join(self, host_mode):
         rng = np.random.RandomState(31)
         ldata = {"a": rng.randint(0, 20, 3000).astype(np.int64),
@@ -1680,6 +1704,48 @@ class TestStringDictPred32:
         d = sorted((x is None, x) for x in dev.to_pydict()["k"])
         h = sorted((x is None, x) for x in host.to_pydict()["k"])
         assert d == h
+
+    def test_cross_column_transform_compares_on_device(self, host_mode):
+        """upper(s1) vs s2 and transform-vs-transform across DIFFERENT
+        columns recode through a pairwise sorted joint dictionary; sorted
+        joint codes are order-isomorphic, so inequalities hold too."""
+        rng = np.random.RandomState(67)
+        n = 9000
+        a = np.array(["mail", "MAIL", " ship", "air", "rail"])[
+            rng.randint(0, 5, n)].tolist()
+        b = np.array(["MAIL", "SHIP", "AIR", "RAIL", "TRUCK"])[
+            rng.randint(0, 5, n)].tolist()
+        for i in range(0, n, 73):
+            a[i] = None
+        for i in range(0, n, 97):
+            b[i] = None
+        data = {"a": dt.Series.from_pylist(a, "a", dt.DataType.string()),
+                "b": dt.Series.from_pylist(b, "b", dt.DataType.string()),
+                "v": rng.rand(n)}
+        for name, build in [
+            ("upper_eq_col", lambda: dt.from_pydict(data).where(
+                col("a").str.lstrip().str.upper() == col("b"))),
+            ("trans_lt_trans", lambda: dt.from_pydict(data).where(
+                col("a").str.upper() < col("b").str.lstrip())),
+            ("ne_projection", lambda: dt.from_pydict(data).select(
+                (col("a").str.upper() != col("b")).alias("d"), col("v"))),
+            ("fused_agg", lambda: dt.from_pydict(data).where(
+                col("a").str.lstrip().str.upper() >= col("b"))
+                .agg(col("v").count().alias("c"))),
+        ]:
+            dev, host = _run_both(build, host_mode)
+            ctr = _counters(dev)
+            engaged = (ctr.get("device_filters", 0)
+                       + ctr.get("device_projections", 0)
+                       + ctr.get("device_aggregations", 0))
+            assert engaged >= 1, (name, ctr)
+            d, h = dev.to_pydict(), host.to_pydict()
+            if "d" in d:
+                assert d["d"] == h["d"], name
+            elif "c" in d:
+                assert d["c"] == h["c"], name
+            else:
+                assert d["a"] == h["a"] and d["b"] == h["b"], name
 
     def test_transformed_string_projection_on_device(self, host_mode):
         """select(upper(strip(s))) produces the transformed VALUES on

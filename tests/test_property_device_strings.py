@@ -102,6 +102,25 @@ def test_transform_sort_parity(case, tname):
     assert got == want
 
 
+@given(_two_cols(), st.sampled_from(["==", "!=", "<", "<=", ">", ">="]),
+       st.sampled_from(sorted(_TRANSFORMS)))
+@settings(max_examples=60, deadline=None)
+def test_cross_column_transform_compare_parity(case, op, tname):
+    """upper(a) OP b across different columns: pairwise joint-dictionary
+    recode — parity over randomized unicode/null/empty pools."""
+    a, b = case
+
+    def build():
+        l = _TRANSFORMS[tname](col("a"))
+        r = col("b")
+        pred = {"==": l == r, "!=": l != r, "<": l < r,
+                "<=": l <= r, ">": l > r, ">=": l >= r}[op]
+        return _frame(a, b).select(pred.alias("p"))
+
+    got, want = _run_device_and_host(build)
+    assert got == want
+
+
 @given(_two_cols(), st.sampled_from(["==", "!=", "<", "<=", ">", ">="]))
 @settings(max_examples=60, deadline=None)
 def test_colcol_compare_parity(case, op):
