@@ -1747,6 +1747,24 @@ class TestStringDictPred32:
             else:
                 assert d["a"] == h["a"] and d["b"] == h["b"], name
 
+    def test_cross_column_compare_all_null_side(self, host_mode):
+        """An ALL-NULL side gives an empty dictionary; the pairwise joint
+        remap pads a 1-lane stub and every comparison row is null — the
+        filter keeps nothing, matching the host exactly."""
+        n = 3000
+        data = {"a": dt.Series.from_pylist([None] * n, "a",
+                                           dt.DataType.string()),
+                "b": dt.Series.from_pylist(["x"] * n, "b",
+                                           dt.DataType.string()),
+                "v": np.arange(n, dtype=np.int64)}
+
+        def q():
+            return dt.from_pydict(data).where(
+                col("a").str.upper() == col("b"))
+
+        dev, host = _run_both(q, host_mode)
+        assert dev.to_pydict()["v"] == host.to_pydict()["v"] == []
+
     def test_transformed_string_projection_on_device(self, host_mode):
         """select(upper(strip(s))) produces the transformed VALUES on
         device: sorted-order ids gather by code and decode through the
