@@ -13,9 +13,12 @@ from .errors import (
     DaftNotFoundError,
     DaftResourceError,
     DaftSchemaError,
+    DaftTimeoutError,
+    DaftTransientError,
     DaftTypeError,
     DaftValueError,
 )
+from . import faults
 from .schema import Field, Schema
 from .series import Series
 

@@ -21,12 +21,25 @@ Pools are keyed by (class, init_args, concurrency) and persist across queries
 from __future__ import annotations
 
 import atexit
+import logging
 import queue
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+logger = logging.getLogger(__name__)
+
 _pools: Dict[Tuple, "ActorPool"] = {}
 _pools_lock = threading.Lock()
+
+# process-wide count of worker threads that outlived their pool's shutdown
+# join window (still daemon, so they die with the process — but a nonzero
+# count means actor instances are pinning memory/devices past shutdown)
+_leak_lock = threading.Lock()
+_leaked_threads = 0
+
+
+def leaked_thread_count() -> int:
+    return _leaked_threads
 
 
 class ActorPool:
@@ -92,11 +105,25 @@ class ActorPool:
                 raise e
         return results
 
-    def shutdown(self) -> None:
+    def shutdown(self, join_timeout_s: float = 5.0) -> None:
+        global _leaked_threads
         for _ in self._threads:
             self._tasks.put(None)
+        leaked = 0
         for t in self._threads:
-            t.join(timeout=5)
+            t.join(timeout=join_timeout_s)
+            if t.is_alive():
+                leaked += 1
+        if leaked:
+            # a worker wedged mid-batch never saw its sentinel: don't block
+            # shutdown forever, but say so loudly and keep the count — a
+            # silent leak pins the actor instance (weights!) until exit
+            with _leak_lock:
+                _leaked_threads += leaked
+            logger.warning(
+                "ActorPool(%s): %d worker thread(s) still running after the "
+                "%.1fs join timeout; leaking them (daemon threads exit with "
+                "the process)", self._cls.__name__, leaked, join_timeout_s)
 
 
 def get_pool(cls: type, init_args: Optional[tuple], concurrency: int) -> ActorPool:

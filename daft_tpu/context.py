@@ -89,6 +89,20 @@ class ExecutionConfig:
     # default until the device measurement (bench q1_deep_pallas_vs_composed)
     # proves it wins — the r4 verdict's "keep it only if it wins" rule.
     use_pallas_deep_fusion: bool = False
+    # query deadline: the runner converts this to an absolute deadline at
+    # run start (ONE deadline across all AQE stages), checked cooperatively
+    # in the morsel loop and at pipeline breakers; expiry raises
+    # DaftTimeoutError carrying the partial RuntimeStats. None = no limit.
+    execution_timeout_s: Optional[float] = None
+    # device circuit breaker (execution.DeviceHealth): after this many
+    # CONSECUTIVE device-kernel failures the breaker opens and every
+    # device-eligible partition routes straight to the host path (one trip,
+    # not one failure tax per partition — the BENCH_r05 tpu_unreachable
+    # lesson) ...
+    device_breaker_threshold: int = 3
+    # ... until the cooldown elapses, after which ONE probe partition tries
+    # the device again: success re-closes the breaker, failure re-opens it.
+    device_breaker_cooldown_s: float = 30.0
 
 
 def resolve_executor_threads(cfg: "ExecutionConfig") -> int:
@@ -136,8 +150,10 @@ class DaftContext:
         return self._runner
 
     def set_runner(self, name: str) -> None:
+        from .errors import DaftValueError
+
         if name not in ("native", "mesh"):
-            raise ValueError(f"unknown runner {name!r}")
+            raise DaftValueError(f"unknown runner {name!r}")
         self._runner_name = name
         self._runner = None
 

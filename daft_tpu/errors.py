@@ -36,3 +36,19 @@ class DaftIOError(DaftError, IOError):
 class DaftResourceError(DaftError, RuntimeError):
     """Unsatisfiable resource request (reference: admission failure in
     pyrunner.py:352-370)."""
+
+
+class DaftTransientError(DaftError, IOError):
+    """Transient, retryable failure (timeouts, 5xx, connection resets, and
+    injected faults). Retry policies key on this type: anything else is
+    treated as permanent and propagates immediately."""
+
+
+class DaftTimeoutError(DaftError, TimeoutError):
+    """Query exceeded ExecutionConfig.execution_timeout_s. Carries the
+    partial RuntimeStats snapshot accumulated before the deadline so
+    callers can see how far the query got."""
+
+    def __init__(self, message: str, stats: "dict | None" = None):
+        super().__init__(message)
+        self.stats = stats or {}

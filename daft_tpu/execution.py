@@ -191,14 +191,129 @@ class RuntimeStats:
             }
 
 
+class DeviceHealth:
+    """Circuit breaker for one accelerator resource (device kernels, mesh
+    collectives). Closed = normal; after `threshold` CONSECUTIVE failures it
+    opens and allow() answers False — callers route straight to the host
+    path instead of re-paying the failure per partition (the BENCH_r05
+    tpu_unreachable tax). After `cooldown_s` the breaker goes half-open and
+    lets exactly ONE probe attempt through: success re-closes it, failure
+    re-opens it for another cooldown.
+
+    Counter names are prefixed by `kind` ("device" → device_breaker_trips,
+    device_breaker_probes, device_breaker_recoveries, ...)."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
+                 kind: str = "device"):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.kind = kind
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._probe_started = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self, stats: Optional[RuntimeStats] = None) -> bool:
+        """May an attempt use the resource right now? Open → False; open
+        past the cooldown → half-open, admitting one probe."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            now = time.monotonic()
+            if (self._state == self.OPEN
+                    and now - self._opened_at >= self.cooldown_s):
+                self._state = self.HALF_OPEN
+            if self._state == self.HALF_OPEN and (
+                    not self._probe_inflight
+                    # a probe whose resolver was abandoned (limit early-stop
+                    # closed the stream before the deferred result resolved)
+                    # must not wedge the breaker open forever: reclaim the
+                    # slot after one cooldown and let a new probe through
+                    or now - self._probe_started >= self.cooldown_s):
+                self._probe_inflight = True
+                self._probe_started = now
+                if stats is not None:
+                    stats.bump(f"{self.kind}_breaker_probes")
+                return True
+            return False
+
+    def record_success(self, stats: Optional[RuntimeStats] = None) -> None:
+        with self._lock:
+            self._consecutive = 0
+            if self._state == self.HALF_OPEN:
+                # only the probe path re-closes the breaker: a straggler
+                # async success that launched BEFORE the trip must not close
+                # an OPEN breaker and route new work back to a dead device
+                self._state = self.CLOSED
+                self._probe_inflight = False
+                if stats is not None:
+                    stats.bump(f"{self.kind}_breaker_recoveries")
+
+    def record_failure(self, stats: Optional[RuntimeStats] = None) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self._state == self.HALF_OPEN:
+                # probe failed: straight back to open for another cooldown
+                self._state = self.OPEN
+                self._opened_at = time.monotonic()
+                self._probe_inflight = False
+                if stats is not None:
+                    stats.bump(f"{self.kind}_breaker_reopens")
+            elif (self._state == self.CLOSED
+                    and self._consecutive >= self.threshold):
+                self._state = self.OPEN
+                self._opened_at = time.monotonic()
+                if stats is not None:
+                    stats.bump(f"{self.kind}_breaker_trips")
+
+    def release_probe(self) -> None:
+        """An admitted attempt DECLINED (no failure, no success — e.g. the
+        kernel layer judged the data ineligible): free the probe slot so the
+        half-open breaker isn't wedged waiting on a result that never comes."""
+        with self._lock:
+            self._probe_inflight = False
+
+
 class ExecutionContext:
-    def __init__(self, cfg: ExecutionConfig, stats: Optional[RuntimeStats] = None):
+    def __init__(self, cfg: ExecutionConfig, stats: Optional[RuntimeStats] = None,
+                 deadline: Optional[float] = None,
+                 device_health: Optional[DeviceHealth] = None):
         self.cfg = cfg
         self.stats = stats or RuntimeStats()
+        # absolute time.monotonic() deadline; runners compute it once per
+        # query so AQE stages share one budget (a context built directly
+        # converts the config knob itself)
+        if deadline is None and cfg.execution_timeout_s is not None:
+            deadline = time.monotonic() + cfg.execution_timeout_s
+        self.deadline = deadline
+        self.device_health = device_health or DeviceHealth(
+            cfg.device_breaker_threshold, cfg.device_breaker_cooldown_s)
         self._pool = None
         self._spill_scope = None
         self._buffers: List = []
         self._accountant: Optional[ResourceAccountant] = None
+
+    def check_deadline(self) -> None:
+        """Cooperative deadline check (morsel loop, pipeline breakers):
+        raises DaftTimeoutError carrying the partial stats accumulated so
+        far when execution_timeout_s has been exceeded."""
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            from .errors import DaftTimeoutError
+
+            self.stats.bump("deadline_expired")
+            raise DaftTimeoutError(
+                f"query exceeded execution_timeout_s="
+                f"{self.cfg.execution_timeout_s}",
+                stats=self.stats.snapshot())
 
     @property
     def spill_scope(self):
@@ -213,6 +328,10 @@ class ExecutionContext:
         """A spillable PartitionBuffer bound to this query's budget, stats,
         and spill directory. Tracked so abandoned queries (limit early-stop,
         cancellation, errors) still return their held bytes to the ledger."""
+        # pipeline breakers are the other cooperative deadline checkpoint
+        # (besides the morsel loop): a breaker about to buffer its whole
+        # input first proves the query still has time budget
+        self.check_deadline()
         from .spill import PartitionBuffer
 
         buf = PartitionBuffer(self.cfg.memory_budget_bytes, self.stats,
@@ -266,9 +385,40 @@ class ExecutionContext:
             self._pool.shutdown(wait=False)
             self._pool = None
 
+    def _device_allowed(self) -> bool:
+        """Breaker gate for work that IS device-eligible: an open breaker
+        sends it to the host path and counts the degraded completion."""
+        if self.device_health.allow(self.stats):
+            return True
+        self.stats.bump("degraded_completions")
+        return False
+
     def _device_eligible(self, part: MicroPartition) -> bool:
         return (self.cfg.use_device_kernels
-                and (part.num_rows_or_none() or 0) >= self.cfg.device_min_rows)
+                and (part.num_rows_or_none() or 0) >= self.cfg.device_min_rows
+                and self._device_allowed())
+
+    def _device_attempt(self, fn, launch: bool = False):
+        """Run one device-path attempt under the fault registry + breaker.
+        An exception records a breaker failure and returns None (the device
+        layer's decline convention); a None result is a decline (probe slot
+        released, breaker untouched). A non-None result records success —
+        unless `launch` is set, in which case the caller owns the outcome
+        (async dispatch: the launch succeeding says nothing about the
+        deferred computation, whose resolver records for real)."""
+        from . import faults
+
+        try:
+            faults.check("device.kernel", self.stats)
+            out = fn()
+        except Exception:
+            self.device_health.record_failure(self.stats)
+            return None
+        if out is None:
+            self.device_health.release_probe()
+        elif not launch:
+            self.device_health.record_success(self.stats)
+        return out
 
     def foreign_owned(self, part: MicroPartition) -> bool:
         """True when this process must not materialize `part` (another host
@@ -293,13 +443,14 @@ class ExecutionContext:
         if self.foreign_owned(part) and not part.is_loaded():
             return self._defer_projection(part, exprs)
         if self._device_eligible(part):
-            try:
+            def _run():
                 from .kernels.device import eval_projection_device
 
-                out = eval_projection_device(part.table(), list(exprs),
-                                             stage_cache=part.device_stage_cache())
-            except Exception:
-                out = None
+                return eval_projection_device(
+                    part.table(), list(exprs),
+                    stage_cache=part.device_stage_cache())
+
+            out = self._device_attempt(_run)
             if out is not None:
                 self.stats.bump("device_projections")
                 return part._wrap(out)
@@ -317,13 +468,14 @@ class ExecutionContext:
             return lambda: deferred
         if not self._device_eligible(part):
             return None
-        try:
+
+        def _launch():
             from .kernels.device import eval_projection_device_async
 
-            resolve = eval_projection_device_async(
+            return eval_projection_device_async(
                 part.table(), list(exprs), stage_cache=part.device_stage_cache())
-        except Exception:
-            return None
+
+        resolve = self._device_attempt(_launch, launch=True)
         if resolve is None:
             return None
         self.stats.bump("device_projections")
@@ -331,15 +483,18 @@ class ExecutionContext:
 
         def finish() -> MicroPartition:
             try:
-                return part._wrap(resolve())
+                out = part._wrap(resolve())
             except Exception:
                 # the partition was NOT computed on device after all: keep
                 # the counters truthful (same attribution the synchronous
                 # path's fallback produces)
+                self.device_health.record_failure(self.stats)
                 self.stats.bump("device_projections", -1)
                 self.stats.bump("device_projection_fallbacks")
                 self.stats.bump("host_projections")
                 return part.eval_expression_list(exprs)
+            self.device_health.record_success(self.stats)
+            return out
 
         return finish
 
@@ -349,14 +504,14 @@ class ExecutionContext:
         eligible: keys compile + sort on device, only the payload take runs
         on host. Host pyarrow sort otherwise."""
         if self._device_eligible(part):
-            try:
+            def _run():
                 from .kernels.device import device_table_argsort
 
-                idx = device_table_argsort(
+                return device_table_argsort(
                     part.table(), sort_by, descending, nulls_first,
                     stage_cache=part.device_stage_cache())
-            except Exception:
-                idx = None
+
+            idx = self._device_attempt(_run)
             if idx is not None:
                 import numpy as np
 
@@ -373,17 +528,17 @@ class ExecutionContext:
         """Route distinct through the device group-codes kernel when the keys
         are device-eligible; host dictionary encode otherwise."""
         if self._device_eligible(part):
-            try:
+            def _run():
                 from .expressions import col
                 from .kernels.device_agg import device_distinct_indices
 
                 keys = list(subset) if subset else [
                     col(n) for n in part.column_names]
-                idx = device_distinct_indices(
+                return device_distinct_indices(
                     part.table(), keys, part.device_stage_cache(),
                     len(part.table()))
-            except Exception:
-                idx = None
+
+            idx = self._device_attempt(_run)
             if idx is not None:
                 import numpy as np
 
@@ -402,16 +557,16 @@ class ExecutionContext:
         fused device kernel when eligible, else the host path (host applies
         the predicate first when one was fused)."""
         if self._device_eligible(part):
-            try:
+            def _run():
                 from .kernels.device_agg import device_grouped_agg
 
-                out = device_grouped_agg(part.table(), list(aggregations),
-                                         list(groupby or []),
-                                         stage_cache=part.device_stage_cache(),
-                                         predicate=predicate,
-                                         stats=self.stats)
-            except Exception:
-                out = None
+                return device_grouped_agg(part.table(), list(aggregations),
+                                          list(groupby or []),
+                                          stage_cache=part.device_stage_cache(),
+                                          predicate=predicate,
+                                          stats=self.stats)
+
+            out = self._device_attempt(_run)
             if out is not None:
                 self.stats.bump("device_aggregations")
                 return MicroPartition.from_table(out)
@@ -450,15 +605,16 @@ class ExecutionContext:
         when ineligible — same contract as eval_projection_dispatch."""
         if not self._device_eligible(part):
             return None
-        try:
+
+        def _launch():
             from .kernels.device_agg import device_grouped_agg_async
 
-            resolve = device_grouped_agg_async(
+            return device_grouped_agg_async(
                 part.table(), list(aggregations), list(groupby or []),
                 stage_cache=part.device_stage_cache(), predicate=predicate,
                 stats=self.stats)
-        except Exception:
-            return None
+
+        resolve = self._device_attempt(_launch, launch=True)
         if resolve is None:
             return None
         self.stats.bump("device_aggregations")
@@ -467,12 +623,16 @@ class ExecutionContext:
         def finish() -> MicroPartition:
             try:
                 out = resolve()
-                if out is not None:
-                    return MicroPartition.from_table(out)
             except Exception:
-                pass
-            # overflow guard or deferred failure: partition was NOT
-            # aggregated on device — keep the counters truthful
+                out = None
+                self.device_health.record_failure(self.stats)
+            if out is not None:
+                self.device_health.record_success(self.stats)
+                return MicroPartition.from_table(out)
+            # overflow guard (a decline, not a device failure) or deferred
+            # failure: partition was NOT aggregated on device — keep the
+            # counters truthful
+            self.device_health.release_probe()
             self.stats.bump("device_aggregations", -1)
             self.stats.bump("device_agg_fallbacks")
             return self._eval_agg_host(part, aggregations, groupby, predicate)
@@ -505,7 +665,8 @@ class ExecutionContext:
                 and 1 <= len(left_on) == len(right_on) <= 4
                 and max(lpart.num_rows_or_none() or 0,
                         rpart.num_rows_or_none() or 0)
-                >= self.cfg.device_min_rows)
+                >= self.cfg.device_min_rows
+                and self._device_allowed())
 
     def _assemble_join(self, res, lpart, rpart, left_on, right_on, how,
                        suffix) -> MicroPartition:
@@ -554,20 +715,21 @@ class ExecutionContext:
         synchronously)."""
         if not self._join_eligible(lpart, rpart, left_on, right_on, how):
             return None
-        try:
+
+        def _launch():
             from .kernels.device_join import (device_join_launch,
                                               join_key_replicas)
 
             single = len(left_on) == 1
-            launch = device_join_launch(
+            return device_join_launch(
                 lpart.table(), rpart.table(), list(left_on), list(right_on),
                 lpart.device_stage_cache(), rpart.device_stage_cache(), how,
                 left_replicas=(join_key_replicas(lpart, left_on[0])
                                if single else None),
                 right_replicas=(join_key_replicas(rpart, right_on[0])
                                 if single else None))
-        except Exception:
-            return None
+
+        launch = self._device_attempt(_launch, launch=True)
         if launch is None:
             return None
         self.stats.bump("device_join_dispatches")
@@ -576,9 +738,11 @@ class ExecutionContext:
             try:
                 res = launch()
             except Exception:
+                self.device_health.record_failure(self.stats)
                 self.stats.bump("device_join_fallbacks")
                 self.stats.bump("host_joins")
                 return lpart.hash_join(rpart, left_on, right_on, how, suffix)
+            self.device_health.record_success(self.stats)
             # assembly runs OUTSIDE the catch-all: a defect there must crash
             # loudly, not silently recompute on host (same error contract
             # as the blocking path)
@@ -608,13 +772,14 @@ class ExecutionContext:
         if self.foreign_owned(part) and not part.is_loaded():
             return self._defer_filter(part, predicate)
         if self._device_eligible(part):
-            try:
+            def _run():
                 from .kernels.device import eval_projection_device
 
-                out = eval_projection_device(part.table(), [predicate],
-                                             stage_cache=part.device_stage_cache())
-            except Exception:
-                out = None
+                return eval_projection_device(
+                    part.table(), [predicate],
+                    stage_cache=part.device_stage_cache())
+
+            out = self._device_attempt(_run)
             if out is not None:
                 self.stats.bump("device_filters")
                 mask = out._columns[0]
@@ -631,14 +796,15 @@ class ExecutionContext:
             return lambda: deferred
         if not self._device_eligible(part):
             return None
-        try:
+
+        def _launch():
             from .kernels.device import eval_projection_device_async
 
-            resolve = eval_projection_device_async(
+            return eval_projection_device_async(
                 part.table(), [predicate],
                 stage_cache=part.device_stage_cache())
-        except Exception:
-            return None
+
+        resolve = self._device_attempt(_launch, launch=True)
         if resolve is None:
             return None
         self.stats.bump("device_filters")
@@ -648,12 +814,15 @@ class ExecutionContext:
             try:
                 out = resolve()
                 mask = out._columns[0]
-                return part._wrap(part.table().filter_with_mask(mask))
+                result = part._wrap(part.table().filter_with_mask(mask))
             except Exception:
+                self.device_health.record_failure(self.stats)
                 self.stats.bump("device_filters", -1)
                 self.stats.bump("device_filter_fallbacks")
                 self.stats.bump("host_filters")
                 return part.filter([predicate])
+            self.device_health.record_success(self.stats)
+            return result
 
         return finish
 
@@ -789,6 +958,7 @@ def _traced(op: PhysicalOp, stream: Iterator[MicroPartition],
     while True:
         if ctx.stats.is_cancelled():
             raise QueryCancelledError(f"query cancelled (at {name})")
+        ctx.check_deadline()
         # Self-time accounting: pulling next(stream) recursively runs the
         # child wrappers on this same thread, so each wrapper pushes a frame,
         # accumulates its INCLUSIVE time into the parent frame, and reports

@@ -18,6 +18,7 @@ from __future__ import annotations
 import datetime
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
+from .errors import DaftValueError
 from .datatypes import DataType, TypeKind, infer_datatype, try_unify
 from .functions import get_function
 from .schema import Field, Schema
@@ -44,7 +45,7 @@ def interval(**kwargs) -> "Expression":
     allowed = ("weeks", "days", "hours", "minutes", "seconds", "milliseconds", "microseconds")
     unknown = set(kwargs) - set(allowed)
     if unknown:
-        raise ValueError(f"unsupported interval unit(s) {sorted(unknown)}; allowed: {allowed}")
+        raise DaftValueError(f"unsupported interval unit(s) {sorted(unknown)}; allowed: {allowed}")
     return lit(datetime.timedelta(**kwargs), DataType.duration("us"))
 
 
@@ -96,7 +97,7 @@ class ExprNode:
 
     def with_children(self, children: List["ExprNode"]) -> "ExprNode":
         if children:
-            raise ValueError(f"{type(self).__name__} has no children")
+            raise DaftValueError(f"{type(self).__name__} has no children")
         return self
 
     def is_aggregation(self) -> bool:
@@ -136,7 +137,7 @@ class Column(ExprNode):
 class Literal(ExprNode):
     def __init__(self, value: Any, dtype: Optional[DataType] = None):
         if isinstance(value, Expression):
-            raise ValueError("lit() of an Expression; pass a plain value")
+            raise DaftValueError("lit() of an Expression; pass a plain value")
         self.value = value
         self.dtype = dtype or infer_datatype(value)
         # A plain python int/float with no declared dtype is *weak-typed*
@@ -261,35 +262,35 @@ class BinaryOp(ExprNode):
                 temporal_dt = lf.dtype if lf.dtype.is_temporal() else rf.dtype
                 litv = _unwrap_string_literal(str_node)
                 if litv is None:
-                    raise ValueError(
+                    raise DaftValueError(
                         f"cannot compare {lf.dtype} with {rf.dtype}: only string "
                         f"literals coerce to temporal types")
                 try:
                     import pyarrow as pa
                     pa.scalar(litv).cast(temporal_dt.to_arrow())
                 except Exception as e:
-                    raise ValueError(
+                    raise DaftValueError(
                         f"string literal {litv!r} does not parse as {temporal_dt}: {e}"
                     ) from e
                 return Field(nm, DataType.bool())
             if try_unify(lf.dtype, rf.dtype) is None and not (
                 lf.dtype.is_temporal() and rf.dtype.is_temporal()
             ):
-                raise ValueError(f"cannot compare {lf.dtype} with {rf.dtype}")
+                raise DaftValueError(f"cannot compare {lf.dtype} with {rf.dtype}")
             return Field(nm, DataType.bool())
         if op in _LOGIC_OPS:
             for f in (lf, rf):
                 if not (f.dtype.is_boolean() or f.dtype.is_null() or f.dtype.is_integer()):
-                    raise ValueError(f"logical op {op} needs bool/int, got {f.dtype}")
+                    raise DaftValueError(f"logical op {op} needs bool/int, got {f.dtype}")
             if lf.dtype.is_integer() or rf.dtype.is_integer():
                 # bitwise form: both sides must be integers — mixing a bool
                 # with an int has no kernel (kleene ops are bool-only)
                 if lf.dtype.is_boolean() or rf.dtype.is_boolean():
-                    raise ValueError(f"cannot {op} {lf.dtype} with {rf.dtype}")
+                    raise DaftValueError(f"cannot {op} {lf.dtype} with {rf.dtype}")
                 u = try_unify(lf.dtype, rf.dtype)
                 if u is None or not u.is_integer():
                     # e.g. signed | uint64 unifies to float64 — no bitwise kernel
-                    raise ValueError(f"cannot {op} {lf.dtype} with {rf.dtype}")
+                    raise DaftValueError(f"cannot {op} {lf.dtype} with {rf.dtype}")
                 return Field(nm, u)
             return Field(nm, DataType.bool())
         # arithmetic
@@ -302,16 +303,16 @@ class BinaryOp(ExprNode):
         if op in ("/", "**"):
             for f in (lf, rf):
                 if not (f.dtype.is_numeric() or f.dtype.is_boolean() or f.dtype.is_null()):
-                    raise ValueError(f"cannot apply {op} to {lf.dtype} and {rf.dtype}")
+                    raise DaftValueError(f"cannot apply {op} to {lf.dtype} and {rf.dtype}")
             return Field(nm, DataType.float64())
         u = try_unify(lf.dtype, rf.dtype)
         if u is None or not (u.is_numeric() or u.is_boolean() or u.is_null()):
-            raise ValueError(f"cannot apply {op} to {lf.dtype} and {rf.dtype}")
+            raise DaftValueError(f"cannot apply {op} to {lf.dtype} and {rf.dtype}")
         if u.is_boolean():
             # bool op numeric unifies to the numeric side (handled above by
             # try_unify); bool op bool arithmetic is rejected like the
             # reference (binary_ops.rs Add: only (Boolean, numeric) is legal)
-            raise ValueError(f"cannot apply {op} to {lf.dtype} and {rf.dtype}")
+            raise DaftValueError(f"cannot apply {op} to {lf.dtype} and {rf.dtype}")
         return Field(nm, u)
 
     def _eval(self, table) -> Series:
@@ -499,7 +500,7 @@ def _temporal_arith_type(op: str, l: DataType, r: DataType) -> DataType:
             return DataType.duration(unit_of(l))
     if op in ("*", "/", "//") and (l.kind == TypeKind.DURATION) != (r.kind == TypeKind.DURATION):
         return l if l.kind == TypeKind.DURATION else r
-    raise ValueError(f"unsupported temporal arithmetic: {l} {op} {r}")
+    raise DaftValueError(f"unsupported temporal arithmetic: {l} {op} {r}")
 
 
 class Not(ExprNode):
@@ -512,7 +513,7 @@ class Not(ExprNode):
     def to_field(self, schema):
         f = self.child.to_field(schema)
         if not (f.dtype.is_boolean() or f.dtype.is_null()):
-            raise ValueError(f"~ expects bool, got {f.dtype}")
+            raise DaftValueError(f"~ expects bool, got {f.dtype}")
         return Field(f.name, DataType.bool())
 
     def _eval(self, table):
@@ -578,7 +579,7 @@ class FillNull(ExprNode):
         _, _, cdt, fdt = effective_operands(self.child, self.fill, f.dtype, g.dtype)
         u = try_unify(cdt, fdt)
         if u is None:
-            raise ValueError(f"fill_null type mismatch: {f.dtype} vs {g.dtype}")
+            raise DaftValueError(f"fill_null type mismatch: {f.dtype} vs {g.dtype}")
         return Field(f.name, u)
 
     def _eval(self, table):
@@ -686,13 +687,13 @@ class IfElse(ExprNode):
     def to_field(self, schema):
         p = self.pred.to_field(schema)
         if not (p.dtype.is_boolean() or p.dtype.is_null()):
-            raise ValueError(f"if_else predicate must be bool, got {p.dtype}")
+            raise DaftValueError(f"if_else predicate must be bool, got {p.dtype}")
         t = self.if_true.to_field(schema)
         f = self.if_false.to_field(schema)
         _, _, tdt, fdt = effective_operands(self.if_true, self.if_false, t.dtype, f.dtype)
         u = try_unify(tdt, fdt)
         if u is None:
-            raise ValueError(f"if_else branches incompatible: {t.dtype} vs {f.dtype}")
+            raise DaftValueError(f"if_else branches incompatible: {t.dtype} vs {f.dtype}")
         return Field(t.name, u)
 
     def _eval(self, table):
@@ -824,7 +825,7 @@ class AggExpr(ExprNode):
 
     def __init__(self, kind: str, child: ExprNode, extra: Optional[Dict[str, Any]] = None):
         if kind not in AGG_KINDS:
-            raise ValueError(f"unknown aggregation {kind!r}")
+            raise DaftValueError(f"unknown aggregation {kind!r}")
         self.kind = kind
         self.child = child
         self.extra = extra or {}
@@ -852,7 +853,7 @@ class AggExpr(ExprNode):
             return Field(f.name, DataType.list(f.dtype))
         if k == "concat":
             if not f.dtype.is_list() and not f.dtype.is_string():
-                raise ValueError(f"agg_concat needs list/string, got {f.dtype}")
+                raise DaftValueError(f"agg_concat needs list/string, got {f.dtype}")
             return Field(f.name, f.dtype)
         if k == "approx_percentiles":
             ps = self.extra.get("percentiles")
@@ -1078,7 +1079,7 @@ class Expression:
         return hash(repr(self._node._key()))
 
     def __bool__(self):
-        raise ValueError(
+        raise DaftValueError(
             "Expressions are lazy and have no truth value; use & | ~ instead of and/or/not"
         )
 
@@ -1583,7 +1584,7 @@ class ExpressionsProjection:
         for e in self.exprs:
             n = e.name()
             if n in seen:
-                raise ValueError(f"duplicate output name {n!r} in projection")
+                raise DaftValueError(f"duplicate output name {n!r} in projection")
             seen.add(n)
 
     def __iter__(self):

@@ -1,0 +1,167 @@
+"""Deterministic, seeded fault injection for resilience testing.
+
+Every recovery path in the engine — scan retries, object-store retries,
+device→host kernel fallbacks, the device circuit breaker, collective→host
+shuffle fallback, spill-failure hold-in-memory — guards real production
+behavior, yet none of it triggers under healthy tests. This registry makes
+those paths deterministically exercisable (HPTMT's per-operator failure
+semantics; arxiv 2604.21275's reproducible transient-fault replay): code at
+a fault *site* calls ``check(site)``, and a test/config arms a plan that
+decides, per call, whether to raise.
+
+Sites wired into the engine:
+
+    io.get              each object-store read attempt (inside the retry loop)
+    scan.read           each scan-task read attempt (inside the retry loop)
+    device.kernel       each device-kernel attempt (sync and async launch)
+    collective.exchange each mesh all_to_all shuffle attempt
+    spill.write         each partition spill write
+
+Plans are deterministic: ``always`` / ``first_n`` / ``nth`` fire by call
+count; ``rate`` hashes (seed, site, call#) so the same seed reproduces the
+same failure sequence on every run — no wall-clock, no global RNG state.
+
+The disarmed fast path is one module-global boolean check, so production
+code pays nothing when no plan is armed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from .errors import DaftTransientError, DaftValueError
+
+
+class InjectedFault(DaftTransientError):
+    """Raised by an armed fault plan. Subclasses the engine's transient
+    error (an IOError/OSError) so retry policies and device fallbacks treat
+    it exactly like a real transient failure."""
+
+
+class FaultPlan:
+    """Decides, per call, whether an armed site fires.
+
+    Modes:
+      - ``always``:      every call fails
+      - ``first_n``:     calls 1..n fail, then the site heals
+                         (n=1 is fail-once-then-heal)
+      - ``nth``:         exactly call #n fails (1-based)
+      - ``rate``:        each call fails with probability ``rate``, decided
+                         by sha256(seed, site, call#) — deterministic
+    """
+
+    __slots__ = ("mode", "n", "rate", "seed", "exc", "message")
+
+    def __init__(self, mode: str = "always", n: int = 1, rate: float = 0.0,
+                 seed: int = 0, exc: type = InjectedFault,
+                 message: str = ""):
+        if mode not in ("always", "first_n", "nth", "rate"):
+            # a misconfigured plan is a caller bug, never a retryable fault
+            raise DaftValueError(f"unknown fault mode {mode!r}")
+        self.mode = mode
+        self.n = n
+        self.rate = rate
+        self.seed = seed
+        self.exc = exc
+        self.message = message
+
+    def should_fire(self, site: str, call_no: int) -> bool:
+        """call_no is 1-based: the first check() at an armed site is #1."""
+        if self.mode == "always":
+            return True
+        if self.mode == "first_n":
+            return call_no <= self.n
+        if self.mode == "nth":
+            return call_no == self.n
+        digest = hashlib.sha256(
+            f"{self.seed}:{site}:{call_no}".encode()).digest()
+        return int.from_bytes(digest[:8], "big") / float(1 << 64) < self.rate
+
+    def __repr__(self) -> str:
+        return (f"FaultPlan({self.mode}, n={self.n}, rate={self.rate}, "
+                f"seed={self.seed})")
+
+
+_lock = threading.Lock()
+_plans: Dict[str, FaultPlan] = {}
+_calls: Dict[str, int] = {}
+_injected: Dict[str, int] = {}
+# fast-path flag: check() returns immediately when nothing is armed, so the
+# hot loops (every io read, every device attempt) pay one boolean test
+_armed = False
+
+
+def arm(site: str, mode: str = "always", **kwargs) -> FaultPlan:
+    """Arm a plan at a site (replacing any existing plan and resetting the
+    site's call AND injected counters). Returns the plan for introspection."""
+    global _armed
+    plan = FaultPlan(mode, **kwargs)
+    with _lock:
+        _plans[site] = plan
+        _calls[site] = 0
+        _injected[site] = 0
+        _armed = True
+    return plan
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Disarm one site, or every site (and clear all counters) when None."""
+    global _armed
+    with _lock:
+        if site is None:
+            _plans.clear()
+            _calls.clear()
+            _injected.clear()
+        else:
+            _plans.pop(site, None)
+        _armed = bool(_plans)
+
+
+@contextmanager
+def inject(site: str, mode: str = "always", **kwargs):
+    """Scoped arming: ``with faults.inject("scan.read", "first_n", n=2): ...``"""
+    arm(site, mode, **kwargs)
+    try:
+        yield
+    finally:
+        disarm(site)
+
+
+def check(site: str, stats=None) -> None:
+    """Call at a fault site. Raises the armed plan's exception when the plan
+    decides this call fails; otherwise a no-op. ``stats`` (a RuntimeStats)
+    gets a ``faults_injected`` counter bump per fired fault; sites without a
+    per-query stats handle (the IO layer) pass None and are still counted in
+    ``snapshot()['injected']``."""
+    if not _armed:
+        return
+    with _lock:
+        plan = _plans.get(site)
+        if plan is None:
+            return
+        _calls[site] = call_no = _calls.get(site, 0) + 1
+        fire = plan.should_fire(site, call_no)
+        if fire:
+            _injected[site] = _injected.get(site, 0) + 1
+    if not fire:
+        return
+    if stats is not None:
+        stats.bump("faults_injected")
+    from . import tracing
+
+    tracing.add_instant(f"fault:{site}", {"call": call_no})
+    raise plan.exc(plan.message or f"injected fault at {site} (call #{call_no})")
+
+
+def snapshot() -> dict:
+    """Registry introspection: armed plans, per-site call and injection
+    counts (tests assert against these)."""
+    with _lock:
+        return {
+            "armed": {site: repr(p) for site, p in _plans.items()},
+            "calls": dict(_calls),
+            "injected": dict(_injected),
+        }

@@ -29,6 +29,7 @@ import urllib.parse
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..errors import DaftTransientError
 from .scan import IO_STATS
 
 
@@ -38,8 +39,10 @@ class ObjectMeta:
     size: Optional[int] = None
 
 
-class TransientIOError(IOError):
-    """Retryable failure (5xx, timeout, connection reset)."""
+class TransientIOError(DaftTransientError):
+    """Retryable failure (5xx, timeout, connection reset). Subclasses
+    DaftTransientError so one retry discipline covers real and injected
+    transient failures engine-wide."""
 
 
 class NotFoundIOError(IOError):
@@ -52,18 +55,29 @@ class NotFoundIOError(IOError):
 @dataclass
 class RetryPolicy:
     """Mirrors the reference's S3 retry config (attempts + exponential
-    backoff; jitter avoids thundering herds on shared endpoints)."""
+    backoff; jitter avoids thundering herds on shared endpoints). The ONE
+    retry discipline in the engine: scan-task retries reuse it with their
+    own `retryable`/`permanent` classes instead of hand-rolling uncapped,
+    jitterless backoff."""
 
     attempts: int = 4
     backoff_s: float = 0.1
     max_backoff_s: float = 4.0
+    # which exceptions retry; DaftTransientError covers the object-store
+    # TransientIOError AND injected faults
+    retryable: tuple = (DaftTransientError,)
+    # subclasses of `retryable` that must propagate immediately (a missing
+    # file inside a retryable OSError net, say) — checked first
+    permanent: tuple = ()
 
     def run(self, fn):
         last = None
         for attempt in range(max(1, self.attempts)):
             try:
                 return fn()
-            except TransientIOError as e:
+            except self.permanent:
+                raise
+            except self.retryable as e:
                 last = e
                 IO_STATS.bump(retries=1)
                 if attempt + 1 >= self.attempts:
@@ -1013,9 +1027,18 @@ class IOClient:
 
     def get(self, path: str, range: Optional[Tuple[int, int]] = None,
             timeout: Optional[float] = None) -> bytes:
+        from .. import faults
+
         src = self.source_for(path)
+
+        def attempt() -> bytes:
+            # fault site inside the retry loop: each ATTEMPT checks, so an
+            # armed first_n plan exercises retry-then-heal deterministically
+            faults.check("io.get")
+            return src.get(path, range, timeout)
+
         with self._sem:
-            data = self.retry.run(lambda: src.get(path, range, timeout))
+            data = self.retry.run(attempt)
         IO_STATS.bump(bytes_read=len(data))
         return data
 

@@ -13,6 +13,7 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..errors import DaftNotFoundError, DaftValueError
 from ..schema import Schema
 from ..stats import TableStats, filter_may_match
 
@@ -171,25 +172,28 @@ class ScanTask:
     def read(self):
         """Materialize this scan task into a Table (applies pushdowns).
 
-        Transient IO errors retry with exponential backoff (reference: the
-        IO-layer retry policies of daft-io s3_like.rs:452-468, applied here at
-        task granularity); permanent errors (missing file, permissions) raise
-        immediately."""
-        import time as _time
-
+        Transient IO errors retry through the shared RetryPolicy — jittered
+        exponential backoff with a cap, so scan tasks hammering a shared
+        endpoint don't form synchronized retry herds (reference: the
+        IO-layer retry policies of daft-io s3_like.rs:452-468, applied here
+        at task granularity); permanent errors (missing file, permissions)
+        raise immediately."""
+        from .. import faults
         from ..context import get_context
+        from .object_store import RetryPolicy
 
         cfg = get_context().execution_config
-        attempts = max(1, cfg.scan_retry_attempts)
-        for attempt in range(attempts):
-            try:
-                return self._read_with_partition_values()
-            except (FileNotFoundError, PermissionError, IsADirectoryError):
-                raise
-            except OSError:
-                if attempt == attempts - 1:
-                    raise
-                _time.sleep(cfg.scan_retry_backoff_s * (2 ** attempt))
+        policy = RetryPolicy(
+            attempts=max(1, cfg.scan_retry_attempts),
+            backoff_s=cfg.scan_retry_backoff_s,
+            retryable=(OSError,),
+            permanent=(FileNotFoundError, PermissionError, IsADirectoryError))
+
+        def attempt():
+            faults.check("scan.read")
+            return self._read_with_partition_values()
+
+        return policy.run(attempt)
 
     def _read_with_partition_values(self):
         """Catalog partition columns don't exist in the file, so a pushed-down
@@ -236,7 +240,7 @@ class ScanTask:
             tbl = read_arrow_ipc_table(self.path, self.pushdowns,
                                        schema=self.schema)
         else:
-            raise ValueError(f"unknown scan format {self.format!r}")
+            raise DaftValueError(f"unknown scan format {self.format!r}")
         if self.partition_values:
             tbl = self._append_partition_columns(tbl)
         return tbl
@@ -390,7 +394,7 @@ def glob_paths(path) -> List[str]:
 
         metas = default_io_client().glob(p)
         if not metas:
-            raise FileNotFoundError(f"{p!r} matched no objects")
+            raise DaftNotFoundError(f"{p!r} matched no objects")
         return [m.path for m in metas]
     if p.startswith("file://"):
         p = p[len("file://"):]
@@ -401,13 +405,13 @@ def glob_paths(path) -> List[str]:
             and os.path.isfile(os.path.join(p, f))
         )
         if not files:
-            raise FileNotFoundError(f"no files found in directory {p!r}")
+            raise DaftNotFoundError(f"no files found in directory {p!r}")
         return files
     if any(ch in p for ch in "*?["):
         files = sorted(f for f in _glob.glob(p, recursive=True) if os.path.isfile(f))
         if not files:
-            raise FileNotFoundError(f"glob {p!r} matched no files")
+            raise DaftNotFoundError(f"glob {p!r} matched no files")
         return files
     if not os.path.exists(p):
-        raise FileNotFoundError(f"path {p!r} does not exist")
+        raise DaftNotFoundError(f"path {p!r} does not exist")
     return [p]

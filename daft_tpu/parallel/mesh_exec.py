@@ -43,7 +43,7 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..execution import ExecutionContext, RuntimeStats
+from ..execution import DeviceHealth, ExecutionContext, RuntimeStats
 from ..kernels.device import DeviceColumn, is_device_dtype, size_bucket, stage_np, unstage
 from ..micropartition import MicroPartition
 from .collectives import build_exchange, exchange_capacity
@@ -188,9 +188,21 @@ def default_mesh(n: Optional[int] = None):
 class MeshExecutionContext(ExecutionContext):
     """ExecutionContext whose shuffles use the device exchange when eligible."""
 
-    def __init__(self, cfg, stats: Optional[RuntimeStats] = None, mesh=None):
-        super().__init__(cfg, stats)
+    def __init__(self, cfg, stats: Optional[RuntimeStats] = None, mesh=None,
+                 deadline: Optional[float] = None, device_health=None,
+                 collective_health=None):
+        super().__init__(cfg, stats, deadline=deadline,
+                         device_health=device_health)
         self.mesh = mesh if mesh is not None else default_mesh()
+        # mesh collectives get the same circuit-breaker treatment as device
+        # kernels: K consecutive exchange failures trip it and every later
+        # shuffle goes straight to the host path until the cooldown probe
+        # proves the link healthy again. MeshRunner passes one instance per
+        # QUERY so AQE stages share trip/cooldown state (same contract as
+        # device_health).
+        self.collective_health = collective_health or DeviceHealth(
+            cfg.device_breaker_threshold, cfg.device_breaker_cooldown_s,
+            kind="collective")
 
     @property
     def n_devices(self) -> int:
@@ -248,7 +260,38 @@ class MeshExecutionContext(ExecutionContext):
                            scheme: str, descending=None, nulls_first=None,
                            boundaries=None) -> Optional[List[MicroPartition]]:
         """All-to-all shuffle over the mesh; None if ineligible (unsupported
-        scheme, non-device payload dtype, empty input, missing boundaries)."""
+        scheme, non-device payload dtype, empty input, missing boundaries),
+        if the collective breaker is open, or if the exchange itself fails
+        (the failure is recorded against the breaker and the caller's host
+        shuffle path takes over).
+
+        Multi-process caveat: a REAL mid-collective failure on one process
+        can leave peers blocked in the exchange — same exposure as before
+        this catch existed (the process previously crashed outright);
+        injected faults fire identically on every process (the registry is
+        armed SPMD) so test fallbacks stay collectively consistent."""
+        from .. import faults
+
+        if not self.collective_health.allow(self.stats):
+            self.stats.bump("degraded_shuffles")
+            return None
+        try:
+            faults.check("collective.exchange", self.stats)
+            out = self._device_shuffle_impl(parts, by, num, scheme,
+                                            descending, nulls_first,
+                                            boundaries)
+        except Exception:
+            self.collective_health.record_failure(self.stats)
+            return None
+        if out is None:
+            self.collective_health.release_probe()
+        else:
+            self.collective_health.record_success(self.stats)
+        return out
+
+    def _device_shuffle_impl(self, parts: List[MicroPartition], by, num: int,
+                             scheme: str, descending=None, nulls_first=None,
+                             boundaries=None) -> Optional[List[MicroPartition]]:
         n = self.n_devices
         if scheme not in ("hash", "random", "range"):
             return None

@@ -12,6 +12,7 @@ pyrunner.py:117 (local bulk runner), and ray_runner.py (distributed). Here:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Iterator, List, Optional
 
 from .context import get_context
@@ -210,17 +211,39 @@ class Runner:
                  stats: Optional[RuntimeStats] = None) -> Iterator[MicroPartition]:
         """AQE dispatch lives here once; backends implement _run_plain."""
         ctx = get_context()
-        if ctx.execution_config.enable_aqe:
+        cfg = ctx.execution_config
+        # one absolute deadline AND one device breaker for the WHOLE query,
+        # created here so AQE stages (each a fresh ExecutionContext) share a
+        # single time budget and a single trip — a dead device must not
+        # re-pay the failure threshold per materialized stage
+        deadline = (time.monotonic() + cfg.execution_timeout_s
+                    if cfg.execution_timeout_s is not None else None)
+        from .execution import DeviceHealth
+
+        health = DeviceHealth(cfg.device_breaker_threshold,
+                              cfg.device_breaker_cooldown_s)
+        collective = DeviceHealth(cfg.device_breaker_threshold,
+                                  cfg.device_breaker_cooldown_s,
+                                  kind="collective")
+        if cfg.enable_aqe:
             from .adaptive import AdaptivePlanner
 
             # AdaptivePlanner hands over already-optimized (sub)plans
             return AdaptivePlanner(
-                lambda p: self._run_plain(p, stats, optimized=True), stats,
-                cfg=ctx.execution_config).run(plan)
-        return self._run_plain(plan, stats)
+                lambda p: self._run_plain(p, stats, optimized=True,
+                                          deadline=deadline,
+                                          device_health=health,
+                                          collective_health=collective),
+                stats, cfg=cfg).run(plan)
+        return self._run_plain(plan, stats, deadline=deadline,
+                               device_health=health,
+                               collective_health=collective)
 
     def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],
-                   optimized: bool = False) -> Iterator[MicroPartition]:
+                   optimized: bool = False,
+                   deadline: Optional[float] = None,
+                   device_health=None,
+                   collective_health=None) -> Iterator[MicroPartition]:
         raise NotImplementedError
 
     def optimize_and_translate(self, plan: LogicalPlan, optimized: bool = False):
@@ -237,10 +260,15 @@ class NativeRunner(Runner):
     name = "native"
 
     def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],
-                   optimized: bool = False) -> Iterator[MicroPartition]:
+                   optimized: bool = False,
+                   deadline: Optional[float] = None,
+                   device_health=None,
+                   collective_health=None) -> Iterator[MicroPartition]:
         ctx = get_context()
         _, phys = self.optimize_and_translate(plan, optimized)
-        exec_ctx = ExecutionContext(ctx.execution_config, stats)
+        exec_ctx = ExecutionContext(ctx.execution_config, stats,
+                                    deadline=deadline,
+                                    device_health=device_health)
         return execute_plan(phys, exec_ctx)
 
 
@@ -254,10 +282,16 @@ class MeshRunner(Runner):
         self.mesh = mesh
 
     def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],
-                   optimized: bool = False) -> Iterator[MicroPartition]:
+                   optimized: bool = False,
+                   deadline: Optional[float] = None,
+                   device_health=None,
+                   collective_health=None) -> Iterator[MicroPartition]:
         ctx = get_context()
         _, phys = self.optimize_and_translate(plan, optimized)
         from .parallel.mesh_exec import MeshExecutionContext
 
-        exec_ctx = MeshExecutionContext(ctx.execution_config, stats, mesh=self.mesh)
+        exec_ctx = MeshExecutionContext(ctx.execution_config, stats,
+                                        mesh=self.mesh, deadline=deadline,
+                                        device_health=device_health,
+                                        collective_health=collective_health)
         return execute_plan(phys, exec_ctx)

@@ -80,12 +80,17 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
             if ctx.stats.is_cancelled():
                 raise QueryCancelledError(
                     f"query cancelled (at {task.op_name})")
+            ctx.check_deadline()
             if task.resource_request:
                 ctx.accountant.admit(task.resource_request)
             pending.append((task, pool.submit(run_task, task)))
             while len(pending) >= window:
                 yield pending.popleft()[1].result()
         while pending:
+            # the deadline stays cooperative through the drain: in-flight
+            # results are yielded, but an expired budget stops the query at
+            # the next partition boundary instead of finishing the backlog
+            ctx.check_deadline()
             yield pending.popleft()[1].result()
     finally:
         for task, fut in pending:

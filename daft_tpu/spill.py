@@ -308,6 +308,9 @@ class PartitionBuffer:
         tbls = part.chunk_tables()
         nrows = 0
         try:
+            from . import faults
+
+            faults.check("spill.write", self.stats)
             # arrow IPC spills (codec per _SPILL_CODEC above): parquet spills
             # paid a full encode+decode round-trip per partition; IPC writes
             # land in the page cache at memcpy speed and the consumer reads
@@ -323,10 +326,12 @@ class PartitionBuffer:
                     w.write_table(at)
                     nrows += at.num_rows
         except Exception:
-            # python-object columns have no arrow representation: hold in
-            # memory rather than fail the query; the slot (with whatever
-            # partial bytes) goes back on the free-list for the next spill
-            # to overwrite
+            # python-object columns have no arrow representation — and a
+            # full/failing spill disk looks the same: hold in memory rather
+            # than fail the query; the slot (with whatever partial bytes)
+            # goes back on the free-list for the next spill to overwrite
+            if self.stats is not None:
+                self.stats.bump("spill_write_failures")
             self.scope.recycle(path)
             return None
         MEMORY_LEDGER.spilled(size)

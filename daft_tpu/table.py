@@ -16,6 +16,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
+from .errors import DaftValueError
 from .datatypes import DataType, TypeKind, try_unify
 from .expressions import (
     AggExpr,
@@ -58,11 +59,11 @@ class Table:
 
     def __init__(self, schema: Schema, columns: List[Series]):
         if len(schema) != len(columns):
-            raise ValueError(f"schema has {len(schema)} fields but got {len(columns)} columns")
+            raise DaftValueError(f"schema has {len(schema)} fields but got {len(columns)} columns")
         n = len(columns[0]) if columns else 0
         for f, c in zip(schema, columns):
             if len(c) != n:
-                raise ValueError(f"column {f.name!r} length {len(c)} != {n}")
+                raise DaftValueError(f"column {f.name!r} length {len(c)} != {n}")
         self.schema = schema
         self._columns = columns
         # per-thread cache of evaluated subexpressions, active only inside
@@ -160,7 +161,7 @@ class Table:
         arrays, fields = [], []
         for f, c in zip(self.schema, self._columns):
             if c.is_python():
-                raise ValueError(f"column {f.name!r} has python dtype; no arrow representation")
+                raise DaftValueError(f"column {f.name!r} has python dtype; no arrow representation")
             arrays.append(c.to_arrow())
             fields.append(pa.field(f.name, c.to_arrow().type))
         return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
@@ -223,7 +224,7 @@ class Table:
             for p in preds:
                 s = p._node.evaluate(self)
                 if not s.dtype.is_boolean() and not s.dtype.is_null():
-                    raise ValueError(f"filter predicate must be boolean, got {s.dtype}")
+                    raise DaftValueError(f"filter predicate must be boolean, got {s.dtype}")
                 mask = s if mask is None else (mask & s)
         if mask is None:
             return self
@@ -267,7 +268,7 @@ class Table:
     def sample(self, fraction: Optional[float] = None, size: Optional[int] = None,
                with_replacement: bool = False, seed: Optional[int] = None) -> "Table":
         if fraction is None and size is None:
-            raise ValueError("sample requires either fraction or size")
+            raise DaftValueError("sample requires either fraction or size")
         n = len(self)
         k = int(round(n * fraction)) if fraction is not None else int(size)
         rng = np.random.RandomState(seed if seed is not None else None)
@@ -281,12 +282,12 @@ class Table:
     @staticmethod
     def concat(tables: List["Table"]) -> "Table":
         if not tables:
-            raise ValueError("concat of zero tables")
+            raise DaftValueError("concat of zero tables")
         first = tables[0]
         names = first.column_names
         for t in tables[1:]:
             if t.column_names != names:
-                raise ValueError(f"concat schema mismatch: {names} vs {t.column_names}")
+                raise DaftValueError(f"concat schema mismatch: {names} vs {t.column_names}")
         cols = []
         for i, name in enumerate(names):
             cols.append(Series.concat([t._columns[i] for t in tables]))
@@ -325,7 +326,7 @@ class Table:
 
     def partition_by_hash(self, exprs: Sequence[Expression], num_partitions: int) -> List["Table"]:
         if num_partitions <= 0:
-            raise ValueError("num_partitions must be positive")
+            raise DaftValueError("num_partitions must be positive")
         h = self.hash_rows(exprs)
         buckets = (h % np.uint64(num_partitions)).astype(np.int64)
         return self._split_by_buckets(buckets, num_partitions)
@@ -421,7 +422,7 @@ class Table:
             while isinstance(node, Alias):
                 node = node.child
             if not isinstance(node, AggExpr):
-                raise ValueError(f"aggregation list contains non-aggregation {e!r}")
+                raise DaftValueError(f"aggregation list contains non-aggregation {e!r}")
             child_s = _broadcast_series(node.child.evaluate(self), n)
             expected_dt = node.to_field(self.schema).dtype
             merged = _bincount_agg_fast(node, child_s, codes, num_groups)
@@ -671,7 +672,7 @@ class Table:
             "outer": "full outer", "semi": "left semi", "anti": "left anti",
         }
         if how not in how_map:
-            raise ValueError(f"unknown join type {how!r}")
+            raise DaftValueError(f"unknown join type {how!r}")
         left_on = _as_expressions(left_on)
         right_on = _as_expressions(right_on)
         lk = self.eval_expression_list(left_on)
@@ -681,7 +682,7 @@ class Table:
         for a, b in zip(lk._columns, rk._columns):
             u = try_unify(a.dtype, b.dtype)
             if u is None:
-                raise ValueError(f"cannot join on {a.dtype} vs {b.dtype}")
+                raise DaftValueError(f"cannot join on {a.dtype} vs {b.dtype}")
             lkc.append(a.cast(u))
             rkc.append(b.cast(u))
 
@@ -818,7 +819,7 @@ class Table:
         for e in exprs:
             s = e._node.evaluate(self)
             if not s.dtype.is_list():
-                raise ValueError(f"explode requires list column, got {s.dtype} for {e.name()!r}")
+                raise DaftValueError(f"explode requires list column, got {s.dtype} for {e.name()!r}")
             list_cols[e.name()] = _broadcast_series(s, len(self))
         first = list_cols[names[0]]
         arr0 = first.to_arrow()
@@ -829,7 +830,7 @@ class Table:
         for nm, s in list_cols.items():
             ln = np.asarray(pc.fill_null(pc.list_value_length(s.to_arrow()), 0), dtype=np.int64)
             if not np.array_equal(ln, lens_np):
-                raise ValueError("exploded columns must have equal list lengths per row")
+                raise DaftValueError("exploded columns must have equal list lengths per row")
         repeat_idx = np.repeat(np.arange(len(self), dtype=np.int64), out_lens)
         out_cols: List[Series] = []
         out_fields: List[Field] = []
@@ -850,7 +851,7 @@ class Table:
         ids = _as_expressions(ids)
         values = _as_expressions(values)
         if not values:
-            raise ValueError("unpivot requires at least one value column")
+            raise DaftValueError("unpivot requires at least one value column")
         id_tbl = self.eval_expression_list(ids) if ids else None
         n = len(self)
         val_series = [e._node.evaluate(self) for e in values]
@@ -858,7 +859,7 @@ class Table:
         for s in val_series[1:]:
             u = try_unify(vdt, s.dtype)
             if u is None:
-                raise ValueError(f"unpivot value columns have incompatible types {vdt} vs {s.dtype}")
+                raise DaftValueError(f"unpivot value columns have incompatible types {vdt} vs {s.dtype}")
             vdt = u
         out_cols: List[Series] = []
         out_fields: List[Field] = []
@@ -917,7 +918,7 @@ def _norm_flag(v, k: int, default):
         return [bool(v)] * k
     out = list(v)
     if len(out) != k:
-        raise ValueError(f"expected {k} flags, got {len(out)}")
+        raise DaftValueError(f"expected {k} flags, got {len(out)}")
     return out
 
 
@@ -1002,7 +1003,7 @@ def _acero_agg_plans(to_agg: List[Expression]):
         while isinstance(node, Alias):
             node = node.child
         if not isinstance(node, AggExpr):
-            raise ValueError(f"aggregation list contains non-aggregation {e!r}")
+            raise DaftValueError(f"aggregation list contains non-aggregation {e!r}")
         spec = _acero_agg_fn(node, threaded=True)
         if spec is None:
             return None

@@ -61,6 +61,20 @@ def add_event(name: str, start_us: float, dur_us: float, tid: int = 0,
         _events.append(ev)
 
 
+def add_instant(name: str, args: Optional[dict] = None) -> None:
+    """Zero-duration marker (chrome-trace 'instant' event) — used for
+    discrete occurrences like injected faults and breaker trips, which have
+    no wall time but matter when lining up a failure against the pipeline."""
+    if _path is None:
+        return
+    ev = {"name": name, "ph": "i", "s": "g", "pid": os.getpid(), "tid": 0,
+          "ts": _now_us() - _t0_us}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+
+
 def flush() -> Optional[str]:
     """Write buffered events; returns the path written (None if disabled)."""
     with _lock:

@@ -1,0 +1,514 @@
+"""Recovery-path tests: the fault-injection registry driving scan retry,
+device-kernel fallback → breaker trip → cooldown recovery, collective →
+host-shuffle fallback, spill-failure hold-in-memory, and query deadlines."""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+import daft_tpu
+from daft_tpu import col, faults
+from daft_tpu.context import get_context
+from daft_tpu.errors import (DaftError, DaftTimeoutError, DaftTransientError)
+from daft_tpu.execution import (DeviceHealth, ExecutionContext, RuntimeStats,
+                                execute_plan)
+from daft_tpu.faults import FaultPlan, InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture
+def cfg():
+    """Fresh ExecutionConfig copy, restored afterwards."""
+    ctx = get_context()
+    old = ctx.execution_config
+    ctx.execution_config = dataclasses.replace(old, enable_result_cache=False)
+    yield ctx.execution_config
+    ctx.execution_config = old
+
+
+# ---------------------------------------------------------------------------
+# plans / registry
+# ---------------------------------------------------------------------------
+
+class TestFaultPlans:
+    def test_first_n_fires_then_heals(self):
+        p = FaultPlan("first_n", n=2)
+        assert [p.should_fire("s", i) for i in (1, 2, 3, 4)] == \
+            [True, True, False, False]
+
+    def test_nth_fires_exactly_once(self):
+        p = FaultPlan("nth", n=3)
+        assert [p.should_fire("s", i) for i in (1, 2, 3, 4)] == \
+            [False, False, True, False]
+
+    def test_rate_is_seed_deterministic(self):
+        a = FaultPlan("rate", rate=0.5, seed=7)
+        b = FaultPlan("rate", rate=0.5, seed=7)
+        seq_a = [a.should_fire("io.get", i) for i in range(1, 200)]
+        seq_b = [b.should_fire("io.get", i) for i in range(1, 200)]
+        assert seq_a == seq_b
+        assert any(seq_a) and not all(seq_a)  # ~50%, not degenerate
+        c = FaultPlan("rate", rate=0.5, seed=8)
+        assert seq_a != [c.should_fire("io.get", i) for i in range(1, 200)]
+
+    def test_rate_zero_and_one(self):
+        assert not any(FaultPlan("rate", rate=0.0, seed=1).should_fire("s", i)
+                       for i in range(1, 50))
+        assert all(FaultPlan("rate", rate=1.0, seed=1).should_fire("s", i)
+                   for i in range(1, 50))
+
+    def test_check_counts_and_raises(self):
+        faults.arm("x.site", "first_n", n=1)
+        with pytest.raises(InjectedFault):
+            faults.check("x.site")
+        faults.check("x.site")  # healed
+        snap = faults.snapshot()
+        assert snap["calls"]["x.site"] == 2
+        assert snap["injected"]["x.site"] == 1
+
+    def test_injected_fault_is_transient_and_oserror(self):
+        assert issubclass(InjectedFault, DaftTransientError)
+        assert issubclass(InjectedFault, OSError)
+        assert issubclass(InjectedFault, DaftError)
+
+    def test_rearm_resets_counters(self):
+        faults.arm("x.site", "always")
+        with pytest.raises(InjectedFault):
+            faults.check("x.site")
+        faults.arm("x.site", "first_n", n=1)  # re-arm: counters start over
+        snap = faults.snapshot()
+        assert snap["calls"]["x.site"] == 0
+        assert snap["injected"]["x.site"] == 0
+
+    def test_disarm_clears(self):
+        faults.arm("x.site", "always")
+        faults.disarm("x.site")
+        faults.check("x.site")  # no raise
+        faults.arm("y.site", "always")
+        faults.disarm()
+        faults.check("y.site")
+
+    def test_inject_context_manager(self):
+        with faults.inject("z.site", "always"):
+            with pytest.raises(InjectedFault):
+                faults.check("z.site")
+        faults.check("z.site")
+
+
+# ---------------------------------------------------------------------------
+# scan retry through the shared RetryPolicy
+# ---------------------------------------------------------------------------
+
+def _write_parquet(tmp_path, n=64):
+    import pyarrow as pa
+    import pyarrow.parquet as papq
+
+    p = str(tmp_path / "t.parquet")
+    papq.write_table(pa.table({"a": list(range(n))}), p)
+    return p
+
+
+class TestScanRetry:
+    def test_transient_faults_retry_then_heal(self, tmp_path, cfg):
+        cfg.scan_retry_attempts = 3
+        cfg.scan_retry_backoff_s = 0.001
+        p = _write_parquet(tmp_path)
+        df = daft_tpu.read_parquet(p)
+        faults.arm("scan.read", "first_n", n=2)
+        out = df.collect().to_pydict()
+        assert out["a"] == list(range(64))
+        assert faults.snapshot()["injected"]["scan.read"] == 2
+
+    def test_retry_exhaustion_raises_transient(self, tmp_path, cfg):
+        cfg.scan_retry_attempts = 3
+        cfg.scan_retry_backoff_s = 0.001
+        p = _write_parquet(tmp_path)
+        df = daft_tpu.read_parquet(p)
+        faults.arm("scan.read", "always")
+        with pytest.raises(DaftTransientError):
+            df.collect().to_pydict()  # to_pydict: scan partitions are lazy
+        # exactly `attempts` attempts were made, not one and not unbounded
+        assert faults.snapshot()["injected"]["scan.read"] == 3
+
+    def test_permanent_errors_do_not_retry(self, tmp_path, cfg):
+        cfg.scan_retry_attempts = 5
+        cfg.scan_retry_backoff_s = 0.001
+        p = _write_parquet(tmp_path)
+        df = daft_tpu.read_parquet(p)
+        faults.arm("scan.read", "always", exc=FileNotFoundError)
+        with pytest.raises(FileNotFoundError):
+            df.collect().to_pydict()
+        assert faults.snapshot()["injected"]["scan.read"] == 1
+
+    def test_backoff_is_jittered_and_capped(self, monkeypatch):
+        from daft_tpu.io.object_store import RetryPolicy, TransientIOError
+
+        sleeps = []
+        monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+        policy = RetryPolicy(attempts=6, backoff_s=1.0, max_backoff_s=2.0)
+
+        def boom():
+            raise TransientIOError("x")
+
+        with pytest.raises(TransientIOError):
+            policy.run(boom)
+        assert len(sleeps) == 5
+        # capped at max_backoff_s (pre-jitter), jitter in [0.5, 1.0)
+        assert all(s < 2.0 for s in sleeps)
+        assert sleeps[-1] >= 1.0  # cap * min-jitter
+
+
+class TestIOClientFaults:
+    def test_io_get_retries_injected_fault(self, tmp_path):
+        from daft_tpu.io.object_store import IOClient, RetryPolicy
+
+        f = tmp_path / "x.bin"
+        f.write_bytes(b"payload")
+        client = IOClient(retry=RetryPolicy(attempts=4, backoff_s=0.001))
+        faults.arm("io.get", "first_n", n=2)
+        assert client.get("file://" + str(f)) == b"payload"
+        assert faults.snapshot()["injected"]["io.get"] == 2
+
+
+# ---------------------------------------------------------------------------
+# device circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestDeviceHealthUnit:
+    def test_trips_after_threshold_consecutive(self):
+        h = DeviceHealth(threshold=3, cooldown_s=60.0)
+        stats = RuntimeStats()
+        h.record_failure(stats)
+        h.record_failure(stats)
+        h.record_success(stats)  # success resets the consecutive count
+        h.record_failure(stats)
+        h.record_failure(stats)
+        assert h.state == DeviceHealth.CLOSED
+        h.record_failure(stats)
+        assert h.state == DeviceHealth.OPEN
+        assert stats.counters["device_breaker_trips"] == 1
+        assert not h.allow(stats)
+
+    def test_cooldown_probe_recovers(self):
+        h = DeviceHealth(threshold=1, cooldown_s=0.02)
+        stats = RuntimeStats()
+        h.record_failure(stats)
+        assert not h.allow(stats)
+        time.sleep(0.03)
+        assert h.allow(stats)          # the one probe
+        assert not h.allow(stats)      # second caller blocked while probing
+        h.record_success(stats)
+        assert h.state == DeviceHealth.CLOSED
+        assert h.allow(stats)
+        assert stats.counters["device_breaker_probes"] == 1
+        assert stats.counters["device_breaker_recoveries"] == 1
+
+    def test_failed_probe_reopens(self):
+        h = DeviceHealth(threshold=1, cooldown_s=0.01)
+        stats = RuntimeStats()
+        h.record_failure(stats)
+        time.sleep(0.02)
+        assert h.allow(stats)
+        h.record_failure(stats)
+        assert h.state == DeviceHealth.OPEN
+        assert stats.counters["device_breaker_reopens"] == 1
+        assert stats.counters["device_breaker_trips"] == 1  # reopen != trip
+
+    def test_stale_success_does_not_close_open_breaker(self):
+        # an async launch that succeeded BEFORE the trip must not re-close
+        # the breaker without a probe (that would route new work straight
+        # back to the dead device)
+        h = DeviceHealth(threshold=2, cooldown_s=60.0)
+        stats = RuntimeStats()
+        h.record_failure(stats)
+        h.record_failure(stats)
+        assert h.state == DeviceHealth.OPEN
+        h.record_success(stats)  # straggler resolver
+        assert h.state == DeviceHealth.OPEN
+        assert stats.counters.get("device_breaker_recoveries", 0) == 0
+
+    def test_abandoned_probe_reclaims_after_cooldown(self):
+        # an async probe whose resolver is never invoked (limit early-stop)
+        # must not wedge the breaker open forever
+        h = DeviceHealth(threshold=1, cooldown_s=0.02)
+        h.record_failure()
+        time.sleep(0.03)
+        assert h.allow()       # probe admitted, then abandoned
+        assert not h.allow()   # still held within the cooldown window
+        time.sleep(0.03)
+        assert h.allow()       # slot reclaimed: a new probe gets through
+
+    def test_declined_probe_releases_slot(self):
+        h = DeviceHealth(threshold=1, cooldown_s=0.01)
+        h.record_failure()
+        time.sleep(0.02)
+        assert h.allow()
+        h.release_probe()  # attempt declined: slot free, breaker half-open
+        assert h.allow()   # the next caller can probe
+
+
+def _device_query(parts=6, rows=30_000):
+    return (daft_tpu.from_pydict(
+        {"x": np.arange(rows, dtype=np.int64) % 997})
+        .into_partitions(parts)
+        .select((col("x") * 2 + 1).alias("y")))
+
+
+class TestDeviceBreakerIntegration:
+    def test_fail_always_trips_once_and_completes_on_host(self, cfg):
+        cfg.use_device_kernels = True
+        cfg.device_min_rows = 1
+        cfg.device_breaker_threshold = 2
+        cfg.device_breaker_cooldown_s = 60.0
+        cfg.executor_threads = 1
+        faults.arm("device.kernel", "always")
+        df = _device_query()
+        got = df.collect().to_pydict()["y"]
+        assert got == [int(x) % 997 * 2 + 1 for x in range(30_000)]
+        c = df.stats.counters
+        # ONE trip, not one failure per partition
+        assert c.get("device_breaker_trips", 0) == 1, c
+        assert c.get("degraded_completions", 0) > 0, c
+        assert c.get("device_projections", 0) == 0, c
+        assert c.get("faults_injected", 0) == cfg.device_breaker_threshold, c
+
+    def test_fail_once_then_heal_recovers_after_cooldown(self, cfg):
+        cfg.use_device_kernels = True
+        cfg.device_min_rows = 1
+        cfg.device_breaker_threshold = 1
+        cfg.device_breaker_cooldown_s = 0.0  # next partition may probe
+        cfg.executor_threads = 1
+        faults.arm("device.kernel", "first_n", n=1)
+        df = _device_query()
+        got = df.collect().to_pydict()["y"]
+        assert got == [int(x) % 997 * 2 + 1 for x in range(30_000)]
+        c = df.stats.counters
+        assert c.get("device_breaker_trips", 0) == 1, c
+        assert c.get("device_breaker_recoveries", 0) == 1, c
+        # later partitions ran on device again
+        assert c.get("device_projections", 0) >= 1, c
+
+    def test_no_faults_no_breaker_activity(self, cfg):
+        cfg.use_device_kernels = True
+        cfg.device_min_rows = 1
+        df = _device_query(parts=2)
+        df.collect()
+        c = df.stats.counters
+        assert c.get("device_breaker_trips", 0) == 0
+        assert c.get("degraded_completions", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# collective breaker → host shuffle fallback
+# ---------------------------------------------------------------------------
+
+class TestCollectiveFallback:
+    def _mesh_ctx(self, cfg):
+        from daft_tpu.parallel.mesh_exec import (MeshExecutionContext,
+                                                 default_mesh)
+
+        return MeshExecutionContext(cfg, mesh=default_mesh(8))
+
+    def _part(self):
+        from daft_tpu.micropartition import MicroPartition
+
+        return MicroPartition.from_table(
+            daft_tpu.from_pydict(
+                {"k": np.arange(256, dtype=np.int64) % 8}
+            ).collect()._result.to_table())
+
+    def test_exchange_failure_declines_to_host(self, cfg):
+        cfg.device_breaker_threshold = 2
+        ctx = self._mesh_ctx(cfg)
+        faults.arm("collective.exchange", "always")
+        p = self._part()
+        assert ctx.try_device_shuffle([p], [col("k")], 8, "hash") is None
+        assert ctx.try_device_shuffle([p], [col("k")], 8, "hash") is None
+        # breaker tripped: the third call never reaches the fault site
+        assert ctx.try_device_shuffle([p], [col("k")], 8, "hash") is None
+        c = ctx.stats.counters
+        assert c.get("collective_breaker_trips", 0) == 1, c
+        assert c.get("degraded_shuffles", 0) == 1, c
+        assert faults.snapshot()["injected"]["collective.exchange"] == 2
+        assert c.get("device_shuffles", 0) == 0, c
+
+    def test_query_completes_via_host_shuffle(self, cfg):
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        cfg.device_breaker_threshold = 1
+        df = (daft_tpu.from_pydict(
+            {"k": np.arange(512, dtype=np.int64) % 7,
+             "v": np.arange(512, dtype=np.int64)})
+            .repartition(8, col("k"))
+            .groupby("k").agg(col("v").sum().alias("s")))
+        faults.arm("collective.exchange", "always")
+        ctx = self._mesh_ctx(cfg)
+        parts = list(execute_plan(translate(optimize(df._plan), cfg), ctx))
+        got = {}
+        for p in parts:
+            d = p.to_pydict()
+            got.update(dict(zip(d["k"], d["s"])))
+        want = {}
+        for i in range(512):
+            want[i % 7] = want.get(i % 7, 0) + i
+        assert got == want
+        assert ctx.stats.counters.get("device_shuffles", 0) == 0
+
+    def test_exchange_heals_after_probe(self, cfg, monkeypatch):
+        from daft_tpu.parallel.mesh_exec import MeshExecutionContext
+
+        cfg.device_breaker_threshold = 1
+        cfg.device_breaker_cooldown_s = 0.0
+        ctx = self._mesh_ctx(cfg)
+        p = self._part()
+        # the exchange itself can't run on this jax build (seed-known gap):
+        # stub the impl — this test is about the breaker's probe/recovery
+        # wiring around it
+        sentinel = [p]
+        monkeypatch.setattr(MeshExecutionContext, "_device_shuffle_impl",
+                            lambda self, *a, **k: sentinel)
+        faults.arm("collective.exchange", "first_n", n=1)
+        assert ctx.try_device_shuffle([p], [col("k")], 8, "hash") is None
+        assert ctx.collective_health.state == DeviceHealth.OPEN
+        out = ctx.try_device_shuffle([p], [col("k")], 8, "hash")
+        assert out is sentinel
+        c = ctx.stats.counters
+        assert c.get("collective_breaker_recoveries", 0) == 1, c
+        assert ctx.collective_health.state == DeviceHealth.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# spill-write failure holds the partition in memory
+# ---------------------------------------------------------------------------
+
+class TestSpillFaults:
+    def test_spill_failure_holds_in_memory(self):
+        from daft_tpu.micropartition import MicroPartition
+        from daft_tpu.spill import PartitionBuffer
+
+        stats = RuntimeStats()
+        buf = PartitionBuffer(budget_bytes=1, stats=stats)
+        part = MicroPartition.from_table(
+            daft_tpu.from_pydict({"a": list(range(1000))})
+            .collect()._result.to_table())
+        faults.arm("spill.write", "always")
+        buf.append(part)
+        [held] = buf.parts()
+        assert held.to_pydict()["a"] == list(range(1000))
+        assert stats.counters.get("spill_write_failures", 0) == 1
+        assert stats.counters.get("spilled_partitions", 0) == 0
+        buf.release()
+
+    def test_spill_works_when_healed(self):
+        from daft_tpu.micropartition import MicroPartition
+        from daft_tpu.spill import PartitionBuffer
+
+        stats = RuntimeStats()
+        buf = PartitionBuffer(budget_bytes=1, stats=stats)
+        part = MicroPartition.from_table(
+            daft_tpu.from_pydict({"a": list(range(1000))})
+            .collect()._result.to_table())
+        faults.arm("spill.write", "first_n", n=1)
+        buf.append(part)   # injected failure: held
+        buf.append(part)   # healed: spills
+        assert stats.counters.get("spilled_partitions", 0) == 1
+        parts = buf.parts()
+        assert all(p.to_pydict()["a"] == list(range(1000)) for p in parts)
+        buf.release()
+
+
+# ---------------------------------------------------------------------------
+# query deadlines
+# ---------------------------------------------------------------------------
+
+class TestDeadlines:
+    def test_deadline_expiry_raises_with_partial_stats(self, cfg):
+        cfg.execution_timeout_s = 1e-6
+        df = _device_query(parts=4)
+        with pytest.raises(DaftTimeoutError) as ei:
+            df.collect()
+        err = ei.value
+        assert isinstance(err, TimeoutError)
+        assert isinstance(err, DaftError)
+        assert isinstance(err.stats, dict) and "counters" in err.stats
+        assert err.stats["counters"].get("deadline_expired", 0) >= 1
+
+    def test_partial_stats_carry_completed_work(self, cfg):
+        stats = RuntimeStats()
+        stats.bump("host_projections", 3)
+        ctx = ExecutionContext(cfg, stats, deadline=time.monotonic() - 1.0)
+        with pytest.raises(DaftTimeoutError) as ei:
+            ctx.check_deadline()
+        assert ei.value.stats["counters"]["host_projections"] == 3
+
+    def test_generous_deadline_does_not_fire(self, cfg):
+        cfg.execution_timeout_s = 300.0
+        df = _device_query(parts=2)
+        got = df.collect().to_pydict()["y"]
+        assert len(got) == 30_000
+
+    def test_no_deadline_by_default(self, cfg):
+        ctx = ExecutionContext(cfg, RuntimeStats())
+        assert ctx.deadline is None
+        ctx.check_deadline()  # no-op
+
+    def test_zero_timeout_is_a_limit_not_disabled(self, cfg):
+        cfg.execution_timeout_s = 0.0
+        ctx = ExecutionContext(cfg, RuntimeStats())
+        assert ctx.deadline is not None
+        time.sleep(0.01)
+        with pytest.raises(DaftTimeoutError):
+            ctx.check_deadline()
+
+
+# ---------------------------------------------------------------------------
+# actor pool shutdown leak detection
+# ---------------------------------------------------------------------------
+
+class TestActorPoolLeak:
+    def test_shutdown_detects_and_counts_leaked_workers(self, caplog):
+        import logging
+        import threading
+
+        from daft_tpu.actor_pool import ActorPool, leaked_thread_count
+
+        release = threading.Event()
+
+        class Stubborn:
+            def __call__(self, x):
+                release.wait(timeout=30)
+                return x
+
+        pool = ActorPool(Stubborn, None, 1)
+        t = threading.Thread(target=lambda: pool.map_batches([(1,)]),
+                             daemon=True)
+        t.start()
+        time.sleep(0.05)  # let the worker pick up the wedged batch
+        base = leaked_thread_count()
+        with caplog.at_level(logging.WARNING, logger="daft_tpu.actor_pool"):
+            pool.shutdown(join_timeout_s=0.05)
+        assert leaked_thread_count() == base + 1
+        assert any("Stubborn" in r.message for r in caplog.records)
+        release.set()
+
+    def test_clean_shutdown_leaks_nothing(self):
+        from daft_tpu.actor_pool import ActorPool, leaked_thread_count
+
+        class Quick:
+            def __call__(self, x):
+                return x + 1
+
+        pool = ActorPool(Quick, None, 2)
+        assert pool.map_batches([(1,), (2,)]) == [2, 3]
+        base = leaked_thread_count()
+        pool.shutdown()
+        assert leaked_thread_count() == base

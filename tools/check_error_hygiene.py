@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Static error-hygiene pass (wired as a tier-1 test via
+tests/test_error_hygiene.py; also runnable standalone: exits nonzero on
+violations).
+
+errors.py states an incremental-adoption contract: modules migrated to the
+DaftError hierarchy must not regress. For every module in MIGRATED this
+pass fails on:
+
+  1. raw builtin raises (``raise ValueError(...)`` and friends) — migrated
+     modules raise the typed hierarchy so ``except DaftError`` stays the
+     engine-wide catch-all;
+  2. bare ``except Exception:`` (or BaseException) whose body is only
+     ``pass`` — swallowed failures hide the exact signals the retry layers
+     and circuit breaker key on.
+
+Modules are added to MIGRATED as they are migrated; never removed.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+MIGRATED = [
+    "daft_tpu/errors.py",
+    "daft_tpu/faults.py",
+    "daft_tpu/context.py",
+    "daft_tpu/expressions.py",
+    "daft_tpu/table.py",
+    "daft_tpu/io/scan.py",
+    "daft_tpu/actor_pool.py",
+    "daft_tpu/scheduler.py",
+]
+
+# builtin exception constructors a migrated module must not raise raw
+# (NotImplementedError is exempt: abstract-method stubs are idiomatic)
+RAW_RAISES = {
+    "ValueError", "TypeError", "RuntimeError", "Exception", "BaseException",
+    "IOError", "OSError", "FileNotFoundError", "PermissionError",
+    "KeyError", "IndexError", "ArithmeticError", "ZeroDivisionError",
+}
+
+Violation = Tuple[str, int, str]
+
+
+def check_source(source: str, relpath: str) -> List[Violation]:
+    out: List[Violation] = []
+    tree = ast.parse(source, filename=relpath)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name in RAW_RAISES:
+                out.append((relpath, node.lineno,
+                            f"raw `raise {name}` — use the DaftError "
+                            "hierarchy (daft_tpu/errors.py)"))
+        elif isinstance(node, ast.Try):
+            for h in node.handlers:
+                if not (len(h.body) == 1 and isinstance(h.body[0], ast.Pass)):
+                    continue
+                label = None
+                if h.type is None:  # `except:` — swallows BaseException
+                    label = "except:"
+                elif (isinstance(h.type, ast.Name)
+                        and h.type.id in ("Exception", "BaseException")):
+                    label = f"except {h.type.id}:"
+                elif isinstance(h.type, ast.Tuple) and any(
+                        isinstance(e, ast.Name)
+                        and e.id in ("Exception", "BaseException")
+                        for e in h.type.elts):
+                    label = "except (... Exception ...):"
+                if label is not None:
+                    out.append((relpath, h.lineno,
+                                f"bare `{label} pass` swallows failures the "
+                                "retry/breaker layers need to see — handle, "
+                                "re-raise typed, or narrow"))
+    return out
+
+
+def run(root: "str | Path | None" = None) -> List[Violation]:
+    root = Path(root) if root else Path(__file__).resolve().parent.parent
+    violations: List[Violation] = []
+    for rel in MIGRATED:
+        path = root / rel
+        violations.extend(check_source(path.read_text(), rel))
+    return violations
+
+
+def main(argv: List[str]) -> int:
+    violations = run(argv[1] if len(argv) > 1 else None)
+    for relpath, lineno, msg in violations:
+        print(f"{relpath}:{lineno}: {msg}")
+    if violations:
+        print(f"error hygiene: {len(violations)} violation(s)")
+        return 1
+    print(f"error hygiene: clean ({len(MIGRATED)} migrated modules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
