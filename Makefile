@@ -1,0 +1,14 @@
+# One-command local check: the same static gates tier-1 runs.
+#   make lint   - daftlint invariants (DTL001-DTL005) + bytecode-compile daft_tpu
+#   make test   - full tier-1 test suite (CPU jax)
+
+PY ?= python
+
+.PHONY: lint test
+
+lint:
+	$(PY) -m tools.daftlint
+	$(PY) -m compileall -q daft_tpu
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

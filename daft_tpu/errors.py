@@ -38,6 +38,12 @@ class DaftResourceError(DaftError, RuntimeError):
     pyrunner.py:352-370)."""
 
 
+class DaftInternalError(DaftError, RuntimeError):
+    """An engine invariant was violated — always a bug in daft_tpu itself,
+    never a user or environment error (reference: DaftError::InternalError).
+    Raised loudly so defects surface instead of corrupting results."""
+
+
 class DaftTransientError(DaftError, IOError):
     """Transient, retryable failure (timeouts, 5xx, connection resets, and
     injected faults). Retry policies key on this type: anything else is

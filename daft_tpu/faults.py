@@ -9,13 +9,10 @@ semantics; arxiv 2604.21275's reproducible transient-fault replay): code at
 a fault *site* calls ``check(site)``, and a test/config arms a plan that
 decides, per call, whether to raise.
 
-Sites wired into the engine:
-
-    io.get              each object-store read attempt (inside the retry loop)
-    scan.read           each scan-task read attempt (inside the retry loop)
-    device.kernel       each device-kernel attempt (sync and async launch)
-    collective.exchange each mesh all_to_all shuffle attempt
-    spill.write         each partition spill write
+Sites wired into the engine are declared in ``SITES`` below — the
+machine-readable registry daftlint's DTL004 rule cross-checks against every
+``check()`` caller (a registered site with no caller is dead resilience
+surface; a caller with an unregistered site can never be armed by name).
 
 Plans are deterministic: ``always`` / ``first_n`` / ``nth`` fire by call
 count; ``rate`` hashes (seed, site, call#) so the same seed reproduces the
@@ -33,6 +30,19 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 from .errors import DaftTransientError, DaftValueError
+
+# The engine's fault-site registry: site name -> where/when it fires. This
+# is the contract daftlint (tools/daftlint, rule DTL004) enforces statically
+# — every entry must have a check() caller in the engine, and engine code
+# must not check() unregistered names. Arbitrary names stay legal at
+# runtime (tests arm synthetic sites to exercise plan mechanics).
+SITES = {
+    "io.get": "each object-store read attempt (inside the retry loop)",
+    "scan.read": "each scan-task read attempt (inside the retry loop)",
+    "device.kernel": "each device-kernel attempt (sync and async launch)",
+    "collective.exchange": "each mesh all_to_all shuffle attempt",
+    "spill.write": "each partition spill write",
+}
 
 
 class InjectedFault(DaftTransientError):

@@ -21,6 +21,7 @@ import hashlib
 import hmac
 import http.client
 import io
+import logging
 import os
 import random
 import threading
@@ -29,8 +30,11 @@ import urllib.parse
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..errors import DaftTransientError
+from ..errors import (DaftIOError, DaftNotFoundError, DaftTransientError,
+                      DaftValueError)
 from .scan import IO_STATS
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -45,11 +49,12 @@ class TransientIOError(DaftTransientError):
     transient failures engine-wide."""
 
 
-class NotFoundIOError(IOError):
+class NotFoundIOError(DaftNotFoundError):
     """The listed container/prefix does not exist (HTTP 404). Distinct from
     transient/auth failures so callers like Storage.list_names can treat
     only genuine absence as an empty directory — an outage or expired
-    credential must propagate, never read as 'table does not exist'."""
+    credential must propagate, never read as 'table does not exist'.
+    A DaftNotFoundError (and so a FileNotFoundError/IOError)."""
 
 
 @dataclass
@@ -195,7 +200,7 @@ def _raise_http(op: str, path: str, status: int):
     swallowing auth/transient failures); everything else stays IOError."""
     if status == 404:
         raise NotFoundIOError(f"{op} {path}: HTTP 404")
-    raise IOError(f"{op} {path}: HTTP {status}")
+    raise DaftIOError(f"{op} {path}: HTTP {status}")
 
 
 class HttpSource(ObjectSource):
@@ -220,7 +225,7 @@ class HttpSource(ObjectSource):
                 url = urllib.parse.urljoin(url, h["location"])
                 continue
             return status, h, data
-        raise IOError(f"{method} {url}: too many redirects")
+        raise DaftIOError(f"{method} {url}: too many redirects")
 
     def get(self, path, range=None, timeout=None):
         headers = {}
@@ -250,14 +255,14 @@ class HttpSource(ObjectSource):
         if status in (409, 412):
             raise FileExistsError(f"PUT {path}: exists (HTTP {status})")
         if status not in (200, 201, 204):
-            raise IOError(f"PUT {path}: HTTP {status}")
+            raise DaftIOError(f"PUT {path}: HTTP {status}")
 
     def ls(self, prefix):
-        raise IOError("http source cannot list; pass explicit urls")
+        raise DaftIOError("http source cannot list; pass explicit urls")
 
     def glob(self, pattern):
         if any(ch in pattern for ch in "*?["):
-            raise IOError("http source cannot glob; pass explicit urls")
+            raise DaftIOError("http source cannot glob; pass explicit urls")
         return [ObjectMeta(pattern)]
 
 
@@ -424,7 +429,7 @@ class S3Source(ObjectSource):
         if status in (409, 412):
             raise FileExistsError(f"PUT {path}: object exists (HTTP {status})")
         if status not in (200, 201):
-            raise IOError(f"PUT {path}: HTTP {status}")
+            raise DaftIOError(f"PUT {path}: HTTP {status}")
 
     def _put_multipart(self, bucket, key, path, data, if_none_match):
         import xml.etree.ElementTree as ET
@@ -435,12 +440,12 @@ class S3Source(ObjectSource):
             payload_hash=self._payload_hash(b"")),
             timeout=self.cfg.timeout)
         if status != 200:
-            raise IOError(f"CreateMultipartUpload {path}: HTTP {status}")
+            raise DaftIOError(f"CreateMultipartUpload {path}: HTTP {status}")
         root = ET.fromstring(body)
         ns = root.tag[:root.tag.index("}") + 1] if root.tag.startswith("{") else ""
         uid_el = root.find(f"{ns}UploadId")
         if uid_el is None or not uid_el.text:
-            raise IOError(f"CreateMultipartUpload {path}: no UploadId")
+            raise DaftIOError(f"CreateMultipartUpload {path}: no UploadId")
         uid = urllib.parse.quote(uid_el.text, safe="")
         try:
             etags: List[str] = []
@@ -454,7 +459,7 @@ class S3Source(ObjectSource):
                     payload_hash=self._payload_hash(part)),
                     body=part, timeout=self.cfg.timeout)
                 if status != 200:
-                    raise IOError(f"UploadPart {n} {path}: HTTP {status}")
+                    raise DaftIOError(f"UploadPart {n} {path}: HTTP {status}")
                 etags.append(h.get("etag", ""))
             manifest = ("<CompleteMultipartUpload>" + "".join(
                 f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
@@ -469,7 +474,7 @@ class S3Source(ObjectSource):
             if status in (409, 412):
                 raise FileExistsError(f"PUT {path}: object exists (HTTP {status})")
             if status != 200:
-                raise IOError(f"CompleteMultipartUpload {path}: HTTP {status}")
+                raise DaftIOError(f"CompleteMultipartUpload {path}: HTTP {status}")
         except BaseException:
             try:  # abort so the store reclaims staged parts; best-effort
                 aurl = self._url(bucket, key, query=f"uploadId={uid}")
@@ -477,7 +482,11 @@ class S3Source(ObjectSource):
                               headers=self._headers("DELETE", aurl),
                               timeout=self.cfg.timeout)
             except Exception:
-                pass
+                # the original upload failure is what propagates; a failed
+                # abort only leaves staged parts for the store's GC
+                logger.warning("AbortMultipartUpload %s failed; staged "
+                               "parts await bucket lifecycle GC", path,
+                               exc_info=True)
             raise
 
     def delete(self, path):
@@ -487,7 +496,7 @@ class S3Source(ObjectSource):
                                        headers=self._headers("DELETE", url),
                                        timeout=self.cfg.timeout)
         if status not in (200, 204):
-            raise IOError(f"DELETE {path}: HTTP {status}")
+            raise DaftIOError(f"DELETE {path}: HTTP {status}")
 
     def ls(self, prefix):
         bucket, key = self._split(prefix)
@@ -503,7 +512,7 @@ class S3Source(ObjectSource):
             if status == 404:
                 raise NotFoundIOError(f"LIST {prefix}: HTTP 404")
             if status != 200:
-                raise IOError(f"LIST {prefix}: HTTP {status}")
+                raise DaftIOError(f"LIST {prefix}: HTTP {status}")
             keys, token = _parse_list_objects(data)
             out.extend(ObjectMeta(f"{self.scheme}://{bucket}/{k}", sz)
                        for k, sz in keys)
@@ -687,7 +696,7 @@ class AzureSource(ObjectSource):
                 rest = p[len(pre):]
                 break
         else:
-            raise ValueError(f"not an azure path: {path}")
+            raise DaftValueError(f"not an azure path: {path}")
         container, _, key = rest.partition("/")
         # abfs://container@account.dfs.core.windows.net/key names the
         # account in the authority: honor it, never silently target a
@@ -696,7 +705,7 @@ class AzureSource(ObjectSource):
             container, authority = container.split("@", 1)
             account = authority.split(".", 1)[0]
             if self.cfg.account and account != self.cfg.account:
-                raise IOError(
+                raise DaftIOError(
                     f"azure path names account {account!r} but the client "
                     f"is configured for {self.cfg.account!r}: {path}")
             if not self.cfg.account:
@@ -711,7 +720,7 @@ class AzureSource(ObjectSource):
                 base = f"{base}/{self.cfg.account}"
             return base
         if not self.cfg.account:
-            raise IOError("azure: AZURE_STORAGE_ACCOUNT is not set")
+            raise DaftIOError("azure: AZURE_STORAGE_ACCOUNT is not set")
         return f"https://{self.cfg.account}.blob.core.windows.net"
 
     def _url(self, container: str, key: str = "", query: str = "") -> str:
@@ -811,7 +820,7 @@ class AzureSource(ObjectSource):
         if status in (409, 412):
             raise FileExistsError(f"PUT {path}: blob exists (HTTP {status})")
         if status not in (200, 201):
-            raise IOError(f"PUT {path}: HTTP {status}")
+            raise DaftIOError(f"PUT {path}: HTTP {status}")
 
     def delete(self, path):
         container, key = self._split(path)
@@ -820,7 +829,7 @@ class AzureSource(ObjectSource):
                                        headers=self._headers("DELETE", url),
                                        timeout=self.cfg.timeout)
         if status not in (200, 202, 204):
-            raise IOError(f"DELETE {path}: HTTP {status}")
+            raise DaftIOError(f"DELETE {path}: HTTP {status}")
 
     def ls(self, prefix):
         container, key = self._split(prefix)
@@ -839,7 +848,7 @@ class AzureSource(ObjectSource):
             if status == 404:
                 raise NotFoundIOError(f"LIST {prefix}: HTTP 404")
             if status != 200:
-                raise IOError(f"LIST {prefix}: HTTP {status}")
+                raise DaftIOError(f"LIST {prefix}: HTTP {status}")
             blobs, marker = _parse_azure_list(data)
             out.extend(ObjectMeta(f"{scheme}://{container}/{name}", size)
                        for name, size in blobs)
@@ -916,7 +925,7 @@ class HuggingFaceSource(ObjectSource):
         else:  # models live at the url root
             kind, repo, inner = "models", "/".join(parts[0:2]), "/".join(parts[2:])
         if not repo or "/" not in repo:
-            raise ValueError(f"hf path needs user/repo: {path}")
+            raise DaftValueError(f"hf path needs user/repo: {path}")
         return kind, repo, inner
 
     def _resolve_url(self, path: str) -> str:
@@ -957,7 +966,7 @@ class HuggingFaceSource(ObjectSource):
         if status == 404:
             raise NotFoundIOError(f"LIST {prefix}: HTTP 404")
         if status != 200:
-            raise IOError(f"LIST {prefix}: HTTP {status}")
+            raise DaftIOError(f"LIST {prefix}: HTTP {status}")
         import json as _json
 
         base = f"hf://{kind}/{repo}" if kind != "models" else f"hf://{repo}"
@@ -1021,7 +1030,7 @@ class IOClient:
                 elif scheme == "file":
                     src = LocalSource()
                 else:
-                    raise ValueError(f"unsupported scheme {scheme}:// in {path}")
+                    raise DaftValueError(f"unsupported scheme {scheme}:// in {path}")
                 self._sources[scheme] = src
         return src
 

@@ -33,6 +33,7 @@ bytes beats syncing dictionaries. Columns that are neither device dtypes
 nor strings (lists, python objects) still force the host path — the same
 Native-vs-Python storage split the reference keeps (SURVEY.md §7 step 1).
 """
+# daftlint: migrated
 
 from __future__ import annotations
 

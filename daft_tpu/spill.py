@@ -191,7 +191,9 @@ class _SpillSlotTask:
             # still existed; make that loud, never silently another
             # partition's bytes.
             if self._scope.generation(self.path) != self._slot_gen:
-                raise RuntimeError(
+                from .errors import DaftInternalError
+
+                raise DaftInternalError(
                     f"spill slot {self.path} was re-taken while a live "
                     "reference could still read it; this is an engine bug")
             with pa.OSFile(self.path) as f:

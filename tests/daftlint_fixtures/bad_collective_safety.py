@@ -1,0 +1,17 @@
+"""DTL003 fixture: an axis-less collective plus an unguarded call into the
+breaker-wrapped exchange layer. Dropped into a scanned parallel/ directory
+by tests/test_daftlint.py; never imported."""
+
+from jax import lax
+
+from .collectives import build_exchange
+
+
+def global_sum(x):
+    return lax.psum(x)  # no axis_name: reduces over whatever axis is ambient
+
+
+def raw_shuffle(mesh, dtypes, trailing):
+    # skips try_device_shuffle's collective_health.allow() gate entirely
+    fn = build_exchange(mesh, 128, dtypes, trailing)
+    return fn

@@ -1,0 +1,13 @@
+"""DTL005 fixture: a module that declares itself migrated to the DaftError
+hierarchy, then regresses. Dropped into a scanned tree by
+tests/test_daftlint.py; never imported."""
+# daftlint: migrated
+
+
+def load(path):
+    if not path:
+        raise ValueError("empty path")  # raw builtin in a migrated module
+    try:
+        return open(path, "rb").read()
+    except Exception:
+        pass  # swallows the exact signal the retry layer keys on

@@ -1,0 +1,16 @@
+"""DTL004 fixture: a check() against a site the registry never declared (a
+test arming the registered names can never make this fire), plus a
+non-literal site. Dropped into a scanned tree by tests/test_daftlint.py;
+never imported."""
+
+from daft_tpu import faults
+
+
+def read_with_typo(buf):
+    faults.check("io.gett")  # not in faults.SITES
+    return buf
+
+
+def read_dynamic(site, buf):
+    faults.check(site)  # unverifiable statically
+    return buf

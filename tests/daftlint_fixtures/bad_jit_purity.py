@@ -1,0 +1,26 @@
+"""DTL001 fixture: a jit-traced kernel with every impurity class. Dropped
+into a scanned kernels/ (or parallel/) directory by tests/test_daftlint.py;
+never imported."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+_CALLS = 0
+
+
+@jax.jit
+def leaky_kernel(x):
+    print("tracing", x.shape)            # trace-time-only print
+    t0 = time.monotonic()                # wall clock frozen into the trace
+    return jnp.sum(x) + t0
+
+
+def counter_kernel(x):
+    global _CALLS                        # trace-time module mutation
+    _CALLS += 1
+    return x.item()                      # host sync mid-trace
+
+
+traced = jax.jit(counter_kernel)

@@ -1,0 +1,30 @@
+"""DTL002 fixture: class attribute and module global each written under a
+lock in one place and without it in another. Dropped into a scanned tree by
+tests/test_daftlint.py; never imported."""
+
+import threading
+
+_registry_lock = threading.Lock()
+_registry = {}
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def reset(self):
+        self.value = 0  # racy: every other write holds self._lock
+
+
+def register(key, item):
+    with _registry_lock:
+        _registry[key] = item
+
+
+def register_fast(key, item):
+    _registry[key] = item  # racy: every other write holds _registry_lock

@@ -1,0 +1,23 @@
+"""Suppression fixture: the same DTL005 violations as bad_error_hygiene.py,
+every one excused by a `# daftlint: disable=...` marker (same-line form,
+comment-above form, and disable=all). The engine must report ZERO findings
+for this file. Never imported."""
+# daftlint: migrated
+
+
+def load(path):
+    if not path:
+        raise ValueError("empty path")  # daftlint: disable=DTL005
+    try:
+        return open(path, "rb").read()
+    # daftlint: disable=DTL005, DTL002
+    except Exception:
+        pass
+
+
+def load_all(path):
+    try:
+        return open(path, "rb").read()
+    # daftlint: disable=all
+    except Exception:
+        pass

@@ -1,0 +1,310 @@
+"""Tier-1 wiring for tools/daftlint: the shipped tree stays clean (modulo
+the committed baseline), every rule catches its fixture, suppressions and
+the baseline round-trip behave, and the CLI's JSON output matches the
+documented schema."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.daftlint import (ALL_RULES, Project, load_baseline, render_json,  # noqa: E402
+                            run_lint, write_baseline)
+from tools.daftlint.engine import suppressions  # noqa: E402
+
+FIXTURES = os.path.join(_ROOT, "tests", "daftlint_fixtures")
+BASELINE = os.path.join(_ROOT, "tools", "daftlint", "baseline.json")
+
+# fixture file -> (destination inside a scanned tree, rule it must trip)
+FIXTURE_MATRIX = {
+    "bad_jit_purity.py": ("daft_tpu/kernels/_fixture_bad.py", "DTL001"),
+    "bad_lock_discipline.py": ("daft_tpu/_fixture_bad.py", "DTL002"),
+    "bad_collective_safety.py": ("daft_tpu/parallel/_fixture_bad.py",
+                                 "DTL003"),
+    "bad_fault_sites.py": ("daft_tpu/_fixture_bad_sites.py", "DTL004"),
+    "bad_error_hygiene.py": ("daft_tpu/_fixture_bad_hygiene.py", "DTL005"),
+}
+
+
+def _lint(root):
+    project = Project.discover(str(root), ["daft_tpu"])
+    return run_lint(project, ALL_RULES, load_baseline(BASELINE))
+
+
+def _copied_tree(tmp_path):
+    shutil.copytree(os.path.join(_ROOT, "daft_tpu"),
+                    os.path.join(str(tmp_path), "daft_tpu"))
+    return tmp_path
+
+
+# ---------------------------------------------------------------------------
+# the engine over the real tree
+# ---------------------------------------------------------------------------
+
+def test_registry_has_five_rules():
+    codes = [r.code for r in ALL_RULES]
+    assert codes == ["DTL001", "DTL002", "DTL003", "DTL004", "DTL005"]
+    assert all(r.name and r.description for r in ALL_RULES)
+
+
+def test_shipped_tree_is_clean():
+    result = _lint(_ROOT)
+    assert not result.new, "\n" + "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in result.new)
+    assert result.exit_code == 0
+    assert result.files_scanned > 40
+
+
+def test_baselined_findings_are_reported_but_do_not_fail():
+    result = _lint(_ROOT)
+    assert load_baseline(BASELINE), "committed baseline should exist"
+    assert {f.key for f in result.baselined} == set(load_baseline(BASELINE))
+    assert all(f.baselined for f in result.baselined)
+
+
+@pytest.mark.parametrize("fixture,dest,rule", [
+    (fx, dest, rule) for fx, (dest, rule) in sorted(FIXTURE_MATRIX.items())])
+def test_added_fixture_trips_its_rule(tmp_path, fixture, dest, rule):
+    """Acceptance: clean tree + any one bad fixture => nonzero, right rule."""
+    root = _copied_tree(tmp_path)
+    shutil.copy(os.path.join(FIXTURES, fixture),
+                os.path.join(str(root), dest.replace("/", os.sep)))
+    result = _lint(root)
+    assert result.exit_code == 1
+    tripped = {f.rule for f in result.new}
+    assert rule in tripped, (rule, tripped)
+    assert all(f.path == dest for f in result.new), result.new
+
+
+def test_suppressed_fixture_stays_clean(tmp_path):
+    root = _copied_tree(tmp_path)
+    shutil.copy(os.path.join(FIXTURES, "suppressed_clean.py"),
+                os.path.join(str(root), "daft_tpu", "_fixture_sup.py"))
+    result = _lint(root)
+    assert result.exit_code == 0
+    assert result.suppressed_count >= 3
+
+
+# ---------------------------------------------------------------------------
+# suppression parsing
+# ---------------------------------------------------------------------------
+
+def test_suppression_same_line_and_next_line():
+    src = ("x = 1  # daftlint: disable=DTL001\n"
+           "# daftlint: disable=DTL002, DTL003\n"
+           "y = 2\n")
+    sup = suppressions(src)
+    assert sup[1] == {"DTL001"}
+    assert sup[3] == {"DTL002", "DTL003"}
+    assert 2 not in sup
+
+
+def test_suppression_all():
+    assert suppressions("# daftlint: disable=all\nz = 1\n")[2] == {"all"}
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+def _mini_violation(root, name="one.py"):
+    pkg = os.path.join(str(root), "daft_tpu")
+    os.makedirs(pkg, exist_ok=True)
+    with open(os.path.join(pkg, name), "w") as f:
+        f.write("# daftlint: migrated\n"
+                "def f():\n"
+                "    raise ValueError('x')\n")
+
+
+def test_baseline_round_trip(tmp_path):
+    _mini_violation(tmp_path)
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    first = run_lint(project, ALL_RULES, {})
+    assert len(first.new) == 1 and first.exit_code == 1
+
+    bl_path = os.path.join(str(tmp_path), "baseline.json")
+    write_baseline(bl_path, first.new,
+                   comments={first.new[0].key: "kept for the test"})
+    entries = load_baseline(bl_path)
+    assert len(entries) == 1
+    assert list(entries.values())[0]["comment"] == "kept for the test"
+
+    # baselined finding disappears from the failing set...
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    second = run_lint(project, ALL_RULES, entries)
+    assert second.exit_code == 0
+    assert len(second.baselined) == 1 and not second.new
+
+    # ...but a NEW finding still fails
+    _mini_violation(tmp_path, "two.py")
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    third = run_lint(project, ALL_RULES, entries)
+    assert third.exit_code == 1
+    assert len(third.new) == 1 and third.new[0].path == "daft_tpu/two.py"
+    assert len(third.baselined) == 1
+
+
+def test_new_duplicate_of_baselined_finding_still_fails(tmp_path):
+    """The baseline budgets OCCURRENCES: one grandfathered swallow does not
+    green-light a second identical swallow added later to the same file."""
+    pkg = os.path.join(str(tmp_path), "daft_tpu")
+    os.makedirs(pkg)
+    body = ("def f():\n    try:\n        g()\n"
+            "    except Exception:\n        pass\n")
+    with open(os.path.join(pkg, "one.py"), "w") as f:
+        f.write("# daftlint: migrated\n" + body)
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    first = run_lint(project, ALL_RULES, {})
+    assert len(first.new) == 1
+    bl_path = os.path.join(str(tmp_path), "baseline.json")
+    write_baseline(bl_path, first.new)
+    with open(os.path.join(pkg, "one.py"), "w") as f:
+        f.write("# daftlint: migrated\n" + body + body.replace("f()", "h()"))
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    again = run_lint(project, ALL_RULES, load_baseline(bl_path))
+    assert again.exit_code == 1
+    assert len(again.new) == 1 and len(again.baselined) == 1
+
+
+def test_fault_registry_not_confused_by_defaults_py(tmp_path):
+    """A file named *defaults.py must not shadow faults.py as the registry,
+    and `defaults.check(...)` is not a fault-site call."""
+    pkg = os.path.join(str(tmp_path), "daft_tpu")
+    os.makedirs(pkg)
+    with open(os.path.join(pkg, "defaults.py"), "w") as f:
+        f.write("X = 1\n\n\ndef check(x):\n    return x\n")
+    with open(os.path.join(pkg, "faults.py"), "w") as f:
+        f.write('SITES = {"io.get": "reads"}\n')
+    with open(os.path.join(pkg, "caller.py"), "w") as f:
+        f.write("from . import faults, defaults\n\n\n"
+                "def r(b):\n"
+                '    faults.check("io.get")\n'
+                '    defaults.check("not.a.site")\n'
+                "    return b\n")
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    result = run_lint(project, ALL_RULES, {})
+    dtl004 = [f for f in result.new if f.rule == "DTL004"]
+    assert not dtl004, dtl004
+
+
+def test_module_closure_under_lock_not_flagged(tmp_path):
+    """Lexical semantics: a helper DEFINED inside `with _lock:` writes the
+    guarded global 'under the lock' (same treatment as the class walk)."""
+    pkg = os.path.join(str(tmp_path), "daft_tpu")
+    os.makedirs(pkg)
+    with open(os.path.join(pkg, "mod.py"), "w") as f:
+        f.write("import threading\n"
+                "_lock = threading.Lock()\n"
+                "_state = {}\n\n\n"
+                "def update():\n"
+                "    with _lock:\n"
+                '        _state["a"] = 1\n\n'
+                "        def helper():\n"
+                '            _state["b"] = 2\n\n'
+                "        helper()\n")
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    result = run_lint(project, ALL_RULES, {})
+    dtl002 = [f for f in result.new if f.rule == "DTL002"]
+    assert not dtl002, dtl002
+
+
+def test_cli_exit_2_on_missing_path():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.daftlint", "daft_tpou_typo"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "not found" in proc.stderr
+
+
+def test_baseline_key_ignores_line_numbers(tmp_path):
+    """Line drift must not churn the baseline: the same violation shifted
+    down a few lines still matches its baseline entry."""
+    _mini_violation(tmp_path)
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    first = run_lint(project, ALL_RULES, {})
+    bl_path = os.path.join(str(tmp_path), "baseline.json")
+    write_baseline(bl_path, first.new)
+    with open(os.path.join(str(tmp_path), "daft_tpu", "one.py"), "w") as f:
+        f.write("# daftlint: migrated\n\n\n\n"
+                "def f():\n"
+                "    raise ValueError('x')\n")
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    again = run_lint(project, ALL_RULES, load_baseline(bl_path))
+    assert again.exit_code == 0 and len(again.baselined) == 1
+
+
+# ---------------------------------------------------------------------------
+# JSON schema + CLI
+# ---------------------------------------------------------------------------
+
+def _check_schema(doc):
+    assert doc["version"] == 1 and doc["tool"] == "daftlint"
+    assert os.path.isabs(doc["root"])
+    assert [r["code"] for r in doc["rules"]] == [
+        "DTL001", "DTL002", "DTL003", "DTL004", "DTL005"]
+    for r in doc["rules"]:
+        assert set(r) == {"code", "name", "description"}
+    counts = doc["counts"]
+    assert set(counts) == {"files", "total", "new", "baselined", "suppressed"}
+    assert counts["total"] == counts["new"] + counts["baselined"]
+    assert counts["total"] == len(doc["findings"])
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "message", "baselined"}
+        assert isinstance(f["line"], int) and f["line"] >= 1
+
+
+def test_render_json_schema():
+    result = _lint(_ROOT)
+    _check_schema(json.loads(render_json(result, ALL_RULES, _ROOT)))
+
+
+def test_cli_clean_tree_and_json():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.daftlint", "--json"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    _check_schema(json.loads(proc.stdout))
+
+
+def test_cli_nonzero_on_new_finding(tmp_path):
+    root = _copied_tree(tmp_path)
+    dest, _rule = FIXTURE_MATRIX["bad_error_hygiene.py"]
+    shutil.copy(os.path.join(FIXTURES, "bad_error_hygiene.py"),
+                os.path.join(str(root), dest.replace("/", os.sep)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.daftlint", "--root", str(root)],
+        cwd=_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "DTL005" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.daftlint", "--list-rules"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    for code in ("DTL001", "DTL002", "DTL003", "DTL004", "DTL005"):
+        assert code in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# parse errors surface instead of crashing
+# ---------------------------------------------------------------------------
+
+def test_syntax_error_becomes_dtl000(tmp_path):
+    pkg = os.path.join(str(tmp_path), "daft_tpu")
+    os.makedirs(pkg)
+    with open(os.path.join(pkg, "broken.py"), "w") as f:
+        f.write("def f(:\n")
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    result = run_lint(project, ALL_RULES, {})
+    assert result.exit_code == 1
+    assert result.new[0].rule == "DTL000"
+    assert "syntax error" in result.new[0].message

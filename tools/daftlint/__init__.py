@@ -1,0 +1,30 @@
+"""daftlint: pluggable AST-based invariant lints for the daft_tpu engine.
+
+The type system cannot see the conventions PR 1's resilience layer depends
+on — jit-traced kernels staying pure, lock-guarded state staying guarded,
+collectives staying breaker-wrapped and axis-named, fault sites staying
+registered and covered, migrated modules staying on the typed error
+hierarchy. daftlint machine-checks them: an engine (`engine.py`) with a
+`Rule` protocol, per-file AST cache, `# daftlint: disable=RULE`
+suppressions, a committed baseline for grandfathered findings, and text +
+JSON output; five rules under `rules/` encode the invariants (DTL001–DTL005).
+
+Run it:
+
+    python -m tools.daftlint               # lint daft_tpu/, exit 1 on new findings
+    python -m tools.daftlint --json        # machine-readable report
+    python -m tools.daftlint --list-rules  # rule table
+
+Adding an invariant is ~50 lines: subclass `Rule` in a module under
+`rules/`, yield `Finding`s from `run()`, and append it to `rules.ALL_RULES`.
+"""
+
+from .engine import (Finding, LintResult, Project, Rule, load_baseline,
+                     render_json, render_text, run_lint, write_baseline)
+from .rules import ALL_RULES
+
+__all__ = [
+    "ALL_RULES", "Finding", "LintResult", "Project", "Rule",
+    "load_baseline", "render_json", "render_text", "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,91 @@
+"""CLI: ``python -m tools.daftlint [paths...] [--json] [--baseline FILE]``.
+
+Exits 0 when the tree is clean (modulo baseline), 1 on new findings, 2 on
+usage errors. ``--write-baseline`` rewrites the baseline from the current
+findings (for grandfathering a just-added rule's backlog — each kept entry
+should gain a ``comment`` explaining why it stays).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from .engine import (Project, load_baseline, render_json, render_text,
+                     run_lint, write_baseline)
+from .rules import ALL_RULES
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="daftlint",
+        description="AST invariant lints for the daft_tpu engine "
+                    "(DTL001-DTL005)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="directories/files to lint, relative to --root "
+                         "(default: daft_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="project root (default: the repo containing this "
+                         "tool)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable JSON report")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file for grandfathered findings "
+                         f"(default: {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report everything as new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite --baseline from the current findings and "
+                         "exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.code}  {r.name:22s} {r.description}")
+        return 0
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    subdirs = args.paths or ["daft_tpu"]
+    # a typo'd path must not green-light CI by linting nothing
+    missing = [s for s in subdirs
+               if not os.path.exists(os.path.join(root, s))]
+    if missing:
+        print(f"daftlint: path(s) not found under {root}: "
+              f"{', '.join(missing)}", file=sys.stderr)
+        return 2
+    project = Project.discover(root, subdirs)
+    if not project.files:
+        print(f"daftlint: no python files found under {root} "
+              f"({', '.join(subdirs)})", file=sys.stderr)
+        return 2
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    result = run_lint(project, ALL_RULES, baseline)
+
+    if args.write_baseline:
+        # comments come from the FILE, not the in-memory dict: with
+        # --no-baseline the dict is empty and the why-kept notes every
+        # grandfathered entry must carry would be silently dropped
+        existing = load_baseline(args.baseline)
+        comments = {k: e["comment"] for k, e in existing.items()
+                    if "comment" in e}
+        write_baseline(args.baseline, result.findings, comments)
+        print(f"daftlint: baseline written to {args.baseline} "
+              f"({len(result.findings)} finding(s))")
+        return 0
+
+    if args.as_json:
+        print(render_json(result, ALL_RULES, root))
+    else:
+        print(render_text(result, ALL_RULES))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,298 @@
+"""daftlint engine: Project (per-file AST cache), Rule protocol, suppression
+comments, baseline handling, and text/JSON rendering.
+
+Contracts:
+
+- A `Finding` is identified for baseline purposes by ``rule:path:message``
+  (line numbers excluded, so unrelated edits that shift lines don't churn
+  the baseline).
+- ``# daftlint: disable=DTL001`` on a line suppresses that line's findings
+  for the named rule(s); on a comment-only line it suppresses the NEXT
+  line. ``disable=all`` suppresses every rule. Comma-separate for several.
+- The committed baseline (``tools/daftlint/baseline.json``) grandfathers
+  findings: they still appear in reports (flagged ``baselined``) but do not
+  fail the run. Only NEW findings exit nonzero.
+- Files that fail to parse produce a single DTL000 finding rather than
+  crashing the run.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+PARSE_ERROR_RULE = "DTL000"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint violation. `path` is a posix relpath from the project root."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    baselined: bool = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "baselined": self.baselined}
+
+
+class Rule:
+    """A lint rule. Subclasses set `code`/`name`/`description` and implement
+    `run(project)`, returning Findings. Rules are project-level (not
+    per-file) so cross-file invariants (fault-site coverage, collective
+    reachability) are first-class; per-file rules just loop project.files."""
+
+    code: str = ""
+    name: str = ""
+    description: str = ""
+
+    def run(self, project: "Project") -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, path: str, line: int, message: str) -> Finding:
+        return Finding(self.code, path, line, message)
+
+
+class Project:
+    """The file set under lint, with cached sources and ASTs (each file is
+    read and parsed at most once per run, however many rules inspect it)."""
+
+    def __init__(self, root: str, files: Sequence[str]):
+        self.root = os.path.abspath(root)
+        self.files: List[str] = sorted(
+            p.replace(os.sep, "/") for p in files)
+        self._sources: Dict[str, str] = {}
+        self._trees: Dict[str, Optional[ast.Module]] = {}
+        self.parse_errors: List[Finding] = []
+
+    @classmethod
+    def discover(cls, root: str,
+                 subdirs: Sequence[str] = ("daft_tpu",)) -> "Project":
+        """All .py files under root/<subdir> for each subdir (a subdir may
+        also be a single .py file)."""
+        root = os.path.abspath(root)
+        files: List[str] = []
+        for sub in subdirs:
+            base = os.path.join(root, sub)
+            if os.path.isfile(base):
+                files.append(os.path.relpath(base, root))
+                continue
+            for dirpath, _dirnames, filenames in os.walk(base):
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        files.append(
+                            os.path.relpath(os.path.join(dirpath, fn), root))
+        return cls(root, files)
+
+    def source(self, rel: str) -> str:
+        if rel not in self._sources:
+            with open(os.path.join(self.root, rel), "r",
+                      encoding="utf-8") as f:
+                self._sources[rel] = f.read()
+        return self._sources[rel]
+
+    def tree(self, rel: str) -> Optional[ast.Module]:
+        """Parsed AST, or None when the file has a syntax error (recorded
+        once as a DTL000 finding)."""
+        if rel not in self._trees:
+            try:
+                self._trees[rel] = ast.parse(self.source(rel), filename=rel)
+            except SyntaxError as e:
+                self._trees[rel] = None
+                self.parse_errors.append(Finding(
+                    PARSE_ERROR_RULE, rel, e.lineno or 1,
+                    f"syntax error: {e.msg}"))
+        return self._trees[rel]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*daftlint:\s*disable=([A-Za-z0-9_,\s-]+)")
+
+
+def suppressions(source: str) -> Dict[int, Set[str]]:
+    """line number -> set of suppressed rule codes (or {'all'}). A marker on
+    a code line covers that line; on a comment-only line it covers the next
+    line (so a suppression can sit above the construct it excuses)."""
+    out: Dict[int, Set[str]] = {}
+    for i, raw in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS_RE.search(raw)
+        if m is None:
+            continue
+        codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+        target = i + 1 if raw.split("#", 1)[0].strip() == "" else i
+        out.setdefault(target, set()).update(codes)
+    return out
+
+
+def _is_suppressed(f: Finding, per_file: Dict[str, Dict[int, Set[str]]],
+                   project: Project) -> bool:
+    if f.path not in per_file:
+        try:
+            per_file[f.path] = suppressions(project.source(f.path))
+        except OSError:
+            per_file[f.path] = {}
+    codes = per_file[f.path].get(f.line)
+    return bool(codes) and (f.rule in codes or "all" in codes)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: Optional[str]) -> Dict[str, dict]:
+    """key -> entry ({rule, path, message, count, comment?}). Missing file
+    => {}. `count` is how many occurrences of the key are grandfathered
+    (duplicate entries in the file accumulate; an entry may also carry an
+    explicit count) — a NEW duplicate of a baselined violation must still
+    fail the run, so run_lint consumes the budget per occurrence."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[str, dict] = {}
+    for entry in data.get("findings", []):
+        key = f"{entry['rule']}:{entry['path']}:{entry['message']}"
+        n = int(entry.get("count", 1))
+        if key in out:
+            out[key]["count"] += n
+        else:
+            out[key] = dict(entry)
+            out[key]["count"] = n
+    return out
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   comments: Optional[Dict[str, str]] = None) -> None:
+    """Write the baseline for the given findings; `comments` maps finding
+    keys to the why-kept note the ISSUE requires for grandfathered entries."""
+    comments = comments or {}
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.line)):
+        e = {"rule": f.rule, "path": f.path, "message": f.message}
+        if f.key in comments:
+            e["comment"] = comments[f.key]
+        entries.append(e)
+    with open(path, "w", encoding="utf-8") as fp:
+        json.dump({"version": 1, "findings": entries}, fp, indent=2,
+                  sort_keys=True)
+        fp.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# run + rendering
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LintResult:
+    findings: List[Finding]      # new + baselined, suppressed removed
+    new: List[Finding]
+    baselined: List[Finding]
+    suppressed_count: int
+    files_scanned: int
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new else 0
+
+
+def run_lint(project: Project, rules: Sequence[Rule],
+             baseline: Optional[Dict[str, dict]] = None) -> LintResult:
+    baseline = baseline or {}
+    # parse everything up front: a syntax-broken file must surface as
+    # DTL000 even when the rule set under run never touches its AST
+    for rel in project.files:
+        project.tree(rel)
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.run(project))
+    raw.extend(project.parse_errors)
+    per_file: Dict[str, Dict[int, Set[str]]] = {}
+    kept: List[Finding] = []
+    suppressed = 0
+    # per-occurrence baseline budget: the Nth duplicate of a baselined
+    # violation beyond its grandfathered count is NEW and fails the run
+    budget = {k: e.get("count", 1) for k, e in baseline.items()}
+    for f in raw:
+        if _is_suppressed(f, per_file, project):
+            suppressed += 1
+            continue
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            f = Finding(f.rule, f.path, f.line, f.message, baselined=True)
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    new = [f for f in kept if not f.baselined]
+    old = [f for f in kept if f.baselined]
+    return LintResult(kept, new, old, suppressed, len(project.files))
+
+
+def render_text(result: LintResult, rules: Sequence[Rule]) -> str:
+    lines = []
+    for f in result.findings:
+        tag = " [baselined]" if f.baselined else ""
+        lines.append(f"{f.path}:{f.line}: {f.rule} {f.message}{tag}")
+    lines.append(
+        f"daftlint: {len(result.new)} new finding(s), "
+        f"{len(result.baselined)} baselined, "
+        f"{result.suppressed_count} suppressed "
+        f"({result.files_scanned} files, {len(rules)} rules)")
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult, rules: Sequence[Rule],
+                root: str) -> str:
+    """The documented JSON schema (see README 'Static analysis'):
+
+    {
+      "version": 1, "tool": "daftlint", "root": "<abs path>",
+      "rules":    [{"code", "name", "description"}, ...],
+      "counts":   {"files", "total", "new", "baselined", "suppressed"},
+      "findings": [{"rule", "path", "line", "message", "baselined"}, ...]
+    }
+    """
+    doc = {
+        "version": 1,
+        "tool": "daftlint",
+        "root": os.path.abspath(root),
+        "rules": [{"code": r.code, "name": r.name,
+                   "description": r.description} for r in rules],
+        "counts": {
+            "files": result.files_scanned,
+            "total": len(result.findings),
+            "new": len(result.new),
+            "baselined": len(result.baselined),
+            "suppressed": result.suppressed_count,
+        },
+        "findings": [f.as_dict() for f in result.findings],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several rules
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

@@ -1,0 +1,21 @@
+"""Rule registry. Adding an invariant = one module here + one entry below."""
+
+from .jit_purity import JitPurityRule
+from .lock_discipline import LockDisciplineRule
+from .collective_safety import CollectiveSafetyRule
+from .fault_sites import FaultSiteCoverageRule
+from .error_hygiene import ErrorHygieneRule
+
+ALL_RULES = [
+    JitPurityRule(),
+    LockDisciplineRule(),
+    CollectiveSafetyRule(),
+    FaultSiteCoverageRule(),
+    ErrorHygieneRule(),
+]
+
+RULES_BY_CODE = {r.code: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_CODE", "JitPurityRule",
+           "LockDisciplineRule", "CollectiveSafetyRule",
+           "FaultSiteCoverageRule", "ErrorHygieneRule"]

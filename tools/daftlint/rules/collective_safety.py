@@ -1,0 +1,178 @@
+"""DTL003 collective-safety: `jax.lax` collectives name their axis and are
+reachable only through breaker-guarded wrappers.
+
+Two checks:
+
+1. **axis-named**: every `lax.<collective>` / `jax.lax.<collective>` call in
+   files under daft_tpu/parallel/ passes the axis explicitly (second
+   positional argument or `axis_name=`/`axis=` keyword). A collective
+   without an axis name compiles against whatever axis is ambient — silent
+   mis-reduction when meshes nest.
+
+2. **breaker-guarded reachability**: a top-level function whose body
+   (nested defs included) invokes a collective is a *bearing* function
+   (e.g. `build_exchange`). Every CALL to a bearing function, anywhere in
+   the linted tree, must sit in a call chain that passes through a
+   breaker-guarded function — one whose body calls `<breaker>.allow(...)`
+   (the DeviceHealth gate). Safety is computed as a fixpoint over the
+   name-based call graph: a caller is safe if it is guarded itself or if
+   every one of ITS call sites is safe; an unguarded entry point with no
+   callers is a finding (nothing stops a future caller skipping the
+   breaker). Calls between functions within the same collectives module are
+   exempt (that module IS the primitive layer).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import Finding, Project, Rule, dotted_name
+
+COLLECTIVES = {"all_to_all", "psum", "pmax", "pmin", "pmean", "all_gather",
+               "ppermute", "pshuffle", "pbroadcast", "psum_scatter"}
+_AXIS_KEYWORDS = {"axis_name", "axis"}
+
+
+def _collective_call(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if parts[-1] in COLLECTIVES and (
+            len(parts) == 1 or parts[-2] == "lax" or parts[0] in ("jax", "lax")):
+        return name
+    return None
+
+
+def _has_axis(node: ast.Call) -> bool:
+    if len(node.args) >= 2:
+        return True
+    return any(kw.arg in _AXIS_KEYWORDS for kw in node.keywords)
+
+
+def _top_level_functions(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
+    """(qualified name, node) for module functions and class methods."""
+    out: List[Tuple[str, ast.AST]] = []
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((stmt.name, stmt))
+        elif isinstance(stmt, ast.ClassDef):
+            for item in stmt.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append((item.name, item))
+    return out
+
+
+def _contains_guard(fn: ast.AST) -> bool:
+    """Does the function body call `<something>.allow(...)` (the breaker)?"""
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "allow"):
+            return True
+    return False
+
+
+class CollectiveSafetyRule(Rule):
+    code = "DTL003"
+    name = "collective-safety"
+    description = ("jax.lax collectives must name an explicit axis and be "
+                   "reachable only via breaker-guarded wrappers")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        parallel_files = [r for r in project.files
+                          if "parallel" in r.split("/")[:-1]]
+
+        # -- check 1: axis named, and find bearing top-level functions
+        bearing: Dict[str, str] = {}  # fn name -> defining file
+        for rel in parallel_files:
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call):
+                    cname = _collective_call(node)
+                    if cname is not None and not _has_axis(node):
+                        out.append(self.finding(
+                            rel, node.lineno,
+                            f"collective `{cname}` without an explicit "
+                            "axis_name"))
+            for fname, fn in _top_level_functions(tree):
+                if any(isinstance(n, ast.Call) and _collective_call(n)
+                       for n in ast.walk(fn)):
+                    bearing[fname] = rel
+        if not bearing:
+            return out
+
+        # -- check 2: every call to a bearing function is breaker-guarded.
+        # Build a project-wide name-keyed call graph over top-level functions.
+        guarded: Set[str] = set()
+        call_sites: Dict[str, List[Tuple[str, Optional[str], int]]] = {}
+        #   callee name -> [(file, enclosing top-level fn name or None, line)]
+        for rel in project.files:
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            fns = _top_level_functions(tree)
+            for fname, fn in fns:
+                if _contains_guard(fn):
+                    guarded.add(fname)
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        callee = self._callee_name(node)
+                        if callee is not None:
+                            call_sites.setdefault(callee, []).append(
+                                (rel, fname, node.lineno))
+            # module-level call sites (outside any function)
+            in_fn = set()
+            for _fname, fn in fns:
+                in_fn.update(id(n) for n in ast.walk(fn))
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) and id(node) not in in_fn:
+                    callee = self._callee_name(node)
+                    if callee is not None:
+                        call_sites.setdefault(callee, []).append(
+                            (rel, None, node.lineno))
+
+        safe_memo: Dict[str, bool] = {}
+
+        def safe(fname: Optional[str], stack: Set[str]) -> bool:
+            if fname is None:
+                return False  # module-level call: nothing guards it
+            if fname in guarded:
+                return True
+            if fname in safe_memo:
+                return safe_memo[fname]
+            if fname in stack:
+                return False  # cycle without a guard anywhere on it
+            sites = call_sites.get(fname, [])
+            if not sites:
+                safe_memo[fname] = False  # unguarded entry point
+                return False
+            stack.add(fname)
+            ok = all(safe(caller, stack) for _rel, caller, _ln in sites)
+            stack.discard(fname)
+            safe_memo[fname] = ok
+            return ok
+
+        for bname, bfile in sorted(bearing.items()):
+            for rel, caller, line in call_sites.get(bname, []):
+                if rel == bfile:
+                    continue  # intra-module calls in the primitive layer
+                if not safe(caller, set()):
+                    where = f"`{caller}`" if caller else "module level"
+                    out.append(self.finding(
+                        rel, line,
+                        f"call to collective-bearing `{bname}` from {where} "
+                        "is not reachable through a breaker-guarded wrapper "
+                        "(.allow() gate)"))
+        return out
+
+    @staticmethod
+    def _callee_name(node: ast.Call) -> Optional[str]:
+        name = dotted_name(node.func)
+        if name is None:
+            return None
+        return name.split(".")[-1]

@@ -1,0 +1,115 @@
+"""DTL005 error-hygiene: migrated modules stay on the DaftError hierarchy.
+
+Port of the former tools/check_error_hygiene.py into the rule framework,
+keeping its incremental-adoption contract: modules listed in MIGRATED (the
+list only grows, never shrinks) must not
+
+1. raise raw builtin exceptions (``raise ValueError(...)`` and friends) —
+   migrated modules raise the typed hierarchy so ``except DaftError`` stays
+   the engine-wide catch-all (NotImplementedError stays exempt:
+   abstract-method stubs are idiomatic);
+2. contain bare ``except Exception:`` / ``except BaseException:`` /
+   ``except:`` handlers whose body is ONLY ``pass`` — swallowed failures
+   hide the exact signals the retry layers and circuit breakers key on.
+
+Beyond MIGRATED, any file whose source carries a ``# daftlint: migrated``
+marker opts itself into the same contract — new modules declare migration
+in-file instead of editing this list.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from ..engine import Finding, Project, Rule
+
+# Modules migrated to the DaftError hierarchy. Entries are appended as
+# modules migrate and NEVER removed (tests/test_error_hygiene.py pins the
+# floor) — regressing a migrated module is exactly what this rule catches.
+MIGRATED = [
+    "daft_tpu/errors.py",
+    "daft_tpu/faults.py",
+    "daft_tpu/context.py",
+    "daft_tpu/expressions.py",
+    "daft_tpu/table.py",
+    "daft_tpu/io/scan.py",
+    "daft_tpu/actor_pool.py",
+    "daft_tpu/scheduler.py",
+    "daft_tpu/spill.py",
+    "daft_tpu/io/object_store.py",
+]
+
+MIGRATED_MARKER = "# daftlint: migrated"
+
+# builtin exception constructors a migrated module must not raise raw
+RAW_RAISES = {
+    "ValueError", "TypeError", "RuntimeError", "Exception", "BaseException",
+    "IOError", "OSError", "FileNotFoundError", "PermissionError",
+    "KeyError", "IndexError", "ArithmeticError", "ZeroDivisionError",
+}
+
+Violation = Tuple[int, str]
+
+
+def check_tree(tree: ast.AST) -> List[Violation]:
+    """(lineno, message) violations in a parsed module."""
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name in RAW_RAISES:
+                out.append((node.lineno,
+                            f"raw `raise {name}` — use the DaftError "
+                            "hierarchy (daft_tpu/errors.py)"))
+        elif isinstance(node, ast.Try):
+            for h in node.handlers:
+                if not (len(h.body) == 1 and isinstance(h.body[0], ast.Pass)):
+                    continue
+                label = None
+                if h.type is None:  # `except:` — swallows BaseException
+                    label = "except:"
+                elif (isinstance(h.type, ast.Name)
+                        and h.type.id in ("Exception", "BaseException")):
+                    label = f"except {h.type.id}:"
+                elif isinstance(h.type, ast.Tuple) and any(
+                        isinstance(e, ast.Name)
+                        and e.id in ("Exception", "BaseException")
+                        for e in h.type.elts):
+                    label = "except (... Exception ...):"
+                if label is not None:
+                    out.append((h.lineno,
+                                f"bare `{label} pass` swallows failures the "
+                                "retry/breaker layers need to see — handle, "
+                                "re-raise typed, or narrow"))
+    return out
+
+
+def check_source(source: str, relpath: str = "<string>") -> List[Violation]:
+    """Convenience used by tests: parse then check."""
+    return check_tree(ast.parse(source, filename=relpath))
+
+
+class ErrorHygieneRule(Rule):
+    code = "DTL005"
+    name = "error-hygiene"
+    description = ("migrated modules must not raise raw builtins or swallow "
+                   "`except Exception: pass`")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        migrated = set(MIGRATED)
+        for rel in project.files:
+            if rel not in migrated and MIGRATED_MARKER not in project.source(rel):
+                continue
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            out.extend(self.finding(rel, lineno, msg)
+                       for lineno, msg in check_tree(tree))
+        return out

@@ -1,0 +1,127 @@
+"""DTL004 fault-site coverage: the fault-injection registry and its call
+sites must agree.
+
+`daft_tpu/faults.py` declares the engine's fault sites in a module-level
+`SITES` mapping (site name -> description). This rule cross-checks it
+against every `faults.check(...)` call in the linted tree:
+
+- a **registered site with no caller** is dead resilience surface — the
+  site's recovery path can never be exercised;
+- a **caller using an unregistered site** silently never fires (tests
+  arming the registered name hit a different string than production code
+  checks) — the exact class of bug the registry exists to prevent;
+- a **non-literal site argument** cannot be statically verified and is
+  flagged so the author either inlines the literal or suppresses with a
+  reason.
+
+The registry file is found by path suffix `faults.py`; if it exists but
+declares no SITES mapping, that is itself a finding (the registry is the
+contract). Projects without a faults.py (unit-test fixture trees) skip the
+rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import Finding, Project, Rule, dotted_name
+
+
+def _find_sites(tree: ast.Module) -> Optional[Tuple[Dict[str, int], int]]:
+    """(site -> lineno, SITES lineno) from a module-level `SITES = {...}`
+    dict/set/tuple/list of string constants; None when absent."""
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+        if not any(isinstance(t, ast.Name) and t.id == "SITES"
+                   for t in targets):
+            continue
+        value = stmt.value
+        keys: List[ast.expr] = []
+        if isinstance(value, ast.Dict):
+            keys = [k for k in value.keys if k is not None]
+        elif isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            keys = list(value.elts)
+        else:
+            return {}, stmt.lineno
+        out: Dict[str, int] = {}
+        for k in keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out[k.value] = k.lineno
+        return out, stmt.lineno
+    return None
+
+
+class FaultSiteCoverageRule(Rule):
+    code = "DTL004"
+    name = "fault-site-coverage"
+    description = ("every registered fault site has a faults.check() caller "
+                   "and no caller uses an unregistered site")
+
+    def run(self, project: Project) -> List[Finding]:
+        registry_rel = next(
+            (r for r in project.files
+             if r == "faults.py" or r.endswith("/faults.py")), None)
+        if registry_rel is None:
+            return []
+        tree = project.tree(registry_rel)
+        if tree is None:
+            return []
+        found = _find_sites(tree)
+        if found is None:
+            return [self.finding(
+                registry_rel, 1,
+                "no module-level `SITES` registry found — declare the fault "
+                "sites so coverage can be checked")]
+        sites, sites_line = found
+
+        out: List[Finding] = []
+        used: Dict[str, Tuple[str, int]] = {}
+        for rel in project.files:
+            if rel == registry_rel:
+                continue
+            ftree = project.tree(rel)
+            if ftree is None:
+                continue
+            for node in ast.walk(ftree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                parts = name.split(".")
+                # exact-segment match: `faults.check` / `x.faults.check`,
+                # never `defaults.check`
+                if len(parts) < 2 or parts[-1] != "check" or \
+                        parts[-2] != "faults":
+                    continue
+                if not node.args:
+                    out.append(self.finding(
+                        rel, node.lineno, "faults.check() without a site"))
+                    continue
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    site = arg.value
+                    used.setdefault(site, (rel, node.lineno))
+                    if site not in sites:
+                        out.append(self.finding(
+                            rel, node.lineno,
+                            f"fault site `{site}` is not registered in "
+                            "faults.SITES — injections armed at registered "
+                            "names will never hit it"))
+                else:
+                    out.append(self.finding(
+                        rel, node.lineno,
+                        "non-literal fault site argument cannot be "
+                        "statically checked against faults.SITES"))
+        for site in sorted(set(sites) - set(used)):
+            out.append(self.finding(
+                registry_rel, sites.get(site, sites_line),
+                f"registered fault site `{site}` has no faults.check() "
+                "caller — its recovery path can never be exercised"))
+        return out

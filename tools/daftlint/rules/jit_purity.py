@@ -1,0 +1,141 @@
+"""DTL001 jit-purity: functions traced by jax.jit must stay pure.
+
+Scope: files under daft_tpu/kernels/ and daft_tpu/parallel/. A traced
+function is one decorated with `@jax.jit` / `@jit` /
+`@functools.partial(jax.jit, ...)`, or passed (by name, lambda, or through
+`jax.shard_map`/`jax.pmap`/`jax.vmap`) to a `jax.jit(...)` call.
+
+Inside a traced function (nested defs included — they trace too) we flag:
+
+- wall-clock / RNG calls (`time.*`, `random.*`, `np.random.*`): traced once
+  at compile time, frozen forever after — silent nondeterminism;
+- `print(...)`: fires at trace time only, lies about per-call behavior
+  (jax.debug.print is the traced alternative);
+- `global` statements: mutating module state from inside a trace runs once
+  per compilation, not per call;
+- host sync (`.item()`, `.tolist()`, `.block_until_ready()`,
+  `jax.device_get`, `np.asarray(...)` on traced values): forces a device
+  round-trip mid-trace or fails under jit outright.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..engine import Finding, Project, Rule, dotted_name
+
+IMPURE_MODULES = {"time", "random"}
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+HOST_SYNC_FUNCS = {"jax.device_get", "np.asarray", "np.array",
+                   "numpy.asarray", "numpy.array"}
+TRACER_WRAPPERS = {"shard_map", "pmap", "vmap", "grad", "value_and_grad"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """`jit`, `jax.jit`, or `functools.partial(jax.jit, ...)`."""
+    name = dotted_name(node)
+    if name in ("jit", "jax.jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def _traced_arg_names(call: ast.Call) -> List[str]:
+    """Names of functions a jax.jit(...) call traces, unwrapping one level
+    of shard_map/pmap/vmap, e.g. jax.jit(jax.shard_map(body, ...)) -> body."""
+    out: List[str] = []
+    for arg in call.args[:1]:
+        if isinstance(arg, ast.Name):
+            out.append(arg.id)
+        elif isinstance(arg, ast.Call):
+            inner = dotted_name(arg.func)
+            if inner and inner.split(".")[-1] in TRACER_WRAPPERS and arg.args:
+                first = arg.args[0]
+                if isinstance(first, ast.Name):
+                    out.append(first.id)
+    return out
+
+
+class JitPurityRule(Rule):
+    code = "DTL001"
+    name = "jit-purity"
+    description = ("jit-traced kernels must not touch time/random/print/"
+                   "global state or force host sync")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for rel in project.files:
+            segs = rel.split("/")[:-1]
+            if "kernels" not in segs and "parallel" not in segs:
+                continue
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            out.extend(self._check_module(rel, tree))
+        return out
+
+    def _check_module(self, rel: str, tree: ast.Module) -> List[Finding]:
+        traced_names: Set[str] = set()
+        traced_fns: List[ast.AST] = []
+        lambdas_traced: List[ast.Lambda] = []
+        all_defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                all_defs.setdefault(node.name, []).append(node)
+                if any(_is_jit_expr(d) for d in node.decorator_list):
+                    traced_fns.append(node)
+            elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+                traced_names.update(_traced_arg_names(node))
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Lambda):
+                        lambdas_traced.append(arg)
+                    elif isinstance(arg, ast.Call):
+                        for a in arg.args[:1]:
+                            if isinstance(a, ast.Lambda):
+                                lambdas_traced.append(a)
+        for name in traced_names:
+            traced_fns.extend(all_defs.get(name, []))
+        out: List[Finding] = []
+        seen = set()
+        for fn in traced_fns:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            out.extend(self._check_traced(rel, fn, fn.name))
+        for lam in lambdas_traced:
+            out.extend(self._check_traced(rel, lam, "<lambda>"))
+        return out
+
+    def _check_traced(self, rel: str, fn: ast.AST,
+                      label: str) -> List[Finding]:
+        out: List[Finding] = []
+
+        def flag(node: ast.AST, msg: str) -> None:
+            out.append(self.finding(
+                rel, getattr(node, "lineno", 1),
+                f"{msg} inside jit-traced `{label}`"))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                flag(node, "`global` statement (trace-time module mutation)")
+            elif isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if fname == "print":
+                    flag(node, "`print` call (fires at trace time only)")
+                elif fname is not None:
+                    root = fname.split(".")[0]
+                    if "." in fname and root in IMPURE_MODULES:
+                        flag(node, f"impure call `{fname}`")
+                    elif fname.startswith(("np.random.", "numpy.random.",
+                                           "jax.random.PRNGKey")):
+                        flag(node, f"impure call `{fname}`")
+                    elif fname in HOST_SYNC_FUNCS:
+                        flag(node, f"host sync `{fname}`")
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in HOST_SYNC_METHODS):
+                    flag(node, f"host sync `.{node.func.attr}()`")
+        return out

@@ -1,0 +1,230 @@
+"""DTL002 lock-discipline: state written under a lock is ALWAYS written
+under that lock — a lightweight static race detector.
+
+Scope: every file under lint (the invariant matters most in execution.py,
+actor_pool.py, spill.py, faults.py, and io/object_store.py, but holds
+engine-wide).
+
+Model, per class: any attribute assigned (`self.x = ...`, `self.x += ...`,
+`self.x[k] = ...`) inside a `with self.<lockish>:` block — where <lockish>
+is an attribute whose name contains lock/cond/mutex — is "guarded". Every
+other write to a guarded attribute outside such a block is a finding,
+except in `__init__`/`__post_init__`/`__new__` (construction happens before
+the object is shared). The same model applies at module scope: module
+globals assigned under `with <lockish-name>:` inside any function must
+never be assigned outside one (module top level, which runs at import
+before threads exist, is exempt).
+
+Deliberately lightweight: reads are not checked, `.append()`-style mutating
+method calls are not tracked (too many false positives on single-consumer
+structures), lock scope is lexical (a closure DEFINED under a lock is
+treated as running under it). When a write is intentionally lock-free
+(single-threaded phase, monotonic flag), suppress with
+`# daftlint: disable=DTL002` and say why, or baseline it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set, Tuple
+
+from ..engine import Finding, Project, Rule
+
+_LOCKISH = re.compile(r"lock|cond|mutex", re.IGNORECASE)
+_INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+# (attr_name, lineno, under_lock)
+_Write = Tuple[str, int, bool]
+
+
+def _self_attr_written(target: ast.AST) -> Optional[str]:
+    """Attribute name when `target` writes self.<attr> or self.<attr>[...]."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"):
+        return target.attr
+    return None
+
+
+def _module_name_written(target: ast.AST,
+                         module_names: Set[str],
+                         declared_global: Set[str]) -> Optional[str]:
+    """Module-global name when `target` writes one: a plain Name declared
+    `global` in the enclosing function, or a subscript store into a name
+    bound at module top level (`_plans[site] = ...`)."""
+    if isinstance(target, ast.Name) and target.id in declared_global:
+        return target.id
+    if isinstance(target, ast.Subscript) and isinstance(target.value, ast.Name):
+        name = target.value.id
+        if name in module_names:
+            return name
+    return None
+
+
+def _is_self_lock(expr: ast.AST) -> bool:
+    return (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and _LOCKISH.search(expr.attr) is not None)
+
+
+def _is_module_lock(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Name) and _LOCKISH.search(expr.id) is not None
+
+
+def _assign_targets(node: ast.stmt) -> List[ast.AST]:
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        return [node.target] if getattr(node, "value", True) is not None else []
+    return []
+
+
+class LockDisciplineRule(Rule):
+    code = "DTL002"
+    name = "lock-discipline"
+    description = ("attributes/globals written under a lock must never be "
+                   "written outside it")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for rel in project.files:
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            out.extend(self._check_module_scope(rel, tree))
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    out.extend(self._check_class(rel, node))
+        return out
+
+    # --- class scope ------------------------------------------------------
+
+    def _check_class(self, rel: str, cls: ast.ClassDef) -> List[Finding]:
+        writes: List[_Write] = []      # outside init
+        guarded: Set[str] = set()
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self._collect_fn(item, False, writes, guarded,
+                             item.name in _INIT_METHODS)
+        if not guarded:
+            return []
+        return [
+            self.finding(rel, lineno,
+                         f"`self.{attr}` is written under `{cls.name}`'s "
+                         "lock elsewhere but written here without it")
+            for attr, lineno, under in writes
+            if attr in guarded and not under
+        ]
+
+    def _collect_fn(self, fn: ast.AST, under: bool, writes: List[_Write],
+                    guarded: Set[str], in_init: bool) -> None:
+        """Record self-attribute writes in `fn`'s body with their lexical
+        lock state; writes under a self-lock mark the attribute guarded."""
+
+        def visit(node: ast.AST, under: bool) -> None:
+            if isinstance(node, ast.With):
+                locked = under or any(
+                    _is_self_lock(item.context_expr)
+                    for item in node.items)
+                for child in node.body:
+                    visit(child, locked)
+                return
+            for tgt in _assign_targets(node) if isinstance(node, ast.stmt) else []:
+                attr = _self_attr_written(tgt)
+                if attr is not None:
+                    if under:
+                        guarded.add(attr)
+                    if not in_init:
+                        writes.append((attr, node.lineno, under))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    continue
+                visit(child, under)
+
+        for stmt in getattr(fn, "body", []):
+            visit(stmt, under)
+
+    # --- module scope -----------------------------------------------------
+
+    def _check_module_scope(self, rel: str,
+                            tree: ast.Module) -> List[Finding]:
+        module_names: Set[str] = set()
+        for stmt in tree.body:
+            for tgt in _assign_targets(stmt):
+                if isinstance(tgt, ast.Name):
+                    module_names.add(tgt.id)
+                elif isinstance(tgt, ast.Tuple):
+                    module_names.update(
+                        e.id for e in tgt.elts if isinstance(e, ast.Name))
+
+        writes: List[_Write] = []
+        guarded: Set[str] = set()
+
+        def scan_fn(fn: ast.AST, under0: bool = False) -> None:
+            declared_global: Set[str] = set()
+
+            def collect_globals(n: ast.AST) -> None:
+                for child in ast.iter_child_nodes(n):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        continue  # nested fns declare their own globals
+                    if isinstance(child, ast.Global):
+                        declared_global.update(child.names)
+                    collect_globals(child)
+
+            collect_globals(fn)
+
+            def visit(node: ast.AST, under: bool) -> None:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # lexical lock state: a closure defined under the lock
+                    # is treated as running under it (same semantics as the
+                    # class-scope walk)
+                    scan_fn(node, under)
+                    return
+                if isinstance(node, ast.With):
+                    locked = under or any(
+                        _is_module_lock(item.context_expr)
+                        for item in node.items)
+                    for child in node.body:
+                        visit(child, locked)
+                    return
+                for tgt in (_assign_targets(node)
+                            if isinstance(node, ast.stmt) else []):
+                    name = _module_name_written(tgt, module_names,
+                                                declared_global)
+                    if name is not None:
+                        if under:
+                            guarded.add(name)
+                        writes.append((name, node.lineno, under))
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, ast.expr):
+                        continue
+                    visit(child, under)
+
+            for stmt in getattr(fn, "body", []):
+                visit(stmt, under0)
+
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_fn(stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                # methods were handled by _check_class for self attrs; module
+                # globals written from methods still count here
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        scan_fn(item)
+        if not guarded:
+            return []
+        return [
+            self.finding(rel, lineno,
+                         f"module global `{name}` is written under a lock "
+                         "elsewhere but written here without it")
+            for name, lineno, under in writes
+            if name in guarded and not under
+        ]
