@@ -54,12 +54,17 @@ except ModuleNotFoundError as _e:  # only tolerate api.py itself being absent (b
 # callers need them even before any expression namespace property is touched).
 from . import multimodal  # noqa: E402,F401
 
-# The sql SUBMODULE shares its name with the sql() entry point: importing the
-# submodule (api.sql does it lazily) rebinds the package attribute to the
-# module, breaking daft_tpu.sql("SELECT ..."). Import the submodule eagerly,
-# then pin the attribute back to the function — later submodule imports no
-# longer touch the package attribute.
-from . import sql as _sql_module  # noqa: E402,F401
+# The sql SUBMODULE shares its name with the sql() entry point: the first
+# REAL submodule import (api.sql does it lazily) rebinds the package
+# attribute to the module, breaking every later daft_tpu.sql("SELECT ...").
+# `from . import sql` cannot force that import here — the package already
+# has a `sql` attribute (the function, from `from .api import *` above), so
+# the from-list machinery skips the submodule entirely. importlib imports
+# it for real; re-pinning the function afterwards makes the attribute
+# stable because later submodule imports hit sys.modules and never setattr.
+import importlib as _importlib  # noqa: E402
+
+_importlib.import_module(f"{__name__}.sql")
 from .api import sql  # noqa: E402,F401
 
 from .viz import register_viz_hook  # noqa: E402,F401

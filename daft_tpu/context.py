@@ -62,6 +62,12 @@ class ExecutionConfig:
     # (reference: RayRunner's cores + max_task_backlog dynamic bound,
     # ray_runner.py:504-685); -1 = auto (one backlog slot per worker)
     max_task_backlog: int = -1
+    # two-phase approximate aggregations (daft_tpu/sketch/): multi-partition
+    # approx_count_distinct / approx_percentiles plan as sketch->merge stages
+    # whose exchange ships serialized sketch bytes, O(sketch_size x
+    # partitions). False restores the raw-row shuffle/gather path (the
+    # before/after axis bench.py's sketch_exchange rung measures).
+    sketch_aggregations: bool = True
     # TPU-specific: route eligible projections/aggregations through the jax
     # device kernel layer (kernels/device.py); host pyarrow path otherwise.
     use_device_kernels: bool = False

@@ -118,12 +118,18 @@ class DataFrame:
         self.collect()
         snap = self.stats.snapshot()
         rows, wall = snap["op_rows"], snap["op_wall_ns"]
+        tput = self.stats.op_throughput()
         names = sorted(set(rows) | set(wall), key=lambda k: -wall.get(k, 0))
         w = max([len(n) for n in names] + [8])
         lines = ["== Runtime Stats ==",
-                 f"{'operator':<{w}}  {'rows out':>12}  {'wall ms':>10}"]
+                 f"{'operator':<{w}}  {'rows out':>12}  {'wall ms':>10}"
+                 f"  {'rows/s':>12}  {'MB/s':>8}"]
         for n in names:
-            lines.append(f"{n:<{w}}  {rows.get(n, 0):>12,}  {wall.get(n, 0) / 1e6:>10.2f}")
+            t = tput.get(n, {})
+            lines.append(
+                f"{n:<{w}}  {rows.get(n, 0):>12,}  {wall.get(n, 0) / 1e6:>10.2f}"
+                f"  {t.get('rows_per_sec', 0.0):>12,.0f}"
+                f"  {t.get('bytes_per_sec', 0.0) / 1e6:>8.1f}")
         counters = snap["counters"]
         if counters:
             lines.append("")

@@ -158,6 +158,7 @@ class RuntimeStats:
         self.counters: Dict[str, int] = {}
         self.op_rows: Dict[str, int] = {}
         self.op_wall_ns: Dict[str, int] = {}
+        self.op_bytes: Dict[str, int] = {}
         self._cancelled = threading.Event()
 
     def cancel(self) -> None:
@@ -177,10 +178,29 @@ class RuntimeStats:
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + n
 
-    def record_op(self, name: str, rows: int, wall_ns: int) -> None:
+    def record_op(self, name: str, rows: int, wall_ns: int,
+                  bytes_out: int = 0) -> None:
         with self._lock:
             self.op_rows[name] = self.op_rows.get(name, 0) + rows
             self.op_wall_ns[name] = self.op_wall_ns.get(name, 0) + wall_ns
+            if bytes_out:
+                self.op_bytes[name] = self.op_bytes.get(name, 0) + bytes_out
+
+    def op_throughput(self) -> Dict[str, Dict[str, float]]:
+        """Per-operator rows/sec and bytes/sec over accumulated wall time —
+        the explain_analyze / bench-snapshot throughput view (VERDICT item 1:
+        ready to fire on first real-TPU contact)."""
+        with self._lock:
+            out: Dict[str, Dict[str, float]] = {}
+            for name, ns in self.op_wall_ns.items():
+                secs = ns / 1e9
+                if secs <= 0:
+                    continue
+                out[name] = {
+                    "rows_per_sec": self.op_rows.get(name, 0) / secs,
+                    "bytes_per_sec": self.op_bytes.get(name, 0) / secs,
+                }
+            return out
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -188,6 +208,7 @@ class RuntimeStats:
                 "counters": dict(self.counters),
                 "op_rows": dict(self.op_rows),
                 "op_wall_ns": dict(self.op_wall_ns),
+                "op_bytes": dict(self.op_bytes),
             }
 
 
@@ -551,11 +572,59 @@ class ExecutionContext:
         self.stats.bump("host_distincts")
         return part.distinct(subset)
 
+    def _sketch_build_device(self, part: MicroPartition, aggregations,
+                             groupby, predicate):
+        """Stage-1 sketch builds (all-sketch_hll agg lists) run their
+        register scatter on device when eligible — behind the same
+        DeviceHealth breaker + device.kernel fault site as every other
+        device kernel. The agg-kind gate runs FIRST, before any breaker or
+        fault-site touch, so non-sketch aggregations never consume a probe
+        slot or a planned fault. Returns a zero-arg resolver (launch
+        already dispatched; the resolver fetches + assembles, host-fallback
+        inside) or None = declined (the normal agg routing takes over)."""
+        from .sketch.device import aggs_all_sketch_hll
+
+        if (predicate is not None
+                or not aggs_all_sketch_hll(aggregations)
+                or not self._device_eligible(part)):
+            return None
+
+        def _launch():
+            from .sketch.device import hll_build_table_device_launch
+
+            return hll_build_table_device_launch(
+                part.table(), list(aggregations), list(groupby or []))
+
+        resolve = self._device_attempt(_launch, launch=True)
+        if resolve is None:
+            return None
+        self.stats.bump("device_sketch_builds")
+
+        def finish() -> MicroPartition:
+            try:
+                out = resolve()
+            except Exception:
+                # the scatter was NOT computed on device: truthful counters,
+                # breaker informed, host build takes over
+                self.device_health.record_failure(self.stats)
+                self.stats.bump("device_sketch_builds", -1)
+                self.stats.bump("device_sketch_fallbacks")
+                return self._eval_agg_host(part, aggregations, groupby,
+                                           predicate)
+            self.device_health.record_success(self.stats)
+            return MicroPartition.from_table(out)
+
+        return finish
+
     def eval_agg(self, part: MicroPartition, aggregations, groupby,
                  predicate=None) -> MicroPartition:
         """Route a (optionally filter-fused) grouped aggregation through the
         fused device kernel when eligible, else the host path (host applies
         the predicate first when one was fused)."""
+        fin = self._sketch_build_device(part, aggregations, groupby,
+                                        predicate)
+        if fin is not None:
+            return fin()
         if self._device_eligible(part):
             def _run():
                 from .kernels.device_agg import device_grouped_agg
@@ -603,6 +672,10 @@ class ExecutionContext:
         """Non-blocking launch of the fused device aggregation; returns a
         zero-arg resolver (host-fallback inside, truthful counters) or None
         when ineligible — same contract as eval_projection_dispatch."""
+        fin = self._sketch_build_device(part, aggregations, groupby,
+                                        predicate)
+        if fin is not None:
+            return fin  # scatter already dispatched; resolver fetches
         if not self._device_eligible(part):
             return None
 
@@ -926,7 +999,7 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
         dt = time.perf_counter_ns() - t0
         n = out.num_rows_or_none()
         rows = n if n is not None else 0
-        ctx.stats.record_op(name, rows, dt)
+        ctx.stats.record_op(name, rows, dt, _part_bytes(out))
         if tracing.active():
             tracing.add_event(name, t0 / 1000.0, dt / 1000.0, tid, {"rows": rows})
         return out
@@ -945,6 +1018,15 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
         yield out
     if not saw_any:
         yield from op.map_empty(ctx)
+
+
+def _part_bytes(part: MicroPartition) -> int:
+    """Output bytes for throughput accounting — loaded partitions only, so
+    instrumentation never triggers IO or forces a deferred op."""
+    if not part.is_loaded():
+        return 0
+    b = part.size_bytes()
+    return b if b is not None else 0
 
 
 _tl = threading.local()
@@ -980,7 +1062,8 @@ def _traced(op: PhysicalOp, stream: Iterator[MicroPartition],
                 stack[-1] += dt
         n = part.num_rows_or_none()
         rows = n if n is not None else 0
-        ctx.stats.record_op(name, rows, max(dt - child_ns, 0))
+        ctx.stats.record_op(name, rows, max(dt - child_ns, 0),
+                            _part_bytes(part))
         if tracing.active():
             tracing.add_event(name, t0 / 1000.0, dt / 1000.0, tid, {"rows": rows})
         tracing.report_progress(name, rows)

@@ -817,6 +817,10 @@ class PyUdf(ExprNode):
 AGG_KINDS = (
     "sum", "mean", "min", "max", "count", "count_distinct", "any_value", "list",
     "concat", "stddev", "approx_count_distinct", "approx_percentiles", "skew",
+    # sketch-stage kinds (planner-internal: populate_aggregation_stages
+    # decomposes approx_* into these; users never write them directly)
+    "sketch_hll", "sketch_quantile", "merge_sketch_hll",
+    "merge_sketch_quantile",
 )
 
 
@@ -856,10 +860,27 @@ class AggExpr(ExprNode):
                 raise DaftValueError(f"agg_concat needs list/string, got {f.dtype}")
             return Field(f.name, f.dtype)
         if k == "approx_percentiles":
+            if not (f.dtype.is_numeric() or f.dtype.is_boolean()
+                    or f.dtype.is_null()):
+                raise DaftValueError(
+                    f"approx_percentiles needs a numeric input, got {f.dtype}")
             ps = self.extra.get("percentiles")
             if isinstance(ps, float):
                 return Field(f.name, DataType.float64())
             return Field(f.name, DataType.list(DataType.float64()))
+        if k == "sketch_hll":
+            return Field(f.name, DataType.binary())
+        if k == "sketch_quantile":
+            if not (f.dtype.is_numeric() or f.dtype.is_boolean()
+                    or f.dtype.is_null()):
+                raise DaftValueError(
+                    f"sketch_quantile needs a numeric input, got {f.dtype}")
+            return Field(f.name, DataType.binary())
+        if k in ("merge_sketch_hll", "merge_sketch_quantile"):
+            if not (f.dtype.is_binary() or f.dtype.is_null()):
+                raise DaftValueError(
+                    f"{k} merges serialized sketches (binary), got {f.dtype}")
+            return Field(f.name, DataType.binary())
         raise AssertionError(k)
 
     def _eval(self, table) -> Series:
@@ -912,6 +933,22 @@ def _eval_agg_on_series(agg: AggExpr, s: Series) -> Series:
         return s.approx_count_distinct()
     if k == "approx_percentiles":
         return s.approx_percentiles(agg.extra.get("percentiles", 0.5))
+    if k == "sketch_hll":
+        from .sketch import hll
+
+        return hll.build_grouped(s, None, 1)
+    if k == "merge_sketch_hll":
+        from .sketch import hll
+
+        return hll.merge_grouped(s, None, 1)
+    if k == "sketch_quantile":
+        from .sketch import quantile
+
+        return quantile.build_grouped(s, None, 1)
+    if k == "merge_sketch_quantile":
+        from .sketch import quantile
+
+        return quantile.merge_grouped(s, None, 1)
     if k == "skew":
         import numpy as np
 

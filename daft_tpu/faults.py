@@ -42,6 +42,10 @@ SITES = {
     "device.kernel": "each device-kernel attempt (sync and async launch)",
     "collective.exchange": "each mesh all_to_all shuffle attempt",
     "spill.write": "each partition spill write",
+    "sketch.merge": "each stage-2 sketch merge (HLL register max / "
+                    "quantile-sample concat, daft_tpu/sketch/)",
+    "collective.sketch": "each mesh register-array sketch-merge collective "
+                         "(all_gather+max, parallel/mesh_exec.py)",
 }
 
 

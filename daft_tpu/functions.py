@@ -901,3 +901,43 @@ def _minhash(s: Series, num_hashes: int = 64, ngram_size: int = 1, seed: int = 1
 register("minhash",
          lambda *a, num_hashes=64, **k: DataType.fixed_size_list(DataType.uint32(), num_hashes),
          _minhash)
+
+
+# ---------------------------------------------------------------------------
+# sketch finalizers: the final-projection stage of the two-phase approximate
+# aggregation decomposition (sketch build -> exchange -> merge -> ESTIMATE;
+# see daft_tpu/sketch/). Inputs are merged Binary sketch columns.
+# ---------------------------------------------------------------------------
+
+def _resolve_hll_estimate(*arg_dtypes, **_kw):
+    dt = arg_dtypes[0]
+    if not (dt.is_binary() or dt.is_null()):
+        raise ValueError(f"sketch.hll_estimate needs a binary sketch column, got {dt}")
+    return DataType.uint64()
+
+
+def _hll_estimate(s: Series) -> Series:
+    from .sketch import hll
+
+    return hll.estimate_series(s)
+
+
+register("sketch.hll_estimate", _resolve_hll_estimate, _hll_estimate)
+
+
+def _resolve_quantile_estimate(*arg_dtypes, percentiles=0.5, **_kw):
+    dt = arg_dtypes[0]
+    if not (dt.is_binary() or dt.is_null()):
+        raise ValueError(f"sketch.quantile_estimate needs a binary sketch column, got {dt}")
+    if isinstance(percentiles, float):
+        return DataType.float64()
+    return DataType.list(DataType.float64())
+
+
+def _quantile_estimate(s: Series, percentiles=0.5) -> Series:
+    from .sketch import quantile
+
+    return quantile.estimate_series(s, percentiles)
+
+
+register("sketch.quantile_estimate", _resolve_quantile_estimate, _quantile_estimate)

@@ -21,19 +21,53 @@ class BpeEncoder:
         self.decoder = {v: k for k, v in ranks.items()}
 
     def _bpe_merge(self, piece: bytes) -> List[int]:
-        parts: List[bytes] = [piece[i:i + 1] for i in range(len(piece))]
-        while len(parts) > 1:
-            best_rank = None
-            best_i = -1
-            for i in range(len(parts) - 1):
-                pair = parts[i] + parts[i + 1]
-                r = self.ranks.get(pair)
-                if r is not None and (best_rank is None or r < best_rank):
-                    best_rank, best_i = r, i
-            if best_i < 0:
-                break
-            parts = parts[:best_i] + [parts[best_i] + parts[best_i + 1]] + parts[best_i + 2:]
-        return [self.ranks[p] for p in parts]
+        """Heap + linked-list merge: O(n log n) instead of the quadratic
+        rescan-per-merge loop (each merge pushes at most two new candidate
+        pairs; stale heap entries are skipped by checking the stored pair
+        against the list's current tokens). Merge ORDER matches the old
+        loop: lowest rank first, leftmost on ties."""
+        import heapq
+
+        n = len(piece)
+        if n == 0:
+            return []
+        parts: List[bytes] = [piece[i:i + 1] for i in range(n)]
+        nxt = list(range(1, n)) + [-1]
+        prv = [-1] + list(range(n - 1))
+        alive = [True] * n
+        heap: List[tuple] = []
+
+        def push(i: int) -> None:
+            j = nxt[i]
+            if j < 0:
+                return
+            r = self.ranks.get(parts[i] + parts[j])
+            if r is not None:
+                heapq.heappush(heap, (r, i, parts[i], parts[j]))
+
+        for i in range(n - 1):
+            push(i)
+        while heap:
+            _r, i, left, right = heapq.heappop(heap)
+            if not alive[i] or parts[i] != left:
+                continue  # stale: this slot already merged
+            j = nxt[i]
+            if j < 0 or parts[j] != right:
+                continue  # stale: the right neighbor changed
+            parts[i] = left + right
+            alive[j] = False
+            nxt[i] = nxt[j]
+            if nxt[j] >= 0:
+                prv[nxt[j]] = i
+            if prv[i] >= 0:
+                push(prv[i])
+            push(i)
+        out: List[int] = []
+        i = 0
+        while i >= 0:
+            out.append(self.ranks[parts[i]])
+            i = nxt[i]
+        return out
 
     def encode(self, text: str) -> List[int]:
         return self._bpe_merge(text.encode("utf-8"))

@@ -34,6 +34,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 MIN_CAPACITY = 128
 
 
+def _shard_map(body, mesh: Mesh, in_specs, out_specs):
+    """Version-compat shard_map: `jax.shard_map(..., check_vma=)` on new
+    jax, `jax.experimental.shard_map.shard_map(..., check_rep=)` on 0.4.x —
+    one accessor so every exchange kernel builds on either."""
+    try:
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
 def _scatter_to_slabs(bucket, valid, cols, n: int, capacity: int):
     """Per-shard send-side scatter: route each row to its destination slab.
 
@@ -110,8 +124,7 @@ def build_exchange(mesh: Mesh, capacity: int, col_dtypes: Tuple,
         P(axis, *([None] * (1 + len(t)))) for t in col_trailing)
     out_specs = (spec3,) + tuple(
         P(axis, *([None] * (2 + len(t)))) for t in col_trailing)
-    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                               out_specs=out_specs, check_vma=False))
+    fn = jax.jit(_shard_map(body, mesh, in_specs, out_specs))
     _EXCHANGE_CACHE[key] = fn
     return fn
 
@@ -163,9 +176,42 @@ def build_exchange_groupby_sum(mesh: Mesh, capacity: int, num_segments: int):
         return sums[None], cnts[None]
 
     spec2 = P(axis, None)
-    fn = jax.jit(jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(spec2, spec2, spec2, spec2),
-        out_specs=(spec2, spec2), check_vma=False))
+    fn = jax.jit(_shard_map(body, mesh, (spec2, spec2, spec2, spec2),
+                            (spec2, spec2)))
     _GROUPED_CACHE[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Sketch register merge (the global stage-2 of an approximate aggregation as
+# ONE collective: per-device HLL register rows all_gather over ICI and merge
+# with an elementwise max — reference semantics: the hyperloglog merge stage
+# of translate.rs:761's sketch decomposition, mapped onto the mesh the way
+# DrJAX maps MapReduce merge primitives onto jax meshes)
+# ---------------------------------------------------------------------------
+
+_REGISTER_MERGE_CACHE: Dict = {}
+
+
+def build_register_allmerge(mesh: Mesh, m: int):
+    """Build (cached) the jitted shard_map register merge for this mesh and
+    register width.
+
+    Returned fn: (regs [n_dev, m] uint8, one sketch row per device)
+      -> merged [n_dev, m] uint8 where EVERY row holds the elementwise max
+    (fully replicated result, like the host-side gather it replaces).
+    """
+    axis = mesh.axis_names[0]
+    key = (mesh, m)
+    if key in _REGISTER_MERGE_CACHE:
+        return _REGISTER_MERGE_CACHE[key]
+
+    def body(regs):
+        r = regs[0].astype(jnp.int32)
+        g = lax.all_gather(r, axis)  # [n_dev, m]
+        return jnp.max(g, axis=0).astype(jnp.uint8)[None]
+
+    spec = P(axis, None)
+    fn = jax.jit(_shard_map(body, mesh, spec, spec))
+    _REGISTER_MERGE_CACHE[key] = fn
     return fn

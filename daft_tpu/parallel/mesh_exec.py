@@ -561,3 +561,112 @@ class MeshExecutionContext(ExecutionContext):
                 cache[(f.name, bucket, x64_enabled())] = dc
             results.append(part)
         return results
+
+    # ------------------------------------------------------------------
+    # sketch subsystem: global stage-2 HLL merges ride ICI as a register
+    # all_gather+max instead of a host loop over gathered sketch rows
+    # ------------------------------------------------------------------
+
+    def try_sketch_register_merge(self, regs: np.ndarray):
+        """Merge [k, m] uint8 HLL register rows into one [m] row with the
+        jitted all_gather+max collective (collectives.build_register_allmerge).
+        Returns None when ineligible, when the collective breaker is open, or
+        when the collective fails (failure recorded against the breaker; the
+        caller's host merge takes over). Fault site: collective.sketch."""
+        from .. import faults
+
+        n = self.n_devices
+        if self._multiproc or regs.ndim != 2 or regs.shape[0] == 0:
+            # multi-process stage-2 inputs are process-local after the
+            # gather; keep the collective merge single-process for now
+            return None
+        if not self.collective_health.allow(self.stats):
+            self.stats.bump("degraded_sketch_merges")
+            return None
+        try:
+            faults.check("collective.sketch", self.stats)
+            from .collectives import build_register_allmerge, shard_to_mesh
+
+            k, m = regs.shape
+            if k > n:
+                # pre-fold surplus rows so one row rides each device
+                pad = (-k) % n
+                folded = np.concatenate(
+                    [regs, np.zeros((pad, m), np.uint8)])
+                regs = folded.reshape(-1, n, m).max(axis=0)
+            elif k < n:
+                regs = np.concatenate(
+                    [regs, np.zeros((n - k, m), np.uint8)])
+            fn = build_register_allmerge(self.mesh, m)
+            out = np.asarray(jax.device_get(
+                fn(shard_to_mesh(np.ascontiguousarray(regs), self.mesh))))[0]
+        except Exception:
+            self.collective_health.record_failure(self.stats)
+            return None
+        self.collective_health.record_success(self.stats)
+        self.stats.bump("collective_sketch_merges")
+        return out
+
+    def _collective_merge_eligible(self, groupby, predicate) -> bool:
+        # no min-rows gate: a stage-2 input is one sketch row per partition
+        # BY DESIGN — routing those few wide rows through ICI is the point.
+        # Multi-process declines HERE, before the partition materializes and
+        # the sketches decode (try_sketch_register_merge would refuse anyway)
+        return (not groupby and predicate is None
+                and self.cfg.use_device_kernels and not self._multiproc)
+
+    def eval_agg(self, part, aggregations, groupby, predicate=None):
+        """Global merge_sketch_hll stages (the gathered stage 2 of a
+        multi-partition approx_count_distinct) merge on the mesh when
+        eligible; everything else takes the base routing."""
+        if self._collective_merge_eligible(groupby, predicate):
+            out = self._sketch_merge_collective(part, aggregations)
+            if out is not None:
+                return out
+        return super().eval_agg(part, aggregations, groupby,
+                                predicate=predicate)
+
+    def eval_agg_dispatch(self, part, aggregations, groupby, predicate=None):
+        """The executor's non-blocking driver probes HERE first; the
+        collective merge resolves synchronously (one tiny all_gather), so
+        it hands back an already-resolved thunk."""
+        if self._collective_merge_eligible(groupby, predicate):
+            out = self._sketch_merge_collective(part, aggregations)
+            if out is not None:
+                return lambda: out
+        return super().eval_agg_dispatch(part, aggregations, groupby,
+                                         predicate=predicate)
+
+    def _sketch_merge_collective(self, part, aggregations):
+        from ..datatypes import DataType
+        from ..expressions import AggExpr, Alias
+        from ..schema import Field, Schema
+        from ..series import Series
+        from ..sketch.hll import binary_to_registers, registers_to_binary
+        from ..table import Table
+
+        nodes = []
+        for e in aggregations:
+            node = e._node
+            while isinstance(node, Alias):
+                node = node.child
+            if not (isinstance(node, AggExpr)
+                    and node.kind == "merge_sketch_hll"):
+                return None
+            nodes.append((e.name(), node))
+        if not nodes:
+            return None
+        tbl = part.table()
+        out_cols = []
+        out_fields = []
+        for alias, node in nodes:
+            child = node.child.evaluate(tbl)
+            merged = self.try_sketch_register_merge(
+                binary_to_registers(child))
+            if merged is None:
+                return None
+            s = Series.from_arrow(registers_to_binary(merged[None]), alias,
+                                  DataType.binary())
+            out_cols.append(s)
+            out_fields.append(Field(alias, DataType.binary()))
+        return MicroPartition.from_table(Table(Schema(out_fields), out_cols))

@@ -443,11 +443,19 @@ class ShuffleOp(PhysicalOp):
             out = dev_shuffle(parts, self.by, n, self.scheme, self.descending,
                               self.nulls_first, pre_boundaries)
             if out is not None:
+                ctx.stats.bump("exchange_rows", sum(len(p) for p in parts))
+                ctx.stats.bump("exchange_bytes",
+                               sum((p.size_bytes() or 0) for p in parts
+                                   if p.is_loaded()))
                 yield from out
                 return
             stream = iter(parts)
         else:
             stream = inputs[0]
+        # every row crossing the exchange is counted (exchange_rows): the
+        # sketch subsystem's acceptance metric is that approx aggs ship
+        # O(sketch_size x partitions) stage-1 rows here instead of raw input
+        stream = _counted(stream, ctx, "exchange_rows")
         buckets = [ctx.partition_buffer() for _ in range(n)]
         saw = False
         if self.scheme == "range":
@@ -498,6 +506,23 @@ class ShuffleOp(PhysicalOp):
     def describe(self):
         by = ", ".join(e._node.display() for e in self.by)
         return f"Shuffle[{self.scheme}] -> {self.num}" + (f" by [{by}]" if by else "")
+
+
+def _counted(stream: PartStream, ctx, counter: str) -> PartStream:
+    """Pass-through that counts rows AND bytes entering an exchange
+    boundary (rows alone can't see payload inflation: a sketch row is
+    16 KiB where a raw row is a few bytes — exchange_bytes keeps the
+    before/after metric honest)."""
+    bytes_counter = counter.replace("_rows", "_bytes")
+    for p in stream:
+        n = p.num_rows_or_none()
+        if n:
+            ctx.stats.bump(counter, n)
+        if p.is_loaded():
+            b = p.size_bytes()
+            if b:
+                ctx.stats.bump(bytes_counter, b)
+        yield p
 
 
 def sample_partition_keys(p: MicroPartition, by: List[Expression], num: int,
@@ -719,7 +744,7 @@ class GatherOp(PhysicalOp):
         super().__init__([child], child.schema, 1)
 
     def execute(self, inputs, ctx) -> PartStream:
-        parts = [p for p in inputs[0]]
+        parts = [p for p in _counted(inputs[0], ctx, "exchange_rows")]
         if not parts:
             yield MicroPartition.empty(self.schema)
         elif len(parts) == 1:
@@ -998,6 +1023,13 @@ class CrossJoinOp(PhysicalOp):
 
 DECOMPOSABLE = {"sum", "count", "mean", "min", "max", "list", "concat", "any_value", "stddev"}
 
+# approximate aggregations decompose through the sketch subsystem
+# (daft_tpu/sketch/): stage 1 builds a fixed-size mergeable sketch per
+# group, the exchange ships serialized sketch BYTES (a Binary column),
+# stage 2 merges registers, and the final projection computes the estimate
+# (reference: daft-sketch/hyperloglog stages in translate.rs:761+)
+SKETCH_DECOMPOSABLE = {"approx_count_distinct", "approx_percentiles"}
+
 
 def _strip_alias(e: Expression) -> AggExpr:
     n = e._node
@@ -1008,9 +1040,10 @@ def _strip_alias(e: Expression) -> AggExpr:
     return n
 
 
-def aggs_decomposable(aggs: List[Expression]) -> bool:
+def aggs_decomposable(aggs: List[Expression], include_sketch: bool = False) -> bool:
+    allowed = DECOMPOSABLE | (SKETCH_DECOMPOSABLE if include_sketch else set())
     try:
-        return all(_strip_alias(e).kind in DECOMPOSABLE for e in aggs)
+        return all(_strip_alias(e).kind in allowed for e in aggs)
     except ValueError:
         return False
 
@@ -1037,7 +1070,9 @@ def populate_aggregation_stages(
         seen_ids[key] = ident
         stage1.append(Expression(AggExpr(kind, child_expr._node, extra)).alias(ident))
         merge_kind = {"sum": "sum", "count": "sum", "min": "min", "max": "max",
-                      "list": "concat", "concat": "concat", "any_value": "any_value"}[kind]
+                      "list": "concat", "concat": "concat", "any_value": "any_value",
+                      "sketch_hll": "merge_sketch_hll",
+                      "sketch_quantile": "merge_sketch_quantile"}[kind]
         stage2.append(Expression(AggExpr(merge_kind, col(ident)._node,
                                          extra if kind == "any_value" else None)).alias(ident))
         return ident
@@ -1077,6 +1112,22 @@ def populate_aggregation_stages(
         elif k == "any_value":
             ident = s1("any_value", child, "any", dict(node.extra))
             final.append(col(ident).alias(alias))
+        elif k == "approx_count_distinct":
+            # sketch->merge->estimate: the exchange carries HLL register
+            # bytes, never the counted rows (daft_tpu/sketch/hll.py)
+            from .expressions import Function
+
+            ident = s1("sketch_hll", child, "hll")
+            final.append(Expression(Function(
+                "sketch.hll_estimate", [col(ident)._node])).alias(alias))
+        elif k == "approx_percentiles":
+            from .expressions import Function
+
+            ident = s1("sketch_quantile", child, "qsketch")
+            final.append(Expression(Function(
+                "sketch.quantile_estimate", [col(ident)._node],
+                {"percentiles": node.extra.get("percentiles", 0.5)}))
+                .alias(alias))
         else:
             raise ValueError(f"aggregation {k!r} is not decomposable")
     return stage1, stage2, final
@@ -1261,9 +1312,11 @@ def _translate_aggregate(plan: Aggregate, cfg) -> PhysicalOp:
     if nparts == 1:
         return AggregateOp(child, plan.aggregations, plan.groupby, plan.schema)
 
-    if not aggs_decomposable(plan.aggregations):
-        # non-decomposable (count_distinct / percentiles / skew): shuffle raw
-        # rows by key, then full agg per partition
+    include_sketch = bool(getattr(cfg, "sketch_aggregations", True))
+    if not aggs_decomposable(plan.aggregations, include_sketch):
+        # non-decomposable (count_distinct / skew / approx_* with the sketch
+        # subsystem disabled): shuffle raw rows by key, then full agg per
+        # partition
         if plan.groupby:
             shuffled = ShuffleOp(child, "hash", nparts, plan.groupby)
             return AggregateOp(shuffled, plan.aggregations, plan.groupby, plan.schema)

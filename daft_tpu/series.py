@@ -608,17 +608,24 @@ class Series:
         return Series(self._name, self._dtype, pa.LargeListArray.from_arrays(offsets, flat))
 
     def approx_count_distinct(self) -> "Series":
-        v = pc.count_distinct(self._arrow)
-        return Series.from_pylist([v.as_py()], self._name, DataType.uint64())
+        # HLL-backed (sketch/hll.py): the SAME estimator the two-phase
+        # sketch->merge plan finalizes, so a query's answer does not depend
+        # on how its input happened to be partitioned (HLL register merge is
+        # exactly associative).
+        from .sketch import hll
+
+        est = hll.count_distinct_estimate(self)
+        return Series.from_pylist([est], self._name, DataType.uint64())
 
     def approx_percentiles(self, percentiles) -> "Series":
-        ps = [percentiles] if isinstance(percentiles, float) else list(percentiles)
-        opts = pc.TDigestOptions(q=ps)
-        v = pc.tdigest(self._arrow, options=opts)
-        vals = v.to_pylist()
+        # quantile-sketch-backed (sketch/quantile.py) for the same
+        # partition-invariance contract as approx_count_distinct
+        from .sketch import quantile
+
+        out = quantile.percentile_estimate(self, percentiles)
         if isinstance(percentiles, float):
-            return Series.from_pylist(vals[:1], self._name, DataType.float64())
-        return Series.from_pylist([vals], self._name, DataType.list(DataType.float64()))
+            return Series.from_pylist([out], self._name, DataType.float64())
+        return Series.from_pylist([out], self._name, DataType.list(DataType.float64()))
 
     # ------------------------------------------------------------------ numeric fns
     def _unary(self, fn, dtype: Optional[DataType] = None) -> "Series":

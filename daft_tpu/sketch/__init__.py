@@ -1,0 +1,49 @@
+"""Mergeable-sketch aggregation subsystem.
+
+Role-equivalent to the reference's src/daft-sketch/ + src/hyperloglog/ wired
+through the planner's two-stage aggregation decomposition
+(src/daft-plan/src/physical_planner/translate.rs:761): approximate
+aggregations decompose into
+
+  stage 1  one fixed-size sketch per partition/group
+           (`sketch_hll` / `sketch_quantile` AggExpr kinds -> Binary column)
+  exchange serialized sketch bytes ride the existing ShuffleOp/GatherOp as a
+           Binary column — payload is O(sketch_size x partitions), never raw
+           rows; on a mesh the global HLL case merges register arrays with a
+           jit'd all_gather+max collective (parallel/collectives.py)
+  stage 2  registers merge per group (`merge_sketch_*` kinds, elementwise
+           max / weighted-sample concat) -> Binary column
+  final    a scalar projection finalizes the estimate
+           (functions `sketch.hll_estimate` / `sketch.quantile_estimate`)
+
+The math lives in kernels/sketches.py (register ranks, estimates,
+deterministic quantile compression); this package is the engine glue:
+grouped builds/merges over arrow-backed Series (hll.py, quantile.py), the
+device register-scatter (device.py), and the kind registry the planner and
+Table kernels share.
+
+Error bounds (enforced by tests/test_sketch_aggs.py, not eyeballed):
+- HLL relative error <= 2 x 1.04/sqrt(HLL_M)  (~1.63% at m=16384)
+- quantile rank error <= 1/QUANTILE_CAP of the total weight (~0.024%)
+"""
+
+from __future__ import annotations
+
+from ..kernels.sketches import (  # noqa: F401  (re-exported subsystem API)
+    HLL_M,
+    HLL_P,
+    HLL_STANDARD_ERROR,
+    QUANTILE_CAP,
+    estimate_from_registers,
+    register_ranks,
+)
+
+#: stage-1 AggExpr kinds: build one serialized sketch per group
+STAGE1_KINDS = frozenset({"sketch_hll", "sketch_quantile"})
+#: stage-2 AggExpr kinds: merge serialized sketches per group
+MERGE_KINDS = frozenset({"merge_sketch_hll", "merge_sketch_quantile"})
+#: every sketch-stage kind (planner-internal; users write approx_*)
+SKETCH_STAGE_KINDS = STAGE1_KINDS | MERGE_KINDS
+#: user-facing aggregations that decompose into sketch->merge stages
+SKETCH_DECOMPOSABLE = frozenset({"approx_count_distinct",
+                                 "approx_percentiles"})

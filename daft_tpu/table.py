@@ -301,14 +301,25 @@ class Table:
         descending = _norm_flag(descending, k, False)
         nulls_first = _norm_flag(nulls_first, k, None)
         keys = [e._node.evaluate(self) for e in sort_keys]
-        arrs, sort_spec = [], []
+        arrs, sort_spec, placements = [], [], []
         for i, (s, d, nf) in enumerate(zip(keys, descending, nulls_first)):
             arrs.append(_broadcast_series(s, len(self)).to_arrow())
-            placement = "at_start" if (nf if nf is not None else d) else "at_end"
-            sort_spec.append((f"k{i}", "descending" if d else "ascending", placement))
-        tbl = pa.Table.from_arrays(arrs, names=[f"k{i}" for i in range(k)])
-        idx = pc.sort_indices(tbl, sort_keys=sort_spec)
-        return Series.from_arrow(idx.cast(pa.uint64()), "indices")
+            placements.append("at_start" if (nf if nf is not None else d) else "at_end")
+            sort_spec.append((f"k{i}", "descending" if d else "ascending"))
+        # pyarrow sort_keys are (name, order) pairs with ONE global
+        # null_placement (per-key 3-tuples are not part of its API); keys
+        # that disagree on placement fall back to a dense-rank lexsort where
+        # each key's rank bakes in its own placement
+        if len(set(placements)) <= 1:
+            tbl = pa.Table.from_arrays(arrs, names=[f"k{i}" for i in range(k)])
+            idx = pc.sort_indices(tbl, sort_keys=sort_spec,
+                                  null_placement=placements[0] if placements else "at_end")
+            return Series.from_arrow(idx.cast(pa.uint64()), "indices")
+        ranks = [np.asarray(pc.rank(a, sort_keys="descending" if d else "ascending",
+                                    null_placement=p, tiebreaker="dense"))
+                 for a, d, p in zip(arrs, descending, placements)]
+        idx = np.lexsort(tuple(reversed(ranks)))  # first key = primary
+        return Series.from_arrow(pa.array(idx.astype(np.uint64)), "indices")
 
     def sort(self, sort_keys: Sequence[Expression], descending=None, nulls_first=None) -> "Table":
         return self.take(self.argsort(sort_keys, descending, nulls_first))
@@ -425,7 +436,9 @@ class Table:
                 raise DaftValueError(f"aggregation list contains non-aggregation {e!r}")
             child_s = _broadcast_series(node.child.evaluate(self), n)
             expected_dt = node.to_field(self.schema).dtype
-            merged = _bincount_agg_fast(node, child_s, codes, num_groups)
+            merged = _sketch_agg_fast(node, child_s, codes, num_groups)
+            if merged is None:
+                merged = _bincount_agg_fast(node, child_s, codes, num_groups)
             if merged is None:
                 merged = _hash_agg_fast(node, child_s, codes, num_groups)
             if merged is None:
@@ -724,9 +737,10 @@ class Table:
             joined = lt.join(rt, keys=key_names, join_type=how_map[how],
                              use_threads=True)
         # deterministic output order: by left index then right index
-        sort_keys = [(c, "ascending", "at_end") for c in ("__lidx", "__ridx") if c in joined.column_names]
+        sort_keys = [(c, "ascending") for c in ("__lidx", "__ridx") if c in joined.column_names]
         if sort_keys:
-            joined = joined.take(pc.sort_indices(joined, sort_keys=sort_keys))
+            joined = joined.take(pc.sort_indices(joined, sort_keys=sort_keys,
+                                                 null_placement="at_end"))
         joined = joined.combine_chunks()
 
         if how in ("semi", "anti"):
@@ -1171,6 +1185,37 @@ def _acero_agg_fn(node: AggExpr, threaded: bool = False):
     if k == "any_value":
         return "first", pc.ScalarAggregateOptions(
             skip_nulls=bool(node.extra.get("ignore_nulls", False)))
+    return None
+
+
+def _sketch_agg_fast(node: AggExpr, child: Series, codes: np.ndarray,
+                     num_groups: int) -> Optional[Series]:
+    """Vectorized grouped kernels of the sketch subsystem (daft_tpu/sketch/):
+    the planner-internal stage kinds (sketch_hll/sketch_quantile build one
+    Binary sketch per group; merge_sketch_* merges serialized sketches) and
+    the single-partition grouped approx_* aggregations, which build+estimate
+    in one pass so grouped results match the two-phase plan's estimates.
+    Returns None for every other kind."""
+    k = node.kind
+    if k in ("sketch_hll", "merge_sketch_hll", "approx_count_distinct"):
+        from .sketch import hll
+
+        if k == "sketch_hll":
+            return hll.build_grouped(child, codes, num_groups)
+        if k == "merge_sketch_hll":
+            return hll.merge_grouped(child, codes, num_groups)
+        est = hll.grouped_estimates(child, codes, num_groups)
+        return Series.from_arrow(pa.array(est, type=pa.uint64()), child.name)
+    if k in ("sketch_quantile", "merge_sketch_quantile", "approx_percentiles"):
+        from .sketch import quantile
+
+        if k == "sketch_quantile":
+            return quantile.build_grouped(child, codes, num_groups)
+        if k == "merge_sketch_quantile":
+            return quantile.merge_grouped(child, codes, num_groups)
+        sketches = quantile.build_grouped(child, codes, num_groups)
+        return quantile.estimate_series(
+            sketches, node.extra.get("percentiles", 0.5))
     return None
 
 

@@ -82,3 +82,68 @@ def test_resolver_matches_kernel(op):
             elif planned != actual:
                 mismatches.append(f"{op}({ld},{rd}): planner={planned} kernel={actual}")
     assert not mismatches, "\n".join(mismatches[:25]) + f"\n... {len(mismatches)} total"
+
+
+AGG_KINDS = ["approx_count_distinct", "approx_percentiles", "count_distinct"]
+
+
+def _agg_expr(kind, c):
+    if kind == "approx_percentiles":
+        return c.approx_percentiles(0.5)
+    return getattr(c, kind)()
+
+
+@pytest.mark.parametrize("kind", AGG_KINDS)
+def test_agg_resolver_matches_kernel(kind):
+    """Aggregation-typing matrix (ISSUE 3 satellite): for every input dtype,
+    the planner-declared aggregation dtype must equal the executed dtype —
+    or both planner and kernel must reject the input (e.g. approx_percentiles
+    over strings). Covers the sketch-backed approx_* kernels end to end."""
+    mismatches = []
+    for d in DTYPES:
+        expr = _agg_expr(kind, col(_COLS[d]))
+        try:
+            planned = expr._node.to_field(_TBL.schema).dtype
+            plan_err = None
+        except Exception as e:  # noqa: BLE001
+            planned, plan_err = None, e
+        try:
+            actual = expr._node.evaluate(_TBL).dtype
+            kern_err = None
+        except Exception as e:  # noqa: BLE001
+            actual, kern_err = None, e
+        if plan_err is not None and kern_err is not None:
+            continue  # both reject: consistent
+        if plan_err is not None or kern_err is not None:
+            mismatches.append(f"{kind}({d}): planner={planned or plan_err!r} "
+                              f"kernel={actual or kern_err!r}")
+        elif planned != actual:
+            mismatches.append(f"{kind}({d}): planner={planned} kernel={actual}")
+    assert not mismatches, "\n".join(mismatches)
+
+
+@pytest.mark.parametrize("kind", AGG_KINDS)
+def test_agg_grouped_dtype_matches_declared(kind):
+    """The grouped kernels (Table.agg fast paths + segment fallback) must
+    emit the planner-declared dtype for every ACCEPTED input dtype."""
+    import daft_tpu as dt
+
+    mismatches = []
+    for d in DTYPES:
+        expr = _agg_expr(kind, col(_COLS[d])).alias("out")
+        try:
+            planned = expr._node.to_field(_TBL.schema).dtype
+        except Exception:  # noqa: BLE001
+            continue  # planner rejects; global-matrix test covers parity
+        grp = dt.Series.from_pylist([0, 1, 0], "g", DataType.int64())
+        tbl = Table.from_pydict(
+            dict({"g": grp}, **{_COLS[d]: _TBL.get_column(_COLS[d])}))
+        try:
+            out = tbl.agg([expr], [col("g")])
+        except Exception:  # noqa: BLE001
+            mismatches.append(f"{kind}({d}): planner accepts, grouped kernel raises")
+            continue
+        actual = out.get_column("out").dtype
+        if actual != planned:
+            mismatches.append(f"{kind}({d}): planner={planned} grouped={actual}")
+    assert not mismatches, "\n".join(mismatches)
