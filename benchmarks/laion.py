@@ -156,16 +156,38 @@ def run_rung(n: int = 1000, src_size: int = 96, out_size: int = 224,
             return {"laion_device_rows_per_sec": 0.0,
                     "laion_vs_baseline": 0.0,
                     "laion_error": "parity_mismatch"}
+        best_frame = got_frame  # stats must describe the BEST run reported
         for _ in range(best_of - 1):
             t0 = time.perf_counter()
-            run_pipeline(urls, src_size, out_size)
-            t_eng = min(t_eng, time.perf_counter() - t0)
+            frame_i = run_pipeline(urls, src_size, out_size)
+            t_i = time.perf_counter() - t0
+            if t_i < t_eng:
+                t_eng, best_frame = t_i, frame_i
             t0 = time.perf_counter()
             oracle(urls, out_size)
             t_orc = min(t_orc, time.perf_counter() - t0)
-        return {"laion_device_rows_per_sec": round(n / t_eng, 1),
-                "laion_vs_baseline": round(t_orc / t_eng, 3),
-                "laion_rows": n}
+        got_frame = best_frame
+        out = {"laion_device_rows_per_sec": round(n / t_eng, 1),
+               "laion_vs_baseline": round(t_orc / t_eng, 3),
+               "laion_rows": n}
+        # attribution for the r5 0.89x host gap: where the engine's wall
+        # actually goes (per-op self time) and how much of it was blocked
+        # IO vs compute — the oracle has no per-stage view, so the engine's
+        # own breakdown is the only way to tell download-wait from
+        # decode/resize overhead round over round
+        try:
+            snap = got_frame.stats.snapshot()
+            total = sum(snap["op_wall_ns"].values()) or 1
+            top = sorted(snap["op_wall_ns"].items(), key=lambda kv: -kv[1])[:3]
+            out["laion_io_wait_share"] = (
+                got_frame.stats.io_breakdown()["io_wait_share"])
+            out["laion_top_ops"] = {
+                name: {"ms": round(ns / 1e6, 1),
+                       "share": round(ns / total, 3)}
+                for name, ns in top}
+        except Exception as e:  # breakdown is best-effort, never the rung
+            out["laion_breakdown_error"] = f"{type(e).__name__}: {e}"[:120]
+        return out
     finally:
         shutdown(server)
 

@@ -53,6 +53,28 @@ class ExecutionConfig:
     # transient-IO retry at scan-task granularity (reference: s3_like.rs retry)
     scan_retry_attempts: int = 3
     scan_retry_backoff_s: float = 0.1
+    # pipelined IO (README "Pipelined IO"): consumption-driven scan
+    # readahead — materializing scan partition i issues the reads of the
+    # next N tasks on the shared executor pool (io/prefetch.py), charged
+    # against the MemoryLedger so readahead never blows memory_budget_bytes.
+    # 0 disables (fully synchronous reads); results are byte-identical at
+    # every depth.
+    scan_prefetch_depth: int = 2
+    # pipeline breakers hand spill IPC writes to a bounded background writer
+    # thread instead of stalling on disk (spill.AsyncSpillWriter); write
+    # failures keep the partition in memory exactly like the sync path, and
+    # writer-internal errors surface at the next check_deadline barrier
+    async_spill_writes: bool = True
+    # draining a spilled buffer issues the NEXT unloaded partition's
+    # read-back on the pool before the consumer needs it (double buffering);
+    # the shuffle reduce side preloads bucket i+1 while bucket i is consumed
+    unspill_readahead: bool = True
+    # map-side shuffle fanout (decode + hash/split) runs as order-preserving
+    # partition tasks on the worker pool — window min(4, workers) for
+    # streams that may carry unloaded (out-of-core) partitions, the normal
+    # workers+backlog window for resident ones — instead of inline on the
+    # consumer thread (reference: FanoutInstruction partition tasks)
+    parallel_shuffle_fanout: bool = True
     # morsel-parallel execution (reference: worker-per-core intermediate ops,
     # intermediate_op.rs:71): 0 = auto (one worker per core when the host has
     # >= 4 cores; sequential below that — oversubscription on tiny hosts

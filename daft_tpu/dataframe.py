@@ -131,6 +131,18 @@ class DataFrame:
                 f"  {t.get('rows_per_sec', 0.0):>12,.0f}"
                 f"  {t.get('bytes_per_sec', 0.0) / 1e6:>8.1f}")
         counters = snap["counters"]
+        io = self.stats.io_breakdown()
+        if io["io_wait_ms"] or io["prefetch_hits"] or io["prefetch_misses"] \
+                or io["spill_write_mbps"] or io["spill_read_mbps"]:
+            lines.append("")
+            lines.append(
+                f"io: wait {io['io_wait_share'] * 100:.1f}% of op wall "
+                f"({io['io_wait_ms']:.1f} ms) · prefetch "
+                f"{io['prefetch_hits']} hit / {io['prefetch_misses']} miss"
+                + (f" / {io['prefetch_throttled']} throttled"
+                   if io["prefetch_throttled"] else "")
+                + f" · spill write {io['spill_write_mbps']:.1f} MB/s"
+                f" · read {io['spill_read_mbps']:.1f} MB/s")
         if counters:
             lines.append("")
             lines.append("counters: " + ", ".join(f"{k}={v}" for k, v in sorted(counters.items())))

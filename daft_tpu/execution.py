@@ -186,6 +186,45 @@ class RuntimeStats:
             if bytes_out:
                 self.op_bytes[name] = self.op_bytes.get(name, 0) + bytes_out
 
+    def io_wait_share(self) -> float:
+        """Fraction of accumulated operator wall time the execution threads
+        spent BLOCKED on IO (scan-prefetch waits and sync scan reads, spill
+        read-backs on the consumer thread, sync spill writes, writer-queue
+        backpressure). Background prefetch/readahead reads that overlapped
+        compute are excluded — this is the residual serialization the
+        pipelined-IO layer exists to shrink."""
+        with self._lock:
+            wait = self.counters.get("io_wait_ns", 0)
+            total = sum(self.op_wall_ns.values())
+        if wait <= 0:
+            return 0.0
+        return min(1.0, wait / max(total, wait))
+
+    def io_breakdown(self) -> Dict[str, float]:
+        """The io_wait-vs-compute split plus prefetch hit/miss and spill
+        write/read throughput — the explain_analyze / bench-snapshot view
+        of the pipelined IO layer."""
+        with self._lock:
+            c = dict(self.counters)
+
+        def mbps(b, ns):
+            return (b / 2**20) / (ns / 1e9) if ns > 0 else 0.0
+
+        return {
+            "io_wait_share": round(self.io_wait_share(), 4),
+            "io_wait_ms": round(c.get("io_wait_ns", 0) / 1e6, 1),
+            "prefetch_hits": c.get("prefetch_hits", 0),
+            "prefetch_misses": c.get("prefetch_misses", 0),
+            "prefetch_throttled": c.get("prefetch_throttled", 0),
+            "unspill_readahead_hits": c.get("unspill_readahead_hits", 0),
+            "spill_write_mbps": round(
+                mbps(c.get("spill_write_bytes", 0),
+                     c.get("spill_write_ns", 0)), 1),
+            "spill_read_mbps": round(
+                mbps(c.get("spill_read_bytes", 0),
+                     c.get("spill_read_ns", 0)), 1),
+        }
+
     def op_throughput(self) -> Dict[str, Dict[str, float]]:
         """Per-operator rows/sec and bytes/sec over accumulated wall time —
         the explain_analyze / bench-snapshot throughput view (VERDICT item 1:
@@ -319,6 +358,11 @@ class ExecutionContext:
         self.device_health = device_health or DeviceHealth(
             cfg.device_breaker_threshold, cfg.device_breaker_cooldown_s)
         self._pool = None
+        # terminal once the query's stream closed: unspill readahead stops
+        # submitting (its buffers are settled by finish_query anyway); the
+        # scan prefetcher MAY still recreate the pool for late reads — see
+        # pool() below
+        self._pool_finished = False
         self._spill_scope = None
         self._buffers: List = []
         self._accountant: Optional[ResourceAccountant] = None
@@ -326,7 +370,11 @@ class ExecutionContext:
     def check_deadline(self) -> None:
         """Cooperative deadline check (morsel loop, pipeline breakers):
         raises DaftTimeoutError carrying the partial stats accumulated so
-        far when execution_timeout_s has been exceeded."""
+        far when execution_timeout_s has been exceeded. Doubles as the
+        barrier where async-spill writer-internal errors surface on the
+        query thread instead of dying with the writer."""
+        if self._spill_scope is not None:
+            self._spill_scope.raise_async_errors()
         if self.deadline is not None and time.monotonic() > self.deadline:
             from .errors import DaftTimeoutError
 
@@ -355,10 +403,22 @@ class ExecutionContext:
         self.check_deadline()
         from .spill import PartitionBuffer
 
-        buf = PartitionBuffer(self.cfg.memory_budget_bytes, self.stats,
-                              scope=self.spill_scope)
+        buf = PartitionBuffer(
+            self.cfg.memory_budget_bytes, self.stats,
+            scope=self.spill_scope,
+            async_spill=self.cfg.async_spill_writes,
+            readahead=(self._bg_submit if self.cfg.unspill_readahead
+                       else None))
         self._buffers.append(buf)
         return buf
+
+    def _bg_submit(self, fn):
+        """Submit background IO (unspill readahead) onto the shared worker
+        pool; raises RuntimeError after shutdown (callers degrade to
+        synchronous reads)."""
+        if self._pool_finished:
+            raise RuntimeError("worker pool already shut down")
+        return self.pool().submit(fn)
 
     @property
     def accountant(self) -> ResourceAccountant:
@@ -393,7 +453,11 @@ class ExecutionContext:
         return resolve_executor_threads(self.cfg)
 
     def pool(self):
-        """Lazily-created shared worker pool; shut down by execute_plan."""
+        """Lazily-created shared worker pool; shut down by execute_plan.
+        A post-shutdown call (scan-prefetch serving late reads, e.g.
+        to_pydict over an unforced collect) recreates it; the recreated
+        pool is released by GC when the last partition referencing the
+        prefetcher loads or dies."""
         if self._pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -402,6 +466,7 @@ class ExecutionContext:
         return self._pool
 
     def shutdown_pool(self) -> None:
+        self._pool_finished = True
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None

@@ -41,7 +41,14 @@ SITES = {
     "scan.read": "each scan-task read attempt (inside the retry loop)",
     "device.kernel": "each device-kernel attempt (sync and async launch)",
     "collective.exchange": "each mesh all_to_all shuffle attempt",
-    "spill.write": "each partition spill write",
+    "spill.write": "each partition spill write (sync, or on the async "
+                   "writer thread — failure holds the partition in memory)",
+    "spill.readback": "each spilled-partition re-materialization "
+                      "(consumer-thread read or unspill readahead; errors "
+                      "propagate to the drain consumer)",
+    "prefetch.fetch": "each background scan-prefetch fetch "
+                      "(io/prefetch.py; errors re-raise from the "
+                      "partition's read on the execution thread)",
     "sketch.merge": "each stage-2 sketch merge (HLL register max / "
                     "quantile-sample concat, daft_tpu/sketch/)",
     "collective.sketch": "each mesh register-array sketch-merge collective "

@@ -1,0 +1,316 @@
+"""Pipelined scan IO: bounded, consumption-driven readahead of scan tasks.
+
+BENCH_r05 showed the out-of-core path fully serializing scan decode with
+compute (TPC-H Q1 SF10 from parquet: 1.3M rows/s vs 17.5M in-memory). This
+module overlaps them: when scan partition i is materialized, the reads for
+partitions i+1..i+depth are issued on the shared executor pool, so the
+decode of the next morsel rides under the compute of the current one (the
+double-buffering/readahead discipline of HPTMT arxiv 2107.12807 and the
+input-pipeline prefetch of arxiv 2604.21275).
+
+Design constraints, in order:
+
+- **Byte-identical results.** A prefetched read goes through exactly the
+  same ``read_chunks``/``read`` path a synchronous read would; the wrapper
+  only moves WHERE it runs. Order is preserved by the scan op, which emits
+  partitions in task order regardless of fetch completion order.
+- **Consumption-driven.** Fetches for i+1.. are triggered by the read of
+  partition i, never by plan construction or emission — a metadata-only
+  query, a narrowed (head/select) partition, or a pruned stream starts no
+  background IO at all, so pushdown IO-reduction guarantees survive.
+- **Budget-charged.** Each in-flight fetch charges its size estimate to the
+  process MemoryLedger; submission stops (prefetch_throttled) while the
+  charge would cross memory_budget_bytes, so readahead can never blow the
+  spill budget it exists to serve.
+- **Deadline/cancel-aware.** No new fetch is submitted after the query's
+  deadline passed or its stats handle was cancelled.
+- **Deadlock-free on the shared pool.** A consumer never blocks on a fetch
+  that is still QUEUED: it cancels the future and reads synchronously
+  (prefetch_misses). Only running fetches — which occupy a worker and wait
+  on nothing — are awaited, so pool starvation cannot form a cycle.
+- **Errors propagate to the consumer.** A failed background fetch re-raises
+  from the partition's read on the execution thread — never lost in a dead
+  worker. The ``prefetch.fetch`` fault site (DTL004-registered) makes that
+  path deterministically testable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, List, Optional
+
+_IDLE, _SUBMITTED, _TAKEN, _ABANDONED = "idle", "submitted", "taken", "abandoned"
+
+
+class _Slot:
+    """Per-task prefetch state, guarded by the owning queue's lock."""
+
+    __slots__ = ("task", "est_bytes", "state", "future", "charged")
+
+    def __init__(self, task, est_bytes: int):
+        self.task = task
+        self.est_bytes = est_bytes
+        self.state = _IDLE
+        self.future = None
+        self.charged = False
+
+
+class ScanPrefetcher:
+    """Bounded readahead queue over one scan's locally-readable task list."""
+
+    def __init__(self, tasks, ctx, depth: int):
+        from ..spill import MEMORY_LEDGER
+
+        self._lock = threading.Lock()
+        self._slots: List[_Slot] = [
+            _Slot(t, t.size_bytes() or 0) for t in tasks]
+        self._ctx = ctx
+        self._stats = ctx.stats
+        self._deadline = getattr(ctx, "deadline", None)
+        self._budget = ctx.cfg.memory_budget_bytes
+        self._depth = max(0, int(depth))
+        self._ledger = MEMORY_LEDGER
+        self._ninflight = 0  # submitted fetches not yet consumed/settled
+        self._closed = False
+
+    def wrap(self, idx: int) -> "PrefetchedScanTask":
+        return PrefetchedScanTask(self, idx)
+
+    # ------------------------------------------------------------- submission
+    def _may_submit(self) -> bool:
+        if self._closed or self._stats.is_cancelled():
+            return False
+        if self._deadline is not None and time.monotonic() > self._deadline:
+            return False
+        return True
+
+    def ensure_ahead(self, from_idx: int) -> None:
+        """Submit background fetches for tasks [from_idx, from_idx+depth)
+        that are still idle. Called by a partition's read, so readahead only
+        follows actual consumption."""
+        if self._depth <= 0 or not self._may_submit():
+            return
+        with self._lock:
+            hi = min(from_idx + self._depth, len(self._slots))
+            for j in range(max(from_idx, 0), hi):
+                s = self._slots[j]
+                if s.state != _IDLE:
+                    continue
+                # one in-flight fetch is always allowed: it is the same
+                # "one working partition" of slack the spill budget already
+                # grants the consumer's own synchronous read — depth-1
+                # double buffering survives even a budget pinned at its
+                # floor. Beyond that, readahead must fit the headroom.
+                if (self._ninflight > 0 and self._budget is not None
+                        and self._ledger.current + self._ledger.prefetch_inflight
+                        + s.est_bytes > self._budget):
+                    self._stats.bump("prefetch_throttled")
+                    return  # budget headroom gone: stop, retry on next read
+                try:
+                    fut = self._ctx.pool().submit(self._fetch, j)
+                except RuntimeError:
+                    # pool already shut down (query finished; a cached
+                    # partition is being read late): degrade to sync reads
+                    self._closed = True
+                    return
+                s.state = _SUBMITTED
+                s.future = fut
+                s.charged = True
+                self._ninflight += 1
+                self._ledger.prefetch_started(s.est_bytes)
+                self._stats.bump("prefetch_submitted")
+
+    def _fetch(self, idx: int) -> List[Any]:
+        """Background fetch body (runs on a pool worker)."""
+        from .. import faults
+
+        faults.check("prefetch.fetch", self._stats)
+        t0 = time.perf_counter_ns()
+        chunks = _read_task_chunks(self._slots[idx].task)
+        self._stats.bump("prefetch_read_ns", time.perf_counter_ns() - t0)
+        return chunks
+
+    # ------------------------------------------------------------ consumption
+    def _release_locked(self, s: _Slot) -> None:
+        # runs under self._lock (every caller holds it); the lock-discipline
+        # rule is lexical and cannot see through the helper
+        if s.charged:
+            s.charged = False
+            self._ninflight -= 1  # daftlint: disable=DTL002
+            self._ledger.prefetch_done(s.est_bytes)
+
+    def fetch_now(self, idx: int) -> List[Any]:
+        """Materialize task ``idx`` (from its prefetch future when one is in
+        flight, synchronously otherwise) and trigger readahead past it.
+
+        On a POOL WORKER (parallel map / pooled shuffle fanout) the
+        prefetcher stands down: the dispatch window already overlaps
+        worker reads, so driving readahead from here would only queue a
+        second copy of the same work and turn this worker into a handoff
+        waiting on another. Worker reads also stay out of io_wait_ns —
+        that counter means consumer-thread blocked time."""
+        from ..scheduler import on_pool_worker
+
+        worker = on_pool_worker()
+        if not worker:
+            self.ensure_ahead(idx + 1)
+        with self._lock:
+            s = self._slots[idx]
+            fut = s.future
+            s.future = None
+            s.state = _TAKEN
+        if fut is None:
+            t0 = time.perf_counter_ns()
+            try:
+                return _read_task_chunks(s.task)
+            finally:
+                if not worker:
+                    self._stats.bump("prefetch_misses")
+                    self._stats.bump("io_wait_ns",
+                                     time.perf_counter_ns() - t0)
+        try:
+            if fut.done():
+                self._stats.bump("prefetch_hits")
+                return fut.result()
+            if fut.cancel():
+                # still queued behind other pool work: never wait on a fetch
+                # that hasn't started (pool-starvation deadlock) — read here
+                self._stats.bump("prefetch_misses")
+                t0 = time.perf_counter_ns()
+                try:
+                    return _read_task_chunks(s.task)
+                finally:
+                    if not worker:
+                        self._stats.bump("io_wait_ns",
+                                         time.perf_counter_ns() - t0)
+            else:
+                # running on a worker right now: it will complete — wait
+                t0 = time.perf_counter_ns()
+                try:
+                    return fut.result()
+                finally:
+                    self._stats.bump("prefetch_hits")
+                    if not worker:
+                        self._stats.bump("io_wait_ns",
+                                         time.perf_counter_ns() - t0)
+        finally:
+            with self._lock:
+                self._release_locked(s)
+
+    def abandon(self, idx: int) -> None:
+        """The wrapper for ``idx`` was narrowed or died unconsumed: stop its
+        fetch if possible and return its ledger charge."""
+        with self._lock:
+            s = self._slots[idx]
+            if s.state == _TAKEN or s.state == _ABANDONED:
+                return
+            s.state = _ABANDONED
+            fut, s.future = s.future, None
+            if fut is None or fut.cancel():
+                self._release_locked(s)
+                return
+
+        def _settle(f):
+            f.exception()  # retrieve, so abandoned failures don't warn
+            with self._lock:
+                self._release_locked(s)
+
+        fut.add_done_callback(_settle)
+
+
+def _read_task_chunks(task) -> List[Any]:
+    """One scan task -> its reader-chunk Tables, via the identical path a
+    direct materialization takes (chunk structure preserved for the
+    shuffle map side; plain tasks read as a single chunk)."""
+    read_chunks = getattr(task, "read_chunks", None)
+    if read_chunks is not None:
+        return list(read_chunks())
+    return [task.read()]
+
+
+class PrefetchedScanTask:
+    """A scan task whose read may be served by a completed background fetch.
+
+    Everything except the read/readahead surface delegates to the wrapped
+    task, so metadata (num_rows/size_bytes/stats/schema) and planning never
+    change. Narrowing (``with_pushdowns``) returns the UNDERLYING task
+    narrowed — a narrowed read is a different read and must not consume the
+    full-task fetch."""
+
+    def __init__(self, queue: ScanPrefetcher, idx: int):
+        self._queue = queue
+        self._idx = idx
+        self._task = queue._slots[idx].task
+        # a wrapper that dies unread (limit early-stop, abandoned stream)
+        # returns its ledger charge and frees its future's result
+        weakref.finalize(self, queue.abandon, idx)
+
+    # --- read surface ----------------------------------------------------
+    def read(self):
+        from ..table import Table
+
+        chunks = self._queue.fetch_now(self._idx)
+        return chunks[0] if len(chunks) == 1 else Table.concat(chunks)
+
+    def read_chunks(self):
+        return self._queue.fetch_now(self._idx)
+
+    def with_pushdowns(self, pushdowns):
+        self._queue.abandon(self._idx)
+        return self._task.with_pushdowns(pushdowns)
+
+    # --- metadata delegates ----------------------------------------------
+    @property
+    def materialized_schema(self):
+        return self._task.materialized_schema
+
+    @property
+    def pushdowns(self):
+        return self._task.pushdowns
+
+    def num_rows(self) -> Optional[int]:
+        return self._task.num_rows()
+
+    def size_bytes(self) -> Optional[int]:
+        return self._task.size_bytes()
+
+    def can_prune(self) -> bool:
+        return self._task.can_prune()
+
+    def __getattr__(self, name):
+        # anything else (path, format, schema, stats, storage_options, ...)
+        # answers from the wrapped task
+        return getattr(self._task, name)
+
+    def __repr__(self) -> str:
+        return f"PrefetchedScanTask#{self._idx}({self._task!r})"
+
+
+def pipeline_scan_parts(parts, ctx):
+    """Wrap a scan's emitted partitions for prefetch: locally-readable tasks
+    go through one ScanPrefetcher (depth = cfg.scan_prefetch_depth);
+    foreign-owned partitions (multi-host scan locality) pass through
+    untouched — this process must never issue their reads. Depth 0 leaves
+    the stream exactly as built."""
+    from ..micropartition import MicroPartition
+
+    depth = getattr(ctx.cfg, "scan_prefetch_depth", 0)
+    if depth <= 0 or not parts:
+        return parts
+    local = [p for p in parts if not ctx.foreign_owned(p)]
+    if not local:
+        return parts
+    queue = ScanPrefetcher([p.scan_task() for p in local], ctx, depth)
+    by_id = {id(p): i for i, p in enumerate(local)}
+    out = []
+    for p in parts:
+        i = by_id.get(id(p))
+        if i is None:
+            out.append(p)
+            continue
+        wrapped = MicroPartition.from_scan_task(queue.wrap(i))
+        wrapped.owner_process = p.owner_process
+        out.append(wrapped)
+    return out

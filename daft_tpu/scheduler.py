@@ -13,10 +13,36 @@ its work (or cancellation) finishes.
 
 from __future__ import annotations
 
+import threading
+import time
 from collections import deque
 from typing import Callable, Iterator, Optional
 
 from .micropartition import MicroPartition
+
+# marks threads currently executing a dispatched partition task: the scan
+# prefetcher uses this to stand down on pool workers (the dispatch window
+# already overlaps their reads; a prefetch future would only add a
+# worker-to-worker handoff) and to keep io_wait_ns meaning CONSUMER-thread
+# blocked time
+_WORKER_TL = threading.local()
+
+
+def on_pool_worker() -> bool:
+    return getattr(_WORKER_TL, "active", False)
+
+
+def _await_result(fut, ctx) -> MicroPartition:
+    """Resolve a head-of-line task future, attributing blocked time to the
+    dispatcher (dispatch_wait_ns) so the io_wait-vs-compute split can tell
+    a starved pipeline from a compute-bound one."""
+    if fut.done():
+        return fut.result()
+    t0 = time.perf_counter_ns()
+    try:
+        return fut.result()
+    finally:
+        ctx.stats.bump("dispatch_wait_ns", time.perf_counter_ns() - t0)
 
 
 class PartitionTask:
@@ -65,9 +91,11 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
     pending: deque = deque()
 
     def run_task(task: PartitionTask) -> MicroPartition:
+        _WORKER_TL.active = True
         try:
             return task.run()
         finally:
+            _WORKER_TL.active = False
             # drop the input partition as soon as the work is done — the
             # result may wait in `pending` behind a slow head-of-line task,
             # and holding input + output would double peak partition memory
@@ -85,13 +113,15 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
                 ctx.accountant.admit(task.resource_request)
             pending.append((task, pool.submit(run_task, task)))
             while len(pending) >= window:
-                yield pending.popleft()[1].result()
+                yield _await_result(pending.popleft()[1], ctx)
         while pending:
             # the deadline stays cooperative through the drain: in-flight
             # results are yielded, but an expired budget stops the query at
             # the next partition boundary instead of finishing the backlog
+            # (check_deadline is also the barrier where async-spill writer
+            # errors surface on the dispatching thread)
             ctx.check_deadline()
-            yield pending.popleft()[1].result()
+            yield _await_result(pending.popleft()[1], ctx)
     finally:
         for task, fut in pending:
             # a queued task that never ran still holds its admission

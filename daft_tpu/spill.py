@@ -1,4 +1,4 @@
-"""Bounded-memory execution: spillable partition buffers.
+"""Bounded-memory execution: spillable partition buffers with pipelined IO.
 
 The reference completes TPC-H SF1000 on a single node at a 16x
 data-to-memory ratio (docs/source/faq/benchmarks.rst:111-124) by keeping
@@ -11,23 +11,57 @@ spill directory and handed back as UNLOADED MicroPartitions — the consumer
 re-materializes them one at a time, so peak engine-held memory stays at
 (budget + one working partition).
 
+Pipelining (the BENCH_r05 out-of-core lesson — scan decode, spill writes
+and unspill reads were all serialized with compute):
+
+- **async spill writeback** (cfg.async_spill_writes): the arrow-IPC write
+  runs on a bounded per-query writer thread, so a breaker appending past
+  the budget keeps fanning out instead of stalling on disk; the partition's
+  chunk tables stay resident (accounted in ``async_spill_inflight``) until
+  the write lands, and a failed write degrades to the same hold-in-memory
+  fallback the synchronous path has always had. Writer-internal errors
+  (engine bugs, not write failures) surface at the next
+  ``check_deadline``/drain barrier, never in a dead thread.
+- **unspill readahead** (cfg.unspill_readahead): while the consumer works
+  on partition i of a drain, partition i+1's read-back runs on the shared
+  executor pool (one slot — classic double buffering); whole next buckets
+  preload via ``preload()`` on the shuffle reduce side. Errors from a
+  background read-back re-raise on the consumer thread at the hand-off.
+
 Accounting is engine-level (sum of buffered partition byte sizes tracked by
 a process-wide ledger with a high-water mark), which tests can assert
-exactly — RSS would be dominated by the jax runtime."""
+exactly — RSS would be dominated by the jax runtime. Scan-prefetch
+readahead (io/prefetch.py) charges the same ledger so the two readahead
+layers share one budget."""
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import tempfile
 import threading
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional
 
 from .micropartition import MicroPartition
 
+logger = logging.getLogger(__name__)
+
+# marks pool threads running BACKGROUND IO (unspill readahead): a spill
+# read-back on one of them is overlap, not consumer wait, so it must not
+# count into io_wait_ns
+_BG_IO = threading.local()
+
+
+def _in_background_io() -> bool:
+    return getattr(_BG_IO, "active", False)
+
 
 class MemoryLedger:
-    """Process-wide account of bytes held by partition buffers."""
+    """Process-wide account of bytes held by partition buffers (plus the
+    in-flight balances of the two readahead layers and spill write/read
+    throughput totals, which bench.py reads per rung)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -35,6 +69,26 @@ class MemoryLedger:
         self.high_water = 0
         self.spilled_bytes = 0
         self.spilled_partitions = 0
+        # releases that would have driven `current` negative (double-release
+        # bugs): clamped at 0, warned, and counted so leak tests can assert
+        self.negative_releases = 0
+        # scan-prefetch charges currently in flight. Deliberately NOT part
+        # of `current`: the prefetcher caps itself against
+        # current + prefetch_inflight (so readahead can never blow the
+        # budget), but charging `current` would make every pipeline-breaker
+        # append see a full ledger and spill its entire input — measured
+        # 2x SLOWER at SF10 than no prefetch at all
+        self.prefetch_inflight = 0
+        # partitions handed to the async spill writer whose bytes are still
+        # resident until the write lands (NOT in `current`: like the sync
+        # writer's working copy, they are transient write-side state,
+        # bounded by the writer queue depth)
+        self.async_spill_inflight = 0
+        # spill write/read throughput totals (file bytes + wall ns)
+        self.spill_write_bytes = 0
+        self.spill_write_ns = 0
+        self.unspill_bytes = 0
+        self.unspill_ns = 0
 
     def add(self, n: int) -> None:
         with self._lock:
@@ -43,12 +97,72 @@ class MemoryLedger:
 
     def sub(self, n: int) -> None:
         with self._lock:
-            self.current -= n
+            self._sub_locked(n)
+
+    def _sub_locked(self, n: int) -> None:
+        # runs under self._lock (every caller holds it); the lock-discipline
+        # rule is lexical and cannot see through the helper
+        if n > self.current:
+            # double-release: clamp rather than poison every later budget
+            # decision with a negative balance — but never silently
+            # daftlint: disable=DTL002
+            self.negative_releases += 1
+            logger.warning(
+                "MemoryLedger release of %d bytes exceeds current balance "
+                "%d (double release?); clamping at 0", n, self.current)
+            self.current = 0  # daftlint: disable=DTL002
+        else:
+            self.current -= n  # daftlint: disable=DTL002
 
     def spilled(self, n: int) -> None:
         with self._lock:
             self.spilled_bytes += n
             self.spilled_partitions += 1
+
+    # --- scan-prefetch charges (io/prefetch.py) -------------------------
+    def prefetch_started(self, n: int) -> None:
+        with self._lock:
+            self.prefetch_inflight += n
+
+    def prefetch_done(self, n: int) -> None:
+        with self._lock:
+            self.prefetch_inflight = max(0, self.prefetch_inflight - n)
+
+    # --- async spill writeback ------------------------------------------
+    def async_spill_started(self, n: int) -> None:
+        with self._lock:
+            self.async_spill_inflight += n
+
+    def async_spill_done(self, n: int) -> None:
+        with self._lock:
+            self.async_spill_inflight = max(0, self.async_spill_inflight - n)
+            self.spilled_bytes += n
+            self.spilled_partitions += 1
+
+    def async_spill_abandoned(self, n: int) -> None:
+        """The write was never submitted (writer closed): nothing in flight."""
+        with self._lock:
+            self.async_spill_inflight = max(0, self.async_spill_inflight - n)
+
+    def async_spill_failed(self, n: int) -> None:
+        """Write failed -> the partition is genuinely held in memory after
+        all: its bytes move from the in-flight balance into `current` (the
+        holding task's finalizer returns them)."""
+        with self._lock:
+            self.async_spill_inflight = max(0, self.async_spill_inflight - n)
+            self.current += n
+            self.high_water = max(self.high_water, self.current)
+
+    # --- spill IO throughput --------------------------------------------
+    def record_spill_write(self, nbytes: int, ns: int) -> None:
+        with self._lock:
+            self.spill_write_bytes += nbytes
+            self.spill_write_ns += ns
+
+    def record_unspill(self, nbytes: int, ns: int) -> None:
+        with self._lock:
+            self.unspill_bytes += nbytes
+            self.unspill_ns += ns
 
     def reset(self) -> None:
         with self._lock:
@@ -56,6 +170,29 @@ class MemoryLedger:
             self.high_water = 0
             self.spilled_bytes = 0
             self.spilled_partitions = 0
+            self.negative_releases = 0
+            self.prefetch_inflight = 0
+            self.async_spill_inflight = 0
+            self.spill_write_bytes = 0
+            self.spill_write_ns = 0
+            self.unspill_bytes = 0
+            self.unspill_ns = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "current": self.current,
+                "high_water": self.high_water,
+                "spilled_bytes": self.spilled_bytes,
+                "spilled_partitions": self.spilled_partitions,
+                "negative_releases": self.negative_releases,
+                "prefetch_inflight": self.prefetch_inflight,
+                "async_spill_inflight": self.async_spill_inflight,
+                "spill_write_bytes": self.spill_write_bytes,
+                "spill_write_ns": self.spill_write_ns,
+                "unspill_bytes": self.unspill_bytes,
+                "unspill_ns": self.unspill_ns,
+            }
 
 
 MEMORY_LEDGER = MemoryLedger()
@@ -69,6 +206,73 @@ _SPILL_SEQ = [0]
 # RAM so the disk itself gates. A/B at SF10 on this host (r5, two
 # interleaved trials): uncompressed 34.8/32.2s vs lz4 46.4/34.3s.
 _SPILL_CODEC: Optional[str] = None
+# max arrow-IPC writes queued/in-flight on the async writer before append()
+# exerts backpressure — bounds dirty not-yet-durable partition bytes to
+# roughly this many working partitions
+_SPILL_WRITER_DEPTH = 4
+
+
+class AsyncSpillWriter:
+    """Bounded single-thread writer for async spill writeback.
+
+    ``submit`` blocks (backpressure) while _SPILL_WRITER_DEPTH jobs are
+    already queued/in-flight — that wait is the breaker's only disk stall,
+    and it is counted into io_wait_ns by the caller. Exceptions a job
+    did not handle itself (engine bugs — write FAILURES are handled by the
+    job's hold-in-memory fallback) are recorded and re-raised at the next
+    check_deadline/drain barrier via ``raise_errors``."""
+
+    def __init__(self, depth: int = _SPILL_WRITER_DEPTH):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="daft-spill-writer")
+        self._slots = threading.Semaphore(max(1, depth))
+        self._lock = threading.Lock()
+        self._errors: List[BaseException] = []
+        self._closed = False
+
+    def submit(self, job: Callable[[], None]) -> bool:
+        """Queue a write job; blocks while the queue is full. False when the
+        writer is already closed (caller falls back to a synchronous/held
+        spill)."""
+        with self._lock:
+            if self._closed:
+                return False
+        self._slots.acquire()
+
+        def run():
+            try:
+                job()
+            except BaseException as e:  # job fallbacks failed: surface later
+                with self._lock:
+                    self._errors.append(e)
+            finally:
+                self._slots.release()
+
+        try:
+            self._pool.submit(run)
+        except RuntimeError:  # closed between the check and the submit
+            self._slots.release()
+            return False
+        return True
+
+    def raise_errors(self) -> None:
+        with self._lock:
+            if not self._errors:
+                return
+            err = self._errors.pop(0)
+        from .errors import DaftInternalError
+
+        raise DaftInternalError(
+            f"async spill writer failed: {err!r}") from err
+
+    def close(self) -> None:
+        """Wait for every queued write to finish, then stop the thread
+        (called before the spill directory is removed)."""
+        with self._lock:
+            self._closed = True
+        self._pool.shutdown(wait=True)
 
 
 class SpillScope:
@@ -81,12 +285,17 @@ class SpillScope:
     faults brand-new pages — measured on this (ballooned) host: 534 MB of
     IPC spill writes take 4.7 s to fresh names vs 0.5-1.1 s over reused
     names. Safety: recycled slots are only handed out after the one
-    materialization copied the bytes out (see _SpillSlotTask)."""
+    materialization copied the bytes out (see _SpillSlotTask).
+
+    The scope also owns the query's AsyncSpillWriter (lazily created);
+    cleanup() drains it before removing the directory, so no write ever
+    races the rmtree."""
 
     def __init__(self):
         self._dir: Optional[str] = None
         self._free_slots: List[str] = []
         self._slot_gen: dict = {}
+        self._writer: Optional[AsyncSpillWriter] = None
         self._lock = threading.Lock()
 
     def take_slot(self) -> Optional[str]:
@@ -117,7 +326,27 @@ class SpillScope:
                 self._dir = tempfile.mkdtemp(prefix="daft_tpu_spill_")
             return self._dir
 
+    def writer(self) -> AsyncSpillWriter:
+        with self._lock:
+            if self._writer is None:
+                self._writer = AsyncSpillWriter()
+            return self._writer
+
+    def raise_async_errors(self) -> None:
+        """Surface writer-internal errors at a barrier (check_deadline /
+        drain). Cheap when no writer exists."""
+        with self._lock:
+            w = self._writer
+        if w is not None:
+            w.raise_errors()
+
     def cleanup(self) -> None:
+        # drain the writer OUTSIDE the scope lock: write jobs are allowed
+        # to touch scope bookkeeping, and close() waits for them
+        with self._lock:
+            w, self._writer = self._writer, None
+        if w is not None:
+            w.close()
         with self._lock:
             if self._dir is not None:
                 shutil.rmtree(self._dir, ignore_errors=True)
@@ -145,14 +374,17 @@ class _SpillSlotTask:
     spill budget is never silently defeated by a hidden strong cache)."""
 
     def __init__(self, path: str, schema, num_rows: int, size_bytes: int,
-                 scope: SpillScope):
+                 scope: SpillScope, rt_stats=None):
         self.path = path
         self.schema = schema
         self.num_rows_exact = num_rows
         # captured at spill time: the live file stops describing THIS
         # partition the moment the slot recycles
         self.size_bytes_exact = size_bytes
+        # scan-task TableStats surface consumed by MicroPartition (none for
+        # spill files); the per-query RuntimeStats handle lives separately
         self.stats = None
+        self._rt_stats = rt_stats
         self._scope = scope
         self._cached_ref = None
         # generation observed when the slot was taken for THIS partition:
@@ -173,37 +405,62 @@ class _SpillSlotTask:
         return self.size_bytes_exact
 
     def read(self):
-        import pyarrow as pa
-        import weakref
-
-        from .io.readers import IO_STATS
-        from .table import Table
-
         with self._read_lock:
             if self._cached_ref is not None:
                 tbl = self._cached_ref()
                 if tbl is not None:
                     return tbl
-            # invariant: this task is alive (we are in its method), so its
-            # slot has NOT been recycled — recycling happens only at task
-            # GC (weakref.finalize in _try_spill). A generation mismatch
-            # means the free-list handed the path out while a reference
-            # still existed; make that loud, never silently another
-            # partition's bytes.
-            if self._scope.generation(self.path) != self._slot_gen:
-                from .errors import DaftInternalError
+            from . import faults
 
-                raise DaftInternalError(
-                    f"spill slot {self.path} was re-taken while a live "
-                    "reference could still read it; this is an engine bug")
-            with pa.OSFile(self.path) as f:
-                arrow_tbl = pa.ipc.open_file(f).read_all()
-            IO_STATS.bump(files_opened=1, bytes_read=arrow_tbl.nbytes,
-                          rows_read=arrow_tbl.num_rows,
-                          columns_read=arrow_tbl.num_columns)
-            tbl = Table.from_arrow(arrow_tbl)
+            # each spill read-back is a fault site: injected failures must
+            # reach the drain consumer, whether the read runs synchronously
+            # or on the readahead pool (DTL004-covered)
+            faults.check("spill.readback", self._rt_stats)
+            tbl = self._materialize_locked()
+            import weakref
+
             self._cached_ref = weakref.ref(tbl)
             return tbl
+
+    def _materialize_locked(self):
+        """File read-back, called under the read lock."""
+        import pyarrow as pa
+
+        from .io.readers import IO_STATS
+        from .table import Table
+
+        # invariant: this task is alive (we are in its method), so its
+        # slot has NOT been recycled — recycling happens only at task
+        # GC (weakref.finalize in _try_spill). A generation mismatch
+        # means the free-list handed the path out while a reference
+        # still existed; make that loud, never silently another
+        # partition's bytes.
+        if self._scope.generation(self.path) != self._slot_gen:
+            from .errors import DaftInternalError
+
+            raise DaftInternalError(
+                f"spill slot {self.path} was re-taken while a live "
+                "reference could still read it; this is an engine bug")
+        t0 = time.perf_counter_ns()
+        with pa.OSFile(self.path) as f:
+            arrow_tbl = pa.ipc.open_file(f).read_all()
+        dt = time.perf_counter_ns() - t0
+        MEMORY_LEDGER.record_unspill(self.size_bytes_exact, dt)
+        if self._rt_stats is not None:
+            from .scheduler import on_pool_worker
+
+            self._rt_stats.bump("spill_read_bytes", self.size_bytes_exact)
+            self._rt_stats.bump("spill_read_ns", dt)
+            if not _in_background_io() and not on_pool_worker():
+                # the consumer thread itself blocked on this read; a read
+                # on the readahead pool or inside a dispatched partition
+                # task (parallel map / pooled fanout) is overlapped work,
+                # not consumer wait
+                self._rt_stats.bump("io_wait_ns", dt)
+        IO_STATS.bump(files_opened=1, bytes_read=arrow_tbl.nbytes,
+                      rows_read=arrow_tbl.num_rows,
+                      columns_read=arrow_tbl.num_columns)
+        return Table.from_arrow(arrow_tbl)
 
     # head() on an unloaded partition narrows the task's limit; spill tasks
     # support that surface by applying the pushdowns to the one read
@@ -218,6 +475,65 @@ class _SpillSlotTask:
 
     def __repr__(self) -> str:
         return f"_SpillSlotTask({self.path}, rows={self.num_rows_exact})"
+
+
+class _AsyncSpillSlotTask(_SpillSlotTask):
+    """A spill slot whose IPC write is still in flight on the writer
+    thread. Until the write lands, the partition's chunk tables stay
+    resident on the task (accounted as async_spill_inflight) and a read
+    serves them directly — the file is only read by consumers arriving
+    after the hand-off dropped the memory copy. A failed write simply
+    keeps the tables: the hold-in-memory fallback of the synchronous
+    path, discovered late."""
+
+    def __init__(self, path: str, schema, num_rows: int, size_bytes: int,
+                 scope: SpillScope, tables, rt_stats=None):
+        super().__init__(path, schema, num_rows, size_bytes, scope,
+                         rt_stats=rt_stats)
+        self._tables = list(tables)
+        # bytes this task holds in ledger `current` after a write failure;
+        # shared with the finalizer so the charge settles exactly once
+        self._held_cell = {"bytes": 0}
+
+    def _write_done(self, file_bytes: int) -> None:
+        with self._read_lock:
+            self._tables = None
+            self.size_bytes_exact = file_bytes
+
+    def _write_failed(self, size: int) -> None:
+        with self._read_lock:
+            self._held_cell["bytes"] = size
+
+    def _materialize_locked(self):
+        if self._tables is not None:
+            from .table import Table
+
+            if self._rt_stats is not None:
+                self._rt_stats.bump("spill_mem_reads")
+            tbls = self._tables
+            if len(tbls) == 1:
+                return tbls[0]
+            # mirror the IPC writer's chunk handling (every batch cast to
+            # the first chunk's schema) so a memory-served read is
+            # byte-identical to the file round-trip
+            s0 = tbls[0].schema
+            tbls = [t if t.schema == s0 else t.cast_to_schema(s0)
+                    for t in tbls]
+            return Table.concat(tbls)
+        return super()._materialize_locked()
+
+    def __repr__(self) -> str:
+        return f"_AsyncSpillSlotTask({self.path}, rows={self.num_rows_exact})"
+
+
+def _settle_async_slot(scope: SpillScope, path: str, held_cell: dict) -> None:
+    """Finalizer for async spill tasks: recycle the slot and return any
+    hold-in-memory bytes a failed write left charged."""
+    scope.recycle(path)
+    held = held_cell.get("bytes", 0)
+    if held:
+        held_cell["bytes"] = 0
+        MEMORY_LEDGER.sub(held)
 
 
 class _SpillSlotView:
@@ -269,17 +585,51 @@ class _SpillSlotView:
         return tbl
 
 
+def _write_spill_ipc(path: str, tbls) -> int:
+    """Arrow-IPC spill write (codec per _SPILL_CODEC): parquet spills paid a
+    full encode+decode round-trip per partition; IPC writes land in the
+    page cache at memcpy speed and the consumer reads them back through
+    warm page-cache file reads (_SpillSlotTask). Chunk-wise: a multi-piece
+    shuffle bucket streams each piece as its own record batch — the bucket
+    is never concatenated just to be spilled. Returns bytes written."""
+    import pyarrow as pa
+
+    atbls = [t.to_arrow() for t in tbls]
+    schema = atbls[0].schema
+    opts = pa.ipc.IpcWriteOptions(compression=_SPILL_CODEC)
+    with pa.OSFile(path, "wb") as f, \
+            pa.ipc.new_file(f, schema, options=opts) as w:
+        for at in atbls:
+            if at.schema != schema:
+                at = at.cast(schema)
+            w.write_table(at)
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return sum(at.nbytes for at in atbls)
+
+
 class PartitionBuffer:
     """Append MicroPartitions; past the budget they spill to arrow IPC files
-    and come back lazy. Iterating yields partitions in append order (spilled ones as
-    Unloaded MicroPartitions that re-read on demand)."""
+    and come back lazy. Iterating yields partitions in append order (spilled
+    ones as Unloaded MicroPartitions that re-read on demand).
+
+    ``async_spill`` routes the IPC writes through the scope's bounded
+    writer thread; ``readahead`` (a submit callable, normally the query
+    pool's) pipelines drain()'s spill read-backs one partition ahead of
+    the consumer. Both default OFF for directly-constructed buffers — the
+    ExecutionContext wires them from the ExecutionConfig."""
 
     def __init__(self, budget_bytes: Optional[int], stats=None,
-                 scope: Optional[SpillScope] = None):
+                 scope: Optional[SpillScope] = None,
+                 async_spill: bool = False,
+                 readahead: Optional[Callable] = None):
         self.budget = budget_bytes
         self.stats = stats
         self.scope = scope or SpillScope()
-        self._items: List[MicroPartition] = []
+        self.async_spill = async_spill
+        self._readahead = readahead
+        self._items: List[Optional[MicroPartition]] = []
         self._held: List[int] = []
 
     def append(self, part: MicroPartition) -> None:
@@ -295,38 +645,36 @@ class PartitionBuffer:
         self._items.append(part)
         self._held.append(size)
 
-    def _try_spill(self, part: MicroPartition, size: int) -> Optional[MicroPartition]:
-        import pyarrow as pa
-
+    def _take_path(self) -> str:
         path = self.scope.take_slot()
         if path is None:
             with _SPILL_LOCK:
                 _SPILL_SEQ[0] += 1
                 seq = _SPILL_SEQ[0]
             path = os.path.join(self.scope.dir(), f"spill_{seq}.arrow")
-        # chunk-wise write: a multi-piece shuffle bucket (chained per-chunk
-        # splits) streams each piece as its own record batch — the bucket is
-        # never concatenated just to be spilled
+        return path
+
+    def _try_spill(self, part: MicroPartition, size: int) -> Optional[MicroPartition]:
+        import weakref
+
+        path = self._take_path()
+        # chunk-wise: a multi-piece shuffle bucket (chained per-chunk splits)
+        # spills its pieces as separate record batches
         tbls = part.chunk_tables()
+        if self.async_spill:
+            out = self._spill_async(path, tbls, size)
+            if out is not None:
+                return out
+            # writer unavailable (closed scope): fall through to sync
         nrows = 0
         try:
             from . import faults
 
             faults.check("spill.write", self.stats)
-            # arrow IPC spills (codec per _SPILL_CODEC above): parquet spills
-            # paid a full encode+decode round-trip per partition; IPC writes
-            # land in the page cache at memcpy speed and the consumer reads
-            # them back through warm page-cache file reads (_SpillSlotTask).
-            atbls = [t.to_arrow() for t in tbls]
-            schema = atbls[0].schema
-            opts = pa.ipc.IpcWriteOptions(compression=_SPILL_CODEC)
-            with pa.OSFile(path, "wb") as f, \
-                    pa.ipc.new_file(f, schema, options=opts) as w:
-                for at in atbls:
-                    if at.schema != schema:
-                        at = at.cast(schema)
-                    w.write_table(at)
-                    nrows += at.num_rows
+            t0 = time.perf_counter_ns()
+            file_bytes = _write_spill_ipc(path, tbls)
+            dt = time.perf_counter_ns() - t0
+            nrows = sum(len(t) for t in tbls)
         except Exception:
             # python-object columns have no arrow representation — and a
             # full/failing spill disk looks the same: hold in memory rather
@@ -337,19 +685,72 @@ class PartitionBuffer:
             self.scope.recycle(path)
             return None
         MEMORY_LEDGER.spilled(size)
+        MEMORY_LEDGER.record_spill_write(file_bytes, dt)
         if self.stats is not None:
             self.stats.bump("spilled_partitions")
-        try:
-            file_bytes = os.path.getsize(path)
-        except OSError:
-            file_bytes = size
+            self.stats.bump("spill_write_bytes", file_bytes)
+            self.stats.bump("spill_write_ns", dt)
+            # a synchronous spill stalls the breaker thread for the whole
+            # write — exactly the wait async writeback removes
+            self.stats.bump("io_wait_ns", dt)
         task = _SpillSlotTask(path, tbls[0].schema, nrows, file_bytes,
-                              self.scope)
+                              self.scope, rt_stats=self.stats)
         # the slot recycles when nothing can read it anymore: task GC, not
         # first-read, so forked references never race the free-list
+        weakref.finalize(task, self.scope.recycle, path)
+        return MicroPartition.from_scan_task(task)
+
+    def _spill_async(self, path: str, tbls, size: int) -> Optional[MicroPartition]:
+        """Hand the IPC write to the scope's bounded writer thread; the
+        returned partition is immediately consumable (reads serve from the
+        resident tables until the write lands)."""
         import weakref
 
-        weakref.finalize(task, self.scope.recycle, path)
+        writer = self.scope.writer()
+        nrows = sum(len(t) for t in tbls)
+        task = _AsyncSpillSlotTask(path, tbls[0].schema, nrows,
+                                   sum(t.size_bytes() for t in tbls),
+                                   self.scope, tbls, rt_stats=self.stats)
+        stats = self.stats
+
+        def job():
+            from . import faults
+
+            try:
+                faults.check("spill.write", stats)
+                t0 = time.perf_counter_ns()
+                file_bytes = _write_spill_ipc(path, tbls)
+                dt = time.perf_counter_ns() - t0
+            except Exception:
+                # same contract as the synchronous path, discovered late:
+                # hold the partition in memory instead of failing the query
+                MEMORY_LEDGER.async_spill_failed(size)
+                task._write_failed(size)
+                if stats is not None:
+                    stats.bump("spill_write_failures")
+                return
+            MEMORY_LEDGER.async_spill_done(size)
+            MEMORY_LEDGER.record_spill_write(file_bytes, dt)
+            task._write_done(file_bytes)
+            if stats is not None:
+                stats.bump("spilled_partitions")
+                stats.bump("spill_write_bytes", file_bytes)
+                stats.bump("spill_write_ns", dt)
+
+        MEMORY_LEDGER.async_spill_started(size)
+        t0 = time.perf_counter_ns()
+        submitted = writer.submit(job)
+        backpressure = time.perf_counter_ns() - t0
+        if not submitted:
+            MEMORY_LEDGER.async_spill_abandoned(size)
+            return None
+        if stats is not None and backpressure > 1_000_000:
+            # the only disk stall left on the append path: a full writer
+            # queue (>1ms counts; the fast path is lock-acquire noise)
+            stats.bump("io_wait_ns", backpressure)
+            stats.bump("spill_backpressure_ns", backpressure)
+        weakref.finalize(task, _settle_async_slot, self.scope, path,
+                         task._held_cell)
         return MicroPartition.from_scan_task(task)
 
     def __len__(self) -> int:
@@ -361,18 +762,111 @@ class PartitionBuffer:
     def parts(self) -> List[MicroPartition]:
         return list(self._items)
 
+    def preload(self) -> None:
+        """Issue background read-backs for unloaded (spilled) items — the
+        shuffle reduce side calls this on bucket i+1 while bucket i is
+        being consumed downstream. Bounded by the spill budget: at least
+        one load always submits (the consumer's own working-partition
+        slack), further ones only while their estimated bytes fit within
+        budget_bytes — a whole oversized bucket never preloads resident
+        unthrottled (preload_throttled counts what waited for the
+        consumer's sequential reads). Errors stay with the partition: a
+        failed background load leaves it unloaded and the consumer's own
+        read raises."""
+        submit = self._readahead
+        if submit is None:
+            return
+        submitted_bytes = 0
+        for p in self._items:
+            if p is None or p.is_loaded():
+                continue
+            est = p.size_bytes() or 0
+            if (submitted_bytes and self.budget is not None
+                    and submitted_bytes + est > self.budget):
+                if self.stats is not None:
+                    self.stats.bump("preload_throttled")
+                return
+            self._submit_load(p)
+            submitted_bytes += est
+
+    def _submit_load(self, part: MicroPartition):
+        submit = self._readahead
+
+        def job():
+            _BG_IO.active = True
+            try:
+                return part.table()
+            finally:
+                _BG_IO.active = False
+
+        try:
+            fut = submit(job)
+        except RuntimeError:  # pool already shut down: consumer reads sync
+            return None
+        if fut is not None:
+            # retrieve background exceptions even when nobody awaits (an
+            # abandoned drain, preload): the partition stays unloaded, so
+            # the consumer's own read raises the same error — result()
+            # still re-raises for awaiting callers
+            fut.add_done_callback(
+                lambda f: None if f.cancelled() else f.exception())
+            if self.stats is not None:
+                self.stats.bump("unspill_readahead_submitted")
+        return fut
+
     def drain(self):
         """Yield partitions in append order, dropping each internal ref as it
         is handed out, so a spilled partition's re-materialized table lives
         only for the consumer's one iteration (out-of-core discipline: the
-        buffer never re-pins the whole input)."""
+        buffer never re-pins the whole input). With readahead wired, the
+        next spilled partition's read-back runs on the pool while the
+        consumer processes the current one; a background failure re-raises
+        HERE, on the consumer thread, at that partition's hand-off."""
+        # drain is a flush barrier: writer-internal errors surface before
+        # the consumer starts pulling
+        self.scope.raise_async_errors()
+        pending_idx = -1
+        pending_fut = None
         for i in range(len(self._items)):
             part, self._items[i] = self._items[i], None
             MEMORY_LEDGER.sub(self._held[i])
             self._held[i] = 0
+            if pending_idx == i and pending_fut is not None:
+                self._await_load(pending_fut)
+                pending_fut = None
+            if self._readahead is not None and pending_fut is None:
+                j = i + 1
+                while (j < len(self._items) and self._items[j] is not None
+                       and self._items[j].is_loaded()):
+                    j += 1
+                if j < len(self._items) and self._items[j] is not None:
+                    pending_fut = self._submit_load(self._items[j])
+                    pending_idx = j
             yield part
         self._items = []
         self._held = []
+
+    def _await_load(self, fut) -> None:
+        """Resolve a readahead future before handing its partition out.
+        Never waits on a fetch that hasn't started (a congested pool would
+        deadlock a consumer that is itself a pool task): cancel and let the
+        consumer read synchronously instead."""
+        if fut.done():
+            if self.stats is not None:
+                self.stats.bump("unspill_readahead_hits")
+            fut.result()  # re-raise a background failure to the consumer
+            return
+        if fut.cancel():
+            if self.stats is not None:
+                self.stats.bump("unspill_readahead_misses")
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            fut.result()
+        finally:
+            if self.stats is not None:
+                self.stats.bump("unspill_readahead_hits")
+                self.stats.bump("io_wait_ns", time.perf_counter_ns() - t0)
 
     def release(self) -> None:
         """Return held bytes to the ledger and drop partition refs (call when

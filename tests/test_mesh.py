@@ -28,6 +28,8 @@ def test_property_mesh_shuffle_parity_random_tables():
     schemes, null densities, and dtype mixes (ints, floats, dates, strings
     with nulls). Every eligible exchange must reproduce the host shuffle's
     row multiset exactly."""
+    pytest.importorskip("hypothesis",
+                        reason="hypothesis not installed in image")
     from hypothesis import given, settings
     from hypothesis import strategies as st
 

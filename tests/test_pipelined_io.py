@@ -1,0 +1,318 @@
+"""Pipelined IO: scan prefetch, async spill writeback, unspill readahead.
+
+The load-bearing invariant is BYTE-IDENTICAL results with the pipeline on
+or off, at every prefetch depth — readahead moves WHERE reads run, never
+what they return or the order partitions flow in. Fault-injection tests
+prove background failures propagate to the caller on the execution thread
+(never lost in a dead worker), and ledger tests pin the memory-accounting
+contract (charges always settle; double-releases clamp and count)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, faults
+from daft_tpu.errors import DaftTransientError
+from daft_tpu.spill import MEMORY_LEDGER
+
+RNG = np.random.RandomState(11)
+
+
+@pytest.fixture
+def cfg():
+    """Snapshot + restore the execution config; result cache off so every
+    run really executes; no scan-task merging so multi-file dirs stay
+    multi-task (the shape prefetch exists for)."""
+    from daft_tpu.context import get_context
+
+    c = get_context().execution_config
+    saved = {k: getattr(c, k) for k in (
+        "scan_prefetch_depth", "async_spill_writes", "unspill_readahead",
+        "parallel_shuffle_fanout", "memory_budget_bytes",
+        "enable_result_cache", "scan_tasks_min_size_bytes",
+        "executor_threads")}
+    c.enable_result_cache = False
+    c.scan_tasks_min_size_bytes = 1
+    yield c
+    for k, v in saved.items():
+        setattr(c, k, v)
+    faults.disarm()
+    MEMORY_LEDGER.reset()
+
+
+def _write_parquet_dir(tmp_path, nfiles=6, rows_per=4000):
+    d = tmp_path / "scan"
+    d.mkdir()
+    for i in range(nfiles):
+        tbl = pa.table({
+            "k": pa.array(RNG.randint(0, 50, rows_per)),
+            "v": pa.array(RNG.rand(rows_per)),
+            "s": pa.array([f"r{i}_{j % 97}" for j in range(rows_per)]),
+        })
+        papq.write_table(tbl, str(d / f"part-{i:02d}.parquet"))
+    return str(d)
+
+
+def _partition_pydicts(df):
+    res = df.collect()
+    return [p.to_pydict() for p in res._result.partitions]
+
+
+class TestScanPrefetch:
+    def test_prefetch_depths_identical_results_and_order(self, cfg, tmp_path):
+        """Property: prefetch off and depths {1, 2, 8} produce identical
+        per-partition results in identical partition order."""
+        path = _write_parquet_dir(tmp_path)
+
+        def run(depth):
+            cfg.scan_prefetch_depth = depth
+            q = (dt.read_parquet(os.path.join(path, "*.parquet"))
+                 .where(col("k") < 40)
+                 .with_column("kv", col("k") * col("v")))
+            return _partition_pydicts(q)
+
+        want = run(0)
+        assert len(want) == 6  # one partition per file, order preserved
+        for depth in (1, 2, 8):
+            got = run(depth)
+            assert got == want, f"depth={depth} changed results/order"
+
+    def test_prefetch_identical_through_shuffle_agg(self, cfg, tmp_path):
+        path = _write_parquet_dir(tmp_path, nfiles=4)
+
+        def run(depth):
+            cfg.scan_prefetch_depth = depth
+            return (dt.read_parquet(os.path.join(path, "*.parquet"))
+                    .groupby("k").agg(col("v").sum().alias("s"))
+                    .sort("k").to_pydict())
+
+        want = run(0)
+        for depth in (1, 2, 8):
+            got = run(depth)
+            assert got["k"] == want["k"]
+            np.testing.assert_allclose(got["s"], want["s"], rtol=1e-12)
+
+    def test_parallel_fanout_identical_buckets(self, cfg, tmp_path):
+        """Map-side fanout on the pool (order-preserving dispatch) must
+        produce byte-identical shuffle output vs the inline path — hash
+        and random schemes, with and without a spill budget."""
+        path = _write_parquet_dir(tmp_path, nfiles=4)
+        cfg.executor_threads = 4
+
+        def run(fanout, budget=None):
+            cfg.parallel_shuffle_fanout = fanout
+            cfg.memory_budget_bytes = budget
+            df = dt.read_parquet(os.path.join(path, "*.parquet"))
+            hashed = _partition_pydicts(df.repartition(3, "k"))
+            rand = _partition_pydicts(df.repartition(5))
+            return hashed, rand
+
+        want = run(False)
+        assert run(True) == want
+        assert run(True, budget=256 * 1024) == want
+
+    def test_prefetch_actually_engages(self, cfg, tmp_path):
+        path = _write_parquet_dir(tmp_path)
+        cfg.scan_prefetch_depth = 2
+        q = dt.read_parquet(os.path.join(path, "*.parquet")).select(
+            col("k"), col("v"))
+        q.to_pydict()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("prefetch_submitted", 0) > 0, c
+        assert c.get("prefetch_hits", 0) + c.get("prefetch_misses", 0) > 0, c
+
+    def test_prefetch_charges_settle(self, cfg, tmp_path):
+        path = _write_parquet_dir(tmp_path)
+        MEMORY_LEDGER.reset()
+        cfg.scan_prefetch_depth = 8
+        got = dt.read_parquet(os.path.join(path, "*.parquet")).to_pydict()
+        assert len(got["k"]) == 6 * 4000
+        assert MEMORY_LEDGER.current == 0
+        assert MEMORY_LEDGER.prefetch_inflight == 0
+
+    def test_prefetch_budget_throttles_not_breaks(self, cfg, tmp_path):
+        """A budget with no readahead headroom throttles prefetch down to
+        the always-allowed single in-flight fetch (the same one-working-
+        partition slack a synchronous read uses) — same results, throttle
+        counter visible, never more than one charge in flight."""
+        path = _write_parquet_dir(tmp_path)
+        cfg.scan_prefetch_depth = 0
+        want = dt.read_parquet(os.path.join(path, "*.parquet")).to_pydict()
+        cfg.scan_prefetch_depth = 4
+        cfg.memory_budget_bytes = 1  # zero headroom beyond the allowed one
+        q = dt.read_parquet(os.path.join(path, "*.parquet"))
+        got = q.to_pydict()
+        assert got == want
+        c = q.stats.snapshot()["counters"]
+        assert c.get("prefetch_throttled", 0) > 0, c
+        assert MEMORY_LEDGER.prefetch_inflight == 0
+
+    def test_prefetch_fetch_fault_propagates_to_caller(self, cfg, tmp_path):
+        """An injected failure in a BACKGROUND fetch re-raises from the
+        partition's read on the execution thread — not lost in the pool."""
+        path = _write_parquet_dir(tmp_path)
+        cfg.scan_prefetch_depth = 2
+        with faults.inject("prefetch.fetch", "always"):
+            with pytest.raises(DaftTransientError):
+                dt.read_parquet(os.path.join(path, "*.parquet")).to_pydict()
+        snap = faults.snapshot()
+        assert not snap["armed"]
+
+    def test_prefetch_fetch_transient_then_heal(self, cfg, tmp_path):
+        """first_n=1: exactly one background fetch dies; the query fails
+        loudly (prefetch fetches are NOT retried — the scan-task retry
+        policy runs inside the read itself, below this site)."""
+        path = _write_parquet_dir(tmp_path)
+        cfg.scan_prefetch_depth = 2
+        with faults.inject("prefetch.fetch", "first_n", n=1):
+            with pytest.raises(DaftTransientError):
+                dt.read_parquet(os.path.join(path, "*.parquet")).to_pydict()
+        # healed: the same query completes
+        got = dt.read_parquet(os.path.join(path, "*.parquet")).to_pydict()
+        assert len(got["k"]) == 6 * 4000
+
+    def test_limit_narrowing_abandons_prefetch(self, cfg, tmp_path):
+        """head() on an emitted scan partition unwraps to the narrowed raw
+        task: results match the no-prefetch run exactly."""
+        path = _write_parquet_dir(tmp_path)
+        cfg.scan_prefetch_depth = 0
+        want = (dt.read_parquet(os.path.join(path, "*.parquet"))
+                .limit(7).to_pydict())
+        cfg.scan_prefetch_depth = 2
+        got = (dt.read_parquet(os.path.join(path, "*.parquet"))
+               .limit(7).to_pydict())
+        assert got == want
+        assert MEMORY_LEDGER.prefetch_inflight == 0
+
+
+class TestAsyncSpill:
+    def _spilling_query(self, n=150_000, parts=8):
+        data = {"k": RNG.randint(0, 2000, n), "v": RNG.rand(n)}
+        return data, dt.from_pydict(data).repartition(parts).sort("k")
+
+    def test_async_spill_parity_and_cleanup(self, cfg):
+        data, q0 = self._spilling_query()
+        cfg.async_spill_writes = False
+        cfg.unspill_readahead = False
+        cfg.memory_budget_bytes = 256 * 1024
+        MEMORY_LEDGER.reset()
+        want = q0.to_pydict()
+        assert q0.stats.snapshot()["counters"].get("spilled_partitions", 0) > 0
+
+        cfg.async_spill_writes = True
+        cfg.unspill_readahead = True
+        MEMORY_LEDGER.reset()
+        q = dt.from_pydict(data).repartition(8).sort("k")
+        got = q.to_pydict()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("spilled_partitions", 0) > 0, c
+        assert got == want
+        # every charge settled: buffers, async in-flight, prefetch
+        assert MEMORY_LEDGER.current == 0
+        assert MEMORY_LEDGER.async_spill_inflight == 0
+
+    def test_async_spill_write_failure_holds_in_memory(self, cfg):
+        """A failing async write degrades to the sync path's hold-in-memory
+        fallback: the query still answers correctly and the failure is
+        counted, never raised."""
+        cfg.async_spill_writes = True
+        cfg.memory_budget_bytes = 128 * 1024
+        MEMORY_LEDGER.reset()
+        data = {"k": RNG.randint(0, 500, 60_000), "v": RNG.rand(60_000)}
+        want = sorted(data["k"].tolist())
+        with faults.inject("spill.write", "always"):
+            q = dt.from_pydict(data).repartition(6).sort("k")
+            got = q.to_pydict()
+            c = q.stats.snapshot()["counters"]
+        assert got["k"] == want
+        assert c.get("spill_write_failures", 0) > 0, c
+        assert c.get("spilled_partitions", 0) == 0, c
+        # held bytes returned once the holding tasks died
+        assert MEMORY_LEDGER.current == 0
+        assert MEMORY_LEDGER.async_spill_inflight == 0
+
+    def test_spill_readback_fault_propagates(self, cfg):
+        """spill.readback armed: the re-materialization error reaches the
+        caller whether the read ran on the consumer thread or the
+        readahead pool."""
+        for readahead in (False, True):
+            cfg.async_spill_writes = True
+            cfg.unspill_readahead = readahead
+            cfg.memory_budget_bytes = 64 * 1024
+            MEMORY_LEDGER.reset()
+            data = {"k": RNG.randint(0, 500, 80_000), "v": RNG.rand(80_000)}
+            with faults.inject("spill.readback", "always"):
+                with pytest.raises(DaftTransientError):
+                    dt.from_pydict(data).repartition(6).sort("k").to_pydict()
+            faults.disarm()
+            # the engine settles its accounting even on the failure path
+            assert MEMORY_LEDGER.current == 0, f"readahead={readahead}"
+
+    def test_unspill_readahead_engages(self, cfg):
+        cfg.async_spill_writes = True
+        cfg.unspill_readahead = True
+        cfg.memory_budget_bytes = 128 * 1024
+        MEMORY_LEDGER.reset()
+        n = 150_000
+        data = {"k": RNG.randint(0, 2000, n), "v": RNG.rand(n)}
+        q = dt.from_pydict(data).repartition(8).sort("k")
+        got = q.to_pydict()
+        assert got["k"] == sorted(data["k"].tolist())
+        c = q.stats.snapshot()["counters"]
+        assert c.get("spilled_partitions", 0) > 0, c
+        assert c.get("unspill_readahead_submitted", 0) > 0, c
+
+    def test_io_breakdown_surface(self, cfg):
+        """The io_wait-vs-compute split renders in explain_analyze and the
+        stats handle exposes the structured breakdown."""
+        cfg.async_spill_writes = True
+        cfg.memory_budget_bytes = 128 * 1024
+        data = {"k": RNG.randint(0, 500, 80_000), "v": RNG.rand(80_000)}
+        q = dt.from_pydict(data).repartition(6).sort("k")
+        q.collect()
+        io = q.stats.io_breakdown()
+        assert set(io) >= {"io_wait_share", "spill_write_mbps",
+                           "spill_read_mbps", "prefetch_hits"}
+        assert 0.0 <= io["io_wait_share"] <= 1.0
+        text = q.explain_analyze()
+        assert "== Runtime Stats ==" in text
+
+
+class TestMemoryLedgerHygiene:
+    def test_double_release_clamps_and_counts(self):
+        MEMORY_LEDGER.reset()
+        MEMORY_LEDGER.add(100)
+        MEMORY_LEDGER.sub(100)
+        MEMORY_LEDGER.sub(100)  # double release: clamp, warn, count
+        assert MEMORY_LEDGER.current == 0
+        assert MEMORY_LEDGER.negative_releases == 1
+        MEMORY_LEDGER.sub(1)
+        assert MEMORY_LEDGER.current == 0
+        assert MEMORY_LEDGER.negative_releases == 2
+        MEMORY_LEDGER.reset()
+        assert MEMORY_LEDGER.negative_releases == 0
+
+    def test_partial_over_release_clamps(self):
+        MEMORY_LEDGER.reset()
+        MEMORY_LEDGER.add(50)
+        MEMORY_LEDGER.sub(80)
+        assert MEMORY_LEDGER.current == 0
+        assert MEMORY_LEDGER.negative_releases == 1
+        MEMORY_LEDGER.reset()
+
+    def test_engine_queries_never_double_release(self, cfg):
+        """Leak check: a spilling query (async spill + readahead on) ends
+        with a balanced ledger and ZERO negative releases."""
+        cfg.async_spill_writes = True
+        cfg.unspill_readahead = True
+        cfg.memory_budget_bytes = 128 * 1024
+        MEMORY_LEDGER.reset()
+        data = {"k": RNG.randint(0, 1000, 100_000), "v": RNG.rand(100_000)}
+        got = dt.from_pydict(data).repartition(8).sort("k").limit(5).to_pydict()
+        assert got["k"] == sorted(data["k"].tolist())[:5]
+        assert MEMORY_LEDGER.current == 0
+        assert MEMORY_LEDGER.negative_releases == 0
