@@ -192,6 +192,90 @@ def run_rung(n: int = 1000, src_size: int = 96, out_size: int = 224,
         shutdown(server)
 
 
+def fusion_pipeline(urls: List[str], src_size: int, out_size: int = 224,
+                    max_connections: int = 32):
+    """The expression-fusion A/B pipeline: a dedupe-style multimodal chain
+    (download -> content-hash sample filter -> decode -> resize -> tensor).
+    Predicate pushdown rewrites the filter to re-fetch `url.download` below
+    the projection that also outputs it, so the UNFUSED engine downloads
+    every kept row twice; the fused plan's cross-segment CSE carries the
+    downloaded bytes from the mask's row set into the projection — the
+    per-op-interpretation tax ISSUE 5 targets, measured end to end."""
+    import daft_tpu as dt
+    from daft_tpu import col
+
+    df = dt.from_pydict({"url": urls})
+    q = (df.select(col("url").url.download(
+            max_connections=max_connections).alias("data"))
+         .where(col("data").hash() % 10 < 8)
+         .select(col("data").image.decode(mode="RGB").alias("img"))
+         .select(col("img").cast(
+             dt.DataType.image("RGB", src_size, src_size)).alias("fimg"))
+         .select(col("fimg").image.resize(out_size, out_size).alias("r"))
+         .select(col("r").cast(dt.DataType.tensor(
+             dt.DataType.uint8(), (out_size, out_size, 3))).alias("t")))
+    return q.collect()
+
+
+def run_fusion_ab(n: int = 1000, src_size: int = 96, out_size: int = 224,
+                  trials: int = 2) -> dict:
+    """Fused-vs-unfused A/B of `fusion_pipeline` (expr_fusion on vs off),
+    interleaved best-of like the spill rung so the host's drifting memory
+    bandwidth cannot bias one side; byte-identical tensors gate the timing.
+    Emits laion_fused_speedup_x (+ walls and the fused run's chain
+    counters)."""
+    import time
+
+    from daft_tpu.context import get_context
+
+    images = make_jpegs(n, size=src_size)
+    server, urls = serve(images)
+    cfg = get_context().execution_config
+    saved = (cfg.expr_fusion, cfg.enable_result_cache)
+    cfg.enable_result_cache = False
+    try:
+        best: dict = {}
+        frames: dict = {}
+        # warm both sides (jit compiles, connection pools) before timing
+        for flag in (True, False):
+            cfg.expr_fusion = flag
+            fusion_pipeline(urls[:32], src_size, out_size)
+        # alternate the within-pair order each trial so long-process drift
+        # (allocator growth, page-cache pressure) cannot bias one side
+        order = [("on", "off") if i % 2 == 0 else ("off", "on")
+                 for i in range(max(trials, 1))]
+        for pair in order:
+            for mode in pair:
+                cfg.expr_fusion = mode == "on"
+                t0 = time.perf_counter()
+                frame = fusion_pipeline(urls, src_size, out_size)
+                wall = time.perf_counter() - t0
+                if mode not in best or wall < best[mode]:
+                    best[mode] = wall
+                    frames[mode] = frame
+        got_on = frame_tensors(frames["on"], out_size)
+        got_off = frame_tensors(frames["off"], out_size)
+        import numpy as _np
+
+        if got_on.shape != got_off.shape or not _np.array_equal(got_on,
+                                                                got_off):
+            return {"laion_fused_speedup_x": 0.0,
+                    "laion_fusion_error": "parity_mismatch"}
+        counters = frames["on"].stats.snapshot()["counters"]
+        return {
+            "laion_fused_speedup_x": round(best["off"] / best["on"], 3),
+            "laion_fused_wall_s": round(best["on"], 3),
+            "laion_unfused_wall_s": round(best["off"], 3),
+            "laion_fused_chains": counters.get("fused_chains", 0),
+            "laion_fused_ops_eliminated": counters.get(
+                "fused_ops_eliminated", 0),
+            "laion_fusion_rows": n,
+        }
+    finally:
+        cfg.expr_fusion, cfg.enable_result_cache = saved
+        shutdown(server)
+
+
 def shutdown(server) -> None:
     """Stop serving AND release the listening socket + pinned image bytes
     (shutdown() alone leaks the fd and the served list for the rest of a

@@ -84,6 +84,13 @@ class ExecutionConfig:
     # (reference: RayRunner's cores + max_task_backlog dynamic bound,
     # ray_runner.py:504-685); -1 = auto (one backlog slot per worker)
     max_task_backlog: int = -1
+    # expression-pipeline fusion (daft_tpu/fuse/): maximal Project/Filter
+    # chains collapse into single-pass FusedMapOp programs (one composed
+    # host projection per partition; one jit program on the device path)
+    # with hash-consing CSE and dead-column elimination. Results are
+    # byte-identical with fusion on or off; False restores the per-op
+    # interpreted chain (the bench.py laion fusion A/B axis).
+    expr_fusion: bool = True
     # two-phase approximate aggregations (daft_tpu/sketch/): multi-partition
     # approx_count_distinct / approx_percentiles plan as sketch->merge stages
     # whose exchange ships serialized sketch bytes, O(sketch_size x

@@ -143,6 +143,12 @@ class DataFrame:
                    if io["prefetch_throttled"] else "")
                 + f" · spill write {io['spill_write_mbps']:.1f} MB/s"
                 f" · read {io['spill_read_mbps']:.1f} MB/s")
+        if counters.get("fused_chains"):
+            lines.append("")
+            lines.append(
+                f"fusion: {counters['fused_chains']} FusedMap chain(s), "
+                f"{counters.get('fused_ops_eliminated', 0)} op(s) eliminated"
+                f", {counters.get('cse_hits', 0)} cse hit(s)")
         if counters:
             lines.append("")
             lines.append("counters: " + ", ".join(f"{k}={v}" for k, v in sorted(counters.items())))

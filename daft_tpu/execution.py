@@ -584,6 +584,93 @@ class ExecutionContext:
 
         return finish
 
+    def _defer_fused(self, part: MicroPartition, program):
+        """Foreign-owned unloaded partition: the whole fused program joins
+        the pending op chain (one deferred single-pass map), preserving
+        per-host scan locality exactly like the unfused chain's deferred
+        Project/Filter ops would."""
+        return part.with_pending_op(
+            lambda t: program.run_host(t), program.out_schema,
+            count_preserving=program.count_preserving)
+
+    def _eval_fused_host(self, part: MicroPartition, program) -> MicroPartition:
+        """Host single-pass evaluation of a fused chain. The legacy per-op
+        class counters advance by the chain's op counts so per-path
+        attribution stays comparable with the unfused engine."""
+        self.stats.bump("host_fused_maps")
+        g = program.graph
+        if g.n_project_ops:
+            self.stats.bump("host_projections", g.n_project_ops)
+        if g.n_filter_ops:
+            self.stats.bump("host_filters", g.n_filter_ops)
+        return part._wrap(program.run_host(part.table()))
+
+    def _bump_fused_device(self, program, n: int = 1) -> None:
+        g = program.graph
+        self.stats.bump("device_fused_maps", n)
+        if g.n_project_ops:
+            self.stats.bump("device_projections", n * g.n_project_ops)
+        if g.n_filter_ops:
+            self.stats.bump("device_filters", n * g.n_filter_ops)
+
+    def eval_fused(self, part: MicroPartition, program) -> MicroPartition:
+        """Route a fused map chain through the device kernel layer as ONE
+        jit program when eligible, else the segmented host pass."""
+        if self.foreign_owned(part) and not part.is_loaded():
+            return self._defer_fused(part, program)
+        if program.device_exprs is not None and self._device_eligible(part):
+            def _run():
+                from .kernels.device import eval_projection_device
+
+                out = eval_projection_device(
+                    part.table(), program.device_exprs,
+                    stage_cache=part.device_stage_cache())
+                return None if out is None else program.assemble_device(out)
+
+            out = self._device_attempt(_run)
+            if out is not None:
+                self._bump_fused_device(program)
+                return part._wrap(out)
+        return self._eval_fused_host(part, program)
+
+    def eval_fused_dispatch(self, part: MicroPartition, program):
+        """Non-blocking launch of the fused device program; same resolver
+        contract as eval_projection_dispatch (host fallback inside,
+        truthful counters)."""
+        if self.foreign_owned(part) and not part.is_loaded():
+            deferred = self._defer_fused(part, program)
+            return lambda: deferred
+        if program.device_exprs is None or not self._device_eligible(part):
+            return None
+
+        def _launch():
+            from .kernels.device import eval_projection_device_async
+
+            return eval_projection_device_async(
+                part.table(), program.device_exprs,
+                stage_cache=part.device_stage_cache())
+
+        resolve = self._device_attempt(_launch, launch=True)
+        if resolve is None:
+            return None
+        self._bump_fused_device(program)
+        self.stats.bump("device_fused_map_dispatches")
+
+        def finish() -> MicroPartition:
+            try:
+                out = program.assemble_device(resolve())
+            except Exception:
+                # the chain was NOT computed on device after all: keep the
+                # counters truthful, inform the breaker, host pass takes over
+                self.device_health.record_failure(self.stats)
+                self._bump_fused_device(program, -1)
+                self.stats.bump("device_fused_map_fallbacks")
+                return self._eval_fused_host(part, program)
+            self.device_health.record_success(self.stats)
+            return part._wrap(out)
+
+        return finish
+
     def eval_sort(self, part: MicroPartition, sort_by, descending=None,
                   nulls_first=None) -> MicroPartition:
         """Route a per-partition sort through the device argsort when

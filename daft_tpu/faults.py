@@ -53,6 +53,9 @@ SITES = {
                     "quantile-sample concat, daft_tpu/sketch/)",
     "collective.sketch": "each mesh register-array sketch-merge collective "
                          "(all_gather+max, parallel/mesh_exec.py)",
+    "fuse.compile": "each map-chain fusion compile (daft_tpu/fuse/; a "
+                    "compile-time failure falls back to the unfused op "
+                    "chain, never a query failure)",
 }
 
 

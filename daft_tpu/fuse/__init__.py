@@ -1,0 +1,23 @@
+"""Expression-pipeline fusion: collapse Project/Filter chains into
+single-pass FusedMap programs (README "Expression fusion").
+
+- `graph.py`  — column-level dataflow DAG: inlining through upstream
+  projections, hash-consing CSE, dead-column elimination, UDF pinning,
+  cross-segment carries, mask conjoining.
+- `compile.py` — FusedProgram (host segmented pass / one-jit device
+  program), the FusedMapOp physical operator, and the `fuse_map_chains`
+  planner pass wired into `physical.translate` behind ``cfg.expr_fusion``.
+"""
+
+from .compile import FusedMapOp, FusedProgram, compile_chain, fuse_map_chains
+from .graph import FusedGraph, FuseDecline, build_fused_graph
+
+__all__ = [
+    "FusedGraph",
+    "FusedMapOp",
+    "FusedProgram",
+    "FuseDecline",
+    "build_fused_graph",
+    "compile_chain",
+    "fuse_map_chains",
+]

@@ -45,6 +45,23 @@ from .schema import Schema
 PartStream = Iterator[MicroPartition]
 
 
+def summarize_exprs(exprs, limit: int = 120) -> str:
+    """Compact expression-list rendering for plan dumps: full displays up to
+    `limit` chars, then a count of what was elided — a 40-column projection
+    must not dump hundreds of chars into every explain line."""
+    parts = []
+    used = 0
+    for i, e in enumerate(exprs):
+        d = e._node.display()
+        if parts and used + len(d) + 2 > limit:
+            return ", ".join(parts) + f", ... (+{len(exprs) - i} more)"
+        if not parts and len(d) > limit:
+            d = d[:limit] + "…"
+        parts.append(d)
+        used += len(d) + 2
+    return ", ".join(parts)
+
+
 class PhysicalOp:
     """Base: children + a generator-producing execute().
 
@@ -244,7 +261,7 @@ class ProjectOp(PhysicalOp):
         return self._map_execute(inputs, ctx)
 
     def describe(self):
-        return "Project: " + ", ".join(e._node.display() for e in self.exprs)
+        return "Project: " + summarize_exprs(self.exprs)
 
 
 class FilterOp(PhysicalOp):
@@ -1263,9 +1280,17 @@ def _is_pure_column_selection(exprs) -> bool:
 
 
 def translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:
-    """Public entry: recursive translation + device-path fusion, so every
-    caller (runners, explain, adaptive) sees the tree that actually runs."""
-    return fuse_for_device(_translate(plan, cfg, morsels), cfg)
+    """Public entry: recursive translation + device-path fusion + map-chain
+    fusion, so every caller (runners, explain, adaptive) sees the tree that
+    actually runs. fuse_for_device runs FIRST so a filter feeding an
+    aggregation folds into FusedFilterAggregateOp; fuse_map_chains then
+    collapses the residual Project/Filter chains (the passes compose)."""
+    out = fuse_for_device(_translate(plan, cfg, morsels), cfg)
+    if getattr(cfg, "expr_fusion", True):
+        from .fuse import fuse_map_chains
+
+        out = fuse_map_chains(out, cfg)
+    return out
 
 
 def _translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:

@@ -1,0 +1,496 @@
+"""Expression-pipeline fusion (daft_tpu/fuse/): byte-identity with fusion
+on/off, chain collapse stats, UDF pinning/non-duplication, composition with
+the device-path aggregate fold, the fuse.compile fault site, and plan-dump
+rendering. Reference role: the fused pipeline_instruction execution of the
+native executor (SURVEY.md §"replace per-op interpretation with XLA
+fusion")."""
+
+import contextlib
+import datetime
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import DataType, col, lit
+from daft_tpu.context import get_context
+from daft_tpu.fuse import FusedMapOp, FuseDecline, compile_chain
+from daft_tpu.optimizer import optimize
+from daft_tpu.physical import (
+    FilterOp,
+    FusedFilterAggregateOp,
+    ProjectOp,
+    translate,
+)
+
+
+@contextlib.contextmanager
+def _cfg(**kwargs):
+    cfg = get_context().execution_config
+    saved = {k: getattr(cfg, k) for k in kwargs}
+    saved.setdefault("enable_result_cache", cfg.enable_result_cache)
+    cfg.enable_result_cache = False  # fusion A/Bs must re-execute
+    for k, v in kwargs.items():
+        setattr(cfg, k, v)
+    try:
+        yield cfg
+    finally:
+        for k, v in saved.items():
+            setattr(cfg, k, v)
+
+
+def _find_ops(op, klass):
+    out = [op] if isinstance(op, klass) else []
+    for c in op.children:
+        out.extend(_find_ops(c, klass))
+    return out
+
+
+def _phys(df):
+    return translate(optimize(df._plan), get_context().execution_config)
+
+
+def _ab(build):
+    """Run `build()` with fusion on and off; returns (fused, unfused)."""
+    with _cfg(expr_fusion=True):
+        fused = build().to_pydict()
+    with _cfg(expr_fusion=False):
+        unfused = build().to_pydict()
+    return fused, unfused
+
+
+# multi-use defs at every stage so the logical projection folder (which
+# refuses to duplicate non-trivial exprs) keeps the chain for the physical
+# fusion pass — the shape the fuse subsystem exists for
+def _select_chain(df, n_stages=3):
+    q = df.select((col("a") + col("b")).alias("x"), col("b"))
+    q = q.select((col("x") * 2).alias("y"), (col("x") + 1).alias("z"),
+                 col("b"))
+    if n_stages >= 3:
+        q = q.select((col("y") + col("z")).alias("u"),
+                     (col("y") * col("z")).alias("v"))
+    return q
+
+
+def _df():
+    return dt.from_pydict({"a": [1.0, 2.0, None, 4.0] * 25,
+                           "b": list(range(100))})
+
+
+class TestChainCollapse:
+    def test_pure_select_chain_is_one_fused_map(self):
+        with _cfg(expr_fusion=True):
+            q = _select_chain(_df())
+            phys = _phys(q)
+            fused = _find_ops(phys, FusedMapOp)
+            assert len(fused) == 1, phys.display_tree()
+            assert not _find_ops(phys, ProjectOp)
+            assert not _find_ops(phys, FilterOp)
+            c = q.collect()
+            counters = c.stats.snapshot()["counters"]
+            assert counters.get("fused_chains") == 1
+            n_ops = fused[0].program.graph.n_ops
+            assert n_ops >= 2
+            assert counters.get("fused_ops_eliminated") == n_ops - 1
+            # x feeds y and z; y,z each feed two outputs: consing must hit
+            assert counters.get("cse_hits", 0) >= 1
+
+    def test_knob_off_keeps_unfused_chain(self):
+        with _cfg(expr_fusion=False):
+            phys = _phys(_select_chain(_df()))
+            assert not _find_ops(phys, FusedMapOp)
+            assert len(_find_ops(phys, ProjectOp)) >= 2
+
+    def test_single_op_never_wrapped(self):
+        with _cfg(expr_fusion=True):
+            phys = _phys(dt.from_pydict({"a": [1, 2]}).select(
+                (col("a") + 1).alias("b")))
+            assert not _find_ops(phys, FusedMapOp)
+
+    def test_fused_results_byte_identical(self):
+        fused, unfused = _ab(lambda: _select_chain(_df()))
+        assert fused == unfused
+
+    def test_filter_between_projects_row_semantics(self):
+        def build():
+            return (_df()
+                    .select((col("a") + col("b")).alias("x"), col("b"))
+                    .where((col("x") > 10) & col("x").not_null())
+                    .select((col("x") * col("b")).alias("w"), col("x")))
+
+        fused, unfused = _ab(build)
+        assert fused == unfused
+
+    def test_consecutive_filters_and_projects(self):
+        def build():
+            return (_df()
+                    .select((col("b") * 3).alias("x"), col("a"))
+                    .where(col("x") > 30)
+                    .select((col("x") + 1).alias("y"), (col("x") - 1).alias("z"))
+                    .where((col("y") % 2) == 0)
+                    .select((col("y") + col("z")).alias("s")))
+
+        fused, unfused = _ab(build)
+        assert fused == unfused
+
+    def test_non_total_expr_waits_for_its_mask(self):
+        """Integer floordiv raises on 0 divisors; the fused pass must apply
+        the guarding mask BEFORE evaluating it (never hoist a can-raise
+        expression over the filter that protects it)."""
+        df = dt.from_pydict({"n": [10, 20, 30, 40] * 10,
+                             "d": [0, 1, 2, 4] * 10})
+
+        def build():
+            return (df.select(col("n"), col("d"),
+                              (col("d") + 0).alias("dd"))
+                    .where(col("dd") != 0)
+                    .select((col("n") // col("dd")).alias("q"),
+                            (col("n") % col("dd")).alias("r")))
+
+        fused, unfused = _ab(build)
+        assert fused == unfused
+        # every surviving row had a nonzero divisor: the mask really gated
+        assert len(fused["q"]) == 30 and all(v is not None for v in fused["q"])
+
+    def test_empty_partitions(self):
+        df = dt.from_pydict({"a": [], "b": []})
+
+        def build():
+            return (df.select((col("a").cast(DataType.float64())
+                               + col("b").cast(DataType.int64())).alias("x"),
+                              col("b"))
+                    .where(col("x") > 0)
+                    .select((col("x") * 2).alias("y")))
+
+        fused, unfused = _ab(build)
+        assert fused == unfused == {"y": []}
+
+    def test_multi_partition_chain(self):
+        def build():
+            return _select_chain(
+                dt.from_pydict({"a": [1.0, None] * 200,
+                                "b": list(range(400))}).into_partitions(7))
+
+        fused, unfused = _ab(build)
+        assert fused == unfused
+
+
+SAMPLES = {
+    DataType.bool(): [True, False, None, True],
+    DataType.int8(): [1, -2, None, 7],
+    DataType.int32(): [1000, -7, None, 12],
+    DataType.int64(): [10_000, -11, None, 3],
+    DataType.uint16(): [1, 300, None, 9],
+    DataType.float32(): [1.5, -0.25, None, 3.5],
+    DataType.float64(): [2.5, -0.125, None, 0.5],
+    DataType.string(): ["a", "bb", None, "ccc"],
+    DataType.date(): [datetime.date(2024, 1, 1),
+                      datetime.date(2020, 6, 5), None,
+                      datetime.date(1999, 12, 31)],
+}
+
+_NULL_PATTERNS = {
+    "mixed": lambda vals: vals,
+    "dense": lambda vals: [v for v in vals if v is not None] + [vals[0]],
+    "all_null": lambda vals: [None] * len(vals),
+}
+
+
+class TestTypingMatrixIdentity:
+    """Property-style sweep: expression chains x dtypes x null patterns must
+    be byte-identical (values AND dtypes) with fusion on or off."""
+
+    @pytest.mark.parametrize("null_pattern", sorted(_NULL_PATTERNS))
+    def test_matrix(self, null_pattern):
+        pat = _NULL_PATTERNS[null_pattern]
+        mism = []
+        for dtype, vals in SAMPLES.items():
+            data = {"c": dt.Series.from_pylist(pat(vals) * 6, "c", dtype),
+                    "k": dt.Series.from_pylist(
+                        list(range(len(vals) * 6)), "k", DataType.int64())}
+
+            def build():
+                df = dt.from_pydict(data)
+                # passthrough + null-test + multi-use keeps the chain alive
+                q = (df.select(col("c"), col("c").is_null().alias("isn"),
+                               col("k"))
+                     .select(col("c").alias("c2"), col("c"), col("isn"),
+                             (col("k") % 3).alias("k3"), col("k"))
+                     .where(~col("isn") | (col("k3") == 0))
+                     .select(col("c2"), col("c"), col("k"),
+                             col("c").is_null().alias("n2")))
+                return q
+
+            def run():
+                c = build().collect()
+                tbl = c.to_table()
+                return (tbl.to_pydict(),
+                        [(f.name, str(f.dtype)) for f in tbl.schema])
+
+            with _cfg(expr_fusion=True):
+                fused = run()
+            with _cfg(expr_fusion=False):
+                unfused = run()
+            if fused != unfused:
+                mism.append(str(dtype))
+        assert not mism, f"fusion drift for dtypes: {mism}"
+
+    def test_numeric_arith_chains(self):
+        numeric = [d for d in SAMPLES if d.is_numeric()]
+        mism = []
+        for dtype in numeric:
+            vals = SAMPLES[dtype]
+            data = {"c": dt.Series.from_pylist(vals * 6, "c", dtype)}
+
+            def build():
+                df = dt.from_pydict(data)
+                return (df.select((col("c") + col("c")).alias("x"), col("c"))
+                        .select((col("x") * 2).alias("y"),
+                                (col("x") - col("c")).alias("z"))
+                        .where(col("y").not_null())
+                        .select((col("y") / 2).alias("h"), col("z")))
+
+            fused, unfused = _ab(build)
+            if fused != unfused:
+                mism.append(str(dtype))
+        assert not mism, f"fusion drift for dtypes: {mism}"
+
+
+class TestUdfBarriers:
+    def test_udf_evaluated_once_under_cse(self):
+        calls = []
+
+        @dt.udf(return_dtype=DataType.int64())
+        def track(s):
+            vals = s.to_pylist()
+            calls.append(len(vals))
+            return [v * 10 for v in vals]
+
+        df = dt.from_pydict({"v": list(range(16))})
+
+        def build():
+            return (df.select(track(col("v")).alias("e"), col("v"))
+                    .select((col("e") + 1).alias("a"),
+                            (col("e") * 2).alias("b"), col("v")))
+
+        with _cfg(expr_fusion=True):
+            q = build()
+            assert len(_find_ops(_phys(q), FusedMapOp)) == 1
+            fused = q.to_pydict()
+            assert calls == [16], "udf must run exactly once per partition"
+        calls.clear()
+        with _cfg(expr_fusion=False):
+            assert build().to_pydict() == fused
+            assert calls == [16]
+
+    def test_udf_not_reordered_across_filter(self):
+        """A UDF defined before a filter that consumes its output keeps its
+        original row set (all rows), not the post-filter subset."""
+        calls = []
+
+        @dt.udf(return_dtype=DataType.int64())
+        def track(s):
+            vals = s.to_pylist()
+            calls.append(len(vals))
+            return [v * 10 for v in vals]
+
+        df = dt.from_pydict({"v": list(range(16))})
+
+        def build():
+            return (df.select(track(col("v")).alias("e"), col("v"))
+                    .where(col("e") > 50)
+                    .select((col("e") + col("v")).alias("s")))
+
+        with _cfg(expr_fusion=True):
+            fused = build().to_pydict()
+            fused_calls = list(calls)
+        calls.clear()
+        with _cfg(expr_fusion=False):
+            unfused = build().to_pydict()
+        assert fused == unfused
+        assert fused_calls == calls == [16]
+
+    def test_distinct_udf_call_sites_not_merged(self):
+        calls = []
+
+        @dt.udf(return_dtype=DataType.int64())
+        def track(s):
+            vals = s.to_pylist()
+            calls.append(len(vals))
+            return [v + 1 for v in vals]
+
+        df = dt.from_pydict({"v": list(range(8))})
+
+        def build():
+            # two structurally identical but DISTINCT call sites: their
+            # side-effect count is observable and must not be CSE'd
+            return (df.select(track(col("v")).alias("e1"), col("v"))
+                    .select(col("e1"), track(col("v")).alias("e2")))
+
+        with _cfg(expr_fusion=True):
+            fused = build().to_pydict()
+            assert calls == [8, 8]
+        calls.clear()
+        with _cfg(expr_fusion=False):
+            assert build().to_pydict() == fused
+            assert calls == [8, 8]
+
+    def test_udf_with_resource_request_declines_fusion(self):
+        @dt.udf(return_dtype=DataType.int64(), num_cpus=1)
+        def f(s):
+            return [v for v in s.to_pylist()]
+
+        df = dt.from_pydict({"v": [1, 2, 3]})
+        with _cfg(expr_fusion=True):
+            q = (df.select(f(col("v")).alias("e"), col("v"))
+                 .select((col("e") + col("v")).alias("s")))
+            phys = _phys(q)
+            assert not _find_ops(phys, FusedMapOp), phys.display_tree()
+            assert q.to_pydict() == {"s": [2, 4, 6]}
+
+
+class TestComposeWithDeviceFold:
+    def test_chain_feeding_filter_agg_still_folds(self):
+        """fuse_for_device runs first: the filter feeding the aggregation
+        folds into FusedFilterAggregateOp; the residual project chain below
+        it fuses into one FusedMapOp — the passes compose. (A UDF-rooted
+        predicate keeps the filter directly under the aggregate: pushdown
+        cannot substitute through a UDF projection.)"""
+
+        @dt.udf(return_dtype=DataType.int64())
+        def ten_x(s):
+            return [v * 10 for v in s.to_pylist()]
+
+        df = dt.from_pydict({"k": ["a", "b"] * 50, "v": list(range(100))})
+
+        def build():
+            return (df.select(ten_x(col("v")).alias("x"), col("k"))
+                    .select(ten_x(col("x")).alias("w"), col("x"), col("k"))
+                    .where(col("w") > 50)
+                    .groupby("k").agg(col("x").sum().alias("s"))
+                    .sort("k"))
+
+        with _cfg(expr_fusion=True):
+            phys = _phys(build())
+            assert _find_ops(phys, FusedFilterAggregateOp), phys.display_tree()
+            assert _find_ops(phys, FusedMapOp), phys.display_tree()
+        fused, unfused = _ab(build)
+        assert fused == unfused
+
+    def test_filter_folded_into_fused_map_feeding_agg(self):
+        """When pushdown buries the filter inside the map chain (no direct
+        Aggregate(Filter(...)) shape exists with fusion off either), the
+        fused chain absorbs it as a mask and the aggregation runs over the
+        single-pass output — byte-identical both ways."""
+        df = dt.from_pydict({"k": ["a", "b"] * 50, "v": list(range(100))})
+
+        def build():
+            return (df.select((col("v") * 2).alias("x"), col("k"), col("v"))
+                    .select((col("x") + col("v")).alias("y"),
+                            (col("x") - col("v")).alias("z"), col("k"))
+                    .where(col("y") > 10)
+                    .groupby("k").agg(col("z").sum().alias("s"))
+                    .sort("k"))
+
+        fused, unfused = _ab(build)
+        assert fused == unfused
+
+    def test_aggregation_in_projection_declines(self):
+        df = dt.from_pydict({"v": [1.0, 2.0, 3.0, 4.0]})
+        with _cfg(expr_fusion=True):
+            q = (df.select(col("v").sum().alias("s"), col("v"))
+                 .select((col("s") + col("v")).alias("t")))
+            phys = _phys(q)
+            assert not _find_ops(phys, FusedMapOp)
+            with _cfg(expr_fusion=False):
+                want = q.to_pydict()
+            assert q.to_pydict() == want
+
+
+class TestDevicePath:
+    def test_fused_chain_runs_as_one_device_program(self):
+        import numpy as np
+
+        data = {"x": (np.arange(20_000, dtype=np.int64) % 997),
+                "y": (np.arange(20_000) % 13).astype(np.float64)}
+
+        def build():
+            df = dt.from_pydict(data)
+            return (df.select((col("x") * 2).alias("a"), col("y"))
+                    .where(col("a") > 100)
+                    .select((col("a") + col("y")).alias("z")))
+
+        with _cfg(expr_fusion=True, use_device_kernels=True,
+                  device_min_rows=1):
+            c = build().collect()
+            counters = c.stats.snapshot()["counters"]
+            assert counters.get("device_fused_maps", 0) >= 1, counters
+            # legacy per-path attribution still advances for the fused ops
+            assert counters.get("device_filters", 0) >= 1
+            assert counters.get("device_projections", 0) >= 1
+            dev = c.to_pydict()
+        with _cfg(expr_fusion=True, use_device_kernels=False):
+            host = build().to_pydict()
+        with _cfg(expr_fusion=False, use_device_kernels=False):
+            unfused = build().to_pydict()
+        assert dev == host == unfused
+
+
+class TestFaultSite:
+    def test_compile_fault_falls_back_to_unfused_chain(self):
+        from daft_tpu import faults
+
+        df = _df()
+        with _cfg(expr_fusion=True):
+            with faults.inject("fuse.compile"):
+                q = _select_chain(df)
+                phys = _phys(q)
+                # the armed compile fault must degrade to the unfused plan
+                assert not _find_ops(phys, FusedMapOp), phys.display_tree()
+                assert len(_find_ops(phys, ProjectOp)) >= 2
+                got = q.to_pydict()  # and the query must still succeed
+                assert faults.snapshot()["injected"].get("fuse.compile", 0) >= 1
+            want = _select_chain(df).to_pydict()
+        assert got == want
+
+    def test_compile_decline_is_typed(self):
+        from daft_tpu.schema import Field, Schema
+
+        schema = Schema([Field("a", DataType.int64())])
+        with pytest.raises(FuseDecline):
+            compile_chain(
+                [("project", [col("missing").alias("x")]),
+                 ("filter", col("x") > 0)],
+                schema, Schema([Field("x", DataType.int64())]))
+
+
+class TestRendering:
+    def test_describe_shows_fused_map_shape(self):
+        with _cfg(expr_fusion=True):
+            phys = _phys(_select_chain(_df()))
+            (fused,) = _find_ops(phys, FusedMapOp)
+            d = fused.describe()
+            assert d.startswith("FusedMap[")
+            assert "ops" in d and "exprs" in d and "cse" in d
+
+    def test_project_describe_truncates_giant_lists(self):
+        n = 60
+        df = dt.from_pydict({f"c{i}": [1, 2] for i in range(n)})
+        with _cfg(expr_fusion=False):
+            phys = _phys(df.select(*[(col(f"c{i}") * 2).alias(f"o{i}")
+                                     for i in range(n)]))
+            (proj,) = _find_ops(phys, ProjectOp)
+            d = proj.describe()
+            assert len(d) < 400, len(d)
+            assert "more)" in d
+
+    def test_explain_analyze_renders_fusion_line(self):
+        with _cfg(expr_fusion=True):
+            q = _select_chain(_df()).collect()
+            text = q.explain_analyze()
+        assert "FusedMap chain(s)" in text
+        assert "fused_chains" in text  # the raw counter is in the dump too
+
+    def test_explain_physical_plan_shows_fused_map(self):
+        with _cfg(expr_fusion=True):
+            text = _select_chain(_df()).explain(show_all=True)
+        assert "FusedMap[" in text

@@ -41,3 +41,16 @@ def test_nonuniform_source_sizes_rejected_cleanly():
             laion.run_pipeline(urls, src_size=64, out_size=32)
     finally:
         laion.shutdown(server)
+
+
+def test_fusion_ab_end_to_end():
+    """The expression-fusion A/B rung (ISSUE 5): runs both modes through the
+    mock server, tensors byte-identical, chain visibly fused, extras
+    well-formed. Small-n smoke — the >=1.2x bar is a bench-host criterion,
+    not a unit assertion."""
+    out = laion.run_fusion_ab(n=24, src_size=48, out_size=64, trials=1)
+    assert "laion_fusion_error" not in out, out
+    assert out["laion_fused_speedup_x"] > 0
+    assert out["laion_fused_chains"] >= 1
+    assert out["laion_fused_ops_eliminated"] >= 1
+    assert out["laion_fusion_rows"] == 24

@@ -116,5 +116,10 @@ class TestUdfSafety:
         q = df.where(col("v") > 0).select((col("v") * 2).alias("w"))
         q.collect()
         snap = q.stats.snapshot()
-        assert snap["op_rows"].get("ProjectOp", 0) > 0
-        assert snap["op_wall_ns"].get("FilterOp", 0) > 0
+        # the Filter+Project chain fuses into one FusedMapOp (expr_fusion);
+        # its worker-side rows + wall time must still be recorded
+        assert snap["op_rows"].get("FusedMapOp", 0) > 0
+        assert snap["op_wall_ns"].get("FusedMapOp", 0) > 0
+        counters = snap["counters"]
+        assert counters.get("fused_chains", 0) >= 1
+        assert counters.get("fused_ops_eliminated", 0) >= 1

@@ -1,6 +1,7 @@
 """DTL001 jit-purity: functions traced by jax.jit must stay pure.
 
-Scope: files under daft_tpu/kernels/ and daft_tpu/parallel/. A traced
+Scope: files under daft_tpu/kernels/, daft_tpu/parallel/, and
+daft_tpu/fuse/ (the fusion compiler emits jit-traced programs). A traced
 function is one decorated with `@jax.jit` / `@jit` /
 `@functools.partial(jax.jit, ...)`, or passed (by name, lambda, or through
 `jax.shard_map`/`jax.pmap`/`jax.vmap`) to a `jax.jit(...)` call.
@@ -70,7 +71,8 @@ class JitPurityRule(Rule):
         out: List[Finding] = []
         for rel in project.files:
             segs = rel.split("/")[:-1]
-            if "kernels" not in segs and "parallel" not in segs:
+            if ("kernels" not in segs and "parallel" not in segs
+                    and "fuse" not in segs):
                 continue
             tree = project.tree(rel)
             if tree is None:
