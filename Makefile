@@ -1,14 +1,20 @@
 # One-command local check: the same static gates tier-1 runs.
-#   make lint   - daftlint invariants (DTL001-DTL005) + bytecode-compile daft_tpu
-#   make test   - full tier-1 test suite (CPU jax)
+#   make lint          - daftlint invariants (DTL001-DTL006) + bytecode-compile
+#                        daft_tpu + profile smoke (QueryProfile schema gate)
+#   make profile-smoke - tiny profiled query; validates the QueryProfile JSON,
+#                        chrome trace, and metrics dump end to end
+#   make test          - full tier-1 test suite (CPU jax)
 
 PY ?= python
 
-.PHONY: lint test
+.PHONY: lint test profile-smoke
 
-lint:
+lint: profile-smoke
 	$(PY) -m tools.daftlint
 	$(PY) -m compileall -q daft_tpu
+
+profile-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.profile_smoke
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

@@ -219,6 +219,34 @@ class _Setup:
                 dt.from_arrow(tables["nation"]).collect())
 
 
+def _save_rung_profile(out: dict, rung: str, build_query) -> None:
+    """Run one profiled execution of a rung's query and save the
+    QueryProfile JSON next to the BENCH snapshot, recording
+    `<rung>_critical_path_op` + the top-3 ops by self-time in the rung's
+    metrics — perf regressions become diagnosable from artifacts alone.
+    Best-effort: a profiling failure never costs the rung its numbers."""
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            f"PROFILE_{rung}.json")
+        q = build_query()
+        q.collect(profile=path)
+        qp = q.profile()
+        from daft_tpu.profile import validate_profile
+
+        errs = validate_profile(qp.to_dict())
+        if errs:
+            out[f"{rung}_profile_error"] = f"schema: {errs[0]}"[:120]
+            return
+        out[f"{rung}_critical_path_op"] = qp.critical_path_op
+        out[f"{rung}_top_ops"] = [
+            {"op": o["op"], "self_ms": round(o["self_ns"] / 1e6, 2),
+             "io_ms": round(o["io_wait_ns"] / 1e6, 2)}
+            for o in qp.top_ops(3)]
+        out[f"{rung}_profile_file"] = os.path.basename(path)
+    except Exception as e:
+        out[f"{rung}_profile_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
 def measure_sketch_exchange(n_rows: int = 50_000, n_parts: int = 8) -> dict:
     """Before/after rows-exchanged comparison for the sketch subsystem: the
     SAME grouped approx_count_distinct with sketch_aggregations off (raw
@@ -327,6 +355,9 @@ def run_device_rungs(scale: float) -> dict:
         "q1_fused_ops_eliminated": dev_counters.get("fused_ops_eliminated", 0),
         "rows": rows,
     }
+    # profiled device q1: critical path + top ops land in the rung metrics,
+    # the full QueryProfile JSON next to the BENCH snapshot
+    _save_rung_profile(out, "q1_device", lambda: tpch.q1(frame))
 
     # ---- deep-fused pallas kernel A/B (r4 verdict weak #5): Q1 with the
     # predicate + derived money columns evaluated INSIDE the pallas kernel
@@ -662,6 +693,15 @@ def _parquet_spill_rung(out: dict, scale: float, rtol: float) -> None:
             c = stats["pipelined"].snapshot()["counters"]
             out[f"{tag}_spilled_partitions"] = c.get("spilled_partitions", 0)
             out[f"{tag}_data_mb"] = round(data_bytes / 2**20, 1)
+            # profiled re-run of the PIPELINED config: background spill /
+            # prefetch attribution for this rung rides the artifact
+            for k, v in modes["pipelined"].items():
+                setattr(cfg, k, v)
+            _save_rung_profile(
+                out, tag,
+                lambda: tpch.q1(
+                    dt.read_parquet(os.path.join(tmp, "*.parquet"))
+                    .repartition(8, "l_returnflag", "l_linestatus")))
         finally:
             for k, v in saved.items():
                 setattr(cfg, k, v)
@@ -743,6 +783,9 @@ def _host_fallback(scale: float) -> dict:
     out["host_rows_per_sec"] = round(rows / t_host_q1, 1)
     out["host_vs_baseline"] = round(t_oracle_q1 / t_host_q1, 3)
     out["q6_host_vs_baseline"] = round(t_oracle_q6 / t_host_q6, 3)
+    # one profiled run per rung: the QueryProfile artifact lands next to
+    # the BENCH snapshot and the headline metrics carry the critical path
+    _save_rung_profile(out, "q1_host", lambda: tpch.q1(frame))
     try:
         cust, orders, nat = s.join_frames()
     except Exception as e:
@@ -751,15 +794,18 @@ def _host_fallback(scale: float) -> dict:
     rungs = [
         ("q3", lambda: tpch.q3(cust, orders, frame).collect().to_pydict(),
          lambda: tpch.oracle_q3(tables["customer"], tables["orders"],
-                                lineitem)),
+                                lineitem),
+         lambda: tpch.q3(cust, orders, frame)),
         ("q5", lambda: tpch.q5(cust, orders, frame, nat).collect()
          .to_pydict(),
          lambda: tpch.oracle_q5(tables["customer"], tables["orders"],
-                                lineitem, tables["nation"])),
+                                lineitem, tables["nation"]),
+         lambda: tpch.q5(cust, orders, frame, nat)),
         ("q12", lambda: tpch.q12(frame).collect().to_pydict(),
-         lambda: tpch.oracle_q12(lineitem)),
+         lambda: tpch.oracle_q12(lineitem),
+         lambda: tpch.q12(frame)),
     ]
-    for name, engine_fn, oracle_fn in rungs:
+    for name, engine_fn, oracle_fn, build_q in rungs:
         try:  # parity gates timing, as everywhere else in this file
             if _parity(engine_fn(), oracle_fn(), rtol=1e-6):
                 # sub-second rungs: best-of-3 rides out the host's drifting
@@ -767,6 +813,7 @@ def _host_fallback(scale: float) -> dict:
                 t_eng, _ = _best_of(engine_fn, n=3)
                 t_orc, _ = _best_of(oracle_fn, n=3)
                 out[f"{name}_host_vs_baseline"] = round(t_orc / t_eng, 3)
+                _save_rung_profile(out, f"{name}_host", build_q)
             else:
                 out[f"{name}_host_vs_baseline"] = 0.0
         except Exception as e:

@@ -294,6 +294,22 @@ def sql_expr(text: str) -> Expression:
     return _sql_expr(text)
 
 
+def last_profile():
+    """The QueryProfile of the most recent profiled query
+    (``df.collect(profile=True)`` / ``enable_profiling``), or None."""
+    from .context import get_context as _gc
+
+    return _gc().last_profile()
+
+
+def metrics_text() -> str:
+    """Prometheus-text dump of the process-level metrics registry
+    (daft_tpu/profile/metrics.py) — the serving layer's scrape surface."""
+    from .profile import METRICS
+
+    return METRICS.render_prometheus()
+
+
 __all__ = [
     "DataFrame",
     "GroupedDataFrame",
@@ -326,6 +342,8 @@ __all__ = [
     "from_scan_operator",
     "read_sql",
     "get_context",
+    "last_profile",
+    "metrics_text",
     "set_execution_config",
     "set_planning_config",
     "set_runner_native",

@@ -129,6 +129,11 @@ class ExecutionConfig:
     # in the morsel loop and at pipeline breakers; expiry raises
     # DaftTimeoutError carrying the partial RuntimeStats. None = no limit.
     execution_timeout_s: Optional[float] = None
+    # structured query profiler (daft_tpu/profile/): arm span/event
+    # recording for every query without passing collect(profile=True) each
+    # time. Off by default — the disarmed hot path is a single flag check
+    # (guard-tested zero-allocation), so q1 wall is unaffected.
+    enable_profiling: bool = False
     # device circuit breaker (execution.DeviceHealth): after this many
     # CONSECUTIVE device-kernel failures the breaker opens and every
     # device-eligible partition routes straight to the host path (one trip,
@@ -161,6 +166,8 @@ class DaftContext:
         self.planning_config = PlanningConfig()
         self.execution_config = ExecutionConfig()
         self._runner = None
+        # most recent QueryProfile built by a profiled collect()
+        self._last_profile = None
         self._runner_name = os.environ.get("DAFT_TPU_RUNNER", "native")
         if os.environ.get("DAFT_TPU_PROGRESS") == "1":
             from . import tracing
@@ -183,6 +190,12 @@ class DaftContext:
             else:
                 self._runner = NativeRunner()
         return self._runner
+
+    def last_profile(self):
+        """The QueryProfile of the most recent profiled query in this
+        process (``df.collect(profile=True)`` / cfg ``enable_profiling``),
+        or None."""
+        return self._last_profile
 
     def set_runner(self, name: str) -> None:
         from .errors import DaftValueError

@@ -67,6 +67,7 @@ class DataFrame:
         self._plan = plan
         self._result = result
         self.stats = RuntimeStats()
+        self._profile = None  # QueryProfile from a profiled collect()
 
     # ------------------------------------------------------------------ metadata
     @property
@@ -110,12 +111,14 @@ class DataFrame:
         return text
 
     def explain_analyze(self) -> str:
-        """Execute (if needed) and render per-operator rows + wall-time.
+        """Execute (if needed, with the profiler armed) and render
+        per-operator rows + wall-time, plus the per-op timeline /
+        critical-path section from the QueryProfile.
 
         Reference: the native executor's explain-analyze output
         (DAFT_DEV_ENABLE_EXPLAIN_ANALYZE, run.rs:106-115) backed by per-node
         RuntimeStatsContext counters (runtime_stats.rs:16-27)."""
-        self.collect()
+        self.collect(profile=True)
         snap = self.stats.snapshot()
         rows, wall = snap["op_rows"], snap["op_wall_ns"]
         tput = self.stats.op_throughput()
@@ -152,6 +155,9 @@ class DataFrame:
         if counters:
             lines.append("")
             lines.append("counters: " + ", ".join(f"{k}={v}" for k, v in sorted(counters.items())))
+        if self._profile is not None and self._profile.ops:
+            lines.append("")
+            lines.append(self._profile.render_timeline())
         text = "\n".join(lines)
         print(text)
         return text
@@ -465,29 +471,60 @@ class DataFrame:
         boundary (reference: stop_plan / MaterializedResult.cancel)."""
         self.stats.cancel()
 
-    def collect(self) -> "DataFrame":
-        if self._result is None:
-            self.stats.reset_cancel()  # a cancelled DataFrame stays retryable
-            from .runners import partition_set_cache, plan_cache_key
+    def collect(self, profile: Union[bool, str, None] = None) -> "DataFrame":
+        """Materialize the plan. ``profile`` arms the structured query
+        profiler for this execution: ``True`` records a QueryProfile
+        (``df.profile()`` / ``daft_tpu.last_profile()``), a string path
+        additionally writes the profile JSON there. ``None`` defers to
+        ``ExecutionConfig.enable_profiling``. An already-materialized
+        DataFrame cannot re-execute: its existing profile (if any) is
+        served — and written to a requested path — instead of silently
+        ignoring the argument."""
+        if self._result is not None:
+            if isinstance(profile, str) and self._profile is not None:
+                self._profile.to_json(profile)
+            return self
+        self.stats.reset_cancel()  # a cancelled DataFrame stays retryable
+        from .runners import partition_set_cache, plan_cache_key
 
-            cache = partition_set_cache()
-            key = (plan_cache_key(self._plan)
-                   if get_context().execution_config.enable_result_cache else None)
-            hit = cache.get(key) if key is not None else None
-            if hit is not None:
-                self.stats.bump("result_cache_hits")
-                self._result = hit
-            else:
-                runner = get_context().runner()
-                self._result = runner.run(self._plan, stats=self.stats)
-                if key is not None:
-                    import weakref
+        cfg = get_context().execution_config
+        want = profile if profile is not None else cfg.enable_profiling
+        if want:
+            from .profile import Profiler
 
-                    cache.put(key, self._result)
-                    # the entry lives exactly as long as some DataFrame owns it
-                    weakref.finalize(self, cache.release, key)
-            self._plan = InMemorySource(self._result.schema, self._result.partitions)
+            self.stats.profiler = Profiler(query_id=f"q-{id(self._plan):x}")
+        cache = partition_set_cache()
+        key = (plan_cache_key(self._plan)
+               if cfg.enable_result_cache else None)
+        hit = cache.get(key) if key is not None else None
+        if hit is not None:
+            self.stats.bump("result_cache_hits")
+            if self.stats.profiler.armed:
+                self.stats.profiler.event("result_cache_hit")
+            self._result = hit
+        else:
+            runner = get_context().runner()
+            self._result = runner.run(self._plan, stats=self.stats)
+            if key is not None:
+                import weakref
+
+                cache.put(key, self._result)
+                # the entry lives exactly as long as some DataFrame owns it
+                weakref.finalize(self, cache.release, key)
+        if want:
+            from .profile import build_profile
+
+            qp = build_profile(self.stats.profiler, self.stats)
+            self._profile = qp
+            get_context()._last_profile = qp
+            if isinstance(want, str):
+                qp.to_json(want)
+        self._plan = InMemorySource(self._result.schema, self._result.partitions)
         return self
+
+    def profile(self):
+        """The QueryProfile recorded by a profiled collect(), or None."""
+        return self._profile
 
     def iter_partitions(self) -> Iterator[MicroPartition]:
         if self._result is not None:

@@ -154,12 +154,18 @@ class RuntimeStats:
     .cancel() — ray_runner.py:489-502, partitioning.py:192)."""
 
     def __init__(self):
+        from .profile.spans import DISARMED
+
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = {}
         self.op_rows: Dict[str, int] = {}
         self.op_wall_ns: Dict[str, int] = {}
         self.op_bytes: Dict[str, int] = {}
         self._cancelled = threading.Event()
+        # the per-query span/event recorder (profile/spans.py). DISARMED is
+        # the shared no-op profiler — collect(profile=...) or an armed
+        # chrome trace swaps in a live one before execution starts
+        self.profiler = DISARMED
 
     def cancel(self) -> None:
         """Stop the query this handle is attached to at the next partition
@@ -175,8 +181,28 @@ class RuntimeStats:
         return self._cancelled.is_set()
 
     def bump(self, key: str, n: int = 1) -> None:
+        # counter updates are read-modify-write and arrive concurrently from
+        # pool workers, the async spill writer, and prefetch threads — the
+        # lock is load-bearing (tests/test_profile.py hammers this)
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + n
+
+    def io_wait(self, ns: int) -> None:
+        """Record consumer-thread blocked IO time: the counter AND the
+        io_wait phase of the innermost open profiler span, so per-op
+        io_wait in a QueryProfile reconciles with the io_wait_ns total."""
+        self.bump("io_wait_ns", ns)
+        p = self.profiler
+        if p.armed:
+            p.phase("io_wait", ns)
+
+    def dispatch_wait(self, ns: int) -> None:
+        """Head-of-line blocked time in the dispatch loop (queue_wait phase
+        on the pulling op's span)."""
+        self.bump("dispatch_wait_ns", ns)
+        p = self.profiler
+        if p.armed:
+            p.phase("queue_wait", ns)
 
     def record_op(self, name: str, rows: int, wall_ns: int,
                   bytes_out: int = 0) -> None:
@@ -303,8 +329,17 @@ class DeviceHealth:
                 self._probe_started = now
                 if stats is not None:
                     stats.bump(f"{self.kind}_breaker_probes")
+                    self._emit(stats, "probe")
                 return True
             return False
+
+    def _emit(self, stats: Optional["RuntimeStats"], transition: str) -> None:
+        """Breaker state transitions are typed events on the profile
+        timeline (kind `breaker`), so a trace shows exactly when the
+        device path opened/recovered relative to the pipeline."""
+        if stats is not None and stats.profiler.armed:
+            stats.profiler.event("breaker", kind=self.kind,
+                                 transition=transition, state=self._state)
 
     def record_success(self, stats: Optional[RuntimeStats] = None) -> None:
         with self._lock:
@@ -317,6 +352,7 @@ class DeviceHealth:
                 self._probe_inflight = False
                 if stats is not None:
                     stats.bump(f"{self.kind}_breaker_recoveries")
+                    self._emit(stats, "recovery")
 
     def record_failure(self, stats: Optional[RuntimeStats] = None) -> None:
         with self._lock:
@@ -328,12 +364,14 @@ class DeviceHealth:
                 self._probe_inflight = False
                 if stats is not None:
                     stats.bump(f"{self.kind}_breaker_reopens")
+                    self._emit(stats, "reopen")
             elif (self._state == self.CLOSED
                     and self._consecutive >= self.threshold):
                 self._state = self.OPEN
                 self._opened_at = time.monotonic()
                 if stats is not None:
                     stats.bump(f"{self.kind}_breaker_trips")
+                    self._emit(stats, "trip")
 
     def release_probe(self) -> None:
         """An admitted attempt DECLINED (no failure, no success — e.g. the
@@ -494,12 +532,19 @@ class ExecutionContext:
         deferred computation, whose resolver records for real)."""
         from . import faults
 
+        prof = self.stats.profiler
+        t0 = time.perf_counter_ns() if prof.armed else 0
         try:
             faults.check("device.kernel", self.stats)
             out = fn()
         except Exception:
             self.device_health.record_failure(self.stats)
             return None
+        finally:
+            if prof.armed:
+                # the host-side cost of staging + launching (sync attempts
+                # include the kernel wall; async launches just the dispatch)
+                prof.phase("device_dispatch", time.perf_counter_ns() - t0)
         if out is None:
             self.device_health.release_probe()
         elif not launch:
@@ -1057,52 +1102,63 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
     """Wire up the generator tree and return the root partition stream.
 
     Every op is wrapped with per-partition accounting (rows + wall time into
-    RuntimeStats, feeding explain_analyze) and, when a chrome trace is armed
-    (tracing.chrome_trace / DAFT_TPU_CHROME_TRACE), with duration events."""
-    tid_counter = [0]
+    RuntimeStats, feeding explain_analyze) and — when the query's profiler
+    is armed — with profiler spans. A chrome trace armed without an armed
+    profiler (tracing.chrome_trace / DAFT_TPU_CHROME_TRACE) arms one here:
+    the chrome output is rendered FROM the span tree at query end (one
+    consolidated writer, re-armed per query)."""
+    from . import tracing
+
+    if not ctx.stats.profiler.armed and tracing.active():
+        from .profile.spans import Profiler
+
+        ctx.stats.profiler = Profiler(query_id=f"q-{id(ctx):x}")
     parallel = ctx.num_workers > 1
 
     def build(op: PhysicalOp) -> Iterator[MicroPartition]:
         child_streams = [build(c) for c in op.children]
         if (parallel and op.map_partition is not None and len(child_streams) == 1
                 and op.parallel_safe()):
-            tid = _next_tid(tid_counter) if trace else 0
             if op.device_pipelinable(ctx) and not op_resource_request(op):
                 # device compute serializes on one chip: prefer the
                 # double-buffered sequential driver — but fall back to thread
                 # fan-out if the first partition declines the device path
-                return _adaptive_device_map(op, child_streams[0], ctx, tid,
-                                            trace)
+                return _adaptive_device_map(op, child_streams[0], ctx, trace)
             # instrumentation happens inside the workers (the consumer-side
             # wrapper would only measure blocked-wait time)
-            return _parallel_map(op, child_streams[0], ctx, tid=tid)
+            return _parallel_map(op, child_streams[0], ctx)
         stream = op.execute(child_streams, ctx)
         if trace:
-            return _traced(op, stream, ctx, _next_tid(tid_counter))
+            return _traced(op, stream, ctx)
         return stream
 
     built = build(root)
 
     def rooted():
+        t0 = time.perf_counter_ns()
         try:
             yield from built
         finally:
             ctx.shutdown_pool()
             ctx.finish_query()
-            from . import tracing
+            prof = ctx.stats.profiler
+            prof.finish()
+            if tracing.active() and prof.armed:
+                # span tree -> chrome events, then rewrite the armed trace
+                # file (buffer kept: the next query appends to the same
+                # consolidated writer)
+                tracing.add_span_events(prof)
+                tracing.flush_query()
+            from .profile.metrics import record_query_metrics
 
+            record_query_metrics(ctx.stats, time.perf_counter_ns() - t0)
             tracing.query_finished()
 
     return rooted()
 
 
-def _next_tid(counter):
-    counter[0] += 1
-    return counter[0]
-
-
 def _adaptive_device_map(op: PhysicalOp, child: Iterator[MicroPartition],
-                         ctx: ExecutionContext, tid: int,
+                         ctx: ExecutionContext,
                          trace: bool) -> Iterator[MicroPartition]:
     """Peek at the first partition: if it accepts the device dispatch, run the
     whole stream through the double-buffered sequential driver (the launched
@@ -1123,22 +1179,25 @@ def _adaptive_device_map(op: PhysicalOp, child: Iterator[MicroPartition],
         return
     dispatch = op.map_partition_dispatch(first, ctx)
     if dispatch is None:
-        yield from _parallel_map(op, itertools.chain([first], it), ctx, tid)
+        yield from _parallel_map(op, itertools.chain([first], it), ctx)
         return
     stream = op._map_execute([it], ctx, _primed=dispatch)
     if trace:
-        stream = _traced(op, stream, ctx, tid)
+        stream = _traced(op, stream, ctx)
     yield from stream
 
 
 def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
-                  ctx: ExecutionContext, tid: int) -> Iterator[MicroPartition]:
+                  ctx: ExecutionContext) -> Iterator[MicroPartition]:
     """Morsel-parallel per-partition map with bounded in-flight window and
     order-preserving output (reference: worker-per-core IntermediateOps with
     round-robin morsel dispatch, intermediate_op.rs:71).
 
-    Stats/trace events are recorded around the worker-side call, so
-    explain_analyze sees real work time, not the consumer's blocked waits."""
+    Stats are recorded around the worker-side call, so explain_analyze sees
+    real work time, not the consumer's blocked waits. The worker-side op
+    SPAN (queue-wait phase included) is opened by scheduler.dispatch, which
+    also carries the dispatching thread's span context across the hop —
+    run_one only annotates it with the row count."""
     from . import tracing
     from .scheduler import PartitionTask, dispatch
 
@@ -1152,8 +1211,11 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
         n = out.num_rows_or_none()
         rows = n if n is not None else 0
         ctx.stats.record_op(name, rows, dt, _part_bytes(out))
-        if tracing.active():
-            tracing.add_event(name, t0 / 1000.0, dt / 1000.0, tid, {"rows": rows})
+        prof = ctx.stats.profiler
+        if prof.armed:
+            sp = prof.current()
+            if sp is not None:
+                sp.set_attr("rows", rows)
         return out
 
     saw_any = False
@@ -1185,12 +1247,14 @@ _tl = threading.local()
 
 
 def _traced(op: PhysicalOp, stream: Iterator[MicroPartition],
-            ctx: ExecutionContext, tid: int) -> Iterator[MicroPartition]:
+            ctx: ExecutionContext) -> Iterator[MicroPartition]:
     from . import tracing
 
     name = op.name()
+    stats = ctx.stats
+    seq = 0
     while True:
-        if ctx.stats.is_cancelled():
+        if stats.is_cancelled():
             raise QueryCancelledError(f"query cancelled (at {name})")
         ctx.check_deadline()
         # Self-time accounting: pulling next(stream) recursively runs the
@@ -1198,13 +1262,20 @@ def _traced(op: PhysicalOp, stream: Iterator[MicroPartition],
         # accumulates its INCLUSIVE time into the parent frame, and reports
         # inclusive - children as its own wall time. explain_analyze then
         # ranks operators by where time is actually spent, not by depth.
+        # The profiler span covers the same interval (kind "op"): its export
+        # self-time subtracts the same same-thread child op spans, so the
+        # QueryProfile reconciles with RuntimeStats by construction.
         stack = getattr(_tl, "stack", None)
         if stack is None:
             stack = _tl.stack = []
         stack.append(0)
+        prof = stats.profiler
+        sp = prof.begin(name, op=name, part=seq) if prof.armed else None
         t0 = time.perf_counter_ns()
+        pulled = False
         try:
             part = next(stream)
+            pulled = True
         except StopIteration:
             return
         finally:
@@ -1212,11 +1283,16 @@ def _traced(op: PhysicalOp, stream: Iterator[MicroPartition],
             child_ns = stack.pop()
             if stack:
                 stack[-1] += dt
+            if sp is not None:
+                # the final StopIteration pull is not a partition: close
+                # its span unrecorded so per-op partition counts stay exact
+                (prof.end if pulled else prof.cancel)(sp)
         n = part.num_rows_or_none()
         rows = n if n is not None else 0
-        ctx.stats.record_op(name, rows, max(dt - child_ns, 0),
-                            _part_bytes(part))
-        if tracing.active():
-            tracing.add_event(name, t0 / 1000.0, dt / 1000.0, tid, {"rows": rows})
+        stats.record_op(name, rows, max(dt - child_ns, 0),
+                        _part_bytes(part))
+        if sp is not None:
+            sp.set_attr("rows", rows)
+        seq += 1
         tracing.report_progress(name, rows)
         yield part

@@ -174,6 +174,8 @@ def check(site: str, stats=None) -> None:
         return
     if stats is not None:
         stats.bump("faults_injected")
+        if stats.profiler.armed:
+            stats.profiler.event("fault_injected", site=site, call=call_no)
     from . import tracing
 
     tracing.add_instant(f"fault:{site}", {"call": call_no})

@@ -179,6 +179,12 @@ class FusedMapOp(PhysicalOp):
         ctx.stats.bump("fused_ops_eliminated", g.n_ops - 1)
         if g.cse_hits:
             ctx.stats.bump("cse_hits", g.cse_hits)
+        if ctx.stats.profiler.armed:
+            # compile outcome as a typed profile event: what fused, how much
+            # it collapsed, and whether a one-program device plan exists
+            ctx.stats.profiler.event(
+                "fusion", ops=g.n_ops, cse_hits=g.cse_hits,
+                device_program=self.program.device_exprs is not None)
 
     def map_partition(self, part, ctx):
         self._record(ctx)

@@ -107,9 +107,15 @@ class ScanPrefetcher:
                         and self._ledger.current + self._ledger.prefetch_inflight
                         + s.est_bytes > self._budget):
                     self._stats.bump("prefetch_throttled")
+                    if self._stats.profiler.armed:
+                        self._stats.profiler.event("throttle",
+                                                   what="scan_prefetch",
+                                                   bytes=s.est_bytes)
                     return  # budget headroom gone: stop, retry on next read
+                prof = self._stats.profiler
+                token = prof.capture() if prof.armed else None
                 try:
-                    fut = self._ctx.pool().submit(self._fetch, j)
+                    fut = self._ctx.pool().submit(self._fetch, j, token)
                 except RuntimeError:
                     # pool already shut down (query finished; a cached
                     # partition is being read late): degrade to sync reads
@@ -122,15 +128,28 @@ class ScanPrefetcher:
                 self._ledger.prefetch_started(s.est_bytes)
                 self._stats.bump("prefetch_submitted")
 
-    def _fetch(self, idx: int) -> List[Any]:
-        """Background fetch body (runs on a pool worker)."""
+    def _fetch(self, idx: int, span_token=None) -> List[Any]:
+        """Background fetch body (runs on a pool worker). ``span_token`` is
+        the submitting thread's captured span, so the fetch interval is
+        attributed to the scan read that triggered the readahead."""
         from .. import faults
 
-        faults.check("prefetch.fetch", self._stats)
-        t0 = time.perf_counter_ns()
-        chunks = _read_task_chunks(self._slots[idx].task)
-        self._stats.bump("prefetch_read_ns", time.perf_counter_ns() - t0)
-        return chunks
+        prof = self._stats.profiler
+        sp = None
+        if span_token is not None and prof.armed:
+            act = prof.activate(span_token)
+            act.__enter__()
+            sp = prof.begin("prefetch.fetch", part=idx, kind="bg")
+        try:
+            faults.check("prefetch.fetch", self._stats)
+            t0 = time.perf_counter_ns()
+            chunks = _read_task_chunks(self._slots[idx].task)
+            self._stats.bump("prefetch_read_ns", time.perf_counter_ns() - t0)
+            return chunks
+        finally:
+            if sp is not None:
+                prof.end(sp)
+                act.__exit__(None, None, None)
 
     # ------------------------------------------------------------ consumption
     def _release_locked(self, s: _Slot) -> None:
@@ -168,8 +187,7 @@ class ScanPrefetcher:
             finally:
                 if not worker:
                     self._stats.bump("prefetch_misses")
-                    self._stats.bump("io_wait_ns",
-                                     time.perf_counter_ns() - t0)
+                    self._stats.io_wait(time.perf_counter_ns() - t0)
         try:
             if fut.done():
                 self._stats.bump("prefetch_hits")
@@ -183,8 +201,7 @@ class ScanPrefetcher:
                     return _read_task_chunks(s.task)
                 finally:
                     if not worker:
-                        self._stats.bump("io_wait_ns",
-                                         time.perf_counter_ns() - t0)
+                        self._stats.io_wait(time.perf_counter_ns() - t0)
             else:
                 # running on a worker right now: it will complete — wait
                 t0 = time.perf_counter_ns()
@@ -193,8 +210,7 @@ class ScanPrefetcher:
                 finally:
                     self._stats.bump("prefetch_hits")
                     if not worker:
-                        self._stats.bump("io_wait_ns",
-                                         time.perf_counter_ns() - t0)
+                        self._stats.io_wait(time.perf_counter_ns() - t0)
         finally:
             with self._lock:
                 self._release_locked(s)

@@ -278,9 +278,13 @@ class MeshExecutionContext(ExecutionContext):
             return None
         try:
             faults.check("collective.exchange", self.stats)
-            out = self._device_shuffle_impl(parts, by, num, scheme,
-                                            descending, nulls_first,
-                                            boundaries)
+            # the whole mesh exchange (staging + all_to_all + gather-back)
+            # is one phase on the profile timeline
+            with self.stats.profiler.span("collective.exchange",
+                                          kind="phase"):
+                out = self._device_shuffle_impl(parts, by, num, scheme,
+                                                descending, nulls_first,
+                                                boundaries)
         except Exception:
             self.collective_health.record_failure(self.stats)
             return None

@@ -193,17 +193,18 @@ class ScanOp(PhysicalOp):
 
         scan_owner = getattr(ctx, "scan_owner", None)
         parts = []
-        for i, task in enumerate(self.tasks):
-            if task.can_prune():
-                ctx.stats.bump("scan_tasks_pruned")
-                continue
-            ctx.stats.bump("scan_tasks_emitted")
-            part = MicroPartition.from_scan_task(task)
-            if scan_owner is not None:
-                # multi-host: the task index over the globally-consistent
-                # list assigns which process materializes (and READS) it
-                part.owner_process = scan_owner(i)
-            parts.append(part)
+        with ctx.stats.profiler.span("scan.plan", kind="phase"):
+            for i, task in enumerate(self.tasks):
+                if task.can_prune():
+                    ctx.stats.bump("scan_tasks_pruned")
+                    continue
+                ctx.stats.bump("scan_tasks_emitted")
+                part = MicroPartition.from_scan_task(task)
+                if scan_owner is not None:
+                    # multi-host: the task index over the globally-consistent
+                    # list assigns which process materializes (and READS) it
+                    part.owner_process = scan_owner(i)
+                parts.append(part)
         # bounded readahead: reading partition i triggers the background
         # fetch of i+1..i+depth (locally-owned tasks only); byte-identical
         # with prefetch off, order preserved by this very loop
@@ -387,8 +388,11 @@ class WriteOp(PhysicalOp):
         wrote = False
         for part in inputs[0]:
             wrote = True
-            yield part.write_tabular(self.root_dir, self.format, self.compression,
-                                     self.partition_cols)
+            with ctx.stats.profiler.span("write.sink", kind="phase"):
+                out = part.write_tabular(self.root_dir, self.format,
+                                         self.compression,
+                                         self.partition_cols)
+            yield out
         if not wrote:
             yield MicroPartition.empty(self.schema)
 
@@ -405,7 +409,8 @@ class CoalesceOp(PhysicalOp):
         self.num = num
 
     def execute(self, inputs, ctx) -> PartStream:
-        parts = [p for p in inputs[0]]
+        with ctx.stats.profiler.span("coalesce.gather", kind="phase"):
+            parts = [p for p in inputs[0]]
         if not parts:
             return
         total = sum(len(p) for p in parts)
@@ -482,43 +487,48 @@ class ShuffleOp(PhysicalOp):
         stream = _counted(stream, ctx, "exchange_rows")
         buckets = [ctx.partition_buffer() for _ in range(n)]
         saw = False
-        if self.scheme == "range":
-            # Boundaries need all inputs, so partitions are buffered
-            # (spillable); keys are SAMPLED AS PARTITIONS STREAM IN so a
-            # spilled partition is never re-materialized for sampling, and
-            # drain() drops each ref after fanout — out-of-core inputs are
-            # resident once at a time.
-            in_buf = ctx.partition_buffer()
-            samples = []
-            for p in stream:
-                if pre_boundaries is None:
-                    samples.append(sample_partition_keys(
-                        p, self.by, n, ctx.cfg.sample_size_for_sort))
-                in_buf.append(p)
-            saw = len(in_buf) > 0
-            if not saw:
-                boundaries = None
-            elif pre_boundaries is not None:
-                boundaries = pre_boundaries  # sampled for the device attempt
+        # the whole map-side fanout (decode + hash/split + bucket appends)
+        # runs inside the FIRST pull of this op: make it a named phase on
+        # the span timeline so the exchange's two halves are separable
+        with ctx.stats.profiler.span("shuffle.fanout", kind="phase"):
+            if self.scheme == "range":
+                # Boundaries need all inputs, so partitions are buffered
+                # (spillable); keys are SAMPLED AS PARTITIONS STREAM IN so a
+                # spilled partition is never re-materialized for sampling,
+                # and drain() drops each ref after fanout — out-of-core
+                # inputs are resident once at a time.
+                in_buf = ctx.partition_buffer()
+                samples = []
+                for p in stream:
+                    if pre_boundaries is None:
+                        samples.append(sample_partition_keys(
+                            p, self.by, n, ctx.cfg.sample_size_for_sort))
+                    in_buf.append(p)
+                saw = len(in_buf) > 0
+                if not saw:
+                    boundaries = None
+                elif pre_boundaries is not None:
+                    boundaries = pre_boundaries  # sampled for device attempt
+                else:
+                    boundaries = boundaries_from_samples(
+                        samples, self.by, n, self.descending, self.nulls_first)
+                for p in in_buf.drain():
+                    for i, piece in enumerate(
+                            p.partition_by_range(self.by, boundaries,
+                                                 self.descending,
+                                                 self.nulls_first)):
+                        buckets[min(i, n - 1)].append(piece)
             else:
-                boundaries = boundaries_from_samples(
-                    samples, self.by, n, self.descending, self.nulls_first)
-            for p in in_buf.drain():
-                for i, piece in enumerate(p.partition_by_range(self.by, boundaries,
-                                                               self.descending,
-                                                               self.nulls_first)):
-                    buckets[min(i, n - 1)].append(piece)
-        else:
-            def fanout(p, pi):
-                if self.scheme == "hash":
-                    return p.partition_by_hash(self.by, n)
-                return p.partition_by_random(n, seed=pi)
+                def fanout(p, pi):
+                    if self.scheme == "hash":
+                        return p.partition_by_hash(self.by, n)
+                    return p.partition_by_random(n, seed=pi)
 
-            for pieces in _fanout_stream(stream, fanout, ctx,
-                                         _subtree_may_yield_unloaded(self)):
-                saw = True
-                for i, piece in enumerate(pieces):
-                    buckets[i].append(piece)
+                for pieces in _fanout_stream(stream, fanout, ctx,
+                                             _subtree_may_yield_unloaded(self)):
+                    saw = True
+                    for i, piece in enumerate(pieces):
+                        buckets[i].append(piece)
         if not saw:
             return
         ctx.stats.bump("shuffles")
@@ -529,7 +539,9 @@ class ShuffleOp(PhysicalOp):
                 # consumer works on bucket i
                 buckets[i + 1].preload()
             if len(buckets[i]):
-                yield MicroPartition.concat(buckets[i].parts())
+                with ctx.stats.profiler.span("shuffle.merge", kind="phase"):
+                    merged = MicroPartition.concat(buckets[i].parts())
+                yield merged
             else:
                 yield MicroPartition.empty(self.schema)
             buckets[i].release()
@@ -816,13 +828,12 @@ class GatherOp(PhysicalOp):
         super().__init__([child], child.schema, 1)
 
     def execute(self, inputs, ctx) -> PartStream:
-        parts = [p for p in _counted(inputs[0], ctx, "exchange_rows")]
-        if not parts:
-            yield MicroPartition.empty(self.schema)
-        elif len(parts) == 1:
-            yield parts[0]
-        else:
-            yield MicroPartition.concat(parts)
+        with ctx.stats.profiler.span("gather.merge", kind="phase"):
+            parts = [p for p in _counted(inputs[0], ctx, "exchange_rows")]
+            out = (MicroPartition.empty(self.schema) if not parts
+                   else parts[0] if len(parts) == 1
+                   else MicroPartition.concat(parts))
+        yield out
 
 
 class DistinctOp(PhysicalOp):
@@ -899,10 +910,11 @@ class HashJoinOp(PhysicalOp):
     def execute(self, inputs, ctx) -> PartStream:
         lbuf = ctx.partition_buffer()
         rbuf = ctx.partition_buffer()
-        for p in inputs[0]:
-            lbuf.append(p)
-        for p in inputs[1]:
-            rbuf.append(p)
+        with ctx.stats.profiler.span("join.build", kind="phase"):
+            for p in inputs[0]:
+                lbuf.append(p)
+            for p in inputs[1]:
+                rbuf.append(p)
         n = max(len(lbuf), len(rbuf))
         lschema = self.children[0].schema
         rschema = self.children[1].schema
@@ -941,12 +953,13 @@ class BroadcastJoinOp(PhysicalOp):
         self.suffix = suffix
 
     def execute(self, inputs, ctx) -> PartStream:
-        small_parts = [p for p in inputs[1]]
-        small = (MicroPartition.concat(small_parts) if len(small_parts) > 1
-                 else (small_parts[0] if small_parts else MicroPartition.empty(self.children[1].schema)))
-        # mesh runners replicate the build keys into every device's HBM here
-        # (one ICI broadcast); per-partition probes then stay device-local
-        small = ctx.prepare_broadcast(small, self.small_on, self.how)
+        with ctx.stats.profiler.span("join.build", kind="phase"):
+            small_parts = [p for p in inputs[1]]
+            small = (MicroPartition.concat(small_parts) if len(small_parts) > 1
+                     else (small_parts[0] if small_parts else MicroPartition.empty(self.children[1].schema)))
+            # mesh runners replicate the build keys into every device's HBM
+            # here (one ICI broadcast); per-partition probes stay device-local
+            small = ctx.prepare_broadcast(small, self.small_on, self.how)
         ctx.stats.bump("broadcast_joins")
 
         def pairs():
@@ -989,12 +1002,15 @@ class SortMergeJoinOp(PhysicalOp):
         ssize = ctx.cfg.sample_size_for_sort
         # keys sampled as partitions stream in: spilled inputs are never
         # re-materialized for boundary estimation
-        for p in inputs[0]:
-            lsamples.append(sample_partition_keys(p, self.left_on, n, ssize))
-            lbuf.append(p)
-        for p in inputs[1]:
-            rsamples.append(sample_partition_keys(p, self.right_on, n, ssize))
-            rbuf.append(p)
+        with ctx.stats.profiler.span("join.build", kind="phase"):
+            for p in inputs[0]:
+                lsamples.append(sample_partition_keys(p, self.left_on, n,
+                                                      ssize))
+                lbuf.append(p)
+            for p in inputs[1]:
+                rsamples.append(sample_partition_keys(p, self.right_on, n,
+                                                      ssize))
+                rbuf.append(p)
         lschema = self.children[0].schema
         rschema = self.children[1].schema
         if n <= 1 or (len(lbuf) <= 1 and len(rbuf) <= 1):

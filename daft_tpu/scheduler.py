@@ -34,22 +34,26 @@ def on_pool_worker() -> bool:
 
 def _await_result(fut, ctx) -> MicroPartition:
     """Resolve a head-of-line task future, attributing blocked time to the
-    dispatcher (dispatch_wait_ns) so the io_wait-vs-compute split can tell
-    a starved pipeline from a compute-bound one."""
+    dispatcher (dispatch_wait_ns, and the queue_wait phase of the pulling
+    op's span) so the io_wait-vs-compute split can tell a starved pipeline
+    from a compute-bound one."""
     if fut.done():
         return fut.result()
     t0 = time.perf_counter_ns()
     try:
         return fut.result()
     finally:
-        ctx.stats.bump("dispatch_wait_ns", time.perf_counter_ns() - t0)
+        ctx.stats.dispatch_wait(time.perf_counter_ns() - t0)
 
 
 class PartitionTask:
     """One unit of per-partition work: a partition, the function to run on
-    it, and the resource request the accountant must admit first."""
+    it, and the resource request the accountant must admit first.
+    ``span_token``/``submit_ns`` carry the dispatching thread's profiler
+    context across the pool hop (set by dispatch when profiling is armed)."""
 
-    __slots__ = ("partition", "fn", "resource_request", "op_name", "seq")
+    __slots__ = ("partition", "fn", "resource_request", "op_name", "seq",
+                 "span_token", "submit_ns")
 
     def __init__(self, partition: MicroPartition, fn: Callable,
                  resource_request=None, op_name: str = "task", seq: int = 0):
@@ -58,6 +62,8 @@ class PartitionTask:
         self.resource_request = resource_request
         self.op_name = op_name
         self.seq = seq
+        self.span_token = None
+        self.submit_ns = 0
 
     def run(self) -> MicroPartition:
         return self.fn(self.partition)
@@ -92,10 +98,29 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
 
     def run_task(task: PartitionTask) -> MicroPartition:
         _WORKER_TL.active = True
+        prof = ctx.stats.profiler
+        sp = None
+        if prof.armed:
+            # adopt the dispatching thread's span context, then open this
+            # task's worker-side op span — background work is attributed to
+            # the op that caused it, and queue/dispatch wait (submit ->
+            # worker start) is a phase, not lost time
+            act = prof.activate(task.span_token)
+            act.__enter__()
+            sp = prof.begin(task.op_name, op=task.op_name, part=task.seq)
+            if task.submit_ns:
+                sp.add_phase("queue_wait",
+                             time.perf_counter_ns() - task.submit_ns)
+        else:
+            act = None
         try:
             return task.run()
         finally:
             _WORKER_TL.active = False
+            if sp is not None:
+                prof.end(sp)
+            if act is not None:
+                act.__exit__(None, None, None)
             # drop the input partition as soon as the work is done — the
             # result may wait in `pending` behind a slow head-of-line task,
             # and holding input + output would double peak partition memory
@@ -103,6 +128,7 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
             if task.resource_request:
                 ctx.accountant.release(task.resource_request)
 
+    prof = ctx.stats.profiler
     try:
         for task in tasks:
             if ctx.stats.is_cancelled():
@@ -111,6 +137,9 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
             ctx.check_deadline()
             if task.resource_request:
                 ctx.accountant.admit(task.resource_request)
+            if prof.armed:
+                task.span_token = prof.capture()
+                task.submit_ns = time.perf_counter_ns()
             pending.append((task, pool.submit(run_task, task)))
             while len(pending) >= window:
                 yield _await_result(pending.popleft()[1], ctx)

@@ -456,7 +456,7 @@ class _SpillSlotTask:
                 # on the readahead pool or inside a dispatched partition
                 # task (parallel map / pooled fanout) is overlapped work,
                 # not consumer wait
-                self._rt_stats.bump("io_wait_ns", dt)
+                self._rt_stats.io_wait(dt)
         IO_STATS.bump(files_opened=1, bytes_read=arrow_tbl.nbytes,
                       rows_read=arrow_tbl.num_rows,
                       columns_read=arrow_tbl.num_columns)
@@ -692,7 +692,10 @@ class PartitionBuffer:
             self.stats.bump("spill_write_ns", dt)
             # a synchronous spill stalls the breaker thread for the whole
             # write — exactly the wait async writeback removes
-            self.stats.bump("io_wait_ns", dt)
+            self.stats.io_wait(dt)
+            if self.stats.profiler.armed:
+                self.stats.profiler.event("spill", mode="sync", rows=nrows,
+                                          bytes=file_bytes)
         task = _SpillSlotTask(path, tbls[0].schema, nrows, file_bytes,
                               self.scope, rt_stats=self.stats)
         # the slot recycles when nothing can read it anymore: task GC, not
@@ -712,30 +715,49 @@ class PartitionBuffer:
                                    sum(t.size_bytes() for t in tbls),
                                    self.scope, tbls, rt_stats=self.stats)
         stats = self.stats
+        # capture the submitting thread's span so the write — which runs on
+        # the writer thread — is attributed to the op that spilled, not lost
+        prof = stats.profiler if stats is not None else None
+        token = prof.capture() if prof is not None and prof.armed else None
 
         def job():
             from . import faults
 
+            sp = None
+            if token is not None:
+                act = prof.activate(token)
+                act.__enter__()
+                sp = prof.begin("spill.write", kind="bg")
             try:
-                faults.check("spill.write", stats)
-                t0 = time.perf_counter_ns()
-                file_bytes = _write_spill_ipc(path, tbls)
-                dt = time.perf_counter_ns() - t0
-            except Exception:
-                # same contract as the synchronous path, discovered late:
-                # hold the partition in memory instead of failing the query
-                MEMORY_LEDGER.async_spill_failed(size)
-                task._write_failed(size)
+                try:
+                    faults.check("spill.write", stats)
+                    t0 = time.perf_counter_ns()
+                    file_bytes = _write_spill_ipc(path, tbls)
+                    dt = time.perf_counter_ns() - t0
+                except Exception:
+                    # same contract as the synchronous path, discovered
+                    # late: hold the partition in memory instead of
+                    # failing the query
+                    MEMORY_LEDGER.async_spill_failed(size)
+                    task._write_failed(size)
+                    if stats is not None:
+                        stats.bump("spill_write_failures")
+                    return
+                MEMORY_LEDGER.async_spill_done(size)
+                MEMORY_LEDGER.record_spill_write(file_bytes, dt)
+                task._write_done(file_bytes)
                 if stats is not None:
-                    stats.bump("spill_write_failures")
-                return
-            MEMORY_LEDGER.async_spill_done(size)
-            MEMORY_LEDGER.record_spill_write(file_bytes, dt)
-            task._write_done(file_bytes)
-            if stats is not None:
-                stats.bump("spilled_partitions")
-                stats.bump("spill_write_bytes", file_bytes)
-                stats.bump("spill_write_ns", dt)
+                    stats.bump("spilled_partitions")
+                    stats.bump("spill_write_bytes", file_bytes)
+                    stats.bump("spill_write_ns", dt)
+                if sp is not None:
+                    sp.set_attr("bytes", file_bytes)
+                    prof.event("spill", mode="async", rows=nrows,
+                               bytes=file_bytes)
+            finally:
+                if sp is not None:
+                    prof.end(sp)
+                    act.__exit__(None, None, None)
 
         MEMORY_LEDGER.async_spill_started(size)
         t0 = time.perf_counter_ns()
@@ -747,7 +769,7 @@ class PartitionBuffer:
         if stats is not None and backpressure > 1_000_000:
             # the only disk stall left on the append path: a full writer
             # queue (>1ms counts; the fast path is lock-acquire noise)
-            stats.bump("io_wait_ns", backpressure)
+            stats.io_wait(backpressure)
             stats.bump("spill_backpressure_ns", backpressure)
         weakref.finalize(task, _settle_async_slot, self.scope, path,
                          task._held_cell)
@@ -785,19 +807,33 @@ class PartitionBuffer:
                     and submitted_bytes + est > self.budget):
                 if self.stats is not None:
                     self.stats.bump("preload_throttled")
+                    if self.stats.profiler.armed:
+                        self.stats.profiler.event("throttle",
+                                                  what="unspill_preload",
+                                                  bytes=est)
                 return
             self._submit_load(p)
             submitted_bytes += est
 
     def _submit_load(self, part: MicroPartition):
         submit = self._readahead
+        prof = self.stats.profiler if self.stats is not None else None
+        token = prof.capture() if prof is not None and prof.armed else None
 
         def job():
             _BG_IO.active = True
+            sp = None
+            if token is not None:
+                act = prof.activate(token)
+                act.__enter__()
+                sp = prof.begin("spill.read", kind="bg")
             try:
                 return part.table()
             finally:
                 _BG_IO.active = False
+                if sp is not None:
+                    prof.end(sp)
+                    act.__exit__(None, None, None)
 
         try:
             fut = submit(job)
@@ -866,7 +902,7 @@ class PartitionBuffer:
         finally:
             if self.stats is not None:
                 self.stats.bump("unspill_readahead_hits")
-                self.stats.bump("io_wait_ns", time.perf_counter_ns() - t0)
+                self.stats.io_wait(time.perf_counter_ns() - t0)
 
     def release(self) -> None:
         """Return held bytes to the ledger and drop partition refs (call when

@@ -3,10 +3,15 @@
 Role-equivalent to the reference's chrome-trace layer
 (src/common/tracing/src/lib.rs:13-55, armed by DAFT_DEV_ENABLE_CHROME_TRACE
 and re-armed per query by the native executor) and its tqdm progress bars
-(daft/runners/progress_bar.py). Events are buffered in memory and flushed as
-one chrome://tracing-compatible JSON array; on TPU the same file can be opened
-alongside an xprof/xplane capture to line up host pipeline stages with device
-kernels.
+(daft/runners/progress_bar.py). Events are buffered in a bounded RING
+(evictions counted, reported as droppedEvents) and written as one
+chrome://tracing-compatible JSON array; since PR 6 the per-op duration
+events are rendered FROM the structured profiler's span tree
+(daft_tpu/profile/) at each query's end — one consolidated writer,
+re-armed per query — so the trace carries the same cross-thread
+attribution the QueryProfile does. On TPU the same file can be opened
+alongside an xprof/xplane capture to line up host pipeline stages with
+device kernels.
 
 Enable with the env var DAFT_TPU_CHROME_TRACE=<path> (armed at import/query
 time) or programmatically:
@@ -21,13 +26,24 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
-from typing import Callable, List, Optional
+from typing import Callable, Deque, Optional
+
+# Buffer cap: a RING — past it the OLDEST events are evicted and counted
+# (dropped_events()), so a long-running armed process keeps the most recent
+# window instead of growing without bound. The flush metadata records the
+# drop count so a truncated trace is never mistaken for a complete one.
+DEFAULT_BUFFER_CAP = 200_000
 
 _lock = threading.Lock()
-_events: List[dict] = []
+_events: Deque[dict] = deque(maxlen=DEFAULT_BUFFER_CAP)
+_dropped = 0
 _path: Optional[str] = None
 _t0_us: float = 0.0
+# thread name -> chrome tid, stable for the LIFETIME of one armed trace:
+# the consolidated multi-query file must keep each real thread on one lane
+_tids: dict = {}
 
 _progress_cb: Optional[Callable[[str, int], None]] = None
 
@@ -40,13 +56,40 @@ def _now_us() -> float:
     return time.perf_counter_ns() / 1000.0
 
 
+def set_buffer_cap(cap: int) -> None:
+    """Resize the ring (keeps the newest events that fit; tests use this to
+    exercise eviction cheaply)."""
+    global _events, _dropped
+    with _lock:
+        old = list(_events)
+        _events = deque(old[-cap:] if cap else [], maxlen=max(1, cap))
+        _dropped += max(0, len(old) - cap)
+
+
+def dropped_events() -> int:
+    with _lock:
+        return _dropped
+
+
 def enable(path: str) -> None:
     """Start buffering events; flush() writes them to `path`."""
-    global _path, _t0_us
+    global _path, _t0_us, _dropped
     with _lock:
         _path = path
         _t0_us = _now_us()
         _events.clear()
+        _dropped = 0
+        _tids.clear()
+
+
+def _append_locked(ev: dict) -> None:
+    # runs under _lock (every caller holds it); the lock-discipline rule is
+    # lexical and cannot see through the helper
+    global _dropped
+    if _events.maxlen is not None and len(_events) == _events.maxlen:
+        # the ring evicts its oldest entry on this append
+        _dropped += 1  # daftlint: disable=DTL002
+    _events.append(ev)
 
 
 def add_event(name: str, start_us: float, dur_us: float, tid: int = 0,
@@ -58,7 +101,7 @@ def add_event(name: str, start_us: float, dur_us: float, tid: int = 0,
     if args:
         ev["args"] = args
     with _lock:
-        _events.append(ev)
+        _append_locked(ev)
 
 
 def add_instant(name: str, args: Optional[dict] = None) -> None:
@@ -72,20 +115,80 @@ def add_instant(name: str, args: Optional[dict] = None) -> None:
     if args:
         ev["args"] = args
     with _lock:
-        _events.append(ev)
+        _append_locked(ev)
 
 
-def flush() -> Optional[str]:
-    """Write buffered events; returns the path written (None if disabled)."""
+def add_span_events(profiler) -> None:
+    """Render a finished query's span tree + typed events into the chrome
+    buffer (the consolidated writer: execution no longer emits per-pull
+    chrome events itself — the span tree is the single source). Threads map
+    to chrome tids by first appearance, stable across the armed trace's
+    lifetime; span phases and attrs ride in `args` so the trace viewer
+    shows the same breakdown the QueryProfile carries. Incremental: only
+    spans/events not yet rendered are emitted, so an AQE query's per-stage
+    flushes never duplicate earlier stages."""
+    if _path is None:
+        return
+    spans, events = profiler.drain_for_chrome()
+    pid = os.getpid()
+    with _lock:
+        t0 = _t0_us
+        for sp in spans:
+            tid = _tids.setdefault(sp.thread, len(_tids))
+            args = {"span": sp.sid, "kind": sp.kind}
+            if sp.parent is not None:
+                args["parent"] = sp.parent
+            if sp.part is not None:
+                args["part"] = sp.part
+            if sp.phases:
+                args.update({f"phase.{k}": v for k, v in sp.phases.items()})
+            if sp.attrs:
+                args.update(sp.attrs)
+            _append_locked({
+                "name": sp.name, "ph": "X", "pid": pid, "tid": tid,
+                "ts": sp.t0_ns / 1000.0 - t0, "dur": sp.dur_ns / 1000.0,
+                "args": args})
+        for ev in events:
+            _append_locked({
+                "name": ev["kind"], "ph": "i", "s": "g", "pid": pid,
+                "tid": 0, "ts": ev["t_ns"] / 1000.0 - t0,
+                "args": dict(ev.get("attrs") or {})})
+
+
+def flush(keep: bool = False) -> Optional[str]:
+    """Write buffered events atomically w.r.t. concurrent emits: the buffer
+    is snapshotted (and, unless ``keep``, cleared) under the lock in one
+    step, then written outside it — an emit racing the file write lands in
+    the next flush, never lost or duplicated. ``keep=True`` is the
+    per-query re-arming mode: the file on disk always reflects everything
+    so far, and later queries keep appending."""
+    global _dropped
     with _lock:
         path = _path
         if path is None:
             return None
         evs = list(_events)
-        _events.clear()
+        dropped = _dropped
+        if not keep:
+            # the written file records this window's drops; the next
+            # window starts with a clean count (a later complete batch
+            # must not be mislabeled as truncated)
+            _events.clear()
+            _dropped = 0
+    doc = {"traceEvents": evs, "displayTimeUnit": "ms"}
+    if dropped:
+        doc["droppedEvents"] = dropped
     with open(path, "w") as f:
-        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+        json.dump(doc, f)
     return path
+
+
+def flush_query() -> Optional[str]:
+    """Query-end flush: rewrite the armed trace file with everything
+    buffered so far, KEEPING the buffer — every query re-arms the same
+    consolidated writer, and the file survives a process kill between
+    queries (reference: the native executor's per-query chrome re-arming)."""
+    return flush(keep=True)
 
 
 def disable() -> None:
@@ -93,6 +196,7 @@ def disable() -> None:
     with _lock:
         _path = None
         _events.clear()
+        _tids.clear()
 
 
 @contextmanager

@@ -1,0 +1,32 @@
+"""DTL006 fixture: a physical op whose custom execute() buffers its whole
+input (a blocking phase) without opening a profiler span and without
+delegating to _map_execute — an attribution blind spot."""
+
+
+class BlindBreakerOp:
+    def __init__(self, children, schema, num_partitions):
+        self.children = children
+        self.schema = schema
+        self.num_partitions = num_partitions
+
+    def execute(self, inputs, ctx):
+        parts = [p for p in inputs[0]]  # pipeline breaker, unprofiled
+        for p in parts:
+            yield p
+
+
+class CoveredOp:
+    """Covered: wraps its blocking phase in a profiler span."""
+
+    def execute(self, inputs, ctx):
+        with ctx.stats.profiler.span("covered.gather", kind="phase"):
+            parts = [p for p in inputs[0]]
+        for p in parts:
+            yield p
+
+
+class DelegatingOp:
+    """Covered: the driver instruments _map_execute streams."""
+
+    def execute(self, inputs, ctx):
+        return self._map_execute(inputs, ctx)

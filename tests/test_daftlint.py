@@ -30,6 +30,7 @@ FIXTURE_MATRIX = {
                                  "DTL003"),
     "bad_fault_sites.py": ("daft_tpu/_fixture_bad_sites.py", "DTL004"),
     "bad_error_hygiene.py": ("daft_tpu/_fixture_bad_hygiene.py", "DTL005"),
+    "bad_span_coverage.py": ("daft_tpu/_fixture_bad_span.py", "DTL006"),
 }
 
 
@@ -48,9 +49,10 @@ def _copied_tree(tmp_path):
 # the engine over the real tree
 # ---------------------------------------------------------------------------
 
-def test_registry_has_five_rules():
+def test_registry_has_six_rules():
     codes = [r.code for r in ALL_RULES]
-    assert codes == ["DTL001", "DTL002", "DTL003", "DTL004", "DTL005"]
+    assert codes == ["DTL001", "DTL002", "DTL003", "DTL004", "DTL005",
+                     "DTL006"]
     assert all(r.name and r.description for r in ALL_RULES)
 
 
@@ -248,7 +250,7 @@ def _check_schema(doc):
     assert doc["version"] == 1 and doc["tool"] == "daftlint"
     assert os.path.isabs(doc["root"])
     assert [r["code"] for r in doc["rules"]] == [
-        "DTL001", "DTL002", "DTL003", "DTL004", "DTL005"]
+        "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006"]
     for r in doc["rules"]:
         assert set(r) == {"code", "name", "description"}
     counts = doc["counts"]
@@ -290,7 +292,8 @@ def test_cli_list_rules():
         [sys.executable, "-m", "tools.daftlint", "--list-rules"],
         cwd=_ROOT, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0
-    for code in ("DTL001", "DTL002", "DTL003", "DTL004", "DTL005"):
+    for code in ("DTL001", "DTL002", "DTL003", "DTL004", "DTL005",
+                 "DTL006"):
         assert code in proc.stdout
 
 

@@ -19,13 +19,28 @@ def _load_bench():
 def test_spill_rung_engages_and_holds_parity():
     bench = _load_bench()
     out = {}
-    bench._parquet_spill_rung(out, 0.1, rtol=1e-9)
     tag = "q1_sf0.1_parquet"
-    assert f"{tag}_error" not in out, out
-    assert out[f"{tag}_spilled_partitions"] > 0, \
-        "proportional budget must force spill even at tiny scales"
-    assert out[f"{tag}_rows_per_sec"] > 0
-    assert out[f"{tag}_wall_s"] > 0
+    profile_path = os.path.join(REPO, f"PROFILE_{tag}.json")
+    try:
+        bench._parquet_spill_rung(out, 0.1, rtol=1e-9)
+        assert f"{tag}_error" not in out, out
+        assert out[f"{tag}_spilled_partitions"] > 0, \
+            "proportional budget must force spill even at tiny scales"
+        assert out[f"{tag}_rows_per_sec"] > 0
+        assert out[f"{tag}_wall_s"] > 0
+        # the rung saves its QueryProfile next to the BENCH snapshot and
+        # reports the critical path + top ops (PR 6)
+        assert f"{tag}_profile_error" not in out, out
+        assert out[f"{tag}_critical_path_op"]
+        assert len(out[f"{tag}_top_ops"]) >= 1
+        import json
+
+        from daft_tpu.profile import validate_profile
+
+        assert validate_profile(json.load(open(profile_path))) == []
+    finally:
+        if os.path.exists(profile_path):
+            os.remove(profile_path)  # test runs leave no repo-root artifacts
 
 
 def test_spill_rung_scale_never_skips():

@@ -5,6 +5,7 @@ from .lock_discipline import LockDisciplineRule
 from .collective_safety import CollectiveSafetyRule
 from .fault_sites import FaultSiteCoverageRule
 from .error_hygiene import ErrorHygieneRule
+from .span_coverage import SpanCoverageRule
 
 ALL_RULES = [
     JitPurityRule(),
@@ -12,10 +13,11 @@ ALL_RULES = [
     CollectiveSafetyRule(),
     FaultSiteCoverageRule(),
     ErrorHygieneRule(),
+    SpanCoverageRule(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in ALL_RULES}
 
 __all__ = ["ALL_RULES", "RULES_BY_CODE", "JitPurityRule",
            "LockDisciplineRule", "CollectiveSafetyRule",
-           "FaultSiteCoverageRule", "ErrorHygieneRule"]
+           "FaultSiteCoverageRule", "ErrorHygieneRule", "SpanCoverageRule"]

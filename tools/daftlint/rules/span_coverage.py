@@ -1,0 +1,99 @@
+"""DTL006 span coverage: physical-operator execute() entry points must be
+visible to the profiler.
+
+The structured profiler (daft_tpu/profile/) gets per-op attribution two
+ways: map-class ops route through ``self._map_execute`` (the driver's
+pull/worker wrappers open their spans), and custom ``execute`` bodies open
+phase spans around their internal blocking sections
+(``ctx.stats.profiler.span(...)``). An op that does neither executes as a
+blind spot — its fanout/build/merge work lands in whichever parent span
+happened to be open, which is exactly the attribution gap the profiler
+exists to close.
+
+This rule mirrors DTL004's registry cross-check pattern: every class named
+``*Op`` defining ``execute(self, inputs, ctx)`` (the physical-operator
+signature) must, somewhere in that method body, either
+
+- delegate to ``self._map_execute(...)`` (driver-instrumented), or
+- open a profiler span (a ``.span(...)`` / ``.begin(...)`` call on a
+  profiler object).
+
+Pre-existing uncovered ops are grandfathered via baseline.json (the
+DTL004 discipline: the backlog is visible, new blind spots fail the run).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding, Project, Rule, dotted_name
+
+# sanctioned span-opening attribute names on a call, e.g.
+# ctx.stats.profiler.span(...), prof.begin(...)
+_SPAN_ATTRS = {"span", "begin"}
+
+
+def _execute_is_covered(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        parts = name.split(".")
+        if parts[-1] == "_map_execute":
+            return True
+        if parts[-1] in _SPAN_ATTRS and len(parts) >= 2:
+            # require a profiler-ish receiver so str.span()-style helpers
+            # never count as coverage: ...profiler.span(...) or a local
+            # bound to one (prof.span / profiler.begin)
+            recv = parts[-2]
+            if recv in ("profiler", "prof") or "profiler" in parts:
+                return True
+    return False
+
+
+def _is_physical_execute(fn: ast.FunctionDef) -> bool:
+    args = [a.arg for a in fn.args.args]
+    if not (len(args) >= 3 and args[0] == "self" and args[1] == "inputs"):
+        return False
+    # skip abstract stubs (docstring + raise/pass only) — the base class
+    # contract, not an entry point
+    body = [n for n in fn.body
+            if not (isinstance(n, ast.Expr)
+                    and isinstance(n.value, ast.Constant))]
+    return not all(isinstance(n, (ast.Raise, ast.Pass)) for n in body)
+
+
+class SpanCoverageRule(Rule):
+    code = "DTL006"
+    name = "span-coverage"
+    description = ("every *Op.execute(self, inputs, ctx) entry point "
+                   "delegates to _map_execute or opens a profiler span")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for rel in project.files:
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ClassDef) or \
+                        not node.name.endswith("Op"):
+                    continue
+                for item in node.body:
+                    if not isinstance(item, ast.FunctionDef) or \
+                            item.name != "execute":
+                        continue
+                    if not _is_physical_execute(item):
+                        continue
+                    if _execute_is_covered(item):
+                        continue
+                    out.append(self.finding(
+                        rel, item.lineno,
+                        f"`{node.name}.execute` opens no profiler span — "
+                        "route through `self._map_execute` or wrap its "
+                        "blocking phases in "
+                        "`ctx.stats.profiler.span(...)`"))
+        return out

@@ -1,0 +1,87 @@
+"""profile-smoke: run a tiny query with profiling armed and validate every
+profiling surface end to end. Wired into `make lint` (and usable alone via
+`make profile-smoke`) so a schema regression in the QueryProfile artifact,
+the chrome-trace writer, or the metrics dump fails the static-gate path
+before any benchmark or downstream tool trips over it.
+
+Checks, in order:
+ 1. collect(profile=path) produces a QueryProfile that passes
+    validate_profile, with ops, a critical path, and zero orphan spans;
+ 2. the JSON artifact on disk round-trips through validate_profile;
+ 3. a chrome trace armed around the same query renders span events;
+ 4. the process metrics registry serves a non-empty Prometheus dump.
+
+Exits nonzero with a named failure on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import daft_tpu as dt
+    from daft_tpu import col, tracing
+    from daft_tpu.profile import validate_profile
+
+    dt.set_execution_config(enable_result_cache=False)
+    tmp = tempfile.mkdtemp(prefix="daft_tpu_profile_smoke_")
+    prof_path = os.path.join(tmp, "profile.json")
+    trace_path = os.path.join(tmp, "trace.json")
+
+    def query():
+        df = dt.from_pydict({"k": ["a", "b", "c"] * 200,
+                             "v": list(range(600))})
+        return (df.where(col("v") > 3).into_partitions(3)
+                .groupby("k").agg(col("v").sum().alias("s")).sort("k"))
+
+    # 1+2: QueryProfile artifact
+    q = query().collect(profile=prof_path)
+    qp = q.profile()
+    if qp is None:
+        print("profile-smoke: FAIL — collect(profile=...) built no profile")
+        return 1
+    errs = validate_profile(qp.to_dict())
+    if errs:
+        print(f"profile-smoke: FAIL — in-memory schema: {errs}")
+        return 1
+    if not qp.ops or qp.critical_path_op not in qp.ops:
+        print("profile-smoke: FAIL — empty ops/critical path")
+        return 1
+    if qp.orphan_spans:
+        print(f"profile-smoke: FAIL — {qp.orphan_spans} orphan span(s)")
+        return 1
+    errs = validate_profile(json.load(open(prof_path)))
+    if errs:
+        print(f"profile-smoke: FAIL — artifact schema: {errs}")
+        return 1
+
+    # 3: chrome trace rendered from the span tree
+    with tracing.chrome_trace(trace_path):
+        query().collect()
+    evs = json.load(open(trace_path)).get("traceEvents", [])
+    if not any(e.get("ph") == "X" and "span" in e.get("args", {})
+               for e in evs):
+        print("profile-smoke: FAIL — chrome trace has no span events")
+        return 1
+
+    # 4: metrics dump
+    text = dt.metrics_text()
+    if "daft_tpu_queries_total" not in text:
+        print("profile-smoke: FAIL — metrics dump missing queries_total")
+        return 1
+
+    print(f"profile-smoke: OK — {len(qp.ops)} op(s), "
+          f"critical path {qp.critical_path_op}, "
+          f"{len(qp.spans())} span(s), {len(evs)} trace event(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
