@@ -1,20 +1,31 @@
 # One-command local check: the same static gates tier-1 runs.
-#   make lint          - daftlint invariants (DTL001-DTL006) + bytecode-compile
+#   make lint          - daftlint invariants (DTL001-DTL007) + bytecode-compile
 #                        daft_tpu + profile smoke (QueryProfile schema gate)
+#                        + obs smoke (flight-recorder schema gate)
 #   make profile-smoke - tiny profiled query; validates the QueryProfile JSON,
 #                        chrome trace, and metrics dump end to end
+#   make obs-smoke     - flight recorder end to end: query log, health
+#                        snapshot, forced slow-query bundle, health gauges
+#   make bench-compare - diff the two newest BENCH_r*.json, flag per-metric
+#                        regressions beyond the noise threshold
 #   make test          - full tier-1 test suite (CPU jax)
 
 PY ?= python
 
-.PHONY: lint test profile-smoke
+.PHONY: lint test profile-smoke obs-smoke bench-compare
 
-lint: profile-smoke
+lint: profile-smoke obs-smoke
 	$(PY) -m tools.daftlint
 	$(PY) -m compileall -q daft_tpu
 
 profile-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.profile_smoke
+
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.obs_smoke
+
+bench-compare:
+	$(PY) -m tools.bench_compare
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

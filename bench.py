@@ -783,6 +783,10 @@ def _host_fallback(scale: float) -> dict:
     out["host_rows_per_sec"] = round(rows / t_host_q1, 1)
     out["host_vs_baseline"] = round(t_oracle_q1 / t_host_q1, 3)
     out["q6_host_vs_baseline"] = round(t_oracle_q6 / t_host_q6, 3)
+    try:  # flight-recorder steady-state cost on the q1 rung (must be noise)
+        out["q1_query_log_overhead_pct"] = _query_log_overhead_pct(s)
+    except Exception as e:
+        out["q1_query_log_error"] = f"{type(e).__name__}: {e}"[:120]
     # one profiled run per rung: the QueryProfile artifact lands next to
     # the BENCH snapshot and the headline metrics carry the critical path
     _save_rung_profile(out, "q1_host", lambda: tpch.q1(frame))
@@ -853,6 +857,29 @@ def _host_fallback(scale: float) -> dict:
     except Exception as e:
         out["sketch_exchange_error"] = f"{type(e).__name__}: {e}"[:200]
     return out
+
+
+def _query_log_overhead_pct(s: "_Setup") -> float:
+    """Interleaved best-of A/B of TPC-H Q1 with the always-on query log
+    enabled vs disabled — the flight-recorder acceptance gate's 'q1 smoke
+    A/B within noise'. Interleaving rides out the build host's drifting
+    memory bandwidth the same way the spill rung's A/B does."""
+    from daft_tpu.context import get_context
+
+    cfg = get_context().execution_config
+    prev = cfg.enable_query_log
+    walls = {True: [], False: []}
+    try:
+        for _ in range(3):
+            for flag in (False, True):
+                cfg.enable_query_log = flag
+                t0 = time.perf_counter()
+                s.run_q1()
+                walls[flag].append(time.perf_counter() - t0)
+    finally:
+        cfg.enable_query_log = prev
+    t_on, t_off = min(walls[True]), min(walls[False])
+    return round((t_on - t_off) / t_off * 100, 2)
 
 
 def _bench_env() -> dict:

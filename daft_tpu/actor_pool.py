@@ -21,12 +21,13 @@ Pools are keyed by (class, init_args, concurrency) and persist across queries
 from __future__ import annotations
 
 import atexit
-import logging
 import queue
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-logger = logging.getLogger(__name__)
+from .obs.log import current_query_id, get_logger, query_context
+
+logger = get_logger("actor_pool")
 
 _pools: Dict[Tuple, "ActorPool"] = {}
 _pools_lock = threading.Lock()
@@ -82,9 +83,12 @@ class ActorPool:
             item = self._tasks.get()
             if item is None:
                 return
-            idx, fn_args, results, errors, done = item
+            idx, fn_args, results, errors, done, qid = item
             try:
-                results[idx] = instance(*fn_args)
+                # the dispatching query's log context rides with the batch:
+                # lines emitted by the actor stay attributed
+                with query_context(qid):
+                    results[idx] = instance(*fn_args)
             except BaseException as e:  # noqa: BLE001
                 errors[idx] = e
             finally:
@@ -96,8 +100,9 @@ class ActorPool:
         results: List[Any] = [None] * k
         errors: List[Optional[BaseException]] = [None] * k
         done = threading.Semaphore(0)
+        qid = current_query_id()
         for i, b in enumerate(batches):
-            self._tasks.put((i, b, results, errors, done))
+            self._tasks.put((i, b, results, errors, done, qid))
         for _ in range(k):
             done.acquire()
         for e in errors:
@@ -120,10 +125,8 @@ class ActorPool:
             # silent leak pins the actor instance (weights!) until exit
             with _leak_lock:
                 _leaked_threads += leaked
-            logger.warning(
-                "ActorPool(%s): %d worker thread(s) still running after the "
-                "%.1fs join timeout; leaking them (daemon threads exit with "
-                "the process)", self._cls.__name__, leaked, join_timeout_s)
+            logger.warning("actor_pool_leak", actor=self._cls.__name__,
+                           leaked=leaked, join_timeout_s=join_timeout_s)
 
 
 def get_pool(cls: type, init_args: Optional[tuple], concurrency: int) -> ActorPool:
@@ -134,6 +137,12 @@ def get_pool(cls: type, init_args: Optional[tuple], concurrency: int) -> ActorPo
             pool = ActorPool(cls, init_args, concurrency)
             _pools[key] = pool
         return pool
+
+
+def pool_count() -> int:
+    """Live actor pools (the health snapshot's view)."""
+    with _pools_lock:
+        return len(_pools)
 
 
 def shutdown_all() -> None:

@@ -304,10 +304,41 @@ def last_profile():
 
 def metrics_text() -> str:
     """Prometheus-text dump of the process-level metrics registry
-    (daft_tpu/profile/metrics.py) — the serving layer's scrape surface."""
+    (daft_tpu/profile/metrics.py) — the serving layer's scrape surface.
+    Health/ledger gauges are refreshed first, so the dump always carries
+    current memory pressure and breaker state."""
+    from .obs.health import refresh_health_gauges
     from .profile import METRICS
 
+    refresh_health_gauges()
     return METRICS.render_prometheus()
+
+
+def query_log(limit: Optional[int] = None) -> List[dict]:
+    """The flight recorder's QueryRecords (oldest first; newest ``limit``
+    when given). One validated record per completed plan execution —
+    success, error, timeout, cancel — appended always-on by the engine
+    (``ExecutionConfig.enable_query_log``)."""
+    from .obs.querylog import QUERY_LOG
+
+    return QUERY_LOG.records(limit)
+
+
+def health() -> dict:
+    """One validated engine-health snapshot: breaker states, MemoryLedger
+    balances, scheduler in-flight window, actor-pool/leaked-thread counts,
+    query-log depth. Mirrored as gauges into ``metrics_text()``."""
+    from .obs.health import engine_health
+
+    return engine_health()
+
+
+def engine_log_tail(n: int = 200, query_id: Optional[str] = None) -> List[dict]:
+    """The newest structured engine-log records (daft_tpu/obs/log.py),
+    optionally filtered to one query id."""
+    from .obs.log import tail
+
+    return tail(n, query_id=query_id)
 
 
 __all__ = [
@@ -344,6 +375,9 @@ __all__ = [
     "get_context",
     "last_profile",
     "metrics_text",
+    "query_log",
+    "health",
+    "engine_log_tail",
     "set_execution_config",
     "set_planning_config",
     "set_runner_native",

@@ -134,6 +134,26 @@ class ExecutionConfig:
     # time. Off by default — the disarmed hot path is a single flag check
     # (guard-tested zero-allocation), so q1 wall is unaffected.
     enable_profiling: bool = False
+    # always-on flight recorder (daft_tpu/obs/): every completed plan
+    # execution appends a QueryRecord to the bounded process query log
+    # (dt.query_log() / df.last_query_record()). Built only from state the
+    # stats stack already collects — one dict build per query, guard-tested
+    # like the DISARMED profiler — so it stays on even in production.
+    # False disables ONLY the ring/last_query_record; the diagnostics
+    # capture below keeps working.
+    enable_query_log: bool = True
+    query_log_depth: int = 256
+    # slow/failed-query auto-capture: a query slower than this (seconds)
+    # counts as slow — it arms the profiler for the NEXT run of the same
+    # plan fingerprint, and (with diagnostics_dir set) dumps a diagnostics
+    # bundle. None disables the slow path; errored/deadline-killed queries
+    # always capture when diagnostics_dir is set.
+    slow_query_threshold_s: Optional[float] = None
+    # where diagnostics bundles land (record.json + stats.txt + profile
+    # when armed + log/trace tails); None = no bundles. Retention is
+    # bounded: only the newest diagnostics_keep_last bundles survive.
+    diagnostics_dir: Optional[str] = None
+    diagnostics_keep_last: int = 20
     # device circuit breaker (execution.DeviceHealth): after this many
     # CONSECUTIVE device-kernel failures the breaker opens and every
     # device-eligible partition routes straight to the host path (one trip,

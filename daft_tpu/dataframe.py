@@ -118,43 +118,10 @@ class DataFrame:
         Reference: the native executor's explain-analyze output
         (DAFT_DEV_ENABLE_EXPLAIN_ANALYZE, run.rs:106-115) backed by per-node
         RuntimeStatsContext counters (runtime_stats.rs:16-27)."""
+        from .obs.capture import render_runtime_stats
+
         self.collect(profile=True)
-        snap = self.stats.snapshot()
-        rows, wall = snap["op_rows"], snap["op_wall_ns"]
-        tput = self.stats.op_throughput()
-        names = sorted(set(rows) | set(wall), key=lambda k: -wall.get(k, 0))
-        w = max([len(n) for n in names] + [8])
-        lines = ["== Runtime Stats ==",
-                 f"{'operator':<{w}}  {'rows out':>12}  {'wall ms':>10}"
-                 f"  {'rows/s':>12}  {'MB/s':>8}"]
-        for n in names:
-            t = tput.get(n, {})
-            lines.append(
-                f"{n:<{w}}  {rows.get(n, 0):>12,}  {wall.get(n, 0) / 1e6:>10.2f}"
-                f"  {t.get('rows_per_sec', 0.0):>12,.0f}"
-                f"  {t.get('bytes_per_sec', 0.0) / 1e6:>8.1f}")
-        counters = snap["counters"]
-        io = self.stats.io_breakdown()
-        if io["io_wait_ms"] or io["prefetch_hits"] or io["prefetch_misses"] \
-                or io["spill_write_mbps"] or io["spill_read_mbps"]:
-            lines.append("")
-            lines.append(
-                f"io: wait {io['io_wait_share'] * 100:.1f}% of op wall "
-                f"({io['io_wait_ms']:.1f} ms) · prefetch "
-                f"{io['prefetch_hits']} hit / {io['prefetch_misses']} miss"
-                + (f" / {io['prefetch_throttled']} throttled"
-                   if io["prefetch_throttled"] else "")
-                + f" · spill write {io['spill_write_mbps']:.1f} MB/s"
-                f" · read {io['spill_read_mbps']:.1f} MB/s")
-        if counters.get("fused_chains"):
-            lines.append("")
-            lines.append(
-                f"fusion: {counters['fused_chains']} FusedMap chain(s), "
-                f"{counters.get('fused_ops_eliminated', 0)} op(s) eliminated"
-                f", {counters.get('cse_hits', 0)} cse hit(s)")
-        if counters:
-            lines.append("")
-            lines.append("counters: " + ", ".join(f"{k}={v}" for k, v in sorted(counters.items())))
+        lines = [render_runtime_stats(self.stats)]
         if self._profile is not None and self._profile.ops:
             lines.append("")
             lines.append(self._profile.render_timeline())
@@ -525,6 +492,13 @@ class DataFrame:
     def profile(self):
         """The QueryProfile recorded by a profiled collect(), or None."""
         return self._profile
+
+    def last_query_record(self):
+        """The flight recorder's QueryRecord for this DataFrame's most
+        recent plan execution (None before any execution, or when the
+        result was served from the plan cache). The same record is in
+        ``daft_tpu.query_log()``."""
+        return self.stats.last_record
 
     def iter_partitions(self) -> Iterator[MicroPartition]:
         if self._result is not None:

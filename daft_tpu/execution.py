@@ -14,6 +14,7 @@ pipeline_instruction execution.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Dict, Iterator, List, Optional
@@ -166,6 +167,9 @@ class RuntimeStats:
         # the shared no-op profiler — collect(profile=...) or an armed
         # chrome trace swaps in a live one before execution starts
         self.profiler = DISARMED
+        # the QueryRecord of this handle's most recent plan execution
+        # (set by execute_plan's completion hook; df.last_query_record())
+        self.last_record = None
 
     def cancel(self) -> None:
         """Stop the query this handle is attached to at the next partition
@@ -335,8 +339,13 @@ class DeviceHealth:
 
     def _emit(self, stats: Optional["RuntimeStats"], transition: str) -> None:
         """Breaker state transitions are typed events on the profile
-        timeline (kind `breaker`), so a trace shows exactly when the
-        device path opened/recovered relative to the pipeline."""
+        timeline (kind `breaker`) AND structured log lines, so both a trace
+        and the always-on flight recorder show exactly when the device path
+        opened/recovered relative to the pipeline."""
+        from .obs.log import get_logger
+
+        get_logger("breaker").info(f"breaker_{transition}", breaker=self.kind,
+                                   state=self._state)
         if stats is not None and stats.profiler.armed:
             stats.profiler.event("breaker", kind=self.kind,
                                  transition=transition, state=self._state)
@@ -415,8 +424,12 @@ class ExecutionContext:
             self._spill_scope.raise_async_errors()
         if self.deadline is not None and time.monotonic() > self.deadline:
             from .errors import DaftTimeoutError
+            from .obs.log import get_logger
 
             self.stats.bump("deadline_expired")
+            get_logger("scheduler").warning(
+                "deadline_expired",
+                timeout_s=self.cfg.execution_timeout_s)
             raise DaftTimeoutError(
                 f"query exceeded execution_timeout_s="
                 f"{self.cfg.execution_timeout_s}",
@@ -1097,6 +1110,56 @@ class ExecutionContext:
         return finish
 
 
+_QUERY_SEQ = itertools.count(1)
+_DONE = object()  # stream-exhausted sentinel for the per-pull context loop
+
+
+def _classify_outcome(e: BaseException) -> str:
+    from .errors import DaftTimeoutError
+
+    if isinstance(e, DaftTimeoutError):
+        return "timeout"
+    if isinstance(e, QueryCancelledError):
+        return "cancelled"
+    return "error"
+
+
+def _record_query(root: PhysicalOp, ctx: ExecutionContext, query_id: str,
+                  fingerprint: str, plan_ops: Dict[str, int], wall_ns: int,
+                  outcome: str, error, rows_emitted: int) -> None:
+    """Completion hook: append the QueryRecord (every outcome, including
+    the error/timeout paths — this runs in execute_plan's ``finally``) and
+    hand it to the slow/failed-query auto-capture. ``enable_query_log``
+    gates only the ring (and ``last_query_record``); the diagnostics
+    capture contract — errored/deadline-killed queries always bundle when
+    ``diagnostics_dir`` is set — survives a disabled log. Observability
+    must never fail the query: any defect here degrades to an error log."""
+    cfg = ctx.cfg
+    want_log = getattr(cfg, "enable_query_log", True)
+    want_capture = (getattr(cfg, "diagnostics_dir", None)
+                    or getattr(cfg, "slow_query_threshold_s", None)
+                    is not None)
+    if not (want_log or want_capture):
+        return
+    try:
+        from .obs import capture as obs_capture
+        from .obs.querylog import QUERY_LOG, build_record
+
+        prof = ctx.stats.profiler
+        rec = build_record(query_id, fingerprint, plan_ops, cfg,
+                           ctx.stats, wall_ns, outcome, error=error,
+                           profiled=prof.armed, rows_emitted=rows_emitted)
+        if want_log:
+            QUERY_LOG.resize(cfg.query_log_depth)
+            QUERY_LOG.append(rec)
+            ctx.stats.last_record = rec
+        obs_capture.maybe_capture(rec, cfg, ctx.stats, prof)
+    except Exception as e:
+        from .obs.log import get_logger
+
+        get_logger("obs").error("query_record_failed", error=repr(e))
+
+
 def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
                  trace: bool = True) -> Iterator[MicroPartition]:
     """Wire up the generator tree and return the root partition stream.
@@ -1106,13 +1169,35 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
     is armed — with profiler spans. A chrome trace armed without an armed
     profiler (tracing.chrome_trace / DAFT_TPU_CHROME_TRACE) arms one here:
     the chrome output is rendered FROM the span tree at query end (one
-    consolidated writer, re-armed per query)."""
+    consolidated writer, re-armed per query) — and so does the slow-query
+    auto-capture when a previous run of this plan fingerprint crossed
+    ``cfg.slow_query_threshold_s``.
+
+    The flight recorder (daft_tpu/obs/) hooks both ends: the query id is
+    bound as structured-log context for the query's lifetime, and EVERY
+    completion — success, error, deadline kill, cancel, abandoned stream —
+    appends a QueryRecord to the process query log."""
     from . import tracing
+    from .obs import log as obs_log
+    from .obs.querylog import plan_signature
 
-    if not ctx.stats.profiler.armed and tracing.active():
-        from .profile.spans import Profiler
+    fingerprint, plan_ops = plan_signature(root)
+    prof = ctx.stats.profiler
+    if prof.armed:
+        query_id = prof.query_id
+    else:
+        query_id = f"q-{next(_QUERY_SEQ)}"
+        arm = tracing.active()
+        if not arm:
+            # slow-query auto-arm is part of the capture contract, which
+            # survives a disabled query log
+            from .obs import capture as obs_capture
 
-        ctx.stats.profiler = Profiler(query_id=f"q-{id(ctx):x}")
+            arm = obs_capture.take_arm(fingerprint)
+        if arm:
+            from .profile.spans import Profiler
+
+            ctx.stats.profiler = Profiler(query_id=query_id)
     parallel = ctx.num_workers > 1
 
     def build(op: PhysicalOp) -> Iterator[MicroPartition]:
@@ -1136,23 +1221,54 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
 
     def rooted():
         t0 = time.perf_counter_ns()
+        outcome, error = "ok", None
+        rows_out = 0
+        it = iter(built)
         try:
-            yield from built
+            # the query id binds per PULL, never across a yield: two lazily
+            # interleaved streams on one thread would otherwise cross-
+            # attribute (and unbind) each other's log context
+            while True:
+                with obs_log.query_context(query_id):
+                    part = next(it, _DONE)
+                if part is _DONE:
+                    break
+                # exact root output count for the QueryRecord (the op-name
+                # rollup can't distinguish a root op from same-class
+                # upstream ops); metadata-only, never forces a load
+                n = part.num_rows_or_none()
+                if n:
+                    rows_out += n
+                yield part
+        except GeneratorExit:
+            # consumer closed the stream early (limit/abandoned iterator):
+            # not a failure, but the record says the plan never finished
+            outcome = "abandoned"
+            raise
+        except BaseException as e:
+            outcome, error = _classify_outcome(e), e
+            raise
         finally:
-            ctx.shutdown_pool()
-            ctx.finish_query()
-            prof = ctx.stats.profiler
-            prof.finish()
-            if tracing.active() and prof.armed:
-                # span tree -> chrome events, then rewrite the armed trace
-                # file (buffer kept: the next query appends to the same
-                # consolidated writer)
-                tracing.add_span_events(prof)
-                tracing.flush_query()
-            from .profile.metrics import record_query_metrics
+            # teardown (and the record/capture hooks it runs) still logs
+            # under this query's id
+            with obs_log.query_context(query_id):
+                ctx.shutdown_pool()
+                ctx.finish_query()
+                prof = ctx.stats.profiler
+                prof.finish()
+                if tracing.active() and prof.armed:
+                    # span tree -> chrome events, then rewrite the armed
+                    # trace file (buffer kept: the next query appends to
+                    # the same consolidated writer)
+                    tracing.add_span_events(prof)
+                    tracing.flush_query()
+                from .profile.metrics import record_query_metrics
 
-            record_query_metrics(ctx.stats, time.perf_counter_ns() - t0)
-            tracing.query_finished()
+                wall_ns = time.perf_counter_ns() - t0
+                record_query_metrics(ctx.stats, wall_ns)
+                _record_query(root, ctx, query_id, fingerprint, plan_ops,
+                              wall_ns, outcome, error, rows_out)
+                tracing.query_finished()
 
     return rooted()
 

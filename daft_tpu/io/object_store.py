@@ -21,7 +21,6 @@ import hashlib
 import hmac
 import http.client
 import io
-import logging
 import os
 import random
 import threading
@@ -32,9 +31,10 @@ from typing import Dict, List, Optional, Tuple
 
 from ..errors import (DaftIOError, DaftNotFoundError, DaftTransientError,
                       DaftValueError)
+from ..obs.log import get_logger
 from .scan import IO_STATS
 
-logger = logging.getLogger(__name__)
+logger = get_logger("object_store")
 
 
 @dataclass
@@ -481,12 +481,12 @@ class S3Source(ObjectSource):
                 _http_request(aurl, method="DELETE",
                               headers=self._headers("DELETE", aurl),
                               timeout=self.cfg.timeout)
-            except Exception:
+            except Exception as abort_err:
                 # the original upload failure is what propagates; a failed
                 # abort only leaves staged parts for the store's GC
-                logger.warning("AbortMultipartUpload %s failed; staged "
-                               "parts await bucket lifecycle GC", path,
-                               exc_info=True)
+                logger.warning("multipart_abort_failed", path=path,
+                               error=repr(abort_err),
+                               note="staged parts await bucket lifecycle GC")
             raise
 
     def delete(self, path):

@@ -41,6 +41,10 @@ import time
 import weakref
 from typing import Any, List, Optional
 
+from ..obs.log import current_query_id, get_logger, query_context
+
+logger = get_logger("prefetch")
+
 _IDLE, _SUBMITTED, _TAKEN, _ABANDONED = "idle", "submitted", "taken", "abandoned"
 
 
@@ -114,11 +118,13 @@ class ScanPrefetcher:
                     return  # budget headroom gone: stop, retry on next read
                 prof = self._stats.profiler
                 token = prof.capture() if prof.armed else None
+                qid = current_query_id()
                 try:
-                    fut = self._ctx.pool().submit(self._fetch, j, token)
+                    fut = self._ctx.pool().submit(self._fetch, j, token, qid)
                 except RuntimeError:
                     # pool already shut down (query finished; a cached
                     # partition is being read late): degrade to sync reads
+                    logger.debug("prefetch_degraded_sync", task=j)
                     self._closed = True
                     return
                 s.state = _SUBMITTED
@@ -128,14 +134,17 @@ class ScanPrefetcher:
                 self._ledger.prefetch_started(s.est_bytes)
                 self._stats.bump("prefetch_submitted")
 
-    def _fetch(self, idx: int, span_token=None) -> List[Any]:
+    def _fetch(self, idx: int, span_token=None, qid=None) -> List[Any]:
         """Background fetch body (runs on a pool worker). ``span_token`` is
-        the submitting thread's captured span, so the fetch interval is
+        the submitting thread's captured span and ``qid`` its query-log
+        context, so the fetch interval — and any log line it emits — is
         attributed to the scan read that triggered the readahead."""
         from .. import faults
 
         prof = self._stats.profiler
         sp = None
+        qctx = query_context(qid)
+        qctx.__enter__()
         if span_token is not None and prof.armed:
             act = prof.activate(span_token)
             act.__enter__()
@@ -150,6 +159,7 @@ class ScanPrefetcher:
             if sp is not None:
                 prof.end(sp)
                 act.__exit__(None, None, None)
+            qctx.__exit__(None, None, None)
 
     # ------------------------------------------------------------ consumption
     def _release_locked(self, s: _Slot) -> None:

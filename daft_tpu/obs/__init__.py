@@ -1,0 +1,33 @@
+"""Always-on flight recorder: query log, slow/failed-query auto-capture,
+engine health snapshot, and structured JSON-lines logging.
+
+PR 6's profiler is opt-in and per-query; this package is the *always-on*
+counterpart a serving runtime needs: a bounded record of every query that
+ran (``querylog``), automatic diagnostics bundles for the slow and failed
+ones (``capture``), a one-call health view of breakers/ledger/pools
+(``health``), and an engine-wide structured logger whose records carry
+query_id across threads (``log``). Everything here is built from data the
+stats stack already collects — the steady-state cost is guard-tested the
+same way the DISARMED profiler is.
+"""
+
+from .log import EngineLogger, current_query_id, get_logger, query_context
+from .querylog import (QUERY_LOG, RECORD_SCHEMA_VERSION, QueryLog,
+                       build_record, plan_signature, validate_record)
+from .health import engine_health, refresh_health_gauges, validate_health
+
+__all__ = [
+    "EngineLogger",
+    "get_logger",
+    "current_query_id",
+    "query_context",
+    "QueryLog",
+    "QUERY_LOG",
+    "RECORD_SCHEMA_VERSION",
+    "build_record",
+    "plan_signature",
+    "validate_record",
+    "engine_health",
+    "refresh_health_gauges",
+    "validate_health",
+]

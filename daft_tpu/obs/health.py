@@ -1,0 +1,204 @@
+# daftlint: migrated
+"""Engine health snapshot: breakers, ledger, scheduler, pools, query log.
+
+``daft_tpu.health()`` returns one validated JSON-able dict answering "is
+the engine healthy right now?" without running a query: per-kind circuit
+breaker states (the runner registers each query's breakers here), the
+MemoryLedger balances, the scheduler's in-flight task window, actor-pool
+and leaked-thread counts, query-log depth and last outcome, and the
+structured-log ring status.
+
+The same snapshot is mirrored into the process metrics registry as gauges
+(``refresh_health_gauges``) so ``daft_tpu.metrics_text()`` exports it —
+the serving layer scrapes one endpoint for both throughput counters and
+health state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional
+
+__all__ = ["HEALTH_SCHEMA_VERSION", "engine_health", "register_breaker",
+           "breaker_states", "refresh_health_gauges", "validate_health"]
+
+HEALTH_SCHEMA_VERSION = 1
+
+_lock = threading.Lock()
+# breaker kind -> weakref to the most recently registered DeviceHealth of
+# that kind (per-query objects; a dead ref reads as "idle")
+_breakers: Dict[str, "weakref.ref"] = {}
+
+# breaker state -> gauge value (0 healthy .. 2 open)
+_BREAKER_GAUGE = {"closed": 0.0, "half_open": 1.0, "open": 2.0, "idle": 0.0}
+
+
+def register_breaker(breaker) -> None:
+    """Track the latest breaker per kind (called by the runner once per
+    query; weakly held so health never pins a finished query's state)."""
+    with _lock:
+        _breakers[breaker.kind] = weakref.ref(breaker)
+
+
+def breaker_states() -> Dict[str, str]:
+    with _lock:
+        items = list(_breakers.items())
+    out: Dict[str, str] = {}
+    for kind, ref in items:
+        b = ref()
+        out[kind] = b.state if b is not None else "idle"
+    return out
+
+
+def engine_health() -> dict:
+    """One validated snapshot of engine-wide state (see module docstring).
+    The metrics-registry mirror is maintained separately by
+    ``refresh_health_gauges`` (called at every query end and at every
+    ``metrics_text()`` scrape), so this stays a single pass over the
+    sources."""
+    from . import log as obs_log
+    from .querylog import QUERY_LOG
+
+    try:
+        from ..spill import MEMORY_LEDGER
+
+        ledger = MEMORY_LEDGER.snapshot()
+    except Exception:
+        ledger = {}
+    try:
+        from ..actor_pool import leaked_thread_count, pool_count
+
+        pools = {"actor_pools": pool_count(),
+                 "leaked_threads": leaked_thread_count()}
+    except Exception:
+        pools = {"actor_pools": 0, "leaked_threads": 0}
+    try:
+        from ..scheduler import inflight_tasks
+
+        sched = {"inflight_tasks": inflight_tasks()}
+    except Exception:
+        sched = {"inflight_tasks": 0}
+    last = QUERY_LOG.last()
+    from ..profile.metrics import METRICS
+
+    snap = METRICS.snapshot()
+    data = {
+        "schema_version": HEALTH_SCHEMA_VERSION,
+        "unix_time": round(time.time(), 3),
+        "breakers": breaker_states(),
+        "ledger": ledger,
+        "scheduler": sched,
+        "pools": pools,
+        "query_log": {
+            "depth": len(QUERY_LOG),
+            "capacity": QUERY_LOG.capacity,
+            "total": QUERY_LOG.total,
+            "last_outcome": last["outcome"] if last else None,
+        },
+        "log": {
+            "records": obs_log.ring_size(),
+            "dropped": obs_log.dropped_records(),
+        },
+        "queries_total": int(snap.get("daft_tpu_queries_total", 0)),
+    }
+    return data
+
+
+def refresh_health_gauges(registry=None) -> None:
+    """Mirror the health snapshot as gauges in the metrics registry (also
+    folds the MemoryLedger balances — the memory-pressure view
+    ``metrics_text()`` exposes without any profiled run)."""
+    from ..profile.metrics import METRICS
+
+    reg = registry if registry is not None else METRICS
+    try:
+        from ..spill import MEMORY_LEDGER
+
+        led = MEMORY_LEDGER.snapshot()
+    except Exception:
+        led = None
+    if led is not None:
+        reg.gauge("daft_tpu_memory_ledger_bytes",
+                  "engine-held partition bytes").set(led["current"])
+        reg.gauge("daft_tpu_memory_ledger_high_water_bytes",
+                  "peak engine-held partition bytes").set(led["high_water"])
+        reg.gauge("daft_tpu_memory_ledger_prefetch_inflight_bytes",
+                  "scan-prefetch bytes in flight").set(
+            led["prefetch_inflight"])
+        reg.gauge("daft_tpu_memory_ledger_async_spill_inflight_bytes",
+                  "async-spill bytes awaiting writeback").set(
+            led["async_spill_inflight"])
+        reg.gauge("daft_tpu_memory_ledger_negative_releases",
+                  "double-release clamps (engine bugs)").set(
+            led["negative_releases"])
+    for kind, st in breaker_states().items():
+        reg.gauge(f"daft_tpu_{kind}_breaker_state",
+                  "circuit breaker: 0 closed, 1 half-open, 2 open").set(
+            _BREAKER_GAUGE.get(st, 0.0))
+    try:
+        from ..scheduler import inflight_tasks
+
+        inflight = inflight_tasks()
+    except Exception:
+        inflight = 0  # scheduler mid-teardown: report an empty window
+    reg.gauge("daft_tpu_scheduler_inflight_tasks",
+              "partition tasks dispatched, not yet finished").set(inflight)
+    try:
+        from ..actor_pool import leaked_thread_count, pool_count
+
+        pools, leaked = pool_count(), leaked_thread_count()
+    except Exception:
+        pools, leaked = 0, 0  # actor layer mid-teardown
+    reg.gauge("daft_tpu_actor_pools", "live actor pools").set(pools)
+    reg.gauge("daft_tpu_leaked_threads",
+              "actor workers that outlived shutdown").set(leaked)
+    from .querylog import QUERY_LOG
+
+    reg.gauge("daft_tpu_query_log_depth",
+              "QueryRecords currently held").set(len(QUERY_LOG))
+
+
+_TOP_KEYS = {
+    "schema_version": int,
+    "unix_time": (int, float),
+    "breakers": dict,
+    "ledger": dict,
+    "scheduler": dict,
+    "pools": dict,
+    "query_log": dict,
+    "log": dict,
+    "queries_total": int,
+}
+
+_BREAKER_STATES = ("closed", "half_open", "open", "idle")
+
+
+def validate_health(d: dict) -> List[str]:
+    """Schema check for a health snapshot — empty list means valid."""
+    errs: List[str] = []
+    if not isinstance(d, dict):
+        return ["health is not an object"]
+    for key, typ in _TOP_KEYS.items():
+        if key not in d:
+            errs.append(f"missing key {key!r}")
+        elif not isinstance(d[key], typ):
+            errs.append(f"{key!r} has type {type(d[key]).__name__}")
+    if errs:
+        return errs
+    if d["schema_version"] != HEALTH_SCHEMA_VERSION:
+        errs.append(f"schema_version {d['schema_version']} != "
+                    f"{HEALTH_SCHEMA_VERSION}")
+    for kind, st in d["breakers"].items():
+        if st not in _BREAKER_STATES:
+            errs.append(f"breakers[{kind!r}] has unknown state {st!r}")
+    for k in ("depth", "capacity", "total"):
+        if not isinstance(d["query_log"].get(k), int):
+            errs.append(f"query_log.{k} missing or non-int")
+    if not isinstance(d["scheduler"].get("inflight_tasks"), int):
+        errs.append("scheduler.inflight_tasks missing or non-int")
+    for k in ("actor_pools", "leaked_threads"):
+        if not isinstance(d["pools"].get(k), int):
+            errs.append(f"pools.{k} missing or non-int")
+    return errs

@@ -65,13 +65,14 @@ def init_distributed(coordinator: Optional[str] = None,
         try:
             jax.distributed.initialize()
         except Exception as e:
-            import warnings
+            from ..obs.log import get_logger
 
             _BOOTSTRAP_FAILED[0] = True
-            warnings.warn(
-                f"zero-config jax.distributed bootstrap failed ({e!r}); "
-                "proceeding single-process — pass coordinator/num_processes/"
-                "process_id explicitly for multi-host execution")
+            get_logger("multihost").warning(
+                "distributed_bootstrap_failed", error=repr(e),
+                note="proceeding single-process — pass coordinator/"
+                     "num_processes/process_id explicitly for multi-host "
+                     "execution")
             return False
         _INITIALIZED[0] = True
         return True

@@ -326,16 +326,28 @@ class LimitOp(PhysicalOp):
 
 
 class ExplodeOp(PhysicalOp):
+    """Map-class since the DTL006 burn-down: per-partition explode runs
+    through the instrumented _map_execute driver (driver/worker op spans,
+    morsel parallelism) instead of a blind streaming loop."""
+
     def __init__(self, child: PhysicalOp, exprs: List[Expression], schema: Schema):
         super().__init__([child], schema, child.num_partitions)
         self.exprs = exprs
 
+    def map_partition(self, part, ctx):
+        return part.explode(self.exprs)
+
+    def _map_exprs(self):
+        return list(self.exprs)
+
     def execute(self, inputs, ctx) -> PartStream:
-        for part in inputs[0]:
-            yield part.explode(self.exprs)
+        return self._map_execute(inputs, ctx)
 
 
 class UnpivotOp(PhysicalOp):
+    """Map-class since the DTL006 burn-down (same driver instrumentation
+    as ExplodeOp)."""
+
     def __init__(self, child: PhysicalOp, ids, values, variable_name, value_name, schema: Schema):
         super().__init__([child], schema, child.num_partitions)
         self.ids = ids
@@ -343,9 +355,15 @@ class UnpivotOp(PhysicalOp):
         self.variable_name = variable_name
         self.value_name = value_name
 
+    def map_partition(self, part, ctx):
+        return part.unpivot(self.ids, self.values, self.variable_name,
+                            self.value_name)
+
+    def _map_exprs(self):
+        return list(self.ids) + list(self.values)
+
     def execute(self, inputs, ctx) -> PartStream:
-        for part in inputs[0]:
-            yield part.unpivot(self.ids, self.values, self.variable_name, self.value_name)
+        return self._map_execute(inputs, ctx)
 
 
 class SampleOp(PhysicalOp):
@@ -709,9 +727,16 @@ class SortOp(PhysicalOp):
         self.nulls_first = nulls_first
 
     def execute(self, inputs, ctx) -> PartStream:
+        # sequential by design: the per-partition sort may route through
+        # the device argsort, and device compute serializes on one chip.
+        # The kernel interval gets its own phase span (DTL006) so profiles
+        # split sort time from pull overhead.
+        prof = ctx.stats.profiler
         for part in inputs[0]:
-            yield ctx.eval_sort(part, self.sort_by, self.descending,
-                                self.nulls_first)
+            with prof.span("sort.partition", kind="phase"):
+                out = ctx.eval_sort(part, self.sort_by, self.descending,
+                                    self.nulls_first)
+            yield out
 
     def describe(self):
         return "Sort: " + ", ".join(e._node.display() for e in self.sort_by)
@@ -842,8 +867,13 @@ class DistinctOp(PhysicalOp):
         self.subset = subset
 
     def execute(self, inputs, ctx) -> PartStream:
+        # sequential like SortOp (the distinct may use the device group-
+        # codes kernel); the kernel interval is a phase span (DTL006)
+        prof = ctx.stats.profiler
         for part in inputs[0]:
-            yield ctx.eval_distinct(part, self.subset)
+            with prof.span("distinct.partition", kind="phase"):
+                out = ctx.eval_distinct(part, self.subset)
+            yield out
 
 
 class PivotOp(PhysicalOp):
@@ -857,9 +887,12 @@ class PivotOp(PhysicalOp):
         self.names = names
 
     def execute(self, inputs, ctx) -> PartStream:
-        parts = [p for p in inputs[0]]
-        part = MicroPartition.concat(parts) if len(parts) > 1 else (
-            parts[0] if parts else MicroPartition.empty(self.children[0].schema))
+        # the gather is this op's blocking phase (DTL006): it buffers the
+        # whole input before the single-partition pivot can run
+        with ctx.stats.profiler.span("pivot.gather", kind="phase"):
+            parts = [p for p in inputs[0]]
+            part = MicroPartition.concat(parts) if len(parts) > 1 else (
+                parts[0] if parts else MicroPartition.empty(self.children[0].schema))
         out = part.pivot(self.groupby, self.pivot_col, self.value_col, self.names, self.agg_fn)
         yield out.cast_to_schema(self.schema)
 

@@ -247,12 +247,12 @@ def record_query_metrics(stats, wall_ns: int,
     folded["__rows"] = rows_total
     stats._metrics_folded = folded
     try:
-        from ..spill import MEMORY_LEDGER
+        # health + ledger gauges (breaker state, ledger balances incl.
+        # prefetch/async-spill in-flight, scheduler window, pool counts,
+        # query-log depth) refresh at every query end — metrics_text()
+        # carries memory pressure without any profiled run
+        from ..obs.health import refresh_health_gauges
 
-        reg.gauge("daft_tpu_memory_ledger_bytes",
-                  "engine-held partition bytes").set(MEMORY_LEDGER.current)
-        reg.gauge("daft_tpu_memory_ledger_high_water_bytes",
-                  "peak engine-held partition bytes").set(
-            MEMORY_LEDGER.high_water)
+        refresh_health_gauges(reg)
     except Exception:
-        pass  # ledger unavailable during interpreter teardown
+        pass  # obs unavailable during interpreter teardown

@@ -225,6 +225,12 @@ class Runner:
         collective = DeviceHealth(cfg.device_breaker_threshold,
                                   cfg.device_breaker_cooldown_s,
                                   kind="collective")
+        from .obs.health import register_breaker
+
+        # the health snapshot tracks the latest breaker per kind (weakly:
+        # a finished query's breaker reads as idle once collected)
+        register_breaker(health)
+        register_breaker(collective)
         if cfg.enable_aqe:
             from .adaptive import AdaptivePlanner
 

@@ -19,6 +19,7 @@ from collections import deque
 from typing import Callable, Iterator, Optional
 
 from .micropartition import MicroPartition
+from .obs.log import current_query_id, query_context
 
 # marks threads currently executing a dispatched partition task: the scan
 # prefetcher uses this to stand down on pool workers (the dispatch window
@@ -30,6 +31,23 @@ _WORKER_TL = threading.local()
 
 def on_pool_worker() -> bool:
     return getattr(_WORKER_TL, "active", False)
+
+
+# process-wide count of dispatched-but-unfinished partition tasks: the
+# health snapshot's view of the scheduler's in-flight window
+_inflight_lock = threading.Lock()
+_inflight = 0
+
+
+def inflight_tasks() -> int:
+    with _inflight_lock:
+        return _inflight
+
+
+def _inflight_add(n: int) -> None:
+    global _inflight
+    with _inflight_lock:
+        _inflight += n
 
 
 def _await_result(fut, ctx) -> MicroPartition:
@@ -50,10 +68,12 @@ class PartitionTask:
     """One unit of per-partition work: a partition, the function to run on
     it, and the resource request the accountant must admit first.
     ``span_token``/``submit_ns`` carry the dispatching thread's profiler
-    context across the pool hop (set by dispatch when profiling is armed)."""
+    context across the pool hop (set by dispatch when profiling is armed);
+    ``query_id`` carries the ALWAYS-ON structured-log query context the
+    same way, so worker-side log lines stay attributed."""
 
     __slots__ = ("partition", "fn", "resource_request", "op_name", "seq",
-                 "span_token", "submit_ns")
+                 "span_token", "submit_ns", "query_id")
 
     def __init__(self, partition: MicroPartition, fn: Callable,
                  resource_request=None, op_name: str = "task", seq: int = 0):
@@ -64,6 +84,7 @@ class PartitionTask:
         self.seq = seq
         self.span_token = None
         self.submit_ns = 0
+        self.query_id = None
 
     def run(self) -> MicroPartition:
         return self.fn(self.partition)
@@ -100,6 +121,10 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
         _WORKER_TL.active = True
         prof = ctx.stats.profiler
         sp = None
+        # the dispatching thread's query binds on this worker for the
+        # task's duration: log lines from worker-side work carry it
+        qctx = query_context(task.query_id)
+        qctx.__enter__()
         if prof.armed:
             # adopt the dispatching thread's span context, then open this
             # task's worker-side op span — background work is attributed to
@@ -121,12 +146,14 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
                 prof.end(sp)
             if act is not None:
                 act.__exit__(None, None, None)
+            qctx.__exit__(None, None, None)
             # drop the input partition as soon as the work is done — the
             # result may wait in `pending` behind a slow head-of-line task,
             # and holding input + output would double peak partition memory
             task.partition = None
             if task.resource_request:
                 ctx.accountant.release(task.resource_request)
+            _inflight_add(-1)
 
     prof = ctx.stats.profiler
     try:
@@ -140,6 +167,8 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
             if prof.armed:
                 task.span_token = prof.capture()
                 task.submit_ns = time.perf_counter_ns()
+            task.query_id = current_query_id()
+            _inflight_add(1)
             pending.append((task, pool.submit(run_task, task)))
             while len(pending) >= window:
                 yield _await_result(pending.popleft()[1], ctx)
@@ -155,5 +184,7 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
         for task, fut in pending:
             # a queued task that never ran still holds its admission
             # reservation: return it, or a later admit() waits forever
-            if fut.cancel() and task.resource_request:
-                ctx.accountant.release(task.resource_request)
+            if fut.cancel():
+                _inflight_add(-1)
+                if task.resource_request:
+                    ctx.accountant.release(task.resource_request)

@@ -36,7 +36,6 @@ layers share one budget."""
 
 from __future__ import annotations
 
-import logging
 import os
 import shutil
 import tempfile
@@ -45,8 +44,9 @@ import time
 from typing import Callable, List, Optional
 
 from .micropartition import MicroPartition
+from .obs.log import current_query_id, get_logger, query_context
 
-logger = logging.getLogger(__name__)
+logger = get_logger("spill")
 
 # marks pool threads running BACKGROUND IO (unspill readahead): a spill
 # read-back on one of them is overlap, not consumer wait, so it must not
@@ -107,9 +107,8 @@ class MemoryLedger:
             # decision with a negative balance — but never silently
             # daftlint: disable=DTL002
             self.negative_releases += 1
-            logger.warning(
-                "MemoryLedger release of %d bytes exceeds current balance "
-                "%d (double release?); clamping at 0", n, self.current)
+            logger.warning("ledger_negative_release", released=n,
+                           current=self.current)
             self.current = 0  # daftlint: disable=DTL002
         else:
             self.current -= n  # daftlint: disable=DTL002
@@ -675,11 +674,13 @@ class PartitionBuffer:
             file_bytes = _write_spill_ipc(path, tbls)
             dt = time.perf_counter_ns() - t0
             nrows = sum(len(t) for t in tbls)
-        except Exception:
+        except Exception as e:
             # python-object columns have no arrow representation — and a
             # full/failing spill disk looks the same: hold in memory rather
             # than fail the query; the slot (with whatever partial bytes)
             # goes back on the free-list for the next spill to overwrite
+            logger.warning("spill_write_failed", mode="sync", path=path,
+                           error=repr(e))
             if self.stats is not None:
                 self.stats.bump("spill_write_failures")
             self.scope.recycle(path)
@@ -715,15 +716,19 @@ class PartitionBuffer:
                                    sum(t.size_bytes() for t in tbls),
                                    self.scope, tbls, rt_stats=self.stats)
         stats = self.stats
-        # capture the submitting thread's span so the write — which runs on
-        # the writer thread — is attributed to the op that spilled, not lost
+        # capture the submitting thread's span AND query context so the
+        # write — which runs on the writer thread — is attributed to the
+        # op (and query) that spilled, not lost
         prof = stats.profiler if stats is not None else None
         token = prof.capture() if prof is not None and prof.armed else None
+        qid = current_query_id()
 
         def job():
             from . import faults
 
             sp = None
+            qctx = query_context(qid)
+            qctx.__enter__()
             if token is not None:
                 act = prof.activate(token)
                 act.__enter__()
@@ -734,10 +739,12 @@ class PartitionBuffer:
                     t0 = time.perf_counter_ns()
                     file_bytes = _write_spill_ipc(path, tbls)
                     dt = time.perf_counter_ns() - t0
-                except Exception:
+                except Exception as e:
                     # same contract as the synchronous path, discovered
                     # late: hold the partition in memory instead of
                     # failing the query
+                    logger.warning("spill_write_failed", mode="async",
+                                   path=path, error=repr(e))
                     MEMORY_LEDGER.async_spill_failed(size)
                     task._write_failed(size)
                     if stats is not None:
@@ -758,6 +765,7 @@ class PartitionBuffer:
                 if sp is not None:
                     prof.end(sp)
                     act.__exit__(None, None, None)
+                qctx.__exit__(None, None, None)
 
         MEMORY_LEDGER.async_spill_started(size)
         t0 = time.perf_counter_ns()
@@ -819,10 +827,13 @@ class PartitionBuffer:
         submit = self._readahead
         prof = self.stats.profiler if self.stats is not None else None
         token = prof.capture() if prof is not None and prof.armed else None
+        qid = current_query_id()
 
         def job():
             _BG_IO.active = True
             sp = None
+            qctx = query_context(qid)
+            qctx.__enter__()
             if token is not None:
                 act = prof.activate(token)
                 act.__enter__()
@@ -834,6 +845,7 @@ class PartitionBuffer:
                 if sp is not None:
                     prof.end(sp)
                     act.__exit__(None, None, None)
+                qctx.__exit__(None, None, None)
 
         try:
             fut = submit(job)

@@ -71,6 +71,14 @@ def dropped_events() -> int:
         return _dropped
 
 
+def tail(n: int = 2000) -> list:
+    """The newest ``n`` buffered chrome events (oldest first) — what the
+    flight recorder's diagnostics bundles snapshot when a trace is armed."""
+    with _lock:
+        evs = list(_events)
+    return evs[-n:]
+
+
 def enable(path: str) -> None:
     """Start buffering events; flush() writes them to `path`."""
     global _path, _t0_us, _dropped

@@ -1,0 +1,14 @@
+"""DTL007 fixture: ad-hoc logging in an engine module — a bare print, a
+warnings.warn, a direct stdlib logging call, and the module-logger pattern.
+Every one must trip log-hygiene. Never imported."""
+import logging
+import warnings
+
+logger = logging.getLogger(__name__)
+
+
+def report(msg):
+    print("engine state:", msg)
+    warnings.warn(msg)
+    logging.warning("raw %s", msg)
+    logger.warning("raw %s", msg)

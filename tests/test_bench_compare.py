@@ -1,0 +1,102 @@
+"""tools/bench_compare: snapshot discovery, direction-aware diffing, noise
+threshold, and CLI exit codes."""
+
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.bench_compare import classify, compare, flatten, main  # noqa: E402
+
+
+def _write(tmp_path, name, doc):
+    with open(os.path.join(str(tmp_path), name), "w") as f:
+        json.dump(doc, f)
+
+
+class TestClassify:
+    def test_directions(self):
+        assert classify("host_rows_per_sec") == "higher"
+        assert classify("q3_host_vs_baseline") == "higher"
+        assert classify("laion_fused_speedup_x") == "higher"
+        assert classify("spill_serial_wall_s") == "lower"
+        assert classify("q1_query_log_overhead_pct") == "lower"
+        assert classify("exchange_rows") == "lower"
+        assert classify("rows") is None  # bare table size: no direction
+        assert classify("some_unknown_thing") is None
+
+
+class TestFlatten:
+    def test_nested_and_non_numeric(self):
+        doc = {"a": 1, "b": {"c": 2.5, "d": "text"}, "e": True, "f": None}
+        flat = flatten(doc)
+        assert flat == {"a": 1.0, "b.c": 2.5}
+
+
+class TestCompare:
+    def test_regression_and_improvement_flagged(self):
+        prev = {"host_rows_per_sec": 100.0, "spill_pipelined_wall_s": 10.0,
+                "q12_host_vs_baseline": 1.0}
+        new = {"host_rows_per_sec": 80.0,   # -20% on higher-better: regressed
+               "spill_pipelined_wall_s": 8.0,   # -20% on lower-better: improved
+               "q12_host_vs_baseline": 1.05}    # +5%: within noise
+        diff = compare(prev, new, threshold=0.10)
+        assert diff["host_rows_per_sec"]["status"] == "regressed"
+        assert diff["spill_pipelined_wall_s"]["status"] == "improved"
+        assert diff["q12_host_vs_baseline"]["status"] == "stable"
+
+    def test_unknown_direction_never_regresses(self):
+        diff = compare({"weird_metric": 1.0}, {"weird_metric": 100.0})
+        assert diff["weird_metric"]["status"] == "info"
+
+    def test_zero_prev_handled(self):
+        diff = compare({"value": 0}, {"value": 5.0})
+        assert diff["value"]["delta_pct"] is None
+
+
+class TestCli:
+    def test_needs_two_snapshots(self, tmp_path):
+        _write(tmp_path, "BENCH_r01.json", {"value": 1})
+        assert main(["--dir", str(tmp_path)]) == 2
+
+    def test_compares_newest_two_and_tolerates_regressions(self, tmp_path,
+                                                           capsys):
+        _write(tmp_path, "BENCH_r01.json", {"host_rows_per_sec": 50.0})
+        _write(tmp_path, "BENCH_r02.json", {"host_rows_per_sec": 100.0})
+        _write(tmp_path, "BENCH_r03.json", {"host_rows_per_sec": 60.0})
+        assert main(["--dir", str(tmp_path)]) == 0  # tolerant by default
+        out = capsys.readouterr().out
+        assert "r02 -> r03" in out and "REGRESSED" in out
+
+    def test_strict_exits_nonzero_on_regression(self, tmp_path):
+        _write(tmp_path, "BENCH_r01.json", {"host_rows_per_sec": 100.0})
+        _write(tmp_path, "BENCH_r02.json", {"host_rows_per_sec": 50.0})
+        assert main(["--dir", str(tmp_path), "--strict"]) == 1
+        # within noise: clean even under --strict
+        _write(tmp_path, "BENCH_r02.json", {"host_rows_per_sec": 95.0})
+        assert main(["--dir", str(tmp_path), "--strict"]) == 0
+
+    def test_json_output_schema(self, tmp_path, capsys):
+        _write(tmp_path, "BENCH_r01.json",
+               {"host_rows_per_sec": 100.0, "nested": {"x_wall_s": 2.0}})
+        _write(tmp_path, "BENCH_r02.json",
+               {"host_rows_per_sec": 120.0, "nested": {"x_wall_s": 1.0}})
+        assert main(["--dir", str(tmp_path), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["prev_round"] == 1 and doc["new_round"] == 2
+        assert "nested.x_wall_s" in doc["metrics"]
+        assert doc["regressions"] == []
+
+    def test_module_invocation(self, tmp_path):
+        _write(tmp_path, "BENCH_r01.json", {"value": 1.0})
+        _write(tmp_path, "BENCH_r02.json", {"value": 1.0})
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.bench_compare",
+             "--dir", str(tmp_path)],
+            cwd=_ROOT, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 regression(s)" in proc.stdout

@@ -31,6 +31,7 @@ FIXTURE_MATRIX = {
     "bad_fault_sites.py": ("daft_tpu/_fixture_bad_sites.py", "DTL004"),
     "bad_error_hygiene.py": ("daft_tpu/_fixture_bad_hygiene.py", "DTL005"),
     "bad_span_coverage.py": ("daft_tpu/_fixture_bad_span.py", "DTL006"),
+    "bad_log_hygiene.py": ("daft_tpu/_fixture_bad_log.py", "DTL007"),
 }
 
 
@@ -49,10 +50,10 @@ def _copied_tree(tmp_path):
 # the engine over the real tree
 # ---------------------------------------------------------------------------
 
-def test_registry_has_six_rules():
+def test_registry_has_seven_rules():
     codes = [r.code for r in ALL_RULES]
     assert codes == ["DTL001", "DTL002", "DTL003", "DTL004", "DTL005",
-                     "DTL006"]
+                     "DTL006", "DTL007"]
     assert all(r.name and r.description for r in ALL_RULES)
 
 
@@ -217,6 +218,35 @@ def test_module_closure_under_lock_not_flagged(tmp_path):
     assert not dtl002, dtl002
 
 
+def test_log_hygiene_module_logger_pattern(tmp_path):
+    """DTL007 sees through the classic `logger = logging.getLogger(...)`
+    indirection: calls on the bound name are ad-hoc logging too."""
+    pkg = os.path.join(str(tmp_path), "daft_tpu")
+    os.makedirs(pkg)
+    with open(os.path.join(pkg, "mod.py"), "w") as f:
+        f.write("import logging\n\n"
+                "log = logging.getLogger(__name__)\n\n\n"
+                "def f():\n"
+                "    log.info('hello %s', 1)\n")
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    result = run_lint(project, ALL_RULES, {})
+    dtl007 = [f for f in result.new if f.rule == "DTL007"]
+    # the getLogger binding AND the call on the bound name both flag
+    assert len(dtl007) == 2, dtl007
+
+
+def test_log_hygiene_structured_backend_exempt(tmp_path):
+    """daft_tpu/obs/log.py is the sanctioned stdlib-logging user."""
+    pkg = os.path.join(str(tmp_path), "daft_tpu", "obs")
+    os.makedirs(pkg)
+    with open(os.path.join(pkg, "log.py"), "w") as f:
+        f.write("import logging\n\n"
+                "backend = logging.getLogger('daft_tpu')\n")
+    project = Project.discover(str(tmp_path), ["daft_tpu"])
+    result = run_lint(project, ALL_RULES, {})
+    assert not [f for f in result.new if f.rule == "DTL007"], result.new
+
+
 def test_cli_exit_2_on_missing_path():
     proc = subprocess.run(
         [sys.executable, "-m", "tools.daftlint", "daft_tpou_typo"],
@@ -250,7 +280,8 @@ def _check_schema(doc):
     assert doc["version"] == 1 and doc["tool"] == "daftlint"
     assert os.path.isabs(doc["root"])
     assert [r["code"] for r in doc["rules"]] == [
-        "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006"]
+        "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006",
+        "DTL007"]
     for r in doc["rules"]:
         assert set(r) == {"code", "name", "description"}
     counts = doc["counts"]
@@ -293,7 +324,7 @@ def test_cli_list_rules():
         cwd=_ROOT, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0
     for code in ("DTL001", "DTL002", "DTL003", "DTL004", "DTL005",
-                 "DTL006"):
+                 "DTL006", "DTL007"):
         assert code in proc.stdout
 
 

@@ -1,0 +1,467 @@
+"""Flight recorder (daft_tpu/obs/): always-on QueryLog, slow/failed-query
+auto-capture, engine health snapshot, structured logging with cross-thread
+query-id context, and the steady-state overhead guard."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, faults
+from daft_tpu.context import get_context
+from daft_tpu.execution import RuntimeStats
+from daft_tpu.obs import log as obs_log
+from daft_tpu.obs.capture import list_bundles
+from daft_tpu.obs.health import validate_health
+from daft_tpu.obs.querylog import (QUERY_LOG, QueryLog, build_record,
+                                   validate_record)
+from daft_tpu.spill import MEMORY_LEDGER
+
+
+@pytest.fixture
+def cfg():
+    c = get_context().execution_config
+    saved = {k: getattr(c, k) for k in (
+        "enable_query_log", "query_log_depth", "slow_query_threshold_s",
+        "diagnostics_dir", "diagnostics_keep_last", "enable_result_cache",
+        "enable_profiling", "memory_budget_bytes", "async_spill_writes",
+        "executor_threads", "execution_timeout_s", "scan_prefetch_depth")}
+    c.enable_result_cache = False
+    yield c
+    for k, v in saved.items():
+        setattr(c, k, v)
+    MEMORY_LEDGER.reset()
+    faults.disarm()
+
+
+def _query(n=200):
+    df = dt.from_pydict({"k": ["a", "b", "c", "d"] * (n // 4),
+                         "v": list(range(n))})
+    return (df.where(col("v") > 5)
+            .groupby("k").agg(col("v").sum().alias("s")).sort("k"))
+
+
+# ---------------------------------------------------------------------------
+# QueryLog: on by default, every outcome recorded
+# ---------------------------------------------------------------------------
+
+class TestQueryLog:
+    def test_record_appended_on_plain_collect(self, cfg):
+        before = QUERY_LOG.total
+        q = _query().collect()
+        assert QUERY_LOG.total == before + 1
+        rec = q.last_query_record()
+        assert rec is not None
+        assert validate_record(rec) == []
+        assert rec["outcome"] == "ok"
+        assert rec["plan_fingerprint"]
+        assert rec["plan_ops"]  # op-name counts of the physical plan
+        assert dt.query_log()[-1] is rec
+        assert rec["counters"]  # RuntimeStats folded in
+        assert rec["wall_s"] > 0
+
+    def test_disabled_by_knob(self, cfg):
+        cfg.enable_query_log = False
+        before = QUERY_LOG.total
+        q = _query().collect()
+        assert QUERY_LOG.total == before
+        assert q.last_query_record() is None
+
+    def test_config_delta_records_tuned_knobs_only(self, cfg):
+        cfg.executor_threads = 1
+        rec = _query().collect().last_query_record()
+        assert rec["config_delta"].get("executor_threads") == 1
+        # defaults don't appear
+        assert "device_min_rows" not in rec["config_delta"]
+
+    def test_error_query_still_records_with_partial_stats(self, cfg):
+        @dt.udf(return_dtype=dt.DataType.int64())
+        def boom(c):
+            raise ValueError("kaboom")
+
+        df = dt.from_pydict({"v": [1, 2, 3]}).select(boom(col("v")))
+        with pytest.raises(ValueError):
+            df.collect()
+        rec = df.last_query_record()
+        assert rec is not None and validate_record(rec) == []
+        assert rec["outcome"] == "error"
+        assert rec["error_type"] == "ValueError"
+        assert "kaboom" in rec["error_message"]
+        assert rec in dt.query_log()
+
+    def test_timeout_query_records_via_finally_path(self, cfg):
+        from daft_tpu.errors import DaftTimeoutError
+
+        cfg.execution_timeout_s = 0.000001
+        df = (dt.from_pydict({"v": list(range(5000))})
+              .into_partitions(8).select((col("v") * 2).alias("w")))
+        with pytest.raises(DaftTimeoutError):
+            df.collect()
+        rec = df.last_query_record()
+        assert rec is not None and validate_record(rec) == []
+        assert rec["outcome"] == "timeout"
+        assert rec["events"].get("deadline_expired", 0) >= 1
+
+    def test_depth_bounds_the_ring(self, cfg):
+        cfg.query_log_depth = 3
+        for _ in range(5):
+            dt.from_pydict({"v": [1]}).select(
+                (col("v") + 1).alias("w")).collect()
+        assert len(QUERY_LOG) <= 3
+        assert QUERY_LOG.capacity == 3
+
+    def test_fingerprint_stable_across_runs_of_same_plan(self, cfg):
+        r1 = _query().collect().last_query_record()
+        r2 = _query().collect().last_query_record()
+        assert r1["plan_fingerprint"] == r2["plan_fingerprint"]
+        assert r1["query_id"] != r2["query_id"]
+
+    def test_concurrent_collects_distinct_complete_records(self, cfg):
+        """N threads collecting simultaneously: every thread gets its own
+        validated record, query ids never collide, no interleaving
+        corruption."""
+        n_threads = 6
+        results = [None] * n_threads
+        errs = []
+        start = threading.Barrier(n_threads)
+
+        def worker(i):
+            try:
+                start.wait()
+                n = 40 + 4 * i
+                df = dt.from_pydict(
+                    {"v": list(range(n))}).into_partitions(2).select(
+                    (col("v") * 2).alias("w"))
+                df.collect()
+                results[i] = (n, df.last_query_record())
+            except Exception as e:  # surface in the main thread
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        qids = set()
+        for n, rec in results:
+            assert rec is not None and validate_record(rec) == []
+            assert rec["outcome"] == "ok"
+            assert rec["rows_emitted"] == n
+            qids.add(rec["query_id"])
+        assert len(qids) == n_threads
+        logged = {r["query_id"] for r in dt.query_log()}
+        assert qids <= logged
+
+
+# ---------------------------------------------------------------------------
+# steady-state overhead guard (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestOverheadGuard:
+    def test_record_fold_allocates_nothing_net(self, cfg):
+        """50k record builds + ring appends must not grow memory: the ring
+        drops what it evicts, and building folds only already-collected
+        state (<4KB net, mirroring the DISARMED profiler guard)."""
+        import tracemalloc
+
+        stats = RuntimeStats()
+        stats.bump("io_wait_ns", 123)
+        stats.record_op("ProjectOp", 10, 1000, 64)
+        log = QueryLog(depth=64)
+
+        def fold(i):
+            rec = build_record(f"q-{i}", "fp0123456789abcd",
+                               {"ProjectOp": 1}, cfg, stats, 1_000_000,
+                               "ok", rows_emitted=10)
+            log.append(rec)
+
+        import gc
+
+        for i in range(2000):  # warm allocator free lists / caches
+            fold(i)
+        log.clear()
+        gc.collect()
+        tracemalloc.start()
+        before = tracemalloc.take_snapshot()
+        for i in range(50_000):
+            fold(i)
+        assert len(log) == 64  # ring stayed bounded through the hammer
+        # drop the ring's (bounded, by-design) live set and collectable
+        # churn so the measurement is NET growth — anything left is a real
+        # per-fold leak
+        log.clear()
+        gc.collect()
+        after = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        growth = sum(s.size_diff for s in after.compare_to(before, "filename")
+                     if s.size_diff > 0)
+        assert growth < 4096, f"record fold leaked {growth} bytes"
+
+
+# ---------------------------------------------------------------------------
+# slow/failed auto-capture
+# ---------------------------------------------------------------------------
+
+class TestAutoCapture:
+    def test_slow_query_bundle_and_auto_arm(self, cfg, tmp_path):
+        cfg.slow_query_threshold_s = 0.0  # every query is "slow"
+        cfg.diagnostics_dir = str(tmp_path)
+        r1 = _query().collect().last_query_record()
+        assert r1["profiled"] is False
+        bundles = list_bundles(str(tmp_path))
+        assert len(bundles) == 1
+        files = set(os.listdir(tmp_path / bundles[0]))
+        assert {"record.json", "stats.txt", "log_tail.jsonl"} <= files
+        assert "profile.json" not in files  # first run ran unprofiled
+        loaded = json.load(open(tmp_path / bundles[0] / "record.json"))
+        assert validate_record(loaded) == []
+        assert "== Runtime Stats ==" in open(
+            tmp_path / bundles[0] / "stats.txt").read()
+        # second run of the SAME plan fingerprint is auto-profiled
+        r2 = _query().collect().last_query_record()
+        assert r2["plan_fingerprint"] == r1["plan_fingerprint"]
+        assert r2["profiled"] is True
+        bundles = list_bundles(str(tmp_path))
+        assert len(bundles) == 2
+        assert "profile.json" in os.listdir(tmp_path / bundles[-1])
+
+    def test_failed_query_bundle_without_threshold(self, cfg, tmp_path):
+        cfg.diagnostics_dir = str(tmp_path)
+        from daft_tpu.errors import DaftTimeoutError
+
+        cfg.execution_timeout_s = 0.000001
+        df = (dt.from_pydict({"v": list(range(5000))})
+              .into_partitions(8).select((col("v") * 3).alias("w")))
+        with pytest.raises(DaftTimeoutError):
+            df.collect()
+        bundles = list_bundles(str(tmp_path))
+        assert len(bundles) == 1 and bundles[0].endswith("_timeout")
+        rec = json.load(open(tmp_path / bundles[0] / "record.json"))
+        assert rec["outcome"] == "timeout"
+
+    def test_retention_keeps_last_k(self, cfg, tmp_path):
+        cfg.slow_query_threshold_s = 0.0
+        cfg.diagnostics_dir = str(tmp_path)
+        cfg.diagnostics_keep_last = 3
+        for i in range(6):
+            dt.from_pydict({"v": list(range(10 + i))}).select(
+                (col("v") + i).alias("w")).collect()
+        assert len(list_bundles(str(tmp_path))) <= 3
+
+    def test_capture_contract_survives_disabled_query_log(self, cfg,
+                                                          tmp_path):
+        """enable_query_log=False gates only the ring: errored queries
+        with diagnostics_dir set still bundle (the documented contract)."""
+        cfg.enable_query_log = False
+        cfg.diagnostics_dir = str(tmp_path)
+
+        @dt.udf(return_dtype=dt.DataType.int64())
+        def boom(c):
+            raise ValueError("still captured")
+
+        df = dt.from_pydict({"v": [1, 2]}).select(boom(col("v")))
+        before = QUERY_LOG.total
+        with pytest.raises(ValueError):
+            df.collect()
+        assert QUERY_LOG.total == before  # ring stayed off
+        assert df.last_query_record() is None
+        bundles = list_bundles(str(tmp_path))
+        assert len(bundles) == 1 and bundles[0].endswith("_error")
+
+    def test_retention_ignores_unrelated_directories(self, cfg, tmp_path):
+        """Pruning only ever touches bundle-named directories: pointing
+        diagnostics_dir at a populated directory must not delete data."""
+        (tmp_path / "precious").mkdir()
+        (tmp_path / "precious" / "data.txt").write_text("keep me")
+        cfg.slow_query_threshold_s = 0.0
+        cfg.diagnostics_dir = str(tmp_path)
+        cfg.diagnostics_keep_last = 1
+        for i in range(3):
+            dt.from_pydict({"v": [i]}).select((col("v") + 1).alias("w")
+                                              ).collect()
+        assert (tmp_path / "precious" / "data.txt").read_text() == "keep me"
+        assert len(list_bundles(str(tmp_path))) <= 1
+
+    def test_no_bundle_without_diagnostics_dir(self, cfg, tmp_path):
+        cfg.slow_query_threshold_s = 0.0
+        before = len(list_bundles(str(tmp_path)))
+        _query().collect()
+        assert len(list_bundles(str(tmp_path))) == before
+
+    def test_capture_never_fails_the_query(self, cfg, tmp_path):
+        # an unwritable diagnostics dir degrades to an error log
+        bad = tmp_path / "file_not_dir"
+        bad.write_text("x")
+        cfg.slow_query_threshold_s = 0.0
+        cfg.diagnostics_dir = str(bad)
+        q = _query().collect()  # must not raise
+        assert q.last_query_record() is not None
+
+
+# ---------------------------------------------------------------------------
+# health snapshot
+# ---------------------------------------------------------------------------
+
+class TestHealth:
+    def test_health_validates_and_names_breakers(self, cfg):
+        _query().collect()
+        h = dt.health()
+        assert validate_health(h) == []
+        assert {"device", "collective"} <= set(h["breakers"])
+        assert h["query_log"]["depth"] >= 1
+        assert h["queries_total"] >= 1
+        assert h["scheduler"]["inflight_tasks"] == 0  # idle engine
+
+    def test_health_gauges_in_metrics_text(self, cfg):
+        _query().collect()
+        text = dt.metrics_text()
+        for name in ("daft_tpu_query_log_depth",
+                     "daft_tpu_device_breaker_state",
+                     "daft_tpu_collective_breaker_state",
+                     "daft_tpu_scheduler_inflight_tasks",
+                     "daft_tpu_actor_pools",
+                     "daft_tpu_leaked_threads"):
+            assert name in text, name
+
+    def test_ledger_gauges_without_profiled_run(self, cfg):
+        """Satellite: MemoryLedger balances are gauges in metrics_text()
+        with no profiling involved."""
+        _query().collect()
+        text = dt.metrics_text()
+        for name in ("daft_tpu_memory_ledger_bytes",
+                     "daft_tpu_memory_ledger_high_water_bytes",
+                     "daft_tpu_memory_ledger_prefetch_inflight_bytes",
+                     "daft_tpu_memory_ledger_async_spill_inflight_bytes",
+                     "daft_tpu_memory_ledger_negative_releases"):
+            assert name in text, name
+
+
+# ---------------------------------------------------------------------------
+# structured logging + query-id propagation
+# ---------------------------------------------------------------------------
+
+class TestStructuredLog:
+    def test_bg_thread_lines_carry_query_id_zero_orphans(self, cfg):
+        """Acceptance: every structured-log line emitted from background
+        threads during a query carries its query_id (async spill writer
+        forced to log via injected write failures)."""
+        cfg.memory_budget_bytes = 20_000
+        cfg.async_spill_writes = True
+        t0 = time.time()
+        with faults.inject("spill.write", "always"):
+            df = (dt.from_pydict({"k": list(range(2000)),
+                                  "v": list(range(2000))})
+                  .repartition(8, "k")
+                  .groupby("k").agg(col("v").sum().alias("s")))
+            q = df.collect()
+        qid = q.last_query_record()["query_id"]
+        recs = [r for r in obs_log.tail(10_000)
+                if r["event"] == "spill_write_failed" and r["ts"] >= t0]
+        bg = [r for r in recs if r["thread"] != "MainThread"]
+        assert bg, "expected writer-thread log lines"
+        orphans = [r for r in bg if r.get("query_id") != qid]
+        assert orphans == [], orphans
+
+    def test_deadline_line_attributed(self, cfg):
+        from daft_tpu.errors import DaftTimeoutError
+
+        cfg.execution_timeout_s = 0.000001
+        df = (dt.from_pydict({"v": list(range(5000))})
+              .into_partitions(8).select((col("v") * 2).alias("w")))
+        with pytest.raises(DaftTimeoutError):
+            df.collect()
+        qid = df.last_query_record()["query_id"]
+        lines = obs_log.tail(100, query_id=qid)
+        assert any(r["event"] == "deadline_expired" for r in lines)
+
+    def test_ring_cap_evicts_and_counts(self):
+        saved = obs_log.tail(10**6)
+        try:
+            obs_log.clear()
+            obs_log.set_ring_cap(10)
+            lg = obs_log.get_logger("test")
+            for i in range(25):
+                lg.debug("e", i=i)
+            assert obs_log.ring_size() == 10
+            assert obs_log.dropped_records() == 15
+            assert obs_log.tail(5)[-1]["i"] == 24
+        finally:
+            obs_log.set_ring_cap(obs_log.DEFAULT_RING_CAP)
+            obs_log.clear()
+
+    def test_interleaved_lazy_streams_never_leak_context(self, cfg):
+        """The query id binds per PULL: between pulls (and after a stream
+        is abandoned) the consumer thread carries NO binding, so two
+        interleaved lazy iterators can't cross-attribute each other."""
+        df1 = dt.from_pydict({"v": list(range(20))}).into_partitions(4) \
+            .select((col("v") + 1).alias("w"))
+        df2 = dt.from_pydict({"v": list(range(20))}).into_partitions(4) \
+            .select((col("v") + 2).alias("w"))
+        it1, it2 = df1.iter_partitions(), df2.iter_partitions()
+        next(it1)
+        assert obs_log.current_query_id() is None
+        next(it2)
+        assert obs_log.current_query_id() is None
+        next(it1)  # resuming q1 after q2 must not run under q2's id
+        assert obs_log.current_query_id() is None
+        it1.close()
+        it2.close()
+        assert obs_log.current_query_id() is None
+
+    def test_query_context_nests_and_restores(self):
+        assert obs_log.current_query_id() is None
+        with obs_log.query_context("q-a"):
+            assert obs_log.current_query_id() == "q-a"
+            with obs_log.query_context("q-b"):
+                assert obs_log.current_query_id() == "q-b"
+            assert obs_log.current_query_id() == "q-a"
+        assert obs_log.current_query_id() is None
+
+    def test_sink_and_file_outputs(self, tmp_path):
+        seen = []
+        obs_log.add_sink(seen.append)
+        path = str(tmp_path / "engine.jsonl")
+        obs_log.log_to_file(path)
+        try:
+            obs_log.get_logger("test").info("hello", x=1)
+        finally:
+            obs_log.remove_sink(seen.append)
+            obs_log.close_file()
+        assert seen and seen[-1]["event"] == "hello"
+        line = json.loads(open(path).read().strip().splitlines()[-1])
+        assert line["event"] == "hello" and line["x"] == 1
+
+    def test_engine_log_tail_api(self, cfg):
+        q = _query().collect()
+        qid = q.last_query_record()["query_id"]
+        # the public filter surface works even when the query logged nothing
+        assert isinstance(dt.engine_log_tail(10, query_id=qid), list)
+
+
+# ---------------------------------------------------------------------------
+# record schema negatives
+# ---------------------------------------------------------------------------
+
+class TestValidation:
+    def test_missing_keys_flagged(self):
+        errs = validate_record({"query_id": "x"})
+        assert any("missing key" in e for e in errs)
+
+    def test_bad_outcome_flagged(self, cfg):
+        rec = dict(_query().collect().last_query_record())
+        rec["outcome"] = "exploded"
+        assert any("outcome" in e for e in validate_record(rec))
+
+    def test_error_outcome_requires_error_type(self, cfg):
+        rec = dict(_query().collect().last_query_record())
+        rec["outcome"] = "error"
+        assert any("error_type" in e for e in validate_record(rec))
+
+    def test_record_json_roundtrips(self, cfg):
+        rec = _query().collect().last_query_record()
+        assert validate_record(json.loads(json.dumps(rec, default=str))) == []

@@ -1,0 +1,167 @@
+"""Compare the newest BENCH_r*.json against the prior snapshot and flag
+per-metric regressions beyond a noise threshold.
+
+The bench snapshots accumulate one JSON per round (BENCH_r01.json,
+BENCH_r02.json, ...). This tool diffs the two newest: every numeric metric
+present in both is compared with a direction inferred from its name
+(walls/latencies/overheads are lower-better; rates/speedups/ratios are
+higher-better; unclassifiable metrics are reported as info, never
+flagged), and a change WORSE than ``--threshold`` (default 10%, the
+observed round-to-round noise on the drifting build hosts) is flagged as
+a regression.
+
+Usage:
+    python -m tools.bench_compare [--dir DIR] [--threshold 0.10]
+                                  [--json] [--strict]
+
+Exit codes: 0 = compared (regressions printed but tolerated), 1 = --strict
+and regressions found, 2 = fewer than two snapshots to compare.
+`make bench-compare` runs the default form.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.10
+
+_SNAPSHOT_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+# metric-name suffix -> direction ("lower" = smaller is better)
+_LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_pct", "_share",
+                   "_bytes", "_rows", "_misses", "_throttled", "_failures",
+                   "_errors", "_overhead_pct")
+_HIGHER_SUFFIXES = ("_per_sec", "_vs_baseline", "_speedup_x", "_gbps",
+                    "_mbps", "_hits", "value")
+
+
+def classify(metric: str) -> Optional[str]:
+    """'lower' / 'higher' / None (unknown direction — never flagged)."""
+    for suf in _HIGHER_SUFFIXES:
+        if metric.endswith(suf):
+            return "higher"
+    for suf in _LOWER_SUFFIXES:
+        if metric.endswith(suf):
+            return "lower"
+    return None
+
+
+def flatten(doc: dict, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of a snapshot, nested dicts dotted
+    (q1_op_throughput.ScanOp.rows_per_sec ...)."""
+    out: Dict[str, float] = {}
+    for k, v in doc.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, dict):
+            out.update(flatten(v, key + "."))
+    return out
+
+
+def find_snapshots(root: str) -> List[Tuple[int, str]]:
+    out = []
+    for fn in os.listdir(root):
+        m = _SNAPSHOT_RE.match(fn)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, fn)))
+    return sorted(out)
+
+
+def compare(prev: dict, new: dict,
+            threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Diff two flattened snapshots. Returns {metric: entry} where entry
+    carries prev/new/delta_pct/direction/status (regressed | improved |
+    stable | info)."""
+    p, n = flatten(prev), flatten(new)
+    out: Dict[str, dict] = {}
+    for metric in sorted(set(p) & set(n)):
+        pv, nv = p[metric], n[metric]
+        direction = classify(metric)
+        if pv == 0:
+            delta = 0.0 if nv == 0 else float("inf")
+        else:
+            delta = (nv - pv) / abs(pv)
+        entry = {"prev": pv, "new": nv,
+                 "delta_pct": round(delta * 100, 2)
+                 if delta != float("inf") else None,
+                 "direction": direction}
+        if direction is None:
+            entry["status"] = "info"
+        else:
+            worse = delta > threshold if direction == "lower" \
+                else delta < -threshold
+            better = delta < -threshold if direction == "lower" \
+                else delta > threshold
+            entry["status"] = ("regressed" if worse
+                               else "improved" if better else "stable")
+        out[metric] = entry
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    threshold = DEFAULT_THRESHOLD
+    as_json = strict = False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--dir":
+            i += 1
+            root = argv[i]
+        elif a.startswith("--dir="):
+            root = a.split("=", 1)[1]
+        elif a == "--threshold":
+            i += 1
+            threshold = float(argv[i])
+        elif a.startswith("--threshold="):
+            threshold = float(a.split("=", 1)[1])
+        elif a == "--json":
+            as_json = True
+        elif a == "--strict":
+            strict = True
+        else:
+            print(f"bench-compare: unknown argument {a!r}", file=sys.stderr)
+            return 2
+        i += 1
+    snaps = find_snapshots(root)
+    if len(snaps) < 2:
+        print(f"bench-compare: need two BENCH_r*.json under {root}, "
+              f"found {len(snaps)}", file=sys.stderr)
+        return 2
+    (r_prev, p_prev), (r_new, p_new) = snaps[-2], snaps[-1]
+    with open(p_prev) as f:
+        prev = json.load(f)
+    with open(p_new) as f:
+        new = json.load(f)
+    diff = compare(prev, new, threshold)
+    regressions = {m: e for m, e in diff.items()
+                   if e["status"] == "regressed"}
+    improved = sum(1 for e in diff.values() if e["status"] == "improved")
+    if as_json:
+        print(json.dumps({
+            "prev_round": r_prev, "new_round": r_new,
+            "threshold": threshold, "metrics": diff,
+            "regressions": sorted(regressions)}, indent=1, sort_keys=True))
+    else:
+        print(f"bench-compare: r{r_prev:02d} -> r{r_new:02d} "
+              f"({len(diff)} shared metric(s), noise ±{threshold:.0%})")
+        for m, e in sorted(diff.items()):
+            if e["status"] in ("regressed", "improved"):
+                arrow = "REGRESSED" if e["status"] == "regressed" else "improved"
+                print(f"  {arrow:>9}  {m}: {e['prev']:g} -> {e['new']:g} "
+                      f"({e['delta_pct']:+.1f}%)")
+        print(f"bench-compare: {len(regressions)} regression(s), "
+              f"{improved} improvement(s)")
+    return 1 if (strict and regressions) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@ from .collective_safety import CollectiveSafetyRule
 from .fault_sites import FaultSiteCoverageRule
 from .error_hygiene import ErrorHygieneRule
 from .span_coverage import SpanCoverageRule
+from .log_hygiene import LogHygieneRule
 
 ALL_RULES = [
     JitPurityRule(),
@@ -14,10 +15,12 @@ ALL_RULES = [
     FaultSiteCoverageRule(),
     ErrorHygieneRule(),
     SpanCoverageRule(),
+    LogHygieneRule(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in ALL_RULES}
 
 __all__ = ["ALL_RULES", "RULES_BY_CODE", "JitPurityRule",
            "LockDisciplineRule", "CollectiveSafetyRule",
-           "FaultSiteCoverageRule", "ErrorHygieneRule", "SpanCoverageRule"]
+           "FaultSiteCoverageRule", "ErrorHygieneRule", "SpanCoverageRule",
+           "LogHygieneRule"]

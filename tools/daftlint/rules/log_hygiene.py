@@ -1,0 +1,96 @@
+"""DTL007 log hygiene: engine modules log through the structured logger.
+
+daft_tpu/obs/log.py is the engine's one logging backend: JSON-lines
+records with cross-thread query-id context, a bounded ring the diagnostics
+bundles snapshot, and stdlib forwarding. Ad-hoc output anywhere else —
+bare ``print``, ``warnings.warn``, direct stdlib ``logging`` calls, or a
+module logger bound via ``logging.getLogger`` — produces lines the flight
+recorder cannot attribute or bundle, which is exactly the blind spot this
+PR closes.
+
+Flagged, per engine file (obs/log.py itself is the sanctioned backend and
+exempt):
+
+- ``print(...)`` calls
+- ``warnings.warn(...)`` / ``warnings.warn_explicit(...)``
+- any ``logging.*(...)`` call (``logging.getLogger``, ``logging.warning``,
+  ...) and ``from logging import ...``
+- calls on a name assigned from ``logging.getLogger(...)`` in the same
+  file (the classic module-logger pattern)
+
+Deliberate survivors — terminal-UI surfaces like progress bars and the
+explain/show REPL output — are grandfathered in baseline.json with
+comments (the DTL004/005/006 discipline: the backlog stays visible, new
+ad-hoc logging fails the run).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..engine import Finding, Project, Rule, dotted_name
+
+_EXEMPT = ("daft_tpu/obs/log.py",)
+
+MSG_PRINT = ("bare `print()` call bypasses the structured engine logger "
+             "(daft_tpu/obs/log.py) — use obs.log.get_logger(...), or "
+             "baseline a deliberate terminal-UI surface")
+MSG_WARNINGS = ("`warnings.warn` bypasses the structured engine logger — "
+                "use obs.log.get_logger(...).warning(...)")
+MSG_LOGGING = ("stdlib `logging` usage bypasses the structured engine "
+               "logger — use obs.log.get_logger(...)")
+
+
+def _stdlib_logger_names(tree: ast.Module) -> Set[str]:
+    """Names assigned from ``logging.getLogger(...)`` anywhere in the file
+    (calls on them are ad-hoc logging even though `logging.` never appears
+    at the call site)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        if dotted_name(node.value.func) != "logging.getLogger":
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    return out
+
+
+class LogHygieneRule(Rule):
+    code = "DTL007"
+    name = "log-hygiene"
+    description = ("engine modules log through the structured engine "
+                   "logger (daft_tpu/obs/log.py) — no bare print(), "
+                   "warnings.warn, or stdlib logging calls")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for rel in project.files:
+            if rel in _EXEMPT:
+                continue
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            loggers = _stdlib_logger_names(tree)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom) and \
+                        node.module == "logging":
+                    out.append(self.finding(rel, node.lineno, MSG_LOGGING))
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                if name == "print":
+                    out.append(self.finding(rel, node.lineno, MSG_PRINT))
+                elif name in ("warnings.warn", "warnings.warn_explicit"):
+                    out.append(self.finding(rel, node.lineno, MSG_WARNINGS))
+                elif name == "logging" or name.startswith("logging."):
+                    out.append(self.finding(rel, node.lineno, MSG_LOGGING))
+                elif "." in name and name.split(".", 1)[0] in loggers:
+                    out.append(self.finding(rel, node.lineno, MSG_LOGGING))
+        return out

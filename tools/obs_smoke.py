@@ -1,0 +1,132 @@
+"""obs-smoke: run queries with the flight recorder in every mode and
+validate each surface end to end. Wired into `make lint` (and usable alone
+via `make obs-smoke`) so a schema regression in the QueryRecord, the
+health snapshot, the diagnostics bundles, or the health gauges fails the
+static-gate path before any production consumer trips over it.
+
+Checks, in order:
+ 1. a plain collect() appends a QueryRecord that passes validate_record,
+    with outcome "ok", a plan fingerprint, and df.last_query_record()
+    identity with the log entry;
+ 2. daft_tpu.health() passes validate_health and names both breaker kinds;
+ 3. a forced slow query (threshold 0 + diagnostics_dir) writes a bundle
+    containing record.json (valid) + stats.txt, and the SECOND run of the
+    same plan fingerprint is auto-profiled (bundle carries profile.json);
+ 4. metrics_text() exports the health/ledger gauges;
+ 5. the structured-log ring carries the bundle's info line with query_id.
+
+Exits nonzero with a named failure on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import daft_tpu as dt
+    from daft_tpu import col
+    from daft_tpu.obs import log as obs_log
+    from daft_tpu.obs.capture import list_bundles
+    from daft_tpu.obs.health import validate_health
+    from daft_tpu.obs.querylog import validate_record
+
+    dt.set_execution_config(enable_result_cache=False)
+
+    def query():
+        df = dt.from_pydict({"k": ["a", "b", "c"] * 200,
+                             "v": list(range(600))})
+        return (df.where(col("v") > 3).into_partitions(3)
+                .groupby("k").agg(col("v").sum().alias("s")).sort("k"))
+
+    # 1: QueryRecord on a plain collect
+    before = len(dt.query_log())
+    q = query().collect()
+    rec = q.last_query_record()
+    if rec is None:
+        print("obs-smoke: FAIL — collect() appended no QueryRecord")
+        return 1
+    errs = validate_record(rec)
+    if errs:
+        print(f"obs-smoke: FAIL — record schema: {errs}")
+        return 1
+    if rec["outcome"] != "ok" or not rec["plan_fingerprint"]:
+        print(f"obs-smoke: FAIL — bad record {rec['outcome']!r}")
+        return 1
+    log = dt.query_log()
+    if len(log) <= before or log[-1] is not rec:
+        print("obs-smoke: FAIL — record missing from dt.query_log()")
+        return 1
+
+    # 2: health snapshot
+    h = dt.health()
+    errs = validate_health(h)
+    if errs:
+        print(f"obs-smoke: FAIL — health schema: {errs}")
+        return 1
+    if not {"device", "collective"} <= set(h["breakers"]):
+        print(f"obs-smoke: FAIL — breakers missing: {h['breakers']}")
+        return 1
+
+    # 3: forced slow-query bundle + auto-arm on the second run
+    tmp = tempfile.mkdtemp(prefix="daft_tpu_obs_smoke_")
+    dt.set_execution_config(slow_query_threshold_s=0.0, diagnostics_dir=tmp)
+    try:
+        r1 = query().collect().last_query_record()
+        r2 = query().collect().last_query_record()
+    finally:
+        dt.set_execution_config(slow_query_threshold_s=None,
+                                diagnostics_dir=None)
+    bundles = list_bundles(tmp)
+    if len(bundles) < 2:
+        print(f"obs-smoke: FAIL — expected 2 bundles, got {bundles}")
+        return 1
+    last = os.path.join(tmp, bundles[-1])
+    files = set(os.listdir(last))
+    if not {"record.json", "stats.txt"} <= files:
+        print(f"obs-smoke: FAIL — bundle incomplete: {sorted(files)}")
+        return 1
+    errs = validate_record(json.load(open(os.path.join(last, "record.json"))))
+    if errs:
+        print(f"obs-smoke: FAIL — bundle record schema: {errs}")
+        return 1
+    if not r2["profiled"] or "profile.json" not in files:
+        print("obs-smoke: FAIL — second slow run was not auto-profiled "
+              f"(profiled={r2['profiled']}, files={sorted(files)})")
+        return 1
+    if r1["plan_fingerprint"] != r2["plan_fingerprint"]:
+        print("obs-smoke: FAIL — plan fingerprint unstable across runs")
+        return 1
+
+    # 4: health/ledger gauges in the metrics dump
+    text = dt.metrics_text()
+    for name in ("daft_tpu_query_log_depth",
+                 "daft_tpu_memory_ledger_bytes",
+                 "daft_tpu_memory_ledger_prefetch_inflight_bytes",
+                 "daft_tpu_device_breaker_state",
+                 "daft_tpu_scheduler_inflight_tasks"):
+        if name not in text:
+            print(f"obs-smoke: FAIL — metrics dump missing {name}")
+            return 1
+
+    # 5: structured-log line for the bundle, with query_id
+    lines = [r for r in obs_log.tail(500)
+             if r["event"] == "diagnostics_bundle"]
+    if not lines or "query_id" not in lines[-1]:
+        print("obs-smoke: FAIL — no attributed diagnostics_bundle log line")
+        return 1
+
+    print(f"obs-smoke: OK — {len(dt.query_log())} record(s), "
+          f"{len(bundles)} bundle(s), auto-armed profile on run 2, "
+          f"{len(obs_log.tail(10**6))} log record(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
