@@ -285,6 +285,100 @@ def measure_sketch_exchange(n_rows: int = 50_000, n_parts: int = 8) -> dict:
     return out
 
 
+def measure_serving(scale: float = 0.01, offered_qps: float = 6.0,
+                    duration_s: float = 8.0, slots: int = 4,
+                    queue_depth: int = 4) -> dict:
+    """Serving rung (ISSUE 8): sustained MIXED workload — TPC-H q1 + q3 +
+    a multimodal-style python-UDF query — submitted to the ServingRuntime
+    at a FIXED offered load. Emits achieved throughput (serving_qps),
+    latency quantiles over completed queries (serving_p50_s /
+    serving_p99_s), and how many submissions admission control shed
+    (serving_shed_count — 0 while the host keeps up with the offered
+    load; a sustained regression shows up as rising p99 and then a
+    nonzero shed count, both flagged by bench_compare's suffix rules)."""
+    import hashlib
+
+    import numpy as np
+
+    import daft_tpu as dt
+    from daft_tpu import DataType, col
+    from daft_tpu.errors import DaftOverloadedError
+    from benchmarks import tpch
+
+    tables = tpch.generate_tables(scale=scale)
+    lineitem = dt.from_arrow(tables["lineitem"]).collect()
+    cust = dt.from_arrow(tables["customer"]).collect()
+    orders = dt.from_arrow(tables["orders"]).collect()
+    # multimodal-style stage: a per-row python "decode" over binary blobs
+    rng = np.random.RandomState(11)
+    blobs = [rng.bytes(2048) for _ in range(512)]
+
+    @dt.udf(return_dtype=DataType.string())
+    def digest(b):
+        return [hashlib.sha1(v).hexdigest() if v is not None else None
+                for v in b.to_pylist()]
+
+    blob_df = dt.from_pydict({"b": blobs}).collect()
+    templates = [
+        lambda: tpch.q1(lineitem),
+        lambda: tpch.q3(cust, orders, lineitem),
+        lambda: blob_df.select(digest(col("b")).alias("h")),
+    ]
+    cfg = dt.context.get_context().execution_config
+    prev_cache = cfg.enable_result_cache
+    cfg.enable_result_cache = False  # measure execution, not lookups
+    from daft_tpu.serve import ServingRuntime
+
+    rt = ServingRuntime(max_concurrent_queries=slots,
+                        queue_depth=queue_depth, admission_timeout_s=None)
+    handles = []
+    shed = 0
+    interval = 1.0 / offered_qps
+    t0 = time.perf_counter()
+    i = 0
+    try:
+        while time.perf_counter() - t0 < duration_s:
+            target = t0 + i * interval
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            try:
+                handles.append(rt.submit(templates[i % len(templates)]()))
+            except DaftOverloadedError:
+                shed += 1
+            i += 1
+        lat = []
+        completed = 0
+        for h in handles:
+            err = h.exception(120)
+            # a query still not terminal after the wait (wedged) is NOT
+            # completed — exception() returns None in that case too
+            if err is None and h.done():
+                completed += 1
+                # queue wait + execution: what a caller actually sees
+                lat.append(h.latency_s())
+        wall = time.perf_counter() - t0
+        lat.sort()
+
+        def q(p):
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        return {
+            "serving_offered_qps": offered_qps,
+            "serving_qps": round(completed / wall, 2),
+            "serving_p50_s": round(q(0.50), 4),
+            "serving_p99_s": round(q(0.99), 4),
+            "serving_shed_count": shed,
+            "serving_completed": completed,
+            "serving_submitted": i,
+        }
+    finally:
+        rt.shutdown(timeout_s=30)
+        cfg.enable_result_cache = prev_cache
+
+
 def run_device_rungs(scale: float) -> dict:
     """Measure everything: host path, device path, oracle, Q3/Q5 join rungs.
     Assumes the accelerator is reachable (caller probes via _tpu_alive).
@@ -560,6 +654,13 @@ def run_device_rungs(scale: float) -> dict:
         out["sketch_exchange"] = measure_sketch_exchange()
     except Exception as e:
         out["sketch_exchange_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # ---- serving rung (host path; sustained mixed load through the
+    # ServingRuntime, ISSUE 8 acceptance) -----------------------------------
+    try:
+        out["serving"] = measure_serving()
+    except Exception as e:
+        out["serving_error"] = f"{type(e).__name__}: {e}"[:200]
 
     return out
 
@@ -856,6 +957,10 @@ def _host_fallback(scale: float) -> dict:
         out["sketch_exchange"] = measure_sketch_exchange()
     except Exception as e:
         out["sketch_exchange_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:  # serving rung is pure host work: it rides the fallback too
+        out["serving"] = measure_serving()
+    except Exception as e:
+        out["serving_error"] = f"{type(e).__name__}: {e}"[:200]
     return out
 
 

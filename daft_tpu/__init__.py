@@ -11,6 +11,7 @@ from .errors import (
     DaftError,
     DaftIOError,
     DaftNotFoundError,
+    DaftOverloadedError,
     DaftResourceError,
     DaftSchemaError,
     DaftTimeoutError,
@@ -36,6 +37,7 @@ __all__ = [
     "DaftSchemaError",
     "DaftNotFoundError",
     "DaftIOError",
+    "DaftOverloadedError",
     "DaftResourceError",
 ]
 

@@ -27,6 +27,7 @@ from .io.scan import (FileFormat, Pushdowns, ScanTask, glob_paths,
 from .logical import InMemorySource, ScanSource
 from .micropartition import MicroPartition
 from .schema import Field, Schema
+from .serve import QueryHandle, ServingRuntime
 from .series import Series
 from .table import Table
 from .udf import UDF
@@ -341,6 +342,17 @@ def engine_log_tail(n: int = 200, query_id: Optional[str] = None) -> List[dict]:
     return tail(n, query_id=query_id)
 
 
+def shutdown(timeout_s: float = 10.0) -> dict:
+    """Graceful engine shutdown: drain every live ServingRuntime (stop
+    admitting, finish in-flight queries, report stragglers), stop the
+    actor pools, and wait — bounded — for engine worker threads to exit.
+    Also registered atexit with a short timeout. Returns
+    ``{"stragglers", "leaked_threads", "waited_s"}``."""
+    from .serve import shutdown as _shutdown
+
+    return _shutdown(timeout_s=timeout_s)
+
+
 __all__ = [
     "DataFrame",
     "GroupedDataFrame",
@@ -378,6 +390,9 @@ __all__ = [
     "query_log",
     "health",
     "engine_log_tail",
+    "ServingRuntime",
+    "QueryHandle",
+    "shutdown",
     "set_execution_config",
     "set_planning_config",
     "set_runner_native",

@@ -154,6 +154,24 @@ class ExecutionConfig:
     # bounded: only the newest diagnostics_keep_last bundles survive.
     diagnostics_dir: Optional[str] = None
     diagnostics_keep_last: int = 20
+    # --- serving runtime (daft_tpu/serve/) ---------------------------------
+    # query-level admission control: how many queries may EXECUTE at once in
+    # a ServingRuntime (per-task admission via ResourceAccountant still
+    # applies inside each query)
+    max_concurrent_queries: int = 4
+    # queries allowed to WAIT for a slot beyond the active set; a submit
+    # past (active slots + this queue) sheds immediately with
+    # DaftOverloadedError instead of piling up unboundedly
+    admission_queue_depth: int = 16
+    # a queued query that cannot get a slot within this window is shed with
+    # DaftOverloadedError; None = wait forever (not recommended for serving)
+    admission_timeout_s: Optional[float] = 30.0
+    # scheduler partition tasks that raise DaftTransientError (including
+    # injected io.get/scan.read faults that exhausted the IO-layer retries)
+    # are re-run through the shared RetryPolicy this many EXTRA times
+    # before failing the query; 0 disables task-level retry
+    task_retry_attempts: int = 2
+    task_retry_backoff_s: float = 0.05
     # device circuit breaker (execution.DeviceHealth): after this many
     # CONSECUTIVE device-kernel failures the breaker opens and every
     # device-eligible partition routes straight to the host path (one trip,

@@ -38,6 +38,13 @@ class DaftResourceError(DaftError, RuntimeError):
     pyrunner.py:352-370)."""
 
 
+class DaftOverloadedError(DaftError, RuntimeError):
+    """The serving runtime shed this query: the admission queue was full,
+    the queue wait exceeded its timeout, or the engine was draining for
+    shutdown. Deliberate load shedding, never an engine bug — callers
+    back off and retry against a less loaded instance."""
+
+
 class DaftInternalError(DaftError, RuntimeError):
     """An engine invariant was violated — always a bug in daft_tpu itself,
     never a user or environment error (reference: DaftError::InternalError).

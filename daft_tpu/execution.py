@@ -393,17 +393,30 @@ class DeviceHealth:
 class ExecutionContext:
     def __init__(self, cfg: ExecutionConfig, stats: Optional[RuntimeStats] = None,
                  deadline: Optional[float] = None,
-                 device_health: Optional[DeviceHealth] = None):
+                 device_health: Optional[DeviceHealth] = None,
+                 qctx=None):
         self.cfg = cfg
-        self.stats = stats or RuntimeStats()
-        # absolute time.monotonic() deadline; runners compute it once per
-        # query so AQE stages share one budget (a context built directly
-        # converts the config knob itself)
-        if deadline is None and cfg.execution_timeout_s is not None:
-            deadline = time.monotonic() + cfg.execution_timeout_s
-        self.deadline = deadline
-        self.device_health = device_health or DeviceHealth(
-            cfg.device_breaker_threshold, cfg.device_breaker_cooldown_s)
+        # the per-query mutable state — stats, deadline, breakers, ledger
+        # share, cancellation — lives on a QueryContext (serve/qcontext.py).
+        # Runners/the serving runtime build one per query so AQE stages
+        # share a single time budget, breaker, and memory share; a context
+        # built directly (tests) assembles an implicit solo one from the
+        # legacy keyword arguments.
+        if qctx is None:
+            from .serve.qcontext import QueryContext
+
+            qctx = QueryContext.build(cfg, stats=stats, deadline=deadline,
+                                      device_health=device_health)
+        self.qctx = qctx
+        self.stats = qctx.stats
+        self.deadline = qctx.deadline
+        self.device_health = qctx.device_health
+        # this query's MemoryLedger (a child share of the process root
+        # under the serving runtime) and byte budget: every buffer,
+        # prefetcher, and the accountant charge/read THESE, never the
+        # process-global account
+        self.ledger = qctx.ledger
+        self.memory_budget = qctx.memory_budget_bytes
         self._pool = None
         # terminal once the query's stream closed: unspill readahead stops
         # submitting (its buffers are settled by finish_query anyway); the
@@ -426,13 +439,13 @@ class ExecutionContext:
             from .errors import DaftTimeoutError
             from .obs.log import get_logger
 
+            limit = (self.qctx.timeout_s if self.qctx.timeout_s is not None
+                     else self.cfg.execution_timeout_s)
             self.stats.bump("deadline_expired")
             get_logger("scheduler").warning(
-                "deadline_expired",
-                timeout_s=self.cfg.execution_timeout_s)
+                "deadline_expired", timeout_s=limit)
             raise DaftTimeoutError(
-                f"query exceeded execution_timeout_s="
-                f"{self.cfg.execution_timeout_s}",
+                f"query exceeded execution_timeout_s={limit}",
                 stats=self.stats.snapshot())
 
     @property
@@ -455,11 +468,12 @@ class ExecutionContext:
         from .spill import PartitionBuffer
 
         buf = PartitionBuffer(
-            self.cfg.memory_budget_bytes, self.stats,
+            self.memory_budget, self.stats,
             scope=self.spill_scope,
             async_spill=self.cfg.async_spill_writes,
             readahead=(self._bg_submit if self.cfg.unspill_readahead
-                       else None))
+                       else None),
+            ledger=self.ledger)
         self._buffers.append(buf)
         return buf
 
@@ -485,7 +499,7 @@ class ExecutionContext:
             self._accountant = ResourceAccountant(
                 cpus=float(max(cores, self.num_workers)),
                 gpus=_accelerator_count,  # resolved only if a task asks
-                memory_bytes=self.cfg.memory_budget_bytes)
+                memory_bytes=self.memory_budget)
         return self._accountant
 
     def finish_query(self) -> None:
@@ -504,21 +518,31 @@ class ExecutionContext:
         return resolve_executor_threads(self.cfg)
 
     def pool(self):
-        """Lazily-created shared worker pool; shut down by execute_plan.
-        A post-shutdown call (scan-prefetch serving late reads, e.g.
-        to_pydict over an unforced collect) recreates it; the recreated
-        pool is released by GC when the last partition referencing the
-        prefetcher loads or dies."""
+        """Lazily-created worker pool; shut down by execute_plan. Under the
+        serving runtime this is a per-query CLIENT of the shared
+        SharedExecutorPool (fair FIFO across admitted queries) instead of a
+        private executor. A post-shutdown call (scan-prefetch serving late
+        reads, e.g. to_pydict over an unforced collect) recreates a private
+        pool; the recreated pool is released by GC when the last partition
+        referencing the prefetcher loads or dies."""
         if self._pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+            shared = self.qctx.shared_pool
+            if shared is not None and not self._pool_finished:
+                self._pool = shared.client(
+                    self.qctx.query_id or f"ctx-{id(self):x}")
+            else:
+                from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = ThreadPoolExecutor(
-                max_workers=self.num_workers, thread_name_prefix="daft-exec")
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.num_workers,
+                    thread_name_prefix="daft-exec")
         return self._pool
 
     def shutdown_pool(self) -> None:
         self._pool_finished = True
         if self._pool is not None:
+            # a shared-pool client interprets this as close(): the SHARED
+            # executor outlives the query; only its queue is torn down
             self._pool.shutdown(wait=False)
             self._pool = None
 
@@ -1186,7 +1210,9 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
     if prof.armed:
         query_id = prof.query_id
     else:
-        query_id = f"q-{next(_QUERY_SEQ)}"
+        # serving-runtime queries carry their admission-visible id through
+        # the whole observability stack (records, logs, health)
+        query_id = ctx.qctx.query_id or f"q-{next(_QUERY_SEQ)}"
         arm = tracing.active()
         if not arm:
             # slow-query auto-arm is part of the capture contract, which

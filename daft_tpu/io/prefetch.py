@@ -73,9 +73,13 @@ class ScanPrefetcher:
         self._ctx = ctx
         self._stats = ctx.stats
         self._deadline = getattr(ctx, "deadline", None)
-        self._budget = ctx.cfg.memory_budget_bytes
+        # the query's budget share and ledger (child of the process root
+        # under the serving runtime): readahead is bounded per QUERY, so
+        # one query's prefetch can never eat a neighbor's headroom
+        self._budget = getattr(ctx, "memory_budget",
+                               ctx.cfg.memory_budget_bytes)
         self._depth = max(0, int(depth))
-        self._ledger = MEMORY_LEDGER
+        self._ledger = getattr(ctx, "ledger", MEMORY_LEDGER)
         self._ninflight = 0  # submitted fetches not yet consumed/settled
         self._closed = False
 
@@ -199,6 +203,16 @@ class ScanPrefetcher:
                     self._stats.bump("prefetch_misses")
                     self._stats.io_wait(time.perf_counter_ns() - t0)
         try:
+            if fut.cancelled():
+                # cancelled from outside (query teardown closed the pool
+                # client): not an error — read synchronously like a miss
+                self._stats.bump("prefetch_misses")
+                t0 = time.perf_counter_ns()
+                try:
+                    return _read_task_chunks(s.task)
+                finally:
+                    if not worker:
+                        self._stats.io_wait(time.perf_counter_ns() - t0)
             if fut.done():
                 self._stats.bump("prefetch_hits")
                 return fut.result()

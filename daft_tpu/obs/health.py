@@ -22,7 +22,8 @@ import weakref
 from typing import Dict, List, Optional
 
 __all__ = ["HEALTH_SCHEMA_VERSION", "engine_health", "register_breaker",
-           "breaker_states", "refresh_health_gauges", "validate_health"]
+           "register_admission", "breaker_states", "admission_state",
+           "refresh_health_gauges", "validate_health"]
 
 HEALTH_SCHEMA_VERSION = 1
 
@@ -30,6 +31,13 @@ _lock = threading.Lock()
 # breaker kind -> weakref to the most recently registered DeviceHealth of
 # that kind (per-query objects; a dead ref reads as "idle")
 _breakers: Dict[str, "weakref.ref"] = {}
+# the most recently created ServingRuntime's AdmissionController (weak: a
+# dropped runtime reads as an idle admission layer)
+_admission: Optional["weakref.ref"] = None
+
+_ADMISSION_IDLE = {"slots": 0, "queue_depth": 0, "active_queries": 0,
+                   "queued_queries": 0, "admitted_total": 0,
+                   "shed_total": 0, "draining": False}
 
 # breaker state -> gauge value (0 healthy .. 2 open)
 _BREAKER_GAUGE = {"closed": 0.0, "half_open": 1.0, "open": 2.0, "idle": 0.0}
@@ -40,6 +48,24 @@ def register_breaker(breaker) -> None:
     query; weakly held so health never pins a finished query's state)."""
     with _lock:
         _breakers[breaker.kind] = weakref.ref(breaker)
+
+
+def register_admission(controller) -> None:
+    """Track the latest serving runtime's admission controller (weakly) so
+    ``dt.health()`` answers queue depth / active queries without a runtime
+    reference."""
+    global _admission
+    with _lock:
+        _admission = weakref.ref(controller)
+
+
+def admission_state() -> dict:
+    with _lock:
+        ref = _admission
+    ctl = ref() if ref is not None else None
+    if ctl is None:
+        return dict(_ADMISSION_IDLE)
+    return ctl.snapshot()
 
 
 def breaker_states() -> Dict[str, str]:
@@ -91,6 +117,7 @@ def engine_health() -> dict:
         "ledger": ledger,
         "scheduler": sched,
         "pools": pools,
+        "admission": admission_state(),
         "query_log": {
             "depth": len(QUERY_LOG),
             "capacity": QUERY_LOG.capacity,
@@ -154,6 +181,18 @@ def refresh_health_gauges(registry=None) -> None:
     reg.gauge("daft_tpu_actor_pools", "live actor pools").set(pools)
     reg.gauge("daft_tpu_leaked_threads",
               "actor workers that outlived shutdown").set(leaked)
+    adm = admission_state()
+    reg.gauge("daft_tpu_admission_active_queries",
+              "queries holding an execution slot").set(
+        adm["active_queries"])
+    reg.gauge("daft_tpu_admission_queue_depth",
+              "queries waiting for an execution slot").set(
+        adm["queued_queries"])
+    reg.gauge("daft_tpu_admission_slots",
+              "max concurrently executing queries").set(adm["slots"])
+    reg.gauge("daft_tpu_queries_shed_total",
+              "queries shed by admission control (overflow/timeout/"
+              "drain)").set(adm["shed_total"])
     from .querylog import QUERY_LOG
 
     reg.gauge("daft_tpu_query_log_depth",
@@ -167,6 +206,7 @@ _TOP_KEYS = {
     "ledger": dict,
     "scheduler": dict,
     "pools": dict,
+    "admission": dict,
     "query_log": dict,
     "log": dict,
     "queries_total": int,
@@ -201,4 +241,7 @@ def validate_health(d: dict) -> List[str]:
     for k in ("actor_pools", "leaked_threads"):
         if not isinstance(d["pools"].get(k), int):
             errs.append(f"pools.{k} missing or non-int")
+    for k in ("slots", "active_queries", "queued_queries", "shed_total"):
+        if not isinstance(d["admission"].get(k), int):
+            errs.append(f"admission.{k} missing or non-int")
     return errs

@@ -39,7 +39,7 @@ __all__ = ["RECORD_SCHEMA_VERSION", "QueryLog", "QUERY_LOG", "build_record",
 RECORD_SCHEMA_VERSION = 1
 DEFAULT_DEPTH = 256
 
-OUTCOMES = ("ok", "error", "timeout", "cancelled", "abandoned")
+OUTCOMES = ("ok", "error", "timeout", "cancelled", "abandoned", "shed")
 
 # RuntimeStats counters surfaced as the record's resilience-event rollup
 _EVENT_COUNTERS = (
@@ -48,6 +48,7 @@ _EVENT_COUNTERS = (
     "collective_breaker_reopens", "collective_breaker_recoveries",
     "faults_injected", "degraded_completions", "deadline_expired",
     "prefetch_throttled", "preload_throttled", "spill_write_failures",
+    "task_retries",
 )
 
 

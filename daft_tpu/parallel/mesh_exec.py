@@ -191,19 +191,22 @@ class MeshExecutionContext(ExecutionContext):
 
     def __init__(self, cfg, stats: Optional[RuntimeStats] = None, mesh=None,
                  deadline: Optional[float] = None, device_health=None,
-                 collective_health=None):
+                 collective_health=None, qctx=None):
         super().__init__(cfg, stats, deadline=deadline,
-                         device_health=device_health)
+                         device_health=device_health, qctx=qctx)
         self.mesh = mesh if mesh is not None else default_mesh()
         # mesh collectives get the same circuit-breaker treatment as device
         # kernels: K consecutive exchange failures trip it and every later
         # shuffle goes straight to the host path until the cooldown probe
-        # proves the link healthy again. MeshRunner passes one instance per
-        # QUERY so AQE stages share trip/cooldown state (same contract as
-        # device_health).
-        self.collective_health = collective_health or DeviceHealth(
-            cfg.device_breaker_threshold, cfg.device_breaker_cooldown_s,
-            kind="collective")
+        # proves the link healthy again. The QueryContext carries one
+        # instance per QUERY so AQE stages share trip/cooldown state (same
+        # contract as device_health).
+        self.collective_health = (collective_health
+                                  or self.qctx.collective_health
+                                  or DeviceHealth(
+                                      cfg.device_breaker_threshold,
+                                      cfg.device_breaker_cooldown_s,
+                                      kind="collective"))
 
     @property
     def n_devices(self) -> int:

@@ -12,7 +12,6 @@ pyrunner.py:117 (local bulk runner), and ray_runner.py (distributed). Here:
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, Iterator, List, Optional
 
 from .context import get_context
@@ -203,53 +202,42 @@ class Runner:
 
     name = "abstract"
 
-    def run(self, plan: LogicalPlan, stats: Optional[RuntimeStats] = None) -> PartitionSet:
-        parts = list(self.run_iter(plan, stats=stats))
+    def run(self, plan: LogicalPlan, stats: Optional[RuntimeStats] = None,
+            qctx=None) -> PartitionSet:
+        parts = list(self.run_iter(plan, stats=stats, qctx=qctx))
         return PartitionSet(plan.schema, parts)
 
     def run_iter(self, plan: LogicalPlan,
-                 stats: Optional[RuntimeStats] = None) -> Iterator[MicroPartition]:
-        """AQE dispatch lives here once; backends implement _run_plain."""
+                 stats: Optional[RuntimeStats] = None,
+                 qctx=None) -> Iterator[MicroPartition]:
+        """AQE dispatch lives here once; backends implement _run_plain.
+
+        The per-query mutable state — ONE absolute deadline, ONE breaker
+        per kind, the MemoryLedger share — lives on a QueryContext created
+        here (or handed in by the serving runtime), so AQE stages (each a
+        fresh ExecutionContext) share a single time budget and a single
+        trip: a dead device must not re-pay the failure threshold per
+        materialized stage."""
         ctx = get_context()
         cfg = ctx.execution_config
-        # one absolute deadline AND one device breaker for the WHOLE query,
-        # created here so AQE stages (each a fresh ExecutionContext) share a
-        # single time budget and a single trip — a dead device must not
-        # re-pay the failure threshold per materialized stage
-        deadline = (time.monotonic() + cfg.execution_timeout_s
-                    if cfg.execution_timeout_s is not None else None)
-        from .execution import DeviceHealth
+        if qctx is None:
+            from .serve.qcontext import QueryContext
 
-        health = DeviceHealth(cfg.device_breaker_threshold,
-                              cfg.device_breaker_cooldown_s)
-        collective = DeviceHealth(cfg.device_breaker_threshold,
-                                  cfg.device_breaker_cooldown_s,
-                                  kind="collective")
-        from .obs.health import register_breaker
-
+            qctx = QueryContext.build(cfg, stats=stats)
         # the health snapshot tracks the latest breaker per kind (weakly:
         # a finished query's breaker reads as idle once collected)
-        register_breaker(health)
-        register_breaker(collective)
+        qctx.register_health()
         if cfg.enable_aqe:
             from .adaptive import AdaptivePlanner
 
             # AdaptivePlanner hands over already-optimized (sub)plans
             return AdaptivePlanner(
-                lambda p: self._run_plain(p, stats, optimized=True,
-                                          deadline=deadline,
-                                          device_health=health,
-                                          collective_health=collective),
-                stats, cfg=cfg).run(plan)
-        return self._run_plain(plan, stats, deadline=deadline,
-                               device_health=health,
-                               collective_health=collective)
+                lambda p: self._run_plain(p, qctx, optimized=True),
+                qctx.stats, cfg=cfg).run(plan)
+        return self._run_plain(plan, qctx)
 
-    def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],
-                   optimized: bool = False,
-                   deadline: Optional[float] = None,
-                   device_health=None,
-                   collective_health=None) -> Iterator[MicroPartition]:
+    def _run_plain(self, plan: LogicalPlan, qctx,
+                   optimized: bool = False) -> Iterator[MicroPartition]:
         raise NotImplementedError
 
     def optimize_and_translate(self, plan: LogicalPlan, optimized: bool = False):
@@ -265,16 +253,11 @@ class Runner:
 class NativeRunner(Runner):
     name = "native"
 
-    def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],
-                   optimized: bool = False,
-                   deadline: Optional[float] = None,
-                   device_health=None,
-                   collective_health=None) -> Iterator[MicroPartition]:
+    def _run_plain(self, plan: LogicalPlan, qctx,
+                   optimized: bool = False) -> Iterator[MicroPartition]:
         ctx = get_context()
         _, phys = self.optimize_and_translate(plan, optimized)
-        exec_ctx = ExecutionContext(ctx.execution_config, stats,
-                                    deadline=deadline,
-                                    device_health=device_health)
+        exec_ctx = ExecutionContext(ctx.execution_config, qctx=qctx)
         return execute_plan(phys, exec_ctx)
 
 
@@ -287,17 +270,12 @@ class MeshRunner(Runner):
     def __init__(self, mesh=None):
         self.mesh = mesh
 
-    def _run_plain(self, plan: LogicalPlan, stats: Optional[RuntimeStats],
-                   optimized: bool = False,
-                   deadline: Optional[float] = None,
-                   device_health=None,
-                   collective_health=None) -> Iterator[MicroPartition]:
+    def _run_plain(self, plan: LogicalPlan, qctx,
+                   optimized: bool = False) -> Iterator[MicroPartition]:
         ctx = get_context()
         _, phys = self.optimize_and_translate(plan, optimized)
         from .parallel.mesh_exec import MeshExecutionContext
 
-        exec_ctx = MeshExecutionContext(ctx.execution_config, stats,
-                                        mesh=self.mesh, deadline=deadline,
-                                        device_health=device_health,
-                                        collective_health=collective_health)
+        exec_ctx = MeshExecutionContext(ctx.execution_config,
+                                        mesh=self.mesh, qctx=qctx)
         return execute_plan(phys, exec_ctx)

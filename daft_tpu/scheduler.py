@@ -50,18 +50,70 @@ def _inflight_add(n: int) -> None:
         _inflight += n
 
 
-def _await_result(fut, ctx) -> MicroPartition:
+def _run_with_retry(task: "PartitionTask", ctx) -> MicroPartition:
+    """Per-task transient retry: a partition task that raises
+    DaftTransientError — e.g. an injected io.get/scan.read fault that
+    exhausted the IO-layer's own retries — re-runs through the shared
+    RetryPolicy up to ``cfg.task_retry_attempts`` extra times instead of
+    failing the whole query on the first transient. Cancellation and the
+    query deadline are re-checked before every re-attempt; retries are
+    counted in RuntimeStats (``task_retries``) and surface in the
+    QueryRecord's event rollup."""
+    extra = max(0, getattr(ctx.cfg, "task_retry_attempts", 0))
+    if extra == 0:
+        return task.run()
+    from .errors import DaftTransientError
+    from .execution import QueryCancelledError
+    from .io.object_store import RetryPolicy
+    from .obs.log import get_logger
+
+    tries = [0]
+
+    def attempt() -> MicroPartition:
+        if tries[0]:
+            if ctx.stats.is_cancelled():
+                raise QueryCancelledError(
+                    f"query cancelled (retrying {task.op_name})")
+            ctx.check_deadline()
+            ctx.stats.bump("task_retries")
+            get_logger("scheduler").warning(
+                "task_retry", op=task.op_name, seq=task.seq,
+                attempt=tries[0])
+        tries[0] += 1
+        return task.run()
+
+    return RetryPolicy(
+        attempts=extra + 1,
+        backoff_s=getattr(ctx.cfg, "task_retry_backoff_s", 0.05),
+        retryable=(DaftTransientError,)).run(attempt)
+
+
+def _await_result(task: "PartitionTask", fut, ctx) -> MicroPartition:
     """Resolve a head-of-line task future, attributing blocked time to the
     dispatcher (dispatch_wait_ns, and the queue_wait phase of the pulling
     op's span) so the io_wait-vs-compute split can tell a starved pipeline
-    from a compute-bound one."""
-    if fut.done():
-        return fut.result()
-    t0 = time.perf_counter_ns()
+    from a compute-bound one. A task cancelled from outside (the serving
+    runtime cancelling a shed/cancelled query's queued work) never ran:
+    its reservations are returned here and the wait surfaces as query
+    cancellation, not a raw concurrent.futures error."""
+    from concurrent.futures import CancelledError
+
+    from .execution import QueryCancelledError
+
     try:
-        return fut.result()
-    finally:
-        ctx.stats.dispatch_wait(time.perf_counter_ns() - t0)
+        if fut.done():
+            return fut.result()
+        t0 = time.perf_counter_ns()
+        try:
+            return fut.result()
+        finally:
+            ctx.stats.dispatch_wait(time.perf_counter_ns() - t0)
+    except CancelledError:
+        _inflight_add(-1)
+        if task.resource_request:
+            ctx.accountant.release(task.resource_request)
+        raise QueryCancelledError(
+            "query cancelled (queued task cancelled)") from None
 
 
 class PartitionTask:
@@ -139,7 +191,7 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
         else:
             act = None
         try:
-            return task.run()
+            return _run_with_retry(task, ctx)
         finally:
             _WORKER_TL.active = False
             if sp is not None:
@@ -171,7 +223,7 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
             _inflight_add(1)
             pending.append((task, pool.submit(run_task, task)))
             while len(pending) >= window:
-                yield _await_result(pending.popleft()[1], ctx)
+                yield _await_result(*pending.popleft(), ctx)
         while pending:
             # the deadline stays cooperative through the drain: in-flight
             # results are yielded, but an expired budget stops the query at
@@ -179,7 +231,7 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
             # (check_deadline is also the barrier where async-spill writer
             # errors surface on the dispatching thread)
             ctx.check_deadline()
-            yield _await_result(pending.popleft()[1], ctx)
+            yield _await_result(*pending.popleft(), ctx)
     finally:
         for task, fut in pending:
             # a queued task that never ran still holds its admission

@@ -1,0 +1,29 @@
+"""Concurrent query serving runtime.
+
+The engine executes one plan at a time per call stack; "millions of users"
+means many plans at once over shared hardware. This package adds the
+robustness layer between user traffic and the executor:
+
+- ``admission.AdmissionController`` — bounded FIFO-with-slots admission in
+  front of execution (``max_concurrent_queries`` slots, bounded wait queue,
+  queue timeout); overflow sheds deterministically with
+  ``DaftOverloadedError`` instead of piling up.
+- ``qcontext.QueryContext`` — the per-query mutable execution state
+  (RuntimeStats, breakers, deadline, MemoryLedger share, cancellation)
+  factored OUT of the process-global context, so one poisoned query
+  degrades alone.
+- ``pool.SharedExecutorPool`` — one worker pool shared by every admitted
+  query, with fair round-robin FIFO dispatch across queries.
+- ``runtime.ServingRuntime`` — N queries concurrently over the shared pool
+  and mesh, drain-mode shutdown, per-query QueryHandles.
+"""
+
+from .admission import AdmissionController
+from .pool import SharedExecutorPool
+from .qcontext import QueryContext
+from .runtime import (QueryHandle, ServingRuntime, leaked_thread_count,
+                      shutdown)
+
+__all__ = ["AdmissionController", "QueryContext", "QueryHandle",
+           "ServingRuntime", "SharedExecutorPool", "leaked_thread_count",
+           "shutdown"]

@@ -1,0 +1,183 @@
+"""Query-level admission control: bounded FIFO-with-slots + overload shed.
+
+The per-task ResourceAccountant (execution.py) keeps one query from
+oversubscribing the host; it does nothing about N queries arriving at
+once. This controller sits in FRONT of execution: at most
+``max_concurrent_queries`` queries hold an execution slot, at most
+``queue_depth`` more wait in FIFO order, and everything beyond that —
+or anything that waits longer than ``timeout_s``, or arrives while the
+engine drains for shutdown — is SHED with ``DaftOverloadedError``. Shedding
+is deliberate: a bounded queue with a fast rejection beats an unbounded
+pile-up that times every caller out (the sustained-throughput lesson of
+the pipelines paper in PAPERS.md).
+
+Protocol (the ServingRuntime drives it):
+
+    ticket = ctl.enqueue(query_id)      # sync; sheds on overflow/drain
+    ctl.await_slot(ticket)              # FIFO wait; sheds on timeout/drain
+    try: ... run the query ...
+    finally: ctl.release(ticket)
+
+``snapshot()`` feeds ``dt.health()`` and the admission gauges in
+``metrics_text()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from ..errors import DaftOverloadedError
+
+
+class _Ticket:
+    __slots__ = ("query_id", "enqueued_at", "admitted")
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self.enqueued_at = time.monotonic()
+        # True once this ticket holds an execution slot (possibly claimed
+        # already at enqueue time — see AdmissionController.enqueue)
+        self.admitted = False
+
+
+class AdmissionController:
+    def __init__(self, slots: int, queue_depth: int,
+                 timeout_s: Optional[float]):
+        self.slots = max(1, int(slots))
+        self.queue_depth = max(0, int(queue_depth))
+        self.timeout_s = timeout_s
+        self._cond = threading.Condition()
+        self._active: Dict[str, float] = {}   # query_id -> admit time
+        self._waiters: Deque[_Ticket] = deque()
+        self._draining = False
+        self.shed_total = 0
+        self.admitted_total = 0
+
+    # ------------------------------------------------------------ admission
+    def enqueue(self, query_id: str) -> _Ticket:
+        """Claim a queue position, or shed NOW: overflow and drain are
+        rejected synchronously at submit time, never discovered after a
+        wait. A query that can run immediately (empty FIFO, free slot)
+        claims its slot HERE — a burst of submits fills all slots before
+        the first driver thread is even scheduled, so effective burst
+        capacity is slots + queue_depth and shed decisions never depend
+        on thread-scheduling timing."""
+        with self._cond:
+            if self._draining:
+                self.shed_total += 1
+                raise DaftOverloadedError(
+                    f"query {query_id} shed: engine is draining for "
+                    "shutdown")
+            ticket = _Ticket(query_id)
+            if not self._waiters and len(self._active) < self.slots:
+                self._admit_locked(ticket)
+                return ticket
+            if len(self._waiters) >= self.queue_depth:
+                self.shed_total += 1
+                raise DaftOverloadedError(
+                    f"query {query_id} shed: admission queue full "
+                    f"({len(self._active)} active / {len(self._waiters)} "
+                    f"queued, slots={self.slots}, "
+                    f"queue_depth={self.queue_depth})")
+            self._waiters.append(ticket)
+            self._cond.notify_all()
+            return ticket
+
+    def _admit_locked(self, ticket: _Ticket) -> None:
+        # runs under self._cond (every caller holds it; the lexical
+        # lock-discipline rule cannot see through the helper)
+        ticket.admitted = True
+        self._active[ticket.query_id] = time.monotonic()
+        self.admitted_total += 1  # daftlint: disable=DTL002
+        self._cond.notify_all()
+
+    def await_slot(self, ticket: _Ticket,
+                   timeout_s: Optional[float] = None) -> None:
+        """Block until this ticket is at the head of the FIFO and a slot is
+        free, then take the slot (a no-op for tickets already admitted at
+        enqueue). Sheds on queue timeout or drain."""
+        limit = timeout_s if timeout_s is not None else self.timeout_s
+        deadline = (time.monotonic() + limit) if limit is not None else None
+        with self._cond:
+            if ticket.admitted:
+                return
+            while True:
+                if self._draining:
+                    self._drop(ticket)
+                    raise DaftOverloadedError(
+                        f"query {ticket.query_id} shed: engine is draining "
+                        "for shutdown")
+                if (self._waiters and self._waiters[0] is ticket
+                        and len(self._active) < self.slots):
+                    self._waiters.popleft()
+                    # notify inside: the next waiter may also fit when
+                    # several slots freed at once
+                    self._admit_locked(ticket)
+                    return
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self._drop(ticket)
+                        raise DaftOverloadedError(
+                            f"query {ticket.query_id} shed: no execution "
+                            f"slot within {limit}s "
+                            f"(active={len(self._active)}, "
+                            f"queued={len(self._waiters)})")
+                self._cond.wait(remaining)
+
+    def _drop(self, ticket: _Ticket) -> None:
+        # runs under self._cond (every caller holds it — the lexical
+        # lock-discipline rule cannot see through the helper): a shed
+        # waiter leaves the FIFO so it cannot block the queries behind it
+        try:
+            self._waiters.remove(ticket)
+        except ValueError:
+            pass
+        self.shed_total += 1  # daftlint: disable=DTL002
+        self._cond.notify_all()
+
+    def release(self, ticket: _Ticket) -> None:
+        with self._cond:
+            self._active.pop(ticket.query_id, None)
+            self._cond.notify_all()
+
+    # ---------------------------------------------------------------- drain
+    def begin_drain(self) -> None:
+        """Stop admitting: queued waiters shed immediately, new submits
+        shed at enqueue; in-flight queries keep their slots."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def wait_drained(self, timeout_s: float) -> List[str]:
+        """Wait for in-flight queries to finish; returns the query ids
+        still active when the timeout expires (the stragglers)."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            while self._active:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            return sorted(self._active)
+
+    # ------------------------------------------------------------- introspection
+    def active_queries(self) -> List[str]:
+        with self._cond:
+            return sorted(self._active)
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            return {
+                "slots": self.slots,
+                "queue_depth": self.queue_depth,
+                "active_queries": len(self._active),
+                "queued_queries": len(self._waiters),
+                "admitted_total": self.admitted_total,
+                "shed_total": self.shed_total,
+                "draining": self._draining,
+            }

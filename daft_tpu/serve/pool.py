@@ -1,0 +1,177 @@
+"""SharedExecutorPool: one worker pool, fairly shared by N queries.
+
+Solo execution gives every query a private ThreadPoolExecutor; N private
+pools would oversubscribe the host N-fold and let one flood of tasks from
+a heavy query starve everyone behind it in a single FIFO. This pool keeps
+ONE executor of ``num_workers`` threads and dispatches across per-query
+FIFO queues round-robin ("fair FIFO-with-slots"): each pump picks the next
+query in rotation that has work, so an admitted query always makes
+progress at roughly 1/active-queries of the pool no matter how deep a
+neighbor's backlog is.
+
+Deadlock/futures contract (what the engine's pipelined-IO layer relies
+on):
+
+- ``Future.cancel()`` works while a task is still in its query's queue —
+  the prefetcher/unspill-readahead "never wait on a fetch that hasn't
+  started" discipline keeps working unchanged.
+- A task handed to the executor occupies a real worker immediately (the
+  pump only dispatches while idle workers exist), so a ``result()`` wait
+  on a RUNNING future can always complete.
+- ``cancel_queued(query)`` cancels everything of one query that has not
+  started — cancellation propagation for shed/cancelled queries.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Deque, Dict, Optional, Tuple
+
+
+class SharedExecutorPool:
+    def __init__(self, num_workers: int,
+                 thread_name_prefix: str = "daft-serve-exec"):
+        self.num_workers = max(1, int(num_workers))
+        self._exec = ThreadPoolExecutor(
+            max_workers=self.num_workers,
+            thread_name_prefix=thread_name_prefix)
+        self._lock = threading.Lock()
+        self._queues: Dict[str, Deque[Tuple[Future, tuple]]] = {}
+        self._rr: Deque[str] = deque()  # round-robin rotation of query keys
+        self._idle = self.num_workers
+        self._closed = False
+
+    # ------------------------------------------------------------- clients
+    def client(self, key: str) -> "_PoolClient":
+        """A per-query façade with the ``submit(fn, *args)`` surface the
+        ExecutionContext/scheduler/prefetcher expect from a pool."""
+        with self._lock:
+            if key not in self._queues:
+                self._queues[key] = deque()
+                self._rr.append(key)
+        return _PoolClient(self, key)
+
+    def submit(self, key: str, fn, args, kwargs) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("worker pool already shut down")
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = deque()
+                self._rr.append(key)
+            q.append((fut, (fn, args, kwargs)))
+        self._pump()
+        return fut
+
+    # ------------------------------------------------------------ dispatch
+    def _pump(self) -> None:
+        """Hand queued tasks to idle workers, one per pump step, rotating
+        across queries. Runs on submitter AND completer threads; the lock
+        makes each claim atomic."""
+        while True:
+            with self._lock:
+                if self._idle <= 0 or self._closed:
+                    return
+                item = None
+                for _ in range(len(self._rr)):
+                    key = self._rr[0]
+                    self._rr.rotate(-1)
+                    q = self._queues.get(key)
+                    while q:
+                        fut, work = q.popleft()
+                        # cancelled-while-queued futures settle here
+                        if fut.set_running_or_notify_cancel():
+                            item = (fut, work)
+                            break
+                    if item is not None:
+                        break
+                if item is None:
+                    return
+                self._idle -= 1
+            fut, (fn, args, kwargs) = item
+            try:
+                self._exec.submit(self._run, fut, fn, args, kwargs)
+            except RuntimeError as e:  # closed between check and submit
+                with self._lock:
+                    self._idle += 1
+                fut.set_exception(e)
+                return
+
+    def _run(self, fut: Future, fn, args, kwargs) -> None:
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException as e:  # delivered via fut.result(), not lost
+            fut.set_exception(e)
+        else:
+            fut.set_result(result)
+        finally:
+            with self._lock:
+                self._idle += 1
+            self._pump()
+
+    # ------------------------------------------------------------- control
+    def cancel_queued(self, key: str) -> int:
+        """Cancel every not-yet-started task of one query (its running
+        tasks finish; the engine's dispatch loop releases their admissions
+        as usual). Returns how many were cancelled."""
+        with self._lock:
+            q = self._queues.get(key)
+            items = list(q) if q else []
+            if q:
+                q.clear()
+        n = 0
+        for fut, _ in items:
+            if fut.cancel():
+                n += 1
+        return n
+
+    def remove(self, key: str) -> None:
+        """Drop a finished query's queue (cancelling any stragglers)."""
+        self.cancel_queued(key)
+        with self._lock:
+            self._queues.pop(key, None)
+            try:
+                self._rr.remove(key)
+            except ValueError:
+                pass
+
+    def queued_tasks(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._lock:
+            self._closed = True
+            pending = [it for q in self._queues.values() for it in q]
+            for q in self._queues.values():
+                q.clear()
+        for fut, _ in pending:
+            fut.cancel()
+        self._exec.shutdown(wait=wait)
+
+
+class _PoolClient:
+    """One query's view of the shared pool. ``close()`` makes further
+    submits raise RuntimeError — the same contract a shut-down private
+    ThreadPoolExecutor gives the prefetch/readahead layers."""
+
+    def __init__(self, pool: SharedExecutorPool, key: str):
+        self._pool = pool
+        self._key = key
+        self._closed = False
+
+    def submit(self, fn, *args, **kwargs) -> Future:
+        if self._closed:
+            raise RuntimeError("worker pool already shut down")
+        return self._pool.submit(self._key, fn, args, kwargs)
+
+    def shutdown(self, wait: bool = False) -> None:
+        self.close()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._pool.remove(self._key)

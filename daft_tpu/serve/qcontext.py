@@ -1,0 +1,122 @@
+"""QueryContext: the per-query half of what used to be ambient state.
+
+Before the serving runtime, one query at a time meant per-query state could
+live wherever it landed: RuntimeStats on the DataFrame, the deadline and
+breakers threaded through ``Runner.run_iter``'s keyword arguments, and ONE
+process-wide MemoryLedger that every buffer charged. With N queries in
+flight those become interference channels — query A's spill pressure fills
+the shared ledger and forces query B to spill; A's breaker trip degrades
+B's device path; A's deadline is whatever the global config said at the
+moment B mutated it.
+
+QueryContext owns all of it, per query:
+
+- ``stats``            — RuntimeStats (counters, cancellation handle)
+- ``deadline``         — ONE absolute deadline across all AQE stages
+- ``device_health`` /
+  ``collective_health``— this query's circuit breakers (a poisoned query
+                         trips its own breaker; the next query starts
+                         closed)
+- ``ledger``           — a MemoryLedger CHILD of the process root, so
+                         budget decisions read this query's balance while
+                         process totals stay exact
+- ``memory_budget_bytes`` — the query's share of the global budget
+                         (``memory_budget_bytes / max_concurrent_queries``
+                         under the serving runtime; the whole budget solo)
+- ``shared_pool``      — the serving runtime's SharedExecutorPool (None
+                         solo: the ExecutionContext creates a private pool
+                         exactly as before)
+
+The process-global ``DaftContext`` is left holding only config + runner,
+which is the de-globalization the DTL008 lint rule pins.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class QueryContext:
+    """Per-query mutable execution state (see module docstring). Built once
+    per query by ``Runner.run_iter`` (solo path) or the ServingRuntime
+    (concurrent path) and shared by every AQE stage of that query."""
+
+    __slots__ = ("query_id", "stats", "deadline", "timeout_s",
+                 "device_health", "collective_health", "ledger",
+                 "memory_budget_bytes", "shared_pool")
+
+    def __init__(self, stats, deadline: Optional[float],
+                 device_health, collective_health,
+                 ledger, memory_budget_bytes: Optional[int],
+                 shared_pool=None, query_id: Optional[str] = None,
+                 timeout_s: Optional[float] = None):
+        self.query_id = query_id
+        self.stats = stats
+        self.deadline = deadline
+        # the effective per-query limit behind `deadline` (config knob or
+        # submit(timeout_s=...) override), kept for truthful error messages
+        self.timeout_s = timeout_s
+        self.device_health = device_health
+        self.collective_health = collective_health
+        self.ledger = ledger
+        self.memory_budget_bytes = memory_budget_bytes
+        self.shared_pool = shared_pool
+
+    @classmethod
+    def build(cls, cfg, stats=None, deadline: Optional[float] = None,
+              device_health=None, collective_health=None,
+              memory_budget_bytes: Optional[int] = None,
+              shared_pool=None, query_id: Optional[str] = None,
+              timeout_s: Optional[float] = None) -> "QueryContext":
+        """Assemble a QueryContext from whatever the caller already has,
+        defaulting the rest from ``cfg`` — the one place the solo path,
+        the serving path, and directly-constructed test ExecutionContexts
+        converge.
+
+        ``memory_budget_bytes`` of None means "the whole configured
+        budget" (solo semantics); the serving runtime passes the query's
+        carved share instead. ``timeout_s`` (when given) overrides
+        ``cfg.execution_timeout_s`` for this query only."""
+        import time
+
+        from ..execution import DeviceHealth, RuntimeStats
+        from ..spill import MEMORY_LEDGER, MemoryLedger
+
+        stats = stats if stats is not None else RuntimeStats()
+        limit = (timeout_s if timeout_s is not None
+                 else cfg.execution_timeout_s)
+        if deadline is None and limit is not None:
+            deadline = time.monotonic() + limit
+        if device_health is None:
+            device_health = DeviceHealth(cfg.device_breaker_threshold,
+                                         cfg.device_breaker_cooldown_s)
+        if collective_health is None:
+            collective_health = DeviceHealth(cfg.device_breaker_threshold,
+                                             cfg.device_breaker_cooldown_s,
+                                             kind="collective")
+        share = (memory_budget_bytes if memory_budget_bytes is not None
+                 else cfg.memory_budget_bytes)
+        # a child ledger is only worth its forwarding cost when queries
+        # actually share the process: solo queries charge the root directly
+        # (identical observable behavior — the root IS the only account)
+        ledger = (MemoryLedger(parent=MEMORY_LEDGER)
+                  if shared_pool is not None else MEMORY_LEDGER)
+        return cls(stats, deadline, device_health, collective_health,
+                   ledger, share, shared_pool=shared_pool,
+                   query_id=query_id, timeout_s=limit)
+
+    def register_health(self) -> None:
+        """Expose this query's breakers to the engine-health snapshot
+        (weakly held: a finished query's breaker reads as idle)."""
+        from ..obs.health import register_breaker
+
+        register_breaker(self.device_health)
+        register_breaker(self.collective_health)
+
+    def cancel(self) -> None:
+        """Stop this query at the next partition boundary and cancel its
+        queued-but-unstarted work on the shared pool (running tasks finish;
+        the dispatch loop re-checks cancellation between results)."""
+        self.stats.cancel()
+        if self.shared_pool is not None and self.query_id is not None:
+            self.shared_pool.cancel_queued(self.query_id)

@@ -59,11 +59,20 @@ def _in_background_io() -> bool:
 
 
 class MemoryLedger:
-    """Process-wide account of bytes held by partition buffers (plus the
-    in-flight balances of the two readahead layers and spill write/read
-    throughput totals, which bench.py reads per rung)."""
+    """Account of bytes held by partition buffers (plus the in-flight
+    balances of the two readahead layers and spill write/read throughput
+    totals, which bench.py reads per rung).
 
-    def __init__(self):
+    The process-wide root (``MEMORY_LEDGER``) is the health/metrics view.
+    A serving query gets a CHILD ledger (``MemoryLedger(parent=root)``)
+    carved to its share of the global budget: budget decisions (spill
+    thresholds, prefetch caps) read the child's balances, so one query's
+    pressure can never spill — or OOM — another, while every mutation
+    forwards its true delta to the parent so the process totals stay
+    exact."""
+
+    def __init__(self, parent: Optional["MemoryLedger"] = None):
+        self._parent = parent
         self._lock = threading.Lock()
         self.current = 0
         self.high_water = 0
@@ -94,12 +103,18 @@ class MemoryLedger:
         with self._lock:
             self.current += n
             self.high_water = max(self.high_water, self.current)
+        if self._parent is not None:
+            self._parent.add(n)
 
     def sub(self, n: int) -> None:
         with self._lock:
-            self._sub_locked(n)
+            released = self._sub_locked(n)
+        # forward only what was ACTUALLY released: a clamped double-release
+        # in one query must not drain bytes other queries hold in the root
+        if self._parent is not None and released:
+            self._parent.sub(released)
 
-    def _sub_locked(self, n: int) -> None:
+    def _sub_locked(self, n: int) -> int:
         # runs under self._lock (every caller holds it); the lock-discipline
         # rule is lexical and cannot see through the helper
         if n > self.current:
@@ -109,39 +124,53 @@ class MemoryLedger:
             self.negative_releases += 1
             logger.warning("ledger_negative_release", released=n,
                            current=self.current)
-            self.current = 0  # daftlint: disable=DTL002
-        else:
-            self.current -= n  # daftlint: disable=DTL002
+            released, self.current = self.current, 0  # daftlint: disable=DTL002
+            return released
+        self.current -= n  # daftlint: disable=DTL002
+        return n
 
     def spilled(self, n: int) -> None:
         with self._lock:
             self.spilled_bytes += n
             self.spilled_partitions += 1
+        if self._parent is not None:
+            self._parent.spilled(n)
 
     # --- scan-prefetch charges (io/prefetch.py) -------------------------
     def prefetch_started(self, n: int) -> None:
         with self._lock:
             self.prefetch_inflight += n
+        if self._parent is not None:
+            self._parent.prefetch_started(n)
 
     def prefetch_done(self, n: int) -> None:
         with self._lock:
-            self.prefetch_inflight = max(0, self.prefetch_inflight - n)
+            done = min(n, self.prefetch_inflight)
+            self.prefetch_inflight -= done
+        if self._parent is not None and done:
+            self._parent.prefetch_done(done)
 
     # --- async spill writeback ------------------------------------------
     def async_spill_started(self, n: int) -> None:
         with self._lock:
             self.async_spill_inflight += n
+        if self._parent is not None:
+            self._parent.async_spill_started(n)
 
     def async_spill_done(self, n: int) -> None:
         with self._lock:
             self.async_spill_inflight = max(0, self.async_spill_inflight - n)
             self.spilled_bytes += n
             self.spilled_partitions += 1
+        if self._parent is not None:
+            self._parent.async_spill_done(n)
 
     def async_spill_abandoned(self, n: int) -> None:
         """The write was never submitted (writer closed): nothing in flight."""
         with self._lock:
             self.async_spill_inflight = max(0, self.async_spill_inflight - n)
+        if self._parent is not None:
+            self._parent.async_spill_abandoned(n)
 
     def async_spill_failed(self, n: int) -> None:
         """Write failed -> the partition is genuinely held in memory after
@@ -151,17 +180,23 @@ class MemoryLedger:
             self.async_spill_inflight = max(0, self.async_spill_inflight - n)
             self.current += n
             self.high_water = max(self.high_water, self.current)
+        if self._parent is not None:
+            self._parent.async_spill_failed(n)
 
     # --- spill IO throughput --------------------------------------------
     def record_spill_write(self, nbytes: int, ns: int) -> None:
         with self._lock:
             self.spill_write_bytes += nbytes
             self.spill_write_ns += ns
+        if self._parent is not None:
+            self._parent.record_spill_write(nbytes, ns)
 
     def record_unspill(self, nbytes: int, ns: int) -> None:
         with self._lock:
             self.unspill_bytes += nbytes
             self.unspill_ns += ns
+        if self._parent is not None:
+            self._parent.record_unspill(nbytes, ns)
 
     def reset(self) -> None:
         with self._lock:
@@ -373,7 +408,7 @@ class _SpillSlotTask:
     spill budget is never silently defeated by a hidden strong cache)."""
 
     def __init__(self, path: str, schema, num_rows: int, size_bytes: int,
-                 scope: SpillScope, rt_stats=None):
+                 scope: SpillScope, rt_stats=None, ledger=None):
         self.path = path
         self.schema = schema
         self.num_rows_exact = num_rows
@@ -384,6 +419,7 @@ class _SpillSlotTask:
         # spill files); the per-query RuntimeStats handle lives separately
         self.stats = None
         self._rt_stats = rt_stats
+        self._ledger = ledger if ledger is not None else MEMORY_LEDGER
         self._scope = scope
         self._cached_ref = None
         # generation observed when the slot was taken for THIS partition:
@@ -444,7 +480,7 @@ class _SpillSlotTask:
         with pa.OSFile(self.path) as f:
             arrow_tbl = pa.ipc.open_file(f).read_all()
         dt = time.perf_counter_ns() - t0
-        MEMORY_LEDGER.record_unspill(self.size_bytes_exact, dt)
+        self._ledger.record_unspill(self.size_bytes_exact, dt)
         if self._rt_stats is not None:
             from .scheduler import on_pool_worker
 
@@ -486,9 +522,9 @@ class _AsyncSpillSlotTask(_SpillSlotTask):
     path, discovered late."""
 
     def __init__(self, path: str, schema, num_rows: int, size_bytes: int,
-                 scope: SpillScope, tables, rt_stats=None):
+                 scope: SpillScope, tables, rt_stats=None, ledger=None):
         super().__init__(path, schema, num_rows, size_bytes, scope,
-                         rt_stats=rt_stats)
+                         rt_stats=rt_stats, ledger=ledger)
         self._tables = list(tables)
         # bytes this task holds in ledger `current` after a write failure;
         # shared with the finalizer so the charge settles exactly once
@@ -525,14 +561,15 @@ class _AsyncSpillSlotTask(_SpillSlotTask):
         return f"_AsyncSpillSlotTask({self.path}, rows={self.num_rows_exact})"
 
 
-def _settle_async_slot(scope: SpillScope, path: str, held_cell: dict) -> None:
+def _settle_async_slot(scope: SpillScope, path: str, held_cell: dict,
+                       ledger=None) -> None:
     """Finalizer for async spill tasks: recycle the slot and return any
     hold-in-memory bytes a failed write left charged."""
     scope.recycle(path)
     held = held_cell.get("bytes", 0)
     if held:
         held_cell["bytes"] = 0
-        MEMORY_LEDGER.sub(held)
+        (ledger if ledger is not None else MEMORY_LEDGER).sub(held)
 
 
 class _SpillSlotView:
@@ -622,11 +659,16 @@ class PartitionBuffer:
     def __init__(self, budget_bytes: Optional[int], stats=None,
                  scope: Optional[SpillScope] = None,
                  async_spill: bool = False,
-                 readahead: Optional[Callable] = None):
+                 readahead: Optional[Callable] = None,
+                 ledger: Optional[MemoryLedger] = None):
         self.budget = budget_bytes
         self.stats = stats
         self.scope = scope or SpillScope()
         self.async_spill = async_spill
+        # the query's ledger share (child of MEMORY_LEDGER under the
+        # serving runtime): budget decisions read THIS balance, so one
+        # query's spill pressure never charges another's headroom
+        self.ledger = ledger if ledger is not None else MEMORY_LEDGER
         self._readahead = readahead
         self._items: List[Optional[MicroPartition]] = []
         self._held: List[int] = []
@@ -634,13 +676,13 @@ class PartitionBuffer:
     def append(self, part: MicroPartition) -> None:
         size = part.size_bytes() or 0
         if (self.budget is not None and len(part)
-                and MEMORY_LEDGER.current + size > self.budget):
+                and self.ledger.current + size > self.budget):
             spilled = self._try_spill(part, size)
             if spilled is not None:
                 self._items.append(spilled)
                 self._held.append(0)
                 return
-        MEMORY_LEDGER.add(size)
+        self.ledger.add(size)
         self._items.append(part)
         self._held.append(size)
 
@@ -685,8 +727,8 @@ class PartitionBuffer:
                 self.stats.bump("spill_write_failures")
             self.scope.recycle(path)
             return None
-        MEMORY_LEDGER.spilled(size)
-        MEMORY_LEDGER.record_spill_write(file_bytes, dt)
+        self.ledger.spilled(size)
+        self.ledger.record_spill_write(file_bytes, dt)
         if self.stats is not None:
             self.stats.bump("spilled_partitions")
             self.stats.bump("spill_write_bytes", file_bytes)
@@ -698,7 +740,8 @@ class PartitionBuffer:
                 self.stats.profiler.event("spill", mode="sync", rows=nrows,
                                           bytes=file_bytes)
         task = _SpillSlotTask(path, tbls[0].schema, nrows, file_bytes,
-                              self.scope, rt_stats=self.stats)
+                              self.scope, rt_stats=self.stats,
+                              ledger=self.ledger)
         # the slot recycles when nothing can read it anymore: task GC, not
         # first-read, so forked references never race the free-list
         weakref.finalize(task, self.scope.recycle, path)
@@ -714,8 +757,10 @@ class PartitionBuffer:
         nrows = sum(len(t) for t in tbls)
         task = _AsyncSpillSlotTask(path, tbls[0].schema, nrows,
                                    sum(t.size_bytes() for t in tbls),
-                                   self.scope, tbls, rt_stats=self.stats)
+                                   self.scope, tbls, rt_stats=self.stats,
+                                   ledger=self.ledger)
         stats = self.stats
+        ledger = self.ledger
         # capture the submitting thread's span AND query context so the
         # write — which runs on the writer thread — is attributed to the
         # op (and query) that spilled, not lost
@@ -745,13 +790,13 @@ class PartitionBuffer:
                     # failing the query
                     logger.warning("spill_write_failed", mode="async",
                                    path=path, error=repr(e))
-                    MEMORY_LEDGER.async_spill_failed(size)
+                    ledger.async_spill_failed(size)
                     task._write_failed(size)
                     if stats is not None:
                         stats.bump("spill_write_failures")
                     return
-                MEMORY_LEDGER.async_spill_done(size)
-                MEMORY_LEDGER.record_spill_write(file_bytes, dt)
+                ledger.async_spill_done(size)
+                ledger.record_spill_write(file_bytes, dt)
                 task._write_done(file_bytes)
                 if stats is not None:
                     stats.bump("spilled_partitions")
@@ -767,12 +812,12 @@ class PartitionBuffer:
                     act.__exit__(None, None, None)
                 qctx.__exit__(None, None, None)
 
-        MEMORY_LEDGER.async_spill_started(size)
+        ledger.async_spill_started(size)
         t0 = time.perf_counter_ns()
         submitted = writer.submit(job)
         backpressure = time.perf_counter_ns() - t0
         if not submitted:
-            MEMORY_LEDGER.async_spill_abandoned(size)
+            ledger.async_spill_abandoned(size)
             return None
         if stats is not None and backpressure > 1_000_000:
             # the only disk stall left on the append path: a full writer
@@ -780,7 +825,7 @@ class PartitionBuffer:
             stats.io_wait(backpressure)
             stats.bump("spill_backpressure_ns", backpressure)
         weakref.finalize(task, _settle_async_slot, self.scope, path,
-                         task._held_cell)
+                         task._held_cell, self.ledger)
         return MicroPartition.from_scan_task(task)
 
     def __len__(self) -> int:
@@ -877,7 +922,7 @@ class PartitionBuffer:
         pending_fut = None
         for i in range(len(self._items)):
             part, self._items[i] = self._items[i], None
-            MEMORY_LEDGER.sub(self._held[i])
+            self.ledger.sub(self._held[i])
             self._held[i] = 0
             if pending_idx == i and pending_fut is not None:
                 self._await_load(pending_fut)
@@ -899,6 +944,12 @@ class PartitionBuffer:
         Never waits on a fetch that hasn't started (a congested pool would
         deadlock a consumer that is itself a pool task): cancel and let the
         consumer read synchronously instead."""
+        if fut.cancelled():
+            # cancelled from outside (pool client closed at teardown): the
+            # partition stays unloaded and the consumer reads synchronously
+            if self.stats is not None:
+                self.stats.bump("unspill_readahead_misses")
+            return
         if fut.done():
             if self.stats is not None:
                 self.stats.bump("unspill_readahead_hits")
@@ -919,6 +970,6 @@ class PartitionBuffer:
     def release(self) -> None:
         """Return held bytes to the ledger and drop partition refs (call when
         the buffer's contents have been consumed downstream)."""
-        MEMORY_LEDGER.sub(sum(self._held))
+        self.ledger.sub(sum(self._held))
         self._items = []
         self._held = []

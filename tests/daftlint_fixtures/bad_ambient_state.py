@@ -1,0 +1,26 @@
+"""DTL008 fixture: ambient module-level engine state — a mutated module
+registry, a class-like engine object bound at module scope, and a
+function that rebinds a module global. Every one must trip
+no-ambient-state. Never imported."""
+
+
+class _HiddenCache:
+    def __init__(self):
+        self.entries = {}
+
+
+# class-like constructor at module scope: an engine object whose internals
+# mutate even though the binding never does
+_CACHE = _HiddenCache()
+
+# a container the file mutates: real ambient state, not a lookup table
+_RESULTS = {}
+
+_counter = 0
+
+
+def remember(key, value):
+    global _counter
+    _counter += 1
+    _RESULTS[key] = value
+    return _counter

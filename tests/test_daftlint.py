@@ -32,6 +32,7 @@ FIXTURE_MATRIX = {
     "bad_error_hygiene.py": ("daft_tpu/_fixture_bad_hygiene.py", "DTL005"),
     "bad_span_coverage.py": ("daft_tpu/_fixture_bad_span.py", "DTL006"),
     "bad_log_hygiene.py": ("daft_tpu/_fixture_bad_log.py", "DTL007"),
+    "bad_ambient_state.py": ("daft_tpu/_fixture_bad_ambient.py", "DTL008"),
 }
 
 
@@ -50,10 +51,10 @@ def _copied_tree(tmp_path):
 # the engine over the real tree
 # ---------------------------------------------------------------------------
 
-def test_registry_has_seven_rules():
+def test_registry_has_eight_rules():
     codes = [r.code for r in ALL_RULES]
     assert codes == ["DTL001", "DTL002", "DTL003", "DTL004", "DTL005",
-                     "DTL006", "DTL007"]
+                     "DTL006", "DTL007", "DTL008"]
     assert all(r.name and r.description for r in ALL_RULES)
 
 
@@ -281,7 +282,7 @@ def _check_schema(doc):
     assert os.path.isabs(doc["root"])
     assert [r["code"] for r in doc["rules"]] == [
         "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006",
-        "DTL007"]
+        "DTL007", "DTL008"]
     for r in doc["rules"]:
         assert set(r) == {"code", "name", "description"}
     counts = doc["counts"]
@@ -324,7 +325,7 @@ def test_cli_list_rules():
         cwd=_ROOT, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0
     for code in ("DTL001", "DTL002", "DTL003", "DTL004", "DTL005",
-                 "DTL006", "DTL007"):
+                 "DTL006", "DTL007", "DTL008"):
         assert code in proc.stdout
 
 

@@ -1,0 +1,608 @@
+"""Serving-runtime tests: admission control, overload shedding, per-query
+isolation (stats/breakers/deadline/ledger shares), fair shared-pool
+scheduling, per-task transient retry, and drain-mode shutdown.
+
+Acceptance (ISSUE 8): 8 concurrent mixed queries (>=2 with injected
+faults, >=1 with an expiring deadline) all reach a terminal state with
+correct per-query results and QueryRecords; admission-queue overflow sheds
+deterministically with DaftOverloadedError; per-query ledger shares are
+enforced under concurrent spill pressure; leaked_thread_count() == 0 after
+a concurrent workload + shutdown."""
+
+import copy
+import threading
+import time
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import DataType, col, udf
+from daft_tpu import faults
+from daft_tpu.errors import (DaftOverloadedError, DaftTimeoutError,
+                             DaftTransientError)
+from daft_tpu.execution import ExecutionContext, RuntimeStats
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.scheduler import PartitionTask, dispatch
+from daft_tpu.serve import (AdmissionController, QueryContext,
+                            ServingRuntime, SharedExecutorPool,
+                            leaked_thread_count)
+from daft_tpu.spill import MEMORY_LEDGER, MemoryLedger
+from daft_tpu.table import Table
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.disarm()
+    MEMORY_LEDGER.reset()
+    yield
+    faults.disarm()
+    MEMORY_LEDGER.reset()
+
+
+def _cfg(**overrides):
+    """A copied ExecutionConfig; serving tests force a real worker pool on
+    this (possibly 2-core) host."""
+    c = copy.copy(dt.get_context().execution_config)
+    c.executor_threads = overrides.pop("executor_threads", 4)
+    for k, v in overrides.items():
+        setattr(c, k, v)
+    return c
+
+
+def _set_cfg(**overrides):
+    """Mutate the live config, returning the previous values."""
+    cfg = dt.get_context().execution_config
+    old = {k: getattr(cfg, k) for k in overrides}
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return old
+
+
+def _restore_cfg(old):
+    cfg = dt.get_context().execution_config
+    for k, v in old.items():
+        setattr(cfg, k, v)
+
+
+@udf(return_dtype=DataType.int64())
+def snooze(x):
+    time.sleep(0.15)
+    return x
+
+
+def _slow_df(n=8):
+    return (dt.from_pydict({"x": list(range(n))})
+            .repartition(4).select(snooze(col("x"))))
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_fifo_with_slots(self):
+        ctl = AdmissionController(slots=1, queue_depth=4, timeout_s=None)
+        t1 = ctl.enqueue("a")
+        ctl.await_slot(t1)
+        order = []
+        tickets = [ctl.enqueue(q) for q in ("b", "c", "d")]
+
+        def waiter(tk):
+            ctl.await_slot(tk)
+            order.append(tk.query_id)
+            time.sleep(0.01)
+            ctl.release(tk)
+
+        threads = [threading.Thread(target=waiter, args=(tk,), daemon=True)
+                   for tk in tickets]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)  # pin the FIFO arrival order
+        ctl.release(t1)
+        for t in threads:
+            t.join(timeout=5)
+        assert order == ["b", "c", "d"]  # FIFO, never slot-stealing
+
+    def test_burst_fills_all_slots_before_shedding(self):
+        """A rapid burst of enqueues claims every free slot SYNCHRONOUSLY:
+        effective burst capacity is slots + queue_depth, and shed decisions
+        never depend on when the driver threads get scheduled."""
+        ctl = AdmissionController(slots=4, queue_depth=4, timeout_s=None)
+        tickets = [ctl.enqueue(f"q{i}") for i in range(8)]  # none shed
+        snap = ctl.snapshot()
+        assert snap["active_queries"] == 4 and snap["queued_queries"] == 4
+        with pytest.raises(DaftOverloadedError, match="queue full"):
+            ctl.enqueue("q9")
+        # the pre-admitted tickets pass await_slot without blocking
+        for tk in tickets[:4]:
+            ctl.await_slot(tk, timeout_s=0.0)
+
+    def test_overflow_sheds_at_enqueue(self):
+        ctl = AdmissionController(slots=1, queue_depth=1, timeout_s=None)
+        t1 = ctl.enqueue("a")
+        ctl.await_slot(t1)
+        ctl.enqueue("b")  # fills the queue
+        with pytest.raises(DaftOverloadedError, match="queue full"):
+            ctl.enqueue("c")
+        assert ctl.snapshot()["shed_total"] == 1
+
+    def test_queue_timeout_sheds(self):
+        ctl = AdmissionController(slots=1, queue_depth=2, timeout_s=0.05)
+        t1 = ctl.enqueue("a")
+        ctl.await_slot(t1)
+        t2 = ctl.enqueue("b")
+        with pytest.raises(DaftOverloadedError, match="no execution slot"):
+            ctl.await_slot(t2)
+        # the shed waiter left the FIFO: a later query still admits
+        ctl.release(t1)
+        t3 = ctl.enqueue("c")
+        ctl.await_slot(t3, timeout_s=1.0)
+        ctl.release(t3)
+
+    def test_drain_sheds_new_and_queued(self):
+        ctl = AdmissionController(slots=1, queue_depth=4, timeout_s=None)
+        t1 = ctl.enqueue("a")
+        ctl.await_slot(t1)
+        t2 = ctl.enqueue("b")
+        ctl.begin_drain()
+        with pytest.raises(DaftOverloadedError, match="draining"):
+            ctl.await_slot(t2)
+        with pytest.raises(DaftOverloadedError, match="draining"):
+            ctl.enqueue("c")
+        assert ctl.wait_drained(0.05) == ["a"]  # in-flight reported
+        ctl.release(t1)
+        assert ctl.wait_drained(1.0) == []
+
+
+# ---------------------------------------------------------------------------
+# SharedExecutorPool
+# ---------------------------------------------------------------------------
+
+class TestSharedPool:
+    def test_round_robin_fairness(self):
+        """With one worker, queued tasks from two queries interleave
+        instead of A's whole backlog running before B's."""
+        pool = SharedExecutorPool(1)
+        running = threading.Event()
+        release = threading.Event()
+
+        def gate():
+            running.set()
+            release.wait(5)
+
+        order = []
+        a, b = pool.client("a"), pool.client("b")
+        gate_fut = a.submit(gate)
+        running.wait(5)  # worker busy: everything below queues
+        futs = ([a.submit(lambda i=i: order.append(("a", i)))
+                 for i in range(3)]
+                + [b.submit(lambda i=i: order.append(("b", i)))
+                   for i in range(3)])
+        release.set()
+        for f in [gate_fut] + futs:
+            f.result(timeout=5)
+        assert order[:2] != [("a", 0), ("a", 1)], order  # interleaved
+        assert [x for x in order if x[0] == "a"] == [("a", i)
+                                                     for i in range(3)]
+        assert [x for x in order if x[0] == "b"] == [("b", i)
+                                                     for i in range(3)]
+        pool.shutdown()
+
+    def test_cancel_queued_and_close(self):
+        pool = SharedExecutorPool(1)
+        block = threading.Event()
+        c = pool.client("q")
+        first = c.submit(block.wait, 5)
+        doomed = [c.submit(lambda: None) for _ in range(3)]
+        assert pool.cancel_queued("q") == 3
+        assert all(f.cancelled() for f in doomed)
+        block.set()
+        first.result(timeout=5)
+        c.close()
+        with pytest.raises(RuntimeError, match="shut down"):
+            c.submit(lambda: None)
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# overload shedding through the runtime
+# ---------------------------------------------------------------------------
+
+class TestOverload:
+    def test_overflow_sheds_deterministically(self):
+        old = _set_cfg(executor_threads=4)
+        rt = ServingRuntime(max_concurrent_queries=1, queue_depth=1,
+                            admission_timeout_s=None)
+        try:
+            h1 = rt.submit(_slow_df())
+            assert h1.wait_admitted(5)
+            h2 = rt.submit(_slow_df())  # queued
+            with pytest.raises(DaftOverloadedError, match="queue full"):
+                rt.submit(_slow_df())  # deterministic shed at the door
+            assert h1.result(30) is not None
+            assert h2.result(30) is not None
+            snap = rt.admission.snapshot()
+            assert snap["shed_total"] == 1
+            assert snap["admitted_total"] == 2
+        finally:
+            rt.shutdown(10)
+            _restore_cfg(old)
+
+    def test_queue_timeout_shed_surfaces_on_handle_with_record(self):
+        old = _set_cfg(executor_threads=4)
+        rt = ServingRuntime(max_concurrent_queries=1, queue_depth=2,
+                            admission_timeout_s=0.05)
+        try:
+            h1 = rt.submit(_slow_df())
+            assert h1.wait_admitted(5)
+            h2 = rt.submit(_slow_df())
+            with pytest.raises(DaftOverloadedError, match="no execution"):
+                h2.result(10)
+            rec = h2.record()
+            assert rec is not None and rec["outcome"] == "shed"
+            assert rec["error_type"] == "DaftOverloadedError"
+            assert rec["query_id"] == h2.query_id
+            h1.result(30)
+        finally:
+            rt.shutdown(10)
+            _restore_cfg(old)
+
+
+# ---------------------------------------------------------------------------
+# per-query isolation
+# ---------------------------------------------------------------------------
+
+def _clean_query():
+    return (dt.from_pydict({"x": list(range(100)),
+                            "g": [i % 5 for i in range(100)]})
+            .where(col("x") % 2 == 0).groupby("g").sum("x").sort("g"))
+
+
+def _spilling_query(rows=4000):
+    return (dt.from_pydict(
+        {"x": list(range(rows)),
+         "s": [f"pad-{i:06d}" * 8 for i in range(rows)]})
+        .repartition(8, "x").groupby("x").count("s"))
+
+
+class TestIsolation:
+    def test_faulty_spilling_neighbor_cannot_touch_clean_query(self):
+        """Query A spills under a tiny ledger share with injected
+        spill.write faults AND an expiring deadline; query B runs clean
+        concurrently. B's results are byte-identical to solo execution
+        and its QueryRecord shows zero fault/breaker/spill events."""
+        solo = _clean_query().to_arrow()
+        old = _set_cfg(executor_threads=4,
+                       memory_budget_bytes=64 * 1024,
+                       enable_result_cache=False)
+        rt = ServingRuntime(max_concurrent_queries=2, queue_depth=8,
+                            admission_timeout_s=None)
+        try:
+            faults.arm("spill.write", "always")
+            ha = rt.submit(_spilling_query(), timeout_s=0.25)
+            hb = rt.submit(_clean_query())
+            b = hb.result(60)
+            a_err = ha.exception(60)
+            # A reached a terminal state ALONE: either its deadline fired
+            # or it completed degraded (spills held in memory)
+            assert ha.done()
+            if a_err is not None:
+                assert isinstance(a_err, DaftTimeoutError), a_err
+            assert b.to_arrow() == solo
+            rec_b = hb.record()
+            assert rec_b["outcome"] == "ok"
+            assert rec_b["events"] == {}, rec_b["events"]
+            assert rec_b["counters"].get("spilled_partitions", 0) == 0
+            rec_a = ha.record()
+            assert rec_a is not None and rec_a["outcome"] in ("timeout",
+                                                             "ok")
+            # A's record carries ITS faults; they never leaked into B's
+            if rec_a["outcome"] == "ok":
+                assert rec_a["events"].get("spill_write_failures", 0) > 0
+        finally:
+            faults.disarm()
+            rt.shutdown(10)
+            _restore_cfg(old)
+
+    def test_ledger_share_enforced_per_query(self):
+        """Under one global budget, the query exceeding its carved share
+        spills ALONE: the small neighbor sharing the process never does."""
+        old = _set_cfg(executor_threads=4,
+                       memory_budget_bytes=128 * 1024,
+                       enable_result_cache=False)
+        rt = ServingRuntime(max_concurrent_queries=2, queue_depth=8,
+                            admission_timeout_s=None)
+        try:
+            ha = rt.submit(_spilling_query())     # >> 64KiB share
+            hb = rt.submit(_clean_query())        # << 64KiB share
+            ha.result(60)
+            hb.result(60)
+            ca = ha.record()["counters"]
+            cb = hb.record()["counters"]
+            assert ca.get("spilled_partitions", 0) > 0, ca
+            assert cb.get("spilled_partitions", 0) == 0, cb
+        finally:
+            rt.shutdown(10)
+            _restore_cfg(old)
+
+    def test_child_ledger_forwards_to_root(self):
+        root = MemoryLedger()
+        child = MemoryLedger(parent=root)
+        child.add(100)
+        other = MemoryLedger(parent=root)
+        other.add(50)
+        assert (child.current, other.current, root.current) == (100, 50,
+                                                                150)
+        child.sub(100)
+        child.sub(100)  # double release: clamped locally...
+        assert child.negative_releases == 1
+        assert root.current == 50  # ...and NOT drained from the root
+        other.sub(50)
+        assert root.current == 0
+
+    def test_breakers_are_per_query(self):
+        """One query's tripped device breaker must not open the next
+        query's (each QueryContext owns fresh DeviceHealth instances)."""
+        cfg = _cfg()
+        q1 = QueryContext.build(cfg)
+        q2 = QueryContext.build(cfg)
+        for _ in range(cfg.device_breaker_threshold):
+            q1.device_health.record_failure()
+        assert q1.device_health.state == "open"
+        assert q2.device_health.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# the 8-query mixed acceptance workload
+# ---------------------------------------------------------------------------
+
+class TestConcurrentMixed:
+    def test_eight_mixed_queries_all_terminal(self):
+        solo_clean = _clean_query().to_arrow()
+        old = _set_cfg(executor_threads=4,
+                       memory_budget_bytes=64 * 1024,
+                       enable_result_cache=False)
+        rt = ServingRuntime(max_concurrent_queries=4, queue_depth=8,
+                            admission_timeout_s=None)
+        try:
+            # >=2 queries with injected faults: spill.write fires only for
+            # the spilling queries (the clean in-memory ones never spill)
+            faults.arm("spill.write", "always")
+            handles = {}
+            handles["faulty1"] = rt.submit(_spilling_query())
+            handles["faulty2"] = rt.submit(_spilling_query())
+            # >=1 with an expiring deadline
+            handles["deadline"] = rt.submit(_slow_df(), timeout_s=0.1)
+            for i in range(4):
+                handles[f"clean{i}"] = rt.submit(_clean_query())
+            handles["udf"] = rt.submit(
+                dt.from_pydict({"x": list(range(8))})
+                .select(snooze(col("x"))))
+            outcomes = {}
+            for name, h in handles.items():
+                err = h.exception(120)
+                assert h.done(), name
+                rec = h.record()
+                assert rec is not None, name
+                outcomes[name] = rec["outcome"]
+                if err is not None:
+                    assert rec["outcome"] in ("timeout", "error"), (name,
+                                                                    err)
+            assert outcomes["deadline"] == "timeout"
+            assert isinstance(handles["deadline"].exception(1),
+                              DaftTimeoutError)
+            for i in range(4):
+                h = handles[f"clean{i}"]
+                assert outcomes[f"clean{i}"] == "ok"
+                assert h.result(1).to_arrow() == solo_clean
+                assert h.record()["events"] == {}
+            assert outcomes["udf"] == "ok"
+            assert sorted(handles["udf"].result(1).to_pydict()["x"]) == \
+                list(range(8))
+            for name in ("faulty1", "faulty2"):
+                rec = handles[name].record()
+                assert rec["outcome"] in ("ok", "error"), name
+                if rec["outcome"] == "ok":
+                    assert rec["events"].get("spill_write_failures",
+                                             0) > 0, name
+            # every query got a distinct id and a distinct record
+            ids = {h.query_id for h in handles.values()}
+            assert len(ids) == len(handles)
+        finally:
+            faults.disarm()
+            rt.shutdown(15)
+            _restore_cfg(old)
+
+
+# ---------------------------------------------------------------------------
+# per-task transient retry (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestTaskRetry:
+    def _ctx(self, **overrides):
+        return ExecutionContext(_cfg(**overrides), RuntimeStats())
+
+    @staticmethod
+    def _mp(i):
+        return MicroPartition.from_table(Table.from_pydict({"x": [i]}))
+
+    def test_transient_task_retries_then_succeeds(self):
+        ctx = self._ctx(task_retry_attempts=2, task_retry_backoff_s=0.0)
+        failures = {"left": 2}
+        lock = threading.Lock()
+
+        def flaky(part):
+            with lock:
+                if failures["left"] > 0:
+                    failures["left"] -= 1
+                    raise DaftTransientError("transient blip")
+            return part
+
+        tasks = (PartitionTask(self._mp(i), flaky, None, "t", i)
+                 for i in range(4))
+        out = [p.to_pydict()["x"][0] for p in dispatch(tasks, ctx)]
+        assert out == list(range(4))
+        assert ctx.stats.counters.get("task_retries") == 2
+        ctx.shutdown_pool()
+
+    def test_bounded_attempts_then_propagates(self):
+        ctx = self._ctx(task_retry_attempts=2, task_retry_backoff_s=0.0)
+        calls = [0]
+
+        def always_fails(part):
+            calls[0] += 1
+            raise DaftTransientError("still down")
+
+        tasks = iter([PartitionTask(self._mp(0), always_fails, None, "t",
+                                    0)])
+        with pytest.raises(DaftTransientError):
+            list(dispatch(tasks, ctx))
+        assert calls[0] == 3  # 1 + 2 retries, never unbounded
+        ctx.shutdown_pool()
+
+    def test_permanent_errors_never_retry(self):
+        ctx = self._ctx(task_retry_attempts=3, task_retry_backoff_s=0.0)
+        calls = [0]
+
+        def broken(part):
+            calls[0] += 1
+            raise ValueError("a bug, not a blip")
+
+        tasks = iter([PartitionTask(self._mp(0), broken, None, "t", 0)])
+        with pytest.raises(ValueError):
+            list(dispatch(tasks, ctx))
+        assert calls[0] == 1
+        assert ctx.stats.counters.get("task_retries", 0) == 0
+        ctx.shutdown_pool()
+
+    def test_injected_scan_fault_beyond_io_retries_recovers(self, tmp_path):
+        """An injected scan.read fault that exhausts the IO layer's own
+        retry budget propagates DaftTransientError into the partition
+        task — which re-runs it instead of failing the query, and the
+        QueryRecord shows the retry."""
+        import pyarrow as pa
+        import pyarrow.parquet as papq
+
+        p = str(tmp_path / "t.parquet")
+        papq.write_table(pa.table({"x": list(range(64))}), p)
+        cfg = dt.get_context().execution_config
+        old = _set_cfg(executor_threads=4, enable_result_cache=False,
+                       scan_retry_backoff_s=0.0, task_retry_backoff_s=0.0)
+        try:
+            df = dt.read_parquet(p).select((col("x") + 1).alias("y"))
+            with faults.inject("scan.read", "first_n",
+                               n=cfg.scan_retry_attempts):
+                got = df.to_pydict()
+            assert got["y"] == [i + 1 for i in range(64)]
+            rec = df.last_query_record()
+            assert rec["events"].get("task_retries", 0) >= 1
+        finally:
+            _restore_cfg(old)
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown + leaks (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestShutdown:
+    def test_drain_mode_finishes_inflight_and_sheds_new(self):
+        old = _set_cfg(executor_threads=4)
+        rt = ServingRuntime(max_concurrent_queries=2, queue_depth=4,
+                            admission_timeout_s=None)
+        try:
+            h = rt.submit(_slow_df())
+            assert h.wait_admitted(5)
+            report = rt.shutdown(timeout_s=30)
+            assert report["drained"] is True
+            assert report["stragglers"] == []
+            assert h.result(1) is not None  # in-flight query finished
+            with pytest.raises(DaftOverloadedError):
+                rt.submit(_clean_query())
+        finally:
+            _restore_cfg(old)
+
+    def test_straggler_reported_and_cancelled(self):
+        @udf(return_dtype=DataType.int64())
+        def very_slow(x):
+            time.sleep(0.3)
+            return x
+
+        old = _set_cfg(executor_threads=4)
+        rt = ServingRuntime(max_concurrent_queries=1, queue_depth=2,
+                            admission_timeout_s=None)
+        try:
+            h = rt.submit(dt.from_pydict({"x": list(range(12))})
+                          .repartition(12).select(very_slow(col("x"))))
+            assert h.wait_admitted(5)
+            report = rt.shutdown(timeout_s=0.05)
+            assert report["drained"] is False
+            assert report["stragglers"] == [h.query_id]
+            # the straggler was cancelled: it reaches a terminal state
+            assert h.exception(30) is not None or h.done()
+        finally:
+            _restore_cfg(old)
+
+    def test_no_leaked_threads_after_concurrent_workload(self):
+        """Satellite acceptance: leaked_thread_count() == 0 after a
+        concurrent workload + dt.shutdown()."""
+        old = _set_cfg(executor_threads=4, enable_result_cache=False)
+        try:
+            rt = ServingRuntime(max_concurrent_queries=3, queue_depth=8,
+                                admission_timeout_s=None)
+            handles = [rt.submit(_clean_query()) for _ in range(6)]
+            for h in handles:
+                h.result(60)
+            report = dt.shutdown(timeout_s=15)
+            assert report["leaked_threads"] == 0
+            assert leaked_thread_count() == 0
+        finally:
+            _restore_cfg(old)
+
+
+# ---------------------------------------------------------------------------
+# observability (satellite 6)
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_health_and_metrics_carry_admission_gauges(self):
+        old = _set_cfg(executor_threads=4)
+        rt = ServingRuntime(max_concurrent_queries=3, queue_depth=5,
+                            admission_timeout_s=None)
+        try:
+            h = rt.submit(_slow_df())
+            assert h.wait_admitted(5)
+            snap = dt.health()
+            from daft_tpu.obs.health import validate_health
+
+            assert validate_health(snap) == []
+            adm = snap["admission"]
+            assert adm["slots"] == 3 and adm["queue_depth"] == 5
+            assert adm["active_queries"] == 1
+            text = dt.metrics_text()
+            assert "daft_tpu_admission_active_queries 1" in text
+            assert "daft_tpu_admission_slots 3" in text
+            assert "daft_tpu_admission_queue_depth" in text
+            assert "daft_tpu_queries_shed_total" in text
+            h.result(30)
+        finally:
+            rt.shutdown(10)
+            _restore_cfg(old)
+
+    def test_shed_records_validate(self):
+        from daft_tpu.obs.querylog import validate_record
+
+        old = _set_cfg(executor_threads=4)
+        rt = ServingRuntime(max_concurrent_queries=1, queue_depth=0,
+                            admission_timeout_s=None)
+        try:
+            h = rt.submit(_slow_df())
+            assert h.wait_admitted(5)
+            with pytest.raises(DaftOverloadedError):
+                rt.submit(_clean_query())
+            shed = [r for r in dt.query_log() if r["outcome"] == "shed"]
+            assert shed, "shed query must leave a QueryRecord"
+            assert validate_record(shed[-1]) == []
+            h.result(30)
+        finally:
+            rt.shutdown(10)
+            _restore_cfg(old)

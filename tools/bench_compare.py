@@ -34,9 +34,9 @@ _SNAPSHOT_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 # metric-name suffix -> direction ("lower" = smaller is better)
 _LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_pct", "_share",
                    "_bytes", "_rows", "_misses", "_throttled", "_failures",
-                   "_errors", "_overhead_pct")
+                   "_errors", "_overhead_pct", "_shed_count")
 _HIGHER_SUFFIXES = ("_per_sec", "_vs_baseline", "_speedup_x", "_gbps",
-                    "_mbps", "_hits", "value")
+                    "_mbps", "_hits", "_qps", "value")
 
 
 def classify(metric: str) -> Optional[str]:

@@ -7,6 +7,7 @@ from .fault_sites import FaultSiteCoverageRule
 from .error_hygiene import ErrorHygieneRule
 from .span_coverage import SpanCoverageRule
 from .log_hygiene import LogHygieneRule
+from .ambient_state import AmbientStateRule
 
 ALL_RULES = [
     JitPurityRule(),
@@ -16,6 +17,7 @@ ALL_RULES = [
     ErrorHygieneRule(),
     SpanCoverageRule(),
     LogHygieneRule(),
+    AmbientStateRule(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in ALL_RULES}
@@ -23,4 +25,4 @@ RULES_BY_CODE = {r.code: r for r in ALL_RULES}
 __all__ = ["ALL_RULES", "RULES_BY_CODE", "JitPurityRule",
            "LockDisciplineRule", "CollectiveSafetyRule",
            "FaultSiteCoverageRule", "ErrorHygieneRule", "SpanCoverageRule",
-           "LogHygieneRule"]
+           "LogHygieneRule", "AmbientStateRule"]

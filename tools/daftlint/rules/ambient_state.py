@@ -1,0 +1,207 @@
+"""DTL008 no-ambient-state: module-level mutable engine state is pinned.
+
+The serving runtime de-globalized per-query execution state into
+QueryContext (daft_tpu/serve/qcontext.py): the process-global context
+holds only config + runner, and everything mutable a query touches —
+stats, breakers, deadline, ledger share — is per-query. This rule pins
+that refactor statically so ambient globals don't creep back:
+
+Flagged, per engine file:
+
+- a module-level name bound to a container (literal, comprehension, or
+  ``dict/list/set/deque/...`` constructor) that the file MUTATES —
+  subscript stores, mutating method calls (``.append/.update/.pop/...``),
+  augmented assigns. A constant lookup table that is only ever read is
+  not state and never flagged;
+- a module-level name bound to a class-like constructor call (CamelCase
+  callee): an engine OBJECT at module scope is ambient state — its
+  internals mutate even when the binding never does;
+- a ``global X`` declaration inside a function (module-global rebinding
+  from code paths — the classic creeping-counter pattern).
+
+Exempt (not state, or not shared):
+
+- synchronization primitives (``threading.Lock/RLock/Condition/Event/
+  Semaphore/Barrier/local``) — coordination, not data;
+- immutable-value factories (``re.compile``, ``frozenset``, ``tuple``,
+  ``object()`` sentinels, ``TypeVar``, lowercase/scalar constructors like
+  ``np.uint64``), ``__all__``, and ``get_logger(...)`` channels (the log
+  ring itself is accounted state in obs/log.py);
+- names in the REGISTRY whitelist below: the sanctioned process-wide
+  registries (the "context/registry whitelist" — each is deliberately
+  global, documented, and surfaced by dt.health()).
+
+Deliberate survivors outside the whitelist are grandfathered in
+baseline.json with comments (the DTL004/005/006/007 discipline: the
+backlog stays visible, NEW ambient state fails the run).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ..engine import Finding, Project, Rule, dotted_name
+
+# (path, name): the sanctioned process-wide registries. Everything here is
+# EITHER pure config/bookkeeping the health snapshot exposes, or the root
+# account per-query state forwards into. Adding an entry is an
+# architecture decision — prefer QueryContext.
+REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
+    # root memory account: per-query child ledgers forward their deltas
+    # here so dt.health() sees process totals
+    ("daft_tpu/spill.py", "MEMORY_LEDGER"),
+    # flight recorder ring + process metrics registry (observability
+    # surfaces; bounded)
+    ("daft_tpu/obs/querylog.py", "QUERY_LOG"),
+    ("daft_tpu/profile/metrics.py", "METRICS"),
+    # health snapshot's weak registries (latest breakers / admission)
+    ("daft_tpu/obs/health.py", "_breakers"),
+    ("daft_tpu/obs/health.py", "_admission"),
+    # result cache: process-wide by design (reference PartitionSetCache)
+    ("daft_tpu/runners.py", "_PARTITION_SET_CACHE"),
+    # live serving runtimes, for engine-wide drain at dt.shutdown()
+    ("daft_tpu/serve/runtime.py", "_RUNTIMES"),
+    # actor pools persist across queries by design (model weights)
+    ("daft_tpu/actor_pool.py", "_pools"),
+}
+
+_CONTAINER_CTOR_BASES = {
+    "dict", "list", "set", "bytearray", "deque", "defaultdict",
+    "OrderedDict", "Counter", "Queue", "LifoQueue", "PriorityQueue",
+    "SimpleQueue", "WeakSet", "WeakValueDictionary", "WeakKeyDictionary",
+}
+
+_EXEMPT_CALL_BASES = {
+    # synchronization, not data
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier",
+    # immutable results / factories
+    "TypeVar",
+    "get_logger",  # a channel into the (accounted) obs/log ring
+    "getLogger",
+}
+
+_MUTATING_METHODS = {
+    "append", "appendleft", "add", "update", "pop", "popleft", "clear",
+    "setdefault", "extend", "remove", "discard", "insert", "put",
+}
+
+MSG_BINDING = ("module-level mutable binding `{name}` is ambient engine "
+               "state — move it onto QueryContext / into the registry "
+               "whitelist (tools/daftlint/rules/ambient_state.py), or "
+               "baseline a deliberate survivor with a comment")
+MSG_OBJECT = ("module-level engine object `{name}` is ambient state — "
+              "move it onto QueryContext / into the registry whitelist "
+              "(tools/daftlint/rules/ambient_state.py), or baseline a "
+              "deliberate survivor with a comment")
+MSG_GLOBAL = ("function `{fn}` rebinds module global `{name}` — ambient "
+              "state mutation; route it through a context/registry "
+              "object, or baseline a deliberate survivor with a comment")
+
+
+def _call_base(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_classlike(base: str) -> bool:
+    """CamelCase callee: SomeClass(...) — an object whose internals mutate
+    even when the binding never does. Lowercase/scalar constructors
+    (np.uint64, pa.schema, object, namedtuple, re.compile) are value
+    factories and stay exempt."""
+    return base[:1].isupper() and not base.isupper()
+
+
+def _mutated_names(tree: ast.Module) -> Set[str]:
+    """Names whose bound container is mutated anywhere in the file:
+    subscript stores/deletes, mutating method calls, augmented assigns."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                if isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.value, ast.Name):
+                    out.add(tgt.value.id)
+                elif isinstance(node, ast.AugAssign) and \
+                        isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.value, ast.Name):
+                    out.add(tgt.value.id)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.attr in _MUTATING_METHODS:
+            out.add(node.func.value.id)
+    return out
+
+
+class AmbientStateRule(Rule):
+    code = "DTL008"
+    name = "no-ambient-state"
+    description = ("module-level mutable engine state must live in the "
+                   "context/registry whitelist — per-query state belongs "
+                   "on QueryContext (daft_tpu/serve/qcontext.py)")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for rel in project.files:
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            mutated = _mutated_names(tree)
+            for node in tree.body:
+                targets: List[ast.expr] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                if value is None:
+                    continue
+                is_container = isinstance(
+                    value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                            ast.SetComp, ast.DictComp))
+                is_object = False
+                if isinstance(value, ast.Call):
+                    base = _call_base(value)
+                    if base is None or base in _EXEMPT_CALL_BASES:
+                        continue
+                    if base in _CONTAINER_CTOR_BASES:
+                        is_container = True
+                    elif _is_classlike(base):
+                        is_object = True
+                if not (is_container or is_object):
+                    continue
+                for tgt in targets:
+                    if not isinstance(tgt, ast.Name) or tgt.id == "__all__":
+                        continue
+                    if (rel, tgt.id) in REGISTRY_WHITELIST:
+                        continue
+                    if is_container and tgt.id not in mutated:
+                        continue  # read-only lookup table, not state
+                    msg = MSG_OBJECT if is_object else MSG_BINDING
+                    out.append(self.finding(
+                        rel, node.lineno, msg.format(name=tgt.id)))
+            # `global X` declarations inside functions
+            for fn in ast.walk(tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                for stmt in ast.walk(fn):
+                    if not isinstance(stmt, ast.Global):
+                        continue
+                    for name in stmt.names:
+                        if (rel, name) in REGISTRY_WHITELIST:
+                            continue
+                        out.append(self.finding(
+                            rel, stmt.lineno,
+                            MSG_GLOBAL.format(fn=fn.name, name=name)))
+        return out
