@@ -285,6 +285,147 @@ def measure_sketch_exchange(n_rows: int = 50_000, n_parts: int = 8) -> dict:
     return out
 
 
+def measure_exchange(n_rows: int = 400_000, n_parts: int = 8,
+                     n_keys: int = 40_000, selectivity: float = 0.05,
+                     n_groups: int = 4_000) -> dict:
+    """Exchange v2 rung (ISSUE 9): before/after A/B of the exchange-
+    reduction legs, reading the engine's own counters so the numbers are
+    what actually crossed the exchange. Every leg is an interleaved
+    best-of A/B (the spill rung's discipline) so the build host's drifting
+    memory bandwidth cancels.
+
+    Leg 1 — selective join (q3 shape): a small dimension keeping
+    ``selectivity`` of the key space inner-joins a wide fact (float
+    measures + a comment-like string payload, the part of a q3 row that
+    makes its exchange expensive) across the co-partitioned hash exchange.
+    With ``runtime_join_filters`` on, the probe side prunes before
+    bucketing — ``exchange_join_rows`` collapses and
+    ``exchange_join_rows_pruned`` counts the rows that never
+    bucketed/spilled/merged.
+
+    Leg 2 — high-cardinality group-by: a count+int-sum aggregation whose
+    stage-2 combine is reassociation-exact, so ``hierarchical_exchange_
+    combine`` folds the P-per-bucket map-side pieces to ~1 —
+    ``exchange_groupby_rows`` drops by ~n_parts.
+
+    Leg 3 — budgeted (out-of-core) exchange: a hash repartition of
+    low-cardinality payload under a memory budget small enough to spill.
+    ``exchange_payload_encoding`` engages only on budgeted queries (the
+    unbudgeted in-memory exchange would pay the encode pass for nothing),
+    shrinking both the ledgered and the spilled bytes
+    (``exchange_spill_bytes`` vs ``_raw``).
+    """
+    import string
+
+    import numpy as np
+
+    import daft_tpu as dt
+    from daft_tpu import col
+
+    rng = np.random.RandomState(13)
+    dim_keys = rng.choice(n_keys, size=int(n_keys * selectivity),
+                          replace=False)
+    dim = {"k": dim_keys.tolist(), "seg": (dim_keys % 7).tolist()}
+    alpha = np.array(list(string.ascii_lowercase))
+    comments = ["".join(alpha[rng.randint(0, 26, 32)]) for _ in range(4096)]
+    fact = {"k": rng.randint(0, n_keys, n_rows).tolist(),
+            "price": rng.rand(n_rows).tolist(),
+            "disc": rng.rand(n_rows).tolist(),
+            "comment": [comments[i % 4096] for i in range(n_rows)]}
+    gb = {"g": rng.randint(0, n_groups, n_rows).tolist(),
+          "c": rng.randint(0, 1000, n_rows).tolist()}
+    status = ["PENDING", "SHIPPED", "DELIVERED", "RETURNED"]
+    enc_rows = n_rows // 4
+    encd = {"k": rng.randint(0, 500, enc_rows).tolist(),
+            "s": [status[i % 4] for i in range(enc_rows)],
+            "v": rng.rand(enc_rows).tolist()}
+
+    cfg = dt.context.get_context().execution_config
+    knobs = ("runtime_join_filters", "exchange_payload_encoding",
+             "hierarchical_exchange_combine")
+    prev = {k: getattr(cfg, k) for k in knobs}
+    prev_cache = cfg.enable_result_cache
+    prev_budget = cfg.memory_budget_bytes
+    cfg.enable_result_cache = False
+
+    def run_join():
+        d = dt.from_pydict(dim).into_partitions(n_parts).collect()
+        f = dt.from_pydict(fact).into_partitions(n_parts).collect()
+        q = (d.join(f, on="k", how="inner", strategy="hash")
+             .groupby("seg")
+             .agg((col("price") * (1 - col("disc"))).sum().alias("rev"),
+                  col("comment").count().alias("nc")))
+        t0 = time.perf_counter()
+        q.collect()
+        return time.perf_counter() - t0, q.stats.snapshot()["counters"]
+
+    def run_groupby():
+        f = dt.from_pydict(gb).into_partitions(n_parts).collect()
+        q = f.groupby("g").agg(col("c").sum().alias("s"),
+                               col("c").count().alias("n"))
+        t0 = time.perf_counter()
+        q.collect()
+        return time.perf_counter() - t0, q.stats.snapshot()["counters"]
+
+    def run_encode():
+        f = dt.from_pydict(encd).into_partitions(n_parts).collect()
+        # budget sized well under the ~30 B/row payload so the exchange
+        # ALWAYS spills, whatever scale the rung runs at
+        cfg.memory_budget_bytes = max(64 * 1024, enc_rows * 8)
+        try:
+            q = f.repartition(n_parts, "k")
+            t0 = time.perf_counter()
+            q.collect()
+            return time.perf_counter() - t0, q.stats.snapshot()["counters"]
+        finally:
+            cfg.memory_budget_bytes = prev_budget
+
+    legs = {"join": run_join, "groupby": run_groupby, "encode": run_encode}
+    out: dict = {"rows": n_rows, "partitions": n_parts,
+                 "join_selectivity": selectivity}
+    try:
+        walls: dict = {(leg, m): [] for leg in legs for m in (False, True)}
+        counters: dict = {}
+        for _ in range(3):  # interleaved best-of
+            for mode in (False, True):
+                for k in knobs:
+                    setattr(cfg, k, mode)
+                for leg, fn in legs.items():
+                    w, c = fn()
+                    walls[(leg, mode)].append(w)
+                    counters[(leg, mode)] = c
+        for leg in legs:
+            on = counters[(leg, True)]
+            off = counters[(leg, False)]
+            rows_on = on.get("exchange_rows", 0)
+            rows_off = off.get("exchange_rows", 0)
+            out[f"exchange_{leg}_rows"] = rows_on
+            out[f"exchange_{leg}_rows_raw"] = rows_off
+            if rows_on:
+                out[f"exchange_{leg}_reduction_x"] = round(
+                    rows_off / rows_on, 2)
+            out[f"{leg}_exchange_bytes"] = on.get("exchange_bytes", 0)
+            t_on = min(walls[(leg, True)])
+            t_off = min(walls[(leg, False)])
+            out[f"exchange_{leg}_speedup_x"] = round(t_off / t_on, 3)
+            out[f"exchange_{leg}_wall_s"] = round(t_on, 4)
+        out["exchange_join_rows_pruned"] = counters[("join", True)].get(
+            "join_filter_rows_pruned", 0)
+        out["exchange_precombined_rows"] = counters[("groupby", True)].get(
+            "exchange_precombined_rows", 0)
+        enc_on = counters[("encode", True)]
+        enc_off = counters[("encode", False)]
+        out["exchange_bytes_encoded"] = enc_on.get("exchange_bytes_encoded", 0)
+        out["exchange_spill_bytes"] = enc_on.get("spill_write_bytes", 0)
+        out["exchange_spill_bytes_raw"] = enc_off.get("spill_write_bytes", 0)
+    finally:
+        for k, v in prev.items():
+            setattr(cfg, k, v)
+        cfg.enable_result_cache = prev_cache
+        cfg.memory_budget_bytes = prev_budget
+    return out
+
+
 def measure_serving(scale: float = 0.01, offered_qps: float = 6.0,
                     duration_s: float = 8.0, slots: int = 4,
                     queue_depth: int = 4) -> dict:
@@ -655,6 +796,13 @@ def run_device_rungs(scale: float) -> dict:
     except Exception as e:
         out["sketch_exchange_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # ---- exchange rung (host path; join-filter + encode + hierarchical-
+    # combine interleaved A/B, ISSUE 9 acceptance) --------------------------
+    try:
+        out["exchange"] = measure_exchange()
+    except Exception as e:
+        out["exchange_rung_error"] = f"{type(e).__name__}: {e}"[:200]
+
     # ---- serving rung (host path; sustained mixed load through the
     # ServingRuntime, ISSUE 8 acceptance) -----------------------------------
     try:
@@ -718,7 +866,13 @@ def _parquet_spill_rung(out: dict, scale: float, rtol: float) -> None:
         saved = {k: getattr(cfg, k) for k in (
             "memory_budget_bytes", "executor_threads", "scan_prefetch_depth",
             "async_spill_writes", "unspill_readahead",
-            "parallel_shuffle_fanout", "scan_tasks_min_size_bytes")}
+            "parallel_shuffle_fanout", "scan_tasks_min_size_bytes",
+            "exchange_payload_encoding")}
+        # this rung measures the SPILL pipeline (IO overlap A/B), so the
+        # exchange encoder stands down: lineitem's low-cardinality columns
+        # encode ~2x and at small scales the shrunken ledger charge stops
+        # the buffers spilling at all — the exchange rung measures encoding
+        cfg.exchange_payload_encoding = False
         # per-file scan tasks (no merging), BOTH modes: 16 x ~36MB units
         # instead of 6 x ~108MB merged ones. Finer grain pipelines better
         # AND collapses run-to-run variance — with merged tasks the same
@@ -957,6 +1111,10 @@ def _host_fallback(scale: float) -> dict:
         out["sketch_exchange"] = measure_sketch_exchange()
     except Exception as e:
         out["sketch_exchange_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:  # exchange rung (ISSUE 9) is pure host work: fallback too
+        out["exchange"] = measure_exchange()
+    except Exception as e:
+        out["exchange_rung_error"] = f"{type(e).__name__}: {e}"[:200]
     try:  # serving rung is pure host work: it rides the fallback too
         out["serving"] = measure_serving()
     except Exception as e:

@@ -97,6 +97,26 @@ class ExecutionConfig:
     # partitions). False restores the raw-row shuffle/gather path (the
     # before/after axis bench.py's sketch_exchange rung measures).
     sketch_aggregations: bool = True
+    # --- exchange v2 (daft_tpu/exchange/, README "Exchange") --------------
+    # runtime join filters (sideways information passing): the join build
+    # side's exchange builds a Bloom + min-max filter from its keys and the
+    # probe side's exchange (or the broadcast-join probe stream) prunes
+    # non-qualifying rows BEFORE bucketing, spill, and merge. Semantics
+    # gated per join type (inner/semi: either side; left: right side only;
+    # right/anti/outer: decline); false-positive tolerant — the join
+    # re-checks every surviving row, so results are byte-identical off.
+    runtime_join_filters: bool = True
+    # dictionary-encode low-cardinality columns of fanout bucket pieces
+    # before they enter the spillable PartitionBuffer (per-column
+    # cardinality sampling skips hostile columns; spilled exchange bytes
+    # shrink too); decode happens once, at reduce-merge. Byte-identical off.
+    exchange_payload_encoding: bool = True
+    # hierarchical exchange: two-stage aggregations fold map-side pieces
+    # headed to the same destination through the stage-2 combine BEFORE
+    # the exchange buffers them (intra-host combine -> inter-host
+    # all_to_all; mirrored on the mesh path ahead of the ICI collective).
+    # Only schema-closed decomposable merges fold; byte-identical off.
+    hierarchical_exchange_combine: bool = True
     # TPU-specific: route eligible projections/aggregations through the jax
     # device kernel layer (kernels/device.py); host pyarrow path otherwise.
     use_device_kernels: bool = False

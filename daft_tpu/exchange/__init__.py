@@ -1,0 +1,42 @@
+# daftlint: migrated
+"""Exchange v2: the reduction/encoding pipeline of the all-to-all exchange.
+
+HPTMT's operator-based architecture (PAPERS.md) argues the exchange should
+be a first-class operator with its own reduction pipeline rather than a
+dumb row mover. This package holds the three legs, each behind its own
+``ExecutionConfig`` knob (default on) and each carrying the hard invariant
+*results are byte-identical with the knob off*:
+
+- :mod:`joinfilter` — runtime join filters (sideways information passing):
+  a Bloom + min-max filter built from the join build side's keys prunes
+  probe-side rows BEFORE they are bucketed, spilled, or merged
+  (``cfg.runtime_join_filters``);
+- :mod:`encode` — dictionary-encoded exchange payloads: low-cardinality
+  columns of fanout buckets shrink before they enter the spillable
+  PartitionBuffer, and decode only at reduce-merge
+  (``cfg.exchange_payload_encoding``);
+- :mod:`combine` — hierarchical exchange: map-side pieces headed to the
+  same destination fold through the stage-2 combine BEFORE the exchange
+  (intra-host combine -> inter-host all_to_all, mirrored on the mesh path
+  in parallel/mesh_exec.py) (``cfg.hierarchical_exchange_combine``).
+
+Correctness never depends on any leg: the join filter is false-positive-
+tolerant (the join itself re-checks), a failed filter build or payload
+encode (fault sites ``join.filter`` / ``exchange.encode``) degrades to the
+unfiltered/unencoded exchange, and the combine is gated to schema-closed
+decomposable merge stages.
+"""
+
+from .combine import BucketCombiner, combine_spec_applicable
+from .encode import EncodedExchangeTask, encode_exchange_partition
+from .joinfilter import JoinFilterBuilder, JoinFilterSlot, RuntimeJoinFilter
+
+__all__ = [
+    "BucketCombiner",
+    "combine_spec_applicable",
+    "EncodedExchangeTask",
+    "encode_exchange_partition",
+    "JoinFilterBuilder",
+    "JoinFilterSlot",
+    "RuntimeJoinFilter",
+]

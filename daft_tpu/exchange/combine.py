@@ -1,0 +1,216 @@
+# daftlint: migrated
+"""Hierarchical exchange: apply the stage-2 combine BEFORE the exchange.
+
+A two-stage aggregation ships one stage-1 partial row per (source
+partition x group) through the hash exchange; every destination bucket
+then holds P pieces that the reduce side merges. Folding the pieces
+headed to the SAME destination with the stage-2 combine as they arrive
+(Xorbits' intra-host combine -> inter-host all_to_all shape, PAPERS.md)
+shrinks what the exchange buffers, ledgers, spills, and merges from
+P x groups rows to ~groups rows per bucket. parallel/mesh_exec.py mirrors
+the same pre-combine on the local contribution ahead of the ICI
+all_to_all.
+
+Byte-identity contract (``hierarchical_exchange_combine`` off must be
+byte-identical): the fold keeps ONE running partial per bucket and always
+re-aggregates ``[running_partial, new pieces...]`` with the partial's rows
+FIRST, so group output order (first-occurrence) is preserved by
+induction. FLOAT SUMS DECLINE the combine entirely: the engine's grouped
+sum kernel (threaded acero) reassociates float additions across morsel
+boundaries, so folding would shift results at the last ulp — integer/
+count sums, min/max, concat, and sketch register merges are exact under
+any reassociation and fold freely (any_value ALSO declines: which value
+"one" picks is input-shape-dependent, see COMBINABLE_KINDS).
+
+Applicability is decided at translate time (:func:`combine_spec_applicable`):
+every stage-2 kind must be a decomposable merge that is exact under
+reassociation, and the merge's output schema must equal the exchanged
+schema (schema-closed fold).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..micropartition import MicroPartition
+
+# fold cadence: pieces staged per bucket before a re-aggregation pass (the
+# running partial always rides first, so cadence only trades fold CPU
+# against staged-piece memory — it cannot change results). 16 keeps the
+# fold work near ONE extra agg per bucket at typical fan-ins (a low
+# cadence measurably doubled the agg work on the bench groupby leg) while
+# still bounding staged-piece memory at high partition counts.
+FOLD_EVERY = 16
+
+# stage-2 kinds the fold may apply early: order-insensitive exact merges
+# (min/max/sketch register max), exact accumulations (integer sums — float
+# sums are gated by dtype below), and order-preserving concatenations
+# (concat). any_value declines: its "pick one" is input-shape-dependent,
+# so a fold could change which value survives.
+COMBINABLE_KINDS = {"sum", "min", "max", "concat",
+                    "merge_sketch_hll", "merge_sketch_quantile"}
+
+# runtime abandon gate: a fold that keeps more than this fraction of its
+# input rows is not reducing (near-unique grouping keys) — the running
+# partial would converge to the full bucket contents and sit resident
+# OUTSIDE the ledgered/spillable PartitionBuffers until stream end, so
+# the combiner abandons and hands everything to the buffers, which can
+# spill under the memory budget.
+ABANDON_MIN_SHRINK = 0.75
+
+
+def combine_spec_applicable(stage2, key_cols, exchanged_schema) -> bool:
+    """Translate-time gate: True when folding `stage2` early over pieces of
+    `exchanged_schema` is closed (output schema == input schema) and every
+    aggregation kind is a known-safe merge that is EXACT under
+    reassociation — float sums decline (the threaded grouped-sum kernel's
+    addition order depends on chunking, so an early fold would drift the
+    last ulp and break the byte-identity contract)."""
+    from ..expressions import AggExpr, Alias
+    from ..physical import _stage_schema
+
+    for e in stage2:
+        node = e._node
+        while isinstance(node, Alias):
+            node = node.child
+        if not (isinstance(node, AggExpr) and node.kind in COMBINABLE_KINDS):
+            return False
+        if node.kind == "sum":
+            try:
+                dt = node.to_field(exchanged_schema).dtype
+            except Exception:
+                return False
+            if not dt.is_integer():
+                return False
+    try:
+        out_schema = _stage_schema(exchanged_schema, stage2, key_cols)
+    except Exception:
+        return False
+    return out_schema == exchanged_schema
+
+
+class BucketCombiner:
+    """Per-destination running partials for one shuffle's fanout.
+
+    ``add(bucket, piece)`` stages a piece; every FOLD_EVERY staged pieces
+    the bucket re-aggregates ``[partial] + staged`` into a new single
+    partial. ``finish()`` folds the remainders and yields
+    ``(bucket, partial)`` for every touched bucket — the only rows that
+    enter the exchange. A fold failure abandons the combiner for the whole
+    shuffle: every staged piece (and prior partials — they are valid
+    partial aggregations of their inputs) is handed back unfolded, which
+    keeps results correct because the reduce-side stage 2 merges partials
+    of ANY granularity.
+
+    Staged bytes live outside the spillable PartitionBuffers, so they are
+    charged to the query's MemoryLedger while resident (released as they
+    fold away or leave) and two runtime gates bound them: a fold that
+    shrinks worse than ABANDON_MIN_SHRINK abandons (near-unique keys — the
+    partial would converge to the whole bucket), and under a byte budget
+    the combiner abandons once its resident payload passes half the budget
+    (the remaining headroom belongs to the buffers, which CAN spill)."""
+
+    def __init__(self, aggs, keys, stats=None, ledger=None, budget=None):
+        self.aggs = list(aggs)
+        self.keys = list(keys)
+        self.stats = stats
+        self.ledger = ledger
+        self.budget = budget
+        self._staged: Dict[int, List[MicroPartition]] = {}
+        self._staged_bytes: Dict[int, int] = {}
+        self._held = 0
+        self._failed = False
+
+    @property
+    def failed(self) -> bool:
+        return self._failed
+
+    def _charge(self, bucket: int, piece: MicroPartition) -> None:
+        b = piece.size_bytes() or 0
+        if b:
+            self._staged_bytes[bucket] = self._staged_bytes.get(bucket, 0) + b
+            self._held += b
+            if self.ledger is not None:
+                self.ledger.add(b)
+
+    def _release(self, bucket: int) -> None:
+        b = self._staged_bytes.pop(bucket, 0)
+        if b:
+            self._held -= b
+            if self.ledger is not None:
+                self.ledger.sub(b)
+
+    def _abandon(self) -> List[Tuple[int, MicroPartition]]:
+        self._failed = True
+        out = [(b, p) for b in sorted(self._staged)
+               for p in self._staged[b]]
+        self._staged = {}
+        for b in list(self._staged_bytes):
+            self._release(b)
+        return out
+
+    def add(self, bucket: int, piece: MicroPartition
+            ) -> Optional[List[Tuple[int, MicroPartition]]]:
+        """Stage one fanout piece. Returns None normally; on a fold
+        failure or an abandon gate (poor shrink / budget pressure),
+        returns every staged ``(bucket, partition)`` so the caller
+        appends them raw (and stops combining)."""
+        staged = self._staged.setdefault(bucket, [])
+        staged.append(piece)
+        self._charge(bucket, piece)
+        if len(staged) >= FOLD_EVERY + 1:
+            folded = self._fold(staged)
+            if folded is None:
+                return self._abandon()
+            self._release(bucket)
+            self._staged[bucket] = [folded]
+            self._charge(bucket, folded)
+        if self.budget is not None and self._held > self.budget // 2:
+            # staged partials cannot spill: past half this query's byte
+            # budget, hand them to the spillable buffers instead
+            return self._abandon()
+        return None
+
+    def finish(self):
+        """Fold remainders; yields (bucket, partial) in bucket order."""
+        for b in sorted(self._staged):
+            staged = self._staged[b]
+            self._release(b)
+            if len(staged) == 1:
+                yield b, staged[0]
+                continue
+            folded = self._fold(staged)
+            if folded is None:
+                for p in staged:
+                    yield b, p
+                continue
+            yield b, folded
+        self._staged = {}
+
+    def _fold(self, staged: List[MicroPartition]) -> Optional[MicroPartition]:
+        from ..errors import DaftTransientError
+
+        in_rows = sum(len(p) for p in staged)
+        try:
+            merged = (MicroPartition.concat(staged) if len(staged) > 1
+                      else staged[0])
+            out = merged.agg(self.aggs, self.keys)
+            if out.schema != merged.schema:
+                return None  # fold not schema-closed at runtime: abandon
+        except DaftTransientError:
+            # a transient merge failure (e.g. the sketch.merge fault site)
+            # keeps its engine-wide contract — surface to the caller, the
+            # same outcome the reduce-side merge would have had; only fold
+            # INFEASIBILITY degrades to raw appends
+            raise
+        except Exception:
+            return None
+        if len(out) > ABANDON_MIN_SHRINK * in_rows:
+            # the fold barely shrank anything — grouping keys are
+            # near-unique, so keeping the partial would just accumulate the
+            # whole bucket un-spillably; treat as infeasible
+            return None
+        if self.stats is not None:
+            self.stats.bump("exchange_precombined_rows",
+                            max(0, in_rows - len(out)))
+        return out

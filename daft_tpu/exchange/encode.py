@@ -1,0 +1,174 @@
+# daftlint: migrated
+"""Encoded exchange payloads: dictionary-encode low-cardinality columns of
+fanout buckets BEFORE they enter the spillable PartitionBuffer.
+
+A shuffle bucket holds pieces of many source partitions until the reduce
+side merges them; those pieces are what the memory ledger charges and what
+spills to disk under a budget. Low-cardinality columns (join keys against
+small dimensions, flags, dates, region/status strings) dictionary-encode
+to a fraction of their raw width, so both the engine-held bytes and the
+spilled IPC bytes shrink — arrow IPC writes dictionary arrays natively, so
+a spilled encoded bucket stays encoded on disk (spill.py's writer accepts
+the encoded arrow payload via the ``encoded_payload`` task hook).
+
+Per-column cardinality sampling skips hostile columns: a prefix sample's
+distinct count must stay under SAMPLE_MAX_RATIO of the sample, and the
+encoded column must actually be smaller than the raw one, or the column
+ships raw. A piece where no column wins ships fully raw (``None`` from
+:func:`encode_exchange_partition`).
+
+Decode happens exactly once, at reduce-merge: the encoded piece is an
+UNLOADED MicroPartition whose task materializes by decoding — the same
+lazy contract spilled partitions already follow, so drain/readahead/concat
+all compose unchanged. Any failure while encoding (including the
+``exchange.encode`` fault site) degrades to the raw piece — never a query
+failure. Results are byte-identical with ``exchange_payload_encoding``
+off: dictionary round-trips are exact.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..micropartition import MicroPartition
+
+# pieces below this many rows are not worth the encode pass
+ENCODE_MIN_ROWS = 64
+# cardinality sampling: prefix sample size and the distinct/sample ratio
+# above which a column is hostile (near-unique) and ships raw
+SAMPLE_ROWS = 1024
+SAMPLE_MAX_RATIO = 0.5
+
+
+class EncodedExchangeTask:
+    """Scan-task-shaped holder for one encoded exchange piece: an arrow
+    table whose low-cardinality columns are dictionary-encoded, plus the
+    engine schema to decode back into. ``read()`` is the decode (runs at
+    reduce-merge or unspill); ``encoded_payload()`` is the spill writer's
+    hook for writing the encoded representation to disk as-is."""
+
+    def __init__(self, atbl, schema, raw_bytes: int):
+        self._atbl = atbl
+        self.schema = schema
+        self.raw_bytes = raw_bytes
+        self.stats = None  # scan-task TableStats surface (none)
+
+    # --- ScanTask metadata surface used by MicroPartition ----------------
+    @property
+    def materialized_schema(self):
+        return self.schema
+
+    def num_rows(self) -> Optional[int]:
+        return self._atbl.num_rows
+
+    def size_bytes(self) -> Optional[int]:
+        return self._atbl.nbytes
+
+    def read(self):
+        """Decode back to an engine Table with the exact original dtypes."""
+        import pyarrow as pa
+
+        from ..series import Series
+        from ..table import Table
+
+        cols = []
+        for f, name in zip(self.schema, self._atbl.column_names):
+            arr = self._atbl.column(name)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            if pa.types.is_dictionary(arr.type):
+                arr = arr.dictionary_decode()
+            cols.append(Series.from_arrow(arr, f.name, f.dtype))
+        return Table(self.schema, cols)
+
+    def read_chunks(self) -> List:
+        return [self.read()]
+
+    def encoded_payload(self) -> List:
+        """The encoded arrow tables for the spill writer (IPC preserves
+        dictionary encoding, so spilled exchange bytes shrink too)."""
+        return [self._atbl]
+
+    # head()/select on unloaded partitions route through pushdowns; exchange
+    # pieces never see them in practice, but keep the surface total
+    @property
+    def pushdowns(self):
+        from ..io.scan import Pushdowns
+
+        return Pushdowns()
+
+    def with_pushdowns(self, pd):
+        from ..spill import _SpillSlotView
+
+        return _SpillSlotView(self, pd)
+
+    def __repr__(self) -> str:
+        return (f"EncodedExchangeTask(rows={self._atbl.num_rows}, "
+                f"bytes={self._atbl.nbytes}/{self.raw_bytes})")
+
+
+def _encode_column(arr):
+    """Dictionary-encode one arrow array when sampling says it pays;
+    returns the encoded array or None (ship raw)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    t = arr.type
+    if (pa.types.is_dictionary(t) or pa.types.is_nested(t)
+            or pa.types.is_null(t)):
+        return None
+    n = len(arr)
+    sample = arr.slice(0, min(n, SAMPLE_ROWS))
+    try:
+        distinct = pc.count_distinct(sample).as_py() or 0
+    except Exception:
+        return None  # dtype without a distinct kernel: hostile by default
+    if distinct > max(16, int(len(sample) * SAMPLE_MAX_RATIO)):
+        return None
+    enc = arr.dictionary_encode()
+    if enc.nbytes >= arr.nbytes:
+        return None  # sampling lied (hostile tail): keep raw
+    return enc
+
+
+def encode_exchange_partition(part: MicroPartition,
+                              stats=None) -> Optional[MicroPartition]:
+    """Encode one fanout piece; returns the encoded (unloaded, lazily
+    decoding) MicroPartition, or None when the piece is too small, has no
+    winning column, or holds python-typed data. Raises only for the
+    caller's fault-degradation contract (the ShuffleOp wraps this in a
+    catch that falls back to the raw piece)."""
+    import pyarrow as pa
+
+    from .. import faults
+
+    n = part.num_rows_or_none() or 0
+    if n < ENCODE_MIN_ROWS:
+        return None
+    faults.check("exchange.encode", stats)
+    tbl = part.table()
+    arrays = []
+    won = False
+    for s in tbl.columns():
+        if s.is_python():
+            return None  # no arrow representation: piece ships raw
+        arr = s.to_arrow()
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        enc = _encode_column(arr)
+        if enc is not None:
+            won = True
+            arrays.append(enc)
+        else:
+            arrays.append(arr)
+    if not won:
+        return None
+    atbl = pa.Table.from_arrays(
+        arrays, names=[f.name for f in tbl.schema])
+    raw = tbl.size_bytes()
+    if atbl.nbytes >= raw:
+        return None
+    task = EncodedExchangeTask(atbl, part.schema, raw)
+    out = MicroPartition.from_scan_task(task)
+    out.owner_process = part.owner_process
+    return out

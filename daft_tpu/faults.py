@@ -56,6 +56,12 @@ SITES = {
     "fuse.compile": "each map-chain fusion compile (daft_tpu/fuse/; a "
                     "compile-time failure falls back to the unfused op "
                     "chain, never a query failure)",
+    "join.filter": "each runtime-join-filter build feed / probe prune "
+                   "(daft_tpu/exchange/joinfilter.py; any failure degrades "
+                   "to the unfiltered exchange, never a query failure)",
+    "exchange.encode": "each exchange-payload encode attempt "
+                       "(daft_tpu/exchange/encode.py; a failure ships the "
+                       "piece raw, never a query failure)",
 }
 
 

@@ -124,9 +124,12 @@ def _hash_fixed(arr: pa.Array, seeds: np.ndarray) -> np.ndarray:
 
 
 def _values_np(arr: pa.Array) -> np.ndarray:
-    """Physical values of a primitive arrow array as numpy (nulls filled arbitrarily)."""
-    if arr.null_count:
-        arr = pc.fill_null(arr, _zero_scalar(arr.type))
+    """Physical values of a primitive arrow array as numpy (nulls filled
+    arbitrarily). Temporal storage casts to its integer physical type
+    BEFORE the null fill: pyarrow has no int->date32 scalar cast, so
+    filling a nullable date column first crashed every hash
+    shuffle/join/filter keyed on it (caught by the exchange byte-identity
+    matrix)."""
     if pa.types.is_date32(arr.type):
         arr = arr.cast(pa.int32())
     elif pa.types.is_date64(arr.type):
@@ -135,6 +138,8 @@ def _values_np(arr: pa.Array) -> np.ndarray:
         arr = arr.cast(pa.int64())
     elif pa.types.is_time(arr.type):
         arr = arr.cast(pa.int64() if arr.type.bit_width == 64 else pa.int32())
+    if arr.null_count:
+        arr = pc.fill_null(arr, _zero_scalar(arr.type))
     return np.asarray(arr)
 
 

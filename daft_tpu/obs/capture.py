@@ -106,11 +106,41 @@ def render_runtime_stats(stats) -> str:
             f"fusion: {counters['fused_chains']} FusedMap chain(s), "
             f"{counters.get('fused_ops_eliminated', 0)} op(s) eliminated"
             f", {counters.get('cse_hits', 0)} cse hit(s)")
+    exch = _render_exchange_line(counters)
+    if exch:
+        lines.append("")
+        lines.append(exch)
     if counters:
         lines.append("")
         lines.append("counters: " + ", ".join(
             f"{k}={v}" for k, v in sorted(counters.items())))
     return "\n".join(lines)
+
+
+def _render_exchange_line(counters: dict) -> str:
+    """The explain_analyze 'exchange:' line (README "Exchange"): join-filter
+    effectiveness ('pruned N of M probe rows'), encoded-vs-raw payload
+    bytes, and pre-exchange combine folds. Empty when nothing fired."""
+    parts = []
+    if counters.get("join_filter_built"):
+        pruned = counters.get("join_filter_rows_pruned", 0)
+        probed = counters.get("join_filter_probe_rows", 0)
+        parts.append(
+            f"join filters: pruned {pruned:,} of {probed:,} probe rows "
+            f"({counters['join_filter_built']} filter(s))")
+    enc = counters.get("exchange_bytes_encoded", 0)
+    # denominator = raw bytes of the pieces the encoder actually saw (NOT
+    # exchange_bytes, which also counts gathers and encode-disabled paths)
+    raw = counters.get("exchange_bytes_encodable", 0)
+    if counters.get("exchange_pieces_encoded") and raw:
+        parts.append(
+            f"encode: {raw:,} -> {enc:,} B ({enc / raw:.0%}, "
+            f"{counters['exchange_pieces_encoded']} piece(s))")
+    if counters.get("exchange_precombined_rows"):
+        parts.append(
+            f"combine: {counters['exchange_precombined_rows']:,} row(s) "
+            "folded pre-exchange")
+    return ("exchange: " + " · ".join(parts)) if parts else ""
 
 
 # a bundle directory name: <stamp>_<query id>_<outcome>. Retention ONLY

@@ -262,7 +262,8 @@ class MeshExecutionContext(ExecutionContext):
 
     def try_device_shuffle(self, parts: List[MicroPartition], by, num: int,
                            scheme: str, descending=None, nulls_first=None,
-                           boundaries=None) -> Optional[List[MicroPartition]]:
+                           boundaries=None,
+                           combine=None) -> Optional[List[MicroPartition]]:
         """All-to-all shuffle over the mesh; None if ineligible (unsupported
         scheme, non-device payload dtype, empty input, missing boundaries),
         if the collective breaker is open, or if the exchange itself fails
@@ -287,7 +288,7 @@ class MeshExecutionContext(ExecutionContext):
                                           kind="phase"):
                 out = self._device_shuffle_impl(parts, by, num, scheme,
                                                 descending, nulls_first,
-                                                boundaries)
+                                                boundaries, combine)
         except Exception:
             self.collective_health.record_failure(self.stats)
             return None
@@ -299,7 +300,8 @@ class MeshExecutionContext(ExecutionContext):
 
     def _device_shuffle_impl(self, parts: List[MicroPartition], by, num: int,
                              scheme: str, descending=None, nulls_first=None,
-                             boundaries=None) -> Optional[List[MicroPartition]]:
+                             boundaries=None,
+                             combine=None) -> Optional[List[MicroPartition]]:
         n = self.n_devices
         if scheme not in ("hash", "random", "range"):
             return None
@@ -345,6 +347,24 @@ class MeshExecutionContext(ExecutionContext):
             merged = Table.concat(tables) if len(tables) != 1 else tables[0]
         else:
             merged = Table.empty(schema)
+        precombined = 0
+        if combine is not None and len(merged):
+            # hierarchical exchange, mesh mirror: fold THIS process's local
+            # contribution through the stage-2 combine ahead of the ICI
+            # all_to_all — the local rows ride the collective pre-reduced
+            # (intra-host combine -> inter-host all_to_all). Schema-closure
+            # was gated at translate time; re-check and decline on drift.
+            try:
+                folded = merged.agg(list(combine[0]), list(combine[1]))
+            except Exception:
+                folded = None
+            if folded is not None and folded.schema == merged.schema:
+                # counted only on exchange SUCCESS (see the bumps before
+                # return) — a late collective failure falls back to the
+                # host path, which re-counts everything
+                precombined = len(merged) - len(folded)
+                merged = folded
+                total = len(merged)
         step = -(-total // nchunks) if total else 0
         chunks = [merged.slice(min(i * step, total), min((i + 1) * step, total))
                   for i in range(nchunks)]
@@ -567,6 +587,20 @@ class MeshExecutionContext(ExecutionContext):
             for f, dc in zip(schema, staged):
                 cache[(f.name, bucket, x64_enabled())] = dc
             results.append(part)
+        # actual exchanged payload, symmetric with the host path's
+        # bucket-append accounting: the rows/bytes THIS process staged onto
+        # the collective (post pre-combine) — not the pre-materialization
+        # estimate the old device branch reported. Bumped only HERE, after
+        # the whole exchange (collective + unstage) succeeded: an earlier
+        # bump would double-count with the host fallback's re-count when a
+        # late failure makes try_device_shuffle return None.
+        if total:
+            self.stats.bump("exchange_rows", total)
+            mb = merged.size_bytes()
+            if mb:
+                self.stats.bump("exchange_bytes", mb)
+        if precombined:
+            self.stats.bump("exchange_precombined_rows", precombined)
         return results
 
     # ------------------------------------------------------------------

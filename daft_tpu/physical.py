@@ -450,7 +450,21 @@ class CoalesceOp(PhysicalOp):
 
 class ShuffleOp(PhysicalOp):
     """Fanout+reduce all-to-all exchange (reference: FanoutInstruction +
-    ReduceMerge, physical_plan.py:1365). scheme: hash | random | range."""
+    ReduceMerge, physical_plan.py:1365). scheme: hash | random | range.
+
+    Exchange v2 (daft_tpu/exchange/, README "Exchange"): the translate
+    wiring may attach a runtime-join-filter slot this exchange FEEDS
+    (build side) or PRUNES WITH (probe side), and/or a stage-2 combine
+    spec that folds map-side pieces per destination before they buffer.
+    Bucket pieces additionally dictionary-encode before entering the
+    spillable PartitionBuffer. Every leg is knob-gated and byte-identical
+    off."""
+
+    # exchange v2 attachments (class-level defaults keep every other
+    # construction site unchanged)
+    filter_feed = None    # JoinFilterSlot this build-side exchange populates
+    probe_filter = None   # JoinFilterSlot whose sealed filter prunes here
+    combine = None        # (stage2_aggs, key_cols) pre-exchange fold spec
 
     def __init__(self, child: PhysicalOp, scheme: str, num: int,
                  by: Optional[List[Expression]] = None,
@@ -463,8 +477,73 @@ class ShuffleOp(PhysicalOp):
         self.descending = descending or [False] * len(self.by)
         self.nulls_first = nulls_first if nulls_first is not None else [None] * len(self.by)
 
+    def _feed_filter(self, stream, ctx) -> PartStream:
+        """Build-side pass-through: fold every streamed partition's join
+        keys into the slot's builder; seal at stream end (the join op
+        drains this side fully before the probe side's exchange runs).
+        Any failure — including the ``join.filter`` fault site — abandons
+        the filter; the exchange itself is untouched (fail-open)."""
+        from . import faults
+
+        slot = self.filter_feed
+        if not getattr(ctx.cfg, "runtime_join_filters", True) \
+                or not slot.eligible:
+            slot.abandon()
+            yield from stream
+            return
+        slot.begin()
+        for p in stream:
+            if ctx.foreign_owned(p):
+                # multi-host scan locality: this process must not read the
+                # partition, and a locally-built filter would miss foreign
+                # build keys (a WRONG prune) — abandon entirely
+                slot.abandon()
+            else:
+                try:
+                    faults.check("join.filter", ctx.stats)
+                    for t in p.chunk_tables():
+                        slot.feed(t)
+                except Exception:
+                    slot.abandon()
+                    ctx.stats.bump("join_filter_errors")
+            yield p
+        try:
+            slot.seal()
+        except Exception:
+            slot.abandon()
+            ctx.stats.bump("join_filter_errors")
+        if slot.filter() is not None:
+            ctx.stats.bump("join_filter_built")
+
+    def _prune_stream(self, stream, ctx) -> PartStream:
+        """Probe-side pass-through: prune each partition with the sealed
+        build-side filter BEFORE bucketing/spill/merge. The slot is
+        consulted per partition (None — unsealed, abandoned, disabled —
+        passes rows through untouched)."""
+        from .exchange.joinfilter import prune_partition
+
+        slot = self.probe_filter
+        for p in stream:
+            jf = slot.filter()
+            if jf is None or (ctx.foreign_owned(p) and not p.is_loaded()):
+                # foreign-owned (multi-host scan locality): pruning would
+                # force this process to read a partition another host owns
+                # — the mesh exchange skips it by owner instead
+                yield p
+            else:
+                yield prune_partition(p, jf, self.by, ctx)
+
     def execute(self, inputs, ctx) -> PartStream:
         n = self.num
+        src = inputs[0]
+        if self.filter_feed is not None:
+            src = self._feed_filter(src, ctx)
+        if self.probe_filter is not None \
+                and getattr(ctx.cfg, "runtime_join_filters", True):
+            src = self._prune_stream(src, ctx)
+        combine = (self.combine if self.combine is not None and
+                   getattr(ctx.cfg, "hierarchical_exchange_combine", True)
+                   else None)
         # Mesh path: one all_to_all collective over ICI instead of host fanout
         # (parallel/mesh_exec.py); falls through to host on ineligibility.
         # Range shuffles sample their boundaries host-side first (reference:
@@ -473,7 +552,7 @@ class ShuffleOp(PhysicalOp):
         dev_shuffle = getattr(ctx, "try_device_shuffle", None)
         pre_boundaries = None
         if dev_shuffle is not None and self.scheme in ("hash", "random", "range"):
-            parts = [p for p in inputs[0]]
+            parts = [p for p in src]
             if not parts:
                 return
             if self.scheme == "range":
@@ -487,23 +566,67 @@ class ShuffleOp(PhysicalOp):
                                for p in parts]
                     pre_boundaries = boundaries_from_samples(
                         samples, self.by, n, self.descending, self.nulls_first)
+            # exchange_rows/exchange_bytes are counted INSIDE the mesh
+            # exchange (actual staged payload, post pre-combine) so the
+            # device and host paths report the same thing
             out = dev_shuffle(parts, self.by, n, self.scheme, self.descending,
-                              self.nulls_first, pre_boundaries)
+                              self.nulls_first, pre_boundaries,
+                              combine=combine)
             if out is not None:
-                ctx.stats.bump("exchange_rows", sum(len(p) for p in parts))
-                ctx.stats.bump("exchange_bytes",
-                               sum((p.size_bytes() or 0) for p in parts
-                                   if p.is_loaded()))
                 yield from out
                 return
             stream = iter(parts)
         else:
-            stream = inputs[0]
-        # every row crossing the exchange is counted (exchange_rows): the
-        # sketch subsystem's acceptance metric is that approx aggs ship
-        # O(sketch_size x partitions) stage-1 rows here instead of raw input
-        stream = _counted(stream, ctx, "exchange_rows")
+            stream = src
         buckets = [ctx.partition_buffer() for _ in range(n)]
+        # payload encoding engages on BUDGETED queries only: that is where
+        # exchanged bytes gate throughput (ledger pressure -> spill IO, and
+        # spilled encoded buckets stay encoded on disk). On an unbudgeted
+        # in-memory exchange the encode/decode pass is pure overhead
+        # (measured ~1.6x on the bench exchange rung), so it stands down.
+        encode = (getattr(ctx.cfg, "exchange_payload_encoding", True)
+                  and ctx.memory_budget is not None)
+        comb = None
+        if combine is not None:
+            from .exchange.combine import BucketCombiner
+
+            comb = BucketCombiner(combine[0], combine[1], ctx.stats,
+                                  ledger=ctx.ledger,
+                                  budget=ctx.memory_budget)
+
+        def exchange_append(i: int, piece: MicroPartition) -> None:
+            # every row/byte ACTUALLY crossing the exchange is counted here
+            # — post filter-prune and pre-combine fold, so the counters are
+            # the real exchanged payload on both the host and mesh paths
+            # (the sketch subsystem's acceptance metric reads these)
+            nrows = piece.num_rows_or_none()
+            if nrows:
+                ctx.stats.bump("exchange_rows", nrows)
+            raw = piece.size_bytes() or 0
+            if raw:
+                ctx.stats.bump("exchange_bytes", raw)
+            if encode:
+                enc_bytes = raw
+                try:
+                    from .exchange.encode import encode_exchange_partition
+
+                    enc = encode_exchange_partition(piece, ctx.stats)
+                except Exception:
+                    enc = None
+                    ctx.stats.bump("exchange_encode_failures")
+                if enc is not None:
+                    piece = enc
+                    enc_bytes = piece.size_bytes() or raw
+                    ctx.stats.bump("exchange_pieces_encoded")
+                # the encoded-vs-raw ratio needs a denominator covering the
+                # SAME pieces (exchange_bytes also counts gathers and
+                # encode-disabled shuffles)
+                if raw:
+                    ctx.stats.bump("exchange_bytes_encodable", raw)
+                if enc_bytes:
+                    ctx.stats.bump("exchange_bytes_encoded", enc_bytes)
+            buckets[i].append(piece)
+
         saw = False
         # the whole map-side fanout (decode + hash/split + bucket appends)
         # runs inside the FIRST pull of this op: make it a named phase on
@@ -535,7 +658,7 @@ class ShuffleOp(PhysicalOp):
                             p.partition_by_range(self.by, boundaries,
                                                  self.descending,
                                                  self.nulls_first)):
-                        buckets[min(i, n - 1)].append(piece)
+                        exchange_append(min(i, n - 1), piece)
             else:
                 def fanout(p, pi):
                     if self.scheme == "hash":
@@ -546,7 +669,20 @@ class ShuffleOp(PhysicalOp):
                                              _subtree_may_yield_unloaded(self)):
                     saw = True
                     for i, piece in enumerate(pieces):
-                        buckets[i].append(piece)
+                        if comb is not None and not comb.failed:
+                            flushed = comb.add(i, piece)
+                            if flushed is not None:
+                                # fold failed: everything staged so far is
+                                # appended raw, combining stops for this
+                                # shuffle (results stay correct — stage 2
+                                # merges partials of any granularity)
+                                for b, part in flushed:
+                                    exchange_append(b, part)
+                        else:
+                            exchange_append(i, piece)
+                if comb is not None:
+                    for b, part in comb.finish():
+                        exchange_append(b, part)
         if not saw:
             return
         ctx.stats.bump("shuffles")
@@ -566,7 +702,16 @@ class ShuffleOp(PhysicalOp):
 
     def describe(self):
         by = ", ".join(e._node.display() for e in self.by)
-        return f"Shuffle[{self.scheme}] -> {self.num}" + (f" by [{by}]" if by else "")
+        tags = []
+        if self.filter_feed is not None:
+            tags.append("join-filter-feed")
+        if self.probe_filter is not None:
+            tags.append("join-filter-probe")
+        if self.combine is not None:
+            tags.append("combine")
+        tag = f" <{'+'.join(tags)}>" if tags else ""
+        return (f"Shuffle[{self.scheme}] -> {self.num}"
+                + (f" by [{by}]" if by else "") + tag)
 
 
 def _subtree_may_yield_unloaded(op: PhysicalOp) -> bool:
@@ -985,6 +1130,45 @@ class BroadcastJoinOp(PhysicalOp):
         self.small_is_left = small_is_left
         self.suffix = suffix
 
+    def _filter_prunable(self) -> bool:
+        """Whether the streamed (big) side may be pruned by a filter built
+        from the replicated side's keys — the shared per-join-type gate
+        (exchange.joinfilter.PRUNABLE); the probe here is the big side,
+        which is the RIGHT side exactly when the small side is left."""
+        from .exchange.joinfilter import prunable
+
+        return prunable(self.how, probe_is_right=self.small_is_left)
+
+    def _build_small_filter(self, small: MicroPartition, ctx):
+        """Bloom + min-max filter over the collected small side's keys, or
+        None (knob off, ineligible dtypes, any failure — fail-open; the
+        ``join.filter`` fault site fires per build attempt)."""
+        from . import faults
+
+        if not getattr(ctx.cfg, "runtime_join_filters", True) \
+                or not self._filter_prunable():
+            return None
+        from .exchange.joinfilter import JoinFilterSlot
+
+        slot = JoinFilterSlot(self.small_on, self.big_on,
+                              self.children[1].schema,
+                              self.children[0].schema, self.how)
+        if not slot.eligible:
+            return None
+        try:
+            faults.check("join.filter", ctx.stats)
+            slot.begin()
+            for t in small.chunk_tables():
+                slot.feed(t)
+            slot.seal()
+        except Exception:
+            ctx.stats.bump("join_filter_errors")
+            return None
+        jf = slot.filter()
+        if jf is not None:
+            ctx.stats.bump("join_filter_built")
+        return jf
+
     def execute(self, inputs, ctx) -> PartStream:
         with ctx.stats.profiler.span("join.build", kind="phase"):
             small_parts = [p for p in inputs[1]]
@@ -993,10 +1177,18 @@ class BroadcastJoinOp(PhysicalOp):
             # mesh runners replicate the build keys into every device's HBM
             # here (one ICI broadcast); per-partition probes stay device-local
             small = ctx.prepare_broadcast(small, self.small_on, self.how)
+            # runtime join filter: the small side IS the build side — prune
+            # each streamed big partition before its probe (fewer rows into
+            # the per-pair join; semantics gated per join type)
+            jf = self._build_small_filter(small, ctx)
         ctx.stats.bump("broadcast_joins")
 
         def pairs():
+            from .exchange.joinfilter import prune_partition
+
             for part in inputs[0]:
+                if jf is not None:
+                    part = prune_partition(part, jf, self.big_on, ctx)
                 if self.small_is_left:
                     yield small, part, self.small_on, self.big_on
                 else:
@@ -1106,6 +1298,15 @@ class SortMergeJoinOp(PhysicalOp):
             for p in buf.drain():
                 pieces = p.partition_by_range(on, bnds, [False] * k, [None] * k)
                 for i, piece in enumerate(pieces):
+                    # the aligned-boundary exchange is a real exchange:
+                    # count its payload at bucket append so this fallback
+                    # matches the mesh path's staged-payload accounting
+                    nrows = piece.num_rows_or_none()
+                    if nrows:
+                        ctx.stats.bump("exchange_rows", nrows)
+                        pb = piece.size_bytes() or 0
+                        if pb:
+                            ctx.stats.bump("exchange_bytes", pb)
                     buckets[min(i, n - 1)].append(piece)
         for i in range(n):
             l = (MicroPartition.concat(lbuckets[i].parts()) if len(lbuckets[i]) > 1
@@ -1462,6 +1663,16 @@ def _translate_aggregate(plan: Aggregate, cfg) -> PhysicalOp:
                      _stage_schema(plan.input.schema, stage1, plan.groupby))
     if plan.groupby:
         exchanged: PhysicalOp = ShuffleOp(p1, "hash", nparts, key_cols)
+        # hierarchical exchange: fold map-side pieces headed to the same
+        # destination through the stage-2 combine BEFORE they buffer
+        # (intra-host combine -> inter-host all_to_all; the mesh path
+        # mirrors it ahead of the ICI collective). Only when the fold is
+        # schema-closed and every stage-2 kind is a known-safe merge.
+        if getattr(cfg, "hierarchical_exchange_combine", True):
+            from .exchange.combine import combine_spec_applicable
+
+            if combine_spec_applicable(stage2, key_cols, p1.schema):
+                exchanged.combine = (stage2, key_cols)
     else:
         exchanged = GatherOp(p1)
     p2 = AggregateOp(exchanged, stage2, key_cols,
@@ -1566,8 +1777,26 @@ def _translate_join(plan: Join, cfg) -> PhysicalOp:
     # hash: co-partition both sides when >1 partition
     nparts = max(left.num_partitions, right.num_partitions)
     if nparts > 1:
-        left = ShuffleOp(left, "hash", nparts, plan.left_on)
-        right = ShuffleOp(right, "hash", nparts, plan.right_on)
+        lshuf = ShuffleOp(left, "hash", nparts, plan.left_on)
+        rshuf = ShuffleOp(right, "hash", nparts, plan.right_on)
+        # runtime join filter (sideways information passing): the left
+        # exchange — drained first by HashJoinOp — builds a Bloom+min-max
+        # filter from its keys; the right exchange prunes with it before
+        # bucketing/spill/merge. Gated per join type: inner/semi — either
+        # side prunable (we prune the one whose exchange runs second);
+        # left — right side only; right/anti/outer — decline (the probe
+        # side's unmatched rows are output).
+        from .exchange.joinfilter import JoinFilterSlot, prunable
+
+        # the probe side is the RIGHT exchange (drained second)
+        if getattr(cfg, "runtime_join_filters", True) \
+                and prunable(plan.how, probe_is_right=True):
+            slot = JoinFilterSlot(plan.left_on, plan.right_on,
+                                  left.schema, right.schema, plan.how)
+            if slot.eligible:
+                lshuf.filter_feed = slot
+                rshuf.probe_filter = slot
+        left, right = lshuf, rshuf
     return HashJoinOp(left, right, plan.left_on, plan.right_on, plan.how,
                       plan.schema, plan.suffix)
 

@@ -522,10 +522,18 @@ class _AsyncSpillSlotTask(_SpillSlotTask):
     path, discovered late."""
 
     def __init__(self, path: str, schema, num_rows: int, size_bytes: int,
-                 scope: SpillScope, tables, rt_stats=None, ledger=None):
+                 scope: SpillScope, tables, rt_stats=None, ledger=None,
+                 reader=None):
         super().__init__(path, schema, num_rows, size_bytes, scope,
                          rt_stats=rt_stats, ledger=ledger)
-        self._tables = list(tables)
+        # reader: pre-landing reads route through it instead of the tables
+        # (encoded exchange payloads — `tables` then holds arrow tables the
+        # engine-side concat below cannot serve, but the reader decodes)
+        self._reader = reader
+        self._tables = list(tables) if reader is None else None
+        # keeps the encoded payload (referenced by the reader closure)
+        # alive until the write lands, mirroring _tables' residency
+        self._enc_tables = list(tables) if reader is not None else None
         # bytes this task holds in ledger `current` after a write failure;
         # shared with the finalizer so the charge settles exactly once
         self._held_cell = {"bytes": 0}
@@ -533,6 +541,8 @@ class _AsyncSpillSlotTask(_SpillSlotTask):
     def _write_done(self, file_bytes: int) -> None:
         with self._read_lock:
             self._tables = None
+            self._reader = None
+            self._enc_tables = None
             self.size_bytes_exact = file_bytes
 
     def _write_failed(self, size: int) -> None:
@@ -540,6 +550,12 @@ class _AsyncSpillSlotTask(_SpillSlotTask):
             self._held_cell["bytes"] = size
 
     def _materialize_locked(self):
+        if self._reader is not None:
+            # encoded payload still in flight (or its write failed): decode
+            # from the resident encoded tables
+            if self._rt_stats is not None:
+                self._rt_stats.bump("spill_mem_reads")
+            return self._reader()
         if self._tables is not None:
             from .table import Table
 
@@ -627,10 +643,13 @@ def _write_spill_ipc(path: str, tbls) -> int:
     page cache at memcpy speed and the consumer reads them back through
     warm page-cache file reads (_SpillSlotTask). Chunk-wise: a multi-piece
     shuffle bucket streams each piece as its own record batch — the bucket
-    is never concatenated just to be spilled. Returns bytes written."""
+    is never concatenated just to be spilled. Entries may be engine Tables
+    OR already-arrow tables (the encoded-exchange payload hook: dictionary
+    columns write natively, so spilled exchange bytes stay encoded and the
+    read-back's Table.from_arrow decodes them). Returns bytes written."""
     import pyarrow as pa
 
-    atbls = [t.to_arrow() for t in tbls]
+    atbls = [t if isinstance(t, pa.Table) else t.to_arrow() for t in tbls]
     schema = atbls[0].schema
     opts = pa.ipc.IpcWriteOptions(compression=_SPILL_CODEC)
     with pa.OSFile(path, "wb") as f, \
@@ -699,15 +718,31 @@ class PartitionBuffer:
         import weakref
 
         path = self._take_path()
-        # chunk-wise: a multi-piece shuffle bucket (chained per-chunk splits)
-        # spills its pieces as separate record batches
-        tbls = part.chunk_tables()
+        task0 = part.scan_task()
+        enc = (getattr(task0, "encoded_payload", None)
+               if task0 is not None else None)
+        if enc is not None:
+            # encoded exchange piece (exchange/encode.py): spill the ENCODED
+            # arrow payload as-is — dictionary columns survive IPC, so the
+            # spilled exchange bytes stay encoded; the slot read-back's
+            # Table.from_arrow decodes. Pre-landing reads (async path) serve
+            # through the task's own decode.
+            tbls = enc()
+            schema = part.schema
+            nrows = len(part)
+            reader = task0.read
+        else:
+            # chunk-wise: a multi-piece shuffle bucket (chained per-chunk
+            # splits) spills its pieces as separate record batches
+            tbls = part.chunk_tables()
+            schema = tbls[0].schema
+            nrows = sum(len(t) for t in tbls)
+            reader = None
         if self.async_spill:
-            out = self._spill_async(path, tbls, size)
+            out = self._spill_async(path, tbls, size, schema, nrows, reader)
             if out is not None:
                 return out
             # writer unavailable (closed scope): fall through to sync
-        nrows = 0
         try:
             from . import faults
 
@@ -715,7 +750,6 @@ class PartitionBuffer:
             t0 = time.perf_counter_ns()
             file_bytes = _write_spill_ipc(path, tbls)
             dt = time.perf_counter_ns() - t0
-            nrows = sum(len(t) for t in tbls)
         except Exception as e:
             # python-object columns have no arrow representation — and a
             # full/failing spill disk looks the same: hold in memory rather
@@ -739,7 +773,7 @@ class PartitionBuffer:
             if self.stats.profiler.armed:
                 self.stats.profiler.event("spill", mode="sync", rows=nrows,
                                           bytes=file_bytes)
-        task = _SpillSlotTask(path, tbls[0].schema, nrows, file_bytes,
+        task = _SpillSlotTask(path, schema, nrows, file_bytes,
                               self.scope, rt_stats=self.stats,
                               ledger=self.ledger)
         # the slot recycles when nothing can read it anymore: task GC, not
@@ -747,18 +781,23 @@ class PartitionBuffer:
         weakref.finalize(task, self.scope.recycle, path)
         return MicroPartition.from_scan_task(task)
 
-    def _spill_async(self, path: str, tbls, size: int) -> Optional[MicroPartition]:
+    def _spill_async(self, path: str, tbls, size: int, schema, nrows: int,
+                     reader=None) -> Optional[MicroPartition]:
         """Hand the IPC write to the scope's bounded writer thread; the
         returned partition is immediately consumable (reads serve from the
-        resident tables until the write lands)."""
+        resident tables — or, for encoded exchange payloads, through
+        ``reader``'s decode — until the write lands)."""
         import weakref
 
+        import pyarrow as pa
+
         writer = self.scope.writer()
-        nrows = sum(len(t) for t in tbls)
-        task = _AsyncSpillSlotTask(path, tbls[0].schema, nrows,
-                                   sum(t.size_bytes() for t in tbls),
+        mem_bytes = sum((t.nbytes if isinstance(t, pa.Table)
+                         else t.size_bytes()) for t in tbls)
+        task = _AsyncSpillSlotTask(path, schema, nrows,
+                                   mem_bytes,
                                    self.scope, tbls, rt_stats=self.stats,
-                                   ledger=self.ledger)
+                                   ledger=self.ledger, reader=reader)
         stats = self.stats
         ledger = self.ledger
         # capture the submitting thread's span AND query context so the

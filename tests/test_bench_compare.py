@@ -26,6 +26,13 @@ class TestClassify:
         assert classify("spill_serial_wall_s") == "lower"
         assert classify("q1_query_log_overhead_pct") == "lower"
         assert classify("exchange_rows") == "lower"
+        # exchange rung (ISSUE 9): more pruning is better and wins over the
+        # generic _rows suffix; exchanged payload bytes are lower-better;
+        # reduction ratios are higher-better
+        assert classify("join_filter_rows_pruned") == "higher"
+        assert classify("exchange_join_rows_pruned") == "higher"
+        assert classify("join_exchange_bytes") == "lower"
+        assert classify("exchange_join_reduction_x") == "higher"
         assert classify("rows") is None  # bare table size: no direction
         assert classify("some_unknown_thing") is None
 

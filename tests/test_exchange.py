@@ -1,0 +1,669 @@
+"""Exchange v2 (ISSUE 9): runtime join filters, encoded payloads,
+hierarchical combine.
+
+The hard invariant under test everywhere: results are BYTE-IDENTICAL with
+each knob off. The one carve-out is float aggregation values, whose
+grouped-sum kernel (threaded acero) is run-to-run nondeterministic at the
+last ulp in the SEED engine already (verified against unmodified HEAD);
+float sums therefore decline the combine fold, and float-valued results
+compare at 1e-12 relative tolerance while everything else compares
+exactly.
+"""
+
+import datetime
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import DataType, col
+from daft_tpu import faults
+
+KNOBS = ("runtime_join_filters", "exchange_payload_encoding",
+         "hierarchical_exchange_combine")
+
+
+@contextmanager
+def knobs(**kw):
+    cfg = dt.context.get_context().execution_config
+    prev = {k: getattr(cfg, k) for k in kw}
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    try:
+        yield cfg
+    finally:
+        for k, v in prev.items():
+            setattr(cfg, k, v)
+
+
+@pytest.fixture(autouse=True)
+def _no_result_cache():
+    with knobs(enable_result_cache=False):
+        yield
+
+
+def _sorted_rows(d: dict):
+    keys = list(d)
+    return sorted(zip(*[d[k] for k in keys]),
+                  key=lambda r: tuple((v is None, str(v)) for v in r)), keys
+
+
+def assert_results_equal(a: dict, b: dict, float_rtol=1e-12):
+    assert set(a) == set(b)
+    ra, ka = _sorted_rows(a)
+    rb, _ = _sorted_rows(b)
+    assert len(ra) == len(rb)
+    for rowa, rowb in zip(ra, rb):
+        for k, va, vb in zip(ka, rowa, rowb):
+            if isinstance(va, float) and isinstance(vb, float):
+                if np.isnan(va) or np.isnan(vb):
+                    assert np.isnan(va) and np.isnan(vb), (k, va, vb)
+                else:
+                    assert va == pytest.approx(vb, rel=float_rtol), (k, va, vb)
+            else:
+                assert va == vb, (k, va, vb)
+
+
+def _ab(build_query, float_rtol=1e-12, **off_knobs):
+    """Run build_query() with exchange-v2 knobs ON then OFF; assert equal
+    results and return (on_counters, off_counters)."""
+    if not off_knobs:
+        off_knobs = {k: False for k in KNOBS}
+    q_on = build_query()
+    on = q_on.collect().to_pydict()
+    with knobs(**off_knobs):
+        q_off = build_query()
+        off = q_off.collect().to_pydict()
+    assert_results_equal(on, off, float_rtol=float_rtol)
+    return (q_on.stats.snapshot()["counters"],
+            q_off.stats.snapshot()["counters"])
+
+
+# ---------------------------------------------------------------------------
+# byte-identity sweep: dtype x null-pattern matrix
+# ---------------------------------------------------------------------------
+
+KEY_SAMPLES = {
+    "int64": (DataType.int64(), lambda i: i % 37),
+    "int32": (DataType.int32(), lambda i: i % 37),
+    "float64": (DataType.float64(), lambda i: (i % 37) * 0.5),
+    "string": (DataType.string(), lambda i: f"k{i % 37}"),
+    "binary": (DataType.binary(), lambda i: b"b%d" % (i % 37)),
+    "date": (DataType.date(),
+             lambda i: datetime.date(2024, 1, 1)
+             + datetime.timedelta(days=i % 37)),
+    "bool": (DataType.bool(), lambda i: bool(i % 2)),
+}
+NULL_PATTERNS = {
+    "none": lambda i: False,
+    "some": lambda i: i % 11 == 0,
+    "heavy": lambda i: i % 2 == 0,
+}
+
+
+class TestByteIdentityMatrix:
+    @pytest.mark.parametrize("dtype_name", sorted(KEY_SAMPLES))
+    @pytest.mark.parametrize("nulls", sorted(NULL_PATTERNS))
+    def test_join_key_matrix(self, dtype_name, nulls):
+        dtype, mk = KEY_SAMPLES[dtype_name]
+        isnull = NULL_PATTERNS[nulls]
+        n = 600
+        lkeys = [None if isnull(i) else mk(i) for i in range(n)]
+        rkeys = [None if isnull(i + 1) else mk(i * 3) for i in range(n // 2)]
+        left = dt.from_pydict({
+            "k": dt.Series.from_pylist(lkeys, "k", dtype),
+            "lv": list(range(n))}).into_partitions(3)
+        right = dt.from_pydict({
+            "k": dt.Series.from_pylist(rkeys, "k", dtype),
+            "rv": list(range(n // 2))}).into_partitions(3)
+
+        def q():
+            return left.join(right, on="k", how="inner", strategy="hash")
+
+        _ab(q)
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer",
+                                     "semi", "anti"])
+    @pytest.mark.parametrize("strategy", ["hash", "broadcast", "sort_merge"])
+    def test_join_types_x_strategies(self, how, strategy):
+        n = 500
+        rng = np.random.RandomState(7)
+        left = dt.from_pydict({"k": rng.randint(0, 40, n).tolist(),
+                               "lv": rng.rand(n).tolist()}).into_partitions(4)
+        right = dt.from_pydict({"k": (np.arange(25) * 2).tolist(),
+                                "rv": list(range(25))}).into_partitions(2)
+
+        def q():
+            return left.join(right, on="k", how=how, strategy=strategy)
+
+        _ab(q)
+
+    def test_grouped_agg_exact_kinds(self):
+        n = 4000
+        rng = np.random.RandomState(3)
+        df = dt.from_pydict({
+            "g": rng.randint(0, 50, n).tolist(),
+            "i": rng.randint(-1000, 1000, n).tolist(),
+            "f": rng.rand(n).tolist(),
+            "s": [f"s{v % 9}" for v in range(n)]}).into_partitions(6)
+
+        def q():
+            return df.groupby("g").agg(
+                col("i").sum().alias("si"), col("i").count().alias("ci"),
+                col("f").min().alias("lo"), col("f").max().alias("hi"),
+                col("s").min().alias("smin"))
+
+        on, _ = _ab(q)
+        assert on.get("exchange_precombined_rows", 0) > 0
+
+    def test_float_sum_mean_identity(self):
+        n = 4000
+        rng = np.random.RandomState(4)
+        df = dt.from_pydict({"g": rng.randint(0, 20, n).tolist(),
+                             "f": rng.rand(n).tolist()}).into_partitions(6)
+
+        def q():
+            return df.groupby("g").agg(col("f").sum().alias("s"),
+                                       col("f").mean().alias("m"))
+
+        on, _ = _ab(q)
+        # float sums DECLINE the combine (reassociation would drift)
+        assert "exchange_precombined_rows" not in on
+
+    def test_compose_with_sketch_aggs(self):
+        n = 6000
+        rng = np.random.RandomState(5)
+        df = dt.from_pydict({"g": (np.arange(n) % 16).tolist(),
+                             "v": rng.randint(0, 3000, n).tolist()
+                             }).into_partitions(8)
+
+        def q():
+            return df.groupby("g").agg(
+                col("v").approx_count_distinct().alias("acd"),
+                col("v").count().alias("c"))
+
+        on, _ = _ab(q)
+        # the sketch exchange still ships O(parts x groups), never raw rows
+        assert on.get("exchange_rows", 0) < n / 4
+
+    def test_compose_with_expr_fusion_and_join(self):
+        n = 3000
+        rng = np.random.RandomState(6)
+        fact = dt.from_pydict({
+            "k": rng.randint(0, 400, n).tolist(),
+            "a": rng.rand(n).tolist(),
+            "b": rng.rand(n).tolist()}).into_partitions(4)
+        dim = dt.from_pydict({"k": list(range(0, 400, 10)),
+                              "seg": [i % 3 for i in range(40)]
+                              }).into_partitions(2)
+
+        def q():
+            j = (dim.join(fact, on="k", how="inner", strategy="hash")
+                 .select(col("seg"), (col("a") * 2 + col("b")).alias("x"))
+                 .filter(col("x") > 0.5))
+            return j.groupby("seg").agg(col("x").count().alias("n"))
+
+        on, _ = _ab(q)
+        assert on.get("join_filter_built", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# join-filter semantics per join type / strategy
+# ---------------------------------------------------------------------------
+
+def _selective_frames(n=5000, keys=2000, keep=60):
+    rng = np.random.RandomState(11)
+    build = dt.from_pydict({"k": list(range(0, keep * 10, 10)),
+                            "bv": list(range(keep))}).into_partitions(3)
+    probe = dt.from_pydict({"k": rng.randint(0, keys, n).tolist(),
+                            "pv": rng.rand(n).tolist()}).into_partitions(3)
+    return build, probe
+
+
+class TestJoinFilterSemantics:
+    @pytest.mark.parametrize("how", ["inner", "semi", "left"])
+    def test_prunable_hash_joins_prune(self, how):
+        build, probe = _selective_frames()
+        q = build.join(probe, on="k", how=how, strategy="hash")
+        q.collect()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("join_filter_built", 0) == 1
+        assert c.get("join_filter_rows_pruned", 0) > 3000
+
+    @pytest.mark.parametrize("how", ["right", "outer", "anti"])
+    def test_nonprunable_hash_joins_decline(self, how):
+        build, probe = _selective_frames()
+        q = build.join(probe, on="k", how=how, strategy="hash")
+        q.collect()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("join_filter_built", 0) == 0
+        assert c.get("join_filter_rows_pruned", 0) == 0
+
+    def test_broadcast_inner_prunes(self):
+        build, probe = _selective_frames()
+        # small side auto-broadcasts under the size threshold
+        q = probe.join(build, on="k", how="inner", strategy="broadcast")
+        q.collect()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("join_filter_built", 0) == 1
+        assert c.get("join_filter_rows_pruned", 0) > 3000
+
+    def test_broadcast_left_declines(self):
+        build, probe = _selective_frames()
+        # left join broadcasts the right side; the big (left) side is
+        # preserved so pruning it would drop output rows — must decline
+        q = probe.join(build, on="k", how="left", strategy="broadcast")
+        q.collect()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("join_filter_rows_pruned", 0) == 0
+
+    def test_null_probe_keys_pruned_and_identical(self):
+        n = 2000
+        pk = [None if i % 3 == 0 else i % 50 for i in range(n)]
+        probe = dt.from_pydict({"k": pk, "pv": list(range(n))
+                                }).into_partitions(3)
+        build = dt.from_pydict({"k": list(range(0, 50, 2)),
+                                "bv": list(range(25))}).into_partitions(2)
+
+        def q():
+            return build.join(probe, on="k", how="inner", strategy="hash")
+
+        on, _ = _ab(q)
+        assert on.get("join_filter_rows_pruned", 0) >= n // 3  # nulls go
+
+    def test_nan_float_keys_bypass_filter(self):
+        lk = [1.0, 2.0, float("nan"), 4.0] * 100
+        rk = [float("nan"), 2.0] * 60
+        left = dt.from_pydict({"k": lk, "lv": list(range(len(lk)))
+                               }).into_partitions(3)
+        right = dt.from_pydict({"k": rk, "rv": list(range(len(rk)))
+                                }).into_partitions(2)
+
+        def q():
+            return left.join(right, on="k", how="inner", strategy="hash")
+
+        _ab(q)  # identity is the contract; NaN rows must not be mis-pruned
+
+    def test_multi_key_join_filtered(self):
+        n = 3000
+        rng = np.random.RandomState(12)
+        probe = dt.from_pydict({"a": rng.randint(0, 40, n).tolist(),
+                                "b": rng.randint(0, 40, n).tolist(),
+                                "pv": list(range(n))}).into_partitions(3)
+        build = dt.from_pydict({"a": [1, 2, 3], "b": [1, 2, 3],
+                                "bv": [10, 20, 30]}).into_partitions(2)
+
+        def q():
+            return build.join(probe, left_on=["a", "b"],
+                              right_on=["a", "b"], how="inner",
+                              strategy="hash")
+
+        on, _ = _ab(q)
+        assert on.get("join_filter_rows_pruned", 0) > 2000
+
+    def test_mismatched_key_dtypes_still_correct(self):
+        # int32 probe keys vs int64 build keys: the filter must hash both
+        # in the unified dtype or silently mis-prune — identity pins it
+        probe = dt.from_pydict({
+            "k": dt.Series.from_pylist(list(range(200)) * 4, "k",
+                                       DataType.int32()),
+            "pv": list(range(800))}).into_partitions(3)
+        build = dt.from_pydict({"k": list(range(0, 200, 5)),
+                                "bv": list(range(40))}).into_partitions(2)
+
+        def q():
+            return build.join(probe, on="k", how="inner", strategy="hash")
+
+        _ab(q)
+
+
+# ---------------------------------------------------------------------------
+# fault degradation: filter/encode failures never fail the query
+# ---------------------------------------------------------------------------
+
+class TestFaultDegradation:
+    def test_filter_build_failure_degrades_to_unfiltered(self):
+        build, probe = _selective_frames()
+        with faults.inject("join.filter", "always"):
+            q = build.join(probe, on="k", how="inner", strategy="hash")
+            out = q.collect().to_pydict()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("join_filter_errors", 0) >= 1
+        assert c.get("join_filter_rows_pruned", 0) == 0
+        with knobs(runtime_join_filters=False):
+            q2 = build.join(probe, on="k", how="inner", strategy="hash")
+            ref = q2.collect().to_pydict()
+        assert_results_equal(out, ref)
+
+    def test_probe_failure_mid_stream_degrades(self):
+        build, probe = _selective_frames()
+        # build feeds 3 partitions (3 checks), seal happens without a
+        # check; the 5th check is the 2nd probe partition
+        with faults.inject("join.filter", "nth", n=5):
+            q = build.join(probe, on="k", how="inner", strategy="hash")
+            out = q.collect().to_pydict()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("join_filter_errors", 0) == 1
+        with knobs(runtime_join_filters=False):
+            q2 = build.join(probe, on="k", how="inner", strategy="hash")
+            ref = q2.collect().to_pydict()
+        assert_results_equal(out, ref)
+
+    def test_encode_failure_ships_raw(self):
+        n = 4000
+        df = dt.from_pydict({"k": (np.arange(n) % 100).tolist(),
+                             "s": [f"v{i % 4}" for i in range(n)]
+                             }).into_partitions(4)
+        with knobs(memory_budget_bytes=20_000):
+            with faults.inject("exchange.encode", "always"):
+                q = df.repartition(4, "k")
+                out = q.collect().to_pydict()
+            c = q.stats.snapshot()["counters"]
+            assert c.get("exchange_encode_failures", 0) >= 1
+            assert c.get("exchange_pieces_encoded", 0) == 0
+            with knobs(exchange_payload_encoding=False):
+                q2 = df.repartition(4, "k")
+                ref = q2.collect().to_pydict()
+        assert_results_equal(out, ref)
+
+    def test_fault_sites_registered(self):
+        assert "join.filter" in faults.SITES
+        assert "exchange.encode" in faults.SITES
+
+
+# ---------------------------------------------------------------------------
+# encoded exchange payloads
+# ---------------------------------------------------------------------------
+
+class TestEncodedExchange:
+    def _lowcard_df(self, n=30000, parts=5):
+        rng = np.random.RandomState(2)
+        status = ["PENDING", "SHIPPED", "DELIVERED", "RETURNED"]
+        return dt.from_pydict({
+            "k": rng.randint(0, 300, n).tolist(),
+            "s": [status[i % 4] for i in range(n)],
+            "v": rng.rand(n).tolist()}).into_partitions(parts)
+
+    def test_budgeted_exchange_encodes_and_matches(self):
+        df = self._lowcard_df()
+
+        def q():
+            return df.repartition(5, "k")
+
+        with knobs(memory_budget_bytes=150_000):
+            on, off = _ab(q)
+        assert on.get("exchange_pieces_encoded", 0) > 0
+        assert on["exchange_bytes_encoded"] < on["exchange_bytes"]
+        # spilled exchange bytes shrink too (the encoded payload hits disk)
+        assert on.get("spill_write_bytes", 0) < off.get("spill_write_bytes", 1)
+
+    def test_unbudgeted_exchange_does_not_encode(self):
+        df = self._lowcard_df(n=8000, parts=3)
+        q = df.repartition(3, "k")
+        q.collect()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("exchange_pieces_encoded", 0) == 0
+
+    def test_hostile_columns_ship_raw(self):
+        # near-unique column: sampling must skip it
+        n = 8000
+        df = dt.from_pydict({"k": list(range(n)),
+                             "v": np.random.RandomState(1).rand(n).tolist()
+                             }).into_partitions(2)
+        with knobs(memory_budget_bytes=50_000):
+            q = df.repartition(2, "k")
+            out = q.collect().to_pydict()
+        c = q.stats.snapshot()["counters"]
+        assert c.get("exchange_pieces_encoded", 0) == 0
+        assert len(out["k"]) == n
+
+    def test_encode_roundtrip_unit(self):
+        from daft_tpu.exchange.encode import encode_exchange_partition
+        from daft_tpu.micropartition import MicroPartition
+
+        n = 2000
+        part = MicroPartition.from_pydict({
+            "i": [None if i % 7 == 0 else i % 9 for i in range(n)],
+            "s": [None if i % 5 == 0 else f"s{i % 6}" for i in range(n)],
+            "d": [datetime.date(2024, 1, 1 + (i % 3)) for i in range(n)],
+        })
+        enc = encode_exchange_partition(part)
+        assert enc is not None
+        assert not enc.is_loaded()
+        assert (enc.size_bytes() or 0) < (part.size_bytes() or 0)
+        assert enc.to_pydict() == part.to_pydict()
+        assert enc.schema == part.schema
+
+    def test_encode_declines_tiny_pieces(self):
+        from daft_tpu.exchange.encode import encode_exchange_partition
+        from daft_tpu.micropartition import MicroPartition
+
+        part = MicroPartition.from_pydict({"a": [1, 1, 2]})
+        assert encode_exchange_partition(part) is None
+
+
+# ---------------------------------------------------------------------------
+# hierarchical combine
+# ---------------------------------------------------------------------------
+
+class TestHierarchicalCombine:
+    def test_exchange_rows_fold(self):
+        n, parts, groups = 16000, 8, 32
+        rng = np.random.RandomState(9)
+        df = dt.from_pydict({"g": (np.arange(n) % groups).tolist(),
+                             "c": rng.randint(0, 100, n).tolist()
+                             }).into_partitions(parts)
+
+        def q():
+            return df.groupby("g").agg(col("c").sum().alias("s"),
+                                       col("c").count().alias("n"))
+
+        on, off = _ab(q)
+        # off: one stage-1 piece per (partition x group); on: ~groups rows
+        assert off["exchange_rows"] == parts * groups
+        assert on["exchange_rows"] == groups
+        assert on["exchange_precombined_rows"] == (parts - 1) * groups
+
+    def test_combine_tag_in_plan(self):
+        from daft_tpu.context import get_context
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        cfg = get_context().execution_config
+        df = dt.from_pydict({"g": [1, 2] * 10, "c": list(range(20))
+                             }).into_partitions(4)
+        plan = df.groupby("g").agg(col("c").sum())._plan
+        tree = translate(optimize(plan), cfg).display_tree()
+        assert "<combine>" in tree
+        fplan = df.groupby("g").agg(col("c").cast(DataType.float64()).sum()
+                                    )._plan
+        ftree = translate(optimize(fplan), cfg).display_tree()
+        assert "<combine>" not in ftree  # float sum declines
+
+    def test_list_agg_folds_in_order(self):
+        n, parts = 2000, 5
+        df = dt.from_pydict({"g": (np.arange(n) % 7).tolist(),
+                             "v": list(range(n))}).into_partitions(parts)
+
+        def q():
+            return df.groupby("g").agg_list(col("v"))
+
+        _ab(q)
+
+    def test_combine_applicability_gate(self):
+        from daft_tpu.exchange.combine import combine_spec_applicable
+        from daft_tpu.physical import (_stage_schema,
+                                       populate_aggregation_stages)
+        from daft_tpu.schema import Schema, Field
+
+        in_schema = Schema([Field("g", DataType.int64()),
+                            Field("i", DataType.int64()),
+                            Field("f", DataType.float64())])
+        key_cols = [col("g")]
+        s1, s2, _ = populate_aggregation_stages([col("i").sum().alias("x")])
+        p1 = _stage_schema(in_schema, s1, key_cols)
+        assert combine_spec_applicable(s2, key_cols, p1)
+        s1f, s2f, _ = populate_aggregation_stages([col("f").sum().alias("x")])
+        p1f = _stage_schema(in_schema, s1f, key_cols)
+        assert not combine_spec_applicable(s2f, key_cols, p1f)
+
+    def test_combiner_abandons_on_poor_shrink(self):
+        # near-unique keys: the running partial would converge to the whole
+        # bucket, resident outside the spillable buffers — the first
+        # non-shrinking fold must abandon and release every ledger charge
+        from daft_tpu.exchange.combine import FOLD_EVERY, BucketCombiner
+        from daft_tpu.micropartition import MicroPartition
+        from daft_tpu.spill import MemoryLedger
+
+        led = MemoryLedger()
+        comb = BucketCombiner([col("x").sum().alias("x")], [col("g")],
+                              ledger=led)
+        flushed = None
+        for i in range(FOLD_EVERY + 1):
+            piece = MicroPartition.from_pydict(
+                {"g": list(range(i * 8, i * 8 + 8)), "x": [1] * 8})
+            flushed = comb.add(0, piece)
+            if flushed is not None:
+                break
+        assert comb.failed
+        assert flushed is not None
+        assert sum(len(p) for _, p in flushed) == (FOLD_EVERY + 1) * 8
+        assert led.current == 0
+        assert led.negative_releases == 0
+
+    def test_combiner_budget_gate(self):
+        # staged partials cannot spill: past half the query budget the
+        # combiner hands everything back to the spillable buffers
+        from daft_tpu.exchange.combine import BucketCombiner
+        from daft_tpu.micropartition import MicroPartition
+        from daft_tpu.spill import MemoryLedger
+
+        led = MemoryLedger()
+        comb = BucketCombiner([col("x").sum().alias("x")], [col("g")],
+                              ledger=led, budget=1)
+        piece = MicroPartition.from_pydict({"g": [1, 1], "x": [1, 2]})
+        flushed = comb.add(0, piece)
+        assert comb.failed
+        assert flushed is not None and len(flushed) == 1
+        assert led.current == 0
+
+    def test_combiner_ledger_balanced_through_folds(self):
+        # shrinking folds: bytes are charged while staged and fully drained
+        # by finish(); the running partial's charge replaces the pieces'
+        from daft_tpu.exchange.combine import FOLD_EVERY, BucketCombiner
+        from daft_tpu.micropartition import MicroPartition
+        from daft_tpu.spill import MemoryLedger
+
+        led = MemoryLedger()
+        comb = BucketCombiner([col("x").sum().alias("x")], [col("g")],
+                              ledger=led)
+        for i in range(FOLD_EVERY + 2):
+            assert comb.add(0, MicroPartition.from_pydict(
+                {"g": [1, 2], "x": [i, i + 1]})) is None
+        assert not comb.failed
+        assert led.current > 0
+        out = list(comb.finish())
+        assert led.current == 0
+        assert led.negative_releases == 0
+        assert sum(len(p) for _, p in out) == 2  # one partial, two groups
+
+
+# ---------------------------------------------------------------------------
+# accounting + observability surfaces
+# ---------------------------------------------------------------------------
+
+class TestAccountingAndSurfaces:
+    def test_exchange_bytes_reflect_pruned_payload(self):
+        build, probe = _selective_frames()
+
+        def q():
+            return build.join(probe, on="k", how="inner", strategy="hash")
+
+        on, off = _ab(q)
+        assert 0 < on["exchange_bytes"] < off["exchange_bytes"]
+        assert 0 < on["exchange_rows"] < off["exchange_rows"]
+
+    def test_scan_fed_exchange_counts_bytes(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as papq
+
+        n = 5000
+        papq.write_table(pa.table({"k": list(range(n)),
+                                   "v": [float(i) for i in range(n)]}),
+                         str(tmp_path / "t.parquet"))
+        df = dt.read_parquet(str(tmp_path / "t.parquet"))
+        q = df.repartition(3, "k")
+        q.collect()
+        c = q.stats.snapshot()["counters"]
+        # the host path counts actual exchanged payload even when the
+        # input stream arrived unloaded (satellite: accounting symmetry)
+        assert c["exchange_rows"] == n
+        assert c["exchange_bytes"] > 0
+
+    def test_smj_host_exchange_counts_payload(self):
+        # the sort-merge join's aligned-boundary range exchange is a real
+        # exchange: the host fallback must count the same payload the mesh
+        # path bumps inside _device_shuffle_impl (accounting symmetry)
+        n = 4000
+        rng = np.random.RandomState(3)
+        left = dt.from_pydict({"k": rng.randint(0, 500, n).tolist(),
+                               "a": list(range(n))}).into_partitions(4)
+        right = dt.from_pydict({"k": rng.randint(0, 500, n).tolist(),
+                                "b": list(range(n))}).into_partitions(4)
+        q = left.join(right, on="k", how="inner", strategy="sort_merge")
+        q.collect()
+        c = q.stats.snapshot()["counters"]
+        assert c["exchange_rows"] == 2 * n
+        assert c["exchange_bytes"] > 0
+
+    def test_explain_analyze_renders_exchange_line(self):
+        build, probe = _selective_frames()
+        q = build.join(probe, on="k", how="inner", strategy="hash")
+        q.collect()
+        text = q.explain_analyze()
+        assert "exchange:" in text
+        assert "pruned" in text
+        assert "probe rows" in text
+
+    def test_query_record_carries_counters(self):
+        build, probe = _selective_frames()
+        q = build.join(probe, on="k", how="inner", strategy="hash")
+        q.collect()
+        rec = q.last_query_record()
+        assert rec is not None
+        assert rec["counters"].get("join_filter_rows_pruned", 0) > 0
+        assert rec["counters"].get("join_filter_built", 0) == 1
+
+    def test_shuffle_describe_tags(self):
+        from daft_tpu.context import get_context
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import translate
+
+        build, probe = _selective_frames()
+        plan = build.join(probe, on="k", how="inner", strategy="hash")._plan
+        tree = translate(optimize(plan), get_context().execution_config
+                         ).display_tree()
+        assert "join-filter-feed" in tree
+        assert "join-filter-probe" in tree
+
+
+# ---------------------------------------------------------------------------
+# bench rung smoke (the ISSUE 9 acceptance numbers, scaled down)
+# ---------------------------------------------------------------------------
+
+class TestBenchRungSmoke:
+    def test_measure_exchange_smoke(self):
+        import bench
+
+        out = bench.measure_exchange(n_rows=24000, n_parts=4,
+                                     n_keys=3000, selectivity=0.05,
+                                     n_groups=200)
+        # >= 5x exchange_rows reduction on the selective-join leg
+        assert out["exchange_join_reduction_x"] >= 5
+        assert out["exchange_join_rows_pruned"] > 10000
+        assert out["exchange_groupby_reduction_x"] > 2
+        assert out["exchange_spill_bytes"] < out["exchange_spill_bytes_raw"]
+        for key in ("exchange_join_speedup_x", "exchange_groupby_speedup_x",
+                    "exchange_encode_speedup_x", "exchange_bytes_encoded"):
+            assert key in out

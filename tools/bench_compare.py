@@ -31,12 +31,18 @@ DEFAULT_THRESHOLD = 0.10
 
 _SNAPSHOT_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
-# metric-name suffix -> direction ("lower" = smaller is better)
+# metric-name suffix -> direction ("lower" = smaller is better). Order
+# matters across the two lists: HIGHER is checked first, so the more
+# specific "_rows_pruned" (exchange-rung join filters: more pruning is
+# better) wins over the generic "_rows" (fewer exchanged rows is better).
+# Exchanged-payload bytes ("*_exchange_bytes") are lower-better via the
+# existing "_bytes" suffix.
 _LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_pct", "_share",
                    "_bytes", "_rows", "_misses", "_throttled", "_failures",
                    "_errors", "_overhead_pct", "_shed_count")
 _HIGHER_SUFFIXES = ("_per_sec", "_vs_baseline", "_speedup_x", "_gbps",
-                    "_mbps", "_hits", "_qps", "value")
+                    "_mbps", "_hits", "_qps", "value", "_rows_pruned",
+                    "_reduction_x")
 
 
 def classify(metric: str) -> Optional[str]:
