@@ -117,6 +117,28 @@ class ExecutionConfig:
     # all_to_all; mirrored on the mesh path ahead of the ICI collective).
     # Only schema-closed decomposable merges fold; byte-identical off.
     hierarchical_exchange_combine: bool = True
+    # --- morsel-driven streaming executor (daft_tpu/stream/, README
+    # "Streaming execution") ----------------------------------------------
+    # streamable chains (Scan/InMemory -> Project/Filter/FusedMap ->
+    # optional Limit) pull fixed-size morsels through bounded channels with
+    # backpressure instead of materializing whole partitions between steps:
+    # bounded working-set memory, first-row latency for limit/interactive
+    # queries, and upstream early-termination when a limit is satisfied.
+    # Results are byte-identical with streaming off (pipeline breakers keep
+    # their partition-granular contract behind the driver's re-chunk
+    # boundary). Declines automatically on the device-kernel and
+    # mesh/multi-host paths.
+    streaming_execution: bool = True
+    # rows per morsel (the streaming unit; morsels never span reader-chunk
+    # boundaries, so the effective size is min(this, chunk rows))
+    morsel_size_rows: int = 128 * 1024
+    # bounded-channel capacity in morsels, per in-flight source partition;
+    # producers block (backpressure) past it
+    stream_channel_capacity: int = 4
+    # producer stages concurrently in flight; 0 = auto (one per worker —
+    # the streaming path replaces _parallel_map's full worker fan-out and
+    # must not cap map parallelism below it)
+    stream_producer_window: int = 0
     # TPU-specific: route eligible projections/aggregations through the jax
     # device kernel layer (kernels/device.py); host pyarrow path otherwise.
     use_device_kernels: bool = False

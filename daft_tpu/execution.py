@@ -191,6 +191,13 @@ class RuntimeStats:
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + n
 
+    def bump_max(self, key: str, n: int) -> None:
+        """Monotonic-max counter (channel high-water marks and the like):
+        the stored value only ever ratchets up to ``n``."""
+        with self._lock:
+            if n > self.counters.get(key, 0):
+                self.counters[key] = n
+
     def io_wait(self, ns: int) -> None:
         """Record consumer-thread blocked IO time: the counter AND the
         io_wait phase of the innermost open profiler span, so per-op
@@ -426,6 +433,13 @@ class ExecutionContext:
         self._spill_scope = None
         self._buffers: List = []
         self._accountant: Optional[ResourceAccountant] = None
+        # live streaming segments (stream/pipeline.py): each registers its
+        # shutdown so query teardown can close the stream tree even when
+        # the pipeline generator is unreachable by close() — an op ABOVE
+        # the segment raising leaves the pipeline suspended at a yield,
+        # and the exception traceback keeps its frame (and its parked
+        # producers) alive until the exception object dies
+        self._active_streams: dict = {}
 
     def check_deadline(self) -> None:
         """Cooperative deadline check (morsel loop, pipeline breakers):
@@ -501,6 +515,32 @@ class ExecutionContext:
                 gpus=_accelerator_count,  # resolved only if a task asks
                 memory_bytes=self.memory_budget)
         return self._accountant
+
+    def register_stream(self, shutdown) -> object:
+        """Track a running streaming segment's shutdown for teardown;
+        returns a token for :meth:`unregister_stream`."""
+        token = object()
+        self._active_streams[token] = shutdown
+        return token
+
+    def unregister_stream(self, token) -> None:
+        self._active_streams.pop(token, None)
+
+    def close_streams(self, short_circuit: bool) -> None:
+        """Shut down every still-registered streaming segment (idempotent
+        per segment). ``short_circuit`` says whether abandoned work counts
+        as ``morsels_short_circuited`` (deliberate early stop) or not
+        (error/cancel/deadline teardown — a failed query's record must not
+        read as if a limit fired)."""
+        while self._active_streams:
+            _, shutdown = self._active_streams.popitem()
+            try:
+                shutdown(short_circuit=short_circuit)
+            except BaseException as e:
+                from .obs.log import get_logger
+
+                get_logger("execution").warning(
+                    "stream_shutdown_failed", error=repr(e))
 
     def finish_query(self) -> None:
         """Release buffer accounting and delete this query's spill files."""
@@ -1227,6 +1267,16 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
     parallel = ctx.num_workers > 1
 
     def build(op: PhysicalOp) -> Iterator[MicroPartition]:
+        # morsel-driven streaming (daft_tpu/stream/): a streamable segment
+        # rooted here replaces its whole op chain with one pipelined
+        # stream — bounded channels, producer stages on the worker pool,
+        # byte-identical re-chunked output. Declines (device path, mesh,
+        # UDFs, no streamable chain) fall through to the normal build.
+        from .stream.pipeline import try_stream
+
+        pipe = try_stream(op, ctx, build, trace)
+        if pipe is not None:
+            return pipe
         child_streams = [build(c) for c in op.children]
         if (parallel and op.map_partition is not None and len(child_streams) == 1
                 and op.parallel_safe()):
@@ -1249,6 +1299,7 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
         t0 = time.perf_counter_ns()
         outcome, error = "ok", None
         rows_out = 0
+        saw_first_rows = False
         it = iter(built)
         try:
             # the query id binds per PULL, never across a yield: two lazily
@@ -1265,6 +1316,15 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
                 n = part.num_rows_or_none()
                 if n:
                     rows_out += n
+                    if not saw_first_rows:
+                        # time-to-first-row: how long the first non-empty
+                        # partition took to surface (the streaming
+                        # executor's first-row latency metric; rendered by
+                        # the explain_analyze "streaming:" line and the
+                        # bench ttfr rung)
+                        saw_first_rows = True
+                        ctx.stats.bump("time_to_first_row_ns",
+                                       time.perf_counter_ns() - t0)
                 yield part
         except GeneratorExit:
             # consumer closed the stream early (limit/abandoned iterator):
@@ -1278,6 +1338,29 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
             # teardown (and the record/capture hooks it runs) still logs
             # under this query's id
             with obs_log.query_context(query_id):
+                # close the stream tree BEFORE the pool goes away: a
+                # streaming pipeline's producers may be blocked on their
+                # channels, and generator close is what shuts the channels
+                # and unblocks them (GC would get there eventually; an
+                # abandoned/erroring query must not leave pool workers
+                # parked until then)
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except BaseException as e:
+                        # a generator's own teardown raising must not skip
+                        # pool shutdown or the record-on-every-completion
+                        # contract (and must not mask the query's error)
+                        obs_log.get_logger("execution").warning(
+                            "stream_close_failed", error=repr(e))
+                # close(it) cannot reach a pipeline suspended below an op
+                # whose raise terminated the chain above it (the traceback
+                # keeps those frames alive — see register_stream): shut
+                # down the stragglers directly. Only a deliberate early
+                # stop (success/abandoned consumer) counts short-circuits.
+                ctx.close_streams(
+                    short_circuit=outcome in ("ok", "abandoned"))
                 ctx.shutdown_pool()
                 ctx.finish_query()
                 prof = ctx.stats.profiler

@@ -158,6 +158,12 @@ class FusedMapOp(PhysicalOp):
     replaced; `fused_chains` / `fused_ops_eliminated` / `cse_hits` counters
     make the collapse visible in every plan dump."""
 
+    # the fused program is a composition of row-local projections and
+    # filters, so the chain streams morsel-wise exactly like its
+    # constituent ops would (pin-bearing programs are declined by the
+    # driver's UDF gate via _map_exprs)
+    morsel_streamable = True
+
     def __init__(self, child: PhysicalOp, program: FusedProgram,
                  schema: Schema):
         super().__init__([child], schema, child.num_partitions)

@@ -144,12 +144,14 @@ def row_group_stats(md, rg_idx: int, schema: Schema) -> TableStats:
     return TableStats(cols, num_rows=rg.num_rows, size_bytes=rg.total_byte_size)
 
 
-def read_parquet_table(path: str, pushdowns: Optional[Pushdowns] = None,
-                       schema: Optional[Schema] = None,
-                       row_group_ids: Optional[List[int]] = None) -> Table:
-    """Read one parquet file with pushdowns: column projection at the IO layer,
-    row-group pruning via footer stats, limit-aware early stop, residual filter
-    on the decoded batch."""
+def plan_parquet_chunks(path: str, pushdowns: Optional[Pushdowns] = None,
+                        schema: Optional[Schema] = None,
+                        row_group_ids: Optional[List[int]] = None):
+    """Everything ``read_parquet_table`` does BEFORE decoding: open the
+    footer, project columns, prune row groups by stats, apply the
+    limit-aware early stop. Returns ``(pf, chosen_row_groups, columns,
+    file_schema)``. The chunk-wise streaming read and the whole-file read
+    share this, so both choose exactly the same row groups."""
     pushdowns = pushdowns or Pushdowns()
     pf = open_parquet_file(path)
     md = pf.metadata
@@ -176,13 +178,17 @@ def read_parquet_table(path: str, pushdowns: Optional[Pushdowns] = None,
         if pushdowns.limit is not None and pushdowns.filters is None and rows_taken >= pushdowns.limit:
             break
     IO_STATS.bump(row_groups_read=len(chosen), row_groups_pruned=pruned)
+    return pf, chosen, columns, file_schema
 
-    if not chosen:
-        empty = file_schema if columns is None else file_schema.select(columns)
-        out = Table.empty(empty)
-        return _drop_filter_only_columns(_residual_filter(out, pushdowns), pushdowns)
 
-    arrow_tbl = pf.read_row_groups(chosen, columns=columns, use_threads=True)
+def _finish_parquet_decode(arrow_tbl: "pa.Table", columns,
+                           pushdowns: Pushdowns,
+                           schema: Optional[Schema]) -> Table:
+    """The decode tail shared by the whole-file and chunk-wise parquet
+    reads (IO accounting, schema cast, residual filter, filter-only-column
+    drop). ONE copy on purpose: the streaming executor's byte-identity
+    invariant needs chunk-wise reads to concatenate to exactly the
+    whole-file content, so any tweak here applies to both paths."""
     IO_STATS.bump(bytes_read=arrow_tbl.nbytes, rows_read=arrow_tbl.num_rows)
     tbl = Table.from_arrow(arrow_tbl)
     if schema is not None:
@@ -190,6 +196,34 @@ def read_parquet_table(path: str, pushdowns: Optional[Pushdowns] = None,
         tbl = tbl.cast_to_schema(Schema(want))
     tbl = _residual_filter(tbl, pushdowns)
     return _drop_filter_only_columns(tbl, pushdowns)
+
+
+def read_parquet_chunk(pf, rg: int, columns, pushdowns: Pushdowns,
+                       schema: Optional[Schema]) -> Table:
+    """Decode ONE planned row group, applying the same schema cast,
+    residual filter, and filter-only-column drop as the whole-file read —
+    chunk-wise reads concatenate to byte-identical content."""
+    arrow_tbl = pf.read_row_group(rg, columns=columns, use_threads=True)
+    return _finish_parquet_decode(arrow_tbl, columns, pushdowns, schema)
+
+
+def read_parquet_table(path: str, pushdowns: Optional[Pushdowns] = None,
+                       schema: Optional[Schema] = None,
+                       row_group_ids: Optional[List[int]] = None) -> Table:
+    """Read one parquet file with pushdowns: column projection at the IO layer,
+    row-group pruning via footer stats, limit-aware early stop, residual filter
+    on the decoded batch."""
+    pushdowns = pushdowns or Pushdowns()
+    pf, chosen, columns, file_schema = plan_parquet_chunks(
+        path, pushdowns, schema, row_group_ids)
+
+    if not chosen:
+        empty = file_schema if columns is None else file_schema.select(columns)
+        out = Table.empty(empty)
+        return _drop_filter_only_columns(_residual_filter(out, pushdowns), pushdowns)
+
+    arrow_tbl = pf.read_row_groups(chosen, columns=columns, use_threads=True)
+    return _finish_parquet_decode(arrow_tbl, columns, pushdowns, schema)
 
 
 # ---------------------------------------------------------------------------

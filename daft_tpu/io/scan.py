@@ -179,21 +179,76 @@ class ScanTask:
         at task granularity); permanent errors (missing file, permissions)
         raise immediately."""
         from .. import faults
-        from ..context import get_context
-        from .object_store import RetryPolicy
 
-        cfg = get_context().execution_config
-        policy = RetryPolicy(
-            attempts=max(1, cfg.scan_retry_attempts),
-            backoff_s=cfg.scan_retry_backoff_s,
-            retryable=(OSError,),
-            permanent=(FileNotFoundError, PermissionError, IsADirectoryError))
+        policy = self._retry_policy()
 
         def attempt():
             faults.check("scan.read")
             return self._read_with_partition_values()
 
         return policy.run(attempt)
+
+    def _retry_policy(self):
+        from ..context import get_context
+        from .object_store import RetryPolicy
+
+        cfg = get_context().execution_config
+        return RetryPolicy(
+            attempts=max(1, cfg.scan_retry_attempts),
+            backoff_s=cfg.scan_retry_backoff_s,
+            retryable=(OSError,),
+            permanent=(FileNotFoundError, PermissionError, IsADirectoryError))
+
+    def iter_chunks(self):
+        """Lazily yield the chunk tables ``read()`` would produce, decoding
+        parquet one row group at a time — the streaming executor's first
+        morsel flows after ONE row-group decode instead of the whole file.
+        The footer/plan open and each row-group decode run inside the same
+        retry policy + ``scan.read`` fault contract as ``read()``; the
+        shared ``plan_parquet_chunks`` guarantees chunk-wise reads choose
+        exactly the row groups the whole-file read would (pruning and the
+        limit early stop included), so concatenated chunks are
+        byte-identical content. Non-parquet formats and deferred
+        partition-value filters collapse to a single whole-read chunk."""
+        if (self.format != FileFormat.PARQUET
+                or (self.partition_values
+                    and self.pushdowns.filters is not None)):
+            yield self.read()
+            return
+        from .. import faults
+        from .readers import plan_parquet_chunks, read_parquet_chunk
+
+        policy = self._retry_policy()
+
+        def plan():
+            faults.check("scan.read")
+            return plan_parquet_chunks(self.path, self.pushdowns,
+                                       self.schema, self.row_group_ids)
+
+        pf, chosen, columns, _ = policy.run(plan)
+        handle = {"pf": pf, "fresh": True}
+        for rg in chosen:
+            handle["fresh"] = True
+
+            def attempt(rg=rg):
+                if not handle["fresh"]:
+                    # retrying a failed decode: the failure may live in the
+                    # open file handle (stale/broken fd on a network fs) —
+                    # reopen before retrying, matching the whole-file path
+                    # where open+read retry together under one policy.run
+                    from .readers import open_parquet_file
+
+                    handle["pf"] = open_parquet_file(self.path)
+                    IO_STATS.bump(files_opened=1)
+                handle["fresh"] = False
+                faults.check("scan.read")
+                return read_parquet_chunk(handle["pf"], rg, columns,
+                                          self.pushdowns, self.schema)
+
+            tbl = policy.run(attempt)
+            if self.partition_values:
+                tbl = self._append_partition_columns(tbl)
+            yield tbl
 
     def _read_with_partition_values(self):
         """Catalog partition columns don't exist in the file, so a pushed-down
@@ -338,6 +393,26 @@ class MergedScanTask(ScanTask):
             return [Table.empty(self.materialized_schema)]
         want = self.materialized_schema
         return [t.cast_to_schema(want) for t in tables]
+
+    def iter_chunks(self):
+        """Lazy counterpart of ``read_chunks``: children decode one at a
+        time (and parquet children one row group at a time), with the same
+        per-child pruning, limit narrowing, and merged-schema cast — the
+        running limit decrements per CHUNK, stopping at the same child the
+        eager path would."""
+        remaining = self.pushdowns.limit
+        want = self.materialized_schema
+        for c in self.children:
+            if c.can_prune():
+                continue
+            if remaining is not None:
+                c = c.with_pushdowns(c.pushdowns.with_limit(remaining))
+            for t in c.iter_chunks():
+                yield t.cast_to_schema(want)
+                if remaining is not None:
+                    remaining -= len(t)
+            if remaining is not None and remaining <= 0:
+                break
 
 
 def merge_scan_tasks_by_size(tasks: Sequence[ScanTask],

@@ -11,7 +11,8 @@ possible so planning never triggers IO. Concat of loaded partitions is O(1)
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import (Any, Dict, Iterator, List, Optional, Sequence, Tuple,
+                    Union)
 
 from .schema import Schema
 from .stats import TableStats
@@ -139,6 +140,25 @@ class MicroPartition:
                 self._scan_task = None
                 return list(self._tables)
         return [self.table()]
+
+    def iter_chunk_tables(self) -> Iterator[Table]:
+        """LAZY counterpart of ``chunk_tables`` for the streaming
+        producers (daft_tpu/stream/): a loaded partition yields its
+        resident tables; an unloaded one decodes chunk by chunk via
+        ``ScanTask.iter_chunks`` (parquet: one row group at a time), so
+        the first morsel flows before the rest of the partition is read.
+        The load state is NOT mutated — the streaming producer consumes
+        the chunks exactly once, and a failed iteration can restart from
+        scratch (the partition-level transient-retry contract). Deferred
+        pending ops collapse to ``chunk_tables()``: they are defined over
+        the whole partition."""
+        with self._lock:
+            if self._state == "loaded":
+                return iter(list(self._tables))
+            task = None if self._pending else self._scan_task
+        if task is None or not hasattr(task, "iter_chunks"):
+            return iter(self.chunk_tables())
+        return (t for t in task.iter_chunks() if len(t))
 
     def __len__(self) -> int:
         n = self.num_rows_or_none()

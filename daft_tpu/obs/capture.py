@@ -106,6 +106,10 @@ def render_runtime_stats(stats) -> str:
             f"fusion: {counters['fused_chains']} FusedMap chain(s), "
             f"{counters.get('fused_ops_eliminated', 0)} op(s) eliminated"
             f", {counters.get('cse_hits', 0)} cse hit(s)")
+    strm = _render_streaming_line(counters)
+    if strm:
+        lines.append("")
+        lines.append(strm)
     exch = _render_exchange_line(counters)
     if exch:
         lines.append("")
@@ -115,6 +119,30 @@ def render_runtime_stats(stats) -> str:
         lines.append("counters: " + ", ".join(
             f"{k}={v}" for k, v in sorted(counters.items())))
     return "\n".join(lines)
+
+
+def _render_streaming_line(counters: dict) -> str:
+    """The explain_analyze 'streaming:' line (README "Streaming
+    execution"): morsels produced, channel high-water, backpressure
+    stalls, limit short-circuits, and time-to-first-row. Empty when no
+    morsel streamed."""
+    n = counters.get("stream_morsels", 0)
+    if not n:
+        return ""
+    parts = [f"{n:,} morsel(s)",
+             f"channel high-water {counters.get('stream_channel_high_water', 0)}"]
+    stalls = counters.get("stream_backpressure_stalls", 0)
+    if stalls:
+        parts.append(
+            f"{stalls} backpressure stall(s) "
+            f"({counters.get('stream_backpressure_ns', 0) / 1e6:.1f} ms)")
+    short = counters.get("morsels_short_circuited", 0)
+    if short:
+        parts.append(f"{short} short-circuited")
+    ttfr = counters.get("time_to_first_row_ns", 0)
+    if ttfr:
+        parts.append(f"first row {ttfr / 1e6:.1f} ms")
+    return "streaming: " + " · ".join(parts)
 
 
 def _render_exchange_line(counters: dict) -> str:

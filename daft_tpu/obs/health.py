@@ -78,6 +78,19 @@ def breaker_states() -> Dict[str, str]:
     return out
 
 
+def _streaming_snapshot() -> dict:
+    """Channel-occupancy view shared by the health snapshot and the gauge
+    mirror — one fallback shape, so a new channels_snapshot key can never
+    leave the two sides disagreeing."""
+    try:
+        from ..stream.channel import channels_snapshot
+
+        return channels_snapshot()
+    except Exception:
+        return {"active_channels": 0, "queued_morsels": 0,
+                "queued_bytes": 0}
+
+
 def engine_health() -> dict:
     """One validated snapshot of engine-wide state (see module docstring).
     The metrics-registry mirror is maintained separately by
@@ -106,6 +119,7 @@ def engine_health() -> dict:
         sched = {"inflight_tasks": inflight_tasks()}
     except Exception:
         sched = {"inflight_tasks": 0}
+    streaming = _streaming_snapshot()
     last = QUERY_LOG.last()
     from ..profile.metrics import METRICS
 
@@ -118,6 +132,7 @@ def engine_health() -> dict:
         "scheduler": sched,
         "pools": pools,
         "admission": admission_state(),
+        "streaming": streaming,
         "query_log": {
             "depth": len(QUERY_LOG),
             "capacity": QUERY_LOG.capacity,
@@ -160,6 +175,12 @@ def refresh_health_gauges(registry=None) -> None:
         reg.gauge("daft_tpu_memory_ledger_negative_releases",
                   "double-release clamps (engine bugs)").set(
             led["negative_releases"])
+        reg.gauge("daft_tpu_memory_ledger_stream_inflight_bytes",
+                  "streaming-channel morsel bytes in flight").set(
+            led.get("stream_inflight", 0))
+        reg.gauge("daft_tpu_memory_ledger_exec_inflight_bytes",
+                  "materialized task outputs parked in the dispatch "
+                  "window").set(led.get("exec_inflight", 0))
     for kind, st in breaker_states().items():
         reg.gauge(f"daft_tpu_{kind}_breaker_state",
                   "circuit breaker: 0 closed, 1 half-open, 2 open").set(
@@ -181,6 +202,16 @@ def refresh_health_gauges(registry=None) -> None:
     reg.gauge("daft_tpu_actor_pools", "live actor pools").set(pools)
     reg.gauge("daft_tpu_leaked_threads",
               "actor workers that outlived shutdown").set(leaked)
+    strm = _streaming_snapshot()
+    reg.gauge("daft_tpu_stream_channels",
+              "live streaming channels (undrained)").set(
+        strm["active_channels"])
+    reg.gauge("daft_tpu_stream_queued_morsels",
+              "morsels queued in streaming channels").set(
+        strm["queued_morsels"])
+    reg.gauge("daft_tpu_stream_queued_bytes",
+              "bytes queued in streaming channels").set(
+        strm["queued_bytes"])
     adm = admission_state()
     reg.gauge("daft_tpu_admission_active_queries",
               "queries holding an execution slot").set(
@@ -207,6 +238,7 @@ _TOP_KEYS = {
     "scheduler": dict,
     "pools": dict,
     "admission": dict,
+    "streaming": dict,
     "query_log": dict,
     "log": dict,
     "queries_total": int,
@@ -244,4 +276,7 @@ def validate_health(d: dict) -> List[str]:
     for k in ("slots", "active_queries", "queued_queries", "shed_total"):
         if not isinstance(d["admission"].get(k), int):
             errs.append(f"admission.{k} missing or non-int")
+    for k in ("active_channels", "queued_morsels", "queued_bytes"):
+        if not isinstance(d["streaming"].get(k), int):
+            errs.append(f"streaming.{k} missing or non-int")
     return errs

@@ -48,7 +48,7 @@ _EVENT_COUNTERS = (
     "collective_breaker_reopens", "collective_breaker_recoveries",
     "faults_injected", "degraded_completions", "deadline_expired",
     "prefetch_throttled", "preload_throttled", "spill_write_failures",
-    "task_retries",
+    "task_retries", "dispatch_backpressure_stalls",
 )
 
 
@@ -152,8 +152,8 @@ def build_record(query_id: str, fingerprint: str, plan_ops: Dict[str, int],
         led = MEMORY_LEDGER.snapshot()
         ledger = {k: led[k] for k in (
             "current", "high_water", "spilled_bytes", "spilled_partitions",
-            "prefetch_inflight", "async_spill_inflight",
-            "negative_releases")}
+            "prefetch_inflight", "async_spill_inflight", "stream_inflight",
+            "exec_inflight", "negative_releases")}
     except Exception:
         ledger = {}
     events = {k: counters[k] for k in _EVENT_COUNTERS if counters.get(k)}
@@ -178,6 +178,24 @@ def build_record(query_id: str, fingerprint: str, plan_ops: Dict[str, int],
         "ledger": ledger,
         "profiled": bool(profiled),
     }
+    if counters.get("stream_morsels"):
+        # the streaming-executor rollup (README "Streaming execution");
+        # optional: absent when no morsel streamed, so schema_version 1
+        # records stay valid
+        rec["streaming"] = {
+            "morsels": counters.get("stream_morsels", 0),
+            "channel_high_water": counters.get(
+                "stream_channel_high_water", 0),
+            "backpressure_stalls": counters.get(
+                "stream_backpressure_stalls", 0),
+            # duration, not just count: 40 stalls of 1 ms vs 500 ms must
+            # be tellable apart from the captured bundle alone
+            "backpressure_ms": round(
+                counters.get("stream_backpressure_ns", 0) / 1e6, 3),
+            "short_circuited": counters.get("morsels_short_circuited", 0),
+            "ttfr_ms": round(
+                counters.get("time_to_first_row_ns", 0) / 1e6, 3),
+        }
     if error is not None:
         rec["error_type"] = type(error).__name__
         rec["error_message"] = str(error)[:400]

@@ -72,6 +72,16 @@ class PhysicalOp:
 
     map_partition = None  # type: ignore[assignment]
 
+    # The morsel contract (daft_tpu/stream/, README "Streaming execution"):
+    # True declares map_partition ROW-LOCAL — applying it per fixed-size
+    # morsel and re-chunking equals applying it per partition, byte for
+    # byte — so the streaming executor may pull this op's work through
+    # bounded channels. Ops that aggregate, reorder, or depend on partition
+    # position must leave this False; daftlint DTL006 pins that a claiming
+    # op implements map_partition (no silent whole-partition
+    # materialization inside a streaming stage).
+    morsel_streamable = False
+
     def map_empty(self, ctx):
         """Partitions to emit when the (parallel-mapped) input is empty."""
         return iter(())
@@ -188,26 +198,36 @@ class ScanOp(PhysicalOp):
         super().__init__([], schema, max(len(tasks), 1))
         self.tasks = tasks
 
+    def plan_parts(self, ctx) -> List[MicroPartition]:
+        """Prune + emit the scan's unloaded partitions (shared by the
+        generator path below and the streaming pipeline driver, so both
+        see identical pruning, counters, and multi-host ownership). The
+        caller owns the ``scan.plan`` phase span."""
+        scan_owner = getattr(ctx, "scan_owner", None)
+        parts = []
+        for i, task in enumerate(self.tasks):
+            if task.can_prune():
+                ctx.stats.bump("scan_tasks_pruned")
+                continue
+            ctx.stats.bump("scan_tasks_emitted")
+            part = MicroPartition.from_scan_task(task)
+            if scan_owner is not None:
+                # multi-host: the task index over the globally-consistent
+                # list assigns which process materializes (and READS) it
+                part.owner_process = scan_owner(i)
+            parts.append(part)
+        return parts
+
     def execute(self, inputs, ctx) -> PartStream:
         from .io.prefetch import pipeline_scan_parts
 
-        scan_owner = getattr(ctx, "scan_owner", None)
-        parts = []
         with ctx.stats.profiler.span("scan.plan", kind="phase"):
-            for i, task in enumerate(self.tasks):
-                if task.can_prune():
-                    ctx.stats.bump("scan_tasks_pruned")
-                    continue
-                ctx.stats.bump("scan_tasks_emitted")
-                part = MicroPartition.from_scan_task(task)
-                if scan_owner is not None:
-                    # multi-host: the task index over the globally-consistent
-                    # list assigns which process materializes (and READS) it
-                    part.owner_process = scan_owner(i)
-                parts.append(part)
+            parts = self.plan_parts(ctx)
         # bounded readahead: reading partition i triggers the background
         # fetch of i+1..i+depth (locally-owned tasks only); byte-identical
-        # with prefetch off, order preserved by this very loop
+        # with prefetch off, order preserved by this very loop. (The
+        # streaming executor bypasses this wrapper: its producer window IS
+        # the readahead, reading chunk-wise on the pool.)
         yield from pipeline_scan_parts(parts, ctx)
 
     def describe(self):
@@ -228,6 +248,12 @@ class InMemoryOp(PhysicalOp):
 # ---------------------------------------------------------------------------
 
 class ProjectOp(PhysicalOp):
+    # row-local projection: per-morsel evaluation + re-chunk is
+    # byte-identical to per-partition evaluation (the streaming driver
+    # still declines UDF-bearing instances — a batch-dependent UDF sees
+    # whole partitions on the partition-granular path)
+    morsel_streamable = True
+
     def __init__(self, child: PhysicalOp, exprs: List[Expression], schema: Schema):
         super().__init__([child], schema, child.num_partitions)
         self.exprs = exprs
@@ -266,6 +292,10 @@ class ProjectOp(PhysicalOp):
 
 
 class FilterOp(PhysicalOp):
+    # row-local predicate: a row's fate depends only on its own values, so
+    # morsel-wise compaction concatenates to the partition-granular result
+    morsel_streamable = True
+
     def __init__(self, child: PhysicalOp, predicate: Expression):
         super().__init__([child], child.schema, child.num_partitions)
         self.predicate = predicate
@@ -304,7 +334,16 @@ class FilterOp(PhysicalOp):
 
 class LimitOp(PhysicalOp):
     """Streaming global limit with early stop (reference: global_limit,
-    physical_plan.py — iterative partition takes)."""
+    physical_plan.py — iterative partition takes).
+
+    Upstream early-termination: once the limit is satisfied the child
+    stream is CLOSED, not merely abandoned — a streaming pipeline below
+    (daft_tpu/stream/) tears down its channels and producers immediately
+    (they stop scanning/decoding partitions nobody will read, counted in
+    ``morsels_short_circuited``) instead of waiting for end-of-query GC.
+    When the limit sits directly atop a streamable chain the driver
+    absorbs it as a morsel-consuming sink instead, and this op never
+    executes."""
 
     def __init__(self, child: PhysicalOp, limit: int):
         super().__init__([child], child.schema, child.num_partitions)
@@ -312,14 +351,19 @@ class LimitOp(PhysicalOp):
 
     def execute(self, inputs, ctx) -> PartStream:
         remaining = self.limit
-        for part in inputs[0]:
-            if remaining <= 0:
-                break
-            n = part.num_rows_or_none()
-            if n is None or n > remaining:
-                part = part.head(remaining)
-            remaining -= len(part)
-            yield part
+        src = inputs[0]
+        if remaining > 0:
+            for part in src:
+                n = part.num_rows_or_none()
+                if n is None or n > remaining:
+                    part = part.head(remaining)
+                remaining -= len(part)
+                yield part
+                if remaining <= 0:
+                    break
+        close = getattr(src, "close", None)
+        if close is not None:
+            close()
 
     def describe(self):
         return f"Limit: {self.limit}"

@@ -50,6 +50,24 @@ def _inflight_add(n: int) -> None:
         _inflight += n
 
 
+def _result_bytes(out) -> int:
+    """Ledger charge for a parked task output — loaded partitions only, so
+    accounting never triggers IO or forces a deferred op. Some dispatch
+    users (exchange split stages) return a LIST of partitions per task."""
+    if isinstance(out, MicroPartition):
+        parts = (out,)
+    elif isinstance(out, (list, tuple)):
+        parts = tuple(p for p in out if isinstance(p, MicroPartition))
+    else:
+        return 0
+    total = 0
+    for p in parts:
+        if p.is_loaded():
+            b = p.size_bytes()
+            total += b or 0
+    return total
+
+
 def _run_with_retry(task: "PartitionTask", ctx) -> MicroPartition:
     """Per-task transient retry: a partition task that raises
     DaftTransientError — e.g. an injected io.get/scan.read fault that
@@ -102,12 +120,20 @@ def _await_result(task: "PartitionTask", fut, ctx) -> MicroPartition:
 
     try:
         if fut.done():
-            return fut.result()
-        t0 = time.perf_counter_ns()
-        try:
-            return fut.result()
-        finally:
-            ctx.stats.dispatch_wait(time.perf_counter_ns() - t0)
+            out = fut.result()
+        else:
+            t0 = time.perf_counter_ns()
+            try:
+                out = fut.result()
+            finally:
+                ctx.stats.dispatch_wait(time.perf_counter_ns() - t0)
+        if task.held_bytes:
+            # the output leaves the dispatch window: it is the consumer's
+            # working unit now (the documented one-unit slack), not parked
+            # between-steps memory
+            ctx.ledger.exec_done(task.held_bytes)
+            task.held_bytes = 0
+        return out
     except CancelledError:
         _inflight_add(-1)
         if task.resource_request:
@@ -125,7 +151,7 @@ class PartitionTask:
     same way, so worker-side log lines stay attributed."""
 
     __slots__ = ("partition", "fn", "resource_request", "op_name", "seq",
-                 "span_token", "submit_ns", "query_id")
+                 "span_token", "submit_ns", "query_id", "held_bytes")
 
     def __init__(self, partition: MicroPartition, fn: Callable,
                  resource_request=None, op_name: str = "task", seq: int = 0):
@@ -137,6 +163,10 @@ class PartitionTask:
         self.span_token = None
         self.submit_ns = 0
         self.query_id = None
+        # ledger exec_inflight charge for this task's materialized output
+        # while it waits in the dispatch window (set by run_task on
+        # success, settled when the consumer pulls — or at teardown)
+        self.held_bytes = 0
 
     def run(self) -> MicroPartition:
         return self.fn(self.partition)
@@ -157,6 +187,14 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
       the worker when the task finishes — or by the dispatcher if a queued
       task is cancelled before it ever ran.
     - cancellation is honored between dispatches.
+    - on a BUDGETED query the window exerts backpressure: materialized
+      task outputs parked behind the head-of-line task are working-set
+      memory (MemoryLedger.exec_inflight), so while they exceed their
+      budget slice (budget/4 — the same share the streaming channels get)
+      no new task is submitted and the head is drained instead. The head
+      task always runs, so a single oversized partition stalls the window,
+      never the query — partition-granular backpressure, the coarse
+      cousin of the streaming channels' morsel-granular byte cap.
     """
     from .execution import QueryCancelledError
 
@@ -166,6 +204,8 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
             backlog = ctx.num_workers
         window = ctx.num_workers + backlog
     window = max(1, window)
+    budget = getattr(ctx, "memory_budget", None)
+    exec_cap = None if budget is None else max(1, budget // 4)
     pool = ctx.pool()
     pending: deque = deque()
 
@@ -191,7 +231,18 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
         else:
             act = None
         try:
-            return _run_with_retry(task, ctx)
+            out = _run_with_retry(task, ctx)
+            held = _result_bytes(out)
+            if held:
+                # the materialized output now waits in `pending` behind the
+                # head-of-line task: charge it to the query's working set
+                # (MemoryLedger.exec_inflight) so pipeline-breaker spill
+                # decisions see the partition-granular path's real
+                # between-steps memory — the streaming path's bounded
+                # channels charge stream_inflight instead
+                task.held_bytes = held
+                ctx.ledger.exec_started(held)
+            return out
         finally:
             _WORKER_TL.active = False
             if sp is not None:
@@ -222,7 +273,12 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
             task.query_id = current_query_id()
             _inflight_add(1)
             pending.append((task, pool.submit(run_task, task)))
-            while len(pending) >= window:
+            while len(pending) >= window or (
+                    exec_cap is not None and pending
+                    and ctx.ledger.exec_inflight > exec_cap):
+                if exec_cap is not None and len(pending) < window \
+                        and ctx.ledger.exec_inflight > exec_cap:
+                    ctx.stats.bump("dispatch_backpressure_stalls")
                 yield _await_result(*pending.popleft(), ctx)
         while pending:
             # the deadline stays cooperative through the drain: in-flight
@@ -240,3 +296,13 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
                 _inflight_add(-1)
                 if task.resource_request:
                     ctx.accountant.release(task.resource_request)
+            else:
+                # running or completed but never pulled (early close): its
+                # parked-output ledger charge settles when the task is done
+                # — fires immediately for already-done futures
+                def _settle(f, t=task):
+                    if t.held_bytes:
+                        ctx.ledger.exec_done(t.held_bytes)
+                        t.held_bytes = 0
+
+                fut.add_done_callback(_settle)

@@ -93,16 +93,50 @@ class MemoryLedger:
         # writer's working copy, they are transient write-side state,
         # bounded by the writer queue depth)
         self.async_spill_inflight = 0
+        # streaming-channel morsel bytes currently queued between producer
+        # and consumer stages (stream/channel.py). NOT in `current` for the
+        # prefetch_inflight reason: charging it there would make pipeline-
+        # breaker appends see a full ledger and spill their whole input.
+        # Bounded by channel capacity x producer window; the high-water
+        # mark is the bench rung's streaming working-set peak.
+        self.stream_inflight = 0
+        self.stream_inflight_high_water = 0
+        # fully-materialized map-task outputs parked in the scheduler's
+        # dispatch window (completed, waiting behind the head-of-line task
+        # for the consumer to pull): the partition-granular path's "whole
+        # partitions between steps" working set, which the streaming path
+        # replaces with bounded channel morsels. Charged by
+        # scheduler.dispatch, released when the consumer pulls the result.
+        # NOT in `current` for the prefetch_inflight reason.
+        self.exec_inflight = 0
+        self.exec_inflight_high_water = 0
+        # peak of current + stream_inflight + prefetch_inflight +
+        # exec_inflight: the query's ledger-visible WORKING SET (buffers +
+        # streaming channels + prefetched-but-unconsumed partitions +
+        # parked whole-partition task outputs). The spill decision charges
+        # all four against the budget, so this peak stays bounded by
+        # memory_budget_bytes (+ the documented one-working-unit slack) —
+        # the bench streaming rung's bounded-memory metric
+        self.working_set_high_water = 0
         # spill write/read throughput totals (file bytes + wall ns)
         self.spill_write_bytes = 0
         self.spill_write_ns = 0
         self.unspill_bytes = 0
         self.unspill_ns = 0
 
+    def _note_working_set_locked(self) -> None:
+        # runs under self._lock (every caller holds it); the lock-discipline
+        # rule is lexical and cannot see through the helper
+        ws = (self.current + self.stream_inflight
+              + self.prefetch_inflight + self.exec_inflight)
+        if ws > self.working_set_high_water:
+            self.working_set_high_water = ws  # daftlint: disable=DTL002
+
     def add(self, n: int) -> None:
         with self._lock:
             self.current += n
             self.high_water = max(self.high_water, self.current)
+            self._note_working_set_locked()
         if self._parent is not None:
             self._parent.add(n)
 
@@ -140,6 +174,7 @@ class MemoryLedger:
     def prefetch_started(self, n: int) -> None:
         with self._lock:
             self.prefetch_inflight += n
+            self._note_working_set_locked()
         if self._parent is not None:
             self._parent.prefetch_started(n)
 
@@ -149,6 +184,40 @@ class MemoryLedger:
             self.prefetch_inflight -= done
         if self._parent is not None and done:
             self._parent.prefetch_done(done)
+
+    # --- streaming-channel charges (stream/channel.py) ------------------
+    def stream_started(self, n: int) -> None:
+        with self._lock:
+            self.stream_inflight += n
+            if self.stream_inflight > self.stream_inflight_high_water:
+                self.stream_inflight_high_water = self.stream_inflight
+            self._note_working_set_locked()
+        if self._parent is not None:
+            self._parent.stream_started(n)
+
+    def stream_done(self, n: int) -> None:
+        with self._lock:
+            done = min(n, self.stream_inflight)
+            self.stream_inflight -= done
+        if self._parent is not None and done:
+            self._parent.stream_done(done)
+
+    # --- parked partition-task outputs (scheduler.dispatch) -------------
+    def exec_started(self, n: int) -> None:
+        with self._lock:
+            self.exec_inflight += n
+            if self.exec_inflight > self.exec_inflight_high_water:
+                self.exec_inflight_high_water = self.exec_inflight
+            self._note_working_set_locked()
+        if self._parent is not None:
+            self._parent.exec_started(n)
+
+    def exec_done(self, n: int) -> None:
+        with self._lock:
+            done = min(n, self.exec_inflight)
+            self.exec_inflight -= done
+        if self._parent is not None and done:
+            self._parent.exec_done(done)
 
     # --- async spill writeback ------------------------------------------
     def async_spill_started(self, n: int) -> None:
@@ -207,6 +276,11 @@ class MemoryLedger:
             self.negative_releases = 0
             self.prefetch_inflight = 0
             self.async_spill_inflight = 0
+            self.stream_inflight = 0
+            self.stream_inflight_high_water = 0
+            self.exec_inflight = 0
+            self.exec_inflight_high_water = 0
+            self.working_set_high_water = 0
             self.spill_write_bytes = 0
             self.spill_write_ns = 0
             self.unspill_bytes = 0
@@ -222,6 +296,11 @@ class MemoryLedger:
                 "negative_releases": self.negative_releases,
                 "prefetch_inflight": self.prefetch_inflight,
                 "async_spill_inflight": self.async_spill_inflight,
+                "stream_inflight": self.stream_inflight,
+                "stream_inflight_high_water": self.stream_inflight_high_water,
+                "exec_inflight": self.exec_inflight,
+                "exec_inflight_high_water": self.exec_inflight_high_water,
+                "working_set_high_water": self.working_set_high_water,
                 "spill_write_bytes": self.spill_write_bytes,
                 "spill_write_ns": self.spill_write_ns,
                 "unspill_bytes": self.unspill_bytes,
@@ -694,8 +773,21 @@ class PartitionBuffer:
 
     def append(self, part: MicroPartition) -> None:
         size = part.size_bytes() or 0
+        # the spill decision charges the query's full ledger-visible
+        # WORKING SET, not just buffered bytes: streaming-channel morsels
+        # and prefetched-but-unconsumed partitions are resident memory
+        # eating the same budget headroom. When backpressure alone can't
+        # bound the working set, the buffers spill earlier — spill is the
+        # fallback, not a separate account (README "Streaming execution").
+        # Streaming's bounded channels charge far less here than the
+        # partition-granular path's whole-partition units (exec_inflight:
+        # materialized task outputs parked in the dispatch window) — the
+        # bench streaming rung's spill-reduction claim.
         if (self.budget is not None and len(part)
-                and self.ledger.current + size > self.budget):
+                and (self.ledger.current + self.ledger.stream_inflight
+                     + self.ledger.prefetch_inflight
+                     + self.ledger.exec_inflight
+                     + size > self.budget)):
             spilled = self._try_spill(part, size)
             if spilled is not None:
                 self._items.append(spilled)

@@ -1,0 +1,24 @@
+"""Morsel-driven streaming execution (README "Streaming execution").
+
+Streamable chains — Scan/InMemory source -> Project/Filter/FusedMap maps
+-> optional Limit sink — pull fixed-size morsels (``cfg.morsel_size_rows``)
+through bounded channels with backpressure instead of materializing whole
+partitions at every step boundary. Pipeline breakers keep their
+partition-granular contract behind the driver's morsel->partition re-chunk
+boundary, so results are byte-identical with ``cfg.streaming_execution``
+off (the hard invariant every test in tests/test_streaming.py pins).
+
+- :mod:`daft_tpu.stream.morsel`   — zero-copy slice views over a
+  MicroPartition's reader chunks
+- :mod:`daft_tpu.stream.channel`  — bounded MPSC channel charged to the
+  query's MemoryLedger share, with close/error propagation
+- :mod:`daft_tpu.stream.pipeline` — segment extraction + the
+  producer/consumer driver over the shared executor pool
+"""
+
+from .channel import BoundedChannel, ChannelClosed, channels_snapshot
+from .morsel import iter_morsels
+from .pipeline import try_stream
+
+__all__ = ["BoundedChannel", "ChannelClosed", "channels_snapshot",
+           "iter_morsels", "try_stream"]

@@ -1,0 +1,46 @@
+# daftlint: migrated
+"""Morsels: the fixed-size unit of streaming execution.
+
+A morsel is a loaded :class:`MicroPartition` wrapping ``Table.slice`` views
+of its source partition's reader chunks — zero-copy where Arrow allows
+(slices share the backing buffers; only the offsets differ). Morsels never
+span chunk boundaries, so a multi-chunk scan partition is morselized
+without ever paying ``table()``'s full concat.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..micropartition import MicroPartition
+
+__all__ = ["iter_morsels"]
+
+
+def iter_morsels(part: MicroPartition,
+                 rows: int) -> Iterator[MicroPartition]:
+    """Slice ``part`` into loaded morsels of at most ``rows`` rows each.
+
+    An unloaded partition reads through ``iter_chunk_tables()`` — the
+    LAZY chunk path (parquet decodes one row group at a time, behind the
+    same retry + ``scan.read`` fault contract as the eager read), so the
+    first morsel flows after one chunk decode instead of a whole
+    partition, and streaming changes WHERE/WHEN the decode runs, never
+    what it returns. An empty partition yields exactly ONE empty morsel:
+    the driver's re-chunk sink rebuilds source partitions 1:1, empty ones
+    included, keeping partition boundaries byte-identical with the
+    partition-granular path.
+    """
+    rows = max(1, int(rows))
+    emitted = False
+    for t in part.iter_chunk_tables():
+        n = len(t)
+        for s in range(0, n, rows):
+            m = MicroPartition.from_table(t.slice(s, min(s + rows, n)))
+            m.owner_process = part.owner_process
+            emitted = True
+            yield m
+    if not emitted:
+        m = MicroPartition.empty(part.schema)
+        m.owner_process = part.owner_process
+        yield m

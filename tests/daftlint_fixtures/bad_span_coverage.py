@@ -30,3 +30,45 @@ class DelegatingOp:
 
     def execute(self, inputs, ctx):
         return self._map_execute(inputs, ctx)
+
+
+class FakeStreamableOp:
+    """Violation: claims the morsel contract without implementing it —
+    the streaming driver would silently fall back to whole-partition
+    materialization inside a streaming stage."""
+
+    morsel_streamable = True
+
+    def execute(self, inputs, ctx):
+        return self._map_execute(inputs, ctx)
+
+
+class HonestStreamableOp:
+    """Covered: morsel_streamable WITH the per-morsel entry point."""
+
+    morsel_streamable = True
+
+    def map_partition(self, part, ctx):
+        return part
+
+    def execute(self, inputs, ctx):
+        return self._map_execute(inputs, ctx)
+
+
+class AnnotatedFakeStreamableOp:
+    """Violation: the annotated-assignment spelling claims the contract
+    too (the runtime getattr sees either form) and must not bypass the
+    map_partition check."""
+
+    morsel_streamable: bool = True
+
+    def execute(self, inputs, ctx):
+        return self._map_execute(inputs, ctx)
+
+
+def _produce_partition(seg, part, chan, ctx):
+    """Violation: a stream-driver producer that opens no profiler span —
+    morsel work on the pool workers becomes an attribution blind spot."""
+    for m in part:
+        chan.put(m, 0)
+    chan.finish()

@@ -36,6 +36,20 @@ class TestClassify:
         assert classify("rows") is None  # bare table size: no direction
         assert classify("some_unknown_thing") is None
 
+    def test_streaming_suffixes(self):
+        # streaming rung (ISSUE 10): time-to-first-row and working-set
+        # peaks are lower-better; throughput (_mbps) stays higher-better
+        assert classify("streaming_ttfr_s") == "lower"
+        assert classify("streaming_serial_ttfr_s") == "lower"
+        assert classify("streaming_peak_mb") == "lower"
+        assert classify("streaming_serial_peak_mb") == "lower"
+        assert classify("spill_write_mbps") == "higher"
+        assert classify("streaming_ttfr_speedup_x") == "higher"
+        # size-context keys (dataset/budget scale with host RAM between
+        # rounds) must stay UNCLASSIFIED — a scale flip is not a regression
+        assert classify("streaming_data_mb") is None
+        assert classify("streaming_budget_mb") is None
+
 
 class TestFlatten:
     def test_nested_and_non_numeric(self):

@@ -35,7 +35,7 @@ def cfg():
         "scan_prefetch_depth", "async_spill_writes", "unspill_readahead",
         "parallel_shuffle_fanout", "memory_budget_bytes",
         "enable_result_cache", "scan_tasks_min_size_bytes",
-        "executor_threads", "enable_profiling")}
+        "executor_threads", "enable_profiling", "streaming_execution")}
     c.enable_result_cache = False
     c.scan_tasks_min_size_bytes = 1
     yield c
@@ -181,6 +181,10 @@ class TestCrossThreadAttribution:
 
     def test_worker_spans_carry_queue_wait(self, cfg):
         cfg.executor_threads = 2
+        # this pins the SCHEDULER's worker-task spans; with streaming on
+        # this plan shape routes through the morsel pipeline instead
+        # (whose attribution tests/test_streaming.py owns)
+        cfg.streaming_execution = False
         df = dt.from_pydict({"v": list(range(4000))}).into_partitions(8)
         q = df.select((col("v") * 3).alias("w")).collect(profile=True)
         spans = q.profile().spans()

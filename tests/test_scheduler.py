@@ -139,3 +139,99 @@ def test_resource_release_on_early_exit():
     t.join(timeout=5)
     assert done, "admission ledger leaked reservations after early exit"
     ctx.shutdown_pool()
+
+
+# ---------------------------------------------------------------------------
+# parked-output working-set accounting + budget backpressure (PR 10)
+# ---------------------------------------------------------------------------
+
+def _mp_big(i, rows=4000):
+    return MicroPartition.from_table(Table.from_pydict(
+        {"x": list(range(rows)),
+         "s": [f"pad-{i}-{j:06d}" * 4 for j in range(rows)]}))
+
+
+def test_parked_outputs_charge_ledger_and_settle():
+    """A completed task output waiting behind the head-of-line task is
+    between-steps working memory: charged to MemoryLedger.exec_inflight
+    while parked, settled the moment the consumer pulls it."""
+    from daft_tpu.spill import MEMORY_LEDGER
+
+    MEMORY_LEDGER.reset()
+    ctx = _ctx(threads=2)
+
+    def slow(part):
+        time.sleep(0.4)
+        return part
+
+    tasks = iter([PartitionTask(_mp_big(0), slow, None, "t", 0),
+                  PartitionTask(_mp_big(1), lambda p: p, None, "t", 1)])
+    g = dispatch(tasks, ctx)
+    next(g)  # blocks on the slow head; the fast task's output parks
+    assert MEMORY_LEDGER.exec_inflight > 0
+    next(g)  # pulling the parked output settles its charge
+    assert MEMORY_LEDGER.exec_inflight == 0
+    assert MEMORY_LEDGER.exec_inflight_high_water > 0
+    assert MEMORY_LEDGER.snapshot()["exec_inflight"] == 0
+    with pytest.raises(StopIteration):
+        next(g)
+    ctx.shutdown_pool()
+    MEMORY_LEDGER.reset()
+
+
+def test_parked_output_charge_settles_on_early_close():
+    """Abandoning the dispatch generator (limit early-stop, error teardown)
+    must settle the parked-output charges of results never pulled."""
+    from daft_tpu.spill import MEMORY_LEDGER
+
+    MEMORY_LEDGER.reset()
+    ctx = _ctx(threads=2)
+
+    def slow(part):
+        time.sleep(0.4)
+        return part
+
+    tasks = iter([PartitionTask(_mp_big(0), slow, None, "t", 0),
+                  PartitionTask(_mp_big(1), lambda p: p, None, "t", 1)])
+    g = dispatch(tasks, ctx)
+    next(g)
+    assert MEMORY_LEDGER.exec_inflight > 0  # fast output parked
+    g.close()  # parked output never pulled
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and MEMORY_LEDGER.exec_inflight:
+        time.sleep(0.01)
+    assert MEMORY_LEDGER.exec_inflight == 0
+    ctx.shutdown_pool()
+    MEMORY_LEDGER.reset()
+
+
+def test_budget_backpressure_throttles_window():
+    """On a budgeted query the dispatch window stops growing while parked
+    outputs exceed their budget slice (budget/4): the head is drained
+    instead, the stall is counted, and results stay in task order."""
+    from daft_tpu.spill import MEMORY_LEDGER
+
+    MEMORY_LEDGER.reset()
+    cfg = daft_tpu.context.get_context().execution_config
+    import copy
+
+    c = copy.copy(cfg)
+    c.executor_threads = 4
+    c.max_task_backlog = -1
+    c.memory_budget_bytes = 64 * 1024  # exec_cap = 16 KiB < one output
+    ctx = ExecutionContext(c, RuntimeStats())
+    assert ctx.memory_budget == 64 * 1024
+
+    def src():
+        for i in range(8):
+            time.sleep(0.02)  # completions land between submissions
+            yield PartitionTask(_mp_big(i, rows=2000), lambda p: p, None,
+                                "t", i)
+
+    got = [p.to_pydict()["x"][0] for p in dispatch(src(), ctx)]
+    assert got == [0] * 8
+    assert ctx.stats.snapshot()["counters"].get(
+        "dispatch_backpressure_stalls", 0) > 0
+    assert MEMORY_LEDGER.exec_inflight == 0  # all charges settled
+    ctx.shutdown_pool()
+    MEMORY_LEDGER.reset()

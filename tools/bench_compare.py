@@ -34,12 +34,20 @@ _SNAPSHOT_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 # metric-name suffix -> direction ("lower" = smaller is better). Order
 # matters across the two lists: HIGHER is checked first, so the more
 # specific "_rows_pruned" (exchange-rung join filters: more pruning is
-# better) wins over the generic "_rows" (fewer exchanged rows is better).
+# better) wins over the generic "_rows" (fewer exchanged rows is better),
+# and "_mbps" (throughput, higher) wins over "_peak_mb" (working-set
+# peak — lower). A generic "_mb" is deliberately ABSENT: size-context
+# keys like streaming_budget_mb/streaming_data_mb track host RAM and
+# auto-scaling, not performance, and must stay unclassified so a scale
+# flip between rounds is never flagged as a regression.
 # Exchanged-payload bytes ("*_exchange_bytes") are lower-better via the
-# existing "_bytes" suffix.
-_LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_pct", "_share",
-                   "_bytes", "_rows", "_misses", "_throttled", "_failures",
-                   "_errors", "_overhead_pct", "_shed_count")
+# existing "_bytes" suffix; "_ttfr_s" (time-to-first-row) is listed
+# explicitly even though "_s" already covers it — it is a headline
+# streaming metric and must survive a reshuffle of the generic suffixes.
+_LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_ttfr_s", "_pct",
+                   "_share", "_bytes", "_peak_mb", "_rows",
+                   "_misses", "_throttled", "_failures", "_errors",
+                   "_overhead_pct", "_shed_count")
 _HIGHER_SUFFIXES = ("_per_sec", "_vs_baseline", "_speedup_x", "_gbps",
                     "_mbps", "_hits", "_qps", "value", "_rows_pruned",
                     "_reduction_x")

@@ -58,6 +58,9 @@ REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
     # health snapshot's weak registries (latest breakers / admission)
     ("daft_tpu/obs/health.py", "_breakers"),
     ("daft_tpu/obs/health.py", "_admission"),
+    # live streaming channels (weak): the dt.health() channel-occupancy
+    # view; entries die with their pipeline
+    ("daft_tpu/stream/channel.py", "_channels"),
     # result cache: process-wide by design (reference PartitionSetCache)
     ("daft_tpu/runners.py", "_PARTITION_SET_CACHE"),
     # live serving runtimes, for engine-wide drain at dt.shutdown()

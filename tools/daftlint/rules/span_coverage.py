@@ -18,6 +18,17 @@ signature) must, somewhere in that method body, either
 - open a profiler span (a ``.span(...)`` / ``.begin(...)`` call on a
   profiler object).
 
+Since the morsel-driven streaming executor (daft_tpu/stream/) the rule
+also pins the *morsel contract* and the stream driver's coverage:
+
+- a class declaring ``morsel_streamable = True`` must define
+  ``map_partition`` in the same class body — claiming streamability
+  without the per-morsel entry point means the driver would silently fall
+  back to whole-partition materialization inside a streaming stage;
+- the stream driver's producer entry point (a function named
+  ``_produce_partition``) must itself open a profiler span, so morsel
+  work is never an attribution blind spot on the pool workers.
+
 Pre-existing uncovered ops are grandfathered via baseline.json (the
 DTL004 discipline: the backlog is visible, new blind spots fail the run).
 """
@@ -66,11 +77,50 @@ def _is_physical_execute(fn: ast.FunctionDef) -> bool:
     return not all(isinstance(n, (ast.Raise, ast.Pass)) for n in body)
 
 
+# stream-driver producer entry points (daft_tpu/stream/pipeline.py): each
+# runs morsel work on a pool worker and must open its own profiler span —
+# or delegate to another function in this set that does (the retry wrapper
+# chain _produce_partition -> _produce_with_retry -> _produce_once)
+_STREAM_DRIVER_FNS = {"_produce_partition", "_produce_with_retry",
+                      "_produce_once"}
+
+
+def _delegates_to_stream_driver(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is not None \
+                    and name.split(".")[-1] in _STREAM_DRIVER_FNS:
+                return True
+    return False
+
+
+def _claims_morsel_streamable(cls: ast.ClassDef) -> bool:
+    # both `morsel_streamable = True` and the annotated
+    # `morsel_streamable: bool = True` — the runtime getattr sees either
+    for item in cls.body:
+        if isinstance(item, ast.Assign):
+            targets = item.targets
+        elif isinstance(item, ast.AnnAssign) and item.value is not None:
+            targets = [item.target]
+        else:
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) \
+                    and tgt.id == "morsel_streamable" \
+                    and isinstance(item.value, ast.Constant) \
+                    and item.value.value is True:
+                return True
+    return False
+
+
 class SpanCoverageRule(Rule):
     code = "DTL006"
     name = "span-coverage"
     description = ("every *Op.execute(self, inputs, ctx) entry point "
-                   "delegates to _map_execute or opens a profiler span")
+                   "delegates to _map_execute or opens a profiler span; "
+                   "morsel_streamable ops implement map_partition; the "
+                   "stream driver's producer opens a span")
 
     def run(self, project: Project) -> List[Finding]:
         out: List[Finding] = []
@@ -79,9 +129,29 @@ class SpanCoverageRule(Rule):
             if tree is None:
                 continue
             for node in ast.walk(tree):
+                if isinstance(node, ast.FunctionDef) \
+                        and node.name in _STREAM_DRIVER_FNS:
+                    if not (_execute_is_covered(node)
+                            or _delegates_to_stream_driver(node)):
+                        out.append(self.finding(
+                            rel, node.lineno,
+                            f"stream-driver `{node.name}` opens no "
+                            "profiler span — morsel work on pool workers "
+                            "must not be an attribution blind spot"))
+                    continue
                 if not isinstance(node, ast.ClassDef) or \
                         not node.name.endswith("Op"):
                     continue
+                methods = {item.name for item in node.body
+                           if isinstance(item, ast.FunctionDef)}
+                if _claims_morsel_streamable(node) \
+                        and "map_partition" not in methods:
+                    out.append(self.finding(
+                        rel, node.lineno,
+                        f"`{node.name}` claims `morsel_streamable = True` "
+                        "but defines no `map_partition` — the streaming "
+                        "driver would silently materialize whole "
+                        "partitions inside a streaming stage"))
                 for item in node.body:
                     if not isinstance(item, ast.FunctionDef) or \
                             item.name != "execute":
