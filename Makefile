@@ -1,20 +1,24 @@
 # One-command local check: the same static gates tier-1 runs.
-#   make lint          - daftlint invariants (DTL001-DTL007) + bytecode-compile
+#   make lint          - daftlint invariants (DTL001-DTL008) + bytecode-compile
 #                        daft_tpu + profile smoke (QueryProfile schema gate)
 #                        + obs smoke (flight-recorder schema gate)
+#                        + chaos smoke (distributed-runner kill survival gate)
 #   make profile-smoke - tiny profiled query; validates the QueryProfile JSON,
 #                        chrome trace, and metrics dump end to end
 #   make obs-smoke     - flight recorder end to end: query log, health
 #                        snapshot, forced slow-query bundle, health gauges
+#   make chaos-smoke   - mixed workload through the distributed runner under
+#                        seeded random worker SIGKILLs: every query terminal,
+#                        zero leaked worker processes
 #   make bench-compare - diff the two newest BENCH_r*.json, flag per-metric
 #                        regressions beyond the noise threshold
 #   make test          - full tier-1 test suite (CPU jax)
 
 PY ?= python
 
-.PHONY: lint test profile-smoke obs-smoke bench-compare
+.PHONY: lint test profile-smoke obs-smoke chaos-smoke bench-compare
 
-lint: profile-smoke obs-smoke
+lint: profile-smoke obs-smoke chaos-smoke
 	$(PY) -m tools.daftlint
 	$(PY) -m compileall -q daft_tpu
 
@@ -23,6 +27,9 @@ profile-smoke:
 
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.obs_smoke
+
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.chaos_smoke
 
 bench-compare:
 	$(PY) -m tools.bench_compare

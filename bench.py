@@ -520,6 +520,84 @@ def measure_serving(scale: float = 0.01, offered_qps: float = 6.0,
         cfg.enable_result_cache = prev_cache
 
 
+def measure_distributed(scale: float = 0.02, workers: int = 2,
+                        trials: int = 2) -> dict:
+    """Distributed-runner rung (ISSUE 11): interleaved best-of A/B of the
+    local runner vs the N-worker multi-process runner on the q1 shape
+    (same data, same plan — the A/B isolates transport+supervision
+    overhead), plus a RECOVERY leg: the same distributed query with one
+    worker SIGKILLed mid-query via the deterministic ``worker.exec``
+    chaos fault. Emits the walls, the distributed-vs-local ratio, and
+    ``distributed_recovery_overhead_pct`` — what surviving a worker loss
+    costs relative to the undisturbed distributed run. Event counts from
+    the recovery leg (losses/redispatches) are recorded as pins, not
+    perf metrics."""
+    from benchmarks import tpch
+
+    import daft_tpu as dt
+    from daft_tpu import faults
+    from daft_tpu.context import get_context
+    from daft_tpu.dist import supervisor as sup
+
+    tables = tpch.generate_tables(scale=scale)
+    frame = dt.from_arrow(tables["lineitem"]).repartition(8).collect()
+    cfg = get_context().execution_config
+    saved = {k: getattr(cfg, k) for k in ("distributed_workers",
+                                          "enable_result_cache")}
+    cfg.enable_result_cache = False
+    walls = {"local": [], "dist": []}
+    out = {"distributed_workers": workers}
+    try:
+        # pool spawn AND the workers' first-query warmup (imports, acero
+        # kernel init, op-cache fill) are one-time costs: pay both OUTSIDE
+        # the timed region so the A/B measures steady-state dispatch
+        cfg.distributed_workers = workers
+        _ = tpch.q1(frame).collect()
+        # q1's float sums reassociate in the threaded acero grouped agg
+        # (nondeterministic even local-vs-local at seed), so the parity
+        # gate is the oracle tolerance the other q1 rungs use, not
+        # byte-equality (the dist/ identity matrix test pins byte-identity
+        # on deterministic plans)
+        want = tpch.oracle_q1(tables["lineitem"])
+        for _t in range(trials):
+            for mode in ("local", "dist"):
+                cfg.distributed_workers = 0 if mode == "local" else workers
+                t0 = time.perf_counter()
+                got = tpch.q1(frame).collect()
+                walls[mode].append(time.perf_counter() - t0)
+                if not _parity(got.to_pydict(), want, rtol=1e-6):
+                    raise AssertionError(
+                        f"distributed rung parity broke in mode {mode}")
+        local_wall = min(walls["local"])
+        dist_wall = min(walls["dist"])
+        out["distributed_local_wall_s"] = round(local_wall, 4)
+        out["distributed_wall_s"] = round(dist_wall, 4)
+        out["distributed_speedup_x"] = round(local_wall / dist_wall, 3)
+        # ---- recovery leg: kill one worker mid-query ---------------------
+        cfg.distributed_workers = workers
+        faults.arm("worker.exec", "nth", n=2)
+        try:
+            t0 = time.perf_counter()
+            got = tpch.q1(frame).collect()
+            recovery_wall = time.perf_counter() - t0
+        finally:
+            faults.disarm()
+        if not _parity(got.to_pydict(), want, rtol=1e-6):
+            raise AssertionError("recovery leg parity broke")
+        c = got.stats.snapshot()["counters"]
+        out["distributed_recovery_wall_s"] = round(recovery_wall, 4)
+        out["distributed_recovery_overhead_pct"] = round(
+            (recovery_wall - dist_wall) / dist_wall * 100.0, 1)
+        out["distributed_worker_losses"] = c.get("worker_losses", 0)
+        out["distributed_task_redispatches"] = c.get(
+            "task_redispatches", 0)
+        return out
+    finally:
+        for k, v in saved.items():
+            setattr(cfg, k, v)
+        sup.shutdown_worker_pool()
+
+
 def measure_streaming(scale: Optional[float] = None) -> dict:
     """Streaming-executor rung (ISSUE 10): interleaved best-of A/B of the
     morsel-driven pipeline vs partition-granular execution, on parquet ON
@@ -1097,6 +1175,13 @@ def run_device_rungs(scale: float) -> dict:
     except Exception as e:
         out["streaming_rung_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # ---- distributed rung (host path; local vs N-worker A/B + worker-loss
+    # recovery leg, ISSUE 11 acceptance) ------------------------------------
+    try:
+        out["distributed"] = measure_distributed()
+    except Exception as e:
+        out["distributed_rung_error"] = f"{type(e).__name__}: {e}"[:200]
+
     return out
 
 
@@ -1410,6 +1495,10 @@ def _host_fallback(scale: float) -> dict:
         out["streaming"] = measure_streaming()
     except Exception as e:
         out["streaming_rung_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:  # distributed rung (ISSUE 11) is pure host work: fallback too
+        out["distributed"] = measure_distributed()
+    except Exception as e:
+        out["distributed_rung_error"] = f"{type(e).__name__}: {e}"[:200]
     return out
 
 

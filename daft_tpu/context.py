@@ -214,6 +214,31 @@ class ExecutionConfig:
     # before failing the query; 0 disables task-level retry
     task_retry_attempts: int = 2
     task_retry_backoff_s: float = 0.05
+    # --- distributed runner (daft_tpu/dist/, README "Distributed
+    # execution") -------------------------------------------------------
+    # supervised worker PROCESSES the DistributedRunner ships map-class
+    # partition tasks to over the length-prefixed socket transport.
+    # 0 = off (single-process execution, the default); N > 0 spawns N
+    # workers, each with a carved child memory budget
+    # (memory_budget_bytes // (N + 1); the driver keeps one share).
+    # Results are byte-identical to the local runner at every N.
+    distributed_workers: int = 0
+    # supervision cadence: the driver pings every worker at this interval
+    # and declares a worker dead when no pong (or result) arrived within
+    # the timeout — its in-flight tasks re-dispatch to surviving workers
+    worker_heartbeat_interval_s: float = 0.5
+    worker_heartbeat_timeout_s: float = 5.0
+    # spawn-to-handshake deadline for one worker process
+    worker_spawn_timeout_s: float = 60.0
+    # total worker RESPAWNS the pool may spend across its lifetime
+    # (initial spawns are free); exhausted = the pool degrades to local
+    # in-process execution instead of cycling forever
+    worker_restart_budget: int = 8
+    # dispatch attempts per task across worker losses: a poison task that
+    # kills every worker it touches fails the QUERY with a DaftError
+    # naming the task once it exhausts this budget (or has excluded every
+    # worker slot), instead of re-dispatching forever
+    dist_task_max_attempts: int = 4
     # device circuit breaker (execution.DeviceHealth): after this many
     # CONSECUTIVE device-kernel failures the breaker opens and every
     # device-eligible partition routes straight to the host path (one trip,
@@ -267,7 +292,24 @@ class DaftContext:
 
             if self._runner_name == "mesh":
                 self._runner = MeshRunner()
+            elif self._runner_name == "distributed":
+                from .dist.runner import DistributedRunner
+
+                self._runner = DistributedRunner()
             else:
+                self._runner = NativeRunner()
+        if self._runner_name == "native":
+            # cfg.distributed_workers alone turns the multi-process runner
+            # on/off; an explicitly-installed runner (mesh, or a test's
+            # hand-built MeshRunner) is never clobbered
+            from .runners import NativeRunner
+
+            dw = self.execution_config.distributed_workers
+            if dw > 0 and type(self._runner) is NativeRunner:
+                from .dist.runner import DistributedRunner
+
+                self._runner = DistributedRunner()
+            elif dw == 0 and type(self._runner).__name__ == "DistributedRunner":
                 self._runner = NativeRunner()
         return self._runner
 
@@ -280,7 +322,7 @@ class DaftContext:
     def set_runner(self, name: str) -> None:
         from .errors import DaftValueError
 
-        if name not in ("native", "mesh"):
+        if name not in ("native", "mesh", "distributed"):
             raise DaftValueError(f"unknown runner {name!r}")
         self._runner_name = name
         self._runner = None

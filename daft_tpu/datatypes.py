@@ -104,6 +104,12 @@ class DataType:
     def __setattr__(self, *a):  # pragma: no cover
         raise AttributeError("DataType is immutable")
 
+    def __reduce__(self):
+        # default unpickling would go through the blocked __setattr__;
+        # rebuilding through __init__ keeps the immutability contract while
+        # letting types cross process boundaries (dist/ worker transport)
+        return (DataType, (self.kind, self.params))
+
     # --- constructors -----------------------------------------------------
     @staticmethod
     def null() -> "DataType":

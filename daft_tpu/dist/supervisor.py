@@ -1,0 +1,852 @@
+"""Worker supervision: spawn, heartbeat, re-dispatch, bounded respawn.
+
+The WorkerPool owns N spawned worker processes and is the driver side of
+the dispatch-backend abstraction (scheduler.DispatchBackend): map-class
+partition tasks route here, execute on a worker, and return — while the
+pool treats worker death as a first-class event:
+
+- **heartbeats with a deadline**: the supervision thread pings every
+  worker each ``worker_heartbeat_interval_s``; no pong within
+  ``worker_heartbeat_timeout_s`` (or a dead process, a severed socket, an
+  injected ``worker.heartbeat`` fault) declares the worker dead.
+- **WorkerHealth breaker per worker** (the DeviceHealth trip/cooldown/
+  probe shape from PR 1): a slot that keeps dying trips its breaker and
+  stops being respawned until the cooldown probe lets one attempt through.
+- **bounded respawn**: respawns (never the initial spawns) consume the
+  pool-wide ``worker_restart_budget``; an exhausted budget degrades the
+  pool to local in-process execution instead of cycling forever.
+- **task re-dispatch with exactly-once results**: each task carries an
+  attempt count and an excluded-worker set. A worker death re-dispatches
+  only tasks still in flight — results already acked into the driver-side
+  ledger are never re-run. A poison task that kills every worker it
+  touches fails its QUERY with a DaftError naming the task once it
+  exhausts ``dist_task_max_attempts`` or has excluded every slot.
+
+Fault sites (CI chaos hooks, all DTL004-registered): ``worker.spawn``
+fails a spawn attempt, ``worker.exec`` SIGKILLs the target worker at
+dispatch (a REAL mid-query worker loss, deterministically placed),
+``worker.heartbeat`` reads as a missed deadline, ``transport.send``
+severs a link.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import pickle
+import secrets
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import DaftError, DaftTransientError
+from ..execution import DeviceHealth
+from ..obs.log import get_logger
+from .transport import TransportClosed, recv_msg, send_msg
+
+logger = get_logger("dist")
+
+# worker-side op-cache keys: process-wide monotonic, never reused (id()
+# would alias across GC)
+_OP_SEQ = itertools.count(1)
+
+
+class WorkerHealth(DeviceHealth):
+    """Per-worker circuit breaker: consecutive deaths trip it open (no
+    respawn), the cooldown probe admits one respawn attempt, and a worker
+    that comes back healthy re-closes it — the DeviceHealth contract
+    applied to process supervision."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0):
+        super().__init__(threshold, cooldown_s, kind="worker")
+
+
+class _LocalFallback(Exception):
+    """Internal: the pool cannot serve this task (degraded/closed) — the
+    caller runs it in-process instead. Never escapes the backend."""
+
+
+class _TaskEntry:
+    """Driver-side ledger row for one dispatched task."""
+
+    __slots__ = ("task_id", "op_name", "seq", "ctx", "attempts", "excluded",
+                 "status", "result", "error", "event", "charged", "wid")
+
+    def __init__(self, task_id: int, op_name: str, seq: int, ctx):
+        self.task_id = task_id
+        self.op_name = op_name
+        self.seq = seq
+        self.ctx = ctx
+        self.attempts = 0
+        self.excluded: set = set()
+        # inflight -> done | error | lost (lost = worker died; re-dispatch)
+        self.status = "idle"
+        self.result: Optional[Tuple] = None
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+        self.charged = 0
+        self.wid: Optional[int] = None
+
+
+class _WorkerHandle:
+    """One supervised worker slot (the slot identity survives respawns)."""
+
+    __slots__ = ("wid", "proc", "sock", "state", "last_pong", "inflight",
+                 "restarts", "deaths", "breaker", "send_lock", "ops_sent",
+                 "rx_thread", "ledger_report", "pid", "tasks_done")
+
+    def __init__(self, wid: int, breaker: WorkerHealth):
+        self.wid = wid
+        self.proc: Optional[subprocess.Popen] = None
+        self.sock: Optional[socket.socket] = None
+        self.state = "dead"  # ready | dead
+        self.last_pong = 0.0
+        self.inflight: Dict[int, _TaskEntry] = {}
+        self.restarts = 0
+        self.deaths = 0
+        self.breaker = breaker
+        self.send_lock = threading.Lock()
+        self.ops_sent: dict = {}  # insertion-ordered op-key window
+        self.rx_thread: Optional[threading.Thread] = None
+        self.ledger_report = {"current": 0, "high_water": 0}
+        self.pid: Optional[int] = None
+        self.tasks_done = 0
+
+
+def _repo_root() -> str:
+    import daft_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        daft_tpu.__file__)))
+
+
+class WorkerPool:
+    """Supervised pool of worker processes behind the scheduler's dispatch
+    backend protocol (``capacity`` / ``try_execute``)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.n = max(1, int(cfg.distributed_workers))
+        self._cond = threading.Condition()
+        self._closed = False
+        self._token = secrets.token_hex(16)
+        self._task_seq = itertools.count(1)
+        # pool-wide counters (the cluster health / gauge surface)
+        self.worker_losses_total = 0
+        self.task_redispatches_total = 0
+        self.tasks_dispatched_total = 0
+        self.tasks_completed_total = 0
+        self.local_fallbacks_total = 0
+        self.restarts_used = 0
+        self.restart_budget = max(0, int(cfg.worker_restart_budget))
+        # the listener the spawned workers dial back into
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(self.n + 4)
+        self._port = self._listener.getsockname()[1]
+        thresh = max(1, int(cfg.device_breaker_threshold))
+        cool = float(cfg.device_breaker_cooldown_s)
+        self.workers: List[_WorkerHandle] = [
+            _WorkerHandle(i, WorkerHealth(thresh, cool))
+            for i in range(self.n)]
+        for w in self.workers:
+            try:
+                self._spawn(w, initial=True)
+            except Exception as e:
+                logger.warning("worker_initial_spawn_failed", worker=w.wid,
+                               error=repr(e))
+        self._supervisor = threading.Thread(
+            target=self._supervise_loop, name="daft-dist-supervisor",
+            daemon=True)
+        self._supervisor.start()
+        from ..obs.health import register_cluster
+
+        register_cluster(self)
+
+    # ------------------------------------------------------------- spawning
+    def _worker_cfg(self):
+        """The cfg a worker runs under: never nested-distributed, one
+        executor thread (one task at a time), and a carved CHILD share of
+        the global memory budget — the driver keeps one share, so all
+        workers plus the driver together can never exceed it."""
+        share = None
+        if self.cfg.memory_budget_bytes is not None:
+            share = max(1, self.cfg.memory_budget_bytes // (self.n + 1))
+        return dataclasses.replace(
+            self.cfg, distributed_workers=0, memory_budget_bytes=share,
+            executor_threads=1, enable_query_log=False,
+            enable_profiling=False, diagnostics_dir=None,
+            slow_query_threshold_s=None)
+
+    def _spawn(self, w: _WorkerHandle, initial: bool = False) -> None:
+        """Spawn slot ``w``'s process and complete the handshake. Raises on
+        failure (caller accounts budget/breaker); the ``worker.spawn``
+        fault site fires per attempt."""
+        from .. import faults
+
+        with self._cond:
+            if self._closed:
+                raise DaftTransientError("worker pool is shut down")
+        faults.check("worker.spawn")
+        env = dict(os.environ)
+        root = _repo_root()
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "daft_tpu.dist.worker",
+             "127.0.0.1", str(self._port), str(w.wid), self._token],
+            env=env, cwd=root, stdout=subprocess.DEVNULL)
+        deadline = time.monotonic() + float(self.cfg.worker_spawn_timeout_s)
+        sock = None
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise DaftTransientError(
+                        f"worker {w.wid} spawn timed out")
+                self._listener.settimeout(min(remaining, 5.0))
+                try:
+                    cand, _ = self._listener.accept()
+                except socket.timeout:
+                    if proc.poll() is not None:
+                        raise DaftTransientError(
+                            f"worker {w.wid} exited rc={proc.returncode} "
+                            "before handshake")
+                    continue
+                try:
+                    hello = recv_msg(cand)
+                except Exception:
+                    cand.close()
+                    continue
+                if (hello.get("type") == "hello"
+                        and hello.get("token") == self._token
+                        and hello.get("worker_id") == w.wid):
+                    sock = cand
+                    break
+                cand.close()  # stale/foreign connection: not ours
+            send_msg(sock, {"type": "init", "cfg": self._worker_cfg()})
+        except BaseException:
+            if sock is not None:
+                sock.close()
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+            raise
+        with self._cond:
+            if self._closed:
+                # shutdown raced this spawn: shutdown() iterated the slots
+                # before this worker existed, so nothing else will ever
+                # reap it — kill it HERE or the zero-leak guarantee breaks
+                closed = True
+            else:
+                closed = False
+                w.proc = proc
+                w.sock = sock
+                w.pid = hello.get("pid")
+                w.state = "ready"
+                w.last_pong = time.monotonic()
+                w.ops_sent = {}
+                if not initial:
+                    w.restarts += 1
+                w.rx_thread = threading.Thread(
+                    target=self._rx_loop, args=(w, sock),
+                    name=f"daft-dist-rx-{w.wid}", daemon=True)
+                w.rx_thread.start()
+                self._cond.notify_all()
+        if closed:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+            raise DaftTransientError("worker pool shut down during spawn")
+        w.breaker.record_success()
+        logger.info("worker_ready", worker=w.wid, pid=w.pid,
+                    respawn=not initial)
+
+    # ------------------------------------------------------------- receive
+    def _rx_loop(self, w: _WorkerHandle, sock: socket.socket) -> None:
+        try:
+            while True:
+                msg = recv_msg(sock)
+                kind = msg.get("type")
+                if kind == "pong":
+                    with self._cond:
+                        if w.sock is sock:
+                            w.last_pong = time.monotonic()
+                            w.ledger_report = msg.get("ledger",
+                                                      w.ledger_report)
+                elif kind in ("result", "task_error"):
+                    self._on_task_reply(w, sock, msg)
+        except TransportClosed:
+            self._on_worker_death(w, sock, "connection closed")
+        except Exception as e:
+            self._on_worker_death(w, sock, f"receiver failed: {e!r}")
+
+    def _on_task_reply(self, w: _WorkerHandle, sock, msg: dict) -> None:
+        with self._cond:
+            if w.sock is not sock:
+                return  # a dead incarnation's straggler frame
+            entry = w.inflight.pop(msg["task_id"], None)
+            if entry is None or entry.status != "inflight":
+                return  # already settled (exactly-once: never re-applied)
+            if msg["type"] == "result":
+                entry.status = "done"
+                entry.result = (msg["part"], msg["rows"], msg["wall_ns"])
+                w.tasks_done += 1
+                self.tasks_completed_total += 1
+            else:
+                err = None
+                if msg.get("error") is not None:
+                    try:
+                        err = pickle.loads(msg["error"])
+                    except Exception:
+                        err = None
+                if not isinstance(err, BaseException):
+                    err = DaftError(
+                        f"worker task failed: {msg.get('error_type')}: "
+                        f"{msg.get('error_message')}")
+                entry.status = "error"
+                entry.error = err
+            if entry.charged:
+                entry.ctx.ledger.dist_done(entry.charged)
+                entry.charged = 0
+            self._cond.notify_all()
+        if entry.status == "done":
+            w.breaker.record_success()
+        entry.event.set()
+
+    # ------------------------------------------------------------ death
+    def _kill_worker(self, w: _WorkerHandle, reason: str) -> None:
+        """SIGKILL the slot's process (the injected ``worker.exec`` chaos
+        hook and the shutdown straggler path), then run the death flow."""
+        with self._cond:
+            proc, sock = w.proc, w.sock
+        if proc is not None and proc.poll() is None:
+            try:
+                os.kill(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+        self._on_worker_death(w, sock, reason)
+
+    def _on_worker_death(self, w: _WorkerHandle, sock, reason: str) -> None:
+        """Declare slot ``w`` dead: reap the process, mark in-flight tasks
+        lost (their waiters re-dispatch), inform the breaker and the
+        per-query counters. Idempotent per incarnation."""
+        with self._cond:
+            if w.state != "ready" or (sock is not None and w.sock is not sock):
+                return
+            if self._closed:
+                # drain-mode shutdown: the worker exiting on request is not
+                # a loss (no breaker failure, no counters, no warning)
+                w.state = "dead"
+                w.sock = None
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                return
+            w.state = "dead"
+            w.deaths += 1
+            dead_sock, proc = w.sock, w.proc
+            w.sock = None
+            entries = [e for e in w.inflight.values()
+                       if e.status == "inflight"]
+            w.inflight.clear()
+            self.worker_losses_total += 1
+            affected = {}
+            for e in entries:
+                e.status = "lost"
+                if e.charged:
+                    e.ctx.ledger.dist_done(e.charged)
+                    e.charged = 0
+                affected[id(e.ctx)] = e.ctx
+            self._cond.notify_all()
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        if proc is not None:
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+        if dead_sock is not None:
+            try:
+                dead_sock.close()
+            except OSError:
+                pass
+        w.breaker.record_failure()
+        for ctx in affected.values():
+            ctx.stats.bump("worker_losses")
+        for e in entries:
+            e.event.set()
+        logger.warning("worker_lost", worker=w.wid, reason=reason,
+                       inflight=len(entries))
+
+    # ------------------------------------------------------- supervision
+    def _supervise_loop(self) -> None:
+        from .. import faults
+
+        interval = max(0.05, float(self.cfg.worker_heartbeat_interval_s))
+        timeout = max(float(self.cfg.worker_heartbeat_timeout_s),
+                      2 * interval)
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+            time.sleep(interval)
+            for w in self.workers:
+                with self._cond:
+                    if self._closed:
+                        return
+                    state, sock, proc = w.state, w.sock, w.proc
+                    stale = (state == "ready"
+                             and time.monotonic() - w.last_pong > timeout)
+                if state == "ready":
+                    if proc is not None and proc.poll() is not None:
+                        self._on_worker_death(
+                            w, sock, f"process exited rc={proc.returncode}")
+                        continue
+                    try:
+                        faults.check("worker.heartbeat")
+                    except DaftTransientError:
+                        # injected missed-deadline: the supervision layer
+                        # must behave exactly as if the worker went silent
+                        self._kill_worker(w, "heartbeat fault injected")
+                        continue
+                    if stale:
+                        self._kill_worker(w, "heartbeat deadline missed")
+                        continue
+                    try:
+                        with w.send_lock:
+                            send_msg(sock, {"type": "ping"})
+                    except Exception as e:
+                        self._on_worker_death(w, sock, f"ping failed: {e!r}")
+                elif state == "dead":
+                    self._maybe_respawn(w)
+
+    def _maybe_respawn(self, w: _WorkerHandle) -> None:
+        with self._cond:
+            if self._closed or self.restarts_used >= self.restart_budget:
+                return
+            if not w.breaker.allow():
+                return  # tripped: wait out the cooldown probe
+            self.restarts_used += 1  # the attempt consumes budget, not success
+        try:
+            self._spawn(w)
+        except Exception as e:
+            w.breaker.record_failure()
+            logger.warning("worker_respawn_failed", worker=w.wid,
+                           error=repr(e),
+                           budget_remaining=self.budget_remaining())
+            if self.budget_remaining() <= 0:
+                logger.error("worker_pool_degraded",
+                             reason="restart budget exhausted",
+                             losses=self.worker_losses_total)
+
+    def budget_remaining(self) -> int:
+        with self._cond:
+            return max(0, self.restart_budget - self.restarts_used)
+
+    # --------------------------------------------------- dispatch backend
+    def capacity(self) -> int:
+        return self.n
+
+    def _usable_locked(self) -> bool:
+        if self._closed:
+            return False
+        if any(w.state == "ready" for w in self.workers):
+            return True
+        return self.restarts_used < self.restart_budget
+
+    def _op_payload(self, op) -> Optional[Tuple[int, bytes]]:
+        """(op_key, pickled map op with children stripped), cached on the
+        op; None when the op cannot cross a process boundary (UDF closures
+        and the like) — the task runs in-process instead. The key comes
+        from a process-wide counter, NOT id(op): address reuse after GC
+        would alias a new op to a dead op's worker-side cache entry."""
+        cached = getattr(op, "_dist_payload", False)
+        if cached is not False:
+            return cached
+        import copy
+
+        try:
+            clone = copy.copy(op)
+            clone.children = []
+            payload = (next(_OP_SEQ), pickle.dumps(
+                clone, protocol=pickle.HIGHEST_PROTOCOL))
+        except Exception:
+            payload = None
+        try:
+            op._dist_payload = payload
+        except Exception:
+            pass
+        return payload
+
+    @staticmethod
+    def _part_eligible(part) -> bool:
+        # deferred op chains are driver-side closures; loaded tables and
+        # plain scan tasks ship fine (the worker reads the file itself)
+        return not getattr(part, "_pending", None)
+
+    def try_execute(self, op, part, ctx, op_name: str, seq: int):
+        """Execute one map task on a worker, blocking until a terminal
+        result. Returns ``(out_partition, rows, wall_ns)`` or None when the
+        task is ineligible / the pool is degraded (caller runs it
+        in-process). Raises the task's real error, the poison-task
+        DaftError, or the query's cancellation/timeout."""
+        if getattr(op, "map_partition", None) is None:
+            return None
+        payload = self._op_payload(op)
+        if payload is None or not self._part_eligible(part):
+            return None
+        with self._cond:
+            if not self._usable_locked():
+                self.local_fallbacks_total += 1
+                ctx.stats.bump("dist_local_fallbacks")
+                return None
+        try:
+            # serialize ONCE, up front: an unshippable partition (driver-
+            # local prefetch state, exotic scan factories) is a decline,
+            # never a worker death — and re-dispatches reuse the bytes
+            part_bytes = pickle.dumps(part,
+                                      protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            return None
+        try:
+            return self._execute(payload, part_bytes, ctx, op_name, seq)
+        except _LocalFallback:
+            with self._cond:
+                self.local_fallbacks_total += 1
+            ctx.stats.bump("dist_local_fallbacks")
+            return None
+
+    def _execute(self, payload, part_bytes, ctx, op_name: str, seq: int):
+        entry = _TaskEntry(next(self._task_seq), op_name, seq, ctx)
+        max_attempts = max(1, int(self.cfg.dist_task_max_attempts))
+        while True:
+            self._check_query(ctx)
+            w = self._acquire_worker(entry, ctx)
+            self._dispatch(entry, w, payload, part_bytes)
+            self._wait(entry, ctx)
+            if entry.status == "done":
+                out, rows, wall_ns = entry.result
+                ctx.stats.bump("dist_tasks")
+                return out, rows, wall_ns
+            if entry.status == "error":
+                raise entry.error
+            # lost: the worker died with this task in flight
+            if entry.wid is not None:
+                entry.excluded.add(entry.wid)
+            if (entry.excluded >= set(range(self.n))
+                    or entry.attempts >= max_attempts):
+                # terminal: no further dispatch happens, so this loss is
+                # NOT a re-dispatch — counting it here would over-report
+                raise DaftError(
+                    f"poison task {op_name}#{seq}: lost "
+                    f"{entry.attempts} worker(s) "
+                    f"(excluded slots {sorted(entry.excluded)}) — "
+                    "refusing further re-dispatch")
+            ctx.stats.bump("task_redispatches")
+            with self._cond:
+                self.task_redispatches_total += 1
+            logger.warning("task_redispatch", op=op_name, seq=seq,
+                           attempts=entry.attempts,
+                           excluded=sorted(entry.excluded))
+
+    def _check_query(self, ctx) -> None:
+        from ..execution import QueryCancelledError
+
+        if ctx.stats.is_cancelled():
+            raise QueryCancelledError(
+                "query cancelled (distributed task)")
+        ctx.check_deadline()
+
+    def _acquire_worker(self, entry: _TaskEntry, ctx) -> _WorkerHandle:
+        """Reserve a ready worker slot outside the task's excluded set
+        (capacity one task per worker). Blocks until one frees up; raises
+        _LocalFallback when the pool can no longer serve, and detects
+        poison-by-exclusion without waiting."""
+        while True:
+            with self._cond:
+                if entry.excluded >= set(range(self.n)):
+                    raise DaftError(
+                        f"poison task {entry.op_name}#{entry.seq}: lost "
+                        f"{entry.attempts} worker(s) (every slot excluded)"
+                        " — refusing further re-dispatch")
+                if not self._usable_locked():
+                    raise _LocalFallback
+                ready = [w for w in self.workers
+                         if w.state == "ready"
+                         and w.wid not in entry.excluded
+                         and not w.inflight]
+                if ready:
+                    w = min(ready, key=lambda h: h.tasks_done)
+                    entry.status = "inflight"
+                    entry.event.clear()
+                    entry.wid = w.wid
+                    w.inflight[entry.task_id] = entry
+                    return w
+                # nothing to wait FOR: no candidate slot is serving (ready
+                # or finishing a task) and none can come back soon — every
+                # dead candidate is budget-blocked or breaker-tripped
+                # (waiting out a 30s cooldown would stall the query while
+                # in-process execution is available). Local fallback.
+                candidates = [w for w in self.workers
+                              if w.wid not in entry.excluded]
+                revivable = (self.restarts_used < self.restart_budget)
+                respawn_pending = revivable and any(
+                    w.state == "dead" and w.breaker.state != "open"
+                    for w in candidates)
+                if not any(w.state == "ready" or w.inflight
+                           for w in candidates) and not respawn_pending:
+                    raise _LocalFallback
+                self._cond.wait(0.05)
+            self._check_query(ctx)
+
+    def _dispatch(self, entry: _TaskEntry, w: _WorkerHandle, payload,
+                  part_bytes: bytes) -> None:
+        from .. import faults
+
+        op_key, op_bytes = payload
+        entry.attempts += 1
+        with self._cond:
+            self.tasks_dispatched_total += 1
+        try:
+            faults.check("worker.exec", entry.ctx.stats)
+        except DaftTransientError:
+            # the chaos contract: an injected worker.exec fault IS a worker
+            # loss — SIGKILL the process for real and let the re-dispatch
+            # machinery (the thing under test) pick up the pieces
+            self._kill_worker(w, "worker.exec fault injected")
+            return
+        with self._cond:
+            # the worker may have died between acquire and here: its death
+            # handler already marked the entry lost and settled any charge
+            # — charging after that point would leak ledger bytes
+            if entry.status != "inflight" or w.sock is None:
+                return
+            sock = w.sock
+            size = len(part_bytes)
+            if size:
+                entry.charged = size
+                entry.ctx.ledger.dist_started(size)
+        msg = {"type": "task", "task_id": entry.task_id, "op_key": op_key,
+               "part": part_bytes}
+        if op_key not in w.ops_sent:
+            msg["op"] = op_bytes
+        try:
+            with w.send_lock:
+                send_msg(sock, msg)
+            # insertion-ordered window, capped BELOW the worker's op cache
+            # so a key we omit op bytes for is always still cached there
+            w.ops_sent[op_key] = True
+            while len(w.ops_sent) > 96:
+                w.ops_sent.pop(next(iter(w.ops_sent)))
+        except Exception as e:
+            self._on_worker_death(w, sock, f"task send failed: {e!r}")
+
+    def _wait(self, entry: _TaskEntry, ctx) -> None:
+        """Block until the entry is terminal, keeping the query's
+        cancellation/deadline semantics live while the work is remote."""
+        while not entry.event.wait(0.05):
+            try:
+                self._check_query(ctx)
+            except BaseException:
+                # the query is over: disown the entry so a late result (or
+                # the worker's death) settles it without a waiter — acked
+                # results are still recorded exactly once
+                raise
+
+    # ------------------------------------------------------------ health
+    def snapshot(self) -> dict:
+        """The dt.health() ``cluster`` section (mirrored as
+        ``daft_tpu_cluster_*`` gauges)."""
+        with self._cond:
+            alive = sum(1 for w in self.workers if w.state == "ready")
+            tripped = sum(1 for w in self.workers
+                          if w.breaker.state == "open")
+            inflight = sum(len(w.inflight) for w in self.workers)
+            workers = {
+                str(w.wid): {
+                    "state": w.state,
+                    "breaker": w.breaker.state,
+                    "pid": w.pid,
+                    "restarts": w.restarts,
+                    "deaths": w.deaths,
+                    "inflight": len(w.inflight),
+                    "tasks_done": w.tasks_done,
+                    "ledger_current": w.ledger_report.get("current", 0),
+                    "ledger_high_water": w.ledger_report.get(
+                        "high_water", 0),
+                }
+                for w in self.workers}
+            return {
+                "workers": self.n,
+                "workers_alive": alive,
+                "workers_restarting": self.n - alive - sum(
+                    1 for w in self.workers
+                    if w.state == "dead"
+                    and self.restarts_used >= self.restart_budget),
+                "workers_tripped": tripped,
+                "tasks_inflight": inflight,
+                "tasks_dispatched_total": self.tasks_dispatched_total,
+                "tasks_completed_total": self.tasks_completed_total,
+                "task_redispatches_total": self.task_redispatches_total,
+                "worker_losses_total": self.worker_losses_total,
+                "local_fallbacks_total": self.local_fallbacks_total,
+                "restarts_used": self.restarts_used,
+                "restart_budget": self.restart_budget,
+                "restart_budget_remaining": max(
+                    0, self.restart_budget - self.restarts_used),
+                "degraded": not self._usable_locked(),
+                "worker_detail": workers,
+            }
+
+    def worker_pids(self) -> Dict[int, int]:
+        """slot -> live pid (the kill-a-worker tests' target list)."""
+        with self._cond:
+            return {w.wid: w.pid for w in self.workers
+                    if w.state == "ready" and w.pid is not None}
+
+    def live_worker_processes(self) -> int:
+        """Spawned worker processes still alive (0 after shutdown — the
+        zero-leak assertion surface)."""
+        with self._cond:
+            procs = [w.proc for w in self.workers if w.proc is not None]
+        return sum(1 for p in procs if p.poll() is None)
+
+    # ---------------------------------------------------------- shutdown
+    def shutdown(self, timeout_s: float = 10.0) -> None:
+        """Stop supervision, ask every worker to exit, SIGKILL stragglers,
+        and fail over any still-waiting tasks to local execution."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            entries = [e for w in self.workers
+                       for e in w.inflight.values()
+                       if e.status == "inflight"]
+            for w in self.workers:
+                for e in list(w.inflight.values()):
+                    if e.status == "inflight":
+                        e.status = "lost"
+                        if e.charged:
+                            e.ctx.ledger.dist_done(e.charged)
+                            e.charged = 0
+                w.inflight.clear()
+            self._cond.notify_all()
+        for e in entries:
+            e.event.set()
+        deadline = time.monotonic() + timeout_s
+        for w in self.workers:
+            with self._cond:
+                sock, proc = w.sock, w.proc
+            if sock is not None:
+                try:
+                    with w.send_lock:
+                        send_msg(sock, {"type": "shutdown"})
+                except Exception:
+                    pass
+        for w in self.workers:
+            with self._cond:
+                proc = w.proc
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:
+                try:
+                    proc.kill()
+                    proc.wait(timeout=5)
+                except Exception:
+                    pass
+        for w in self.workers:
+            with self._cond:
+                sock, w.sock, w.state = w.sock, None, "dead"
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        if self._supervisor.is_alive():
+            self._supervisor.join(timeout=max(
+                0.1, deadline - time.monotonic()))
+        for w in self.workers:
+            if w.rx_thread is not None and w.rx_thread.is_alive():
+                w.rx_thread.join(timeout=max(
+                    0.05, deadline - time.monotonic()))
+        logger.info("worker_pool_shutdown",
+                    losses=self.worker_losses_total,
+                    redispatches=self.task_redispatches_total,
+                    restarts_used=self.restarts_used)
+
+
+# ---------------------------------------------------------------------------
+# process-wide pool lifecycle (one pool, rebuilt when the knobs change)
+# ---------------------------------------------------------------------------
+
+_POOL: Optional[WorkerPool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def get_worker_pool(cfg) -> Optional[WorkerPool]:
+    """The process's WorkerPool for ``cfg`` (spawned on first use; rebuilt
+    when worker count or budget changes). None when distribution is off."""
+    global _POOL
+    if cfg.distributed_workers <= 0:
+        return None
+    with _POOL_LOCK:
+        pool = _POOL
+        if pool is not None and not pool._closed and (
+                pool.n == cfg.distributed_workers
+                and pool.cfg.memory_budget_bytes == cfg.memory_budget_bytes):
+            return pool
+        if pool is not None:
+            pool.shutdown()
+        _POOL = WorkerPool(cfg)
+        return _POOL
+
+
+def shutdown_worker_pool(timeout_s: float = 10.0) -> None:
+    """Tear the process pool down (dt.shutdown(), atexit, tests)."""
+    global _POOL
+    with _POOL_LOCK:
+        pool, _POOL = _POOL, None
+    if pool is not None:
+        pool.shutdown(timeout_s=timeout_s)
+
+
+def worker_pool_snapshot() -> Optional[dict]:
+    """The live pool's cluster snapshot, or None (idle) — the dt.health()
+    hook that must never spawn a pool as a side effect."""
+    with _POOL_LOCK:
+        pool = _POOL
+    if pool is None or pool._closed:
+        return None
+    return pool.snapshot()
+
+
+def live_worker_process_count() -> int:
+    with _POOL_LOCK:
+        pool = _POOL
+    return 0 if pool is None else pool.live_worker_processes()

@@ -1,0 +1,74 @@
+"""Length-prefixed message transport between the driver and its workers.
+
+One frame = an 8-byte big-endian payload length + a pickled message dict.
+Pickle is the wire format because the payloads ARE engine objects — Tables
+(arrow-backed columns), scan tasks, physical map ops — and the endpoints
+are trusted same-host processes the driver itself spawned (the token
+handshake in worker.py keeps strangers off the socket; this is an IPC
+plane, not a network service).
+
+Failure contract: any partial read/EOF raises :class:`TransportClosed`
+(a DaftTransientError — the supervision layer treats it as a dead
+connection and re-dispatches), and every send passes the
+``transport.send`` fault site so CI can sever a link deterministically.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+
+from ..errors import DaftTransientError
+
+# one frame's length prefix: 8-byte big-endian unsigned
+_LEN = struct.Struct(">Q")
+# a frame bigger than this is a protocol desync/corruption, not a payload
+# (partitions are bounded by the memory budget, far below 1 TiB)
+MAX_FRAME_BYTES = 1 << 40
+
+
+class TransportClosed(DaftTransientError):
+    """The peer went away mid-frame (EOF, reset, severed link)."""
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    """Serialize + frame + send one message. Raises TransportClosed on a
+    dead connection; the ``transport.send`` fault site fires here."""
+    from .. import faults
+
+    data = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    try:
+        faults.check("transport.send")
+        sock.sendall(_LEN.pack(len(data)) + data)
+    except DaftTransientError:
+        raise
+    except OSError as e:
+        raise TransportClosed(f"transport send failed: {e!r}") from e
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            chunk = sock.recv(min(n - got, 1 << 20))
+        except OSError as e:
+            raise TransportClosed(f"transport recv failed: {e!r}") from e
+        if not chunk:
+            raise TransportClosed(
+                f"peer closed mid-frame ({got}/{n} bytes)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> dict:
+    """Receive one framed message (blocking). Raises TransportClosed on
+    EOF/reset and DaftTransientError on a corrupt frame."""
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > MAX_FRAME_BYTES:
+        raise DaftTransientError(
+            f"transport frame length {length} exceeds {MAX_FRAME_BYTES} "
+            "(protocol desync)")
+    return pickle.loads(_recv_exact(sock, length))

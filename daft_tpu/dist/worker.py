@@ -1,0 +1,155 @@
+"""Distributed-worker process entrypoint: ``python -m daft_tpu.dist.worker``.
+
+One worker = one OS process the supervisor spawned. It connects back to
+the driver's listener, authenticates with the spawn token, receives its
+ExecutionConfig (with a carved child memory budget), and then serves
+tasks until told to stop:
+
+- a **reader thread** drains the socket: ``ping`` is answered immediately
+  (a busy worker still heartbeats), ``task`` messages queue for the
+  executor loop, ``shutdown`` (or EOF) ends the process;
+- the **main loop** executes one task at a time — unpickle the map op
+  (cached per op key), materialize/execute ``op.map_partition`` against a
+  local ExecutionContext, and ship the result (or the error) back.
+
+The worker never decides policy: retries, re-dispatch, deadlines, and
+poison detection all live driver-side in supervisor.py — a worker that
+dies mid-task simply stops answering, and the supervision layer treats
+the silence as the failure signal.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import socket
+import sys
+import threading
+import time
+
+
+def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
+    # late imports: the module must be importable for argv parsing before
+    # the (expensive) engine import decides the process's fate
+    from ..context import get_context
+    from ..obs.log import get_logger
+    from .transport import TransportClosed, recv_msg, send_msg
+
+    log = get_logger("dist.worker")
+    send_lock = threading.Lock()
+
+    def reply(msg: dict) -> None:
+        with send_lock:
+            send_msg(sock, msg)
+
+    reply({"type": "hello", "worker_id": worker_id, "pid": os.getpid(),
+           "token": token})
+    init = recv_msg(sock)
+    if init.get("type") != "init":
+        raise RuntimeError(f"expected init, got {init.get('type')!r}")
+    cfg = init["cfg"]
+    ctx = get_context()
+    ctx.execution_config = cfg
+
+    from ..execution import ExecutionContext
+
+    exec_ctx = ExecutionContext(cfg)
+    tasks: "queue.Queue" = queue.Queue()
+    inflight = [0]
+    op_cache: dict = {}
+
+    def ledger_report() -> dict:
+        try:
+            from ..spill import MEMORY_LEDGER
+
+            snap = MEMORY_LEDGER.snapshot()
+            return {"current": snap["current"],
+                    "high_water": snap["high_water"]}
+        except Exception:
+            return {"current": 0, "high_water": 0}
+
+    def read_loop() -> None:
+        try:
+            while True:
+                msg = recv_msg(sock)
+                kind = msg.get("type")
+                if kind == "ping":
+                    reply({"type": "pong", "worker_id": worker_id,
+                           "inflight": inflight[0],
+                           "ledger": ledger_report()})
+                elif kind == "task":
+                    inflight[0] += 1
+                    tasks.put(msg)
+                elif kind == "shutdown":
+                    tasks.put(None)
+                    return
+        except TransportClosed:
+            tasks.put(None)  # driver went away: exit cleanly
+        except Exception as e:
+            log.error("worker_reader_failed", error=repr(e))
+            tasks.put(None)
+
+    reader = threading.Thread(target=read_loop, name="daft-dist-reader",
+                              daemon=True)
+    reader.start()
+
+    while True:
+        msg = tasks.get()
+        if msg is None:
+            break
+        task_id = msg["task_id"]
+        try:
+            op_key = msg["op_key"]
+            if "op" in msg:
+                # (re-)insert at the end so eviction order tracks the
+                # driver's send order (its ops_sent window is smaller than
+                # this cache, so a key it omits is always still here)
+                op_cache.pop(op_key, None)
+                op_cache[op_key] = pickle.loads(msg["op"])
+                while len(op_cache) > 128:  # bounded across queries
+                    op_cache.pop(next(iter(op_cache)))
+            op = op_cache[op_key]
+            part = msg["part"]
+            if isinstance(part, (bytes, bytearray)):
+                # the driver pre-serializes partitions once (re-dispatches
+                # reuse the bytes); decode here
+                part = pickle.loads(part)
+            t0 = time.perf_counter_ns()
+            out = op.map_partition(part, exec_ctx)
+            wall_ns = time.perf_counter_ns() - t0
+            n = out.num_rows_or_none()
+            reply({"type": "result", "task_id": task_id, "part": out,
+                   "rows": n if n is not None else 0, "wall_ns": wall_ns})
+        except BaseException as e:  # a task failure must not kill the worker
+            try:
+                err_pickle = pickle.dumps(e)
+            except Exception:
+                err_pickle = None
+            reply({"type": "task_error", "task_id": task_id,
+                   "error": err_pickle, "error_type": type(e).__name__,
+                   "error_message": str(e)[:2000]})
+        finally:
+            inflight[0] -= 1
+    return 0
+
+
+def main(argv) -> int:
+    host, port, worker_id, token = (
+        argv[0], int(argv[1]), int(argv[2]), argv[3])
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.settimeout(None)
+    try:
+        return _serve(sock, worker_id, token)
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    # workers compute on the host path by default: a spawned worker must
+    # never race the driver for the accelerator (override to opt in)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main(sys.argv[1:]))

@@ -425,6 +425,11 @@ class ExecutionContext:
         self.ledger = qctx.ledger
         self.memory_budget = qctx.memory_budget_bytes
         self._pool = None
+        # dispatch backend for map-class partition tasks (scheduler.
+        # DispatchBackend): None = the in-process pool; the
+        # DistributedRunner attaches the supervised WorkerPool here so
+        # eligible tasks execute in worker processes
+        self.dist_backend = None
         # terminal once the query's stream closed: unspill readahead stops
         # submitting (its buffers are settled by finish_query anyway); the
         # scan prefetcher MAY still recreate the pool for late reads — see
@@ -555,7 +560,13 @@ class ExecutionContext:
     def num_workers(self) -> int:
         from .context import resolve_executor_threads
 
-        return resolve_executor_threads(self.cfg)
+        n = resolve_executor_threads(self.cfg)
+        if self.dist_backend is not None:
+            # a remote-dispatched task occupies a LOCAL pool thread for the
+            # round trip, so the local pool must cover the whole worker
+            # fleet (plus one driver-side slot) or the cluster idles
+            n = max(n, self.dist_backend.capacity() + 1)
+        return n
 
     def pool(self):
         """Lazily-created worker pool; shut down by execute_plan. Under the
@@ -1424,17 +1435,18 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
     also carries the dispatching thread's span context across the hop —
     run_one only annotates it with the row count."""
     from . import tracing
-    from .scheduler import PartitionTask, dispatch
+    from .scheduler import PartitionTask, dispatch, run_map_task
 
     name = op.name()
     req = op_resource_request(op)
 
-    def run_one(part):
-        t0 = time.perf_counter_ns()
-        out = op.map_partition(part, ctx)
-        dt = time.perf_counter_ns() - t0
-        n = out.num_rows_or_none()
-        rows = n if n is not None else 0
+    def run_one(part, seq=0):
+        out, rows_hint, dt = run_map_task(op, part, ctx, name, seq)
+        if rows_hint is not None:
+            rows = rows_hint
+        else:
+            n = out.num_rows_or_none()
+            rows = n if n is not None else 0
         ctx.stats.record_op(name, rows, dt, _part_bytes(out))
         prof = ctx.stats.profiler
         if prof.armed:
@@ -1449,7 +1461,8 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
         nonlocal saw_any
         for i, part in enumerate(child):
             saw_any = True
-            yield PartitionTask(part, run_one, req, name, i)
+            yield PartitionTask(part, lambda p, _i=i: run_one(p, _i),
+                                req, name, i)
 
     for out in dispatch(tasks(), ctx):
         n = out.num_rows_or_none()

@@ -62,6 +62,23 @@ SITES = {
     "exchange.encode": "each exchange-payload encode attempt "
                        "(daft_tpu/exchange/encode.py; a failure ships the "
                        "piece raw, never a query failure)",
+    "worker.spawn": "each distributed-worker process spawn attempt "
+                    "(daft_tpu/dist/supervisor.py; a failure consumes "
+                    "restart budget and the pool degrades, never hangs)",
+    "worker.exec": "each task dispatch to a distributed worker "
+                   "(daft_tpu/dist/supervisor.py; an injected fault "
+                   "SIGKILLs the target worker — the deterministic "
+                   "kill-a-worker-mid-query chaos hook — and the task "
+                   "re-dispatches to a surviving worker)",
+    "worker.heartbeat": "each supervision-loop heartbeat check of one "
+                        "worker (daft_tpu/dist/supervisor.py; an injected "
+                        "fault reads as a missed heartbeat deadline — the "
+                        "worker is declared dead and its in-flight tasks "
+                        "re-dispatch)",
+    "transport.send": "each length-prefixed frame send on the worker "
+                      "transport (daft_tpu/dist/transport.py; a failed "
+                      "send marks the connection dead and the supervision "
+                      "layer re-dispatches)",
 }
 
 

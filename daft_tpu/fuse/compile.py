@@ -171,6 +171,18 @@ class FusedMapOp(PhysicalOp):
         self._recorded = False
         self._record_lock = threading.Lock()
 
+    def __getstate__(self):
+        # the record lock is per-process coordination state, not program
+        # identity: drop it so a fused op can ship over the dist/ worker
+        # transport (the receiving process records against ITS stats)
+        state = dict(self.__dict__)
+        state.pop("_record_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._record_lock = threading.Lock()
+
     def _record(self, ctx) -> None:
         """Chain-level counters, once per query (the op tree is rebuilt per
         translate, so instance state is query-scoped)."""

@@ -54,6 +54,43 @@ class MicroPartition:
     def device_stage_cache(self) -> Dict[Any, Any]:
         return self._device_cache
 
+    # ------------------------------------------------------------- pickling
+    # Partitions cross process boundaries on the dist/ worker transport.
+    # Loaded partitions ship their tables; unloaded ones ship the scan task
+    # (the WORKER reads the file — per-worker scan locality). Deferred op
+    # chains are closures that cannot cross a process boundary, so they
+    # materialize first (the dist backend declines those tasks anyway).
+    def __getstate__(self):
+        with self._lock:
+            if self._state == "loaded":
+                return {"schema": self.schema, "tables": list(self._tables),
+                        "stats": self._stats, "owner": self.owner_process}
+            if not self._pending:
+                task = self._scan_task
+                # a PrefetchedScanTask wrapper carries driver-local state
+                # (queue slot, future): ship the UNDERLYING task — the
+                # receiving process performs its own read
+                task = getattr(task, "_task", task)
+                return {"schema": self.schema, "scan_task": task,
+                        "stats": self._stats, "owner": self.owner_process}
+        return {"schema": self.schema, "tables": [self.table()],
+                "stats": self._stats, "owner": self.owner_process}
+
+    def __setstate__(self, state):
+        # a freshly-unpickled partition is visible to exactly one thread:
+        # its lock does not exist yet, so lock discipline cannot apply
+        self.schema = state["schema"]
+        self._tables = state.get("tables")  # daftlint: disable=DTL002
+        self._scan_task = state.get("scan_task")  # daftlint: disable=DTL002
+        self._state = ("loaded" if self._tables is not None  # daftlint: disable=DTL002
+                       else "unloaded")
+        self._stats = state.get("stats")
+        self._lock = threading.Lock()
+        self._device_cache = {}
+        self.owner_process = state.get("owner")
+        self._pending = None  # daftlint: disable=DTL002
+        self._count_preserving = True
+
     def with_pending_op(self, fn, schema: Schema,
                         count_preserving: bool) -> "MicroPartition":
         """Deferred map op over an unloaded partition: same scan task, the

@@ -22,8 +22,9 @@ import weakref
 from typing import Dict, List, Optional
 
 __all__ = ["HEALTH_SCHEMA_VERSION", "engine_health", "register_breaker",
-           "register_admission", "breaker_states", "admission_state",
-           "refresh_health_gauges", "validate_health"]
+           "register_admission", "register_cluster", "breaker_states",
+           "admission_state", "cluster_state", "refresh_health_gauges",
+           "validate_health"]
 
 HEALTH_SCHEMA_VERSION = 1
 
@@ -34,10 +35,21 @@ _breakers: Dict[str, "weakref.ref"] = {}
 # the most recently created ServingRuntime's AdmissionController (weak: a
 # dropped runtime reads as an idle admission layer)
 _admission: Optional["weakref.ref"] = None
+# the most recently created dist/ WorkerPool (weak: a dropped/shut-down
+# pool reads as an idle cluster)
+_cluster: Optional["weakref.ref"] = None
 
 _ADMISSION_IDLE = {"slots": 0, "queue_depth": 0, "active_queries": 0,
                    "queued_queries": 0, "admitted_total": 0,
                    "shed_total": 0, "draining": False}
+
+_CLUSTER_IDLE = {"workers": 0, "workers_alive": 0, "workers_restarting": 0,
+                 "workers_tripped": 0, "tasks_inflight": 0,
+                 "tasks_dispatched_total": 0, "tasks_completed_total": 0,
+                 "task_redispatches_total": 0, "worker_losses_total": 0,
+                 "local_fallbacks_total": 0, "restarts_used": 0,
+                 "restart_budget": 0, "restart_budget_remaining": 0,
+                 "degraded": False, "worker_detail": {}}
 
 # breaker state -> gauge value (0 healthy .. 2 open)
 _BREAKER_GAUGE = {"closed": 0.0, "half_open": 1.0, "open": 2.0, "idle": 0.0}
@@ -59,6 +71,14 @@ def register_admission(controller) -> None:
         _admission = weakref.ref(controller)
 
 
+def register_cluster(pool) -> None:
+    """Track the latest distributed WorkerPool (weakly) so ``dt.health()``
+    answers worker/task/restart state without a pool reference."""
+    global _cluster
+    with _lock:
+        _cluster = weakref.ref(pool)
+
+
 def admission_state() -> dict:
     with _lock:
         ref = _admission
@@ -66,6 +86,18 @@ def admission_state() -> dict:
     if ctl is None:
         return dict(_ADMISSION_IDLE)
     return ctl.snapshot()
+
+
+def cluster_state() -> dict:
+    with _lock:
+        ref = _cluster
+    pool = ref() if ref is not None else None
+    if pool is None or getattr(pool, "_closed", False):
+        return dict(_CLUSTER_IDLE)
+    try:
+        return pool.snapshot()
+    except Exception:
+        return dict(_CLUSTER_IDLE)  # pool mid-teardown
 
 
 def breaker_states() -> Dict[str, str]:
@@ -132,6 +164,7 @@ def engine_health() -> dict:
         "scheduler": sched,
         "pools": pools,
         "admission": admission_state(),
+        "cluster": cluster_state(),
         "streaming": streaming,
         "query_log": {
             "depth": len(QUERY_LOG),
@@ -212,6 +245,28 @@ def refresh_health_gauges(registry=None) -> None:
     reg.gauge("daft_tpu_stream_queued_bytes",
               "bytes queued in streaming channels").set(
         strm["queued_bytes"])
+    clu = cluster_state()
+    reg.gauge("daft_tpu_cluster_workers_alive",
+              "distributed workers currently serving tasks").set(
+        clu["workers_alive"])
+    reg.gauge("daft_tpu_cluster_workers_restarting",
+              "distributed worker slots awaiting respawn").set(
+        clu["workers_restarting"])
+    reg.gauge("daft_tpu_cluster_workers_tripped",
+              "worker slots with an open WorkerHealth breaker").set(
+        clu["workers_tripped"])
+    reg.gauge("daft_tpu_cluster_tasks_inflight",
+              "tasks currently executing on distributed workers").set(
+        clu["tasks_inflight"])
+    reg.gauge("daft_tpu_cluster_task_redispatches_total",
+              "tasks re-dispatched after a worker loss").set(
+        clu["task_redispatches_total"])
+    reg.gauge("daft_tpu_cluster_worker_losses_total",
+              "worker deaths observed by the supervisor").set(
+        clu["worker_losses_total"])
+    reg.gauge("daft_tpu_cluster_restart_budget_remaining",
+              "worker respawns the pool may still spend").set(
+        clu["restart_budget_remaining"])
     adm = admission_state()
     reg.gauge("daft_tpu_admission_active_queries",
               "queries holding an execution slot").set(
@@ -238,6 +293,7 @@ _TOP_KEYS = {
     "scheduler": dict,
     "pools": dict,
     "admission": dict,
+    "cluster": dict,
     "streaming": dict,
     "query_log": dict,
     "log": dict,
@@ -279,4 +335,13 @@ def validate_health(d: dict) -> List[str]:
     for k in ("active_channels", "queued_morsels", "queued_bytes"):
         if not isinstance(d["streaming"].get(k), int):
             errs.append(f"streaming.{k} missing or non-int")
+    for k in ("workers", "workers_alive", "workers_restarting",
+              "workers_tripped", "tasks_inflight",
+              "task_redispatches_total", "worker_losses_total",
+              "restarts_used", "restart_budget",
+              "restart_budget_remaining"):
+        if not isinstance(d["cluster"].get(k), int):
+            errs.append(f"cluster.{k} missing or non-int")
+    if not isinstance(d["cluster"].get("degraded"), bool):
+        errs.append("cluster.degraded missing or non-bool")
     return errs

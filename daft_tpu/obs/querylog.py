@@ -49,6 +49,7 @@ _EVENT_COUNTERS = (
     "faults_injected", "degraded_completions", "deadline_expired",
     "prefetch_throttled", "preload_throttled", "spill_write_failures",
     "task_retries", "dispatch_backpressure_stalls",
+    "task_redispatches", "worker_losses", "dist_local_fallbacks",
 )
 
 
@@ -153,7 +154,7 @@ def build_record(query_id: str, fingerprint: str, plan_ops: Dict[str, int],
         ledger = {k: led[k] for k in (
             "current", "high_water", "spilled_bytes", "spilled_partitions",
             "prefetch_inflight", "async_spill_inflight", "stream_inflight",
-            "exec_inflight", "negative_releases")}
+            "exec_inflight", "dist_inflight", "negative_releases")}
     except Exception:
         ledger = {}
     events = {k: counters[k] for k in _EVENT_COUNTERS if counters.get(k)}

@@ -279,7 +279,9 @@ class MeshExecutionContext(ExecutionContext):
 
         if not self.collective_health.allow(self.stats):
             self.stats.bump("degraded_shuffles")
-            return None
+            return self._try_transport_shuffle(parts, by, num, scheme,
+                                               descending, nulls_first,
+                                               boundaries)
         try:
             faults.check("collective.exchange", self.stats)
             # the whole mesh exchange (staging + all_to_all + gather-back)
@@ -291,11 +293,108 @@ class MeshExecutionContext(ExecutionContext):
                                                 boundaries, combine)
         except Exception:
             self.collective_health.record_failure(self.stats)
-            return None
+            # multi-process clusters whose collective backend cannot move
+            # bytes between processes (the jaxlib CPU gap) still have the
+            # dist/ peer transport as a data plane; single-process meshes
+            # fall to the plain host shuffle as before
+            return self._try_transport_shuffle(parts, by, num, scheme,
+                                               descending, nulls_first,
+                                               boundaries)
         if out is None:
             self.collective_health.release_probe()
         else:
             self.collective_health.record_success(self.stats)
+        return out
+
+    def _try_transport_shuffle(self, parts, by, num, scheme, descending,
+                               nulls_first, boundaries):
+        """Never raises: None (host path takes over) when the transport
+        cannot serve or itself fails."""
+        if not self._multiproc:
+            return None
+        try:
+            return self._transport_shuffle(parts, by, num, scheme,
+                                           descending, nulls_first,
+                                           boundaries)
+        except Exception as e:
+            from ..obs.log import get_logger
+
+            get_logger("mesh").warning("transport_shuffle_failed",
+                                       error=repr(e))
+            return None
+
+    def _transport_shuffle(self, parts, by, num, scheme, descending,
+                           nulls_first, boundaries):
+        """Cross-process exchange over the dist/ peer allgather plane: each
+        process materializes only the partitions it OWNS (per-host scan
+        locality holds), allgathers the pickled contributions, and every
+        process reconstitutes the full input and buckets it identically —
+        the same SPMD reconvergence contract as the collective exchange's
+        post-all_to_all allgather. Returns None when no peer plane exists
+        or the scheme cannot be served."""
+        import pickle
+
+        from ..dist.peer import get_peer_group
+
+        if scheme not in ("hash", "random", "range"):
+            return None
+        if scheme == "range" and boundaries is None:
+            return None
+        peer = get_peer_group()
+        if peer is None:
+            return None
+        nproc = jax.process_count()
+        my_proc = jax.process_index()
+        # contribution ownership by part index — identical rule to
+        # _device_shuffle_impl, so in-memory SPMD-duplicated inputs are
+        # contributed exactly once and foreign scan partitions stay unread
+        local = []
+        sent_rows = sent_bytes = 0
+        for i, p in enumerate(parts):
+            owner = (p.owner_process if p.owner_process is not None
+                     else i % nproc)
+            if owner == my_proc:
+                t = p.table()
+                local.append((i, t))
+                sent_rows += len(t)
+                sent_bytes += t.size_bytes()
+        datas = peer.allgather(
+            pickle.dumps(local, protocol=pickle.HIGHEST_PROTOCOL))
+        full = {}
+        for d in datas:
+            for i, t in pickle.loads(d):
+                full[i] = t
+        schema = parts[0].schema
+        ordered = []
+        for i in range(len(parts)):
+            t = full.get(i)
+            mp = (MicroPartition.from_table(t) if t is not None
+                  else MicroPartition.empty(schema))
+            ordered.append(mp)
+        # identical bucketing to ShuffleOp's host fanout (piece i of every
+        # part, concatenated in part order) so results are byte-identical
+        # with the exchange the collective/host paths produce
+        buckets = [[] for _ in range(num)]
+        for pi, mp in enumerate(ordered):
+            if scheme == "hash":
+                pieces = mp.partition_by_hash(by, num)
+            elif scheme == "random":
+                pieces = mp.partition_by_random(num, seed=pi)
+            else:
+                pieces = mp.partition_by_range(by, boundaries, descending,
+                                               nulls_first)
+            for i, piece in enumerate(pieces):
+                if len(piece):
+                    buckets[min(i, num - 1)].append(piece)
+        self.stats.bump("transport_shuffles")
+        if sent_rows:
+            self.stats.bump("exchange_rows", sent_rows)
+        if sent_bytes:
+            self.stats.bump("exchange_bytes", sent_bytes)
+        out = []
+        for b in range(num):
+            out.append(MicroPartition.concat(buckets[b]) if buckets[b]
+                       else MicroPartition.empty(schema))
         return out
 
     def _device_shuffle_impl(self, parts: List[MicroPartition], by, num: int,

@@ -35,6 +35,16 @@ import jax
 
 _INITIALIZED = [False]
 _BOOTSTRAP_FAILED = [False]
+# (coordinator, num_processes, process_id) of the connected cluster — the
+# dist/peer.py host-side allgather plane derives its rendezvous from this
+# when the collective backend cannot move bytes between processes
+_CLUSTER = [None]
+
+
+def cluster_info():
+    """(coordinator, num_processes, process_id) once init_distributed
+    connected this process, else None."""
+    return _CLUSTER[0]
 
 
 def init_distributed(coordinator: Optional[str] = None,
@@ -75,11 +85,13 @@ def init_distributed(coordinator: Optional[str] = None,
                      "execution")
             return False
         _INITIALIZED[0] = True
+        _CLUSTER[0] = (None, None, None)
         return True
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
     _INITIALIZED[0] = True
+    _CLUSTER[0] = (coordinator, num_processes, process_id)
     return True
 
 

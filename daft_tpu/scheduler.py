@@ -68,6 +68,41 @@ def _result_bytes(out) -> int:
     return total
 
 
+class DispatchBackend:
+    """Where a map-class partition task's work actually runs. The implicit
+    default backend is the in-process pool (dispatch() submits run_task to
+    ``ctx.pool()``); attaching an object with this shape to
+    ``ExecutionContext.dist_backend`` (dist/supervisor.WorkerPool) routes
+    eligible tasks to worker PROCESSES instead — through the same
+    dispatch window, ``_run_with_retry``, deadline, and cancellation
+    machinery, because the backend call happens INSIDE the task function
+    the in-process pool runs."""
+
+    def capacity(self) -> int:  # concurrent tasks the backend can absorb
+        raise NotImplementedError
+
+    def try_execute(self, op, part, ctx, op_name: str, seq: int):
+        """Execute one map task remotely; return (out, rows, wall_ns), or
+        None when the task is ineligible / the backend is degraded (the
+        caller runs it in-process). Raises the task's terminal error."""
+        raise NotImplementedError
+
+
+def run_map_task(op, part, ctx, op_name: str, seq: int):
+    """One map-class partition execution, routed through the context's
+    dispatch backend when present and willing, in-process otherwise.
+    Returns ``(out_partition, rows, wall_ns)`` where wall_ns is the real
+    work time (the worker's own measurement on the remote path)."""
+    backend = getattr(ctx, "dist_backend", None)
+    if backend is not None:
+        res = backend.try_execute(op, part, ctx, op_name, seq)
+        if res is not None:
+            return res
+    t0 = time.perf_counter_ns()
+    out = op.map_partition(part, ctx)
+    return out, None, time.perf_counter_ns() - t0
+
+
 def _run_with_retry(task: "PartitionTask", ctx) -> MicroPartition:
     """Per-task transient retry: a partition task that raises
     DaftTransientError — e.g. an injected io.get/scan.read fault that

@@ -49,7 +49,7 @@ _runtimes_lock = threading.Lock()
 
 # thread-name prefixes the engine owns; leaked_thread_count() scans these
 _ENGINE_THREAD_PREFIXES = ("daft-serve", "daft-exec", "daft-actor",
-                           "daft-spill-writer")
+                           "daft-spill-writer", "daft-dist")
 
 
 class QueryHandle:
@@ -330,6 +330,15 @@ def shutdown(timeout_s: float = 10.0) -> dict:
     from ..actor_pool import shutdown_all
 
     shutdown_all()
+    try:
+        from ..dist.supervisor import shutdown_worker_pool
+
+        # distributed worker PROCESSES die here too: zero leaked workers
+        # after dt.shutdown() is part of the kill-a-worker acceptance
+        shutdown_worker_pool(timeout_s=max(
+            0.5, timeout_s - (time.monotonic() - t0)))
+    except Exception as e:
+        logger.error("worker_pool_shutdown_failed", error=repr(e))
     # private per-query pools are released by GC (their worker threads exit
     # via the executor's weakref wakeup); collect so the wait below sees it
     gc.collect()
@@ -353,6 +362,17 @@ def _atexit_shutdown() -> None:
         live = bool(_RUNTIMES)
     if live:
         shutdown(timeout_s=2.0)
+        return
+    try:
+        import sys
+
+        dist_mod = sys.modules.get("daft_tpu.dist.supervisor")
+        if dist_mod is not None:
+            # worker PROCESSES are not daemon threads: they must be told
+            # to exit even when no serving runtime ever existed
+            dist_mod.shutdown_worker_pool(timeout_s=2.0)
+    except Exception:
+        pass
 
 
 atexit.register(_atexit_shutdown)

@@ -110,6 +110,13 @@ class MemoryLedger:
         # NOT in `current` for the prefetch_inflight reason.
         self.exec_inflight = 0
         self.exec_inflight_high_water = 0
+        # partition bytes shipped to (or results awaited from) distributed
+        # worker processes (dist/supervisor.py): the DRIVER's exact view of
+        # payload held remotely on its behalf — cluster totals stay exact
+        # even though the bytes are resident in another process. NOT in
+        # `current` for the prefetch_inflight reason.
+        self.dist_inflight = 0
+        self.dist_inflight_high_water = 0
         # peak of current + stream_inflight + prefetch_inflight +
         # exec_inflight: the query's ledger-visible WORKING SET (buffers +
         # streaming channels + prefetched-but-unconsumed partitions +
@@ -219,6 +226,22 @@ class MemoryLedger:
         if self._parent is not None and done:
             self._parent.exec_done(done)
 
+    # --- distributed-worker in-flight payload (dist/supervisor.py) ------
+    def dist_started(self, n: int) -> None:
+        with self._lock:
+            self.dist_inflight += n
+            if self.dist_inflight > self.dist_inflight_high_water:
+                self.dist_inflight_high_water = self.dist_inflight
+        if self._parent is not None:
+            self._parent.dist_started(n)
+
+    def dist_done(self, n: int) -> None:
+        with self._lock:
+            done = min(n, self.dist_inflight)
+            self.dist_inflight -= done
+        if self._parent is not None and done:
+            self._parent.dist_done(done)
+
     # --- async spill writeback ------------------------------------------
     def async_spill_started(self, n: int) -> None:
         with self._lock:
@@ -300,6 +323,8 @@ class MemoryLedger:
                 "stream_inflight_high_water": self.stream_inflight_high_water,
                 "exec_inflight": self.exec_inflight,
                 "exec_inflight_high_water": self.exec_inflight_high_water,
+                "dist_inflight": self.dist_inflight,
+                "dist_inflight_high_water": self.dist_inflight_high_water,
                 "working_set_high_water": self.working_set_high_water,
                 "spill_write_bytes": self.spill_write_bytes,
                 "spill_write_ns": self.spill_write_ns,

@@ -144,6 +144,11 @@ def try_stream(op, ctx, build, trace: bool = True):
         # mesh / multi-host: partitions are pinned to devices/processes;
         # morselizing would force foreign reads
         return None
+    if getattr(ctx, "dist_backend", None) is not None:
+        # distributed runner: map-class work ships to worker PROCESSES at
+        # partition granularity through the dispatch backend — in-process
+        # morsel channels would keep that work on the driver
+        return None
     seg = extract_segment(op, ctx)
     if seg is None:
         return None

@@ -1,0 +1,49 @@
+"""Minimal raw-collective probe worker: joins an N-process jax cluster and
+runs ONE cross-process psum — nothing else. The parent test keeps the
+strict xfail for the true ICI-collective gap keyed on this probe's output,
+while the engine-level multihost scenarios run for real over the dist/
+peer transport.
+
+Run: python multihost_probe.py <process_id> <num_processes> <port>
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from daft_tpu.parallel.multihost import global_mesh, init_distributed  # noqa: E402
+
+assert init_distributed(f"localhost:{port}", nproc, pid)
+mesh = global_mesh()
+
+try:
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from daft_tpu.parallel.collectives import _shard_map
+
+    arr = jax.device_put(
+        jnp.arange(mesh.devices.size, dtype=jnp.int32),
+        NamedSharding(mesh, P(mesh.axis_names[0])))
+    probe = _shard_map(
+        lambda x: jax.lax.psum(x, mesh.axis_names[0]), mesh=mesh,
+        in_specs=P(mesh.axis_names[0]), out_specs=P())
+    jax.block_until_ready(probe(arr))
+    print(f"PROBE_OK {pid}", flush=True)
+except Exception as e:
+    print(f"PROBE_FAILED {pid}: {type(e).__name__}: {e}", flush=True)

@@ -36,6 +36,19 @@ class TestClassify:
         assert classify("rows") is None  # bare table size: no direction
         assert classify("some_unknown_thing") is None
 
+    def test_distributed_suffixes(self):
+        # distributed rung (ISSUE 11): walls and the recovery-overhead
+        # headline are lower-better, the local-vs-dist ratio higher-better;
+        # chaos-leg EVENT counts (losses/redispatches/worker count) are
+        # pinned by the seeded fault plan and must stay unclassified
+        assert classify("distributed_wall_s") == "lower"
+        assert classify("distributed_recovery_wall_s") == "lower"
+        assert classify("distributed_recovery_overhead_pct") == "lower"
+        assert classify("distributed_speedup_x") == "higher"
+        assert classify("distributed_worker_losses") is None
+        assert classify("distributed_task_redispatches") is None
+        assert classify("distributed_workers") is None
+
     def test_streaming_suffixes(self):
         # streaming rung (ISSUE 10): time-to-first-row and working-set
         # peaks are lower-better; throughput (_mbps) stays higher-better

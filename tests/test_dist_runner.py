@@ -1,0 +1,470 @@
+"""Fault-tolerant multi-process distributed runner (daft_tpu/dist/).
+
+Covers the ISSUE-11 acceptance surface:
+- identity matrix: byte-identical results to the local runner across
+  worker counts and plan shapes (scan, shuffle, join, sort, distinct);
+- kill-a-worker: SIGKILLing a worker mid-query (the worker.exec chaos
+  fault does a REAL SIGKILL, plus an external os.kill variant) completes
+  the query byte-identically, records worker_losses/task_redispatches in
+  its QueryRecord, and respawns the slot;
+- poison task: a task that kills every worker it touches fails the query
+  with a DaftError naming the task — no hang, within the restart budget;
+- fault sites worker.spawn / worker.heartbeat / transport.send degrade to
+  respawn/re-dispatch, not a hang;
+- exactly-once: acked results are never re-run;
+- cluster health/gauges/ledger surfaces; zero leaked worker processes
+  after dt.shutdown().
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, faults
+from daft_tpu.context import get_context, set_execution_config
+from daft_tpu.errors import DaftError, DaftTimeoutError
+from daft_tpu.dist import supervisor as sup
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    cfg_before = get_context().execution_config
+    faults.disarm()
+    yield
+    faults.disarm()
+    get_context().execution_config = cfg_before
+
+
+def _fresh_pool_shutdown():
+    sup.shutdown_worker_pool()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_teardown():
+    yield
+    sup.shutdown_worker_pool()
+    assert sup.live_worker_process_count() == 0
+
+
+def _data(n=8000):
+    return {"a": list(range(n)), "b": [i % 13 for i in range(n)],
+            "s": [None if i % 11 == 0 else f"g{i % 5}" for i in range(n)]}
+
+
+def _queries(df):
+    other = dt.from_pydict({"b": list(range(13)),
+                            "w": [i * 10 for i in range(13)]})
+    return {
+        "map_agg": (df.select(col("a"), (col("a") * col("b") + 1)
+                              .alias("ab"))
+                    .where(col("ab") % 5 != 0)
+                    .groupby("b" if False else "ab")
+                    .agg(col("a").sum().alias("s")).sort("ab")),
+        "shuffle_groupby": (df.repartition(5, "b").groupby("b")
+                            .agg(col("a").sum().alias("s"),
+                                 col("a").count().alias("c")).sort("b")),
+        "join": (df.join(other, on="b").select(col("a"), col("w"))
+                 .sort("a")),
+        "sort": df.sort("a", desc=True).select(col("a"), col("s")),
+        "distinct": df.select(col("b"), col("s")).distinct().sort("b"),
+    }
+
+
+def _collect_all(reparts):
+    out = {}
+    for name, q in _queries(dt.from_pydict(_data()).repartition(
+            reparts)).items():
+        out[name] = q.collect().to_arrow()
+    return out
+
+
+class TestIdentityMatrix:
+    def test_byte_identical_across_worker_counts(self, tmp_path):
+        set_execution_config(enable_result_cache=False)
+        local = _collect_all(6)
+        for workers in (1, 3):
+            set_execution_config(distributed_workers=workers,
+                                 enable_result_cache=False)
+            got = _collect_all(6)
+            for name, tbl in local.items():
+                assert got[name].equals(tbl), (workers, name)
+        sup.shutdown_worker_pool()
+
+    def test_scan_partitions_read_by_workers(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as papq
+
+        for i in range(4):
+            papq.write_table(
+                pa.table({"a": list(range(i * 100, i * 100 + 100))}),
+                str(tmp_path / f"f{i}.parquet"))
+        pat = str(tmp_path / "*.parquet")
+        set_execution_config(enable_result_cache=False,
+                             scan_tasks_min_size_bytes=0)
+        local = (dt.read_parquet(pat).select((col("a") * 3).alias("t"))
+                 .sort("t").collect().to_arrow())
+        set_execution_config(distributed_workers=2,
+                             enable_result_cache=False,
+                             scan_tasks_min_size_bytes=0)
+        res = (dt.read_parquet(pat).select((col("a") * 3).alias("t"))
+               .sort("t").collect())
+        assert res.to_arrow().equals(local)
+        # the scan tasks themselves shipped: workers did remote work
+        assert res.stats.snapshot()["counters"].get("dist_tasks", 0) >= 1
+
+    def test_udf_tasks_stay_local(self):
+        @dt.udf(return_dtype=dt.DataType.int64())
+        def plus1(c):
+            return [v + 1 for v in c.to_pylist()]
+
+        set_execution_config(distributed_workers=2,
+                             enable_result_cache=False)
+        df = dt.from_pydict({"a": [1, 2, 3]}).repartition(2)
+        out = df.select(plus1(col("a")).alias("p")).sort("p").collect()
+        assert out.to_pydict()["p"] == [2, 3, 4]
+
+
+class TestKillAWorker:
+    def test_fault_sigkill_mid_query_recovers_byte_identical(self):
+        set_execution_config(enable_result_cache=False)
+        local = _collect_all(8)["map_agg"]
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=4,
+                             enable_result_cache=False)
+        # warm the pool so the kill hits a running fleet
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        pids_before = dict(pool.worker_pids())
+        assert len(pids_before) == 4
+        faults.arm("worker.exec", "nth", n=3)  # third dispatch dies
+        try:
+            res = _queries(dt.from_pydict(_data()).repartition(8))[
+                "map_agg"].collect()
+        finally:
+            faults.disarm()
+        assert res.to_arrow().equals(local)
+        rec = res.last_query_record()
+        assert rec["events"].get("worker_losses", 0) >= 1, rec["events"]
+        assert rec["events"].get("task_redispatches", 0) >= 1, rec["events"]
+        # the killed pid is really gone (SIGKILL, not simulation)
+        snap = pool.snapshot()
+        assert snap["worker_losses_total"] >= 1
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if pool.snapshot()["workers_alive"] == 4:
+                break
+            time.sleep(0.1)
+        snap = pool.snapshot()
+        assert snap["workers_alive"] == 4, snap  # respawned
+        assert snap["restarts_used"] >= 1
+        sup.shutdown_worker_pool()
+        assert sup.live_worker_process_count() == 0
+
+    def test_external_sigkill_mid_query(self):
+        set_execution_config(enable_result_cache=False)
+        big = {"a": list(range(60000)), "b": [i % 7 for i in range(60000)]}
+        q = lambda df: (df.select(col("a"), (col("a") * col("b"))
+                                  .alias("ab"))
+                        .where(col("ab") % 3 != 1)
+                        .groupby("ab").agg(col("a").sum().alias("s"))
+                        .sort("ab"))
+        local = q(dt.from_pydict(big).repartition(64)).collect().to_arrow()
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=4,
+                             enable_result_cache=False)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+
+        killed = []
+
+        def killer():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not killed:
+                snap = pool.snapshot()
+                for wid, d in snap["worker_detail"].items():
+                    if d["state"] == "ready" and d["inflight"] > 0 \
+                            and d["pid"]:
+                        try:
+                            os.kill(d["pid"], signal.SIGKILL)
+                        except OSError:
+                            continue
+                        killed.append(d["pid"])
+                        return
+                time.sleep(0.002)
+
+        t = threading.Thread(target=killer)
+        t.start()
+        res = q(dt.from_pydict(big).repartition(64)).collect()
+        t.join(timeout=35)
+        assert res.to_arrow().equals(local)
+        assert killed, "killer never saw an in-flight worker"
+        assert pool.snapshot()["worker_losses_total"] >= 1
+        sup.shutdown_worker_pool()
+        assert sup.live_worker_process_count() == 0
+
+
+class TestPoisonTask:
+    def test_poison_task_fails_query_with_daft_error(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=3,
+                             worker_restart_budget=6,
+                             enable_result_cache=False)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        faults.arm("worker.exec", "always")
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(DaftError, match=r"poison task \w+#\d+"):
+                dt.from_pydict(_data(3000)).repartition(4).select(
+                    (col("a") * 2).alias("c")).collect()
+        finally:
+            faults.disarm()
+        assert time.monotonic() - t0 < 60, "poison detection hung"
+        pool_snap = sup.worker_pool_snapshot()
+        assert pool_snap["restarts_used"] <= 6  # within the budget
+        sup.shutdown_worker_pool()
+        assert sup.live_worker_process_count() == 0
+
+    def test_restart_budget_exhaustion_degrades_to_local(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                             worker_restart_budget=0,
+                             worker_heartbeat_interval_s=0.1,
+                             enable_result_cache=False)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        # both workers die OUTSIDE any task (missed heartbeats), budget 0
+        # means no respawn: the pool is degraded, not any task poisoned —
+        # queries must still complete LOCALLY, not hang or error
+        faults.arm("worker.heartbeat", "first_n", n=2)
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if pool.snapshot()["workers_alive"] == 0:
+                    break
+                time.sleep(0.05)
+        finally:
+            faults.disarm()
+        snap = pool.snapshot()
+        assert snap["workers_alive"] == 0
+        assert snap["degraded"] is True
+        res = dt.from_pydict(_data(3000)).repartition(4).select(
+            (col("a") * 2).alias("c")).collect()
+        assert sorted(res.to_pydict()["c"]) == [v * 2 for v in range(3000)]
+        c = res.stats.snapshot()["counters"]
+        assert c.get("dist_local_fallbacks", 0) >= 1
+        sup.shutdown_worker_pool()
+
+
+class TestFaultSites:
+    def test_spawn_fault_consumes_budget_then_heals(self):
+        sup.shutdown_worker_pool()
+        faults.arm("worker.spawn", "first_n", n=1)
+        try:
+            set_execution_config(distributed_workers=2,
+                                 enable_result_cache=False)
+            res = dt.from_pydict(_data(2000)).repartition(3).select(
+                (col("a") + 1).alias("c")).collect()
+        finally:
+            faults.disarm()
+        # the query completed despite slot 0 failing its initial spawn
+        assert sorted(res.to_pydict()["c"]) == [v + 1 for v in range(2000)]
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            snap = sup.worker_pool_snapshot()
+            if snap and snap["workers_alive"] == 2:
+                break
+            time.sleep(0.1)
+        assert sup.worker_pool_snapshot()["workers_alive"] == 2
+        sup.shutdown_worker_pool()
+
+    def test_heartbeat_fault_declares_worker_dead_not_hang(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                             worker_heartbeat_interval_s=0.1,
+                             enable_result_cache=False)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        faults.arm("worker.heartbeat", "nth", n=1)
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if pool.snapshot()["worker_losses_total"] >= 1:
+                    break
+                time.sleep(0.05)
+        finally:
+            faults.disarm()
+        assert pool.snapshot()["worker_losses_total"] >= 1
+        # queries keep completing through the loss + respawn
+        res = dt.from_pydict(_data(2000)).repartition(3).select(
+            (col("a") + 2).alias("c")).collect()
+        assert sorted(res.to_pydict()["c"]) == [v + 2 for v in range(2000)]
+        sup.shutdown_worker_pool()
+
+    def test_transport_send_fault_redispatches(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                             enable_result_cache=False)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        # sever the link under the 3rd frame sent (task sends + pings share
+        # the site): the send failure must read as a worker loss and the
+        # task must re-dispatch, not hang
+        faults.arm("transport.send", "nth", n=3)
+        try:
+            res = dt.from_pydict(_data(4000)).repartition(6).select(
+                (col("a") * 5).alias("c")).collect()
+        finally:
+            faults.disarm()
+        assert sorted(res.to_pydict()["c"]) == [v * 5 for v in range(4000)]
+        sup.shutdown_worker_pool()
+
+    def test_sites_registered(self):
+        for site in ("worker.spawn", "worker.exec", "worker.heartbeat",
+                     "transport.send"):
+            assert site in faults.SITES
+
+
+class TestExactlyOnce:
+    def test_acked_results_never_rerun(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                             enable_result_cache=False)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        res = dt.from_pydict(_data(4000)).repartition(5).select(
+            (col("a") + 9).alias("c")).collect()
+        assert sorted(res.to_pydict()["c"]) == [v + 9 for v in range(4000)]
+        snap = pool.snapshot()
+        # nothing failed: dispatch count == completion count, no re-runs
+        assert snap["tasks_dispatched_total"] == snap[
+            "tasks_completed_total"]
+        assert snap["task_redispatches_total"] == 0
+        # after a mid-query loss, only LOST tasks re-dispatch: completed
+        # count grows by exactly (tasks + redispatched), never more
+        faults.arm("worker.exec", "nth", n=2)
+        try:
+            res2 = dt.from_pydict(_data(4000)).repartition(5).select(
+                (col("a") + 9).alias("c")).collect()
+        finally:
+            faults.disarm()
+        assert sorted(res2.to_pydict()["c"]) == [v + 9 for v in range(4000)]
+        c = res2.stats.snapshot()["counters"]
+        snap2 = pool.snapshot()
+        done_delta = (snap2["tasks_completed_total"]
+                      - snap["tasks_completed_total"])
+        dispatched_delta = (snap2["tasks_dispatched_total"]
+                            - snap["tasks_dispatched_total"])
+        # every extra dispatch is accounted by a recorded re-dispatch (or a
+        # fault-killed dispatch that never reached a worker)
+        assert dispatched_delta - done_delta <= c.get(
+            "task_redispatches", 0) + c.get("dist_local_fallbacks", 0) + 1
+        sup.shutdown_worker_pool()
+
+
+class TestClusterSurfaces:
+    def test_health_cluster_section_and_gauges(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                             enable_result_cache=False)
+        _ = dt.from_pydict(_data(1000)).repartition(2).select(
+            col("a")).collect()
+        from daft_tpu.obs.health import validate_health
+
+        h = dt.health()
+        assert validate_health(h) == []
+        clu = h["cluster"]
+        assert clu["workers"] == 2
+        assert clu["workers_alive"] == 2
+        assert clu["restart_budget_remaining"] == clu["restart_budget"]
+        assert clu["degraded"] is False
+        assert set(clu["worker_detail"]) == {"0", "1"}
+        mt = dt.metrics_text()
+        assert "daft_tpu_cluster_workers_alive 2" in mt
+        assert "daft_tpu_cluster_worker_losses_total" in mt
+        sup.shutdown_worker_pool()
+        h2 = dt.health()
+        assert validate_health(h2) == []
+        assert h2["cluster"]["workers"] == 0  # idle shape after teardown
+
+    def test_worker_budget_carved_and_reported(self):
+        sup.shutdown_worker_pool()
+        budget = 64 * 1024 * 1024
+        set_execution_config(distributed_workers=3,
+                             memory_budget_bytes=budget,
+                             enable_result_cache=False)
+        _ = dt.from_pydict(_data(1000)).repartition(2).select(
+            col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        wcfg = pool._worker_cfg()
+        assert wcfg.memory_budget_bytes == budget // 4  # N workers + driver
+        assert wcfg.distributed_workers == 0  # never nested
+        # heartbeat pongs report worker-side ledger balances into health
+        deadline = time.monotonic() + 10
+        seen = False
+        while time.monotonic() < deadline and not seen:
+            detail = pool.snapshot()["worker_detail"]
+            seen = all("ledger_current" in d for d in detail.values())
+            time.sleep(0.05)
+        assert seen
+        sup.shutdown_worker_pool()
+        set_execution_config(memory_budget_bytes=None)
+
+    def test_record_ledger_has_dist_inflight(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                             enable_result_cache=False)
+        res = dt.from_pydict(_data(2000)).repartition(3).select(
+            col("a")).collect()
+        rec = res.last_query_record()
+        assert "dist_inflight" in rec["ledger"]
+        assert rec["ledger"]["dist_inflight"] == 0  # settled at query end
+        sup.shutdown_worker_pool()
+
+    def test_deadline_respected_while_remote(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                             enable_result_cache=False,
+                             execution_timeout_s=0.0001)
+        try:
+            with pytest.raises(DaftTimeoutError):
+                dt.from_pydict(_data(4000)).repartition(6).select(
+                    (col("a") * 2).alias("c")).collect()
+        finally:
+            set_execution_config(execution_timeout_s=None)
+        sup.shutdown_worker_pool()
+
+
+class TestTransportUnit:
+    def test_roundtrip_and_eof(self):
+        import socket as _socket
+
+        from daft_tpu.dist.transport import (TransportClosed, recv_msg,
+                                             send_msg)
+
+        a, b = _socket.socketpair()
+        try:
+            send_msg(a, {"type": "x", "blob": b"\x00" * 100000,
+                         "n": [1, 2, 3]})
+            msg = recv_msg(b)
+            assert msg["type"] == "x" and len(msg["blob"]) == 100000
+            a.close()
+            with pytest.raises(TransportClosed):
+                recv_msg(b)
+        finally:
+            b.close()
+
+    def test_runner_selection(self):
+        from daft_tpu.dist.runner import DistributedRunner
+        from daft_tpu.runners import NativeRunner
+
+        ctx = get_context()
+        set_execution_config(distributed_workers=0)
+        ctx.set_runner("native")
+        assert type(ctx.runner()) is NativeRunner
+        set_execution_config(distributed_workers=2)
+        assert type(ctx.runner()) is DistributedRunner
+        set_execution_config(distributed_workers=0)
+        assert type(ctx.runner()) is NativeRunner

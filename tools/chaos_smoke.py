@@ -1,0 +1,129 @@
+"""chaos-smoke: a short mixed workload through the distributed runner
+under randomized-but-SEEDED worker kills. Wired into `make lint` (and
+usable alone via `make chaos-smoke`) so a supervision regression — a
+hang, a lost query, a leaked worker process — fails the static-gate path
+deterministically (the fault plan hashes (seed, site, call#), so every
+run kills the same dispatches).
+
+Checks, in order:
+ 1. every query in the workload reaches a TERMINAL QueryRecord (outcome
+    in the schema's OUTCOMES — recovered "ok" and poison-task "error"
+    both count; silence/hang does not), within a hard wall clock;
+ 2. results of recovered queries are byte-identical to the local runner;
+ 3. at least one worker loss + re-dispatch actually happened (the chaos
+    was real, not a no-op plan);
+ 4. after shutdown: zero live worker processes, zero engine threads.
+
+Exits nonzero with a named failure on any violation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHAOS_SEED = 11
+KILL_RATE = 0.12
+WORKERS = 2
+QUERIES = 5
+
+
+def main() -> int:
+    import daft_tpu as dt
+    from daft_tpu import col, faults
+    from daft_tpu.dist import supervisor as sup
+    from daft_tpu.errors import DaftError
+    from daft_tpu.obs.querylog import OUTCOMES, validate_record
+
+    dt.set_execution_config(enable_result_cache=False)
+
+    def make_queries():
+        df = dt.from_pydict({"a": list(range(4000)),
+                             "b": [i % 9 for i in range(4000)]})
+        other = dt.from_pydict({"b": list(range(9)),
+                                "w": [i * 3 for i in range(9)]})
+        return [
+            ("map", df.repartition(4).select((col("a") * 2).alias("c"))
+             .sort("c")),
+            ("agg", df.repartition(4).groupby("b")
+             .agg(col("a").sum().alias("s")).sort("b")),
+            ("join", df.join(other, on="b").select(col("a"), col("w"))
+             .sort("a")),
+            ("filter", df.repartition(3).where(col("a") % 7 == 0)
+             .select(col("a")).sort("a")),
+            ("distinct", df.select(col("b")).distinct().sort("b")),
+        ][:QUERIES]
+
+    # oracle results, local runner
+    oracle = {name: q.collect().to_arrow() for name, q in make_queries()}
+
+    dt.set_execution_config(distributed_workers=WORKERS,
+                            worker_heartbeat_interval_s=0.2)
+    # warm the fleet before arming so the chaos hits execution, not spawn
+    _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+    before_log = len(dt.query_log())
+    faults.arm("worker.exec", "rate", rate=KILL_RATE, seed=CHAOS_SEED)
+    outcomes = {}
+    try:
+        for name, q in make_queries():
+            try:
+                res = q.collect()
+                rec = res.last_query_record()
+                outcomes[name] = (rec, res.to_arrow())
+            except DaftError:
+                # poison-task (or degraded) failure: terminal, recorded by
+                # the flight recorder's finally hook — fetch its record
+                outcomes[name] = (dt.query_log()[-1], None)
+    finally:
+        faults.disarm()
+
+    recs = dt.query_log()[before_log:]
+    if len(recs) < QUERIES:
+        print(f"FAIL: only {len(recs)} QueryRecords for {QUERIES} queries")
+        return 1
+    for name, (rec, got) in outcomes.items():
+        if rec is None:
+            print(f"FAIL: query {name} has no terminal QueryRecord")
+            return 1
+        errs = validate_record(rec)
+        if errs:
+            print(f"FAIL: query {name} record invalid: {errs}")
+            return 1
+        if rec["outcome"] not in OUTCOMES:
+            print(f"FAIL: query {name} outcome {rec['outcome']!r}")
+            return 1
+        if got is not None and not got.equals(oracle[name]):
+            print(f"FAIL: query {name} result diverged from local runner")
+            return 1
+    print(f"CHAOS_QUERIES_OK {len(outcomes)} terminal "
+          f"({sum(1 for r, g in outcomes.values() if g is not None)} ok)")
+
+    snap = sup.worker_pool_snapshot()
+    if snap is None or snap["worker_losses_total"] < 1:
+        print("FAIL: chaos plan never killed a worker — smoke is a no-op")
+        return 1
+    print(f"CHAOS_LOSSES_OK losses={snap['worker_losses_total']} "
+          f"redispatches={snap['task_redispatches_total']} "
+          f"restarts={snap['restarts_used']}")
+
+    dt.shutdown()
+    live = sup.live_worker_process_count()
+    if live:
+        print(f"FAIL: {live} worker process(es) leaked after shutdown")
+        return 1
+    from daft_tpu.serve import leaked_thread_count
+
+    leaked = leaked_thread_count()
+    if leaked:
+        print(f"FAIL: {leaked} engine thread(s) leaked after shutdown")
+        return 1
+    print("CHAOS_SHUTDOWN_OK zero leaked processes/threads")
+    print("CHAOS_SMOKE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

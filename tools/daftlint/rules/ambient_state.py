@@ -67,6 +67,18 @@ REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
     ("daft_tpu/serve/runtime.py", "_RUNTIMES"),
     # actor pools persist across queries by design (model weights)
     ("daft_tpu/actor_pool.py", "_pools"),
+    # the process's distributed worker pool (one supervised fleet per
+    # process, torn down by dt.shutdown/atexit)
+    ("daft_tpu/dist/supervisor.py", "_POOL"),
+    # health snapshot's weak ref to the latest worker pool
+    ("daft_tpu/obs/health.py", "_cluster"),
+    # immutable struct.Struct frame-header codec, not state
+    ("daft_tpu/dist/transport.py", "_LEN"),
+    # one peer-allgather plane per process (cluster membership is
+    # process-lifetime state, like the jax distributed runtime it mirrors)
+    ("daft_tpu/dist/peer.py", "_GROUP"),
+    # cluster identity recorded at init_distributed (coordinator/nproc/pid)
+    ("daft_tpu/parallel/multihost.py", "_CLUSTER"),
 }
 
 _CONTAINER_CTOR_BASES = {
