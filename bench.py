@@ -543,7 +543,11 @@ def measure_distributed(scale: float = 0.02, workers: int = 2,
     frame = dt.from_arrow(tables["lineitem"]).repartition(8).collect()
     cfg = get_context().execution_config
     saved = {k: getattr(cfg, k) for k in ("distributed_workers",
-                                          "enable_result_cache")}
+                                          "enable_result_cache",
+                                          "partition_integrity",
+                                          "speculative_execution",
+                                          "speculation_min_s",
+                                          "speculation_quantile_factor")}
     cfg.enable_result_cache = False
     walls = {"local": [], "dist": []}
     out = {"distributed_workers": workers}
@@ -591,6 +595,82 @@ def measure_distributed(scale: float = 0.02, workers: int = 2,
         out["distributed_worker_losses"] = c.get("worker_losses", 0)
         out["distributed_task_redispatches"] = c.get(
             "task_redispatches", 0)
+        # ---- integrity A/B: checksums on vs off, interleaved ------------
+        # (ISSUE 12 gate: end-to-end partition integrity — spill crc,
+        # transport frame crc, encode crc — must cost < 3% on this leg)
+        # interleaved on the SHARED warmed fleet (a fresh pool per mode
+        # swings ±100ms on this host — far above the measured cost);
+        # workers MIRROR the driver's per-frame checksum flag, so the
+        # toggle flips both directions of frame traffic without respawn
+        walls_i = {"on": [], "off": []}
+        deltas = []
+        for _t in range(max(24, trials)):
+            # alternate the in-pair order (a fixed order systematically
+            # taxes whichever mode runs first on this host) and estimate
+            # from the MEDIAN of time-adjacent paired deltas over many
+            # pairs: the 1-2 core build hosts drift in multi-second
+            # phases and single pair deltas swing +-15%, an order of
+            # magnitude above the ~1-2% true checksum cost (striped bulk
+            # frames sample ~1.6% of the bytes; micro-measured 0.14 ms
+            # per 3 MB frame per side) — the median over ~24 pairs is
+            # the estimator that empirically centers on it
+            order = ("on", "off") if _t % 2 == 0 else ("off", "on")
+            pair = {}
+            for mode in order:
+                cfg.partition_integrity = (mode == "on")
+                t0 = time.perf_counter()
+                got = tpch.q1(frame).collect()
+                pair[mode] = time.perf_counter() - t0
+                walls_i[mode].append(pair[mode])
+                if not _parity(got.to_pydict(), want, rtol=1e-6):
+                    raise AssertionError(
+                        f"integrity A/B parity broke (checksums {mode})")
+            deltas.append((pair["on"] - pair["off"]) / pair["off"])
+        cfg.partition_integrity = True
+        deltas.sort()
+        mid = len(deltas) // 2
+        med = (deltas[mid] if len(deltas) % 2
+               else (deltas[mid - 1] + deltas[mid]) / 2)
+        out["integrity_wall_on_s"] = round(min(walls_i["on"]), 4)
+        out["integrity_wall_off_s"] = round(min(walls_i["off"]), 4)
+        out["integrity_overhead_pct"] = round(med * 100.0, 2)
+        # ---- straggler leg: one worker slowed, speculation on vs off ----
+        from collections import deque
+
+        from daft_tpu.faults import ENV_FAULT_SPEC
+
+        sup.shutdown_worker_pool()
+        os.environ[ENV_FAULT_SPEC] = json.dumps(
+            {"site": "worker.task", "mode": "always", "delay_s": 0.5,
+             "worker_id": 0})
+        cfg.speculation_min_s = 0.15
+        cfg.speculation_quantile_factor = 2.0
+        try:
+            walls_s = {}
+            for mode in ("off", "on"):
+                cfg.speculative_execution = (mode == "on")
+                got = tpch.q1(frame).collect()  # (re)spawn + warm, slowly
+                pool = sup._POOL
+                if pool is not None:
+                    # seed the p75 history with healthy walls so the
+                    # straggler threshold does not drift with the
+                    # warmup's straggled samples
+                    with pool._cond:
+                        for op in list(pool._op_walls):
+                            pool._op_walls[op] = deque([0.01] * 8,
+                                                       maxlen=64)
+                t0 = time.perf_counter()
+                got = tpch.q1(frame).collect()
+                walls_s[mode] = time.perf_counter() - t0
+                if not _parity(got.to_pydict(), want, rtol=1e-6):
+                    raise AssertionError(
+                        f"straggler leg parity broke (speculation {mode})")
+            out["straggler_wall_off_s"] = round(walls_s["off"], 4)
+            out["straggler_wall_on_s"] = round(walls_s["on"], 4)
+            out["straggler_mitigation_speedup_x"] = round(
+                walls_s["off"] / walls_s["on"], 3)
+        finally:
+            os.environ.pop(ENV_FAULT_SPEC, None)
         return out
     finally:
         for k, v in saved.items():

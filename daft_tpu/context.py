@@ -239,6 +239,35 @@ class ExecutionConfig:
     # naming the task once it exhausts this budget (or has excluded every
     # worker slot), instead of re-dispatching forever
     dist_task_max_attempts: int = 4
+    # --- self-healing data plane (daft_tpu/integrity/, README "Data
+    # integrity & speculation") ----------------------------------------
+    # end-to-end partition integrity: payloads leaving compute (spill IPC
+    # files, transport frames, encoded exchange pieces) carry a crc32
+    # recorded at production and verified at re-entry; a mismatch raises
+    # DaftCorruptionError (transient — lineage recompute / task re-dispatch
+    # own recovery) instead of a garbled table. Results are byte-identical
+    # with this off; off also skips the checksum computation (the bench
+    # integrity_overhead_pct A/B axis).
+    partition_integrity: bool = True
+    # lineage-based recomputation: a bounded per-query LineageLog records
+    # how spilled partitions were produced (scan task ref, or fanout op +
+    # source partition ref); a corrupted or missing spill artifact is
+    # recomputed from its recipe (partitions_recomputed) instead of
+    # failing the query, degrading to a query-level DaftError only when
+    # lineage is truncated or the recompute itself fails
+    lineage_recomputation: bool = True
+    lineage_log_depth: int = 4096
+    # speculative straggler mitigation (distributed runner): a remote task
+    # exceeding speculation_quantile_factor x the running p75 task wall
+    # for its op (floor speculation_min_s) gets a duplicate dispatched to
+    # a different worker; first result wins through the exactly-once ack
+    # ledger, the loser is cancelled, and concurrent duplicates are
+    # bounded by speculation_max_inflight so a sick fleet cannot double
+    # its own load (tasks_speculated / speculation_wins counters)
+    speculative_execution: bool = True
+    speculation_quantile_factor: float = 3.0
+    speculation_min_s: float = 1.0
+    speculation_max_inflight: int = 2
     # device circuit breaker (execution.DeviceHealth): after this many
     # CONSECUTIVE device-kernel failures the breaker opens and every
     # device-eligible partition routes straight to the host path (one trip,

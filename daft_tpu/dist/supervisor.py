@@ -42,18 +42,27 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..errors import DaftError, DaftTransientError
 from ..execution import DeviceHealth
 from ..obs.log import get_logger
-from .transport import TransportClosed, recv_msg, send_msg
+from .transport import PROTOCOL_VERSION, TransportClosed, recv_msg, send_msg
 
 logger = get_logger("dist")
 
 # worker-side op-cache keys: process-wide monotonic, never reused (id()
 # would alias across GC)
 _OP_SEQ = itertools.count(1)
+
+# speculative execution: completed-wall samples kept per op name (the
+# running distribution the p75 straggler threshold is computed from), and
+# the minimum sample count before speculation may trigger at all — with
+# fewer completions the p75 is noise, and duplicating tasks on a cold
+# pool would be pure added load
+_WALL_HISTORY = 64
+_SPECULATION_MIN_SAMPLES = 4
 
 
 class WorkerHealth(DeviceHealth):
@@ -75,7 +84,8 @@ class _TaskEntry:
     """Driver-side ledger row for one dispatched task."""
 
     __slots__ = ("task_id", "op_name", "seq", "ctx", "attempts", "excluded",
-                 "status", "result", "error", "event", "charged", "wid")
+                 "status", "result", "error", "event", "charged", "wid",
+                 "active_wids", "spec_wid", "dispatched_at")
 
     def __init__(self, task_id: int, op_name: str, seq: int, ctx):
         self.task_id = task_id
@@ -91,6 +101,18 @@ class _TaskEntry:
         self.event = threading.Event()
         self.charged = 0
         self.wid: Optional[int] = None
+        # worker slots currently executing this entry (>1 while a
+        # speculative duplicate is in flight); the entry only reads as
+        # LOST when the set empties — one of two runners dying is not a
+        # loss, it is exactly what speculation pays for
+        self.active_wids: set = set()
+        # the duplicate's worker slot while one is in flight (None
+        # otherwise); invariant: the pool-wide _spec_inflight counter
+        # counts entries whose spec_wid is set
+        self.spec_wid: Optional[int] = None
+        # when the current primary dispatch left the driver — the clock
+        # the straggler threshold compares against
+        self.dispatched_at = 0.0
 
 
 class _WorkerHandle:
@@ -144,6 +166,15 @@ class WorkerPool:
         self.local_fallbacks_total = 0
         self.restarts_used = 0
         self.restart_budget = max(0, int(cfg.worker_restart_budget))
+        # speculative straggler mitigation: completed-wall history per op
+        # (feeds the p75 threshold), the bounded count of duplicates in
+        # flight, and the speculated/won totals
+        self._op_walls: Dict[str, deque] = {}
+        self._spec_inflight = 0
+        self.tasks_speculated_total = 0
+        self.speculation_wins_total = 0
+        # transport frame checksums follow the integrity knob
+        self._checksum = bool(getattr(cfg, "partition_integrity", True))
         # the listener the spawned workers dial back into
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -225,12 +256,24 @@ class WorkerPool:
                     cand.close()
                     continue
                 if (hello.get("type") == "hello"
+                        and hello.get("proto") != PROTOCOL_VERSION):
+                    # old-frame peer (pre-checksum protocol) or a version
+                    # skew: reject at the handshake — mixed-version frames
+                    # would desync, and unverified payloads defeat the
+                    # end-to-end integrity contract
+                    logger.warning("worker_proto_rejected", worker=w.wid,
+                                   got=hello.get("proto"),
+                                   want=PROTOCOL_VERSION)
+                    cand.close()
+                    continue
+                if (hello.get("type") == "hello"
                         and hello.get("token") == self._token
                         and hello.get("worker_id") == w.wid):
                     sock = cand
                     break
                 cand.close()  # stale/foreign connection: not ours
-            send_msg(sock, {"type": "init", "cfg": self._worker_cfg()})
+            send_msg(sock, {"type": "init", "cfg": self._worker_cfg()},
+                     checksum=self._checksum)
         except BaseException:
             if sock is not None:
                 sock.close()
@@ -288,26 +331,58 @@ class WorkerPool:
                             w.last_pong = time.monotonic()
                             w.ledger_report = msg.get("ledger",
                                                       w.ledger_report)
-                elif kind in ("result", "task_error"):
+                elif kind in ("result", "task_error", "task_skipped"):
                     self._on_task_reply(w, sock, msg)
         except TransportClosed:
             self._on_worker_death(w, sock, "connection closed")
         except Exception as e:
+            # includes DaftCorruptionError from a checksum-failed frame:
+            # a corrupt link is a dead link — re-dispatch owns recovery
             self._on_worker_death(w, sock, f"receiver failed: {e!r}")
 
     def _on_task_reply(self, w: _WorkerHandle, sock, msg: dict) -> None:
+        cancel_targets: List[_WorkerHandle] = []
         with self._cond:
             if w.sock is not sock:
                 return  # a dead incarnation's straggler frame
             entry = w.inflight.pop(msg["task_id"], None)
-            if entry is None or entry.status != "inflight":
-                return  # already settled (exactly-once: never re-applied)
+            if entry is None:
+                return
+            entry.active_wids.discard(w.wid)
+            if msg["type"] == "task_skipped" or entry.status != "inflight":
+                # a cancelled speculative loser (skipped before it started,
+                # or its late result after the winner settled): the pop
+                # above frees the slot; exactly-once — never re-applied
+                self._cond.notify_all()
+                return
             if msg["type"] == "result":
                 entry.status = "done"
                 entry.result = (msg["part"], msg["rows"], msg["wall_ns"])
                 w.tasks_done += 1
                 self.tasks_completed_total += 1
+                # feed the straggler threshold's running distribution
+                self._op_walls.setdefault(
+                    entry.op_name, deque(maxlen=_WALL_HISTORY)).append(
+                    msg["wall_ns"] / 1e9)
             else:
+                if entry.active_wids:
+                    # another runner of this entry is still executing
+                    # (speculation): DROP the failed runner instead of
+                    # settling — "first result wins" means first RESULT,
+                    # not first reply, and an erroring duplicate must
+                    # never cancel healthy in-flight work (nor count as
+                    # a speculation win)
+                    if entry.spec_wid == w.wid:
+                        entry.spec_wid = None
+                        self._spec_inflight -= 1
+                    elif entry.spec_wid is not None:
+                        # the primary failed: the duplicate is now the
+                        # worker of record
+                        entry.wid = entry.spec_wid
+                        entry.spec_wid = None
+                        self._spec_inflight -= 1
+                    self._cond.notify_all()
+                    return
                 err = None
                 if msg.get("error") is not None:
                     try:
@@ -320,12 +395,39 @@ class WorkerPool:
                         f"{msg.get('error_message')}")
                 entry.status = "error"
                 entry.error = err
+            spec_win = False
+            if entry.spec_wid is not None:
+                # a speculated entry settled: first result wins, the
+                # still-running dispatch is the loser — cancel it (frees
+                # its worker's queue slot if the task never started; a
+                # mid-execution loser finishes and its result is dropped
+                # by the exactly-once guard above)
+                spec_win = (w.wid == entry.spec_wid)
+                entry.spec_wid = None
+                self._spec_inflight -= 1
+                if spec_win:
+                    self.speculation_wins_total += 1
+                cancel_targets = [ow for ow in self.workers
+                                  if ow.wid in entry.active_wids
+                                  and ow.sock is not None]
             if entry.charged:
                 entry.ctx.ledger.dist_done(entry.charged)
                 entry.charged = 0
             self._cond.notify_all()
         if entry.status == "done":
             w.breaker.record_success()
+        if spec_win:
+            entry.ctx.stats.bump("speculation_wins")
+            logger.warning("speculation_win", op=entry.op_name,
+                           seq=entry.seq, worker=w.wid)
+        for ow in cancel_targets:
+            try:
+                with ow.send_lock:
+                    send_msg(ow.sock, {"type": "cancel",
+                                       "task_id": entry.task_id},
+                             checksum=self._checksum)
+            except Exception:
+                pass  # a dead loser settles through the death path
         entry.event.set()
 
     # ------------------------------------------------------------ death
@@ -363,8 +465,30 @@ class WorkerPool:
             w.deaths += 1
             dead_sock, proc = w.sock, w.proc
             w.sock = None
-            entries = [e for e in w.inflight.values()
-                       if e.status == "inflight"]
+            entries = []
+            for e in w.inflight.values():
+                if e.status != "inflight":
+                    continue  # a settled speculative loser parked here
+                e.active_wids.discard(w.wid)
+                if e.active_wids:
+                    # a speculative duplicate (or the primary) of this
+                    # entry is still running on another worker: the entry
+                    # SURVIVES this death — exactly what the duplicate
+                    # was dispatched to buy
+                    if e.spec_wid == w.wid:
+                        e.spec_wid = None
+                        self._spec_inflight -= 1
+                    elif e.spec_wid is not None:
+                        # the primary died: the duplicate is now the
+                        # worker of record (exclusion on a later loss)
+                        e.wid = e.spec_wid
+                        e.spec_wid = None
+                        self._spec_inflight -= 1
+                    continue
+                if e.spec_wid is not None:
+                    e.spec_wid = None
+                    self._spec_inflight -= 1
+                entries.append(e)
             w.inflight.clear()
             self.worker_losses_total += 1
             affected = {}
@@ -434,7 +558,8 @@ class WorkerPool:
                         continue
                     try:
                         with w.send_lock:
-                            send_msg(sock, {"type": "ping"})
+                            send_msg(sock, {"type": "ping"},
+                                     checksum=self._checksum)
                     except Exception as e:
                         self._on_worker_death(w, sock, f"ping failed: {e!r}")
                 elif state == "dead":
@@ -543,7 +668,7 @@ class WorkerPool:
             self._check_query(ctx)
             w = self._acquire_worker(entry, ctx)
             self._dispatch(entry, w, payload, part_bytes)
-            self._wait(entry, ctx)
+            self._wait(entry, ctx, payload, part_bytes)
             if entry.status == "done":
                 out, rows, wall_ns = entry.result
                 ctx.stats.bump("dist_tasks")
@@ -600,6 +725,8 @@ class WorkerPool:
                     entry.status = "inflight"
                     entry.event.clear()
                     entry.wid = w.wid
+                    entry.active_wids = {w.wid}
+                    entry.spec_wid = None
                     w.inflight[entry.task_id] = entry
                     return w
                 # nothing to wait FOR: no candidate slot is serving (ready
@@ -620,11 +747,16 @@ class WorkerPool:
             self._check_query(ctx)
 
     def _dispatch(self, entry: _TaskEntry, w: _WorkerHandle, payload,
-                  part_bytes: bytes) -> None:
+                  part_bytes: bytes, speculative: bool = False) -> None:
         from .. import faults
 
         op_key, op_bytes = payload
-        entry.attempts += 1
+        if not speculative:
+            # a speculative duplicate is added capacity for the SAME
+            # attempt: it must not consume the poison-task budget, and the
+            # straggler clock keeps timing the original dispatch
+            entry.attempts += 1
+            entry.dispatched_at = time.monotonic()
         with self._cond:
             self.tasks_dispatched_total += 1
         try:
@@ -640,10 +772,22 @@ class WorkerPool:
             # handler already marked the entry lost and settled any charge
             # — charging after that point would leak ledger bytes
             if entry.status != "inflight" or w.sock is None:
+                if speculative:
+                    # the entry settled (or this worker died) before the
+                    # duplicate's frame ever left: unwind the reservation,
+                    # or the slot would wait forever for a reply that can
+                    # never come
+                    w.inflight.pop(entry.task_id, None)
+                    entry.active_wids.discard(w.wid)
+                    if entry.spec_wid == w.wid:
+                        entry.spec_wid = None
+                        self._spec_inflight -= 1
                 return
             sock = w.sock
             size = len(part_bytes)
-            if size:
+            if size and not entry.charged:
+                # charged once per entry, not per duplicate: the driver
+                # ships the same payload twice but holds it once
                 entry.charged = size
                 entry.ctx.ledger.dist_started(size)
         msg = {"type": "task", "task_id": entry.task_id, "op_key": op_key,
@@ -652,7 +796,7 @@ class WorkerPool:
             msg["op"] = op_bytes
         try:
             with w.send_lock:
-                send_msg(sock, msg)
+                send_msg(sock, msg, checksum=self._checksum)
             # insertion-ordered window, capped BELOW the worker's op cache
             # so a key we omit op bytes for is always still cached there
             w.ops_sent[op_key] = True
@@ -661,17 +805,66 @@ class WorkerPool:
         except Exception as e:
             self._on_worker_death(w, sock, f"task send failed: {e!r}")
 
-    def _wait(self, entry: _TaskEntry, ctx) -> None:
+    def _wait(self, entry: _TaskEntry, ctx, payload,
+              part_bytes: bytes) -> None:
         """Block until the entry is terminal, keeping the query's
-        cancellation/deadline semantics live while the work is remote."""
+        cancellation/deadline semantics live while the work is remote —
+        and watching for straggling: an entry past the speculation
+        threshold gets a duplicate dispatched to a different worker. A
+        query that dies here disowns the entry; a late result (or the
+        worker's death) settles it without a waiter, exactly once."""
         while not entry.event.wait(0.05):
-            try:
-                self._check_query(ctx)
-            except BaseException:
-                # the query is over: disown the entry so a late result (or
-                # the worker's death) settles it without a waiter — acked
-                # results are still recorded exactly once
-                raise
+            self._check_query(ctx)
+            self._maybe_speculate(entry, ctx, payload, part_bytes)
+
+    def _maybe_speculate(self, entry: _TaskEntry, ctx, payload,
+                         part_bytes: bytes) -> None:
+        """Speculative straggler mitigation: when this entry has been
+        running longer than ``speculation_quantile_factor`` x the op's
+        running p75 completed wall (floor ``speculation_min_s``), dispatch
+        a duplicate to a different idle worker. First result wins through
+        the exactly-once ack ledger, the loser is cancelled, and
+        pool-wide duplicates are bounded by ``speculation_max_inflight``
+        so a sick fleet cannot double its own load."""
+        # speculation knobs are PER-QUERY semantics: read the query's own
+        # config, not the pool's spawn-time snapshot
+        cfg = ctx.cfg
+        if not getattr(cfg, "speculative_execution", True):
+            return
+        with self._cond:
+            if (self._closed or entry.status != "inflight"
+                    or entry.spec_wid is not None):
+                return
+            hist = self._op_walls.get(entry.op_name)
+            if hist is None or len(hist) < _SPECULATION_MIN_SAMPLES:
+                return
+            walls = sorted(hist)
+            p75 = walls[min(len(walls) - 1, (3 * len(walls)) // 4)]
+            threshold = max(
+                float(getattr(cfg, "speculation_min_s", 1.0)),
+                float(getattr(cfg, "speculation_quantile_factor", 3.0))
+                * p75)
+            if time.monotonic() - entry.dispatched_at < threshold:
+                return
+            if self._spec_inflight >= max(
+                    0, int(getattr(cfg, "speculation_max_inflight", 2))):
+                return
+            cands = [w for w in self.workers
+                     if w.state == "ready" and not w.inflight
+                     and w.wid not in entry.active_wids
+                     and w.wid not in entry.excluded]
+            if not cands:
+                return
+            w = min(cands, key=lambda h: h.tasks_done)
+            entry.spec_wid = w.wid
+            entry.active_wids.add(w.wid)
+            w.inflight[entry.task_id] = entry
+            self._spec_inflight += 1
+            self.tasks_speculated_total += 1
+        ctx.stats.bump("tasks_speculated")
+        logger.warning("task_speculated", op=entry.op_name, seq=entry.seq,
+                       worker=w.wid, threshold_s=round(threshold, 3))
+        self._dispatch(entry, w, payload, part_bytes, speculative=True)
 
     # ------------------------------------------------------------ health
     def snapshot(self) -> dict:
@@ -709,6 +902,9 @@ class WorkerPool:
                 "tasks_completed_total": self.tasks_completed_total,
                 "task_redispatches_total": self.task_redispatches_total,
                 "worker_losses_total": self.worker_losses_total,
+                "tasks_speculated_total": self.tasks_speculated_total,
+                "speculation_wins_total": self.speculation_wins_total,
+                "speculation_inflight": self._spec_inflight,
                 "local_fallbacks_total": self.local_fallbacks_total,
                 "restarts_used": self.restarts_used,
                 "restart_budget": self.restart_budget,
@@ -746,6 +942,9 @@ class WorkerPool:
                 for e in list(w.inflight.values()):
                     if e.status == "inflight":
                         e.status = "lost"
+                        if e.spec_wid is not None:
+                            e.spec_wid = None
+                            self._spec_inflight -= 1
                         if e.charged:
                             e.ctx.ledger.dist_done(e.charged)
                             e.charged = 0
@@ -760,7 +959,8 @@ class WorkerPool:
             if sock is not None:
                 try:
                     with w.send_lock:
-                        send_msg(sock, {"type": "shutdown"})
+                        send_msg(sock, {"type": "shutdown"},
+                                 checksum=self._checksum)
                 except Exception:
                     pass
         for w in self.workers:
@@ -820,6 +1020,11 @@ def get_worker_pool(cfg) -> Optional[WorkerPool]:
         if pool is not None and not pool._closed and (
                 pool.n == cfg.distributed_workers
                 and pool.cfg.memory_budget_bytes == cfg.memory_budget_bytes):
+            # adopt the caller's config for the tunables that need no
+            # respawn (speculation knobs, driver-side frame checksums) —
+            # worker-resident settings keep their spawn-time values
+            pool.cfg = cfg
+            pool._checksum = bool(getattr(cfg, "partition_integrity", True))
             return pool
         if pool is not None:
             pool.shutdown()

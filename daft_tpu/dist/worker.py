@@ -7,10 +7,15 @@ tasks until told to stop:
 
 - a **reader thread** drains the socket: ``ping`` is answered immediately
   (a busy worker still heartbeats), ``task`` messages queue for the
-  executor loop, ``shutdown`` (or EOF) ends the process;
+  executor loop, ``cancel`` marks a queued task skippable (the losing
+  side of a speculative duplicate), ``shutdown`` (or EOF) ends the
+  process;
 - the **main loop** executes one task at a time — unpickle the map op
   (cached per op key), materialize/execute ``op.map_partition`` against a
-  local ExecutionContext, and ship the result (or the error) back.
+  local ExecutionContext, and ship the result (or the error) back. The
+  ``worker.task`` fault site fires per execution and is armable from the
+  parent's environment (``faults.ENV_FAULT_SPEC``), which is how chaos
+  tooling slows exactly one worker into a deterministic straggler.
 
 The worker never decides policy: retries, re-dispatch, deadlines, and
 poison detection all live driver-side in supervisor.py — a worker that
@@ -32,25 +37,38 @@ import time
 def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
     # late imports: the module must be importable for argv parsing before
     # the (expensive) engine import decides the process's fate
+    from .. import faults
     from ..context import get_context
     from ..obs.log import get_logger
-    from .transport import TransportClosed, recv_msg, send_msg
+    from .transport import _FLAG_CRC, PROTOCOL_VERSION, TransportClosed, \
+        recv_msg, send_msg
 
     log = get_logger("dist.worker")
     send_lock = threading.Lock()
+    # frame checksums MIRROR the driver's: every received frame's flag
+    # byte updates this, so a driver-side cfg.partition_integrity toggle
+    # flips both directions of traffic without a respawn. The hello
+    # itself is always checksummed (both sides speak v2 or the handshake
+    # rejects).
+    checksum = [True]
 
     def reply(msg: dict) -> None:
         with send_lock:
-            send_msg(sock, msg)
+            send_msg(sock, msg, checksum=checksum[0])
 
     reply({"type": "hello", "worker_id": worker_id, "pid": os.getpid(),
-           "token": token})
+           "token": token, "proto": PROTOCOL_VERSION})
     init = recv_msg(sock)
     if init.get("type") != "init":
         raise RuntimeError(f"expected init, got {init.get('type')!r}")
     cfg = init["cfg"]
+    checksum[0] = bool(getattr(cfg, "partition_integrity", True))
     ctx = get_context()
     ctx.execution_config = cfg
+    # fault plans armed by the PARENT process via the environment (chaos
+    # tooling's cross-process hook — e.g. a worker.task delay plan that
+    # slows exactly this worker into a straggler)
+    faults.arm_from_env(worker_id)
 
     from ..execution import ExecutionContext
 
@@ -58,6 +76,11 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
     tasks: "queue.Queue" = queue.Queue()
     inflight = [0]
     op_cache: dict = {}
+    # task ids cancelled by the driver (the losing side of a speculative
+    # duplicate): queued-but-unstarted tasks are skipped with an explicit
+    # task_skipped ack; a task already executing cannot be preempted —
+    # the driver discards its late result through the exactly-once ledger
+    cancelled: set = set()
 
     def ledger_report() -> dict:
         try:
@@ -72,7 +95,8 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
     def read_loop() -> None:
         try:
             while True:
-                msg = recv_msg(sock)
+                msg, flags = recv_msg(sock, with_flags=True)
+                checksum[0] = bool(flags & _FLAG_CRC)
                 kind = msg.get("type")
                 if kind == "ping":
                     reply({"type": "pong", "worker_id": worker_id,
@@ -81,6 +105,14 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                 elif kind == "task":
                     inflight[0] += 1
                     tasks.put(msg)
+                elif kind == "cancel":
+                    # ids never reuse, so stale entries are harmless —
+                    # but bound the set anyway (a cleared stale id at
+                    # worst skips a skip: the task runs and the driver
+                    # drops its result through the exactly-once ledger)
+                    if len(cancelled) > 4096:
+                        cancelled.clear()
+                    cancelled.add(msg.get("task_id"))
                 elif kind == "shutdown":
                     tasks.put(None)
                     return
@@ -99,6 +131,13 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
         if msg is None:
             break
         task_id = msg["task_id"]
+        if task_id in cancelled:
+            # speculative loser cancelled before this task ever started:
+            # ack the skip so the driver frees the slot deterministically
+            cancelled.discard(task_id)
+            inflight[0] -= 1
+            reply({"type": "task_skipped", "task_id": task_id})
+            continue
         try:
             op_key = msg["op_key"]
             if "op" in msg:
@@ -116,6 +155,10 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                 # reuse the bytes); decode here
                 part = pickle.loads(part)
             t0 = time.perf_counter_ns()
+            # the straggler/chaos hook: an armed delay plan slows this
+            # worker (counted into the reported wall), a failure plan
+            # becomes a task_error the driver's retry machinery owns
+            faults.check("worker.task")
             out = op.map_partition(part, exec_ctx)
             wall_ns = time.perf_counter_ns() - t0
             n = out.num_rows_or_none()
@@ -131,6 +174,9 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                    "error_message": str(e)[:2000]})
         finally:
             inflight[0] -= 1
+            # a cancel that raced an already-executing task left its id
+            # parked in the set; the id is spent now — drop it
+            cancelled.discard(task_id)
     return 0
 
 

@@ -57,6 +57,16 @@ class DaftTransientError(DaftError, IOError):
     treated as permanent and propagates immediately."""
 
 
+class DaftCorruptionError(DaftTransientError):
+    """A payload failed its end-to-end integrity check — a spill IPC file,
+    a transport frame, or an encoded exchange piece came back with bytes
+    that do not match the checksum recorded when the payload was produced
+    (or the artifact is missing/unparseable at re-entry). Raised INSTEAD of
+    surfacing a garbled table or a deep arrow decode error. Classified
+    transient: the lineage-recompute and task-retry/re-dispatch layers own
+    recovery, and only when both are exhausted does the query fail."""
+
+
 class DaftTimeoutError(DaftError, TimeoutError):
     """Query exceeded ExecutionConfig.execution_timeout_s. Carries the
     partial RuntimeStats snapshot accumulated before the deadline so

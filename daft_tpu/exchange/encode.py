@@ -47,11 +47,30 @@ class EncodedExchangeTask:
     reduce-merge or unspill); ``encoded_payload()`` is the spill writer's
     hook for writing the encoded representation to disk as-is."""
 
-    def __init__(self, atbl, schema, raw_bytes: int):
+    def __init__(self, atbl, schema, raw_bytes: int,
+                 crc: Optional[int] = None, stats=None):
         self._atbl = atbl
         self.schema = schema
         self.raw_bytes = raw_bytes
+        # end-to-end integrity: crc32 over the encoded table's buffer
+        # bytes, recorded at encode and re-verified at decode (None =
+        # checksums off). The spill round-trip is covered separately by
+        # the spill file's own checksum.
+        self.crc = crc
+        self._rt_stats = stats
         self.stats = None  # scan-task TableStats surface (none)
+
+    # encoded pieces cross process boundaries (dist transport, multihost
+    # transport-shuffle): the per-query RuntimeStats handle holds thread
+    # locks and must not ride along — the crc does, so the receiving
+    # process still verifies (only the counter bump is driver-local)
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_rt_stats"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
     # --- ScanTask metadata surface used by MicroPartition ----------------
     @property
@@ -65,12 +84,26 @@ class EncodedExchangeTask:
         return self._atbl.nbytes
 
     def read(self):
-        """Decode back to an engine Table with the exact original dtypes."""
+        """Decode back to an engine Table with the exact original dtypes
+        (verifying the encode-time checksum first, so a damaged payload
+        raises DaftCorruptionError instead of decoding garbage)."""
         import pyarrow as pa
 
         from ..series import Series
         from ..table import Table
 
+        if self.crc is not None:
+            from ..errors import DaftCorruptionError
+            from ..integrity.checksum import crc32_table
+
+            got = crc32_table(self._atbl)
+            if got != self.crc:
+                if self._rt_stats is not None:
+                    self._rt_stats.bump("corruption_detected")
+                raise DaftCorruptionError(
+                    f"encoded exchange piece failed its integrity check "
+                    f"(crc {got:#010x} != {self.crc:#010x}, "
+                    f"rows={self._atbl.num_rows})")
         cols = []
         for f, name in zip(self.schema, self._atbl.column_names):
             arr = self._atbl.column(name)
@@ -131,8 +164,9 @@ def _encode_column(arr):
     return enc
 
 
-def encode_exchange_partition(part: MicroPartition,
-                              stats=None) -> Optional[MicroPartition]:
+def encode_exchange_partition(part: MicroPartition, stats=None,
+                              integrity: bool = True
+                              ) -> Optional[MicroPartition]:
     """Encode one fanout piece; returns the encoded (unloaded, lazily
     decoding) MicroPartition, or None when the piece is too small, has no
     winning column, or holds python-typed data. Raises only for the
@@ -168,7 +202,15 @@ def encode_exchange_partition(part: MicroPartition,
     raw = tbl.size_bytes()
     if atbl.nbytes >= raw:
         return None
-    task = EncodedExchangeTask(atbl, part.schema, raw)
+    crc = None
+    if integrity:
+        from ..integrity.checksum import crc32_table
+
+        crc = crc32_table(atbl)
+    task = EncodedExchangeTask(atbl, part.schema, raw, crc=crc, stats=stats)
     out = MicroPartition.from_scan_task(task)
     out.owner_process = part.owner_process
+    # the encoded piece decodes to exactly the raw piece, so the raw
+    # piece's lineage recipe (if any) re-derives this one too
+    out.lineage_recipe = part.lineage_recipe
     return out

@@ -436,6 +436,7 @@ class ExecutionContext:
         # pool() below
         self._pool_finished = False
         self._spill_scope = None
+        self._lineage = None
         self._buffers: List = []
         self._accountant: Optional[ResourceAccountant] = None
         # live streaming segments (stream/pipeline.py): each registers its
@@ -476,6 +477,21 @@ class ExecutionContext:
             self._spill_scope = SpillScope()
         return self._spill_scope
 
+    @property
+    def lineage(self):
+        """This query's bounded LineageLog (integrity/lineage.py), or None
+        when lineage recomputation is off. Spilled partitions record how
+        they were produced here so a corrupted/missing spill artifact
+        recomputes instead of failing the query."""
+        if not getattr(self.cfg, "lineage_recomputation", True):
+            return None
+        if self._lineage is None:
+            from .integrity.lineage import LineageLog
+
+            self._lineage = LineageLog(
+                getattr(self.cfg, "lineage_log_depth", 4096))
+        return self._lineage
+
     def partition_buffer(self):
         """A spillable PartitionBuffer bound to this query's budget, stats,
         and spill directory. Tracked so abandoned queries (limit early-stop,
@@ -492,7 +508,9 @@ class ExecutionContext:
             async_spill=self.cfg.async_spill_writes,
             readahead=(self._bg_submit if self.cfg.unspill_readahead
                        else None),
-            ledger=self.ledger)
+            ledger=self.ledger,
+            integrity=getattr(self.cfg, "partition_integrity", True),
+            lineage=self.lineage)
         self._buffers.append(buf)
         return buf
 

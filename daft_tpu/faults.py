@@ -79,6 +79,22 @@ SITES = {
                       "transport (daft_tpu/dist/transport.py; a failed "
                       "send marks the connection dead and the supervision "
                       "layer re-dispatches)",
+    "spill.corrupt": "each landed spill IPC write (sync or async writer "
+                     "thread, daft_tpu/spill.py; an injected fault FLIPS "
+                     "A REAL BIT in the written file AFTER its checksum "
+                     "was recorded — the deterministic disk-corruption "
+                     "hook behind detection + lineage recompute)",
+    "transport.corrupt": "each checksummed transport frame send "
+                         "(daft_tpu/dist/transport.py; an injected fault "
+                         "flips a real bit in the payload AFTER its crc "
+                         "was computed — the receiver's verify raises "
+                         "DaftCorruptionError and the supervision layer "
+                         "re-dispatches)",
+    "worker.task": "each task execution on a distributed worker "
+                   "(daft_tpu/dist/worker.py; armable per worker via "
+                   "DAFT_TPU_DIST_FAULT_SPEC — a delay_s plan SLOWS the "
+                   "worker instead of failing it, the deterministic "
+                   "straggler hook behind speculative execution)",
 }
 
 
@@ -100,11 +116,11 @@ class FaultPlan:
                          by sha256(seed, site, call#) — deterministic
     """
 
-    __slots__ = ("mode", "n", "rate", "seed", "exc", "message")
+    __slots__ = ("mode", "n", "rate", "seed", "exc", "message", "delay_s")
 
     def __init__(self, mode: str = "always", n: int = 1, rate: float = 0.0,
                  seed: int = 0, exc: type = InjectedFault,
-                 message: str = ""):
+                 message: str = "", delay_s: float = 0.0):
         if mode not in ("always", "first_n", "nth", "rate"):
             # a misconfigured plan is a caller bug, never a retryable fault
             raise DaftValueError(f"unknown fault mode {mode!r}")
@@ -114,6 +130,11 @@ class FaultPlan:
         self.seed = seed
         self.exc = exc
         self.message = message
+        # delay plans SLOW the site instead of failing it (the straggler
+        # hook): a firing call sleeps delay_s and returns — the one
+        # deliberate wall-clock dependency in this module, because a
+        # straggler IS a wall-clock phenomenon
+        self.delay_s = float(delay_s)
 
     def should_fire(self, site: str, call_no: int) -> bool:
         """call_no is 1-based: the first check() at an armed site is #1."""
@@ -202,7 +223,57 @@ def check(site: str, stats=None) -> None:
     from . import tracing
 
     tracing.add_instant(f"fault:{site}", {"call": call_no})
+    if plan.delay_s > 0:
+        # straggler plan: the site is slowed, not failed
+        import time
+
+        time.sleep(plan.delay_s)
+        return
     raise plan.exc(plan.message or f"injected fault at {site} (call #{call_no})")
+
+
+# env var a parent process sets BEFORE spawning workers so fault plans
+# cross the process boundary (module-global plans do not): a JSON object
+# (or list of objects) with site/mode/n/rate/seed/delay_s and an optional
+# worker_id that scopes the plan to one worker slot — how the chaos/bench
+# tooling slows exactly one worker into a straggler
+ENV_FAULT_SPEC = "DAFT_TPU_DIST_FAULT_SPEC"
+
+
+def arm_from_env(worker_id: Optional[int] = None) -> int:
+    """Arm plans from :data:`ENV_FAULT_SPEC` (called by the distributed
+    worker entrypoint at startup). Returns how many plans were armed; a
+    malformed spec arms nothing — chaos tooling must never be able to
+    turn a worker into a startup crash."""
+    import json
+    import os
+
+    raw = os.environ.get(ENV_FAULT_SPEC)
+    if not raw:
+        return 0
+    try:
+        specs = json.loads(raw)
+    except ValueError:
+        return 0
+    if isinstance(specs, dict):
+        specs = [specs]
+    armed = 0
+    for spec in specs:
+        if not isinstance(spec, dict) or "site" not in spec:
+            continue
+        target = spec.get("worker_id")
+        if target is not None and worker_id is not None \
+                and int(target) != int(worker_id):
+            continue
+        try:
+            arm(spec["site"], spec.get("mode", "always"),
+                n=int(spec.get("n", 1)), rate=float(spec.get("rate", 0.0)),
+                seed=int(spec.get("seed", 0)),
+                delay_s=float(spec.get("delay_s", 0.0)))
+            armed += 1
+        except Exception:
+            continue
+    return armed
 
 
 def snapshot() -> dict:

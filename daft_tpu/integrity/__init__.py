@@ -1,0 +1,16 @@
+"""End-to-end partition integrity (README "Data integrity & speculation").
+
+Payloads that leave compute and come back — spill IPC files, transport
+frames, encoded exchange pieces — carry a fast checksum computed when the
+payload is produced and verified when it re-enters compute
+(:mod:`.checksum`), so silent corruption surfaces as a typed
+``DaftCorruptionError`` instead of a garbled table. A bounded per-query
+:class:`.lineage.LineageLog` records how spilled partitions were produced
+so a corrupted (or missing) artifact is recomputed from its source
+instead of failing the query."""
+
+from .checksum import crc32_bytes, crc32_file, crc32_table, flip_file_bits
+from .lineage import LineageLog
+
+__all__ = ["crc32_bytes", "crc32_file", "crc32_table", "flip_file_bits",
+           "LineageLog"]

@@ -22,7 +22,7 @@ from .table import Table
 class MicroPartition:
     __slots__ = ("schema", "_state", "_tables", "_scan_task", "_stats", "_lock",
                  "_device_cache", "owner_process", "_pending",
-                 "_count_preserving")
+                 "_count_preserving", "lineage_recipe")
 
     def __init__(self, schema: Schema, tables: Optional[List[Table]] = None,
                  scan_task=None, stats: Optional[TableStats] = None):
@@ -50,6 +50,13 @@ class MicroPartition:
         self.owner_process: Optional[int] = None
         self._pending: Optional[List[Any]] = None
         self._count_preserving = True
+        # lineage recipe (integrity/lineage.py): a zero-arg closure that
+        # re-derives this partition's exact tables from stable storage.
+        # Attached by producers whose derivation is cheap to replay (e.g.
+        # shuffle fanout over a scan-backed source); consumed by the spill
+        # layer so a corrupted spill file recomputes instead of failing
+        # the query. Never pickled (closures are driver-local).
+        self.lineage_recipe = None
 
     def device_stage_cache(self) -> Dict[Any, Any]:
         return self._device_cache
@@ -90,6 +97,7 @@ class MicroPartition:
         self.owner_process = state.get("owner")
         self._pending = None  # daftlint: disable=DTL002
         self._count_preserving = True
+        self.lineage_recipe = None
 
     def with_pending_op(self, fn, schema: Schema,
                         count_preserving: bool) -> "MicroPartition":

@@ -47,6 +47,8 @@ _CLUSTER_IDLE = {"workers": 0, "workers_alive": 0, "workers_restarting": 0,
                  "workers_tripped": 0, "tasks_inflight": 0,
                  "tasks_dispatched_total": 0, "tasks_completed_total": 0,
                  "task_redispatches_total": 0, "worker_losses_total": 0,
+                 "tasks_speculated_total": 0, "speculation_wins_total": 0,
+                 "speculation_inflight": 0,
                  "local_fallbacks_total": 0, "restarts_used": 0,
                  "restart_budget": 0, "restart_budget_remaining": 0,
                  "degraded": False, "worker_detail": {}}
@@ -214,6 +216,9 @@ def refresh_health_gauges(registry=None) -> None:
         reg.gauge("daft_tpu_memory_ledger_exec_inflight_bytes",
                   "materialized task outputs parked in the dispatch "
                   "window").set(led.get("exec_inflight", 0))
+        reg.gauge("daft_tpu_spill_disk_full_events",
+                  "ENOSPC spill writes degraded to hold-in-memory").set(
+            led.get("disk_full_events", 0))
     for kind, st in breaker_states().items():
         reg.gauge(f"daft_tpu_{kind}_breaker_state",
                   "circuit breaker: 0 closed, 1 half-open, 2 open").set(
@@ -267,6 +272,12 @@ def refresh_health_gauges(registry=None) -> None:
     reg.gauge("daft_tpu_cluster_restart_budget_remaining",
               "worker respawns the pool may still spend").set(
         clu["restart_budget_remaining"])
+    reg.gauge("daft_tpu_cluster_tasks_speculated_total",
+              "straggler tasks that got a speculative duplicate").set(
+        clu.get("tasks_speculated_total", 0))
+    reg.gauge("daft_tpu_cluster_speculation_wins_total",
+              "speculative duplicates that beat the original").set(
+        clu.get("speculation_wins_total", 0))
     adm = admission_state()
     reg.gauge("daft_tpu_admission_active_queries",
               "queries holding an execution slot").set(
@@ -338,6 +349,7 @@ def validate_health(d: dict) -> List[str]:
     for k in ("workers", "workers_alive", "workers_restarting",
               "workers_tripped", "tasks_inflight",
               "task_redispatches_total", "worker_losses_total",
+              "tasks_speculated_total", "speculation_wins_total",
               "restarts_used", "restart_budget",
               "restart_budget_remaining"):
         if not isinstance(d["cluster"].get(k), int):

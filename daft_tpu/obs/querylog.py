@@ -50,6 +50,8 @@ _EVENT_COUNTERS = (
     "prefetch_throttled", "preload_throttled", "spill_write_failures",
     "task_retries", "dispatch_backpressure_stalls",
     "task_redispatches", "worker_losses", "dist_local_fallbacks",
+    "corruption_detected", "partitions_recomputed", "lineage_truncated",
+    "spill_disk_full", "tasks_speculated", "speculation_wins",
 )
 
 
@@ -154,7 +156,8 @@ def build_record(query_id: str, fingerprint: str, plan_ops: Dict[str, int],
         ledger = {k: led[k] for k in (
             "current", "high_water", "spilled_bytes", "spilled_partitions",
             "prefetch_inflight", "async_spill_inflight", "stream_inflight",
-            "exec_inflight", "dist_inflight", "negative_releases")}
+            "exec_inflight", "dist_inflight", "negative_releases",
+            "disk_full_events")}
     except Exception:
         ledger = {}
     events = {k: counters[k] for k in _EVENT_COUNTERS if counters.get(k)}

@@ -654,7 +654,10 @@ class ShuffleOp(PhysicalOp):
                 try:
                     from .exchange.encode import encode_exchange_partition
 
-                    enc = encode_exchange_partition(piece, ctx.stats)
+                    enc = encode_exchange_partition(
+                        piece, ctx.stats,
+                        integrity=getattr(ctx.cfg, "partition_integrity",
+                                          True))
                 except Exception:
                     enc = None
                     ctx.stats.bump("exchange_encode_failures")
@@ -672,6 +675,7 @@ class ShuffleOp(PhysicalOp):
             buckets[i].append(piece)
 
         saw = False
+        lineage_on = getattr(ctx.cfg, "lineage_recomputation", True)
         # the whole map-side fanout (decode + hash/split + bucket appends)
         # runs inside the FIRST pull of this op: make it a named phase on
         # the span timeline so the exchange's two halves are separable
@@ -684,10 +688,20 @@ class ShuffleOp(PhysicalOp):
                 # inputs are resident once at a time.
                 in_buf = ctx.partition_buffer()
                 samples = []
+                src_tasks = []
                 for p in stream:
                     if pre_boundaries is None:
                         samples.append(sample_partition_keys(
                             p, self.by, n, ctx.cfg.sample_size_for_sort))
+                    if lineage_on:
+                        # scan-backed sources make every range piece
+                        # recomputable (integrity/lineage.py): capture the
+                        # task BEFORE the buffer/fanout materializes p
+                        from .integrity.lineage import unwrap_source_task
+
+                        src_tasks.append(unwrap_source_task(p))
+                    else:
+                        src_tasks.append(None)
                     in_buf.append(p)
                 saw = len(in_buf) > 0
                 if not saw:
@@ -697,17 +711,45 @@ class ShuffleOp(PhysicalOp):
                 else:
                     boundaries = boundaries_from_samples(
                         samples, self.by, n, self.descending, self.nulls_first)
-                for p in in_buf.drain():
-                    for i, piece in enumerate(
-                            p.partition_by_range(self.by, boundaries,
-                                                 self.descending,
-                                                 self.nulls_first)):
+                for pi, p in enumerate(in_buf.drain()):
+                    pieces = p.partition_by_range(self.by, boundaries,
+                                                  self.descending,
+                                                  self.nulls_first)
+                    for i, piece in enumerate(pieces):
+                        if src_tasks[pi] is not None:
+                            from .integrity.lineage import \
+                                range_piece_recipe
+
+                            piece.lineage_recipe = range_piece_recipe(
+                                src_tasks[pi], self.by, boundaries,
+                                self.descending, self.nulls_first, i)
                         exchange_append(min(i, n - 1), piece)
             else:
                 def fanout(p, pi):
+                    # lineage (integrity/lineage.py): when the SOURCE
+                    # partition is scan-backed, every piece of this
+                    # deterministic split can be recomputed by re-reading
+                    # the source — capture the recipe BEFORE the split
+                    # materializes p, so a piece spilled later survives a
+                    # corrupted/missing spill file. Loaded/pruned sources
+                    # decline (capturing them would pin memory): their
+                    # pieces carry truncated lineage by design.
+                    src_task = None
+                    if lineage_on:
+                        from .integrity.lineage import unwrap_source_task
+
+                        src_task = unwrap_source_task(p)
                     if self.scheme == "hash":
-                        return p.partition_by_hash(self.by, n)
-                    return p.partition_by_random(n, seed=pi)
+                        pieces = p.partition_by_hash(self.by, n)
+                    else:
+                        pieces = p.partition_by_random(n, seed=pi)
+                    if src_task is not None:
+                        from .integrity.lineage import fanout_piece_recipe
+
+                        for i, piece in enumerate(pieces):
+                            piece.lineage_recipe = fanout_piece_recipe(
+                                src_task, self.by, self.scheme, n, pi, i)
+                    return pieces
 
                 for pieces in _fanout_stream(stream, fanout, ctx,
                                              _subtree_may_yield_unloaded(self)):

@@ -19,6 +19,31 @@ from .datatypes import DataType, TypeKind, infer_datatype, try_unify
 from .kernels.host_hash import hash_array
 
 
+def _stable_value_bytes(v) -> bytes:
+    """Cross-process-stable byte representation of a python value for
+    hashing. A plain pickle is NOT enough: set/frozenset iteration order
+    follows per-process-randomized string hashing, and two ==-equal dicts
+    can differ in insertion order — either would bucket the same value
+    differently on different worker processes. Containers canonicalize
+    recursively (sets/dict items sorted by their own stable bytes);
+    opaque leaves pickle at a FIXED protocol so driver and workers agree
+    regardless of interpreter defaults. Raises for unpicklable leaves
+    (the caller maps that to DaftValueError)."""
+    import pickle
+
+    if isinstance(v, (set, frozenset)):
+        return b"S(" + b",".join(
+            sorted(_stable_value_bytes(x) for x in v)) + b")"
+    if isinstance(v, dict):
+        items = sorted((_stable_value_bytes(k), _stable_value_bytes(x))
+                       for k, x in v.items())
+        return b"D(" + b",".join(k + b":" + x for k, x in items) + b")"
+    if isinstance(v, (list, tuple)):
+        tag = b"L(" if isinstance(v, list) else b"T("
+        return tag + b",".join(_stable_value_bytes(x) for x in v) + b")"
+    return pickle.dumps(v, protocol=4)
+
+
 class Series:
     __slots__ = ("_name", "_dtype", "_arrow", "_pyobjs")
 
@@ -538,8 +563,29 @@ class Series:
         if seed is not None:
             seeds = np.asarray(seed.cast(DataType.uint64())._arrow).astype(np.uint64)
         if self._arrow is None:
+            # python-object columns hash STABLE bytes of the value, never
+            # its repr: the default object.__repr__ embeds the memory
+            # address, so the same value would bucket differently on
+            # different worker processes and a distributed shuffle keyed on
+            # such a column would silently mispartition.
             import zlib
-            vals = [zlib.crc32(repr(v).encode()) if v is not None else None for v in self._pyobjs]
+
+            from .errors import DaftValueError
+
+            vals = []
+            for v in self._pyobjs:
+                if v is None:
+                    vals.append(None)
+                    continue
+                try:
+                    buf = _stable_value_bytes(v)
+                except Exception as e:
+                    raise DaftValueError(
+                        f"cannot hash unpicklable python object of type "
+                        f"{type(v).__name__} in column {self._name!r}: a "
+                        "cross-process-stable hash needs a stable byte "
+                        f"representation ({e})") from e
+                vals.append(zlib.crc32(buf))
             return Series.from_pylist(vals, self._name, DataType.uint64())
         h = hash_array(self._arrow, seed=seeds)
         return Series.from_arrow(pa.array(h), self._name, DataType.uint64())

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from .errors import DaftTransientError
 from .micropartition import MicroPartition
 from .obs.log import current_query_id, get_logger, query_context
 
@@ -130,6 +131,17 @@ class MemoryLedger:
         self.spill_write_ns = 0
         self.unspill_bytes = 0
         self.unspill_ns = 0
+        # ENOSPC spill writes classified as a full disk (permanent
+        # DaftIOError class, degraded to hold-in-memory): the health/
+        # metrics flag operators alert on — a full spill device turns a
+        # bounded-memory engine back into an in-memory one
+        self.disk_full_events = 0
+
+    def disk_full(self) -> None:
+        with self._lock:
+            self.disk_full_events += 1
+        if self._parent is not None:
+            self._parent.disk_full()
 
     def _note_working_set_locked(self) -> None:
         # runs under self._lock (every caller holds it); the lock-discipline
@@ -308,6 +320,7 @@ class MemoryLedger:
             self.spill_write_ns = 0
             self.unspill_bytes = 0
             self.unspill_ns = 0
+            self.disk_full_events = 0
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -330,6 +343,7 @@ class MemoryLedger:
                 "spill_write_ns": self.spill_write_ns,
                 "unspill_bytes": self.unspill_bytes,
                 "unspill_ns": self.unspill_ns,
+                "disk_full_events": self.disk_full_events,
             }
 
 
@@ -512,7 +526,9 @@ class _SpillSlotTask:
     spill budget is never silently defeated by a hidden strong cache)."""
 
     def __init__(self, path: str, schema, num_rows: int, size_bytes: int,
-                 scope: SpillScope, rt_stats=None, ledger=None):
+                 scope: SpillScope, rt_stats=None, ledger=None,
+                 expected_crc: Optional[int] = None,
+                 lineage=None, lineage_key=None):
         self.path = path
         self.schema = schema
         self.num_rows_exact = num_rows
@@ -531,6 +547,14 @@ class _SpillSlotTask:
         # would mean the free-list violated the GC-recycle invariant)
         self._slot_gen: int = scope.generation(path)
         self._read_lock = threading.Lock()
+        # end-to-end integrity: crc32 of the file bytes as written (None =
+        # checksums off); the read-back verifies before parsing, so a
+        # rotted file raises DaftCorruptionError, never a garbled table
+        self.expected_crc = expected_crc
+        # lineage recovery handle: (LineageLog, recipe key) — a corrupted
+        # or missing file recomputes through the recipe instead of failing
+        self._lineage = lineage
+        self._lineage_key = lineage_key
 
     # --- ScanTask metadata surface used by MicroPartition ----------------
     @property
@@ -562,12 +586,12 @@ class _SpillSlotTask:
             return tbl
 
     def _materialize_locked(self):
-        """File read-back, called under the read lock."""
-        import pyarrow as pa
-
-        from .io.readers import IO_STATS
-        from .table import Table
-
+        """File read-back (integrity-verified), called under the read
+        lock. A corrupted, garbled, or missing file raises
+        DaftCorruptionError — unless the lineage log still holds this
+        partition's recipe, in which case the partition is RECOMPUTED
+        from its source and served (``partitions_recomputed``) and the
+        query never sees the damage."""
         # invariant: this task is alive (we are in its method), so its
         # slot has NOT been recycled — recycling happens only at task
         # GC (weakref.finalize in _try_spill). A generation mismatch
@@ -580,9 +604,51 @@ class _SpillSlotTask:
             raise DaftInternalError(
                 f"spill slot {self.path} was re-taken while a live "
                 "reference could still read it; this is an engine bug")
+        from .errors import DaftCorruptionError
+
+        try:
+            return self._read_file_locked()
+        except DaftCorruptionError as e:
+            tbl = self._recompute_locked(e)
+            if tbl is not None:
+                return tbl
+            raise
+
+    def _read_file_locked(self):
+        """Verify + parse the spill file; every damage mode — checksum
+        mismatch, truncated/garbled IPC stream, missing file — surfaces
+        as DaftCorruptionError, never a deep arrow error."""
+        import pyarrow as pa
+
+        from .errors import DaftCorruptionError
+        from .io.readers import IO_STATS
+        from .table import Table
+
         t0 = time.perf_counter_ns()
-        with pa.OSFile(self.path) as f:
-            arrow_tbl = pa.ipc.open_file(f).read_all()
+        try:
+            if self.expected_crc is not None:
+                from .integrity.checksum import crc32_file
+
+                got = crc32_file(self.path)
+                if got != self.expected_crc:
+                    if self._rt_stats is not None:
+                        self._rt_stats.bump("corruption_detected")
+                    raise DaftCorruptionError(
+                        f"spill file {self.path} failed its integrity "
+                        f"check (crc {got:#010x} != "
+                        f"{self.expected_crc:#010x})")
+            with pa.OSFile(self.path) as f:
+                arrow_tbl = pa.ipc.open_file(f).read_all()
+        except DaftCorruptionError:
+            raise
+        except FileNotFoundError as e:
+            raise DaftCorruptionError(
+                f"spill file {self.path} missing at unspill: {e!r}") from e
+        except Exception as e:
+            if self._rt_stats is not None:
+                self._rt_stats.bump("corruption_detected")
+            raise DaftCorruptionError(
+                f"spill file {self.path} unreadable: {e!r}") from e
         dt = time.perf_counter_ns() - t0
         self._ledger.record_unspill(self.size_bytes_exact, dt)
         if self._rt_stats is not None:
@@ -600,6 +666,34 @@ class _SpillSlotTask:
                       rows_read=arrow_tbl.num_rows,
                       columns_read=arrow_tbl.num_columns)
         return Table.from_arrow(arrow_tbl)
+
+    def _recompute_locked(self, cause):
+        """Lineage recovery: re-derive the partition through its recorded
+        recipe. Returns the recomputed Table, or None when lineage is
+        truncated (no/evicted recipe) or the recompute itself failed —
+        the caller then raises the original corruption."""
+        log = self._lineage
+        recipe = log.get(self._lineage_key) if log is not None else None
+        if recipe is None:
+            if self._rt_stats is not None:
+                self._rt_stats.bump("lineage_truncated")
+            logger.warning("spill_lineage_truncated", path=self.path,
+                           cause=repr(cause))
+            return None
+        try:
+            tbl = _concat_chunk_tables(recipe())
+        except Exception as e:
+            logger.warning("lineage_recompute_failed", path=self.path,
+                           error=repr(e), cause=repr(cause))
+            return None
+        if self._rt_stats is not None:
+            self._rt_stats.bump("partitions_recomputed")
+            if self._rt_stats.profiler.armed:
+                self._rt_stats.profiler.event(
+                    "partition_recomputed", path=self.path, rows=len(tbl))
+        logger.warning("partition_recomputed", path=self.path,
+                       rows=len(tbl), cause=repr(cause))
+        return tbl
 
     # head() on an unloaded partition narrows the task's limit; spill tasks
     # support that surface by applying the pushdowns to the one read
@@ -627,9 +721,10 @@ class _AsyncSpillSlotTask(_SpillSlotTask):
 
     def __init__(self, path: str, schema, num_rows: int, size_bytes: int,
                  scope: SpillScope, tables, rt_stats=None, ledger=None,
-                 reader=None):
+                 reader=None, lineage=None, lineage_key=None):
         super().__init__(path, schema, num_rows, size_bytes, scope,
-                         rt_stats=rt_stats, ledger=ledger)
+                         rt_stats=rt_stats, ledger=ledger,
+                         lineage=lineage, lineage_key=lineage_key)
         # reader: pre-landing reads route through it instead of the tables
         # (encoded exchange payloads — `tables` then holds arrow tables the
         # engine-side concat below cannot serve, but the reader decodes)
@@ -642,12 +737,13 @@ class _AsyncSpillSlotTask(_SpillSlotTask):
         # shared with the finalizer so the charge settles exactly once
         self._held_cell = {"bytes": 0}
 
-    def _write_done(self, file_bytes: int) -> None:
+    def _write_done(self, file_bytes: int, crc: Optional[int] = None) -> None:
         with self._read_lock:
             self._tables = None
             self._reader = None
             self._enc_tables = None
             self.size_bytes_exact = file_bytes
+            self.expected_crc = crc
 
     def _write_failed(self, size: int) -> None:
         with self._read_lock:
@@ -661,31 +757,32 @@ class _AsyncSpillSlotTask(_SpillSlotTask):
                 self._rt_stats.bump("spill_mem_reads")
             return self._reader()
         if self._tables is not None:
-            from .table import Table
-
             if self._rt_stats is not None:
                 self._rt_stats.bump("spill_mem_reads")
-            tbls = self._tables
-            if len(tbls) == 1:
-                return tbls[0]
-            # mirror the IPC writer's chunk handling (every batch cast to
-            # the first chunk's schema) so a memory-served read is
-            # byte-identical to the file round-trip
-            s0 = tbls[0].schema
-            tbls = [t if t.schema == s0 else t.cast_to_schema(s0)
-                    for t in tbls]
-            return Table.concat(tbls)
+            return _concat_chunk_tables(self._tables)
         return super()._materialize_locked()
 
     def __repr__(self) -> str:
         return f"_AsyncSpillSlotTask({self.path}, rows={self.num_rows_exact})"
 
 
-def _settle_async_slot(scope: SpillScope, path: str, held_cell: dict,
-                       ledger=None) -> None:
-    """Finalizer for async spill tasks: recycle the slot and return any
-    hold-in-memory bytes a failed write left charged."""
+def _settle_sync_slot(scope: SpillScope, path: str, lineage,
+                      lineage_key) -> None:
+    """Finalizer for sync spill tasks: recycle the slot and drop the
+    lineage recipe (an unreachable slot can never need recomputing)."""
     scope.recycle(path)
+    if lineage is not None:
+        lineage.forget(lineage_key)
+
+
+def _settle_async_slot(scope: SpillScope, path: str, held_cell: dict,
+                       ledger=None, lineage=None, lineage_key=None) -> None:
+    """Finalizer for async spill tasks: recycle the slot, drop the lineage
+    recipe, and return any hold-in-memory bytes a failed write left
+    charged."""
+    scope.recycle(path)
+    if lineage is not None:
+        lineage.forget(lineage_key)
     held = held_cell.get("bytes", 0)
     if held:
         held_cell["bytes"] = 0
@@ -741,6 +838,52 @@ class _SpillSlotView:
         return tbl
 
 
+def _concat_chunk_tables(tbls):
+    """Chunk list -> ONE Table, mirroring the IPC writer's chunk handling
+    (every batch cast to the first chunk's schema) so memory-served and
+    lineage-recomputed reads are byte-identical to the file round-trip."""
+    from .table import Table
+
+    if len(tbls) == 1:
+        return tbls[0]
+    s0 = tbls[0].schema
+    tbls = [t if t.schema == s0 else t.cast_to_schema(s0) for t in tbls]
+    return Table.concat(tbls)
+
+
+def _is_disk_full(e: BaseException) -> bool:
+    import errno
+
+    return isinstance(e, OSError) and e.errno == errno.ENOSPC
+
+
+def _classify_spill_failure(e: BaseException, path: str, mode: str,
+                            ledger: "MemoryLedger", stats) -> None:
+    """Shared failure classification for sync/async spill writes. A full
+    disk is a PERMANENT condition (errors.DaftIOError class) distinct
+    from a flaky write: it gets its own counter/health flag, and the
+    partial file is removed so a later unspill can never read a
+    truncated IPC stream off a recycled slot."""
+    if _is_disk_full(e):
+        from .errors import DaftIOError
+
+        ledger.disk_full()
+        if stats is not None:
+            stats.bump("spill_disk_full")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        logger.warning("spill_disk_full", mode=mode, path=path,
+                       error=repr(DaftIOError(
+                           f"spill device full (ENOSPC): {e}")))
+    else:
+        logger.warning("spill_write_failed", mode=mode, path=path,
+                       error=repr(e))
+    if stats is not None:
+        stats.bump("spill_write_failures")
+
+
 def _write_spill_ipc(path: str, tbls) -> int:
     """Arrow-IPC spill write (codec per _SPILL_CODEC): parquet spills paid a
     full encode+decode round-trip per partition; IPC writes land in the
@@ -783,7 +926,8 @@ class PartitionBuffer:
                  scope: Optional[SpillScope] = None,
                  async_spill: bool = False,
                  readahead: Optional[Callable] = None,
-                 ledger: Optional[MemoryLedger] = None):
+                 ledger: Optional[MemoryLedger] = None,
+                 integrity: bool = False, lineage=None):
         self.budget = budget_bytes
         self.stats = stats
         self.scope = scope or SpillScope()
@@ -792,6 +936,14 @@ class PartitionBuffer:
         # serving runtime): budget decisions read THIS balance, so one
         # query's spill pressure never charges another's headroom
         self.ledger = ledger if ledger is not None else MEMORY_LEDGER
+        # end-to-end integrity (cfg.partition_integrity): spill writes
+        # record a crc32 of the landed file and read-backs verify it;
+        # `lineage` (a LineageLog, cfg.lineage_recomputation) records how
+        # spilled partitions were produced so corruption recomputes
+        # instead of failing. Both default OFF for directly-constructed
+        # buffers — the ExecutionContext wires them from the config.
+        self.integrity = integrity
+        self.lineage = lineage
         self._readahead = readahead
         self._items: List[Optional[MicroPartition]] = []
         self._held: List[int] = []
@@ -831,10 +983,31 @@ class PartitionBuffer:
             path = os.path.join(self.scope.dir(), f"spill_{seq}.arrow")
         return path
 
+    def _lineage_key_for(self, part: MicroPartition):
+        """Record this partition's recompute recipe (if it has one) in the
+        query's bounded LineageLog; returns the recipe key or None
+        (truncated lineage — corruption will degrade, not recompute)."""
+        if self.lineage is None:
+            return None
+        recipe = getattr(part, "lineage_recipe", None)
+        if recipe is None:
+            # a partition that IS a re-readable scan task's output:
+            # the source file is the recipe
+            from .integrity.lineage import task_recipe, unwrap_source_task
+
+            src = unwrap_source_task(part)
+            if src is None:
+                return None
+            recipe = task_recipe(src)
+        return self.lineage.record(recipe)
+
     def _try_spill(self, part: MicroPartition, size: int) -> Optional[MicroPartition]:
         import weakref
 
         path = self._take_path()
+        # capture lineage BEFORE materialization: the recipe check reads
+        # the partition's pre-spill lazy state
+        lineage_key = self._lineage_key_for(part)
         task0 = part.scan_task()
         enc = (getattr(task0, "encoded_payload", None)
                if task0 is not None else None)
@@ -856,7 +1029,8 @@ class PartitionBuffer:
             nrows = sum(len(t) for t in tbls)
             reader = None
         if self.async_spill:
-            out = self._spill_async(path, tbls, size, schema, nrows, reader)
+            out = self._spill_async(path, tbls, size, schema, nrows, reader,
+                                    lineage_key)
             if out is not None:
                 return out
             # writer unavailable (closed scope): fall through to sync
@@ -868,16 +1042,31 @@ class PartitionBuffer:
             file_bytes = _write_spill_ipc(path, tbls)
             dt = time.perf_counter_ns() - t0
         except Exception as e:
-            # python-object columns have no arrow representation — and a
-            # full/failing spill disk looks the same: hold in memory rather
-            # than fail the query; the slot (with whatever partial bytes)
-            # goes back on the free-list for the next spill to overwrite
-            logger.warning("spill_write_failed", mode="sync", path=path,
-                           error=repr(e))
-            if self.stats is not None:
-                self.stats.bump("spill_write_failures")
+            # python-object columns have no arrow representation, flaky
+            # disks happen, and ENOSPC is classified as a permanently full
+            # device (its own counter/flag, partial file removed): in
+            # every case hold in memory rather than fail the query; the
+            # slot goes back on the free-list for the next spill
+            _classify_spill_failure(e, path, "sync", self.ledger,
+                                    self.stats)
             self.scope.recycle(path)
             return None
+        crc = None
+        if self.integrity:
+            from .integrity.checksum import crc32_file
+
+            crc = crc32_file(path)
+        try:
+            from . import faults
+
+            # the deterministic disk-corruption hook: an armed plan flips
+            # a real bit in the landed file AFTER its checksum was
+            # recorded, so detection + recompute are testable end to end
+            faults.check("spill.corrupt", self.stats)
+        except DaftTransientError:
+            from .integrity.checksum import flip_file_bits
+
+            flip_file_bits(path)
         self.ledger.spilled(size)
         self.ledger.record_spill_write(file_bytes, dt)
         if self.stats is not None:
@@ -892,14 +1081,18 @@ class PartitionBuffer:
                                           bytes=file_bytes)
         task = _SpillSlotTask(path, schema, nrows, file_bytes,
                               self.scope, rt_stats=self.stats,
-                              ledger=self.ledger)
+                              ledger=self.ledger, expected_crc=crc,
+                              lineage=self.lineage,
+                              lineage_key=lineage_key)
         # the slot recycles when nothing can read it anymore: task GC, not
-        # first-read, so forked references never race the free-list
-        weakref.finalize(task, self.scope.recycle, path)
+        # first-read, so forked references never race the free-list (and
+        # the lineage recipe is dropped with it — nothing can need it)
+        weakref.finalize(task, _settle_sync_slot, self.scope, path,
+                         self.lineage, lineage_key)
         return MicroPartition.from_scan_task(task)
 
     def _spill_async(self, path: str, tbls, size: int, schema, nrows: int,
-                     reader=None) -> Optional[MicroPartition]:
+                     reader=None, lineage_key=None) -> Optional[MicroPartition]:
         """Hand the IPC write to the scope's bounded writer thread; the
         returned partition is immediately consumable (reads serve from the
         resident tables — or, for encoded exchange payloads, through
@@ -914,9 +1107,12 @@ class PartitionBuffer:
         task = _AsyncSpillSlotTask(path, schema, nrows,
                                    mem_bytes,
                                    self.scope, tbls, rt_stats=self.stats,
-                                   ledger=self.ledger, reader=reader)
+                                   ledger=self.ledger, reader=reader,
+                                   lineage=self.lineage,
+                                   lineage_key=lineage_key)
         stats = self.stats
         ledger = self.ledger
+        integrity = self.integrity
         # capture the submitting thread's span AND query context so the
         # write — which runs on the writer thread — is attributed to the
         # op (and query) that spilled, not lost
@@ -943,17 +1139,27 @@ class PartitionBuffer:
                 except Exception as e:
                     # same contract as the synchronous path, discovered
                     # late: hold the partition in memory instead of
-                    # failing the query
-                    logger.warning("spill_write_failed", mode="async",
-                                   path=path, error=repr(e))
+                    # failing the query (ENOSPC classified as disk-full —
+                    # counter/flag set, partial file removed)
+                    _classify_spill_failure(e, path, "async", ledger,
+                                            stats)
                     ledger.async_spill_failed(size)
                     task._write_failed(size)
-                    if stats is not None:
-                        stats.bump("spill_write_failures")
                     return
+                crc = None
+                if integrity:
+                    from .integrity.checksum import crc32_file
+
+                    crc = crc32_file(path)
+                try:
+                    faults.check("spill.corrupt", stats)
+                except DaftTransientError:
+                    from .integrity.checksum import flip_file_bits
+
+                    flip_file_bits(path)
                 ledger.async_spill_done(size)
                 ledger.record_spill_write(file_bytes, dt)
-                task._write_done(file_bytes)
+                task._write_done(file_bytes, crc)
                 if stats is not None:
                     stats.bump("spilled_partitions")
                     stats.bump("spill_write_bytes", file_bytes)
@@ -981,7 +1187,8 @@ class PartitionBuffer:
             stats.io_wait(backpressure)
             stats.bump("spill_backpressure_ns", backpressure)
         weakref.finalize(task, _settle_async_slot, self.scope, path,
-                         task._held_cell, self.ledger)
+                         task._held_cell, self.ledger, self.lineage,
+                         lineage_key)
         return MicroPartition.from_scan_task(task)
 
     def __len__(self) -> int:

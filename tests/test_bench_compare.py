@@ -49,6 +49,17 @@ class TestClassify:
         assert classify("distributed_task_redispatches") is None
         assert classify("distributed_workers") is None
 
+    def test_integrity_and_speculation_suffixes(self):
+        # ISSUE 12: the checksum-cost headline is lower-better (its gate
+        # is < 3% on the q1 leg), the straggler-mitigation headline
+        # higher-better; the A/B walls are ordinary lower-better walls
+        assert classify("integrity_overhead_pct") == "lower"
+        assert classify("integrity_wall_on_s") == "lower"
+        assert classify("integrity_wall_off_s") == "lower"
+        assert classify("straggler_mitigation_speedup_x") == "higher"
+        assert classify("straggler_wall_on_s") == "lower"
+        assert classify("straggler_wall_off_s") == "lower"
+
     def test_streaming_suffixes(self):
         # streaming rung (ISSUE 10): time-to-first-row and working-set
         # peaks are lower-better; throughput (_mbps) stays higher-better

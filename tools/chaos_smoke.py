@@ -1,19 +1,26 @@
 """chaos-smoke: a short mixed workload through the distributed runner
-under randomized-but-SEEDED worker kills. Wired into `make lint` (and
-usable alone via `make chaos-smoke`) so a supervision regression — a
-hang, a lost query, a leaked worker process — fails the static-gate path
-deterministically (the fault plan hashes (seed, site, call#), so every
-run kills the same dispatches).
+under randomized-but-SEEDED chaos. Wired into `make lint` (and usable
+alone via `make chaos-smoke`) so a supervision/integrity regression — a
+hang, a lost query, a garbled result, a leaked worker process — fails
+the static-gate path deterministically (the fault plans hash
+(seed, site, call#)).
 
-Checks, in order:
- 1. every query in the workload reaches a TERMINAL QueryRecord (outcome
-    in the schema's OUTCOMES — recovered "ok" and poison-task "error"
-    both count; silence/hang does not), within a hard wall clock;
- 2. results of recovered queries are byte-identical to the local runner;
- 3. at least one worker loss + re-dispatch actually happened (the chaos
-    was real, not a no-op plan);
- 4. after shutdown: zero live worker processes, zero engine threads.
+Three legs, then shutdown:
+ 1. **worker kills** (``worker.exec`` at rate): every query reaches a
+    TERMINAL QueryRecord (recovered "ok" and poison-task "error" both
+    count; silence/hang does not), recovered results byte-identical to
+    the local runner, at least one real loss + re-dispatch;
+ 2. **corruption** (``spill.corrupt`` + ``transport.corrupt`` at rate):
+    seeded bit-flips on landed spill files and transport frames during a
+    budgeted scan-backed workload — every query completes with results
+    byte-identical to the clean local runner and at least one partition
+    is lineage-recomputed;
+ 3. **straggler** (one worker slowed via a ``worker.task`` delay plan):
+    the query completes within 2x the clean wall (floored at 1s — below
+    that the fixed speculation threshold dominates any ratio) with
+    ``speculation_wins >= 1``.
 
+After all legs: zero live worker processes, zero engine threads.
 Exits nonzero with a named failure on any violation.
 """
 
@@ -27,6 +34,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CHAOS_SEED = 11
 KILL_RATE = 0.12
+CORRUPT_SPILL_RATE = 0.35
+CORRUPT_FRAME_RATE = 0.02
+STRAGGLER_DELAY_S = 0.8
 WORKERS = 2
 QUERIES = 5
 
@@ -109,6 +119,13 @@ def main() -> int:
           f"redispatches={snap['task_redispatches_total']} "
           f"restarts={snap['restarts_used']}")
 
+    rc = _corruption_leg()
+    if rc:
+        return rc
+    rc = _straggler_leg()
+    if rc:
+        return rc
+
     dt.shutdown()
     live = sup.live_worker_process_count()
     if live:
@@ -122,6 +139,179 @@ def main() -> int:
         return 1
     print("CHAOS_SHUTDOWN_OK zero leaked processes/threads")
     print("CHAOS_SMOKE_OK")
+    return 0
+
+
+def _corruption_leg() -> int:
+    """Seeded bit-flips on spill files + transport frames: every query
+    byte-identical to the clean local runner, >= 1 lineage recompute."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import daft_tpu as dt
+    from daft_tpu import col, faults
+    from daft_tpu.errors import DaftError
+
+    d = tempfile.mkdtemp(prefix="chaos_corrupt_src_")
+
+    def make_queries():
+        # scan-backed shapes whose spills (fanout/range pieces, encoded
+        # exchange payloads, buffered scan partitions) all carry lineage
+        # recipes — corruption anywhere on them must self-heal. Shapes
+        # that spill post-shuffle loaded partitions (big sorts, join
+        # builds) carry truncated lineage BY DESIGN and degrade to a
+        # typed error instead; that path is pinned by tests, not smoked.
+        df = dt.read_parquet(os.path.join(d, "*.parquet"))
+        return [
+            ("agg", df.repartition(6, "b").groupby("b")
+             .agg(col("a").sum().alias("s")).sort("b")),
+            ("agg_enc", df.repartition(6, "g").groupby("g")
+             .agg(col("a").sum().alias("s"),
+                  col("a").count().alias("c")).sort("g")),
+            ("filter", df.repartition(5).where(col("a") % 7 == 0)
+             .select(col("a")).sort("a")),
+            ("fcount", df.where(col("a") % 3 == 0).repartition(4, "b")
+             .groupby("b").agg(col("a").count().alias("c")).sort("b")),
+            ("distinct", df.select(col("b"), col("g")).distinct()
+             .sort("b")),
+        ][:QUERIES]
+
+    try:
+        for i in range(4):
+            n = 8000
+            pq.write_table(pa.table({
+                "a": list(range(i * n, (i + 1) * n)),
+                "b": [j % 13 for j in range(n)],
+                "g": [f"g{j % 5}" for j in range(n)],
+            }), os.path.join(d, f"p{i}.parquet"))
+        dt.set_execution_config(enable_result_cache=False,
+                                scan_tasks_min_size_bytes=1,
+                                distributed_workers=0,
+                                memory_budget_bytes=None)
+        oracle = {name: q.collect().to_arrow()
+                  for name, q in make_queries()}
+        dt.set_execution_config(distributed_workers=WORKERS,
+                                memory_budget_bytes=120_000,
+                                worker_heartbeat_interval_s=0.2,
+                                worker_restart_budget=12)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()  # warm
+        before_log = len(dt.query_log())
+        faults.arm("spill.corrupt", "rate", rate=CORRUPT_SPILL_RATE,
+                   seed=CHAOS_SEED)
+        faults.arm("transport.corrupt", "rate", rate=CORRUPT_FRAME_RATE,
+                   seed=CHAOS_SEED)
+        recomputed = 0
+        try:
+            for name, q in make_queries():
+                try:
+                    res = q.collect()
+                except DaftError as e:
+                    print(f"FAIL: corruption leg query {name} errored: "
+                          f"{type(e).__name__}: {str(e)[:120]}")
+                    return 1
+                if not res.to_arrow().equals(oracle[name]):
+                    print(f"FAIL: corruption leg query {name} diverged "
+                          "from the clean local runner")
+                    return 1
+                recomputed += res.stats.snapshot()["counters"].get(
+                    "partitions_recomputed", 0)
+        finally:
+            faults.disarm()
+        from daft_tpu.obs.querylog import validate_record
+
+        recs = dt.query_log()[before_log:]
+        if len(recs) < QUERIES:
+            print(f"FAIL: corruption leg produced {len(recs)} "
+                  f"QueryRecords for {QUERIES} queries")
+            return 1
+        for rec in recs:
+            errs = validate_record(rec)
+            if errs:
+                print(f"FAIL: corruption leg record invalid: {errs}")
+                return 1
+        if recomputed < 1:
+            print("FAIL: corruption leg never recomputed a partition — "
+                  "the corruption plan was a no-op")
+            return 1
+        print(f"CHAOS_CORRUPTION_OK {QUERIES} byte-identical, "
+              f"partitions_recomputed={recomputed}")
+        return 0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _straggler_leg() -> int:
+    """One worker slowed via a worker.task delay plan: speculation keeps
+    the query within 2x the clean wall (floored) with >= 1 win."""
+    import json
+    import time
+    from collections import deque
+
+    import daft_tpu as dt
+    from daft_tpu import col, faults
+    from daft_tpu.dist import supervisor as sup
+
+    def q():
+        df = dt.from_pydict({"a": list(range(60_000)),
+                             "b": [i % 9 for i in range(60_000)]})
+        return (df.repartition(8).select((col("a") * 3).alias("c"))
+                .sort("c"))
+
+    dt.set_execution_config(enable_result_cache=False,
+                            memory_budget_bytes=None,
+                            distributed_workers=0)
+    want = q().collect().to_arrow()
+    # clean distributed wall: fresh pool, no straggler
+    sup.shutdown_worker_pool()
+    dt.set_execution_config(distributed_workers=WORKERS,
+                            worker_heartbeat_interval_s=0.2,
+                            speculation_min_s=0.15,
+                            speculation_quantile_factor=2.0)
+    _ = q().collect()  # spawn + warm
+    t0 = time.perf_counter()
+    _ = q().collect()
+    clean_wall = time.perf_counter() - t0
+    # respawn with worker 0 slowed (the env spec binds at spawn)
+    sup.shutdown_worker_pool()
+    os.environ[faults.ENV_FAULT_SPEC] = json.dumps(
+        {"site": "worker.task", "mode": "always",
+         "delay_s": STRAGGLER_DELAY_S, "worker_id": 0})
+    try:
+        _ = q().collect()  # spawn + warm (slowly)
+        # seed the wall history so the p75 threshold reflects healthy
+        # tasks, not the warmup's straggled ones — deterministic trigger
+        pool = sup._POOL
+        with pool._cond:
+            for op in list(pool._op_walls):
+                pool._op_walls[op] = deque([0.01] * 8, maxlen=64)
+        t0 = time.perf_counter()
+        res = q().collect()
+        spec_wall = time.perf_counter() - t0
+    finally:
+        os.environ.pop(faults.ENV_FAULT_SPEC, None)
+    if not res.to_arrow().equals(want):
+        print("FAIL: straggler leg result diverged from the local runner")
+        return 1
+    c = res.stats.snapshot()["counters"]
+    snap = sup.worker_pool_snapshot()
+    wins = snap["speculation_wins_total"] if snap else 0
+    if c.get("speculation_wins", 0) < 1 and wins < 1:
+        print("FAIL: straggler leg never won a speculation "
+              f"(speculated={c.get('tasks_speculated', 0)})")
+        return 1
+    limit = 2.0 * max(clean_wall, 1.0)
+    if spec_wall > limit:
+        print(f"FAIL: straggler leg wall {spec_wall:.2f}s exceeds "
+              f"{limit:.2f}s (clean {clean_wall:.2f}s)")
+        return 1
+    print(f"CHAOS_STRAGGLER_OK wall={spec_wall:.2f}s "
+          f"clean={clean_wall:.2f}s wins={wins} "
+          f"speculated={c.get('tasks_speculated', 0)}")
+    # the next leg / shutdown must not inherit the straggler fleet
+    sup.shutdown_worker_pool()
     return 0
 
 

@@ -73,7 +73,8 @@ REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
     # health snapshot's weak ref to the latest worker pool
     ("daft_tpu/obs/health.py", "_cluster"),
     # immutable struct.Struct frame-header codec, not state
-    ("daft_tpu/dist/transport.py", "_LEN"),
+    # immutable frame-header struct (protocol v2: len + flags + crc)
+    ("daft_tpu/dist/transport.py", "_HDR"),
     # one peer-allgather plane per process (cluster membership is
     # process-lifetime state, like the jax distributed runtime it mirrors)
     ("daft_tpu/dist/peer.py", "_GROUP"),
