@@ -10,17 +10,23 @@
 #   make chaos-smoke   - mixed workload through the distributed runner under
 #                        seeded random worker SIGKILLs: every query terminal,
 #                        zero leaked worker processes
+#   make cache-smoke   - plan/program cache cold->warm->invalidate->warm
+#                        cycle: hit counters, byte-identity, prefix replay,
+#                        gauge surfaces
 #   make bench-compare - diff the two newest BENCH_r*.json, flag per-metric
 #                        regressions beyond the noise threshold
 #   make test          - full tier-1 test suite (CPU jax)
 
 PY ?= python
 
-.PHONY: lint test profile-smoke obs-smoke chaos-smoke bench-compare
+.PHONY: lint test profile-smoke obs-smoke chaos-smoke cache-smoke bench-compare
 
-lint: profile-smoke obs-smoke chaos-smoke
+lint: profile-smoke obs-smoke chaos-smoke cache-smoke
 	$(PY) -m tools.daftlint
 	$(PY) -m compileall -q daft_tpu
+
+cache-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.cache_smoke
 
 profile-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.profile_smoke

@@ -506,7 +506,7 @@ def measure_serving(scale: float = 0.01, offered_qps: float = 6.0,
                 return 0.0
             return lat[min(len(lat) - 1, int(p * len(lat)))]
 
-        return {
+        out = {
             "serving_offered_qps": offered_qps,
             "serving_qps": round(completed / wall, 2),
             "serving_p50_s": round(q(0.50), 4),
@@ -515,9 +515,64 @@ def measure_serving(scale: float = 0.01, offered_qps: float = 6.0,
             "serving_completed": completed,
             "serving_submitted": i,
         }
+        out.update(_measure_repeat_shapes(rt, [
+            lambda: tpch.q1(lineitem),
+            lambda: tpch.q3(cust, orders, lineitem),
+        ]))
+        return out
     finally:
         rt.shutdown(timeout_s=30)
         cfg.enable_result_cache = prev_cache
+
+
+def _measure_repeat_shapes(rt, shapes, runs_per_shape: int = 12) -> dict:
+    """Repeat-shape leg (ISSUE 13): each plan shape submitted
+    ``runs_per_shape`` times sequentially through the serving runtime —
+    run 1 plans cold, runs 2..N serve the cached plan. Emits warm-vs-cold
+    p50, the plan-cache hit rate over the leg, and the planning share of
+    wall before/after (the compile-time share the cache removes)."""
+    from daft_tpu.adapt.history import HISTORY
+    from daft_tpu.adapt.plancache import PLAN_CACHE
+
+    PLAN_CACHE.clear()
+    HISTORY.clear()
+    pc0 = PLAN_CACHE.snapshot()
+    cold_lat, warm_lat = [], []
+    cold_share, warm_share = [], []
+    for shape in shapes:
+        for j in range(runs_per_shape):
+            h = rt.submit(shape())
+            h.result(120)
+            lat = h.latency_s() or 0.0
+            rec = h.record() or {}
+            share = 0.0
+            if rec.get("wall_s"):
+                share = rec.get("planning_ms", 0.0) / (
+                    rec["wall_s"] * 1000.0)
+            if j == 0:
+                cold_lat.append(lat)
+                cold_share.append(share)
+            else:
+                warm_lat.append(lat)
+                warm_share.append(share)
+    pc1 = PLAN_CACHE.snapshot()
+    hits = pc1["hits"] - pc0["hits"]
+    misses = pc1["misses"] - pc0["misses"]
+
+    def p50(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2] if xs else 0.0
+
+    return {
+        "serving_cold_p50_s": round(p50(cold_lat), 4),
+        "serving_warm_p50_s": round(p50(warm_lat), 4),
+        "serving_plan_cache_hit_rate": round(
+            hits / max(1, hits + misses), 4),
+        "serving_planning_share_cold_pct": round(
+            100.0 * sum(cold_share) / max(1, len(cold_share)), 2),
+        "serving_planning_share_warm_pct": round(
+            100.0 * sum(warm_share) / max(1, len(warm_share)), 2),
+    }
 
 
 def measure_distributed(scale: float = 0.02, workers: int = 2,

@@ -1,0 +1,38 @@
+# daftlint: migrated
+"""Query-velocity subsystem for repeat-shaped traffic (README "Plan &
+program cache").
+
+"Millions of users" traffic is overwhelmingly repeat-shaped, yet every
+repeat of the same plan shape used to re-plan, re-optimize, re-fuse, and
+re-jit from scratch. This package closes the loop the flight recorder
+(daft_tpu/obs/) opened, with three legs — each behind an
+``ExecutionConfig`` knob (default on), each byte-identical off, each
+failing open:
+
+- ``plancache``    — a bounded, thread-safe, process-level cache keyed by
+                     a CANONICAL plan fingerprint (structure + schema,
+                     literals parameterized out) mapping to the optimized
+                     logical plan, translated physical plan, and compiled
+                     ``FusedProgram``s, so hot serving traffic skips
+                     ``optimize()`` + ``translate()`` + fuse-compile
+                     entirely.
+- ``history``/``fdo`` — feedback-directed optimization: a per-fingerprint
+                     history folded from the QueryLog feeds the planner,
+                     so broadcast-vs-hash join flips and shuffle fan-out
+                     resizes happen on the FIRST run of a repeated shape
+                     (upstream's AdaptivePlanner re-plans from
+                     *materialized* stats; this re-plans from *recorded*
+                     ones). Every decision is a typed profiler event and
+                     revertible: a runtime mispredict demotes the entry.
+- ``resultcache``  — scan+project/filter prefixes shared across queries
+                     memoize their materialized partitions, keyed by the
+                     exact sub-plan fingerprint + source mtime.
+"""
+
+from .fingerprint import canonical_fingerprint, canonical_site_fp
+from .history import HISTORY
+from .plancache import PLAN_CACHE
+from .resultcache import RESULT_CACHE
+
+__all__ = ["canonical_fingerprint", "canonical_site_fp", "HISTORY",
+           "PLAN_CACHE", "RESULT_CACHE"]

@@ -1,0 +1,349 @@
+# daftlint: migrated
+"""Feedback-directed optimization: planner decisions from RECORDED stats.
+
+Upstream's AdaptivePlanner (PAPER.md L5) re-plans from *materialized*
+stats — it has to execute a stage before it learns a side was small. FDO
+closes the same loop from *historical* stats: the flight recorder already
+measured what this plan shape did last time, so the decision lands on the
+FIRST run of a repeated shape, before anything materializes.
+
+Decisions (each counted, logged, and emitted as a typed profiler event;
+all behind ``cfg.history_fdo``, byte-identical result sets with it off):
+
+- **join strategy** (``join_strategy_hint``, consulted by
+  ``physical._translate_join``): a join side whose static size estimate
+  is above (or unknown to) the broadcast threshold but whose OBSERVED
+  bytes are safely below it flips to a broadcast join — gated on the
+  side's subtree being able to shrink (Filter/Aggregate/Limit/...), so a
+  bare source whose static estimate is already truthful never flips.
+- **shuffle fan-out** (``agg_shuffle_fanout``, consulted by
+  ``physical._translate_aggregate``): the internal hash exchange of a
+  two-stage aggregation is resized to
+  ``ceil(observed_bytes / shuffle_target_partition_bytes)`` (shrink-only,
+  engine-chosen fan-outs only — user Repartition counts are never touched).
+- **segment mode** (``apply_query_hints``): a shape whose recorded
+  streaming runs spent most of their wall backpressure-stalled executes
+  with ``streaming_execution`` off for this query only.
+
+Every decision is *revertible*: its expectation is recorded on the plan
+cache entry (``still_valid`` re-derives it as history evolves) and the
+runtime mispredict guard (``note_broadcast_mispredict``, fired by
+``BroadcastJoinOp`` when a history-says-small side arrives big) demotes
+the entry and falls back to the uncached plan on the next run — the
+current query completes correctly either way.
+
+Decisions run only inside a ``collecting`` scope (opened by
+``plancache.plan_query``'s cold path): AQE stage re-plans and bare
+``explain`` translates keep today's static behavior.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional
+
+from ..obs.log import get_logger
+
+__all__ = ["collecting", "active", "join_strategy_hint",
+           "agg_shuffle_fanout", "observation_key", "still_valid",
+           "apply_query_hints", "note_broadcast_mispredict"]
+
+logger = get_logger("fdo")
+
+# flip to broadcast only when observed bytes sit at half the threshold or
+# less: hysteresis against shapes oscillating around the boundary
+_BROADCAST_SLACK = 0.5
+# runtime mispredict guard: the materialized side may exceed the
+# threshold by this factor before the plan is demoted (observation EWMAs
+# drift; a 10% overshoot is not a wrong decision)
+_MISPREDICT_SLACK = 1.5
+# resize an aggregate exchange only when it is worth a layout change
+_FANOUT_MIN_PARTS = 4
+
+_tl = threading.local()
+
+
+class _Collector:
+    __slots__ = ("cfg", "stats", "enabled", "expects", "fanout_ok")
+
+    def __init__(self, cfg, stats, enabled: bool, fanout_ok: bool = True):
+        self.cfg = cfg
+        self.stats = stats
+        self.enabled = enabled
+        # mesh plans decline fan-out resizes: the device exchange yields
+        # its collective's partition count and cannot honor a reduce-side
+        # fan-in, which would desynchronize translate's partition counts
+        self.fanout_ok = fanout_ok
+        self.expects: List[dict] = []
+
+
+@contextlib.contextmanager
+def collecting(cfg, stats, enabled: bool = True, fanout_ok: bool = True):
+    """Scope within which translate's FDO hooks are live; yields the
+    collector whose ``expects`` the plan cache stores with the entry."""
+    coll = _Collector(cfg, stats, enabled, fanout_ok)
+    prev = getattr(_tl, "coll", None)
+    _tl.coll = coll
+    try:
+        yield coll
+    finally:
+        _tl.coll = prev
+
+
+def active() -> Optional[_Collector]:
+    coll = getattr(_tl, "coll", None)
+    if coll is None or not coll.enabled:
+        return None
+    if not getattr(coll.cfg, "history_fdo", True):
+        return None
+    return coll
+
+
+def observation_key(subplan) -> Optional[str]:
+    """The site fp a physical exchange/join should observe its payload
+    under — None outside a collecting scope (no tagging overhead)."""
+    if active() is None:
+        return None
+    try:
+        from .fingerprint import canonical_site_fp
+
+        return canonical_site_fp(subplan)
+    except Exception:
+        return None
+
+
+def _bump(coll, counter: str, **log_fields) -> None:
+    if coll.stats is not None:
+        coll.stats.bump(counter)
+        p = coll.stats.profiler
+        if p.armed:
+            p.event("fdo", kind=counter, **log_fields)
+    logger.info(counter, **log_fields)
+
+
+# --------------------------------------------------------------- decisions
+
+def _shrinkable(side) -> bool:
+    """Whether the side's static size estimate can overestimate: a
+    cardinality-changing op in the subtree, or a filter/limit PUSHED INTO
+    a scan (the optimizer removes the Filter node but the scan still
+    reads a fraction of the file its size estimate charges in full)."""
+    from ..adaptive import _subtree_can_shrink
+    from ..logical import ScanSource
+
+    if _subtree_can_shrink(side):
+        return True
+
+    def scan_pushed(p) -> bool:
+        if isinstance(p, ScanSource):
+            pd = p.pushdowns()
+            return pd.filters is not None or pd.limit is not None
+        return any(scan_pushed(c) for c in p.children())
+
+    return scan_pushed(side)
+
+
+def join_strategy_hint(plan) -> Optional[str]:
+    """'left' / 'right' — broadcast that side — or None (no hint). Called
+    by ``physical._translate_join`` for joins with no explicit strategy.
+
+    Every side the join-type preservation rules ALLOW broadcasting is
+    consulted (both for inner joins — a historically small left side
+    flips just as well as a right one); each consult records a
+    revalidation expectation so fresh history re-derives the decision."""
+    coll = active()
+    if coll is None:
+        return None
+    from ..physical import _broadcast_side
+    from .history import HISTORY
+
+    if plan.how == "outer":
+        return None
+    try:
+        threshold = int(coll.cfg.broadcast_join_size_bytes_threshold)
+        # which sides MAY be broadcast (outer-preservation rules): inner
+        # allows either; left/semi/anti only right; right only left
+        preferred = _broadcast_side(plan, None, None)
+        candidates = [preferred]
+        if plan.how == "inner":
+            candidates.append("left" if preferred == "right" else "right")
+        from .fingerprint import canonical_site_fp
+
+        for side_name in candidates:
+            side = plan.left if side_name == "left" else plan.right
+            static = side.approx_size_bytes()
+            if static is not None and static <= threshold:
+                return None  # the static planner already broadcasts it
+            if not _shrinkable(side):
+                continue  # static estimate is already truthful
+            site = canonical_site_fp(side)
+            hist = HISTORY.size(site)
+            flip = (hist is not None
+                    and hist[1] <= threshold * _BROADCAST_SLACK)
+            coll.expects.append({
+                "kind": "join", "site": site, "threshold": threshold,
+                "decided": "broadcast" if flip else "none",
+            })
+            if flip:
+                _bump(coll, "fdo_join_flips", site=site, side=side_name,
+                      observed_bytes=hist[1], threshold=threshold)
+                return side_name
+        return None
+    except Exception as e:
+        logger.warning("fdo_join_hint_failed", error=repr(e))
+        return None
+
+
+def broadcast_guard(plan, side_name: str):
+    """(site_fp, max_bytes) the BroadcastJoinOp checks the materialized
+    small side against — the runtime mispredict detector for a
+    history-seeded flip."""
+    coll = active()
+    if coll is None:
+        return None
+    try:
+        from .fingerprint import canonical_site_fp
+
+        side = plan.left if side_name == "left" else plan.right
+        threshold = int(coll.cfg.broadcast_join_size_bytes_threshold)
+        return (canonical_site_fp(side),
+                int(threshold * _MISPREDICT_SLACK))
+    except Exception:
+        return None
+
+
+def note_broadcast_mispredict(guard, actual_bytes: int, ctx,
+                              canonical_fp: str) -> None:
+    """History said broadcast; the side arrived big. Count it, demote the
+    shape's plan-cache entries, and record the truth — the query itself
+    completes on the (correct, merely slower) broadcast plan, and the
+    next plan of this shape derives hash from the fresh observation."""
+    site_fp, _max = guard
+    ctx.stats.bump("fdo_mispredicts")
+    p = ctx.stats.profiler
+    if p.armed:
+        p.event("fdo", kind="fdo_mispredict", site=site_fp,
+                actual_bytes=actual_bytes)
+    logger.warning("fdo_mispredict", site=site_fp,
+                   actual_bytes=actual_bytes)
+    try:
+        from .history import HISTORY
+        from .plancache import PLAN_CACHE
+
+        HISTORY.note_mispredict(site_fp)
+        if canonical_fp:
+            PLAN_CACHE.demote(canonical_fp)
+    except Exception as e:
+        logger.warning("fdo_demote_failed", error=repr(e))
+
+
+def agg_shuffle_fanout(plan, nparts: int) -> Optional[int]:
+    """A smaller fan-out for the internal exchange of a two-stage grouped
+    aggregation, derived from the observed map-side payload — or None.
+    Shrink-only, and only when the change is material (engine-chosen
+    fan-outs of >= _FANOUT_MIN_PARTS shrinking by >= 2x)."""
+    coll = active()
+    if coll is None or not coll.fanout_ok or nparts < _FANOUT_MIN_PARTS:
+        return None
+    try:
+        from .fingerprint import canonical_site_fp
+        from .history import HISTORY
+
+        site = "aggx:" + canonical_site_fp(plan)
+        hist = HISTORY.size(site)
+        target = max(int(coll.cfg.shuffle_target_partition_bytes), 1)
+        ideal = None
+        if hist is not None:
+            ideal = max(1, -(-hist[1] // target))
+        decided = (ideal if ideal is not None
+                   and ideal <= nparts // 2 else None)
+        coll.expects.append({
+            "kind": "fanout", "site": site, "target": target,
+            "nparts": nparts, "decided": decided or 0,
+        })
+        if decided is None:
+            return None
+        _bump(coll, "fdo_shuffle_resizes", site=site,
+              from_parts=nparts, to_parts=decided,
+              observed_bytes=hist[1])
+        return decided
+    except Exception as e:
+        logger.warning("fdo_fanout_hint_failed", error=repr(e))
+        return None
+
+
+def agg_observation_key(plan) -> Optional[str]:
+    """Site key the aggregate exchange observes its input payload under
+    (matches ``agg_shuffle_fanout``'s lookup key)."""
+    coll = active()
+    if coll is None:
+        return None
+    try:
+        from .fingerprint import canonical_site_fp
+
+        return "aggx:" + canonical_site_fp(plan)
+    except Exception:
+        return None
+
+
+def still_valid(exp: dict) -> bool:
+    """Re-derive one recorded decision expectation against CURRENT
+    history; False drops the cached entry (plancache.revalidate)."""
+    from .history import HISTORY
+
+    hist = HISTORY.size(exp["site"])
+    if exp["kind"] == "join":
+        flip = (hist is not None
+                and hist[1] <= exp["threshold"] * _BROADCAST_SLACK)
+        return ("broadcast" if flip else "none") == exp["decided"]
+    if exp["kind"] == "fanout":
+        ideal = None
+        if hist is not None:
+            ideal = max(1, -(-hist[1] // exp["target"]))
+        decided = (ideal if ideal is not None
+                   and ideal <= exp["nparts"] // 2 else 0)
+        return decided == exp["decided"]
+    return True  # unknown kinds never invalidate
+
+
+# ------------------------------------------------------------ query hints
+
+# stand down streaming only when stalls dominated: > 50% of wall across
+# >= 2 recorded runs
+_STREAM_STALL_SHARE = 0.5
+_STREAM_MIN_RUNS = 2
+
+
+def apply_query_hints(canonical_fp: str, cfg, stats):
+    """Per-query config adjustments from the shape's recorded profile —
+    today: streaming-vs-partition segment choice from recorded
+    backpressure share. Returns ``cfg`` or a replaced copy; never raises."""
+    if not canonical_fp or not getattr(cfg, "history_fdo", True) \
+            or not getattr(cfg, "streaming_execution", True):
+        return cfg
+    try:
+        from .history import HISTORY
+
+        prof = HISTORY.query_profile(canonical_fp)
+        if (prof is None or prof["runs"] < _STREAM_MIN_RUNS
+                or not prof["stream_morsels"]):
+            return cfg
+        if prof["backpressure_ms"] \
+                <= _STREAM_STALL_SHARE * prof["wall_s"] * 1000.0:
+            return cfg
+        import dataclasses
+
+        if stats is not None:
+            stats.bump("fdo_stream_hints")
+            p = stats.profiler
+            if p.armed:
+                p.event("fdo", kind="fdo_stream_hint",
+                        fingerprint=canonical_fp)
+        logger.info("fdo_stream_hint", fingerprint=canonical_fp,
+                    backpressure_ms=round(prof["backpressure_ms"], 1),
+                    wall_s=round(prof["wall_s"], 3))
+        return dataclasses.replace(cfg, streaming_execution=False)
+    except Exception as e:
+        logger.warning("fdo_query_hint_failed", error=repr(e))
+        return cfg

@@ -1,0 +1,209 @@
+# daftlint: migrated
+"""Canonical plan fingerprints: structure + schema, literals masked out.
+
+Two queries that differ only in literal values — ``WHERE x > 5`` vs
+``WHERE x > 9`` — share a canonical fingerprint, so the plan cache and
+the FDO history treat them as one *shape* while the exact, literal-bearing
+fingerprint (``obs.querylog.plan_signature``) keeps per-query identity in
+the QueryRecord.
+
+Two scopes:
+
+- ``identity`` (``canonical_fingerprint``): the cross-process-stable
+  shape label the QueryRecord carries as ``plan_fingerprint_canonical``.
+  In-memory sources contribute only schema + partition count (a process-
+  local object token would break cross-interpreter stability); scan
+  sources contribute paths/format/pushdown structure but NOT mtimes (a
+  rewritten file keeps its shape).
+- ``site`` (``canonical_site_fp``): the process-local key the FDO history
+  observes plan subtrees under. In-memory sources additionally contribute
+  their data-identity token so observations from one test frame can never
+  seed decisions for a different frame that merely shares a schema.
+
+The serialization is deterministic: no ``id()``, no ``hash()``, no
+default object reprs (their embedded addresses are scrubbed defensively),
+callables by ``__qualname__`` — pinned by the two-interpreter stability
+test in tests/test_adapt.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Any, List, Optional
+
+__all__ = ["canonical_fingerprint", "canonical_site_fp",
+           "canonical_expr_key", "literal_values"]
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+# attributes that are derived/cache state, never identity
+_SKIP_ATTRS = ("schema", "file_schema", "_memoizable_cache", "_cache_token",
+               "_obs_signature")
+
+
+def _scrub(s: str) -> str:
+    """Strip memory addresses from default reprs — identity must be
+    process-independent."""
+    return _ADDR_RE.sub("0x", s)
+
+
+def _scalar(v: Any) -> str:
+    if callable(v):
+        return f"fn:{getattr(v, '__qualname__', getattr(v, '__name__', 'fn'))}"
+    return _scrub(repr(v))
+
+
+def _expr_canon(node, out: List[str],
+                params: Optional[List[Any]]) -> None:
+    from ..expressions import Expression, ExprNode, Literal
+
+    if isinstance(node, Expression):
+        node = node._node
+    if isinstance(node, Literal):
+        # the value is parameterized OUT; dtype + weakness stay (they are
+        # typing-relevant — a weak lit(2) and a strong lit(2, int64)
+        # resolve differently in binary contexts)
+        if params is not None:
+            params.append(node.value)
+        out.append(f"lit?:{node.dtype!r}:w{int(node.weak)}")
+        return
+    out.append(type(node).__name__)
+    kids = node.children()
+    kid_ids = {id(k) for k in kids}
+    for k in sorted(vars(node)):
+        if k in _SKIP_ATTRS:
+            continue
+        v = getattr(node, k)
+        if isinstance(v, ExprNode):
+            if id(v) in kid_ids:
+                continue  # serialized via children() below
+            out.append(f"{k}=(")
+            _expr_canon(v, out, params)
+            out.append(")")
+        elif isinstance(v, (list, tuple)) and any(
+                isinstance(e, (ExprNode, Expression)) for e in v):
+            if all(id(getattr(e, "_node", e)) in kid_ids for e in v):
+                continue
+            out.append(f"{k}=[")
+            for e in v:
+                _expr_canon(e, out, params)
+            out.append("]")
+        else:
+            out.append(f"{k}={_scalar(v)}")
+    out.append("(")
+    for c in kids:
+        _expr_canon(c, out, params)
+    out.append(")")
+
+
+def canonical_expr_key(expr) -> str:
+    """Canonical (literal-masked) serialization of one expression."""
+    out: List[str] = []
+    _expr_canon(expr, out, None)
+    return "|".join(out)
+
+
+def _schema_canon(schema) -> str:
+    return ",".join(f"{f.name}:{f.dtype!r}" for f in schema)
+
+
+def _scan_task_canon(t, out: List[str], params) -> None:
+    """Shape identity of one scan task: path/format/options/pushdowns —
+    NOT mtime or size (a rewritten file keeps its shape; exactness is the
+    binding key's job)."""
+    out.append(f"scan:{getattr(t, 'path', '?')}"
+               f"|{getattr(t, 'format', '?')}")
+    # MergedScanTask and friends expose children; fold them in
+    for c in getattr(t, "children", ()) or ():
+        _scan_task_canon(c, out, params)
+    opts = getattr(t, "storage_options", None)
+    if opts:
+        out.append(";".join(f"{k}={_scalar(v)}" for k, v in sorted(
+            opts.items(), key=lambda kv: kv[0])))
+    out.append(f"rg={getattr(t, 'row_group_ids', None)!r}"
+               f"|pv={_scrub(repr(getattr(t, 'partition_values', None)))}")
+    sch = getattr(t, "schema", None)
+    if sch is not None:
+        out.append(_schema_canon(sch))
+    pd = getattr(t, "pushdowns", None)
+    if pd is not None:
+        out.append(f"cols={getattr(pd, 'columns', None)!r}"
+                   f"|limit={getattr(pd, 'limit', None)!r}")
+        filt = getattr(pd, "filters", None)
+        if filt is not None:
+            out.append("filt=(")
+            _expr_canon(filt, out, params)
+            out.append(")")
+
+
+def _plan_canon(p, out: List[str], params, scope: str) -> None:
+    from ..expressions import Expression
+    from ..logical import InMemorySource, ScanSource
+
+    out.append(type(p).__name__)
+    if isinstance(p, InMemorySource):
+        out.append(f"mem[{len(p.partitions)}]:{_schema_canon(p.schema)}")
+        if scope == "site":
+            # data identity: observations must never cross frames that
+            # merely share a schema (process-local by design)
+            out.append(f"tok={p._cache_token}")
+        return
+    if isinstance(p, ScanSource):
+        for t in p.tasks:
+            _scan_task_canon(t, out, params)
+        return
+    kids = p.children()
+    kid_ids = {id(k) for k in kids}
+    for k in sorted(vars(p)):
+        if k in _SKIP_ATTRS or k.startswith("_fdo"):
+            continue
+        v = getattr(p, k)
+        if id(v) in kid_ids:
+            continue
+        if isinstance(v, Expression):
+            out.append(f"{k}=(")
+            _expr_canon(v, out, params)
+            out.append(")")
+        elif isinstance(v, (list, tuple)) and any(
+                isinstance(e, Expression) for e in v):
+            out.append(f"{k}=[")
+            for e in v:
+                _expr_canon(e, out, params)
+            out.append("]")
+        else:
+            out.append(f"{k}={_scalar(v)}")
+    out.append("(")
+    for c in kids:
+        _plan_canon(c, out, params, scope)
+    out.append(")")
+
+
+def _digest(parts: List[str]) -> str:
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def canonical_fingerprint(plan) -> str:
+    """Cross-process-stable shape fingerprint of a logical plan, literals
+    parameterized out (the QueryRecord's ``plan_fingerprint_canonical``)."""
+    out: List[str] = []
+    _plan_canon(plan, out, None, "identity")
+    return _digest(out)
+
+
+def canonical_site_fp(plan) -> str:
+    """Process-local observation key for one plan subtree (FDO history):
+    canonical shape PLUS in-memory data-identity tokens."""
+    out: List[str] = []
+    _plan_canon(plan, out, None, "site")
+    return _digest(out)
+
+
+def literal_values(plan) -> List[Any]:
+    """The literal values a canonical fingerprint masked out, in
+    deterministic walk order (diagnostic surface; the plan cache keys
+    bindings by the exact structural key instead)."""
+    params: List[Any] = []
+    out: List[str] = []
+    _plan_canon(plan, out, params, "identity")
+    return params

@@ -1,0 +1,165 @@
+# daftlint: migrated
+"""FDO history: what repeated plan shapes actually did at runtime.
+
+A bounded, thread-safe, process-level registry with two views:
+
+- **site observations** (``observe``/``size``): per canonical *subtree*
+  fingerprint (``fingerprint.canonical_site_fp``), the rows/bytes that
+  actually flowed through that subtree — join sides observed at their
+  exchanges, aggregate map-side output observed at its shuffle. This is
+  what seeds broadcast-vs-hash flips and shuffle fan-out resizes on the
+  FIRST run of a repeated shape (upstream's AdaptivePlanner needs a
+  materialization barrier to learn the same fact).
+- **query profiles** (``fold``): per canonical *query* fingerprint, the
+  wall/ttfr/streaming aggregates of past runs — the streaming-vs-
+  partition segment hint's input.
+
+``fold`` runs from ``execution.execute_plan``'s completion hook (fail-open:
+a history defect degrades to an error log, never a query failure) and
+afterwards asks the plan cache to revalidate entries whose FDO decisions
+consulted the just-updated sites — so a shape cached with a hash join is
+re-planned (and flips to broadcast) as soon as history says its build
+side is small, and a runtime mispredict (``note_mispredict``) demotes the
+entry the same way.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["QueryHistory", "HISTORY"]
+
+# EWMA weight for new observations (repeat-shaped traffic drifts slowly;
+# a single outlier run must not whipsaw the planner)
+_ALPHA = 0.5
+
+
+class _SiteStats:
+    __slots__ = ("rows", "bytes", "count", "last_rows", "last_bytes",
+                 "mispredicts")
+
+    def __init__(self):
+        self.rows = 0.0
+        self.bytes = 0.0
+        self.count = 0
+        self.last_rows = 0
+        self.last_bytes = 0
+        self.mispredicts = 0
+
+
+class QueryHistory:
+    """Bounded history registry (see module docstring)."""
+
+    def __init__(self, max_sites: int = 4096, max_queries: int = 1024):
+        self._lock = threading.Lock()
+        self._sites: "OrderedDict[str, _SiteStats]" = OrderedDict()
+        self._queries: "OrderedDict[str, dict]" = OrderedDict()
+        self._max_sites = max_sites
+        self._max_queries = max_queries
+
+    # ----------------------------------------------------------- sites
+    def observe(self, site_fp: str, rows: int, nbytes: int) -> None:
+        with self._lock:
+            st = self._sites.get(site_fp)
+            if st is None:
+                st = self._sites[site_fp] = _SiteStats()
+                st.rows = float(rows)
+                st.bytes = float(nbytes)
+            else:
+                st.rows = (1 - _ALPHA) * st.rows + _ALPHA * rows
+                st.bytes = (1 - _ALPHA) * st.bytes + _ALPHA * nbytes
+            st.count += 1
+            st.last_rows = rows
+            st.last_bytes = nbytes
+            self._sites.move_to_end(site_fp)
+            while len(self._sites) > self._max_sites:
+                self._sites.popitem(last=False)
+
+    def size(self, site_fp: str) -> Optional[Tuple[int, int, int]]:
+        """(ewma rows, ewma bytes, observation count) or None."""
+        with self._lock:
+            st = self._sites.get(site_fp)
+            if st is None:
+                return None
+            return int(st.rows), int(st.bytes), st.count
+
+    def note_mispredict(self, site_fp: str) -> None:
+        """A decision seeded from this site was wrong at runtime (e.g. a
+        history-says-broadcast side grew past the threshold). The caller
+        also observes the TRUE size, so the next plan degrades to the
+        uncached decision on its own; this just keeps the event countable."""
+        with self._lock:
+            st = self._sites.get(site_fp)
+            if st is not None:
+                st.mispredicts += 1
+
+    # --------------------------------------------------------- queries
+    def query_profile(self, canonical_fp: str) -> Optional[dict]:
+        with self._lock:
+            p = self._queries.get(canonical_fp)
+            return dict(p) if p is not None else None
+
+    def fold(self, canonical_fp: str, stats, rec: dict) -> None:
+        """Fold one finished execution into the history: site observations
+        accumulated by the tagged exchanges/joins (``stats.fdo_obs``) and
+        the per-query aggregates, then revalidate dependent plan-cache
+        entries.
+
+        Only CLEAN completions contribute site observations. The
+        observation points already record only after fully draining their
+        input (a mid-fanout teardown never reaches ``fdo_observe``), but
+        an errored/abandoned/deadline-killed run is drained here and
+        discarded anyway — biased-low sizes from any partially-consumed
+        path must never seed a broadcast flip."""
+        obs = stats.take_fdo_obs()
+        if rec.get("outcome") != "ok":
+            obs = {}
+        for site_fp, (rows, nbytes) in obs.items():
+            self.observe(site_fp, rows, nbytes)
+        if canonical_fp and rec.get("outcome") == "ok":
+            counters = rec.get("counters", {})
+            prof = {
+                "wall_s": rec.get("wall_s", 0.0),
+                "ttfr_ms": counters.get("time_to_first_row_ns", 0) / 1e6,
+                "stream_morsels": counters.get("stream_morsels", 0),
+                "backpressure_ms":
+                    counters.get("stream_backpressure_ns", 0) / 1e6,
+                "runs": 1,
+            }
+            with self._lock:
+                prev = self._queries.get(canonical_fp)
+                if prev is not None:
+                    for k in ("wall_s", "ttfr_ms", "backpressure_ms"):
+                        prof[k] = (1 - _ALPHA) * prev[k] + _ALPHA * prof[k]
+                    prof["stream_morsels"] = max(prev["stream_morsels"],
+                                                 prof["stream_morsels"])
+                    prof["runs"] = prev["runs"] + 1
+                self._queries[canonical_fp] = prof
+                self._queries.move_to_end(canonical_fp)
+                while len(self._queries) > self._max_queries:
+                    self._queries.popitem(last=False)
+        if obs:
+            # new facts may flip a decision a cached plan baked in: drop
+            # entries whose recorded FDO expectations no longer hold
+            from .plancache import PLAN_CACHE
+
+            PLAN_CACHE.revalidate(set(obs))
+
+    # ------------------------------------------------------------ admin
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"sites": len(self._sites),
+                    "queries": len(self._queries),
+                    "mispredicts": sum(s.mispredicts
+                                       for s in self._sites.values())}
+
+    def clear(self) -> None:
+        """Tests only."""
+        with self._lock:
+            self._sites.clear()
+            self._queries.clear()
+
+
+HISTORY = QueryHistory()

@@ -268,6 +268,34 @@ class ExecutionConfig:
     speculation_quantile_factor: float = 3.0
     speculation_min_s: float = 1.0
     speculation_max_inflight: int = 2
+    # --- query-velocity subsystem (daft_tpu/adapt/, README "Plan &
+    # program cache") ---------------------------------------------------
+    # plan/program cache: repeated plan shapes serve their optimized
+    # logical plan, translated physical plan, and compiled FusedPrograms
+    # from a bounded process cache keyed by a canonical fingerprint
+    # (literals parameterized out) — warm traffic performs zero
+    # optimize()/translate()/fuse-compile calls, byte-identical to a
+    # cold plan. Invalidated on any config change, source mtime change,
+    # cache-version bump, or FDO revalidation/demotion; fails open.
+    plan_cache: bool = True
+    # total estimated plan bytes held before LRU shedding (charged to the
+    # MemoryLedger's plan_cache_bytes account)
+    plan_cache_bytes: int = 64 * 1024 * 1024
+    # feedback-directed optimization: the planner consults the recorded
+    # history of this plan shape (flight-recorder rollups folded per
+    # canonical fingerprint) — broadcast-vs-hash join flips, aggregate-
+    # exchange fan-out resizes, and streaming-segment hints land on the
+    # FIRST run of a repeated shape instead of after an AQE
+    # materialization. Decisions are typed profiler events; a runtime
+    # mispredict demotes the cached plan and reverts the decision.
+    history_fdo: bool = True
+    # sub-plan result cache: scan+project/filter prefixes shared across
+    # queries memoize their materialized partitions, keyed by the exact
+    # prefix fingerprint + source mtime (the _PARTITION_SET_CACHE
+    # invalidation discipline); bytes LRU-shed under the cap below and
+    # charged to the ledger's subplan_cache_bytes account
+    subplan_result_cache: bool = True
+    subplan_cache_bytes: int = 64 * 1024 * 1024
     # device circuit breaker (execution.DeviceHealth): after this many
     # CONSECUTIVE device-kernel failures the breaker opens and every
     # device-eligible partition routes straight to the host path (one trip,

@@ -28,8 +28,9 @@ class DistributedRunner(Runner):
                    optimized: bool = False) -> Iterator[MicroPartition]:
         ctx = get_context()
         cfg = ctx.execution_config
-        _, phys = self.optimize_and_translate(plan, optimized)
-        exec_ctx = ExecutionContext(cfg, qctx=qctx)
+        _, phys, run_cfg = self.plan_query(plan, optimized,
+                                           stats=qctx.stats)
+        exec_ctx = ExecutionContext(run_cfg, qctx=qctx)
         if cfg.distributed_workers > 0:
             from .supervisor import get_worker_pool
 

@@ -170,6 +170,10 @@ class RuntimeStats:
         # the QueryRecord of this handle's most recent plan execution
         # (set by execute_plan's completion hook; df.last_query_record())
         self.last_record = None
+        # FDO site observations (daft_tpu/adapt/): canonical subtree
+        # fingerprint -> [rows, bytes] accumulated by tagged exchanges/
+        # joins, folded into the process history at query end
+        self.fdo_obs: Dict[str, list] = {}
 
     def cancel(self) -> None:
         """Stop the query this handle is attached to at the next partition
@@ -197,6 +201,25 @@ class RuntimeStats:
         with self._lock:
             if n > self.counters.get(key, 0):
                 self.counters[key] = n
+
+    def fdo_observe(self, site_fp: str, rows: int, nbytes: int) -> None:
+        """Accumulate one FDO site observation (what actually flowed
+        through a tagged plan subtree this query)."""
+        with self._lock:
+            cur = self.fdo_obs.get(site_fp)
+            if cur is None:
+                self.fdo_obs[site_fp] = [rows, nbytes]
+            else:
+                cur[0] += rows
+                cur[1] += nbytes
+
+    def take_fdo_obs(self) -> Dict[str, tuple]:
+        """Drain the accumulated observations (history fold consumes them
+        exactly once per execution)."""
+        with self._lock:
+            out = {k: (v[0], v[1]) for k, v in self.fdo_obs.items()}
+            self.fdo_obs.clear()
+        return out
 
     def io_wait(self, ns: int) -> None:
         """Record consumer-thread blocked IO time: the counter AND the
@@ -1228,29 +1251,47 @@ def _record_query(root: PhysicalOp, ctx: ExecutionContext, query_id: str,
     ``diagnostics_dir`` is set — survives a disabled log. Observability
     must never fail the query: any defect here degrades to an error log."""
     cfg = ctx.cfg
+    canonical = getattr(root, "_canonical_fp", "")
     want_log = getattr(cfg, "enable_query_log", True)
     want_capture = (getattr(cfg, "diagnostics_dir", None)
                     or getattr(cfg, "slow_query_threshold_s", None)
                     is not None)
-    if not (want_log or want_capture):
-        return
-    try:
-        from .obs import capture as obs_capture
-        from .obs.querylog import QUERY_LOG, build_record
+    rec = None
+    if want_log or want_capture:
+        try:
+            from .obs import capture as obs_capture
+            from .obs.querylog import QUERY_LOG, build_record
 
-        prof = ctx.stats.profiler
-        rec = build_record(query_id, fingerprint, plan_ops, cfg,
-                           ctx.stats, wall_ns, outcome, error=error,
-                           profiled=prof.armed, rows_emitted=rows_emitted)
-        if want_log:
-            QUERY_LOG.resize(cfg.query_log_depth)
-            QUERY_LOG.append(rec)
-            ctx.stats.last_record = rec
-        obs_capture.maybe_capture(rec, cfg, ctx.stats, prof)
-    except Exception as e:
-        from .obs.log import get_logger
+            prof = ctx.stats.profiler
+            rec = build_record(query_id, fingerprint, plan_ops, cfg,
+                               ctx.stats, wall_ns, outcome, error=error,
+                               profiled=prof.armed,
+                               rows_emitted=rows_emitted,
+                               canonical=canonical)
+            if want_log:
+                QUERY_LOG.resize(cfg.query_log_depth)
+                QUERY_LOG.append(rec)
+                ctx.stats.last_record = rec
+            obs_capture.maybe_capture(rec, cfg, ctx.stats, prof)
+        except Exception as e:
+            from .obs.log import get_logger
 
-        get_logger("obs").error("query_record_failed", error=repr(e))
+            get_logger("obs").error("query_record_failed", error=repr(e))
+    if getattr(cfg, "history_fdo", True):
+        # fold this execution's FDO observations + profile into the
+        # process history (daft_tpu/adapt/history.py) — the input of the
+        # next plan of this shape. Never fails the query.
+        try:
+            from .adapt.history import HISTORY
+
+            HISTORY.fold(canonical, ctx.stats, rec if rec is not None
+                         else {"outcome": outcome,
+                               "wall_s": wall_ns / 1e9,
+                               "counters": ctx.stats.snapshot()["counters"]})
+        except Exception as e:
+            from .obs.log import get_logger
+
+            get_logger("obs").error("history_fold_failed", error=repr(e))
 
 
 def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
@@ -1275,6 +1316,10 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
     from .obs.querylog import plan_signature
 
     fingerprint, plan_ops = plan_signature(root)
+    # the query's canonical (literal-masked) shape fingerprint, stamped by
+    # the planner (adapt/plancache.plan_query); ops consult it for FDO
+    # mispredict demotion, the completion hook for the QueryRecord
+    ctx.canonical_fp = getattr(root, "_canonical_fp", "")
     prof = ctx.stats.profiler
     if prof.armed:
         query_id = prof.query_id
@@ -1296,6 +1341,16 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
     parallel = ctx.num_workers > 1
 
     def build(op: PhysicalOp) -> Iterator[MicroPartition]:
+        # sub-plan result cache (daft_tpu/adapt/resultcache.py): a
+        # scan+project/filter prefix another query already materialized
+        # replays its cached partitions (or tees its output in on this
+        # first execution). Declines (knob off, mesh/multi-host, UDFs,
+        # unstattable sources) fall through; fails open.
+        from .adapt.resultcache import try_result_cache
+
+        served = try_result_cache(op, ctx, build, trace)
+        if served is not None:
+            return served
         # morsel-driven streaming (daft_tpu/stream/): a streamable segment
         # rooted here replaces its whole op chain with one pipelined
         # stream — bounded channels, producer stages on the worker pool,

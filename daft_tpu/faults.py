@@ -95,6 +95,14 @@ SITES = {
                    "DAFT_TPU_DIST_FAULT_SPEC — a delay_s plan SLOWS the "
                    "worker instead of failing it, the deterministic "
                    "straggler hook behind speculative execution)",
+    "plancache.lookup": "each plan-cache consult "
+                        "(daft_tpu/adapt/plancache.py; a failure degrades "
+                        "to uncached planning — the warm path fails OPEN, "
+                        "never a query failure)",
+    "resultcache.lookup": "each sub-plan result-cache consult "
+                          "(daft_tpu/adapt/resultcache.py; a failure "
+                          "degrades to plain execution of the prefix — "
+                          "fails open, never a query failure)",
 }
 
 
@@ -173,6 +181,15 @@ def arm(site: str, mode: str = "always", **kwargs) -> FaultPlan:
         _injected[site] = 0
         _armed = True
     return plan
+
+
+def any_armed() -> bool:
+    """True while ANY fault plan is armed. The adapt/ caches consult this
+    and stand down entirely under an armed registry: fault injection is a
+    determinism surface (a cached plan or replayed prefix would let an
+    armed site silently never fire), so chaos runs always execute for
+    real."""
+    return _armed
 
 
 def disarm(site: Optional[str] = None) -> None:

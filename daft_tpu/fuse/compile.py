@@ -242,8 +242,19 @@ class FusedMapOp(PhysicalOp):
         g = self.program.graph
         n_exprs = self.n_exprs
         body = summarize_exprs(self.program.output_exprs)
+        # masks (and scratch lets) are part of the chain's identity: the
+        # plan fingerprint hashes this display, so `where x > 5` and
+        # `where x > 9` must not collide just because fusion folded the
+        # filter out of the op list
+        segs = []
+        for lets, mask in self.program._host_segments:
+            if lets:
+                segs.append("let " + summarize_exprs(lets))
+            if mask is not None:
+                segs.append("where " + summarize_exprs([mask]))
+        tail = (" | " + " | ".join(segs)) if segs else ""
         return (f"FusedMap[{g.n_ops} ops, {n_exprs} exprs, "
-                f"{g.cse_hits} cse]: {body}")
+                f"{g.cse_hits} cse]: {body}{tail}")
 
     @property
     def n_exprs(self) -> int:

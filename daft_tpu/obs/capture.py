@@ -106,6 +106,10 @@ def render_runtime_stats(stats) -> str:
             f"fusion: {counters['fused_chains']} FusedMap chain(s), "
             f"{counters.get('fused_ops_eliminated', 0)} op(s) eliminated"
             f", {counters.get('cse_hits', 0)} cse hit(s)")
+    plan_line = _render_planning_line(counters)
+    if plan_line:
+        lines.append("")
+        lines.append(plan_line)
     strm = _render_streaming_line(counters)
     if strm:
         lines.append("")
@@ -119,6 +123,39 @@ def render_runtime_stats(stats) -> str:
         lines.append("counters: " + ", ".join(
             f"{k}={v}" for k, v in sorted(counters.items())))
     return "\n".join(lines)
+
+
+def _render_planning_line(counters: dict) -> str:
+    """The explain_analyze 'planning:' line (README "Plan & program
+    cache"): optimize+translate+fuse wall (the cost the plan cache's
+    warm path removes), the fuse-compile share, cache hit/miss for this
+    query, and any FDO decisions. Empty when nothing was recorded
+    (direct execute_plan without a runner)."""
+    ns = counters.get("planning_wall_ns", 0)
+    if not ns:
+        return ""
+    parts = [f"{ns / 1e6:.1f} ms"]
+    comp = counters.get("compile_wall_ns", 0)
+    if comp:
+        parts.append(f"compile {comp / 1e6:.1f} ms")
+    hits = counters.get("plan_cache_hits", 0)
+    misses = counters.get("plan_cache_misses", 0)
+    if hits or misses:
+        parts.append(f"plan cache {hits} hit / {misses} miss")
+    if counters.get("subplan_cache_hits"):
+        parts.append(
+            f"{counters['subplan_cache_hits']} prefix replay(s)")
+    fdo_bits = []
+    for key, label in (("fdo_join_flips", "join flip"),
+                       ("fdo_shuffle_resizes", "fan-out resize"),
+                       ("fdo_stream_hints", "stream hint"),
+                       ("fdo_mispredicts", "MISPREDICT")):
+        n = counters.get(key, 0)
+        if n:
+            fdo_bits.append(f"{n} {label}(s)")
+    if fdo_bits:
+        parts.append("fdo: " + ", ".join(fdo_bits))
+    return "planning: " + " · ".join(parts)
 
 
 def _render_streaming_line(counters: dict) -> str:

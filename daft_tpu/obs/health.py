@@ -112,6 +112,41 @@ def breaker_states() -> Dict[str, str]:
     return out
 
 
+_PLAN_CACHE_IDLE = {"entries": 0, "bindings": 0, "bytes": 0, "hits": 0,
+                    "misses": 0, "evictions": 0, "demotions": 0,
+                    "errors": 0, "result_entries": 0, "result_bytes": 0,
+                    "result_hits": 0, "result_misses": 0,
+                    "result_evictions": 0, "history_sites": 0,
+                    "history_queries": 0, "history_mispredicts": 0}
+
+
+def _plan_cache_snapshot() -> dict:
+    """Plan-cache + sub-plan result-cache + FDO-history view shared by the
+    health snapshot and the gauge mirror (one fallback shape, like
+    streaming's)."""
+    try:
+        from ..adapt.history import HISTORY
+        from ..adapt.plancache import PLAN_CACHE
+        from ..adapt.resultcache import RESULT_CACHE
+
+        pc = PLAN_CACHE.snapshot()
+        rc = RESULT_CACHE.snapshot()
+        h = HISTORY.snapshot()
+        return {
+            "entries": pc["entries"], "bindings": pc["bindings"],
+            "bytes": pc["bytes"], "hits": pc["hits"],
+            "misses": pc["misses"], "evictions": pc["evictions"],
+            "demotions": pc["demotions"], "errors": pc["errors"],
+            "result_entries": rc["entries"], "result_bytes": rc["bytes"],
+            "result_hits": rc["hits"], "result_misses": rc["misses"],
+            "result_evictions": rc["evictions"],
+            "history_sites": h["sites"], "history_queries": h["queries"],
+            "history_mispredicts": h["mispredicts"],
+        }
+    except Exception:
+        return dict(_PLAN_CACHE_IDLE)
+
+
 def _streaming_snapshot() -> dict:
     """Channel-occupancy view shared by the health snapshot and the gauge
     mirror — one fallback shape, so a new channels_snapshot key can never
@@ -168,6 +203,7 @@ def engine_health() -> dict:
         "admission": admission_state(),
         "cluster": cluster_state(),
         "streaming": streaming,
+        "plan_cache": _plan_cache_snapshot(),
         "query_log": {
             "depth": len(QUERY_LOG),
             "capacity": QUERY_LOG.capacity,
@@ -278,6 +314,32 @@ def refresh_health_gauges(registry=None) -> None:
     reg.gauge("daft_tpu_cluster_speculation_wins_total",
               "speculative duplicates that beat the original").set(
         clu.get("speculation_wins_total", 0))
+    pc = _plan_cache_snapshot()
+    reg.gauge("daft_tpu_plan_cache_entries",
+              "plan/program cache entries (canonical shapes)").set(
+        pc["entries"])
+    reg.gauge("daft_tpu_plan_cache_bytes",
+              "estimated bytes held by the plan/program cache").set(
+        pc["bytes"])
+    reg.gauge("daft_tpu_plan_cache_hits_total",
+              "plan-cache hits (warm plans served)").set(pc["hits"])
+    reg.gauge("daft_tpu_plan_cache_misses_total",
+              "plan-cache misses (cold plans built)").set(pc["misses"])
+    reg.gauge("daft_tpu_plan_cache_evictions_total",
+              "plan-cache entries shed by the LRU byte cap").set(
+        pc["evictions"])
+    reg.gauge("daft_tpu_plan_cache_demotions_total",
+              "plan-cache entries demoted (FDO mispredict/"
+              "revalidation)").set(pc["demotions"])
+    reg.gauge("daft_tpu_subplan_cache_entries",
+              "sub-plan result-cache entries (memoized prefixes)").set(
+        pc["result_entries"])
+    reg.gauge("daft_tpu_subplan_cache_bytes",
+              "bytes held by the sub-plan result cache").set(
+        pc["result_bytes"])
+    reg.gauge("daft_tpu_subplan_cache_hits_total",
+              "sub-plan result-cache hits (prefixes replayed)").set(
+        pc["result_hits"])
     adm = admission_state()
     reg.gauge("daft_tpu_admission_active_queries",
               "queries holding an execution slot").set(
@@ -306,6 +368,7 @@ _TOP_KEYS = {
     "admission": dict,
     "cluster": dict,
     "streaming": dict,
+    "plan_cache": dict,
     "query_log": dict,
     "log": dict,
     "queries_total": int,
@@ -346,6 +409,9 @@ def validate_health(d: dict) -> List[str]:
     for k in ("active_channels", "queued_morsels", "queued_bytes"):
         if not isinstance(d["streaming"].get(k), int):
             errs.append(f"streaming.{k} missing or non-int")
+    for k in _PLAN_CACHE_IDLE:
+        if not isinstance(d["plan_cache"].get(k), int):
+            errs.append(f"plan_cache.{k} missing or non-int")
     for k in ("workers", "workers_alive", "workers_restarting",
               "workers_tripped", "tasks_inflight",
               "task_redispatches_total", "worker_losses_total",

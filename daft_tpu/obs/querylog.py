@@ -144,9 +144,16 @@ def build_record(query_id: str, fingerprint: str, plan_ops: Dict[str, int],
                  cfg, stats, wall_ns: int, outcome: str,
                  error: Optional[BaseException] = None,
                  profiled: bool = False,
-                 rows_emitted: int = 0) -> dict:
+                 rows_emitted: int = 0,
+                 canonical: str = "") -> dict:
     """One QueryRecord from already-collected state. Never raises on a
-    degraded environment (ledger unavailable at teardown -> {})."""
+    degraded environment (ledger unavailable at teardown -> {}).
+
+    ``canonical`` is the literal-masked shape fingerprint
+    (adapt/fingerprint.py): ``WHERE x > 5`` and ``WHERE x > 9`` share it
+    while ``plan_fingerprint`` keeps them apart — the plan cache and FDO
+    history key on the former, auto-capture identity on the latter.
+    Empty when the execution bypassed the planner (direct execute_plan)."""
     snap = stats.snapshot()
     counters = snap["counters"]
     try:
@@ -168,8 +175,15 @@ def build_record(query_id: str, fingerprint: str, plan_ops: Dict[str, int],
         "wall_s": round(wall_ns / 1e9, 6),
         "outcome": outcome,
         "plan_fingerprint": fingerprint,
+        "plan_fingerprint_canonical": canonical,
         "plan_ops": dict(plan_ops),
         "config_delta": config_delta(cfg),
+        # planning time made visible (the very cost the plan cache
+        # removes): optimize+translate+fuse wall on a cold plan, cache
+        # lookup+rehydrate wall on a warm one; compile_ms is the
+        # fuse-compile share
+        "planning_ms": round(counters.get("planning_wall_ns", 0) / 1e6, 3),
+        "compile_ms": round(counters.get("compile_wall_ns", 0) / 1e6, 3),
         "rows_emitted": int(rows_emitted),
         "op_rows": dict(snap["op_rows"]),
         "op_wall_ms": {k: round(v / 1e6, 3)
@@ -214,6 +228,9 @@ _TOP_KEYS = {
     "wall_s": (int, float),
     "outcome": str,
     "plan_fingerprint": str,
+    "plan_fingerprint_canonical": str,
+    "planning_ms": (int, float),
+    "compile_ms": (int, float),
     "plan_ops": dict,
     "config_delta": dict,
     "op_rows": dict,

@@ -509,6 +509,18 @@ class ShuffleOp(PhysicalOp):
     filter_feed = None    # JoinFilterSlot this build-side exchange populates
     probe_filter = None   # JoinFilterSlot whose sealed filter prunes here
     combine = None        # (stage2_aggs, key_cols) pre-exchange fold spec
+    # FDO observation key (daft_tpu/adapt/): when set, the payload that
+    # actually crossed this exchange is recorded under this canonical
+    # subtree fingerprint at query end — the history future plans read
+    fdo_obs_key = None
+    # FDO fan-out resize (daft_tpu/adapt/fdo.py): emit this many output
+    # partitions by concatenating ADJACENT hash buckets at reduce time.
+    # Hashing stays modulo `num`, so group co-location, combine folds,
+    # and — because per-bucket group sets are disjoint and
+    # first-occurrence order composes — the OUTPUT ROW ORDER are all
+    # byte-identical to the unresized exchange; only the partition count
+    # (stage-2 invocations, downstream fan-in) shrinks. None = off.
+    reduce_to = None
 
     def __init__(self, child: PhysicalOp, scheme: str, num: int,
                  by: Optional[List[Expression]] = None,
@@ -559,11 +571,16 @@ class ShuffleOp(PhysicalOp):
         if slot.filter() is not None:
             ctx.stats.bump("join_filter_built")
 
-    def _prune_stream(self, stream, ctx) -> PartStream:
+    def _prune_stream(self, stream, ctx, obs=None) -> PartStream:
         """Probe-side pass-through: prune each partition with the sealed
         build-side filter BEFORE bucketing/spill/merge. The slot is
         consulted per partition (None — unsealed, abandoned, disabled —
-        passes rows through untouched)."""
+        passes rows through untouched).
+
+        ``obs`` is the shuffle's FDO observation accumulator: what
+        pruning removed is added BACK there, so the side's recorded size
+        is the pre-prune truth — a broadcast flip seeded from post-prune
+        bytes would materialize the side UNPRUNED and mispredict."""
         from .exchange.joinfilter import prune_partition
 
         slot = self.probe_filter
@@ -575,16 +592,26 @@ class ShuffleOp(PhysicalOp):
                 # — the mesh exchange skips it by owner instead
                 yield p
             else:
-                yield prune_partition(p, jf, self.by, ctx)
+                out = prune_partition(p, jf, self.by, ctx)
+                if obs is not None and p.is_loaded():
+                    # prune_partition forced the load; both sizes are free
+                    pre_r = p.num_rows_or_none() or 0
+                    pre_b = p.size_bytes() or 0
+                    post_r = out.num_rows_or_none() or 0
+                    post_b = out.size_bytes() or 0
+                    obs[0] += max(0, pre_r - post_r)
+                    obs[1] += max(0, pre_b - post_b)
+                yield out
 
     def execute(self, inputs, ctx) -> PartStream:
         n = self.num
         src = inputs[0]
+        fdo_obs = [0, 0] if self.fdo_obs_key is not None else None
         if self.filter_feed is not None:
             src = self._feed_filter(src, ctx)
         if self.probe_filter is not None \
                 and getattr(ctx.cfg, "runtime_join_filters", True):
-            src = self._prune_stream(src, ctx)
+            src = self._prune_stream(src, ctx, obs=fdo_obs)
         combine = (self.combine if self.combine is not None and
                    getattr(ctx.cfg, "hierarchical_exchange_combine", True)
                    else None)
@@ -649,6 +676,9 @@ class ShuffleOp(PhysicalOp):
             raw = piece.size_bytes() or 0
             if raw:
                 ctx.stats.bump("exchange_bytes", raw)
+            if fdo_obs is not None:
+                fdo_obs[0] += nrows or 0
+                fdo_obs[1] += raw
             if encode:
                 enc_bytes = raw
                 try:
@@ -769,22 +799,55 @@ class ShuffleOp(PhysicalOp):
                 if comb is not None:
                     for b, part in comb.finish():
                         exchange_append(b, part)
+        if fdo_obs is not None and saw:
+            ctx.stats.fdo_observe(self.fdo_obs_key, fdo_obs[0], fdo_obs[1])
         if not saw:
             return
         ctx.stats.bump("shuffles")
+        k = (self.reduce_to
+             if self.reduce_to is not None and 0 < self.reduce_to < n
+             else None)
+        if k is None:
+            for i in range(n):
+                if i + 1 < n:
+                    # unspill readahead across the reduce side: bucket
+                    # i+1's spilled pieces re-materialize on the pool
+                    # while the consumer works on bucket i
+                    buckets[i + 1].preload()
+                if len(buckets[i]):
+                    with ctx.stats.profiler.span("shuffle.merge",
+                                                 kind="phase"):
+                        merged = MicroPartition.concat(buckets[i].parts())
+                    yield merged
+                else:
+                    yield MicroPartition.empty(self.schema)
+                buckets[i].release()
+            return
+        # FDO reduce-side fan-in: adjacent buckets merge into k outputs
+        # (bucket i -> output i*k//n), in bucket order — byte-identical
+        # rows AND row order vs the k=None loop's concatenated outputs
+        groups: List[List[int]] = [[] for _ in range(k)]
         for i in range(n):
-            if i + 1 < n:
-                # unspill readahead across the reduce side: bucket i+1's
-                # spilled pieces re-materialize on the pool while the
-                # consumer works on bucket i
-                buckets[i + 1].preload()
-            if len(buckets[i]):
-                with ctx.stats.profiler.span("shuffle.merge", kind="phase"):
-                    merged = MicroPartition.concat(buckets[i].parts())
+            groups[i * k // n].append(i)
+        ctx.stats.bump("fdo_reduced_partitions", n - k)
+        for g, idxs in enumerate(groups):
+            if g + 1 < k:
+                for j in groups[g + 1]:
+                    buckets[j].preload()
+            parts: List[MicroPartition] = []
+            for i in idxs:
+                if len(buckets[i]):
+                    parts.extend(buckets[i].parts())
+            if parts:
+                with ctx.stats.profiler.span("shuffle.merge",
+                                             kind="phase"):
+                    merged = (MicroPartition.concat(parts)
+                              if len(parts) > 1 else parts[0])
                 yield merged
             else:
                 yield MicroPartition.empty(self.schema)
-            buckets[i].release()
+            for i in idxs:
+                buckets[i].release()
 
     def describe(self):
         by = ", ".join(e._node.display() for e in self.by)
@@ -795,6 +858,8 @@ class ShuffleOp(PhysicalOp):
             tags.append("join-filter-probe")
         if self.combine is not None:
             tags.append("combine")
+        if self.reduce_to is not None:
+            tags.append(f"fdo-reduce {self.reduce_to}")
         tag = f" <{'+'.join(tags)}>" if tags else ""
         return (f"Shuffle[{self.scheme}] -> {self.num}"
                 + (f" by [{by}]" if by else "") + tag)
@@ -1207,6 +1272,12 @@ class BroadcastJoinOp(PhysicalOp):
     """Collect the small side fully, stream the large side (reference:
     broadcast join strategy, translate.rs join planning)."""
 
+    # set by _translate_join when FDO history (not a static estimate)
+    # chose this strategy: (site_fp, max_bytes) mispredict guard + the
+    # observation key that keeps the side's history current
+    fdo_guard = None
+    fdo_obs_key = None
+
     def __init__(self, big: PhysicalOp, small: PhysicalOp, big_on, small_on,
                  how: str, schema: Schema, small_is_left: bool, suffix: str = "right."):
         super().__init__([big, small], schema, big.num_partitions)
@@ -1268,6 +1339,20 @@ class BroadcastJoinOp(PhysicalOp):
             # the per-pair join; semantics gated per join type)
             jf = self._build_small_filter(small, ctx)
         ctx.stats.bump("broadcast_joins")
+        small_bytes = small.size_bytes() or 0
+        if self.fdo_obs_key is not None:
+            # keep the side's history current even while the broadcast
+            # plan serves — a grown side reverts the decision next plan
+            ctx.stats.fdo_observe(self.fdo_obs_key, len(small), small_bytes)
+        if self.fdo_guard is not None and small_bytes > self.fdo_guard[1]:
+            # history said broadcast; the side arrived big. The query
+            # completes on this (correct, merely slower) plan — the entry
+            # is demoted and the next plan degrades to the uncached hash
+            # strategy from the fresh observation above.
+            from .adapt.fdo import note_broadcast_mispredict
+
+            note_broadcast_mispredict(self.fdo_guard, small_bytes, ctx,
+                                      getattr(ctx, "canonical_fp", ""))
 
         def pairs():
             from .exchange.joinfilter import prune_partition
@@ -1615,17 +1700,27 @@ def _is_pure_column_selection(exprs) -> bool:
     return True
 
 
-def translate(plan: LogicalPlan, cfg, morsels: bool = False) -> PhysicalOp:
+def translate(plan: LogicalPlan, cfg, morsels: bool = False,
+              stats=None) -> PhysicalOp:
     """Public entry: recursive translation + device-path fusion + map-chain
     fusion, so every caller (runners, explain, adaptive) sees the tree that
     actually runs. fuse_for_device runs FIRST so a filter feeding an
     aggregation folds into FusedFilterAggregateOp; fuse_map_chains then
-    collapses the residual Project/Filter chains (the passes compose)."""
+    collapses the residual Project/Filter chains (the passes compose).
+
+    ``stats`` (when given) receives ``compile_wall_ns`` — the fuse-compile
+    share of planning, the cost the plan cache's warm path removes and
+    which must therefore stay measurable (README "Plan & program cache")."""
+    import time as _time
+
     out = fuse_for_device(_translate(plan, cfg, morsels), cfg)
     if getattr(cfg, "expr_fusion", True):
         from .fuse import fuse_map_chains
 
+        t0 = _time.perf_counter_ns()
         out = fuse_map_chains(out, cfg)
+        if stats is not None:
+            stats.bump("compile_wall_ns", _time.perf_counter_ns() - t0)
     return out
 
 
@@ -1748,7 +1843,23 @@ def _translate_aggregate(plan: Aggregate, cfg) -> PhysicalOp:
     p1 = AggregateOp(child, stage1, plan.groupby,
                      _stage_schema(plan.input.schema, stage1, plan.groupby))
     if plan.groupby:
+        from .adapt import fdo as _fdo
+
+        # feedback-directed fan-out: the internal exchange of a repeated
+        # aggregation shape emits only as many partitions as its RECORDED
+        # map-side payload needs (shrink-only; engine-chosen counts only).
+        # Hash modulus stays nparts and adjacent buckets merge at reduce
+        # time, so rows AND row order are byte-identical to the unresized
+        # plan — only the partition count (stage-2 invocations,
+        # downstream fan-in) shrinks.
         exchanged: PhysicalOp = ShuffleOp(p1, "hash", nparts, key_cols)
+        resized = _fdo.agg_shuffle_fanout(plan, nparts)
+        if resized:
+            exchanged.reduce_to = resized
+            exchanged.num_partitions = resized
+        okey = _fdo.agg_observation_key(plan)
+        if okey:
+            exchanged.fdo_obs_key = okey
         # hierarchical exchange: fold map-side pieces headed to the same
         # destination through the stage-2 combine BEFORE they buffer
         # (intra-host combine -> inter-host all_to_all; the mesh path
@@ -1830,6 +1941,8 @@ def _cast_to(op: PhysicalOp, schema: Schema) -> PhysicalOp:
 
 
 def _translate_join(plan: Join, cfg) -> PhysicalOp:
+    from .adapt import fdo as _fdo
+
     left = _translate(plan.left, cfg)
     right = _translate(plan.right, cfg)
 
@@ -1837,8 +1950,16 @@ def _translate_join(plan: Join, cfg) -> PhysicalOp:
         return CrossJoinOp(left, right, plan.schema, plan.suffix)
 
     strategy = plan.strategy
+    fdo_side = None
     if strategy is None:
-        strategy = _choose_join_strategy(plan, cfg)
+        # feedback-directed flip (daft_tpu/adapt/fdo.py): a side whose
+        # RECORDED size sits safely under the broadcast threshold flips
+        # this join on the first run of a repeated shape — no AQE
+        # materialization barrier needed. Active only inside a planning
+        # collector scope; declines everywhere else.
+        fdo_side = _fdo.join_strategy_hint(plan)
+        strategy = ("broadcast" if fdo_side is not None
+                    else _choose_join_strategy(plan, cfg))
     if strategy == "broadcast" and plan.how == "outer":
         # an outer join preserves both sides; replaying the replicated side per
         # big-side partition would duplicate its unmatched rows
@@ -1847,14 +1968,26 @@ def _translate_join(plan: Join, cfg) -> PhysicalOp:
     if strategy == "broadcast":
         lsize = plan.left.approx_size_bytes()
         rsize = plan.right.approx_size_bytes()
-        broadcast_left = _broadcast_side(plan, lsize, rsize) == "left"
+        if fdo_side is not None:
+            broadcast_left = fdo_side == "left"
+        else:
+            broadcast_left = _broadcast_side(plan, lsize, rsize) == "left"
         if broadcast_left:
-            return BroadcastJoinOp(right, left, plan.right_on, plan.left_on,
-                                   plan.how, plan.schema, small_is_left=True,
-                                   suffix=plan.suffix)
-        return BroadcastJoinOp(left, right, plan.left_on, plan.right_on,
-                               plan.how, plan.schema, small_is_left=False,
-                               suffix=plan.suffix)
+            op = BroadcastJoinOp(right, left, plan.right_on, plan.left_on,
+                                 plan.how, plan.schema, small_is_left=True,
+                                 suffix=plan.suffix)
+        else:
+            op = BroadcastJoinOp(left, right, plan.left_on, plan.right_on,
+                                 plan.how, plan.schema, small_is_left=False,
+                                 suffix=plan.suffix)
+        if fdo_side is not None:
+            # runtime mispredict detector: the materialized small side is
+            # checked against the guard; history keeps observing it so a
+            # grown side reverts the decision on the next plan
+            op.fdo_guard = _fdo.broadcast_guard(plan, fdo_side)
+            op.fdo_obs_key = _fdo.observation_key(
+                plan.left if fdo_side == "left" else plan.right)
+        return op
 
     if strategy == "sort_merge":
         return SortMergeJoinOp(left, right, plan.left_on, plan.right_on,
@@ -1865,6 +1998,15 @@ def _translate_join(plan: Join, cfg) -> PhysicalOp:
     if nparts > 1:
         lshuf = ShuffleOp(left, "hash", nparts, plan.left_on)
         rshuf = ShuffleOp(right, "hash", nparts, plan.right_on)
+        # FDO observation: each side's exchange records the rows/bytes
+        # that actually crossed it, keyed by the side's canonical subtree
+        # fingerprint — the history a future plan's broadcast flip reads
+        lkey = _fdo.observation_key(plan.left)
+        if lkey:
+            lshuf.fdo_obs_key = lkey
+        rkey = _fdo.observation_key(plan.right)
+        if rkey:
+            rshuf.fdo_obs_key = rkey
         # runtime join filter (sideways information passing): the left
         # exchange — drained first by HashJoinOp — builds a Bloom+min-max
         # filter from its keys; the right exchange prunes with it before

@@ -18,8 +18,6 @@ from .context import get_context
 from .execution import ExecutionContext, RuntimeStats, execute_plan
 from .logical import LogicalPlan
 from .micropartition import MicroPartition
-from .optimizer import optimize
-from .physical import translate
 from .schema import Schema
 
 
@@ -240,13 +238,24 @@ class Runner:
                    optimized: bool = False) -> Iterator[MicroPartition]:
         raise NotImplementedError
 
-    def optimize_and_translate(self, plan: LogicalPlan, optimized: bool = False):
-        from .physical import fuse_for_device
+    def plan_query(self, plan: LogicalPlan, optimized: bool = False,
+                   stats=None):
+        """FDO-informed planning, served from the process plan cache when
+        possible (daft_tpu/adapt/plancache.py). Returns
+        ``(optimized_plan, physical_plan, run_cfg)`` — ``run_cfg`` may
+        carry a per-query history hint (e.g. streaming off). Planning
+        wall (and the fuse-compile share) lands in ``stats`` as
+        ``planning_wall_ns`` / ``compile_wall_ns``."""
+        from .adapt.plancache import plan_query
 
         ctx = get_context()
-        opt = plan if optimized else optimize(plan)
-        phys = translate(opt, ctx.execution_config)
-        phys = fuse_for_device(phys, ctx.execution_config)
+        return plan_query(plan, ctx.execution_config, stats=stats,
+                          optimized=optimized, runner=self.name)
+
+    def optimize_and_translate(self, plan: LogicalPlan, optimized: bool = False,
+                               stats=None):
+        opt, phys, _ = self.plan_query(plan, optimized=optimized,
+                                       stats=stats)
         return opt, phys
 
 
@@ -255,9 +264,9 @@ class NativeRunner(Runner):
 
     def _run_plain(self, plan: LogicalPlan, qctx,
                    optimized: bool = False) -> Iterator[MicroPartition]:
-        ctx = get_context()
-        _, phys = self.optimize_and_translate(plan, optimized)
-        exec_ctx = ExecutionContext(ctx.execution_config, qctx=qctx)
+        _, phys, run_cfg = self.plan_query(plan, optimized,
+                                           stats=qctx.stats)
+        exec_ctx = ExecutionContext(run_cfg, qctx=qctx)
         return execute_plan(phys, exec_ctx)
 
 
@@ -272,10 +281,10 @@ class MeshRunner(Runner):
 
     def _run_plain(self, plan: LogicalPlan, qctx,
                    optimized: bool = False) -> Iterator[MicroPartition]:
-        ctx = get_context()
-        _, phys = self.optimize_and_translate(plan, optimized)
+        _, phys, run_cfg = self.plan_query(plan, optimized,
+                                           stats=qctx.stats)
         from .parallel.mesh_exec import MeshExecutionContext
 
-        exec_ctx = MeshExecutionContext(ctx.execution_config,
+        exec_ctx = MeshExecutionContext(run_cfg,
                                         mesh=self.mesh, qctx=qctx)
         return execute_plan(phys, exec_ctx)

@@ -136,6 +136,27 @@ class MemoryLedger:
         # metrics flag operators alert on — a full spill device turns a
         # bounded-memory engine back into an in-memory one
         self.disk_full_events = 0
+        # process-level cache accounts (daft_tpu/adapt/): plan/program
+        # cache and sub-plan result cache resident bytes. NOT in
+        # `current` — they are process-lifetime state shed by their own
+        # LRU caps, not per-query working set the spill machinery should
+        # react to; the accounts exist so dt.health()/metrics expose
+        # exactly where cache memory sits
+        self.plan_cache_bytes = 0
+        self.subplan_cache_bytes = 0
+
+    def cache_account(self, account: str, delta: int) -> None:
+        """Charge/release one of the process cache accounts
+        (``plan_cache_bytes`` / ``subplan_cache_bytes``); clamped at 0."""
+        if account not in ("plan_cache_bytes", "subplan_cache_bytes"):
+            from .errors import DaftValueError
+
+            raise DaftValueError(f"unknown cache account {account!r}")
+        with self._lock:
+            v = getattr(self, account) + delta
+            setattr(self, account, max(0, v))  # daftlint: disable=DTL002
+        if self._parent is not None:
+            self._parent.cache_account(account, delta)
 
     def disk_full(self) -> None:
         with self._lock:
@@ -344,6 +365,8 @@ class MemoryLedger:
                 "unspill_bytes": self.unspill_bytes,
                 "unspill_ns": self.unspill_ns,
                 "disk_full_events": self.disk_full_events,
+                "plan_cache_bytes": self.plan_cache_bytes,
+                "subplan_cache_bytes": self.subplan_cache_bytes,
             }
 
 

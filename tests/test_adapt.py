@@ -1,0 +1,839 @@
+"""daft_tpu/adapt/: plan/program cache + feedback-directed optimization +
+sub-plan result cache (ISSUE 13).
+
+Pins the subsystem's contracts:
+- warm-path proof: the 2nd run of an identical query performs ZERO
+  optimize()/translate()/fuse-compile calls and is byte-identical to the
+  cold run and to cache-off;
+- canonical fingerprints: literal-invariant, structure-sensitive, and
+  stable across spawned interpreters (two-process test);
+- the invalidation matrix: config delta, source mtime, integrity/lineage
+  knob toggles, cache-version/generation bumps — no stale plan or stale
+  result is ever served;
+- concurrent serving hammer: exactly-once compile per shape;
+- FDO: a broadcast-vs-hash flip made from RECORDED history on the first
+  run of a repeated shape, byte-identical results, and the mispredict
+  path demoting the entry without query failure; aggregate-exchange
+  fan-out resize; streaming stand-down hint;
+- sub-plan result cache: prefix replay, mtime invalidation, byte cap,
+  declines (UDF, budget, knob off);
+- health/gauge surfaces + ledger cache accounts.
+"""
+
+import contextlib
+import os
+import subprocess
+import sys
+import threading
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col
+from daft_tpu.adapt import fdo
+from daft_tpu.adapt.fingerprint import (canonical_fingerprint,
+                                        canonical_site_fp)
+from daft_tpu.adapt.history import HISTORY
+from daft_tpu.adapt.plancache import PLAN_CACHE, clone_plan
+from daft_tpu.adapt.resultcache import RESULT_CACHE
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CFG_KEYS = (
+    "plan_cache", "plan_cache_bytes", "history_fdo",
+    "subplan_result_cache", "subplan_cache_bytes", "enable_result_cache",
+    "broadcast_join_size_bytes_threshold", "memory_budget_bytes",
+    "morsel_size_rows", "partition_integrity", "lineage_recomputation",
+    "streaming_execution", "scan_prefetch_depth", "executor_threads",
+    "shuffle_target_partition_bytes", "expr_fusion",
+)
+
+
+@pytest.fixture
+def cfg():
+    from daft_tpu.context import get_context
+
+    c = get_context().execution_config
+    saved = {k: getattr(c, k) for k in _CFG_KEYS}
+    c.enable_result_cache = False  # exercise execution, not whole-plan hits
+    PLAN_CACHE.clear()
+    RESULT_CACHE.clear()
+    HISTORY.clear()
+    yield c
+    for k, v in saved.items():
+        setattr(c, k, v)
+    PLAN_CACHE.clear()
+    RESULT_CACHE.clear()
+    HISTORY.clear()
+
+
+@contextlib.contextmanager
+def counting_planner():
+    """Count every optimize() / _translate() / fuse compile_chain() call —
+    the three costs the warm path must not pay."""
+    import daft_tpu.fuse.compile as fuse_compile
+    import daft_tpu.optimizer as optimizer_mod
+    import daft_tpu.physical as physical_mod
+
+    calls = {"optimize": 0, "translate": 0, "fuse_compile": 0}
+    real = (optimizer_mod.optimize, physical_mod.translate,
+            fuse_compile.compile_chain)
+
+    def opt(p, *a, **k):
+        calls["optimize"] += 1
+        return real[0](p, *a, **k)
+
+    def tr(p, *a, **k):
+        calls["translate"] += 1
+        return real[1](p, *a, **k)
+
+    def fc(*a, **k):
+        calls["fuse_compile"] += 1
+        return real[2](*a, **k)
+
+    optimizer_mod.optimize = opt
+    physical_mod.translate = tr
+    fuse_compile.compile_chain = fc
+    try:
+        yield calls
+    finally:
+        optimizer_mod.optimize = real[0]
+        physical_mod.translate = real[1]
+        fuse_compile.compile_chain = real[2]
+
+
+def _write_parquet(path, nrows=2000, nkeys=5, scale=1.0):
+    papq.write_table(pa.table({
+        "k": [i % nkeys for i in range(nrows)],
+        "v": [float(i) * scale for i in range(nrows)],
+    }), str(path))
+
+
+# ---------------------------------------------------------------------------
+# canonical fingerprints
+# ---------------------------------------------------------------------------
+
+class TestCanonicalFingerprint:
+    def test_same_shape_same_fp(self, cfg):
+        df = dt.from_pydict({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]})
+        p1 = df.where(col("a") > 2).select(col("b"))._plan
+        p2 = df.where(col("a") > 2).select(col("b"))._plan
+        assert canonical_fingerprint(p1) == canonical_fingerprint(p2)
+
+    def test_literals_masked_structure_not(self, cfg):
+        df = dt.from_pydict({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]})
+        base = df.where(col("a") > 2).select(col("b"))._plan
+        other_lit = df.where(col("a") > 9).select(col("b"))._plan
+        other_col = df.where(col("b") > 2).select(col("b"))._plan
+        other_op = df.where(col("a") < 2).select(col("b"))._plan
+        fp = canonical_fingerprint(base)
+        assert canonical_fingerprint(other_lit) == fp
+        assert canonical_fingerprint(other_col) != fp
+        assert canonical_fingerprint(other_op) != fp
+
+    def test_literal_dtype_stays_identity(self, cfg):
+        from daft_tpu import lit
+        from daft_tpu.datatypes import DataType
+
+        df = dt.from_pydict({"a": [1, 2, 3]})
+        weak = df.where(col("a") > lit(2))._plan
+        strong = df.where(col("a") > lit(2, DataType.int8()))._plan
+        assert canonical_fingerprint(weak) != canonical_fingerprint(strong)
+
+    def test_site_fp_distinguishes_data_identity(self, cfg):
+        # two frames sharing a schema must NOT share observation history
+        a = dt.from_pydict({"a": [1, 2, 3]})._plan
+        b = dt.from_pydict({"a": [4, 5, 6]})._plan
+        assert canonical_fingerprint(a) == canonical_fingerprint(b)
+        assert canonical_site_fp(a) != canonical_site_fp(b)
+
+    def test_records_carry_both_fingerprints(self, cfg):
+        df = dt.from_pydict({"a": [1, 2, 3, 4], "b": [1.0, 2.0, 3.0, 4.0]})
+        q1 = df.where(col("a") > 2).select(col("b")).collect()
+        q2 = df.where(col("a") > 1).select(col("b")).collect()
+        r1, r2 = q1.last_query_record(), q2.last_query_record()
+        assert r1["plan_fingerprint_canonical"]
+        assert r1["plan_fingerprint_canonical"] == \
+            r2["plan_fingerprint_canonical"]
+        assert r1["plan_fingerprint"] != r2["plan_fingerprint"]
+        assert r1["planning_ms"] > 0
+
+    def test_cross_process_stability(self, cfg, tmp_path):
+        """Same plan shape -> same canonical fingerprint in two SPAWNED
+        interpreters; different literals -> same canonical, different
+        exact (the satellite's pinned contract)."""
+        script = (
+            "import os; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+            f"import sys; sys.path.insert(0, {_ROOT!r})\n"
+            "import daft_tpu as dt\n"
+            "from daft_tpu import col\n"
+            "from daft_tpu.adapt.fingerprint import canonical_fingerprint\n"
+            "from daft_tpu.obs.querylog import plan_signature\n"
+            "from daft_tpu.context import get_context\n"
+            "from daft_tpu.physical import translate, fuse_for_device\n"
+            "from daft_tpu.optimizer import optimize\n"
+            "df = dt.from_pydict({'a': [1, 2, 3], 'b': [1.0, 2.0, 3.0]})\n"
+            "cfg = get_context().execution_config\n"
+            "out = []\n"
+            "for lit in (5, 9):\n"
+            "    plan = df.where(col('a') > lit).select(col('b'))._plan\n"
+            "    phys = fuse_for_device(translate(optimize(plan), cfg), cfg)\n"
+            "    out.append(canonical_fingerprint(plan))\n"
+            "    out.append(plan_signature(phys)[0])\n"
+            "print('|'.join(out))\n")
+        lines = []
+        for _ in range(2):
+            res = subprocess.run([sys.executable, "-c", script],
+                                 capture_output=True, text=True, timeout=180)
+            assert res.returncode == 0, res.stderr
+            lines.append(res.stdout.strip().splitlines()[-1])
+        c5a, e5a, c9a, e9a = lines[0].split("|")
+        c5b, e5b, c9b, e9b = lines[1].split("|")
+        assert c5a == c5b == c9a == c9b  # canonical: literal- and process-invariant
+        assert e5a == e5b and e9a == e9b  # exact: process-invariant
+        assert e5a != e9a                 # exact: literal-sensitive
+
+
+# ---------------------------------------------------------------------------
+# plan cache: warm path + invalidation matrix
+# ---------------------------------------------------------------------------
+
+class TestPlanCacheWarmPath:
+    def test_second_run_zero_planning_and_byte_identical(self, cfg):
+        # in-memory source: the Project/Filter chain survives optimize
+        # (scan sources absorb filters as pushdowns), so the fuse
+        # compiler is part of the cold cost the warm path must skip
+        df = dt.from_pydict({"k": [i % 5 for i in range(2000)],
+                             "v": [float(i) for i in range(2000)]})
+
+        def query():
+            return (df.with_column("w", col("v") * 2.0)
+                    .where(col("w") > 10.0)
+                    .groupby("k").agg(col("w").sum().alias("s"))
+                    .sort("k"))
+
+        cfg.subplan_result_cache = False  # isolate the PLAN cache's effect
+        with counting_planner() as calls:
+            cold = query().collect()
+            want = cold.to_arrow()
+            cold_calls = dict(calls)
+            assert cold_calls["optimize"] == 1
+            assert cold_calls["fuse_compile"] >= 1
+            warm = query().collect()
+            assert calls == cold_calls, (
+                f"warm run planned: {calls} vs {cold_calls}")
+        c = warm.stats.snapshot()["counters"]
+        assert c.get("plan_cache_hits") == 1
+        assert c.get("planning_wall_ns", 0) > 0  # lookup+rehydrate, measured
+        assert warm.to_arrow() == want
+        # cache-off control: byte-identical too
+        cfg.plan_cache = False
+        off = query().collect()
+        assert off.to_arrow() == want
+        assert "plan_cache_hits" not in off.stats.snapshot()["counters"]
+
+    def test_concurrent_hammer_exactly_once_compile(self, cfg, tmp_path):
+        _write_parquet(tmp_path / "t.parquet")
+        path = str(tmp_path / "t.parquet")
+        cfg.subplan_result_cache = False
+
+        def query():
+            return (dt.read_parquet(path)
+                    .with_column("w", col("v") + 1.0)
+                    .groupby("k").agg(col("w").sum().alias("s"))
+                    .sort("k"))
+
+        want = None
+        errors = []
+        results = []
+        lock = threading.Lock()
+
+        def worker():
+            try:
+                for _ in range(3):
+                    got = query().to_pydict()
+                    with lock:
+                        results.append(got)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        with counting_planner() as calls:
+            want = query().to_pydict()  # sequential warm-up: the 1 cold plan
+            threads = [threading.Thread(target=worker) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert calls["optimize"] == 1, calls
+            assert calls["translate"] == 1, calls
+        assert not errors, errors
+        assert len(results) == 24
+        assert all(r == want for r in results)
+        snap = PLAN_CACHE.snapshot()
+        assert snap["hits"] == 24
+        assert snap["misses"] == 1
+
+    def test_concurrent_cold_misses_single_flight(self, cfg, tmp_path):
+        """8 threads racing the SAME cold shape compile exactly once."""
+        _write_parquet(tmp_path / "t.parquet")
+        path = str(tmp_path / "t.parquet")
+        cfg.subplan_result_cache = False
+
+        def query():
+            return (dt.read_parquet(path)
+                    .with_column("w", col("v") * 3.0)
+                    .groupby("k").agg(col("w").max().alias("m"))
+                    .sort("k"))
+
+        barrier = threading.Barrier(8)
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def worker():
+            try:
+                barrier.wait(30)
+                got = query().to_pydict()
+                with lock:
+                    results.append(got)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        with counting_planner() as calls:
+            threads = [threading.Thread(target=worker) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert calls["optimize"] == 1, calls
+        assert not errors, errors
+        assert len(set(map(str, results))) == 1
+
+
+class TestPlanCacheInvalidation:
+    def _query(self, path):
+        return (dt.read_parquet(path)
+                .with_column("w", col("v") * 2.0)
+                .groupby("k").agg(col("w").sum().alias("s"))
+                .sort("k"))
+
+    def test_config_delta_invalidates(self, cfg, tmp_path):
+        _write_parquet(tmp_path / "t.parquet")
+        path = str(tmp_path / "t.parquet")
+        with counting_planner() as calls:
+            want = self._query(path).to_pydict()
+            cfg.morsel_size_rows = cfg.morsel_size_rows + 1
+            got = self._query(path).to_pydict()
+            assert calls["optimize"] == 2  # knob change -> fresh plan
+        assert got == want
+
+    def test_integrity_and_lineage_knobs_invalidate(self, cfg, tmp_path):
+        _write_parquet(tmp_path / "t.parquet")
+        path = str(tmp_path / "t.parquet")
+        with counting_planner() as calls:
+            want = self._query(path).to_pydict()
+            cfg.partition_integrity = not cfg.partition_integrity
+            assert self._query(path).to_pydict() == want
+            cfg.lineage_recomputation = not cfg.lineage_recomputation
+            assert self._query(path).to_pydict() == want
+            assert calls["optimize"] == 3  # one fresh plan per toggle
+
+    def test_source_mtime_invalidates(self, cfg, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path)
+        self._query(path).collect()
+        _write_parquet(path, nrows=10, nkeys=2, scale=100.0)
+        with counting_planner() as calls:
+            got = self._query(path).to_pydict()
+            assert calls["optimize"] == 1  # rewrite forced a re-plan
+        # never stale: the new rows are served
+        assert got["k"] == [0, 1]
+        assert got["s"][0] == sum(2.0 * 100.0 * i
+                                  for i in range(10) if i % 2 == 0)
+
+    def test_version_and_generation_bump_invalidate(self, cfg, tmp_path,
+                                                    monkeypatch):
+        import daft_tpu.adapt.plancache as pc_mod
+
+        _write_parquet(tmp_path / "t.parquet")
+        path = str(tmp_path / "t.parquet")
+        want = self._query(path).to_pydict()
+        monkeypatch.setattr(pc_mod, "CACHE_VERSION",
+                            pc_mod.CACHE_VERSION + 1)
+        with counting_planner() as calls:
+            assert self._query(path).to_pydict() == want
+            assert calls["optimize"] == 1  # version bump -> fresh plan
+            PLAN_CACHE.bump_generation()
+            assert self._query(path).to_pydict() == want
+            assert calls["optimize"] == 2  # generation bump -> fresh plan
+
+    def test_byte_cap_lru_sheds(self, cfg, tmp_path):
+        _write_parquet(tmp_path / "t.parquet")
+        path = str(tmp_path / "t.parquet")
+        cfg.plan_cache_bytes = 30 * 1024  # a couple of plans at most
+        lits = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+        for lit in lits:
+            (dt.read_parquet(path).with_column("w", col("v") * lit)
+             .groupby("k").agg(col("w").sum().alias("s"))
+             .sort("k")).collect()
+        snap = PLAN_CACHE.snapshot()
+        assert snap["evictions"] > 0
+        assert snap["bytes"] <= cfg.plan_cache_bytes
+        from daft_tpu.spill import MEMORY_LEDGER
+
+        assert MEMORY_LEDGER.snapshot()["plan_cache_bytes"] == snap["bytes"]
+
+    def test_lookup_fault_fails_open(self, cfg, tmp_path):
+        from daft_tpu import faults
+
+        _write_parquet(tmp_path / "t.parquet")
+        path = str(tmp_path / "t.parquet")
+        want = self._query(path).to_pydict()
+        with faults.inject("plancache.lookup", "always"):
+            q = self._query(path)
+            assert q.to_pydict() == want  # degraded, never failed
+            c = q.stats.snapshot()["counters"]
+            assert c.get("plan_cache_errors", 0) >= 1
+            assert "plan_cache_hits" not in c
+
+    def test_armed_faults_stand_cache_down(self, cfg, tmp_path):
+        """Any armed fault plan disables reuse: a cached plan would let an
+        armed site (e.g. fuse.compile) silently never fire."""
+        from daft_tpu import faults
+
+        _write_parquet(tmp_path / "t.parquet")
+        path = str(tmp_path / "t.parquet")
+        want = self._query(path).to_pydict()  # warm entry exists now
+        with faults.inject("fuse.compile", "always"):
+            q = self._query(path)
+            assert q.to_pydict() == want
+            c = q.stats.snapshot()["counters"]
+            assert "plan_cache_hits" not in c
+            # the armed site really fired (unfused fallback ran)
+            assert "fused_chains" not in c
+
+
+# ---------------------------------------------------------------------------
+# feedback-directed optimization
+# ---------------------------------------------------------------------------
+
+def _write_join_files(tmp_path):
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    fact = str(tmp_path / "fact.parquet")
+    dim = str(tmp_path / "dim.parquet")
+    papq.write_table(pa.table({
+        "k": [i % 500 for i in range(5000)],
+        "v": list(range(5000))}), fact)
+    # incompressible payload: the dim FILE is far above the broadcast
+    # threshold while the filtered rows are far below it
+    papq.write_table(pa.table({
+        "k": list(range(500)),
+        "w": [rng.bytes(200).hex() for _ in range(500)]}), dim)
+    return fact, dim
+
+
+class TestFDOJoinFlip:
+    def _query(self, fact, dim, lit=5):
+        f = dt.read_parquet(fact).into_partitions(4)
+        d = dt.read_parquet(dim).where(col("k") < lit)
+        return f.join(d, on="k").sum("v")
+
+    def test_flip_on_first_run_of_repeated_shape(self, cfg, tmp_path):
+        cfg.broadcast_join_size_bytes_threshold = 4000
+        fact, dim = _write_join_files(tmp_path)
+        q1 = self._query(fact, dim)
+        want = q1.to_pydict()
+        c1 = q1.stats.snapshot()["counters"]
+        assert c1.get("host_joins", 0) >= 1       # cold: hash join
+        assert "broadcast_joins" not in c1
+        q2 = self._query(fact, dim)
+        assert q2.to_pydict() == want             # byte-identical
+        c2 = q2.stats.snapshot()["counters"]
+        assert c2.get("fdo_join_flips") == 1      # flipped from history
+        assert c2.get("broadcast_joins", 0) >= 1
+        # a DIFFERENT literal shares the shape: flip on ITS first run too
+        q3 = self._query(fact, dim, lit=7)
+        q3.collect()
+        assert q3.stats.snapshot()["counters"].get("fdo_join_flips") == 1
+
+    def test_warm_runs_reuse_flipped_plan(self, cfg, tmp_path):
+        cfg.broadcast_join_size_bytes_threshold = 4000
+        fact, dim = _write_join_files(tmp_path)
+        want = self._query(fact, dim).to_pydict()
+        self._query(fact, dim).collect()          # flipped cold plan
+        q3 = self._query(fact, dim)
+        assert q3.to_pydict() == want
+        c3 = q3.stats.snapshot()["counters"]
+        assert c3.get("plan_cache_hits") == 1
+        assert c3.get("broadcast_joins", 0) >= 1
+
+    def test_mispredict_demotes_and_degrades(self, cfg, tmp_path):
+        import numpy as np
+
+        cfg.broadcast_join_size_bytes_threshold = 4000
+        fact, dim = _write_join_files(tmp_path)
+        self._query(fact, dim).collect()          # history: side is small
+        q2 = self._query(fact, dim)
+        q2.collect()                              # flipped to broadcast
+        assert q2.stats.snapshot()["counters"].get("fdo_join_flips") == 1
+        # the dim file grows: history now says broadcast, reality says no
+        rng = np.random.RandomState(3)
+        papq.write_table(pa.table({
+            "k": [i % 4 for i in range(4000)],
+            "w": [rng.bytes(200).hex() for _ in range(4000)]}), dim)
+        demos_before = PLAN_CACHE.snapshot()["demotions"]
+        q3 = self._query(fact, dim)
+        got3 = q3.to_pydict()                     # completes, no failure
+        c3 = q3.stats.snapshot()["counters"]
+        assert c3.get("fdo_mispredicts", 0) >= 1
+        assert PLAN_CACHE.snapshot()["demotions"] > demos_before
+        # next plan degrades to the uncached hash strategy
+        q4 = self._query(fact, dim)
+        assert q4.to_pydict() == got3
+        c4 = q4.stats.snapshot()["counters"]
+        assert "fdo_join_flips" not in c4
+        assert c4.get("host_joins", 0) >= 1
+
+    def test_small_left_side_of_inner_join_flips_too(self, cfg, tmp_path):
+        """Inner joins consult BOTH sides: a historically small LEFT side
+        flips even though the static planner's preferred broadcast side
+        (right, with unknown sizes) stays big."""
+        cfg.broadcast_join_size_bytes_threshold = 4000
+        fact, dim = _write_join_files(tmp_path)
+
+        def q(lit=5):
+            d = dt.read_parquet(dim).where(col("k") < lit)
+            f = dt.read_parquet(fact).into_partitions(4)
+            return d.join(f, on="k").sum("v")
+
+        want = q().to_pydict()
+        q2 = q()
+        assert q2.to_pydict() == want
+        c2 = q2.stats.snapshot()["counters"]
+        assert c2.get("fdo_join_flips") == 1, c2
+        assert c2.get("broadcast_joins", 0) >= 1
+
+    def test_history_fdo_off_never_flips(self, cfg, tmp_path):
+        cfg.broadcast_join_size_bytes_threshold = 4000
+        cfg.history_fdo = False
+        fact, dim = _write_join_files(tmp_path)
+        want = self._query(fact, dim).to_pydict()
+        q2 = self._query(fact, dim)
+        assert q2.to_pydict() == want
+        c2 = q2.stats.snapshot()["counters"]
+        assert "fdo_join_flips" not in c2
+        assert c2.get("host_joins", 0) >= 1
+
+
+class TestFDOFanout:
+    def test_aggregate_exchange_resized_from_history(self, cfg):
+        df = dt.from_pydict({
+            "k": [i % 7 for i in range(4000)],
+            "v": [float(i) for i in range(4000)],
+        }).into_partitions(8).collect()
+        q = df.groupby("k").agg(col("v").sum().alias("s")).sort("k")
+        want = q.to_pydict()
+        c1 = q.stats.snapshot()["counters"]
+        assert "fdo_shuffle_resizes" not in c1    # cold: nothing recorded
+        q2 = df.groupby("k").agg(col("v").sum().alias("s")).sort("k")
+        assert q2.to_pydict() == want             # byte-identical
+        c2 = q2.stats.snapshot()["counters"]
+        assert c2.get("fdo_shuffle_resizes") == 1, c2
+
+    def test_write_plans_never_resize(self, cfg, tmp_path):
+        """An identical write query's output file count must not change
+        with process history (one file per partition)."""
+        import glob
+
+        df = dt.from_pydict({
+            "k": [i % 7 for i in range(4000)],
+            "v": [float(i) for i in range(4000)],
+        }).into_partitions(8).collect()
+
+        def write(i):
+            out = str(tmp_path / f"out{i}")
+            (df.groupby("k").agg(col("v").sum().alias("s"))
+             .write_parquet(out))
+            return len(glob.glob(os.path.join(out, "*.parquet")))
+
+        n1 = write(1)
+        n2 = write(2)  # history exists now; the file layout must not move
+        assert n1 == n2
+
+    def test_failed_runs_never_feed_history(self, cfg):
+        """Site observations from a non-ok execution are discarded — a
+        partially-consumed exchange must never seed a broadcast flip."""
+        from daft_tpu.execution import RuntimeStats
+
+        stats = RuntimeStats()
+        stats.fdo_observe("deadbeef00000000", 10, 100)
+        HISTORY.fold("", stats, {"outcome": "error", "wall_s": 0.1,
+                                 "counters": {}})
+        assert HISTORY.size("deadbeef00000000") is None
+        stats.fdo_observe("deadbeef00000000", 10, 100)
+        HISTORY.fold("", stats, {"outcome": "ok", "wall_s": 0.1,
+                                 "counters": {}})
+        assert HISTORY.size("deadbeef00000000") == (10, 100, 1)
+
+    def test_fanout_off_with_knob(self, cfg):
+        cfg.history_fdo = False
+        df = dt.from_pydict({
+            "k": [i % 7 for i in range(4000)],
+            "v": [float(i) for i in range(4000)],
+        }).into_partitions(8).collect()
+        q = df.groupby("k").agg(col("v").sum().alias("s"))
+        want = q.to_pydict()
+        q2 = df.groupby("k").agg(col("v").sum().alias("s"))
+        assert q2.to_pydict() == want
+        assert "fdo_shuffle_resizes" not in \
+            q2.stats.snapshot()["counters"]
+
+
+class TestFDOStreamHint:
+    def test_backpressure_dominated_shape_stands_streaming_down(self, cfg):
+        from daft_tpu.execution import RuntimeStats
+
+        # synthetic history: 2 recorded runs, stalls dominating wall
+        fp = "feedcafe00000000"
+        for _ in range(2):
+            HISTORY._queries[fp] = {
+                "wall_s": 1.0, "ttfr_ms": 5.0, "stream_morsels": 100,
+                "backpressure_ms": 900.0,
+                "runs": HISTORY._queries.get(fp, {}).get("runs", 0) + 1,
+            }
+        stats = RuntimeStats()
+        out = fdo.apply_query_hints(fp, cfg, stats)
+        assert out is not cfg
+        assert out.streaming_execution is False
+        assert stats.snapshot()["counters"].get("fdo_stream_hints") == 1
+
+    def test_healthy_shape_keeps_streaming(self, cfg):
+        from daft_tpu.execution import RuntimeStats
+
+        fp = "feedcafe00000001"
+        HISTORY._queries[fp] = {
+            "wall_s": 1.0, "ttfr_ms": 5.0, "stream_morsels": 100,
+            "backpressure_ms": 10.0, "runs": 5,
+        }
+        out = fdo.apply_query_hints(fp, cfg, RuntimeStats())
+        assert out is cfg
+
+
+# ---------------------------------------------------------------------------
+# sub-plan result cache
+# ---------------------------------------------------------------------------
+
+class TestResultCache:
+    def _prefix(self, path):
+        return dt.read_parquet(path).with_column("c", col("v") * 2.0)
+
+    def test_shared_prefix_replayed_byte_identical(self, cfg, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path)
+        r1 = self._prefix(path).sum("c").to_pydict()
+        q2 = self._prefix(path).min("c")
+        r2 = q2.to_pydict()
+        c2 = q2.stats.snapshot()["counters"]
+        assert c2.get("subplan_cache_hits") == 1
+        assert "scan_tasks_emitted" not in c2      # zero scan work
+        assert r1["c"][0] == sum(2.0 * i for i in range(2000))
+        assert r2["c"][0] == 0.0
+        # control: same second query with the knob off, same bytes
+        cfg.subplan_result_cache = False
+        q3 = self._prefix(path).min("c")
+        assert q3.to_pydict() == r2
+        assert "subplan_cache_hits" not in q3.stats.snapshot()["counters"]
+
+    def test_mtime_invalidates_no_stale_rows(self, cfg, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path)
+        self._prefix(path).sum("c").collect()
+        papq.write_table(pa.table({"k": [1], "v": [5.0]}), path)
+        q = self._prefix(path).min("c")
+        assert q.to_pydict()["c"][0] == 10.0       # fresh rows, never stale
+        c = q.stats.snapshot()["counters"]
+        assert "subplan_cache_hits" not in c
+
+    def test_byte_cap_evicts_and_ledger_accounts(self, cfg, tmp_path):
+        cfg.subplan_cache_bytes = 20000
+        for i in range(6):
+            path = str(tmp_path / f"t{i}.parquet")
+            _write_parquet(path, nrows=1000)
+            self._prefix(path).sum("c").collect()
+        snap = RESULT_CACHE.snapshot()
+        assert snap["evictions"] > 0
+        assert snap["bytes"] <= cfg.subplan_cache_bytes
+        from daft_tpu.spill import MEMORY_LEDGER
+
+        assert MEMORY_LEDGER.snapshot()["subplan_cache_bytes"] == \
+            snap["bytes"]
+
+    def test_oversized_prefix_abandons_tee_early(self, cfg, tmp_path):
+        """A prefix bigger than the cap is never RETAINED by the tee (the
+        accumulation is byte-bounded, not just rejected at put())."""
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path, nrows=4000)
+        cfg.subplan_cache_bytes = 1024  # far below the prefix's bytes
+        q = self._prefix(path).sum("c")
+        q.collect()
+        snap = RESULT_CACHE.snapshot()
+        assert snap["inserts"] == 0
+        assert snap["bytes"] == 0
+
+    def test_udf_prefix_declines(self, cfg, tmp_path):
+        from daft_tpu.datatypes import DataType
+
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path)
+
+        @dt.udf(return_dtype=DataType.float64())
+        def plus1(s):
+            return [v + 1 for v in s.to_pylist()]
+
+        # the UDF projection is the ONLY map op over the scan: the whole
+        # prefix declines (non-deterministic user code is never memoized)
+        q = dt.read_parquet(path).select(plus1(col("v")).alias("c"))
+        q.collect()
+        assert RESULT_CACHE.snapshot()["inserts"] == 0
+
+    def test_budgeted_query_declines(self, cfg, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path)
+        cfg.memory_budget_bytes = 64 * 1024 * 1024
+        self._prefix(path).sum("c").collect()
+        assert RESULT_CACHE.snapshot()["inserts"] == 0
+
+    def test_lookup_fault_fails_open(self, cfg, tmp_path):
+        from daft_tpu import faults
+
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path)
+        want = self._prefix(path).sum("c").to_pydict()
+        with faults.inject("resultcache.lookup", "always"):
+            q = self._prefix(path).sum("c")
+            assert q.to_pydict() == want
+            assert q.stats.snapshot()["counters"].get(
+                "subplan_cache_errors", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# rehydration (clone) semantics
+# ---------------------------------------------------------------------------
+
+class TestRehydration:
+    def test_clone_resets_per_query_state(self, cfg):
+        from daft_tpu.context import get_context
+        from daft_tpu.fuse.compile import FusedMapOp
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import fuse_for_device, translate
+
+        # in-memory source: the Project/Filter chain survives optimize
+        # and fuses (scan sources absorb filters as pushdowns)
+        df = dt.from_pydict({"v": [1.0, 2.0, 3.0, 4.0]})
+        plan = (df.with_column("w", col("v") + 1.0)
+                .where(col("w") > 3.0))._plan
+        c = get_context().execution_config
+        phys = fuse_for_device(translate(optimize(plan), c), c)
+
+        def find(op, cls):
+            if isinstance(op, cls):
+                return op
+            for ch in op.children:
+                got = find(ch, cls)
+                if got is not None:
+                    return got
+            return None
+
+        fused = find(phys, FusedMapOp)
+        assert fused is not None
+        fused._recorded = True  # simulate a prior execution's latch
+        clone = clone_plan(phys)
+        cfused = find(clone, FusedMapOp)
+        assert cfused is not fused
+        assert cfused._recorded is False
+        assert cfused.program is fused.program  # immutable, shared
+
+    def test_join_filter_slots_fresh_and_paired(self, cfg):
+        from daft_tpu.context import get_context
+        from daft_tpu.optimizer import optimize
+        from daft_tpu.physical import ShuffleOp, fuse_for_device, translate
+
+        a = dt.from_pydict({"k": list(range(100)),
+                            "v": list(range(100))}).into_partitions(2)
+        b = dt.from_pydict({"k": list(range(50)),
+                            "w": list(range(50))}).into_partitions(2)
+        plan = a.join(b, on="k", strategy="hash")._plan
+        c = get_context().execution_config
+        phys = fuse_for_device(translate(optimize(plan), c), c)
+
+        def shuffles(op, out):
+            if isinstance(op, ShuffleOp):
+                out.append(op)
+            for ch in op.children:
+                shuffles(ch, out)
+            return out
+
+        orig = shuffles(phys, [])
+        feed = [s for s in orig if s.filter_feed is not None]
+        probe = [s for s in orig if s.probe_filter is not None]
+        assert feed and probe
+        assert feed[0].filter_feed is probe[0].probe_filter  # shared slot
+        clone = clone_plan(phys)
+        cs = shuffles(clone, [])
+        cfeed = [s for s in cs if s.filter_feed is not None][0]
+        cprobe = [s for s in cs if s.probe_filter is not None][0]
+        assert cfeed.filter_feed is cprobe.probe_filter      # still paired
+        assert cfeed.filter_feed is not feed[0].filter_feed  # but fresh
+
+
+# ---------------------------------------------------------------------------
+# health / gauges / ledger surfaces
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_health_section_validates(self, cfg, tmp_path):
+        from daft_tpu.obs.health import engine_health, validate_health
+
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path)
+        q = dt.read_parquet(path).with_column("w", col("v") + 1.0).sum("w")
+        q.collect()
+        snap = engine_health()
+        assert validate_health(snap) == []
+        pc = snap["plan_cache"]
+        assert pc["entries"] >= 1
+        assert pc["bytes"] > 0
+
+    def test_gauges_exported(self, cfg, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path)
+        (dt.read_parquet(path).with_column("w", col("v") + 1.0)
+         .sum("w")).collect()
+        text = dt.metrics_text()
+        for g in ("daft_tpu_plan_cache_entries",
+                  "daft_tpu_plan_cache_bytes",
+                  "daft_tpu_plan_cache_hits_total",
+                  "daft_tpu_plan_cache_misses_total",
+                  "daft_tpu_plan_cache_demotions_total",
+                  "daft_tpu_subplan_cache_entries",
+                  "daft_tpu_subplan_cache_bytes",
+                  "daft_tpu_subplan_cache_hits_total"):
+            assert g in text, g
+
+    def test_explain_analyze_planning_line(self, cfg, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        _write_parquet(path)
+        q = dt.read_parquet(path).with_column("w", col("v") + 1.0).sum("w")
+        text = q.explain_analyze()
+        assert "planning:" in text
+        assert "plan cache" in text
+
+    def test_ledger_carries_cache_accounts(self, cfg):
+        from daft_tpu.spill import MEMORY_LEDGER
+
+        snap = MEMORY_LEDGER.snapshot()
+        assert "plan_cache_bytes" in snap
+        assert "subplan_cache_bytes" in snap

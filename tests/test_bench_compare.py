@@ -74,6 +74,24 @@ class TestClassify:
         assert classify("streaming_data_mb") is None
         assert classify("streaming_budget_mb") is None
 
+    def test_plan_cache_suffixes(self):
+        # serving rung repeat-shape leg (ISSUE 13): the plan-cache hit
+        # rate is higher-better (a falling rate means repeat traffic is
+        # re-planning); warm/cold p50s are ordinary lower-better walls
+        assert classify("serving_plan_cache_hit_rate") == "higher"
+        assert classify("serving_warm_p50_s") == "lower"
+        assert classify("serving_cold_p50_s") == "lower"
+        assert classify("serving_planning_share_warm_pct") == "lower"
+
+    def test_hit_rate_direction_in_compare(self):
+        prev = {"serving_plan_cache_hit_rate": 0.95,
+                "serving_warm_p50_s": 0.10}
+        new = {"serving_plan_cache_hit_rate": 0.50,   # -47%: regressed
+               "serving_warm_p50_s": 0.05}            # -50%: improved
+        diff = compare(prev, new, threshold=0.10)
+        assert diff["serving_plan_cache_hit_rate"]["status"] == "regressed"
+        assert diff["serving_warm_p50_s"]["status"] == "improved"
+
 
 class TestFlatten:
     def test_nested_and_non_numeric(self):

@@ -49,7 +49,9 @@ _SNAPSHOT_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 # chaos-leg EVENT counts ("_worker_losses", "_task_redispatches",
 # "_workers") are deliberately ABSENT from both lists: they are pinned by
 # the rung's seeded fault plan, not performance, and a plan change must
-# never read as a regression.
+# never read as a regression. "_hit_rate" (serving rung: plan-cache hits
+# over the repeat-shape leg) is higher-better — a falling hit rate means
+# repeat traffic is re-planning.
 _LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_ttfr_s", "_pct",
                    "_share", "_bytes", "_peak_mb", "_rows",
                    "_misses", "_throttled", "_failures", "_errors",
@@ -57,7 +59,7 @@ _LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_ttfr_s", "_pct",
                    "_shed_count")
 _HIGHER_SUFFIXES = ("_per_sec", "_vs_baseline", "_speedup_x", "_gbps",
                     "_mbps", "_hits", "_qps", "value", "_rows_pruned",
-                    "_reduction_x")
+                    "_reduction_x", "_hit_rate")
 
 
 def classify(metric: str) -> Optional[str]:

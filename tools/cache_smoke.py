@@ -1,0 +1,172 @@
+"""cache-smoke: the plan/program cache's cold->warm->invalidate->warm
+cycle validated end to end. Wired into `make lint` (and usable alone via
+`make cache-smoke`) so a keying or invalidation regression — a stale
+plan served after a source rewrite, a warm run that silently re-plans,
+a gauge surface going dark — fails the static-gate path before any
+production consumer trips over it.
+
+Checks, in order:
+ 1. COLD: the first run of a file-backed query misses the plan cache,
+    records planning_wall_ns, and carries both fingerprints (canonical +
+    exact) in its QueryRecord;
+ 2. WARM: the second run hits (zero optimize()/translate() calls, pinned
+    by instrumentation), is byte-identical to the cold run, and its
+    record shows the same canonical fingerprint;
+ 3. sub-plan result cache: a second query sharing the scan+project
+    prefix replays it (subplan_cache_hits == 1) byte-identically;
+ 4. INVALIDATE: rewriting the source file (mtime/size change) forces a
+    fresh plan AND fresh prefix — the new rows are served, never stale;
+ 5. WARM AGAIN: the rewritten shape warms back up on its next run;
+ 6. dt.health()["plan_cache"] validates and the daft_tpu_plan_cache_* /
+    daft_tpu_subplan_cache_* gauges appear in metrics_text().
+
+Exits nonzero with a named failure on any violation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import daft_tpu as dt
+    import daft_tpu.optimizer as optimizer_mod
+    from daft_tpu import col
+    from daft_tpu.adapt.plancache import PLAN_CACHE
+    from daft_tpu.adapt.resultcache import RESULT_CACHE
+    from daft_tpu.obs.health import validate_health
+
+    dt.set_execution_config(enable_result_cache=False)
+    PLAN_CACHE.clear()
+    RESULT_CACHE.clear()
+
+    calls = {"optimize": 0}
+    real_optimize = optimizer_mod.optimize
+
+    def counted(plan, *a, **k):
+        calls["optimize"] += 1
+        return real_optimize(plan, *a, **k)
+
+    optimizer_mod.optimize = counted
+    try:
+        d = tempfile.mkdtemp(prefix="cache_smoke_")
+        path = os.path.join(d, "t.parquet")
+        pq.write_table(pa.table({"k": [i % 5 for i in range(2000)],
+                                 "v": [float(i) for i in range(2000)]}),
+                       path)
+
+        def query():
+            return (dt.read_parquet(path)
+                    .with_column("w", col("v") * 2.0)
+                    .groupby("k").agg(col("w").sum().alias("s"))
+                    .sort("k"))
+
+        # 1: cold
+        q1 = query().collect()
+        want = q1.to_pydict()
+        rec1 = q1.last_query_record()
+        if calls["optimize"] != 1:
+            print(f"cache-smoke: FAIL — cold run made "
+                  f"{calls['optimize']} optimize() calls, wanted 1")
+            return 1
+        if not rec1 or not rec1["plan_fingerprint_canonical"]:
+            print("cache-smoke: FAIL — cold record has no canonical "
+                  "fingerprint")
+            return 1
+        if rec1["planning_ms"] <= 0:
+            print("cache-smoke: FAIL — planning_wall_ns not recorded")
+            return 1
+
+        # 2: warm — zero re-planning, byte-identical
+        q2 = query().collect()
+        if calls["optimize"] != 1:
+            print(f"cache-smoke: FAIL — warm run re-planned "
+                  f"({calls['optimize']} optimize() calls)")
+            return 1
+        if q2.to_pydict() != want:
+            print("cache-smoke: FAIL — warm result differs from cold")
+            return 1
+        c2 = q2.stats.snapshot()["counters"]
+        if c2.get("plan_cache_hits") != 1:
+            print(f"cache-smoke: FAIL — warm run counters: {c2}")
+            return 1
+        rec2 = q2.last_query_record()
+        if rec2["plan_fingerprint_canonical"] != \
+                rec1["plan_fingerprint_canonical"]:
+            print("cache-smoke: FAIL — canonical fingerprint unstable")
+            return 1
+
+        # 3: shared prefix replay — same scan+project prefix (identical
+        # column pruning), different consumer
+        q3 = (dt.read_parquet(path)
+              .with_column("w", col("v") * 2.0)
+              .groupby("k").agg(col("w").min().alias("m"))
+              .sort("k")).collect()
+        c3 = q3.stats.snapshot()["counters"]
+        if c3.get("subplan_cache_hits", 0) != 1:
+            print(f"cache-smoke: FAIL — prefix not replayed: {c3}")
+            return 1
+        got3 = q3.to_pydict()
+        if got3["m"][0] != 0.0 or len(got3["k"]) != 5:
+            print(f"cache-smoke: FAIL — replayed prefix wrong result: "
+                  f"{got3}")
+            return 1
+
+        # 4: source rewrite invalidates both caches (q3's own cold plan
+        # made the baseline 2 optimize() calls)
+        base = calls["optimize"]
+        pq.write_table(pa.table({"k": [1, 1], "v": [100.0, 100.0]}), path)
+        q4 = query().collect()
+        got4 = q4.to_pydict()
+        if got4 != {"k": [1], "s": [400.0]}:
+            print(f"cache-smoke: FAIL — stale result after rewrite: "
+                  f"{got4}")
+            return 1
+        if calls["optimize"] != base + 1:
+            print(f"cache-smoke: FAIL — rewrite did not force a re-plan "
+                  f"({calls['optimize']} optimize() calls, "
+                  f"baseline {base})")
+            return 1
+
+        # 5: the rewritten shape warms back up
+        q5 = query().collect()
+        if calls["optimize"] != base + 1 or q5.to_pydict() != got4:
+            print("cache-smoke: FAIL — rewritten shape did not re-warm")
+            return 1
+
+        # 6: health section + gauges
+        snap = dt.health()
+        errs = validate_health(snap)
+        if errs:
+            print(f"cache-smoke: FAIL — health schema: {errs}")
+            return 1
+        pc = snap["plan_cache"]
+        if pc["entries"] < 1 or pc["hits"] < 2 or pc["result_hits"] < 1:
+            print(f"cache-smoke: FAIL — plan_cache section: {pc}")
+            return 1
+        text = dt.metrics_text()
+        for gauge in ("daft_tpu_plan_cache_entries",
+                      "daft_tpu_plan_cache_hits_total",
+                      "daft_tpu_subplan_cache_hits_total"):
+            if gauge not in text:
+                print(f"cache-smoke: FAIL — gauge {gauge} missing")
+                return 1
+    finally:
+        optimizer_mod.optimize = real_optimize
+        dt.shutdown(timeout_s=5)
+
+    print("cache-smoke: OK — cold->warm->invalidate->warm cycle, "
+          "prefix replay, hit counters, byte-identity, gauges")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

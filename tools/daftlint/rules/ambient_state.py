@@ -80,6 +80,15 @@ REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
     ("daft_tpu/dist/peer.py", "_GROUP"),
     # cluster identity recorded at init_distributed (coordinator/nproc/pid)
     ("daft_tpu/parallel/multihost.py", "_CLUSTER"),
+    # query-velocity subsystem (daft_tpu/adapt/, README "Plan & program
+    # cache"): process-level by design — the whole point is reuse across
+    # queries. All bounded (LRU byte caps / history depth caps), all
+    # ledger-accounted, all clearable.
+    ("daft_tpu/adapt/plancache.py", "PLAN_CACHE"),
+    ("daft_tpu/adapt/history.py", "HISTORY"),
+    ("daft_tpu/adapt/resultcache.py", "RESULT_CACHE"),
+    # FDO planning collector: a thread-local scope marker, not shared state
+    ("daft_tpu/adapt/fdo.py", "_tl"),
 }
 
 _CONTAINER_CTOR_BASES = {
