@@ -600,6 +600,7 @@ def measure_distributed(scale: float = 0.02, workers: int = 2,
     saved = {k: getattr(cfg, k) for k in ("distributed_workers",
                                           "enable_result_cache",
                                           "partition_integrity",
+                                          "cluster_telemetry",
                                           "speculative_execution",
                                           "speculation_min_s",
                                           "speculation_quantile_factor")}
@@ -689,6 +690,37 @@ def measure_distributed(scale: float = 0.02, workers: int = 2,
         out["integrity_wall_on_s"] = round(min(walls_i["on"]), 4)
         out["integrity_wall_off_s"] = round(min(walls_i["off"]), 4)
         out["integrity_overhead_pct"] = round(med * 100.0, 2)
+        # ---- telemetry A/B: fragments on vs off, interleaved ------------
+        # (ISSUE 15 gate: the cluster observability plane — per-task
+        # fragment build on the worker, piggyback on the reply frame,
+        # driver-side merge — must cost < 3% on this leg. Unprofiled
+        # queries piggyback only the counters delta + log tail, so the
+        # steady-state cost is one small dict per task per direction.
+        # Same estimator as the integrity A/B: order-alternated pairs,
+        # median of time-adjacent paired deltas.)
+        walls_tel = {"on": [], "off": []}
+        deltas_tel = []
+        for _t in range(max(24, trials)):
+            order = ("on", "off") if _t % 2 == 0 else ("off", "on")
+            pair = {}
+            for mode in order:
+                cfg.cluster_telemetry = (mode == "on")
+                t0 = time.perf_counter()
+                got = tpch.q1(frame).collect()
+                pair[mode] = time.perf_counter() - t0
+                walls_tel[mode].append(pair[mode])
+                if not _parity(got.to_pydict(), want, rtol=1e-6):
+                    raise AssertionError(
+                        f"telemetry A/B parity broke (fragments {mode})")
+            deltas_tel.append((pair["on"] - pair["off"]) / pair["off"])
+        cfg.cluster_telemetry = True
+        deltas_tel.sort()
+        mid = len(deltas_tel) // 2
+        med_tel = (deltas_tel[mid] if len(deltas_tel) % 2
+                   else (deltas_tel[mid - 1] + deltas_tel[mid]) / 2)
+        out["dist_telemetry_wall_on_s"] = round(min(walls_tel["on"]), 4)
+        out["dist_telemetry_wall_off_s"] = round(min(walls_tel["off"]), 4)
+        out["dist_telemetry_overhead_pct"] = round(med_tel * 100.0, 2)
         # ---- straggler leg: one worker slowed, speculation on vs off ----
         from collections import deque
 

@@ -328,10 +328,26 @@ def query_log(limit: Optional[int] = None) -> List[dict]:
 def health() -> dict:
     """One validated engine-health snapshot: breaker states, MemoryLedger
     balances, scheduler in-flight window, actor-pool/leaked-thread counts,
-    query-log depth. Mirrored as gauges into ``metrics_text()``."""
+    live query progress (``"queries"``), query-log depth. Mirrored as
+    gauges into ``metrics_text()``."""
     from .obs.health import engine_health
 
     return engine_health()
+
+
+def query_progress(query_id: Optional[str] = None):
+    """Live progress of running queries (daft_tpu/obs/cluster.py): ops
+    completed/total, rows/bytes flowed, tasks in flight, per-worker
+    dispatch state, streaming channel depths. With ``query_id``, one
+    query's snapshot (None when it is not currently executing); without,
+    the list of all running queries — the same data
+    ``dt.health()["queries"]`` carries."""
+    from .obs.cluster import queries_snapshot
+    from .obs.cluster import query_progress as _one
+
+    if query_id is not None:
+        return _one(query_id)
+    return queries_snapshot()
 
 
 def engine_log_tail(n: int = 200, query_id: Optional[str] = None) -> List[dict]:
@@ -389,6 +405,7 @@ __all__ = [
     "metrics_text",
     "query_log",
     "health",
+    "query_progress",
     "engine_log_tail",
     "ServingRuntime",
     "QueryHandle",

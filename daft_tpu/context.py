@@ -239,6 +239,16 @@ class ExecutionConfig:
     # naming the task once it exhausts this budget (or has excluded every
     # worker slot), instead of re-dispatching forever
     dist_task_max_attempts: int = 4
+    # cluster-wide observability plane (daft_tpu/obs/cluster.py): workers
+    # piggyback a bounded, versioned telemetry fragment (span subtree,
+    # RuntimeStats delta, typed events, log tail) on every task reply;
+    # the driver merges it into the query's span tree, counter rollups,
+    # and log ring, so one query produces ONE truthful trace regardless
+    # of how many processes ran it. Strictly fail-open: a dropped or
+    # corrupt fragment costs a telemetry_dropped counter, never a task
+    # failure. Off = replies carry result/error only (the bench
+    # dist_telemetry_overhead_pct A/B axis).
+    cluster_telemetry: bool = True
     # --- self-healing data plane (daft_tpu/integrity/, README "Data
     # integrity & speculation") ----------------------------------------
     # end-to-end partition integrity: payloads leaving compute (spill IPC

@@ -85,7 +85,8 @@ class _TaskEntry:
 
     __slots__ = ("task_id", "op_name", "seq", "ctx", "attempts", "excluded",
                  "status", "result", "error", "event", "charged", "wid",
-                 "active_wids", "spec_wid", "dispatched_at")
+                 "active_wids", "spec_wid", "dispatched_at", "frag",
+                 "frag_wid", "submit_pc", "sent_pc", "reply_pc")
 
     def __init__(self, task_id: int, op_name: str, seq: int, ctx):
         self.task_id = task_id
@@ -113,6 +114,17 @@ class _TaskEntry:
         # when the current primary dispatch left the driver — the clock
         # the straggler threshold compares against
         self.dispatched_at = 0.0
+        # telemetry fragment from the settling reply (obs/cluster.py) and
+        # the worker slot it came from; merged by _execute on the query
+        # thread, where the dist.remote span is open
+        self.frag = None
+        self.frag_wid: Optional[int] = None
+        # driver-side perf_counter stamps for the dist.remote phase split:
+        # submit (dispatch entered) -> sent (frame on the wire) -> reply
+        # (reply frame processed) — visible even when the fragment is lost
+        self.submit_pc = 0
+        self.sent_pc = 0
+        self.reply_pc = 0
 
 
 class _WorkerHandle:
@@ -120,7 +132,8 @@ class _WorkerHandle:
 
     __slots__ = ("wid", "proc", "sock", "state", "last_pong", "inflight",
                  "restarts", "deaths", "breaker", "send_lock", "ops_sent",
-                 "rx_thread", "ledger_report", "pid", "tasks_done")
+                 "rx_thread", "ledger_report", "pid", "tasks_done",
+                 "telemetry_rx", "telemetry_dropped")
 
     def __init__(self, wid: int, breaker: WorkerHealth):
         self.wid = wid
@@ -138,6 +151,11 @@ class _WorkerHandle:
         self.ledger_report = {"current": 0, "high_water": 0}
         self.pid: Optional[int] = None
         self.tasks_done = 0
+        # telemetry accounting for THIS incarnation (reset on respawn):
+        # fragments received on replies vs the worker's pong-echoed tseq —
+        # a positive gap is a fragment lost in flight (telemetry_dropped)
+        self.telemetry_rx = 0
+        self.telemetry_dropped = 0
 
 
 def _repo_root() -> str:
@@ -166,6 +184,10 @@ class WorkerPool:
         self.local_fallbacks_total = 0
         self.restarts_used = 0
         self.restart_budget = max(0, int(cfg.worker_restart_budget))
+        # telemetry fragments lost pool-wide: pong-gap detections, lost
+        # in-flight replies at worker death (driver-side merge drops are
+        # per-query RuntimeStats counters, not pool state)
+        self.telemetry_dropped_total = 0
         # speculative straggler mitigation: completed-wall history per op
         # (feeds the p75 threshold), the bounded count of duplicates in
         # flight, and the speculated/won totals
@@ -297,6 +319,10 @@ class WorkerPool:
                 w.state = "ready"
                 w.last_pong = time.monotonic()
                 w.ops_sent = {}
+                # a fresh incarnation's tseq starts at 0: reset the
+                # per-incarnation telemetry accounting with it
+                w.telemetry_rx = 0
+                w.telemetry_dropped = 0
                 if not initial:
                     w.restarts += 1
                 w.rx_thread = threading.Thread(
@@ -331,6 +357,18 @@ class WorkerPool:
                             w.last_pong = time.monotonic()
                             w.ledger_report = msg.get("ledger",
                                                       w.ledger_report)
+                            tseq = msg.get("tseq")
+                            if isinstance(tseq, int):
+                                # the worker attached tseq fragments ever;
+                                # any it sent that never arrived (and were
+                                # not already counted) were dropped in
+                                # flight — fail-open means we COUNT them,
+                                # never chase them
+                                gap = (tseq - w.telemetry_rx
+                                       - w.telemetry_dropped)
+                                if gap > 0:
+                                    w.telemetry_dropped += gap
+                                    self.telemetry_dropped_total += gap
                 elif kind in ("result", "task_error", "task_skipped"):
                     self._on_task_reply(w, sock, msg)
         except TransportClosed:
@@ -342,9 +380,16 @@ class WorkerPool:
 
     def _on_task_reply(self, w: _WorkerHandle, sock, msg: dict) -> None:
         cancel_targets: List[_WorkerHandle] = []
+        reply_pc = time.perf_counter_ns()
         with self._cond:
             if w.sock is not sock:
                 return  # a dead incarnation's straggler frame
+            frag = msg.get("telemetry")
+            if frag is not None:
+                # counted on ARRIVAL (even a discarded speculative loser's
+                # fragment arrived fine) so the pong-gap math only ever
+                # flags frames that truly never made it
+                w.telemetry_rx += 1
             entry = w.inflight.pop(msg["task_id"], None)
             if entry is None:
                 return
@@ -358,6 +403,9 @@ class WorkerPool:
             if msg["type"] == "result":
                 entry.status = "done"
                 entry.result = (msg["part"], msg["rows"], msg["wall_ns"])
+                entry.frag = frag
+                entry.frag_wid = w.wid
+                entry.reply_pc = reply_pc
                 w.tasks_done += 1
                 self.tasks_completed_total += 1
                 # feed the straggler threshold's running distribution
@@ -395,6 +443,9 @@ class WorkerPool:
                         f"{msg.get('error_message')}")
                 entry.status = "error"
                 entry.error = err
+                entry.frag = frag
+                entry.frag_wid = w.wid
+                entry.reply_pc = reply_pc
             spec_win = False
             if entry.spec_wid is not None:
                 # a speculated entry settled: first result wins, the
@@ -498,6 +549,12 @@ class WorkerPool:
                     e.ctx.ledger.dist_done(e.charged)
                     e.charged = 0
                 affected[id(e.ctx)] = e.ctx
+                if getattr(e.ctx.cfg, "cluster_telemetry", True):
+                    # the in-flight task's would-be fragment died with the
+                    # worker: counted, never chased — and the driver-side
+                    # span around the remote wait still closes, so a lost
+                    # fragment can never orphan a driver span
+                    self.telemetry_dropped_total += 1
             self._cond.notify_all()
         if proc is not None and proc.poll() is None:
             try:
@@ -517,6 +574,9 @@ class WorkerPool:
         w.breaker.record_failure()
         for ctx in affected.values():
             ctx.stats.bump("worker_losses")
+        for e in entries:
+            if getattr(e.ctx.cfg, "cluster_telemetry", True):
+                e.ctx.stats.bump("telemetry_dropped")
         for e in entries:
             e.event.set()
         logger.warning("worker_lost", worker=w.wid, reason=reason,
@@ -671,9 +731,14 @@ class WorkerPool:
             self._wait(entry, ctx, payload, part_bytes)
             if entry.status == "done":
                 out, rows, wall_ns = entry.result
+                self._finish_telemetry(entry, ctx)
                 ctx.stats.bump("dist_tasks")
                 return out, rows, wall_ns
             if entry.status == "error":
+                # task_error replies piggyback telemetry too — the failing
+                # task's counters/spans/logs are exactly the ones worth
+                # having when queries get hard to debug
+                self._finish_telemetry(entry, ctx)
                 raise entry.error
             # lost: the worker died with this task in flight
             if entry.wid is not None:
@@ -693,6 +758,33 @@ class WorkerPool:
             logger.warning("task_redispatch", op=op_name, seq=seq,
                            attempts=entry.attempts,
                            excluded=sorted(entry.excluded))
+
+    def _finish_telemetry(self, entry: _TaskEntry, ctx) -> None:
+        """Terminal-reply observability, on the query thread while the
+        ``dist.remote`` span run_map_task opened is still this thread's
+        innermost: stamp the driver-side phase split (submit -> sent ->
+        reply — visible even when the worker's fragment was lost) and
+        merge the piggybacked telemetry fragment (obs/cluster.py;
+        strictly fail-open)."""
+        prof = ctx.stats.profiler
+        if prof.armed:
+            sp = prof.current()
+            if sp is not None:
+                if entry.sent_pc and entry.submit_pc:
+                    sp.add_phase("submit",
+                                 max(0, entry.sent_pc - entry.submit_pc))
+                if entry.reply_pc and entry.sent_pc:
+                    sp.add_phase("remote_wait",
+                                 max(0, entry.reply_pc - entry.sent_pc))
+                sp.set_attr("worker", entry.frag_wid
+                            if entry.frag_wid is not None else entry.wid)
+                sp.set_attr("attempts", entry.attempts)
+        if entry.frag is not None:
+            from ..obs.cluster import merge_fragment
+
+            frag, entry.frag = entry.frag, None
+            merge_fragment(ctx, frag, entry.frag_wid
+                           if entry.frag_wid is not None else -1)
 
     def _check_query(self, ctx) -> None:
         from ..execution import QueryCancelledError
@@ -757,6 +849,7 @@ class WorkerPool:
             # straggler clock keeps timing the original dispatch
             entry.attempts += 1
             entry.dispatched_at = time.monotonic()
+            entry.submit_pc = time.perf_counter_ns()
         with self._cond:
             self.tasks_dispatched_total += 1
         try:
@@ -792,11 +885,27 @@ class WorkerPool:
                 entry.ctx.ledger.dist_started(size)
         msg = {"type": "task", "task_id": entry.task_id, "op_key": op_key,
                "part": part_bytes}
+        if getattr(entry.ctx.cfg, "cluster_telemetry", True):
+            # the span-context propagation half of the telemetry plane:
+            # the task envelope carries the query id (log attribution),
+            # the dispatching op's identity (the splice anchor names it),
+            # and whether the driver's query is profiled (the worker arms
+            # a local profiler only then — unprofiled queries piggyback
+            # counters + log tail only)
+            from ..obs.log import current_query_id
+
+            msg["telemetry"] = True
+            msg["query_id"] = current_query_id()
+            msg["op_name"] = entry.op_name
+            msg["seq"] = entry.seq
+            msg["profile"] = bool(entry.ctx.stats.profiler.armed)
         if op_key not in w.ops_sent:
             msg["op"] = op_bytes
         try:
             with w.send_lock:
                 send_msg(sock, msg, checksum=self._checksum)
+            if not speculative:
+                entry.sent_pc = time.perf_counter_ns()
             # insertion-ordered window, capped BELOW the worker's op cache
             # so a key we omit op bytes for is always still cached there
             w.ops_sent[op_key] = True
@@ -887,6 +996,8 @@ class WorkerPool:
                     "ledger_current": w.ledger_report.get("current", 0),
                     "ledger_high_water": w.ledger_report.get(
                         "high_water", 0),
+                    "telemetry_rx": w.telemetry_rx,
+                    "telemetry_dropped": w.telemetry_dropped,
                 }
                 for w in self.workers}
             return {
@@ -905,6 +1016,7 @@ class WorkerPool:
                 "tasks_speculated_total": self.tasks_speculated_total,
                 "speculation_wins_total": self.speculation_wins_total,
                 "speculation_inflight": self._spec_inflight,
+                "telemetry_dropped_total": self.telemetry_dropped_total,
                 "local_fallbacks_total": self.local_fallbacks_total,
                 "restarts_used": self.restarts_used,
                 "restart_budget": self.restart_budget,

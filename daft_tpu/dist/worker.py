@@ -17,6 +17,16 @@ tasks until told to stop:
   parent's environment (``faults.ENV_FAULT_SPEC``), which is how chaos
   tooling slows exactly one worker into a deterministic straggler.
 
+Telemetry (daft_tpu/obs/cluster.py): when the driver's task envelope asks
+for it, the task runs inside a :class:`TelemetryCollector` scope — a local
+Profiler (armed only when the driver's query is profiled), a RuntimeStats
+counter snapshot, and a log-record capture — and the bounded fragment it
+builds piggybacks on the ``result``/``task_error`` reply. Fragments carry
+an incremental sequence number (``tseq``) that pongs echo, so the
+supervisor can count fragments lost in flight (a dead worker's un-shipped
+telemetry) as ``telemetry_dropped``. Building a fragment is strictly
+fail-open: any defect ships the reply WITHOUT telemetry, never an error.
+
 The worker never decides policy: retries, re-dispatch, deadlines, and
 poison detection all live driver-side in supervisor.py — a worker that
 dies mid-task simply stops answering, and the supervision layer treats
@@ -32,6 +42,35 @@ import socket
 import sys
 import threading
 import time
+
+
+def _execute_task(op, part, exec_ctx, msg: dict):
+    """Run one map task against the worker-local ExecutionContext, inside
+    a task-scope span when the task's telemetry collector armed a local
+    profiler — the span is the root the driver splices the worker subtree
+    under (DTL006 pins this entry point opening it). The ``worker.task``
+    fault site fires per execution (the chaos straggler/failure hook)."""
+    from .. import faults
+    from ..obs.log import get_logger
+
+    prof = exec_ctx.stats.profiler
+    sp = None
+    if prof.armed:
+        sp = prof.begin("worker.task", op=msg.get("op_name"),
+                        part=msg.get("seq"), kind="bg")
+    try:
+        faults.check("worker.task")
+        return op.map_partition(part, exec_ctx)
+    except BaseException as e:
+        # the worker's view of the failure, emitted INSIDE the telemetry
+        # scope so the fragment's log tail relays it to the driver's ring
+        get_logger("dist.worker").warning(
+            "worker_task_failed", op=msg.get("op_name"),
+            seq=msg.get("seq"), error=repr(e))
+        raise
+    finally:
+        if sp is not None:
+            prof.end(sp)
 
 
 def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
@@ -51,9 +90,18 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
     # itself is always checksummed (both sides speak v2 or the handshake
     # rejects).
     checksum = [True]
+    # fragments attached to replies, ever (the telemetry sequence number):
+    # read and bumped ONLY under send_lock, so a pong echoing it can never
+    # overtake the reply frame that carried the counted fragment — socket
+    # FIFO then guarantees the driver sees the fragment before the seq
+    tel_seq = [0]
 
-    def reply(msg: dict) -> None:
+    def reply(msg: dict, frag=None) -> None:
         with send_lock:
+            if frag is not None:
+                tel_seq[0] += 1
+                msg["telemetry"] = frag
+                msg["tseq"] = tel_seq[0]
             send_msg(sock, msg, checksum=checksum[0])
 
     reply({"type": "hello", "worker_id": worker_id, "pid": os.getpid(),
@@ -99,8 +147,11 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                 checksum[0] = bool(flags & _FLAG_CRC)
                 kind = msg.get("type")
                 if kind == "ping":
+                    with send_lock:
+                        seq = tel_seq[0]
                     reply({"type": "pong", "worker_id": worker_id,
                            "inflight": inflight[0],
+                           "tseq": seq,
                            "ledger": ledger_report()})
                 elif kind == "task":
                     inflight[0] += 1
@@ -138,6 +189,7 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
             inflight[0] -= 1
             reply({"type": "task_skipped", "task_id": task_id})
             continue
+        collector = None
         try:
             op_key = msg["op_key"]
             if "op" in msg:
@@ -154,24 +206,47 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                 # the driver pre-serializes partitions once (re-dispatches
                 # reuse the bytes); decode here
                 part = pickle.loads(part)
+            if msg.get("telemetry"):
+                # per-task telemetry scope (obs/cluster.py): counter
+                # snapshot + log capture always, a bounded local profiler
+                # when the driver's query is profiled. Failing to BUILD
+                # the scope must not fail the task (fail-open).
+                try:
+                    from ..obs.cluster import TelemetryCollector
+
+                    collector = TelemetryCollector(
+                        msg.get("query_id"), msg.get("op_name", "task"),
+                        msg.get("seq", 0), exec_ctx.stats,
+                        profile=bool(msg.get("profile")))
+                except Exception:
+                    collector = None
             t0 = time.perf_counter_ns()
-            # the straggler/chaos hook: an armed delay plan slows this
-            # worker (counted into the reported wall), a failure plan
-            # becomes a task_error the driver's retry machinery owns
-            faults.check("worker.task")
-            out = op.map_partition(part, exec_ctx)
+            # _execute_task fires the worker.task chaos hook: an armed
+            # delay plan slows this worker (counted into the reported
+            # wall), a failure plan becomes a task_error the driver's
+            # retry machinery owns
+            if collector is not None:
+                with collector:
+                    out = _execute_task(op, part, exec_ctx, msg)
+            else:
+                out = _execute_task(op, part, exec_ctx, msg)
             wall_ns = time.perf_counter_ns() - t0
             n = out.num_rows_or_none()
             reply({"type": "result", "task_id": task_id, "part": out,
-                   "rows": n if n is not None else 0, "wall_ns": wall_ns})
+                   "rows": n if n is not None else 0, "wall_ns": wall_ns},
+                  frag=collector.fragment() if collector else None)
         except BaseException as e:  # a task failure must not kill the worker
             try:
                 err_pickle = pickle.dumps(e)
             except Exception:
                 err_pickle = None
+            try:
+                frag = collector.fragment() if collector else None
+            except Exception:
+                frag = None
             reply({"type": "task_error", "task_id": task_id,
                    "error": err_pickle, "error_type": type(e).__name__,
-                   "error_message": str(e)[:2000]})
+                   "error_message": str(e)[:2000]}, frag=frag)
         finally:
             inflight[0] -= 1
             # a cancel that raced an already-executing task left its id

@@ -453,6 +453,10 @@ class ExecutionContext:
         # DistributedRunner attaches the supervised WorkerPool here so
         # eligible tasks execute in worker processes
         self.dist_backend = None
+        # live-progress tracker (obs/cluster.QueryProgress), set by
+        # execute_plan for the execution's lifetime; None for direct op
+        # execution in tests — every hook guards on it
+        self.progress = None
         # terminal once the query's stream closed: unspill readahead stops
         # submitting (its buffers are settled by finish_query anyway); the
         # scan prefetcher MAY still recreate the pool for late reads — see
@@ -1385,6 +1389,15 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
         rows_out = 0
         saw_first_rows = False
         it = iter(built)
+        # live query progress (obs/cluster.py): registered while this
+        # execution runs, snapshotted by dt.health()["queries"] /
+        # QueryHandle.progress(); last-wins per query id across AQE stages
+        from .obs.cluster import (QueryProgress, register_progress,
+                                  unregister_progress)
+
+        progress = QueryProgress(query_id, ctx.stats, plan_ops)
+        ctx.progress = progress
+        register_progress(progress)
         try:
             # the query id binds per PULL, never across a yield: two lazily
             # interleaved streams on one thread would otherwise cross-
@@ -1400,6 +1413,7 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
                 n = part.num_rows_or_none()
                 if n:
                     rows_out += n
+                    progress.add_rows(n)
                     if not saw_first_rows:
                         # time-to-first-row: how long the first non-empty
                         # partition took to surface (the streaming
@@ -1420,48 +1434,57 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
             raise
         finally:
             # teardown (and the record/capture hooks it runs) still logs
-            # under this query's id
-            with obs_log.query_context(query_id):
-                # close the stream tree BEFORE the pool goes away: a
-                # streaming pipeline's producers may be blocked on their
-                # channels, and generator close is what shuts the channels
-                # and unblocks them (GC would get there eventually; an
-                # abandoned/erroring query must not leave pool workers
-                # parked until then)
-                close = getattr(it, "close", None)
-                if close is not None:
-                    try:
-                        close()
-                    except BaseException as e:
-                        # a generator's own teardown raising must not skip
-                        # pool shutdown or the record-on-every-completion
-                        # contract (and must not mask the query's error)
-                        obs_log.get_logger("execution").warning(
-                            "stream_close_failed", error=repr(e))
-                # close(it) cannot reach a pipeline suspended below an op
-                # whose raise terminated the chain above it (the traceback
-                # keeps those frames alive — see register_stream): shut
-                # down the stragglers directly. Only a deliberate early
-                # stop (success/abandoned consumer) counts short-circuits.
-                ctx.close_streams(
-                    short_circuit=outcome in ("ok", "abandoned"))
-                ctx.shutdown_pool()
-                ctx.finish_query()
-                prof = ctx.stats.profiler
-                prof.finish()
-                if tracing.active() and prof.armed:
-                    # span tree -> chrome events, then rewrite the armed
-                    # trace file (buffer kept: the next query appends to
-                    # the same consolidated writer)
-                    tracing.add_span_events(prof)
-                    tracing.flush_query()
-                from .profile.metrics import record_query_metrics
+            # under this query's id. The progress entry unregisters in the
+            # inner finally: a teardown step raising must not leak a
+            # phantom "running" query into the process registry forever.
+            try:
+                with obs_log.query_context(query_id):
+                    # close the stream tree BEFORE the pool goes away: a
+                    # streaming pipeline's producers may be blocked on
+                    # their channels, and generator close is what shuts
+                    # the channels and unblocks them (GC would get there
+                    # eventually; an abandoned/erroring query must not
+                    # leave pool workers parked until then)
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except BaseException as e:
+                            # a generator's own teardown raising must not
+                            # skip pool shutdown or the record-on-every-
+                            # completion contract (and must not mask the
+                            # query's error)
+                            obs_log.get_logger("execution").warning(
+                                "stream_close_failed", error=repr(e))
+                    # close(it) cannot reach a pipeline suspended below an
+                    # op whose raise terminated the chain above it (the
+                    # traceback keeps those frames alive — see
+                    # register_stream): shut down the stragglers directly.
+                    # Only a deliberate early stop (success/abandoned
+                    # consumer) counts short-circuits.
+                    ctx.close_streams(
+                        short_circuit=outcome in ("ok", "abandoned"))
+                    ctx.shutdown_pool()
+                    ctx.finish_query()
+                    prof = ctx.stats.profiler
+                    prof.finish()
+                    if tracing.active() and prof.armed:
+                        # span tree -> chrome events, then rewrite the
+                        # armed trace file (buffer kept: the next query
+                        # appends to the same consolidated writer)
+                        tracing.add_span_events(prof)
+                        tracing.flush_query()
+                    from .profile.metrics import record_query_metrics
 
-                wall_ns = time.perf_counter_ns() - t0
-                record_query_metrics(ctx.stats, wall_ns)
-                _record_query(root, ctx, query_id, fingerprint, plan_ops,
-                              wall_ns, outcome, error, rows_out)
-                tracing.query_finished()
+                    wall_ns = time.perf_counter_ns() - t0
+                    record_query_metrics(ctx.stats, wall_ns)
+                    _record_query(root, ctx, query_id, fingerprint,
+                                  plan_ops, wall_ns, outcome, error,
+                                  rows_out)
+                    tracing.query_finished()
+            finally:
+                unregister_progress(progress)
+                ctx.progress = None
 
     return rooted()
 
@@ -1543,6 +1566,9 @@ def _parallel_map(op: PhysicalOp, child: Iterator[MicroPartition],
         yield out
     if not saw_any:
         yield from op.map_empty(ctx)
+    progress = getattr(ctx, "progress", None)
+    if progress is not None:
+        progress.op_done(name)
 
 
 def _part_bytes(part: MicroPartition) -> int:
@@ -1588,6 +1614,9 @@ def _traced(op: PhysicalOp, stream: Iterator[MicroPartition],
             part = next(stream)
             pulled = True
         except StopIteration:
+            progress = getattr(ctx, "progress", None)
+            if progress is not None:
+                progress.op_done(name)
             return
         finally:
             dt = time.perf_counter_ns() - t0

@@ -95,6 +95,12 @@ SITES = {
                    "DAFT_TPU_DIST_FAULT_SPEC — a delay_s plan SLOWS the "
                    "worker instead of failing it, the deterministic "
                    "straggler hook behind speculative execution)",
+    "telemetry.fragment": "each worker telemetry-fragment merge at the "
+                          "driver (daft_tpu/obs/cluster.py; an injected "
+                          "fault DROPS the fragment — telemetry_dropped "
+                          "counts it, the task's result is untouched and "
+                          "the task is never re-dispatched: telemetry is "
+                          "fail-open end to end)",
     "plancache.lookup": "each plan-cache consult "
                         "(daft_tpu/adapt/plancache.py; a failure degrades "
                         "to uncached planning — the warm path fails OPEN, "

@@ -48,7 +48,7 @@ _CLUSTER_IDLE = {"workers": 0, "workers_alive": 0, "workers_restarting": 0,
                  "tasks_dispatched_total": 0, "tasks_completed_total": 0,
                  "task_redispatches_total": 0, "worker_losses_total": 0,
                  "tasks_speculated_total": 0, "speculation_wins_total": 0,
-                 "speculation_inflight": 0,
+                 "speculation_inflight": 0, "telemetry_dropped_total": 0,
                  "local_fallbacks_total": 0, "restarts_used": 0,
                  "restart_budget": 0, "restart_budget_remaining": 0,
                  "degraded": False, "worker_detail": {}}
@@ -189,6 +189,12 @@ def engine_health() -> dict:
     except Exception:
         sched = {"inflight_tasks": 0}
     streaming = _streaming_snapshot()
+    try:
+        from .cluster import queries_snapshot
+
+        queries = queries_snapshot()
+    except Exception:
+        queries = []  # progress registry mid-teardown
     last = QUERY_LOG.last()
     from ..profile.metrics import METRICS
 
@@ -203,6 +209,7 @@ def engine_health() -> dict:
         "admission": admission_state(),
         "cluster": cluster_state(),
         "streaming": streaming,
+        "queries": queries,
         "plan_cache": _plan_cache_snapshot(),
         "query_log": {
             "depth": len(QUERY_LOG),
@@ -314,6 +321,24 @@ def refresh_health_gauges(registry=None) -> None:
     reg.gauge("daft_tpu_cluster_speculation_wins_total",
               "speculative duplicates that beat the original").set(
         clu.get("speculation_wins_total", 0))
+    reg.gauge("daft_tpu_cluster_telemetry_dropped_total",
+              "worker telemetry fragments lost in flight (pong-gap + "
+              "worker-death detections; fail-open by contract)").set(
+        clu.get("telemetry_dropped_total", 0))
+    try:
+        from .cluster import queries_snapshot
+
+        qsnaps = queries_snapshot()
+    except Exception:
+        qsnaps = []
+    reg.gauge("daft_tpu_query_progress_active",
+              "queries currently executing").set(len(qsnaps))
+    reg.gauge("daft_tpu_query_progress_tasks_inflight",
+              "partition tasks in flight across running queries").set(
+        sum(q.get("tasks_inflight", 0) for q in qsnaps))
+    reg.gauge("daft_tpu_query_progress_rows_flowed",
+              "rows flowed through operators of running queries").set(
+        sum(q.get("rows_flowed", 0) for q in qsnaps))
     pc = _plan_cache_snapshot()
     reg.gauge("daft_tpu_plan_cache_entries",
               "plan/program cache entries (canonical shapes)").set(
@@ -368,6 +393,7 @@ _TOP_KEYS = {
     "admission": dict,
     "cluster": dict,
     "streaming": dict,
+    "queries": list,
     "plan_cache": dict,
     "query_log": dict,
     "log": dict,
@@ -416,10 +442,21 @@ def validate_health(d: dict) -> List[str]:
               "workers_tripped", "tasks_inflight",
               "task_redispatches_total", "worker_losses_total",
               "tasks_speculated_total", "speculation_wins_total",
+              "telemetry_dropped_total",
               "restarts_used", "restart_budget",
               "restart_budget_remaining"):
         if not isinstance(d["cluster"].get(k), int):
             errs.append(f"cluster.{k} missing or non-int")
     if not isinstance(d["cluster"].get("degraded"), bool):
         errs.append("cluster.degraded missing or non-bool")
+    for i, q in enumerate(d["queries"]):
+        if not isinstance(q, dict):
+            errs.append(f"queries[{i}] is not an object")
+            continue
+        if not isinstance(q.get("query_id"), str):
+            errs.append(f"queries[{i}].query_id missing or non-str")
+        for k in ("ops_total", "ops_completed", "rows_flowed",
+                  "bytes_flowed", "rows_emitted", "tasks_inflight"):
+            if not isinstance(q.get(k), int):
+                errs.append(f"queries[{i}].{k} missing or non-int")
     return errs

@@ -34,7 +34,7 @@ from typing import Callable, Deque, Dict, List, Optional
 
 __all__ = ["EngineLogger", "get_logger", "current_query_id", "query_context",
            "tail", "clear", "set_ring_cap", "dropped_records",
-           "log_to_file", "close_file", "add_sink", "remove_sink",
+           "log_to_file", "close_file", "add_sink", "remove_sink", "inject",
            "DEFAULT_RING_CAP"]
 
 # bounded record ring: a record is a small dict, so the worst-case buffer
@@ -85,6 +85,39 @@ def query_context(qid: Optional[str]):
 # the logger
 # ---------------------------------------------------------------------------
 
+def _publish(rec: dict, py_logger: logging.Logger) -> Optional[str]:
+    """Ring append + sink dispatch + JSON-lines file write for one record
+    — the single publish discipline ``EngineLogger._emit`` and
+    :func:`inject` share, so relayed worker records and driver records
+    can never diverge in eviction accounting, sink error handling, or
+    file flushing. Returns the serialized line when a file is armed."""
+    # the shared-ring eviction counter, same module-global pattern every
+    # other ring accessor here uses (baselined for clear/set_ring_cap/...)
+    global _dropped  # daftlint: disable=DTL008
+    with _lock:
+        if _ring.maxlen is not None and len(_ring) == _ring.maxlen:
+            _dropped += 1
+        _ring.append(rec)
+        sinks = list(_sinks) if _sinks else None
+        f = _file
+    if sinks is not None:
+        for s in sinks:
+            try:
+                s(rec)
+            except Exception:
+                py_logger.exception("log sink failed")
+    line = None
+    if f is not None:
+        try:
+            line = json.dumps(rec, default=str)
+            with _file_lock:
+                f.write(line + "\n")
+                f.flush()
+        except (OSError, ValueError):
+            pass  # a full/closed log file must never fail the engine
+    return line
+
+
 class EngineLogger:
     """Named structured logger. ``logger.warning("spill_write_failed",
     path=..., error=...)`` emits one record; the ``event`` is a stable
@@ -97,7 +130,6 @@ class EngineLogger:
         self._py = logging.getLogger(f"daft_tpu.{name}")
 
     def _emit(self, level: str, event: str, fields: dict) -> None:
-        global _dropped
         rec = {"ts": round(time.time(), 6), "level": level,
                "logger": self.name, "event": event,
                "thread": threading.current_thread().name}
@@ -106,27 +138,7 @@ class EngineLogger:
             rec["query_id"] = qid
         if fields:
             rec.update(fields)
-        with _lock:
-            if _ring.maxlen is not None and len(_ring) == _ring.maxlen:
-                _dropped += 1
-            _ring.append(rec)
-            sinks = list(_sinks) if _sinks else None
-            f = _file
-        if sinks is not None:
-            for s in sinks:
-                try:
-                    s(rec)
-                except Exception:
-                    self._py.exception("log sink failed")
-        line = None
-        if f is not None:
-            try:
-                line = json.dumps(rec, default=str)
-                with _file_lock:
-                    f.write(line + "\n")
-                    f.flush()
-            except (OSError, ValueError):
-                pass  # a full/closed log file must never fail the engine
+        line = _publish(rec, self._py)
         lvl = _LEVELS[level]
         if self._py.isEnabledFor(lvl):
             self._py.log(lvl, "%s",
@@ -199,6 +211,17 @@ def dropped_records() -> int:
 def ring_size() -> int:
     with _lock:
         return len(_ring)
+
+
+def inject(rec: dict) -> None:
+    """Publish a pre-built record to the ring (plus sinks and the
+    JSON-lines file) AS RECORDED — the telemetry merge relays
+    worker-process log records through here so they land in the driver's
+    ring with their original timestamp/level/query_id intact. Stdlib
+    forwarding is skipped: the record already went through a worker's
+    stdlib tree, and re-forwarding would double every worker line for
+    caplog users."""
+    _publish(rec, logging.getLogger("daft_tpu.obs"))
 
 
 def add_sink(fn: Callable[[dict], None]) -> None:

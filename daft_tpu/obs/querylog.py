@@ -52,6 +52,7 @@ _EVENT_COUNTERS = (
     "task_redispatches", "worker_losses", "dist_local_fallbacks",
     "corruption_detected", "partitions_recomputed", "lineage_truncated",
     "spill_disk_full", "tasks_speculated", "speculation_wins",
+    "telemetry_dropped", "telemetry_truncated",
 )
 
 

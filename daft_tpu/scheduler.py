@@ -92,10 +92,29 @@ def run_map_task(op, part, ctx, op_name: str, seq: int):
     """One map-class partition execution, routed through the context's
     dispatch backend when present and willing, in-process otherwise.
     Returns ``(out_partition, rows, wall_ns)`` where wall_ns is the real
-    work time (the worker's own measurement on the remote path)."""
+    work time (the worker's own measurement on the remote path).
+
+    The remote path runs under a driver-side ``dist.remote`` phase span:
+    the backend stamps its submit -> sent -> reply split onto it and
+    splices the worker's telemetry fragment under it, so remote queue/
+    transport time stays visible even when a worker's fragment is lost
+    (the span is driver-local truth, not worker-reported)."""
     backend = getattr(ctx, "dist_backend", None)
     if backend is not None:
-        res = backend.try_execute(op, part, ctx, op_name, seq)
+        prof = ctx.stats.profiler
+        sp = prof.begin("dist.remote", op=op_name, part=seq,
+                        kind="phase") if prof.armed else None
+        try:
+            res = backend.try_execute(op, part, ctx, op_name, seq)
+        except BaseException:
+            if sp is not None:
+                prof.end(sp)  # a remote error is still a remote execution
+            raise
+        if sp is not None:
+            # a decline (ineligible task / degraded pool) was not a
+            # remote execution: close the span unrecorded so profiles
+            # never show phantom remote phases
+            (prof.end if res is not None else prof.cancel)(sp)
         if res is not None:
             return res
     t0 = time.perf_counter_ns()
@@ -173,6 +192,9 @@ def _await_result(task: "PartitionTask", fut, ctx) -> MicroPartition:
         _inflight_add(-1)
         if task.resource_request:
             ctx.accountant.release(task.resource_request)
+        progress = getattr(ctx, "progress", None)
+        if progress is not None:
+            progress.task_finished()
         raise QueryCancelledError(
             "query cancelled (queued task cancelled)") from None
 
@@ -243,6 +265,10 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
     exec_cap = None if budget is None else max(1, budget // 4)
     pool = ctx.pool()
     pending: deque = deque()
+    # the live-progress tracker (obs/cluster.QueryProgress) counts this
+    # query's dispatched-but-unfinished tasks; O(1) per task, absent when
+    # the plan ran through execute_plan without one (direct tests)
+    progress = getattr(ctx, "progress", None)
 
     def run_task(task: PartitionTask) -> MicroPartition:
         _WORKER_TL.active = True
@@ -292,6 +318,8 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
             if task.resource_request:
                 ctx.accountant.release(task.resource_request)
             _inflight_add(-1)
+            if progress is not None:
+                progress.task_finished()
 
     prof = ctx.stats.profiler
     try:
@@ -307,6 +335,8 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
                 task.submit_ns = time.perf_counter_ns()
             task.query_id = current_query_id()
             _inflight_add(1)
+            if progress is not None:
+                progress.task_started()
             pending.append((task, pool.submit(run_task, task)))
             while len(pending) >= window or (
                     exec_cap is not None and pending
@@ -331,6 +361,8 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
                 _inflight_add(-1)
                 if task.resource_request:
                     ctx.accountant.release(task.resource_request)
+                if progress is not None:
+                    progress.task_finished()
             else:
                 # running or completed but never pulled (early close): its
                 # parked-output ledger charge settles when the task is done

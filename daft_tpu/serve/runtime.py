@@ -113,6 +113,16 @@ class QueryHandle:
         """This query's flight-recorder QueryRecord (None until terminal)."""
         return self.stats.last_record
 
+    def progress(self):
+        """Live progress snapshot of this query while it executes (the
+        ``dt.health()["queries"]`` entry: ops completed/total, rows/bytes
+        flowed, tasks in flight, per-worker dispatch state, streaming
+        channel depths). None before admission and after completion —
+        a finished query's truth lives in :meth:`record`."""
+        from ..obs.cluster import query_progress
+
+        return query_progress(self.query_id)
+
     def cancel(self) -> None:
         """Stop the query at the next partition boundary; queued-but-
         unstarted work on the shared pool is cancelled too."""

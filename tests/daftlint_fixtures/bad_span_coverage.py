@@ -72,3 +72,10 @@ def _produce_partition(seg, part, chan, ctx):
     for m in part:
         chan.put(m, 0)
     chan.finish()
+
+
+def _execute_task(op, part, exec_ctx, msg):
+    """Violation: a distributed-worker task entry point that opens no
+    task-scope span — the driver would have nothing to splice the worker
+    telemetry subtree under, a cluster-wide attribution blind spot."""
+    return op.map_partition(part, exec_ctx)

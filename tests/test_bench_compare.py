@@ -49,6 +49,14 @@ class TestClassify:
         assert classify("distributed_task_redispatches") is None
         assert classify("distributed_workers") is None
 
+    def test_telemetry_suffixes(self):
+        # ISSUE 15: the cluster-telemetry cost headline is lower-better
+        # (its gate is < 3% on the distributed q1 leg); the A/B walls are
+        # ordinary lower-better walls
+        assert classify("dist_telemetry_overhead_pct") == "lower"
+        assert classify("dist_telemetry_wall_on_s") == "lower"
+        assert classify("dist_telemetry_wall_off_s") == "lower"
+
     def test_integrity_and_speculation_suffixes(self):
         # ISSUE 12: the checksum-cost headline is lower-better (its gate
         # is < 3% on the q1 leg), the straggler-mitigation headline

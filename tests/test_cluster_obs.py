@@ -1,0 +1,430 @@
+"""Cluster-wide observability plane (daft_tpu/obs/cluster.py, ISSUE 15).
+
+Covers the acceptance surface:
+- ONE truthful trace: under distributed_workers=2, a profiled query's
+  QueryProfile validates with ZERO orphan spans, carries >=1 spliced span
+  per worker process (the chrome per-worker lanes), stamps driver-side
+  ``dist.remote`` phase spans, and its per-op rows rollup equals the local
+  runner's run of the same query;
+- fail-open end to end: an injected ``telemetry.fragment`` fault, a
+  corrupt fragment, or an oversized fragment changes COUNTERS only —
+  results stay byte-identical and no task is re-dispatched because of
+  telemetry; a SIGKILL'd worker's lost fragments are counted, never
+  orphan driver spans;
+- worker log relay: worker-process log records land in the driver's ring
+  with query_id intact (zero orphan relayed lines);
+- live query progress: QueryProgress registry, dt.health()["queries"],
+  QueryHandle.progress(), and the telemetry health/gauge surfaces.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, faults
+from daft_tpu.context import get_context, set_execution_config
+from daft_tpu.dist import supervisor as sup
+from daft_tpu.obs import cluster as obs_cluster
+from daft_tpu.obs import log as obs_log
+from daft_tpu.obs.cluster import (TELEMETRY_VERSION, build_fragment,
+                                  merge_fragment, validate_fragment)
+from daft_tpu.obs.health import validate_health
+from daft_tpu.obs.querylog import validate_record
+from daft_tpu.profile.export import validate_profile
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    cfg_before = get_context().execution_config
+    faults.disarm()
+    yield
+    faults.disarm()
+    os.environ.pop(faults.ENV_FAULT_SPEC, None)
+    get_context().execution_config = cfg_before
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_teardown():
+    yield
+    sup.shutdown_worker_pool()
+    assert sup.live_worker_process_count() == 0
+
+
+def _frame(n=8000):
+    return dt.from_pydict(
+        {"a": list(range(n)), "b": [i % 13 for i in range(n)]})
+
+
+def _query(df):
+    return (df.select(col("b"), (col("a") * col("b") + 1).alias("ab"))
+            .where(col("ab") % 5 != 0)
+            .groupby("b").agg(col("ab").sum().alias("s")).sort("b"))
+
+
+# ---------------------------------------------------------------------------
+# the merged trace
+# ---------------------------------------------------------------------------
+
+class TestMergedTrace:
+    def test_profiled_query_one_truthful_trace(self):
+        set_execution_config(enable_result_cache=False)
+        local = _query(_frame().repartition(4)).collect()
+        local_rows = local.stats.snapshot()["op_rows"]
+
+        set_execution_config(distributed_workers=2,
+                            enable_result_cache=False)
+        got = _query(_frame().repartition(4)).collect(profile=True)
+        assert got.to_arrow().equals(local.to_arrow())
+
+        data = got.profile().to_dict()
+        assert validate_profile(data) == []
+        # zero-orphan invariant extends cluster-wide
+        assert data["orphan_spans"] == 0
+        # >=1 span per worker process: the chrome per-worker lanes
+        lanes = {s["thread"] for s in data["spans"]
+                 if s["thread"].startswith("worker-")}
+        assert lanes >= {"worker-0", "worker-1"}, lanes
+        names = {s["name"] for s in data["spans"]}
+        assert "dist.remote" in names
+        assert "worker.task" in names
+        # spliced worker spans are never kind "op" (the driver's own op
+        # span covers the remote wall; a second op span would double the
+        # per-op rollup)
+        for s in data["spans"]:
+            if s["thread"].startswith("worker-"):
+                assert s["kind"] != "op", s
+        # per-op rows rollup equals the local runner's
+        assert got.stats.snapshot()["op_rows"] == local_rows
+        c = got.stats.snapshot()["counters"]
+        assert c.get("telemetry_merged", 0) >= 1
+        assert not c.get("telemetry_dropped")
+        # QueryRecord carries the remote contributions + validates
+        rec = got.last_query_record()
+        assert validate_record(rec) == []
+        assert rec["op_rows"] == local_rows
+        assert rec["counters"].get("dist_tasks", 0) >= 1
+
+    def test_dist_remote_span_carries_phase_split(self):
+        set_execution_config(distributed_workers=2,
+                            enable_result_cache=False)
+        got = _query(_frame().repartition(4)).collect(profile=True)
+        remote = [s for s in got.profile().to_dict()["spans"]
+                  if s["name"] == "dist.remote"]
+        assert remote
+        # the driver-side split is driver-local truth: present even when
+        # a worker's fragment is lost
+        assert any("remote_wait" in (s.get("phases") or {})
+                   for s in remote), remote[:3]
+        assert all((s.get("attrs") or {}).get("worker") is not None
+                   for s in remote)
+
+    def test_unprofiled_query_still_folds_counters(self):
+        set_execution_config(distributed_workers=2,
+                            enable_result_cache=False)
+        got = _query(_frame().repartition(4)).collect()
+        c = got.stats.snapshot()["counters"]
+        # counters + log tail piggyback even without a profiler armed
+        assert c.get("telemetry_merged", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# fail-open semantics
+# ---------------------------------------------------------------------------
+
+class TestFailOpen:
+    def test_injected_fragment_fault_changes_counters_only(self):
+        set_execution_config(enable_result_cache=False)
+        local = _query(_frame().repartition(4)).collect()
+        set_execution_config(distributed_workers=2,
+                            enable_result_cache=False)
+        faults.arm("telemetry.fragment", "always")
+        try:
+            got = _query(_frame().repartition(4)).collect()
+        finally:
+            faults.disarm()
+        # results byte-identical; the only trace of the fault is counters
+        assert got.to_arrow().equals(local.to_arrow())
+        c = got.stats.snapshot()["counters"]
+        assert c.get("telemetry_dropped", 0) >= 1
+        assert not c.get("telemetry_merged")
+        # no task was re-dispatched or retried BECAUSE of telemetry
+        assert not c.get("task_redispatches")
+        assert not c.get("task_retries")
+        rec = got.last_query_record()
+        assert rec["outcome"] == "ok"
+        assert rec["events"].get("telemetry_dropped", 0) >= 1
+
+    def test_corrupt_fragment_dropped_not_fatal(self):
+        from daft_tpu.execution import ExecutionContext
+
+        ctx = ExecutionContext(get_context().execution_config)
+        for garbage in (None, 42, [], {"v": 99}, {"v": TELEMETRY_VERSION},
+                        {"v": TELEMETRY_VERSION, "counters": "nope",
+                         "spans": [], "events": [], "logs": [],
+                         "t0_ns": 0, "dur_ns": 0},
+                        {"v": TELEMETRY_VERSION, "counters": {},
+                         "spans": [{"bad": 1}], "events": [], "logs": [],
+                         "t0_ns": 0, "dur_ns": 0}):
+            assert merge_fragment(ctx, garbage, 0) is False
+        c = ctx.stats.snapshot()["counters"]
+        assert c.get("telemetry_dropped") == 7
+        assert not c.get("telemetry_merged")
+
+    def test_oversized_fragment_truncated_not_fatal(self):
+        from daft_tpu.execution import ExecutionContext
+
+        logs = [{"event": "x" * 2000, "level": "info"} for _ in range(50)]
+        spans = [{"id": i + 1, "parent": None, "name": "n" * 500,
+                  "kind": "bg", "thread": "t", "t0_ns": 0, "dur_ns": 1}
+                 for i in range(50)]
+        frag = build_fragment("q-x", "op", 0, 0, 10, {"host_filters": 3},
+                              spans, [], logs, max_bytes=4096)
+        assert frag["truncated"] is True
+        # the counters delta (the rollup-bearing part) survived
+        assert frag["counters"] == {"host_filters": 3}
+        assert validate_fragment(frag) == []
+        ctx = ExecutionContext(get_context().execution_config)
+        assert merge_fragment(ctx, frag, 1) is True
+        c = ctx.stats.snapshot()["counters"]
+        assert c.get("host_filters") == 3
+        assert c.get("telemetry_truncated") == 1
+        assert c.get("telemetry_merged") == 1
+
+    def test_sigkilled_worker_lost_fragments_never_orphan_spans(self):
+        set_execution_config(enable_result_cache=False)
+        local = _query(_frame().repartition(8)).collect()
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                            enable_result_cache=False)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()  # warm
+        faults.arm("worker.exec", "nth", n=3)
+        try:
+            got = _query(_frame().repartition(8)).collect(profile=True)
+        finally:
+            faults.disarm()
+        assert got.to_arrow().equals(local.to_arrow())
+        data = got.profile().to_dict()
+        assert validate_profile(data) == []
+        assert data["orphan_spans"] == 0
+        rec = got.last_query_record()
+        assert validate_record(rec) == []
+        assert rec["events"].get("worker_losses", 0) >= 1
+        # the killed worker's in-flight fragment was counted, not chased
+        assert rec["events"].get("telemetry_dropped", 0) >= 1
+        pool = sup._POOL
+        assert pool is not None
+        assert pool.snapshot()["telemetry_dropped_total"] >= 1
+
+    def test_worker_task_error_relays_worker_log_with_query_id(self):
+        sup.shutdown_worker_pool()
+        os.environ[faults.ENV_FAULT_SPEC] = json.dumps(
+            {"site": "worker.task", "mode": "first_n", "n": 1})
+        set_execution_config(distributed_workers=2,
+                            enable_result_cache=False)
+        try:
+            got = _query(_frame().repartition(4)).collect()
+        finally:
+            os.environ.pop(faults.ENV_FAULT_SPEC, None)
+        # the injected worker-side failure retried to success...
+        rec = got.last_query_record()
+        assert rec["outcome"] == "ok"
+        assert rec["events"].get("task_retries", 0) >= 1
+        # ...and the worker's own view of it was relayed into the
+        # driver's ring, query id intact (zero orphan relayed lines)
+        relayed = [r for r in obs_log.tail(2000) if "relay_worker" in r]
+        assert any(r["event"] == "worker_task_failed" for r in relayed)
+        assert all("query_id" in r for r in relayed), relayed[:3]
+        sup.shutdown_worker_pool()
+
+    def test_fault_site_registered(self):
+        assert "telemetry.fragment" in faults.SITES
+
+
+# ---------------------------------------------------------------------------
+# fragment schema + splice units
+# ---------------------------------------------------------------------------
+
+class TestFragmentUnits:
+    def test_build_fragment_bounds_entries(self):
+        logs = [{"event": f"e{i}"} for i in range(500)]
+        frag = build_fragment("q", "op", 1, 100, 50, {}, [], [], logs)
+        assert len(frag["logs"]) <= obs_cluster.MAX_FRAGMENT_LOGS
+        assert frag["truncated"] is True
+        assert validate_fragment(frag) == []
+
+    def test_splice_remaps_ids_and_reparents_roots(self):
+        from daft_tpu.profile.spans import Profiler
+
+        prof = Profiler(query_id="t")
+        anchor = prof.begin("op0", op="op0")
+        # worker subtree recorded in END order (child before parent),
+        # with worker-local ids that collide with driver ids
+        child = {"id": 1, "parent": 2, "name": "phasey", "kind": "phase",
+                 "thread": "MainThread", "t0_ns": 50, "dur_ns": 10}
+        root = {"id": 2, "parent": None, "name": "worker.task",
+                "kind": "op", "thread": "MainThread", "t0_ns": 0,
+                "dur_ns": 100}
+        n = prof.splice([child, root], [{"t_ns": 60, "kind": "spill"}],
+                        anchor.sid, 1000, thread="worker-7")
+        prof.end(anchor)
+        assert n == 2
+        spans = {s.name: s for s in prof.spans_snapshot()}
+        assert spans["worker.task"].parent == anchor.sid
+        assert spans["phasey"].parent == spans["worker.task"].sid
+        # remote op spans demote to bg (never double the per-op rollup)
+        assert spans["worker.task"].kind == "bg"
+        assert spans["worker.task"].thread == "worker-7"
+        assert spans["worker.task"].t0_ns == 1000
+        evs = prof.events_snapshot()
+        assert evs and evs[0]["t_ns"] == 1060
+
+    def test_splice_respects_span_cap(self):
+        from daft_tpu.profile.spans import Profiler
+
+        prof = Profiler(query_id="t", max_spans=2)
+        spans = [{"id": i + 1, "parent": None, "name": f"s{i}",
+                  "kind": "bg", "thread": "x", "t0_ns": 0, "dur_ns": 1}
+                 for i in range(5)]
+        assert prof.splice(spans, [], None, 0) == 2
+        assert prof.dropped_spans == 3
+
+    def test_collector_never_entered_builds_nothing(self):
+        from daft_tpu.execution import RuntimeStats
+
+        c = obs_cluster.TelemetryCollector("q", "op", 0, RuntimeStats())
+        assert c.fragment() is None
+
+
+# ---------------------------------------------------------------------------
+# live query progress
+# ---------------------------------------------------------------------------
+
+class TestQueryProgress:
+    def test_progress_unit_lifecycle(self):
+        from daft_tpu.execution import RuntimeStats
+
+        p = obs_cluster.QueryProgress("q-p", RuntimeStats(),
+                                      {"ScanOp": 1, "ProjectOp": 2})
+        p.task_started()
+        p.op_done("ScanOp")
+        p.op_done("ScanOp")  # over-count capped at the plan's 1 instance
+        p.add_rows(10)
+        snap = p.snapshot()
+        assert snap["ops_total"] == 3
+        assert snap["ops_completed"] == 1
+        assert snap["tasks_inflight"] == 1
+        assert snap["rows_emitted"] == 10
+        # repeated op CLASSES count per instance: completion can reach
+        # ops_total on plans with two ProjectOps
+        p.op_done("ProjectOp")
+        p.op_done("ProjectOp")
+        assert p.snapshot()["ops_completed"] == 3
+        p.task_finished()
+        p.task_finished()  # clamped, never negative
+        assert p.snapshot()["tasks_inflight"] == 0
+
+    def test_progress_visible_during_execution_and_health_validates(self):
+        set_execution_config(enable_result_cache=False)
+        seen = []
+
+        @dt.udf(return_dtype=dt.DataType.int64())
+        def sample(c):
+            seen.append(dt.query_progress())
+            h = dt.health()
+            seen.append(("health", validate_health(h), len(h["queries"])))
+            return c.to_pylist()
+
+        df = _frame(2000).repartition(2)
+        df.select(sample(col("a")).alias("a")).collect()
+        progress_lists = [s for s in seen if isinstance(s, list)]
+        assert any(pl for pl in progress_lists), seen
+        entry = next(pl for pl in progress_lists if pl)[0]
+        for key in ("query_id", "elapsed_s", "ops_total", "ops_completed",
+                    "rows_flowed", "bytes_flowed", "rows_emitted",
+                    "tasks_inflight", "workers", "channels"):
+            assert key in entry, (key, entry)
+        health_probes = [s for s in seen if isinstance(s, tuple)]
+        assert health_probes
+        for _tag, errs, n_queries in health_probes:
+            assert errs == []
+            assert n_queries >= 1
+
+    def test_progress_unregistered_after_completion(self):
+        set_execution_config(enable_result_cache=False)
+        got = _query(_frame(1000).repartition(2)).collect()
+        assert got is not None
+        assert dt.query_progress() == []
+
+    def test_serving_handle_progress(self):
+        import threading
+
+        from daft_tpu.serve.runtime import ServingRuntime
+
+        set_execution_config(enable_result_cache=False)
+        gate = threading.Event()
+        sampled = []
+
+        @dt.udf(return_dtype=dt.DataType.int64())
+        def slow(c):
+            gate.wait(10)
+            return c.to_pylist()
+
+        rt = ServingRuntime(max_concurrent_queries=2)
+        try:
+            df = _frame(1000).repartition(2)
+            h = rt.submit(df.select(slow(col("a")).alias("a")))
+            assert h.wait_admitted(10)
+            deadline = time.monotonic() + 10
+            snap = None
+            while time.monotonic() < deadline:
+                snap = h.progress()
+                if snap is not None:
+                    break
+                time.sleep(0.01)
+            gate.set()
+            h.result(timeout=30)
+            assert snap is not None, "no live progress observed"
+            assert snap["query_id"] == h.query_id
+            assert snap["ops_total"] >= 1
+            # a finished query's progress is gone; its truth is the record
+            deadline = time.monotonic() + 5
+            while h.progress() is not None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert h.progress() is None
+        finally:
+            gate.set()
+            rt.shutdown(timeout_s=10)
+
+
+# ---------------------------------------------------------------------------
+# health / gauges / sequence accounting
+# ---------------------------------------------------------------------------
+
+class TestHealthSurfaces:
+    def test_cluster_health_carries_telemetry_detail_and_gauges(self):
+        set_execution_config(distributed_workers=2,
+                            enable_result_cache=False)
+        _ = _query(_frame(2000).repartition(4)).collect()
+        h = dt.health()
+        assert validate_health(h) == []
+        clu = h["cluster"]
+        assert "telemetry_dropped_total" in clu
+        for w in clu["worker_detail"].values():
+            assert "telemetry_rx" in w and "telemetry_dropped" in w
+        # a healthy run receives every fragment it was promised
+        assert sum(w["telemetry_rx"]
+                   for w in clu["worker_detail"].values()) >= 1
+        text = dt.metrics_text()
+        assert "daft_tpu_cluster_telemetry_dropped_total" in text
+        assert "daft_tpu_query_progress_active" in text
+        assert "daft_tpu_query_progress_tasks_inflight" in text
+
+    def test_idle_cluster_health_still_validates(self):
+        sup.shutdown_worker_pool()
+        h = dt.health()
+        assert validate_health(h) == []
+        assert h["cluster"]["telemetry_dropped_total"] == 0
+        assert isinstance(h["queries"], list)

@@ -45,7 +45,9 @@ _SNAPSHOT_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 # explicitly even though "_s" already covers it — it is a headline
 # streaming metric and must survive a reshuffle of the generic suffixes.
 # "_recovery_overhead_pct" (distributed rung: the cost of surviving a
-# mid-query worker SIGKILL) is headline-pinned the same way. The
+# mid-query worker SIGKILL) is headline-pinned the same way, and so is
+# "_telemetry_overhead_pct" (distributed rung: what the cluster
+# observability plane's per-task fragments cost — the <3% gate). The
 # chaos-leg EVENT counts ("_worker_losses", "_task_redispatches",
 # "_workers") are deliberately ABSENT from both lists: they are pinned by
 # the rung's seeded fault plan, not performance, and a plan change must
@@ -56,7 +58,7 @@ _LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_ttfr_s", "_pct",
                    "_share", "_bytes", "_peak_mb", "_rows",
                    "_misses", "_throttled", "_failures", "_errors",
                    "_overhead_pct", "_recovery_overhead_pct",
-                   "_shed_count")
+                   "_telemetry_overhead_pct", "_shed_count")
 _HIGHER_SUFFIXES = ("_per_sec", "_vs_baseline", "_speedup_x", "_gbps",
                     "_mbps", "_hits", "_qps", "value", "_rows_pruned",
                     "_reduction_x", "_hit_rate")

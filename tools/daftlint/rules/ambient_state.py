@@ -89,6 +89,10 @@ REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
     ("daft_tpu/adapt/resultcache.py", "RESULT_CACHE"),
     # FDO planning collector: a thread-local scope marker, not shared state
     ("daft_tpu/adapt/fdo.py", "_tl"),
+    # live query-progress registry (obs/cluster.py): one entry per
+    # RUNNING execution, registered/unregistered by execute_plan — the
+    # dt.health()["queries"] source; bounded by concurrent query count
+    ("daft_tpu/obs/cluster.py", "_progress"),
 }
 
 _CONTAINER_CTOR_BASES = {

@@ -84,6 +84,13 @@ def _is_physical_execute(fn: ast.FunctionDef) -> bool:
 _STREAM_DRIVER_FNS = {"_produce_partition", "_produce_with_retry",
                       "_produce_once"}
 
+# distributed-worker task entry point (daft_tpu/dist/worker.py): every
+# remote task execution must open a task-scope span — it is the root the
+# driver splices the worker's telemetry subtree under (obs/cluster.py),
+# and without it the whole worker becomes a cluster-wide attribution
+# blind spot exactly when queries get hardest to debug
+_WORKER_TASK_FNS = {"_execute_task"}
+
 
 def _delegates_to_stream_driver(fn: ast.FunctionDef) -> bool:
     for node in ast.walk(fn):
@@ -120,7 +127,8 @@ class SpanCoverageRule(Rule):
     description = ("every *Op.execute(self, inputs, ctx) entry point "
                    "delegates to _map_execute or opens a profiler span; "
                    "morsel_streamable ops implement map_partition; the "
-                   "stream driver's producer opens a span")
+                   "stream driver's producer and the distributed worker's "
+                   "task entry point open spans")
 
     def run(self, project: Project) -> List[Finding]:
         out: List[Finding] = []
@@ -138,6 +146,15 @@ class SpanCoverageRule(Rule):
                             f"stream-driver `{node.name}` opens no "
                             "profiler span — morsel work on pool workers "
                             "must not be an attribution blind spot"))
+                    continue
+                if isinstance(node, ast.FunctionDef) \
+                        and node.name in _WORKER_TASK_FNS:
+                    if not _execute_is_covered(node):
+                        out.append(self.finding(
+                            rel, node.lineno,
+                            f"worker task entry `{node.name}` opens no "
+                            "task-scope profiler span — remote work "
+                            "would vanish from the merged cluster trace"))
                     continue
                 if not isinstance(node, ast.ClassDef) or \
                         not node.name.endswith("Op"):

@@ -13,7 +13,13 @@ Checks, in order:
     containing record.json (valid) + stats.txt, and the SECOND run of the
     same plan fingerprint is auto-profiled (bundle carries profile.json);
  4. metrics_text() exports the health/ledger gauges;
- 5. the structured-log ring carries the bundle's info line with query_id.
+ 5. the structured-log ring carries the bundle's info line with query_id;
+ 6. DISTRIBUTED leg: a 2-worker profiled query produces ONE merged
+    QueryProfile that validates with zero orphan spans, carries at least
+    one spliced span per worker process (the chrome per-worker lanes),
+    stamps driver-side dist.remote spans, and leaves zero orphan worker
+    log lines in the driver's ring — the cluster observability plane's
+    schema gate (daft_tpu/obs/cluster.py).
 
 Exits nonzero with a named failure on any violation.
 """
@@ -122,8 +128,64 @@ def main() -> int:
         print("obs-smoke: FAIL — no attributed diagnostics_bundle log line")
         return 1
 
+    # 6: distributed leg — one merged trace across 2 worker processes
+    from daft_tpu.context import get_context
+    from daft_tpu.dist import supervisor as sup
+    from daft_tpu.profile.export import validate_profile
+
+    cfg = get_context().execution_config
+    cfg.distributed_workers = 2
+    try:
+        d = dt.from_pydict({"k": list(range(6000)),
+                            "g": [i % 17 for i in range(6000)]})
+        q2 = (d.repartition(4)
+              .select(col("g"), (col("k") * col("g")).alias("kg"))
+              .where(col("kg") % 3 != 0)
+              .groupby("g").agg(col("kg").sum().alias("s")).sort("g"))
+        got = q2.collect(profile=True)
+        prof = got.profile()
+        data = prof.to_dict() if prof is not None else None
+        if data is None or validate_profile(data):
+            print("obs-smoke: FAIL — distributed QueryProfile invalid: "
+                  f"{None if data is None else validate_profile(data)}")
+            return 1
+        if data["orphan_spans"]:
+            print(f"obs-smoke: FAIL — {data['orphan_spans']} orphan "
+                  "span(s) in the merged distributed profile")
+            return 1
+        lanes = {s["thread"] for s in data["spans"]
+                 if s["thread"].startswith("worker-")}
+        if len(lanes) < 2:
+            print(f"obs-smoke: FAIL — expected >=2 per-worker chrome "
+                  f"lanes, got {sorted(lanes)}")
+            return 1
+        names = {s["name"] for s in data["spans"]}
+        if "dist.remote" not in names or "worker.task" not in names:
+            print(f"obs-smoke: FAIL — remote spans missing from "
+                  f"{sorted(names)[:10]}")
+            return 1
+        orphan_worker_lines = [
+            r for r in obs_log.tail(10**6)
+            if "relay_worker" in r and "query_id" not in r]
+        if orphan_worker_lines:
+            print("obs-smoke: FAIL — worker log lines without query_id: "
+                  f"{orphan_worker_lines[:2]}")
+            return 1
+        c = got.stats.snapshot()["counters"]
+        if not c.get("telemetry_merged"):
+            print("obs-smoke: FAIL — no telemetry fragment merged on the "
+                  "distributed leg")
+            return 1
+    finally:
+        cfg.distributed_workers = 0
+        sup.shutdown_worker_pool()
+    if sup.live_worker_process_count():
+        print("obs-smoke: FAIL — leaked worker processes")
+        return 1
+
     print(f"obs-smoke: OK — {len(dt.query_log())} record(s), "
           f"{len(bundles)} bundle(s), auto-armed profile on run 2, "
+          f"{len(lanes)} worker lane(s) in the merged profile, "
           f"{len(obs_log.tail(10**6))} log record(s)")
     return 0
 
