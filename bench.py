@@ -603,7 +603,11 @@ def measure_distributed(scale: float = 0.02, workers: int = 2,
                                           "cluster_telemetry",
                                           "speculative_execution",
                                           "speculation_min_s",
-                                          "speculation_quantile_factor")}
+                                          "speculation_quantile_factor",
+                                          "peer_shuffle",
+                                          "distributed_workers_min",
+                                          "distributed_workers_max",
+                                          "scan_tasks_min_size_bytes")}
     cfg.enable_result_cache = False
     walls = {"local": [], "dist": []}
     out = {"distributed_workers": workers}
@@ -758,11 +762,172 @@ def measure_distributed(scale: float = 0.02, workers: int = 2,
                 walls_s["off"] / walls_s["on"], 3)
         finally:
             os.environ.pop(ENV_FAULT_SPEC, None)
+        # restore straggler-leg tuning before the peer-plane legs
+        for k in ("speculative_execution", "speculation_min_s",
+                  "speculation_quantile_factor"):
+            setattr(cfg, k, saved[k])
+        _peer_plane_legs(out, cfg)
         return out
     finally:
         for k, v in saved.items():
             setattr(cfg, k, v)
         sup.shutdown_worker_pool()
+
+
+def _peer_plane_legs(out: dict, cfg) -> None:
+    """Peer-to-peer shuffle legs of the distributed rung (ISSUE 16).
+
+    Driver-bytes leg — WEAK scaling (rows grow with N): parquet-backed
+    shuffle+groupby at 2 and 4 workers, star (peer_shuffle off) vs p2p,
+    reading each query's ``dist_driver_bytes`` counter (task payload +
+    op bytes dispatched plus result bytes returned). The gate:
+    ``dist_p2p_growth_x`` stays flat (within 10%) going 2 -> 4 workers
+    while ``dist_star_growth_x`` tracks the ~2x data growth — on the p2p
+    plane the driver ships scan-task metadata and piece-location maps,
+    never payload, so its bytes do not scale with the data.
+
+    Preemption leg — ``peer_preemption_overhead_pct``: SIGTERM one worker
+    mid-shuffle (graceful drain: quiesce, let peers re-source its pieces,
+    exit) on an elastic min==max pool that respawns the slot, vs the
+    undisturbed run. Order-alternated pairs, median of time-adjacent
+    paired deltas (same estimator as the integrity/telemetry A/Bs)."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+
+    import pyarrow as pa
+    import pyarrow.parquet as papq
+
+    import daft_tpu as dt
+    from daft_tpu.dist import supervisor as sup
+
+    tmp = tempfile.mkdtemp(prefix="daft-peer-bench-")
+    cfg.scan_tasks_min_size_bytes = 0
+    # weak scaling: ROWS grow with N; the plan SHAPE (file count, bucket
+    # count) stays fixed so the A/B isolates payload-byte growth from
+    # task-count growth — star must ship the 2x payload through the
+    # driver, p2p ships the same number of (tiny) scan tasks and
+    # location maps either way
+    n_files, n_buckets, rows_per_worker = 8, 8, 40_000
+    try:
+        # ---- driver-bytes leg: star vs p2p at 2 and 4 workers -----------
+        def dataset(n_workers: int) -> str:
+            d = os.path.join(tmp, f"n{n_workers}")
+            if not os.path.isdir(d):
+                os.makedirs(d)
+                per_file = rows_per_worker * n_workers // n_files
+                for i in range(n_files):
+                    base = i * per_file
+                    papq.write_table(
+                        pa.table({"a": list(range(base, base + per_file)),
+                                  "b": [v % 997 for v in
+                                        range(base, base + per_file)]}),
+                        os.path.join(d, f"part{i}.parquet"))
+            return os.path.join(d, "*.parquet")
+
+        def driver_bytes(n_workers: int, p2p: bool) -> int:
+            sup.shutdown_worker_pool()
+            cfg.distributed_workers = n_workers
+            cfg.peer_shuffle = p2p
+            pat = dataset(n_workers)
+            q = (dt.read_parquet(pat)
+                 .repartition(n_buckets, "b").groupby("b")
+                 .agg(dt.col("a").sum().alias("s")).sort("b"))
+            _ = q.collect()  # spawn + warm outside the measured query
+            res = (dt.read_parquet(pat)
+                   .repartition(n_buckets, "b").groupby("b")
+                   .agg(dt.col("a").sum().alias("s")).sort("b").collect())
+            c = res.stats.snapshot()["counters"]
+            return int(c.get("dist_driver_bytes", 0))
+
+        star = {n: driver_bytes(n, p2p=False) for n in (2, 4)}
+        p2p = {n: driver_bytes(n, p2p=True) for n in (2, 4)}
+        out["dist_driver_bytes_star"] = star[4]
+        out["dist_driver_bytes_p2p"] = p2p[4]
+        if star[2]:
+            out["dist_star_growth_x"] = round(star[4] / star[2], 3)
+        if p2p[2]:
+            out["dist_p2p_growth_x"] = round(p2p[4] / p2p[2], 3)
+        # ---- preemption leg: SIGTERM one worker mid-shuffle -------------
+        sup.shutdown_worker_pool()
+        workers = 2
+        cfg.distributed_workers = workers
+        cfg.distributed_workers_min = workers
+        cfg.distributed_workers_max = workers
+        cfg.peer_shuffle = True
+        pat = dataset(workers)
+
+        def run_query():
+            return (dt.read_parquet(pat)
+                    .repartition(n_buckets, "b").groupby("b")
+                    .agg(dt.col("a").sum().alias("s")).sort("b")
+                    .collect())
+
+        want = run_query().to_pydict()  # spawn + warm
+
+        def heal(timeout_s: float = 15.0):
+            # wait for the elastic controller to respawn the drained slot
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                pool = sup._POOL
+                if pool is not None:
+                    with pool._cond:
+                        ready = sum(1 for w in pool.workers
+                                    if w.state == "ready"
+                                    and not w.draining)
+                    if ready >= workers:
+                        return
+                time.sleep(0.1)
+
+        def sigterm_one(after_s: float):
+            time.sleep(after_s)
+            pool = sup._POOL
+            if pool is None:
+                return
+            with pool._cond:
+                pids = [w.proc.pid for w in pool.workers
+                        if w.proc is not None and w.state == "ready"]
+            if pids:
+                try:
+                    os.kill(pids[0], _signal.SIGTERM)
+                except OSError:
+                    pass
+
+        base = run_query()  # steady-state wall estimate for kill timing
+        t0 = time.perf_counter()
+        _ = run_query()
+        est_wall = time.perf_counter() - t0
+        deltas = []
+        for t in range(8):
+            pair = {}
+            order = (("ctl", "kill") if t % 2 == 0 else ("kill", "ctl"))
+            for mode in order:
+                heal()
+                killer = None
+                if mode == "kill":
+                    killer = threading.Thread(
+                        target=sigterm_one, args=(est_wall * 0.3,),
+                        daemon=True)
+                    killer.start()
+                t0 = time.perf_counter()
+                got = run_query()
+                pair[mode] = time.perf_counter() - t0
+                if killer is not None:
+                    killer.join()
+                if got.to_pydict() != want:
+                    raise AssertionError(
+                        f"peer preemption leg parity broke ({mode})")
+            deltas.append((pair["kill"] - pair["ctl"]) / pair["ctl"])
+        deltas.sort()
+        mid = len(deltas) // 2
+        med = (deltas[mid] if len(deltas) % 2
+               else (deltas[mid - 1] + deltas[mid]) / 2)
+        out["peer_preemption_overhead_pct"] = round(med * 100.0, 1)
+        del base
+    finally:
+        sup.shutdown_worker_pool()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def measure_streaming(scale: Optional[float] = None) -> dict:

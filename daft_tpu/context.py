@@ -249,6 +249,34 @@ class ExecutionConfig:
     # failure. Off = replies carry result/error only (the bench
     # dist_telemetry_overhead_pct A/B axis).
     cluster_telemetry: bool = True
+    # peer-to-peer shuffle data plane (daft_tpu/dist/peerplane.py, README
+    # "Peer-to-peer shuffle & elasticity"): hash/random shuffles dispatch
+    # fanout tasks that park their pieces ON the workers, and reduce
+    # buckets carry only a piece-location map — whoever materializes a
+    # bucket pulls its pieces straight from the hosting peers over the
+    # token-authenticated crc-framed transport, so driver payload bytes
+    # stay flat as the worker count grows. Results are byte-identical
+    # with this off and at every N; a dead/corrupt/stale peer degrades to
+    # lineage recompute of just the lost pieces (peer_refetches), never a
+    # failed query.
+    peer_shuffle: bool = True
+    # elastic worker pool: when BOTH bounds are set, the supervisor scales
+    # the live worker count inside [min, max] — up under pressure
+    # (admission queue depth + dispatch waiters; warm FDO history jumps
+    # straight toward max, a cold pool steps by one), down by gracefully
+    # DRAINING an idle worker after elastic_idle_scale_down_s of fleet
+    # idleness. Unset (the default) keeps the fixed-size pool semantics.
+    distributed_workers_min: Optional[int] = None
+    distributed_workers_max: Optional[int] = None
+    elastic_scale_interval_s: float = 0.5
+    elastic_idle_scale_down_s: float = 10.0
+    # drain_worker()/SIGTERM grace: a draining worker stops taking tasks
+    # but keeps serving hosted shuffle pieces for this window, so spot
+    # preemption costs bounded recompute, never a failed query; a worker
+    # whose in-flight task outlives drain_timeout is killed and the task
+    # re-dispatches through the normal loss path
+    worker_drain_grace_s: float = 2.0
+    worker_drain_timeout_s: float = 10.0
     # --- self-healing data plane (daft_tpu/integrity/, README "Data
     # integrity & speculation") ----------------------------------------
     # end-to-end partition integrity: payloads leaving compute (spill IPC

@@ -86,7 +86,8 @@ class _TaskEntry:
     __slots__ = ("task_id", "op_name", "seq", "ctx", "attempts", "excluded",
                  "status", "result", "error", "event", "charged", "wid",
                  "active_wids", "spec_wid", "dispatched_at", "frag",
-                 "frag_wid", "submit_pc", "sent_pc", "reply_pc")
+                 "frag_wid", "submit_pc", "sent_pc", "reply_pc", "extra",
+                 "prefer", "result_wid")
 
     def __init__(self, task_id: int, op_name: str, seq: int, ctx):
         self.task_id = task_id
@@ -125,6 +126,16 @@ class _TaskEntry:
         self.submit_pc = 0
         self.sent_pc = 0
         self.reply_pc = 0
+        # envelope extras merged into the task message (a peer-shuffle
+        # fanout carries its split spec here instead of a map op)
+        self.extra: Optional[dict] = None
+        # peer-locality preference: worker slots already hosting this
+        # task's input pieces (dispatch picks among these when one is
+        # free, turning remote piece fetches into local store reads)
+        self.prefer: Optional[set] = None
+        # the slot whose RESULT settled the entry (the piece-hosting
+        # worker for a fanout — survives speculation; wid does not)
+        self.result_wid: Optional[int] = None
 
 
 class _WorkerHandle:
@@ -133,13 +144,14 @@ class _WorkerHandle:
     __slots__ = ("wid", "proc", "sock", "state", "last_pong", "inflight",
                  "restarts", "deaths", "breaker", "send_lock", "ops_sent",
                  "rx_thread", "ledger_report", "pid", "tasks_done",
-                 "telemetry_rx", "telemetry_dropped")
+                 "telemetry_rx", "telemetry_dropped", "peer_addr",
+                 "peer_report", "draining", "drained")
 
     def __init__(self, wid: int, breaker: WorkerHealth):
         self.wid = wid
         self.proc: Optional[subprocess.Popen] = None
         self.sock: Optional[socket.socket] = None
-        self.state = "dead"  # ready | dead
+        self.state = "dead"  # ready | dead | spawning (elastic growth)
         self.last_pong = 0.0
         self.inflight: Dict[int, _TaskEntry] = {}
         self.restarts = 0
@@ -156,6 +168,15 @@ class _WorkerHandle:
         # a positive gap is a fragment lost in flight (telemetry_dropped)
         self.telemetry_rx = 0
         self.telemetry_dropped = 0
+        # peer-shuffle piece-server endpoint from the hello, and the
+        # worker's pong-piggybacked piece-store snapshot (peerplane.py)
+        self.peer_addr: Optional[Tuple[str, int]] = None
+        self.peer_report: dict = {}
+        # draining: quiescing on request (no new tasks; pieces still
+        # served through the grace window); drained: the quiesce finished
+        # — this slot's exit is NOT a worker loss
+        self.draining = False
+        self.drained = False
 
 
 def _repo_root() -> str:
@@ -172,10 +193,32 @@ class WorkerPool:
     def __init__(self, cfg):
         self.cfg = cfg
         self.n = max(1, int(cfg.distributed_workers))
+        # elastic bounds: with BOTH set the supervision loop scales the
+        # live worker count inside [n_min, n_max] (admission-queue depth +
+        # dispatch waiters push up, sustained idleness drains down);
+        # unset keeps the fixed-size pool semantics exactly
+        wmin = getattr(cfg, "distributed_workers_min", None)
+        wmax = getattr(cfg, "distributed_workers_max", None)
+        self._elastic = wmin is not None and wmax is not None
+        self.n_min = max(1, int(wmin)) if self._elastic else self.n
+        self.n_max = max(self.n_min, int(wmax)) if self._elastic else self.n
+        if self._elastic:
+            self.n = min(max(self.n, self.n_min), self.n_max)
+        # the knob values this pool was built for (get_worker_pool's
+        # rebuild predicate — self.n drifts under elasticity)
+        self._cfg_key = (cfg.distributed_workers, wmin, wmax,
+                         cfg.memory_budget_bytes)
         self._cond = threading.Condition()
         self._closed = False
         self._token = secrets.token_hex(16)
         self._task_seq = itertools.count(1)
+        # handshakes are serialized: concurrent spawns would steal each
+        # other's hello candidates off the shared listener. A stolen but
+        # VALID hello for another slot is parked (wid -> (conn, hello))
+        # for that slot's spawner rather than closed — closing it would
+        # kill the sibling's worker mid-handshake
+        self._spawn_lock = threading.Lock()
+        self._parked: Dict[int, tuple] = {}
         # pool-wide counters (the cluster health / gauge surface)
         self.worker_losses_total = 0
         self.task_redispatches_total = 0
@@ -188,6 +231,23 @@ class WorkerPool:
         # in-flight replies at worker death (driver-side merge drops are
         # per-query RuntimeStats counters, not pool state)
         self.telemetry_dropped_total = 0
+        # peer-shuffle plane: live shuffle ids (dropped at query finish),
+        # and every payload byte the DRIVER shipped or received over the
+        # task channel — the star-vs-p2p flatness gate's numerator
+        self._shuffle_seq = itertools.count(1)
+        self._live_shuffles: set = set()
+        self.driver_payload_bytes_total = 0
+        # elastic controller state: wids never reuse (a recycled wid
+        # would alias a fresh worker into old tasks' excluded sets)
+        self._next_wid = itertools.count(self.n)
+        self.workers_drained_total = 0
+        self.scale_ups_total = 0
+        self.scale_downs_total = 0
+        self.last_scale_decision = "init"
+        self._last_scale_at = 0.0
+        self._idle_since = time.monotonic()
+        self._acquire_waiters = 0
+        self._scaling = False
         # speculative straggler mitigation: completed-wall history per op
         # (feeds the p75 threshold), the bounded count of duplicates in
         # flight, and the speculated/won totals
@@ -201,12 +261,12 @@ class WorkerPool:
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("127.0.0.1", 0))
-        self._listener.listen(self.n + 4)
+        self._listener.listen(self.n_max + 4)
         self._port = self._listener.getsockname()[1]
-        thresh = max(1, int(cfg.device_breaker_threshold))
-        cool = float(cfg.device_breaker_cooldown_s)
+        self._bthresh = max(1, int(cfg.device_breaker_threshold))
+        self._bcool = float(cfg.device_breaker_cooldown_s)
         self.workers: List[_WorkerHandle] = [
-            _WorkerHandle(i, WorkerHealth(thresh, cool))
+            _WorkerHandle(i, WorkerHealth(self._bthresh, self._bcool))
             for i in range(self.n)]
         for w in self.workers:
             try:
@@ -230,7 +290,9 @@ class WorkerPool:
         workers plus the driver together can never exceed it."""
         share = None
         if self.cfg.memory_budget_bytes is not None:
-            share = max(1, self.cfg.memory_budget_bytes // (self.n + 1))
+            # carve by the elastic CEILING so the budget invariant holds
+            # at any scale without respawning the fleet on a resize
+            share = max(1, self.cfg.memory_budget_bytes // (self.n_max + 1))
         return dataclasses.replace(
             self.cfg, distributed_workers=0, memory_budget_bytes=share,
             executor_threads=1, enable_query_log=False,
@@ -258,41 +320,62 @@ class WorkerPool:
         deadline = time.monotonic() + float(self.cfg.worker_spawn_timeout_s)
         sock = None
         try:
+            self._spawn_lock.acquire()
             while True:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise DaftTransientError(
-                        f"worker {w.wid} spawn timed out")
-                self._listener.settimeout(min(remaining, 5.0))
-                try:
-                    cand, _ = self._listener.accept()
-                except socket.timeout:
-                    if proc.poll() is not None:
+                parked = self._parked.pop(w.wid, None)
+                if parked is not None:
+                    # a sibling spawner already accepted and validated our
+                    # worker's hello off the shared listener
+                    cand, hello = parked
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
                         raise DaftTransientError(
-                            f"worker {w.wid} exited rc={proc.returncode} "
-                            "before handshake")
-                    continue
-                try:
-                    hello = recv_msg(cand)
-                except Exception:
-                    cand.close()
-                    continue
-                if (hello.get("type") == "hello"
-                        and hello.get("proto") != PROTOCOL_VERSION):
-                    # old-frame peer (pre-checksum protocol) or a version
-                    # skew: reject at the handshake — mixed-version frames
-                    # would desync, and unverified payloads defeat the
-                    # end-to-end integrity contract
-                    logger.warning("worker_proto_rejected", worker=w.wid,
-                                   got=hello.get("proto"),
-                                   want=PROTOCOL_VERSION)
-                    cand.close()
-                    continue
+                            f"worker {w.wid} spawn timed out")
+                    self._listener.settimeout(min(remaining, 5.0))
+                    try:
+                        cand, _ = self._listener.accept()
+                    except socket.timeout:
+                        if proc.poll() is not None:
+                            raise DaftTransientError(
+                                f"worker {w.wid} exited rc={proc.returncode}"
+                                " before handshake")
+                        continue
+                    try:
+                        hello = recv_msg(cand)
+                    except Exception:
+                        cand.close()
+                        continue
+                    if (hello.get("type") == "hello"
+                            and hello.get("proto") != PROTOCOL_VERSION):
+                        # old-frame peer (pre-checksum protocol) or a
+                        # version skew: reject at the handshake — mixed-
+                        # version frames would desync, and unverified
+                        # payloads defeat the end-to-end integrity contract
+                        logger.warning("worker_proto_rejected", worker=w.wid,
+                                       got=hello.get("proto"),
+                                       want=PROTOCOL_VERSION)
+                        cand.close()
+                        continue
                 if (hello.get("type") == "hello"
                         and hello.get("token") == self._token
                         and hello.get("worker_id") == w.wid):
                     sock = cand
                     break
+                other = hello.get("worker_id") if (
+                    hello.get("type") == "hello"
+                    and hello.get("token") == self._token) else None
+                if isinstance(other, int) and other != w.wid:
+                    # a concurrent spawn's worker dialed in while we held
+                    # the listener: park its handshake for that spawner
+                    stale = self._parked.pop(other, None)
+                    if stale is not None:
+                        try:
+                            stale[0].close()
+                        except OSError:
+                            pass
+                    self._parked[other] = (cand, hello)
+                    continue
                 cand.close()  # stale/foreign connection: not ours
             send_msg(sock, {"type": "init", "cfg": self._worker_cfg()},
                      checksum=self._checksum)
@@ -305,6 +388,8 @@ class WorkerPool:
             except Exception:
                 pass
             raise
+        finally:
+            self._spawn_lock.release()
         with self._cond:
             if self._closed:
                 # shutdown raced this spawn: shutdown() iterated the slots
@@ -323,6 +408,12 @@ class WorkerPool:
                 # per-incarnation telemetry accounting with it
                 w.telemetry_rx = 0
                 w.telemetry_dropped = 0
+                peer_port = hello.get("peer_port")
+                w.peer_addr = (("127.0.0.1", int(peer_port))
+                               if peer_port else None)
+                w.peer_report = {}
+                w.draining = False
+                w.drained = False
                 if not initial:
                     w.restarts += 1
                 w.rx_thread = threading.Thread(
@@ -357,6 +448,9 @@ class WorkerPool:
                             w.last_pong = time.monotonic()
                             w.ledger_report = msg.get("ledger",
                                                       w.ledger_report)
+                            peer = msg.get("peer")
+                            if isinstance(peer, dict):
+                                w.peer_report = peer
                             tseq = msg.get("tseq")
                             if isinstance(tseq, int):
                                 # the worker attached tseq fragments ever;
@@ -369,6 +463,18 @@ class WorkerPool:
                                 if gap > 0:
                                     w.telemetry_dropped += gap
                                     self.telemetry_dropped_total += gap
+                elif kind == "draining":
+                    # SIGTERM landed on the worker itself (spot
+                    # preemption): it finishes its current task, keeps
+                    # serving pieces through the grace window, then
+                    # exits — from here on it takes no new work and its
+                    # exit reads as a drain, not a loss
+                    with self._cond:
+                        if w.sock is sock and w.state == "ready":
+                            w.draining = True
+                            self._cond.notify_all()
+                    logger.info("worker_draining", worker=w.wid,
+                                reason="sigterm")
                 elif kind in ("result", "task_error", "task_skipped"):
                     self._on_task_reply(w, sock, msg)
         except TransportClosed:
@@ -403,6 +509,7 @@ class WorkerPool:
             if msg["type"] == "result":
                 entry.status = "done"
                 entry.result = (msg["part"], msg["rows"], msg["wall_ns"])
+                entry.result_wid = w.wid
                 entry.frag = frag
                 entry.frag_wid = w.wid
                 entry.reply_pc = reply_pc
@@ -500,6 +607,14 @@ class WorkerPool:
         per-query counters. Idempotent per incarnation."""
         with self._cond:
             if w.state != "ready" or (sock is not None and w.sock is not sock):
+                # a stale incarnation's death (the slot already moved on):
+                # still close ITS socket, or the rx thread that reported the
+                # death stays blocked in recv() forever
+                if sock is not None and sock is not w.sock:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
                 return
             if self._closed:
                 # drain-mode shutdown: the worker exiting on request is not
@@ -513,7 +628,19 @@ class WorkerPool:
                         pass
                 return
             w.state = "dead"
-            w.deaths += 1
+            drained = w.draining
+            if drained:
+                # a graceful quiesce completing (drain_worker / SIGTERM):
+                # no new tasks landed since the draining mark, peers had
+                # the grace window to finish fetching, and its remaining
+                # pieces re-source through lineage at the read site —
+                # this exit is paid-for, not a failure (no breaker hit,
+                # no worker_losses)
+                w.draining = False
+                w.drained = True
+                self.workers_drained_total += 1
+            else:
+                w.deaths += 1
             dead_sock, proc = w.sock, w.proc
             w.sock = None
             entries = []
@@ -541,7 +668,8 @@ class WorkerPool:
                     self._spec_inflight -= 1
                 entries.append(e)
             w.inflight.clear()
-            self.worker_losses_total += 1
+            if not drained:
+                self.worker_losses_total += 1
             affected = {}
             for e in entries:
                 e.status = "lost"
@@ -571,6 +699,18 @@ class WorkerPool:
                 dead_sock.close()
             except OSError:
                 pass
+        if drained:
+            # every query that lived through the drain records it (the
+            # QueryRecord workers_drained event counter)
+            from ..obs.cluster import active_query_stats
+
+            for st in active_query_stats():
+                st.bump("workers_drained")
+            for e in entries:
+                e.event.set()
+            logger.info("worker_drained", worker=w.wid, reason=reason,
+                        raced_inflight=len(entries))
+            return
         w.breaker.record_failure()
         for ctx in affected.values():
             ctx.stats.bump("worker_losses")
@@ -594,13 +734,18 @@ class WorkerPool:
                 if self._closed:
                     return
             time.sleep(interval)
-            for w in self.workers:
+            self._elastic_step()
+            with self._cond:
+                fleet = list(self.workers)
+            for w in fleet:
                 with self._cond:
                     if self._closed:
                         return
                     state, sock, proc = w.state, w.sock, w.proc
                     stale = (state == "ready"
                              and time.monotonic() - w.last_pong > timeout)
+                    if state == "dead" and w.drained:
+                        continue  # a drained slot is retired, not sick
                 if state == "ready":
                     if proc is not None and proc.poll() is not None:
                         self._on_worker_death(
@@ -647,6 +792,197 @@ class WorkerPool:
     def budget_remaining(self) -> int:
         with self._cond:
             return max(0, self.restart_budget - self.restarts_used)
+
+    # ----------------------------------------------------------- elastic
+    def _elastic_step(self) -> None:
+        """One scale decision per ``elastic_scale_interval_s``: demand =
+        admission-queue depth + busy workers + dispatch waiters. Pressure
+        grows the fleet toward ``n_max`` (a WARM FDO history — this
+        process has completed queries before, so the traffic shape is
+        known — jumps straight to max; a cold pool steps by one);
+        fleet-wide idleness past ``elastic_idle_scale_down_s`` gracefully
+        DRAINS one worker down toward ``n_min``. Drained/retired slots
+        are pruned; fresh slots get never-reused wids."""
+        if not self._elastic:
+            return
+        now = time.monotonic()
+        interval = max(0.05, float(getattr(
+            self.cfg, "elastic_scale_interval_s", 0.5)))
+        if now - self._last_scale_at < interval:
+            return
+        self._last_scale_at = now
+        try:
+            from ..obs.health import admission_state
+
+            queued = int((admission_state() or {}).get(
+                "queued_queries", 0) or 0)
+        except Exception:
+            queued = 0
+        with self._cond:
+            if self._closed or self._scaling:
+                return
+            retired = [w for w in self.workers
+                       if w.drained and w.state == "dead"]
+            for w in retired:
+                self.workers.remove(w)
+            if retired:
+                self.n = len(self.workers)
+            live = [w for w in self.workers if not w.draining
+                    and not w.drained]
+            busy = sum(1 for w in live if w.inflight)
+            demand = queued + busy + self._acquire_waiters
+            n_live = len(live)
+            grow = min(self.n_max - n_live,
+                       max(demand - n_live, self.n_min - n_live))
+            if grow > 0:
+                if grow > 1 or demand > n_live:
+                    # scaling UP under real pressure: with warm FDO
+                    # history the traffic shape is a known repeat — jump;
+                    # cold, step by one and let the next tick re-decide
+                    try:
+                        from ..adapt.history import HISTORY
+
+                        warm = HISTORY.snapshot().get("queries", 0) > 0
+                    except Exception:
+                        warm = False
+                    if not warm:
+                        grow = min(grow, max(1, self.n_min - n_live))
+                new = []
+                for _ in range(grow):
+                    w = _WorkerHandle(next(self._next_wid),
+                                      WorkerHealth(self._bthresh,
+                                                   self._bcool))
+                    # "spawning", not the default "dead": the supervise
+                    # loop would otherwise race a budgeted respawn of this
+                    # slot against the scale-up thread's spawn — two
+                    # processes for one wid, the loser's socket orphaned
+                    w.state = "spawning"
+                    self.workers.append(w)
+                    new.append(w)
+                self.n = len(self.workers)
+                self.scale_ups_total += 1
+                self.last_scale_decision = (
+                    f"up+{len(new)} (queued={queued} busy={busy} "
+                    f"waiters={self._acquire_waiters})")
+                self._idle_since = now
+                self._scaling = True
+            elif (demand == 0 and n_live > self.n_min
+                    and now - self._idle_since > float(getattr(
+                        self.cfg, "elastic_idle_scale_down_s", 10.0))):
+                # sustained idleness: gracefully retire ONE worker per
+                # decision (prefer the emptiest piece store — its drain
+                # strands the least to re-source)
+                idle = [w for w in live if w.state == "ready"]
+                if not idle:
+                    return
+                victim = min(idle, key=lambda h: (
+                    h.peer_report.get("pieces_hosted", 0), h.tasks_done))
+                self.scale_downs_total += 1
+                self.last_scale_decision = f"down-1 (drain w{victim.wid})"
+                self._idle_since = now
+                self._scaling = True
+                new = None
+            else:
+                if demand > 0:
+                    self._idle_since = now
+                return
+        if new:
+            def _grow_fleet(handles=new):
+                try:
+                    for w in handles:
+                        try:
+                            # fleet growth is capacity we asked for, not
+                            # failure recovery: initial=True keeps it off
+                            # the restart budget
+                            self._spawn(w, initial=True)
+                        except Exception as e:
+                            with self._cond:
+                                if w.state == "spawning":
+                                    # hand the slot to the supervise
+                                    # loop's budgeted respawn path
+                                    w.state = "dead"
+                            logger.warning("elastic_spawn_failed",
+                                           worker=w.wid, error=repr(e))
+                finally:
+                    with self._cond:
+                        self._scaling = False
+
+            threading.Thread(target=_grow_fleet, daemon=True,
+                             name="daft-dist-scale-up").start()
+            logger.info("elastic_scale_up", count=len(new),
+                        queued=queued, busy=busy)
+        else:
+            def _shrink_fleet(wid=victim.wid):
+                try:
+                    self.drain_worker(wid)
+                finally:
+                    with self._cond:
+                        self._scaling = False
+
+            threading.Thread(target=_shrink_fleet, daemon=True,
+                             name="daft-dist-scale-down").start()
+            logger.info("elastic_scale_down", worker=victim.wid)
+
+    def drain_worker(self, wid: int) -> bool:
+        """Gracefully quiesce one worker: stop routing tasks to it, wait
+        out its in-flight work, then ask it to exit after the piece-serve
+        grace window — a preemption that costs bounded recompute, never a
+        failed query. The ``worker.drain`` fault site fires here; an
+        injected fault (and a drain that times out) degrades to the
+        SIGKILL/redispatch path, which the loss machinery already owns.
+        Returns True when the worker exited as a drain."""
+        from .. import faults
+
+        with self._cond:
+            w = next((x for x in self.workers if x.wid == wid), None)
+            if w is None or w.state != "ready" or w.draining:
+                return False
+            w.draining = True
+            self._cond.notify_all()
+        logger.info("worker_drain_requested", worker=wid)
+        try:
+            faults.check("worker.drain")
+        except DaftTransientError:
+            with self._cond:
+                w.draining = False
+            self._kill_worker(w, "worker.drain fault injected")
+            return False
+        deadline = time.monotonic() + float(getattr(
+            self.cfg, "worker_drain_timeout_s", 10.0))
+        with self._cond:
+            while (w.inflight and w.state == "ready"
+                    and time.monotonic() < deadline):
+                self._cond.wait(0.05)
+            still_busy = bool(w.inflight) and w.state == "ready"
+            sock, alive = w.sock, w.state == "ready"
+        if still_busy:
+            # its in-flight task outlived the drain window: this is the
+            # bounded part of "bounded recompute" — kill and re-dispatch
+            with self._cond:
+                w.draining = False
+            self._kill_worker(w, "drain timed out with task in flight")
+            return False
+        if not alive:
+            return bool(w.drained)  # died mid-drain; death flow decided
+        try:
+            with w.send_lock:
+                send_msg(sock, {"type": "drain"},
+                         checksum=self._checksum)
+        except Exception:
+            pass  # a dead link settles through the death path
+        grace = float(getattr(self.cfg, "worker_drain_grace_s", 2.0))
+        exit_deadline = time.monotonic() + grace + max(
+            5.0, float(getattr(self.cfg, "worker_drain_timeout_s", 10.0)))
+        with self._cond:
+            while w.state == "ready" and time.monotonic() < exit_deadline:
+                self._cond.wait(0.1)
+            alive = w.state == "ready"
+        if alive:
+            with self._cond:
+                w.draining = False
+            self._kill_worker(w, "drain grace expired without exit")
+            return False
+        return bool(w.drained)
 
     # --------------------------------------------------- dispatch backend
     def capacity(self) -> int:
@@ -713,16 +1049,58 @@ class WorkerPool:
                                       protocol=pickle.HIGHEST_PROTOCOL)
         except Exception:
             return None
+        from .peerplane import peer_preference
+
         try:
-            return self._execute(payload, part_bytes, ctx, op_name, seq)
+            return self._execute(payload, part_bytes, ctx, op_name, seq,
+                                 prefer=peer_preference(part))
         except _LocalFallback:
             with self._cond:
                 self.local_fallbacks_total += 1
             ctx.stats.bump("dist_local_fallbacks")
             return None
 
-    def _execute(self, payload, part_bytes, ctx, op_name: str, seq: int):
+    def execute_fanout(self, part, spec: dict, ctx, op_name: str,
+                       seq: int):
+        """Dispatch one peer-shuffle FANOUT task: the worker splits the
+        source partition and parks the pieces in its local store
+        (peerplane.execute_fanout); only piece metadata comes back.
+        Returns ``(wid, (host, port), metas)`` naming the hosting slot,
+        or None when the pool declines (the caller splits driver-side).
+        Rides the whole _execute machinery, so re-dispatch, speculation,
+        and exactly-once settle compose: a worker dying mid-fanout just
+        re-stores the same deterministic pieces elsewhere."""
+        if not self._part_eligible(part):
+            return None
+        with self._cond:
+            if not self._usable_locked():
+                self.local_fallbacks_total += 1
+                ctx.stats.bump("dist_local_fallbacks")
+                return None
+        try:
+            part_bytes = pickle.dumps(part,
+                                      protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            return None
+        from .peerplane import peer_preference
+
+        try:
+            metas, _rows, _wall = self._execute(
+                None, part_bytes, ctx, op_name, seq,
+                extra={"shuffle": spec}, prefer=peer_preference(part))
+        except _LocalFallback:
+            with self._cond:
+                self.local_fallbacks_total += 1
+            ctx.stats.bump("dist_local_fallbacks")
+            return None
+        return metas
+
+    def _execute(self, payload, part_bytes, ctx, op_name: str, seq: int,
+                 extra: Optional[dict] = None,
+                 prefer: Optional[set] = None):
         entry = _TaskEntry(next(self._task_seq), op_name, seq, ctx)
+        entry.extra = extra
+        entry.prefer = prefer
         max_attempts = max(1, int(self.cfg.dist_task_max_attempts))
         while True:
             self._check_query(ctx)
@@ -733,6 +1111,28 @@ class WorkerPool:
                 out, rows, wall_ns = entry.result
                 self._finish_telemetry(entry, ctx)
                 ctx.stats.bump("dist_tasks")
+                if extra is not None and "shuffle" in extra:
+                    # resolve the hosting slot's piece-server endpoint:
+                    # the pieces live on whichever worker's result
+                    # settled the entry (speculation-proof)
+                    with self._cond:
+                        host = next((h for h in self.workers
+                                     if h.wid == entry.result_wid), None)
+                        addr = host.peer_addr if host is not None else None
+                    if addr is None:
+                        return None, rows, wall_ns
+                    return (entry.result_wid, addr, out), rows, wall_ns
+                rbytes = 0
+                try:
+                    rbytes = out.size_bytes() or 0
+                except Exception:
+                    rbytes = 0
+                if rbytes:
+                    # the reply payload transited the driver too: the
+                    # other half of the star topology's O(cluster) bill
+                    with self._cond:
+                        self.driver_payload_bytes_total += rbytes
+                    ctx.stats.bump("dist_driver_bytes", rbytes)
                 return out, rows, wall_ns
             if entry.status == "error":
                 # task_error replies piggyback telemetry too — the failing
@@ -743,7 +1143,9 @@ class WorkerPool:
             # lost: the worker died with this task in flight
             if entry.wid is not None:
                 entry.excluded.add(entry.wid)
-            if (entry.excluded >= set(range(self.n))
+            with self._cond:
+                live = {w.wid for w in self.workers if not w.drained}
+            if ((live and entry.excluded >= live)
                     or entry.attempts >= max_attempts):
                 # terminal: no further dispatch happens, so this loss is
                 # NOT a re-dispatch — counting it here would over-report
@@ -801,7 +1203,8 @@ class WorkerPool:
         poison-by-exclusion without waiting."""
         while True:
             with self._cond:
-                if entry.excluded >= set(range(self.n)):
+                live = {w.wid for w in self.workers if not w.drained}
+                if live and entry.excluded >= live:
                     raise DaftError(
                         f"poison task {entry.op_name}#{entry.seq}: lost "
                         f"{entry.attempts} worker(s) (every slot excluded)"
@@ -810,9 +1213,18 @@ class WorkerPool:
                     raise _LocalFallback
                 ready = [w for w in self.workers
                          if w.state == "ready"
+                         and not w.draining
                          and w.wid not in entry.excluded
                          and not w.inflight]
                 if ready:
+                    if entry.prefer:
+                        # peer locality: a free slot already hosting this
+                        # task's input pieces wins (fetches become local
+                        # store reads); otherwise any free slot serves
+                        hosts = [w for w in ready
+                                 if w.wid in entry.prefer]
+                        if hosts:
+                            ready = hosts
                     w = min(ready, key=lambda h: h.tasks_done)
                     entry.status = "inflight"
                     entry.event.clear()
@@ -825,24 +1237,37 @@ class WorkerPool:
                 # or finishing a task) and none can come back soon — every
                 # dead candidate is budget-blocked or breaker-tripped
                 # (waiting out a 30s cooldown would stall the query while
-                # in-process execution is available). Local fallback.
+                # in-process execution is available). An elastic pool
+                # below its ceiling is worth waiting on: the waiter count
+                # below IS the scale-up controller's demand signal.
                 candidates = [w for w in self.workers
-                              if w.wid not in entry.excluded]
+                              if w.wid not in entry.excluded
+                              and not w.draining and not w.drained]
                 revivable = (self.restarts_used < self.restart_budget)
                 respawn_pending = revivable and any(
                     w.state == "dead" and w.breaker.state != "open"
                     for w in candidates)
-                if not any(w.state == "ready" or w.inflight
-                           for w in candidates) and not respawn_pending:
+                headroom = self._elastic and len(
+                    [w for w in self.workers
+                     if not w.draining and not w.drained]) < self.n_max
+                if (not any(w.state == "ready" or w.inflight
+                            for w in candidates)
+                        and not respawn_pending and not headroom):
                     raise _LocalFallback
-                self._cond.wait(0.05)
+                self._acquire_waiters += 1
+                try:
+                    self._cond.wait(0.05)
+                finally:
+                    self._acquire_waiters -= 1
             self._check_query(ctx)
 
     def _dispatch(self, entry: _TaskEntry, w: _WorkerHandle, payload,
                   part_bytes: bytes, speculative: bool = False) -> None:
         from .. import faults
 
-        op_key, op_bytes = payload
+        # payload None = a peer-shuffle fanout (no map op crosses the
+        # wire; entry.extra carries the split spec instead)
+        op_key, op_bytes = payload if payload is not None else (None, b"")
         if not speculative:
             # a speculative duplicate is added capacity for the SAME
             # attempt: it must not consume the poison-task budget, and the
@@ -883,8 +1308,12 @@ class WorkerPool:
                 # ships the same payload twice but holds it once
                 entry.charged = size
                 entry.ctx.ledger.dist_started(size)
-        msg = {"type": "task", "task_id": entry.task_id, "op_key": op_key,
+        msg = {"type": "task", "task_id": entry.task_id,
                "part": part_bytes}
+        if payload is not None:
+            msg["op_key"] = op_key
+        if entry.extra:
+            msg.update(entry.extra)
         if getattr(entry.ctx.cfg, "cluster_telemetry", True):
             # the span-context propagation half of the telemetry plane:
             # the task envelope carries the query id (log attribution),
@@ -899,18 +1328,25 @@ class WorkerPool:
             msg["op_name"] = entry.op_name
             msg["seq"] = entry.seq
             msg["profile"] = bool(entry.ctx.stats.profiler.armed)
-        if op_key not in w.ops_sent:
+        wire = len(part_bytes)
+        if payload is not None and op_key not in w.ops_sent:
             msg["op"] = op_bytes
+            wire += len(op_bytes)
         try:
             with w.send_lock:
                 send_msg(sock, msg, checksum=self._checksum)
             if not speculative:
                 entry.sent_pc = time.perf_counter_ns()
-            # insertion-ordered window, capped BELOW the worker's op cache
-            # so a key we omit op bytes for is always still cached there
-            w.ops_sent[op_key] = True
-            while len(w.ops_sent) > 96:
-                w.ops_sent.pop(next(iter(w.ops_sent)))
+            with self._cond:
+                self.driver_payload_bytes_total += wire
+            entry.ctx.stats.bump("dist_driver_bytes", wire)
+            if payload is not None:
+                # insertion-ordered window, capped BELOW the worker's op
+                # cache so a key we omit op bytes for is always still
+                # cached there
+                w.ops_sent[op_key] = True
+                while len(w.ops_sent) > 96:
+                    w.ops_sent.pop(next(iter(w.ops_sent)))
         except Exception as e:
             self._on_worker_death(w, sock, f"task send failed: {e!r}")
 
@@ -975,15 +1411,81 @@ class WorkerPool:
                        worker=w.wid, threshold_s=round(threshold, 3))
         self._dispatch(entry, w, payload, part_bytes, speculative=True)
 
+    # ------------------------------------------------------- peer plane
+    def new_shuffle_id(self) -> int:
+        """A fresh pool-unique shuffle id; registered live until its
+        query's finish broadcasts the drop."""
+        sid = next(self._shuffle_seq)
+        with self._cond:
+            self._live_shuffles.add(sid)
+        return sid
+
+    def peer_token(self) -> str:
+        return self._token
+
+    def peer_ready(self) -> bool:
+        """Any ready worker with a piece-server endpoint? (The p2p branch
+        stands down to the star path otherwise.)"""
+        with self._cond:
+            return any(w.state == "ready" and not w.draining
+                       and w.peer_addr is not None
+                       for w in self.workers)
+
+    def drop_shuffles(self, sids) -> None:
+        """Broadcast end-of-life for the given shuffle ids: every worker
+        (and the driver's own store) frees the hosted pieces. Fire-and-
+        forget — a worker that misses the drop frees at process exit."""
+        sids = [s for s in sids]
+        if not sids:
+            return
+        from .peerplane import plane
+
+        plane().drop_shuffles(sids)
+        with self._cond:
+            for s in sids:
+                self._live_shuffles.discard(s)
+            targets = [w for w in self.workers
+                       if w.state == "ready" and w.sock is not None]
+        for w in targets:
+            try:
+                with w.send_lock:
+                    send_msg(w.sock, {"type": "drop_shuffles",
+                                      "ids": sids},
+                             checksum=self._checksum)
+            except Exception:
+                pass  # a dead worker's pieces died with it
+
     # ------------------------------------------------------------ health
     def snapshot(self) -> dict:
         """The dt.health() ``cluster`` section (mirrored as
         ``daft_tpu_cluster_*`` gauges)."""
+        from .peerplane import plane
+
+        peer = plane().snapshot()
         with self._cond:
             alive = sum(1 for w in self.workers if w.state == "ready")
             tripped = sum(1 for w in self.workers
                           if w.breaker.state == "open")
             inflight = sum(len(w.inflight) for w in self.workers)
+            draining = sum(1 for w in self.workers if w.draining)
+            # aggregate the workers' pong-piggybacked piece-store
+            # snapshots over the driver's own (ensure_local pulls)
+            for w in self.workers:
+                for k, v in (w.peer_report or {}).items():
+                    if k in peer and isinstance(v, int):
+                        peer[k] += v
+            peer["shuffles_active"] = len(self._live_shuffles)
+            elastic = {
+                "enabled": int(self._elastic),
+                "workers_target": self.n,
+                "workers_min": self.n_min,
+                "workers_max": self.n_max,
+                "draining": draining,
+                "workers_drained_total": self.workers_drained_total,
+                "scale_ups_total": self.scale_ups_total,
+                "scale_downs_total": self.scale_downs_total,
+                "last_scale_decision": self.last_scale_decision,
+            }
             workers = {
                 str(w.wid): {
                     "state": w.state,
@@ -1017,6 +1519,11 @@ class WorkerPool:
                 "speculation_wins_total": self.speculation_wins_total,
                 "speculation_inflight": self._spec_inflight,
                 "telemetry_dropped_total": self.telemetry_dropped_total,
+                "driver_payload_bytes_total":
+                    self.driver_payload_bytes_total,
+                "workers_drained_total": self.workers_drained_total,
+                "peer_plane": peer,
+                "elastic": elastic,
                 "local_fallbacks_total": self.local_fallbacks_total,
                 "restarts_used": self.restarts_used,
                 "restart_budget": self.restart_budget,
@@ -1100,6 +1607,15 @@ class WorkerPool:
             self._listener.close()
         except OSError:
             pass
+        # atomic swap, NOT _spawn_lock: an in-flight handshake can hold
+        # that lock for the whole spawn timeout, and shutdown must not
+        # stall behind it (a racing spawner sees the fresh empty dict)
+        parked, self._parked = self._parked, {}
+        for cand, _hello in parked.values():
+            try:
+                cand.close()
+            except OSError:
+                pass
         if self._supervisor.is_alive():
             self._supervisor.join(timeout=max(
                 0.1, deadline - time.monotonic()))
@@ -1130,8 +1646,12 @@ def get_worker_pool(cfg) -> Optional[WorkerPool]:
     with _POOL_LOCK:
         pool = _POOL
         if pool is not None and not pool._closed and (
-                pool.n == cfg.distributed_workers
-                and pool.cfg.memory_budget_bytes == cfg.memory_budget_bytes):
+                pool._cfg_key == (cfg.distributed_workers,
+                                  getattr(cfg, "distributed_workers_min",
+                                          None),
+                                  getattr(cfg, "distributed_workers_max",
+                                          None),
+                                  cfg.memory_budget_bytes)):
             # adopt the caller's config for the tunables that need no
             # respawn (speculation knobs, driver-side frame checksums) —
             # worker-resident settings keep their spawn-time values

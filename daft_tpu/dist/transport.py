@@ -85,6 +85,21 @@ class TransportClosed(DaftTransientError):
     """The peer went away mid-frame (EOF, reset, severed link)."""
 
 
+def dial(host: str, port: int, timeout: float = 30.0) -> socket.socket:
+    """Open one framed-transport connection to a peer endpoint (the
+    worker piece-servers of dist/peerplane.py dial each other with this).
+    Connect is bounded by ``timeout`` and the socket keeps it for framed
+    round-trips, so a dead peer reads as TransportClosed instead of a
+    hang; the caller owns close()."""
+    try:
+        sock = socket.create_connection((host, port), timeout=timeout)
+    except OSError as e:
+        raise TransportClosed(
+            f"transport dial {host}:{port} failed: {e!r}") from e
+    sock.settimeout(timeout)
+    return sock
+
+
 def send_msg(sock: socket.socket, msg: dict, checksum: bool = True) -> None:
     """Serialize + frame + send one message. ``checksum`` stamps the
     payload's crc32 into the header for receiver-side verification

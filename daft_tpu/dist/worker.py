@@ -38,6 +38,7 @@ from __future__ import annotations
 import os
 import pickle
 import queue
+import signal
 import socket
 import sys
 import threading
@@ -79,11 +80,16 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
     from .. import faults
     from ..context import get_context
     from ..obs.log import get_logger
+    from .peerplane import PieceServer, execute_fanout, plane
     from .transport import _FLAG_CRC, PROTOCOL_VERSION, TransportClosed, \
         recv_msg, send_msg
 
     log = get_logger("dist.worker")
     send_lock = threading.Lock()
+    # the peer-shuffle piece server binds BEFORE the hello carries its
+    # port: no dispatched reduce task can ever hold an unbound address
+    peer_server = PieceServer(token)
+    peer_server.start()
     # frame checksums MIRROR the driver's: every received frame's flag
     # byte updates this, so a driver-side cfg.partition_integrity toggle
     # flips both directions of traffic without a respawn. The hello
@@ -105,12 +111,14 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
             send_msg(sock, msg, checksum=checksum[0])
 
     reply({"type": "hello", "worker_id": worker_id, "pid": os.getpid(),
-           "token": token, "proto": PROTOCOL_VERSION})
+           "token": token, "proto": PROTOCOL_VERSION,
+           "peer_port": peer_server.port})
     init = recv_msg(sock)
     if init.get("type") != "init":
         raise RuntimeError(f"expected init, got {init.get('type')!r}")
     cfg = init["cfg"]
     checksum[0] = bool(getattr(cfg, "partition_integrity", True))
+    peer_server.checksum = checksum[0]
     ctx = get_context()
     ctx.execution_config = cfg
     # fault plans armed by the PARENT process via the environment (chaos
@@ -121,6 +129,10 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
     from ..execution import ExecutionContext
 
     exec_ctx = ExecutionContext(cfg)
+    # peer-plane identity + stats hook: fetch/refetch counters bumped
+    # during piece pulls land on the worker's RuntimeStats and ride the
+    # telemetry fragments back into the driver's per-query rollup
+    plane().configure(worker_id, exec_ctx.stats)
     tasks: "queue.Queue" = queue.Queue()
     inflight = [0]
     op_cache: dict = {}
@@ -152,7 +164,8 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                     reply({"type": "pong", "worker_id": worker_id,
                            "inflight": inflight[0],
                            "tseq": seq,
-                           "ledger": ledger_report()})
+                           "ledger": ledger_report(),
+                           "peer": plane().snapshot()})
                 elif kind == "task":
                     inflight[0] += 1
                     tasks.put(msg)
@@ -164,6 +177,13 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                     if len(cancelled) > 4096:
                         cancelled.clear()
                     cancelled.add(msg.get("task_id"))
+                elif kind == "drop_shuffles":
+                    # end-of-life broadcast for a query's shuffle pieces
+                    plane().drop_shuffles(msg.get("ids", []))
+                elif kind == "drain":
+                    # graceful quiesce: queued AFTER any in-flight task,
+                    # so current work finishes and replies first
+                    tasks.put({"_drain": True})
                 elif kind == "shutdown":
                     tasks.put(None)
                     return
@@ -177,9 +197,39 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                               daemon=True)
     reader.start()
 
+    # SIGTERM = spot preemption notice: tell the driver we are draining
+    # (it stops routing tasks here), finish the current task, keep
+    # serving hosted pieces through the grace window, then exit 0. The
+    # handler only spawns a thread — the main thread may hold send_lock
+    # when the signal lands, and a direct reply() would self-deadlock.
+    def _on_sigterm(signum, frame):
+        def _announce():
+            try:
+                reply({"type": "draining", "worker_id": worker_id})
+            except Exception:
+                pass
+            tasks.put({"_drain": True})
+
+        threading.Thread(target=_announce, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        pass  # non-main thread / exotic platform: drain stays driver-led
+
     while True:
         msg = tasks.get()
         if msg is None:
+            break
+        if msg.get("_drain"):
+            # quiesce: no new tasks will arrive (the driver marked this
+            # slot draining); hold the piece server open for the grace
+            # window so peers finish their fetches, then leave — pieces
+            # lost with us re-source from lineage at the read site
+            log.info("worker_draining", worker=worker_id,
+                     pieces=plane().snapshot()["pieces_hosted"])
+            time.sleep(float(getattr(cfg, "worker_drain_grace_s", 2.0)))
+            peer_server.close()
             break
         task_id = msg["task_id"]
         if task_id in cancelled:
@@ -191,16 +241,20 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
             continue
         collector = None
         try:
-            op_key = msg["op_key"]
-            if "op" in msg:
-                # (re-)insert at the end so eviction order tracks the
-                # driver's send order (its ops_sent window is smaller than
-                # this cache, so a key it omits is always still here)
-                op_cache.pop(op_key, None)
-                op_cache[op_key] = pickle.loads(msg["op"])
-                while len(op_cache) > 128:  # bounded across queries
-                    op_cache.pop(next(iter(op_cache)))
-            op = op_cache[op_key]
+            spec = msg.get("shuffle")
+            op = None
+            if spec is None:
+                op_key = msg["op_key"]
+                if "op" in msg:
+                    # (re-)insert at the end so eviction order tracks the
+                    # driver's send order (its ops_sent window is smaller
+                    # than this cache, so a key it omits is always still
+                    # here)
+                    op_cache.pop(op_key, None)
+                    op_cache[op_key] = pickle.loads(msg["op"])
+                    while len(op_cache) > 128:  # bounded across queries
+                        op_cache.pop(next(iter(op_cache)))
+                op = op_cache[op_key]
             part = msg["part"]
             if isinstance(part, (bytes, bytearray)):
                 # the driver pre-serializes partitions once (re-dispatches
@@ -227,11 +281,20 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
             # retry machinery owns
             if collector is not None:
                 with collector:
-                    out = _execute_task(op, part, exec_ctx, msg)
+                    out = (execute_fanout(part, spec, exec_ctx)
+                           if spec is not None
+                           else _execute_task(op, part, exec_ctx, msg))
             else:
-                out = _execute_task(op, part, exec_ctx, msg)
+                out = (execute_fanout(part, spec, exec_ctx)
+                       if spec is not None
+                       else _execute_task(op, part, exec_ctx, msg))
             wall_ns = time.perf_counter_ns() - t0
-            n = out.num_rows_or_none()
+            if spec is not None:
+                # a fanout's reply is piece METADATA only — the payload
+                # bytes stay parked in this process's piece store
+                n = sum(m[1] for m in out)
+            else:
+                n = out.num_rows_or_none()
             reply({"type": "result", "task_id": task_id, "part": out,
                    "rows": n if n is not None else 0, "wall_ns": wall_ns},
                   frag=collector.fragment() if collector else None)

@@ -473,6 +473,14 @@ class ExecutionContext:
         # and the exception traceback keeps its frame (and its parked
         # producers) alive until the exception object dies
         self._active_streams: dict = {}
+        # shuffle ids whose pieces live on PEER workers (dist/peerplane.py):
+        # finish_query tells the pool to drop them fleet-wide — by then
+        # every root output has been forced local (see rooted())
+        self._peer_shuffles: set = set()
+
+    def register_peer_shuffle(self, sid: int) -> None:
+        """Record a peer-hosted shuffle for drop at query finish."""
+        self._peer_shuffles.add(sid)
 
     def check_deadline(self) -> None:
         """Cooperative deadline check (morsel loop, pipeline breakers):
@@ -600,6 +608,15 @@ class ExecutionContext:
         if self._spill_scope is not None:
             self._spill_scope.cleanup()
             self._spill_scope = None
+        if self._peer_shuffles:
+            sids, self._peer_shuffles = list(self._peer_shuffles), set()
+            backend = self.dist_backend
+            drop = getattr(backend, "drop_shuffles", None)
+            if drop is not None:
+                try:
+                    drop(sids)
+                except Exception:
+                    pass  # pool mid-teardown: workers clear on exit anyway
 
     @property
     def num_workers(self) -> int:
@@ -1407,6 +1424,14 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
                     part = next(it, _DONE)
                 if part is _DONE:
                     break
+                if ctx._peer_shuffles:
+                    # a root output backed by peer-hosted shuffle pieces
+                    # must not outlive them: force it local BEFORE the
+                    # finally-block's finish_query drops the shuffles
+                    from .dist.peerplane import ensure_local
+
+                    with obs_log.query_context(query_id):
+                        ensure_local(part)
                 # exact root output count for the QueryRecord (the op-name
                 # rollup can't distinguish a root op from same-class
                 # upstream ops); metadata-only, never forces a load

@@ -109,6 +109,16 @@ SITES = {
                           "(daft_tpu/adapt/resultcache.py; a failure "
                           "degrades to plain execution of the prefix — "
                           "fails open, never a query failure)",
+    "peer.fetch": "each peer-shuffle piece fetch at the read site "
+                  "(daft_tpu/dist/peerplane.py; an injected fault reads "
+                  "as a dead/severed peer — the fetcher fails over to "
+                  "the piece's lineage recipe and recomputes just the "
+                  "lost piece (peer_refetches), never a hung query)",
+    "worker.drain": "each graceful worker drain request "
+                    "(daft_tpu/dist/supervisor.py; an injected fault "
+                    "degrades the drain to the SIGKILL/redispatch loss "
+                    "path — the already-proven recovery machinery — "
+                    "never a hung quiesce)",
 }
 
 

@@ -55,7 +55,7 @@ from .log import get_logger, query_context
 __all__ = ["TELEMETRY_VERSION", "TelemetryCollector", "build_fragment",
            "validate_fragment", "merge_fragment", "QueryProgress",
            "register_progress", "unregister_progress", "query_progress",
-           "queries_snapshot"]
+           "queries_snapshot", "active_query_stats"]
 
 logger = get_logger("obs.cluster")
 
@@ -468,6 +468,14 @@ def query_progress(query_id: str) -> Optional[dict]:
         return p.snapshot()
     except Exception:
         return None
+
+
+def active_query_stats() -> List:
+    """RuntimeStats of every currently-executing query — the supervisor's
+    hook for attributing cluster-level events (a graceful worker drain)
+    to the queries running while they happened."""
+    with _progress_lock:
+        return [p.stats for p in _progress.values()]
 
 
 def queries_snapshot() -> List[dict]:

@@ -43,6 +43,21 @@ _ADMISSION_IDLE = {"slots": 0, "queue_depth": 0, "active_queries": 0,
                    "queued_queries": 0, "admitted_total": 0,
                    "shed_total": 0, "draining": False}
 
+# peer-shuffle data plane (dist/peerplane.py): piece-store occupancy and
+# transfer totals, aggregated driver + worker pong reports
+_PEER_IDLE = {"pieces_hosted": 0, "piece_bytes_hosted": 0,
+              "pieces_stored_total": 0, "pieces_served_total": 0,
+              "peer_bytes_served_total": 0, "pieces_fetched_total": 0,
+              "pieces_refetched_total": 0, "peer_bytes_fetched_total": 0,
+              "shuffles_dropped_total": 0, "shuffles_active": 0}
+
+# elastic pool controller (dist/supervisor._elastic_step): target within
+# [min, max], drain/scale totals, and the last decision as a human string
+_ELASTIC_IDLE = {"enabled": 0, "workers_target": 0, "workers_min": 0,
+                 "workers_max": 0, "draining": 0, "workers_drained_total": 0,
+                 "scale_ups_total": 0, "scale_downs_total": 0,
+                 "last_scale_decision": "idle"}
+
 _CLUSTER_IDLE = {"workers": 0, "workers_alive": 0, "workers_restarting": 0,
                  "workers_tripped": 0, "tasks_inflight": 0,
                  "tasks_dispatched_total": 0, "tasks_completed_total": 0,
@@ -51,6 +66,9 @@ _CLUSTER_IDLE = {"workers": 0, "workers_alive": 0, "workers_restarting": 0,
                  "speculation_inflight": 0, "telemetry_dropped_total": 0,
                  "local_fallbacks_total": 0, "restarts_used": 0,
                  "restart_budget": 0, "restart_budget_remaining": 0,
+                 "driver_payload_bytes_total": 0, "workers_drained_total": 0,
+                 "peer_plane": dict(_PEER_IDLE),
+                 "elastic": dict(_ELASTIC_IDLE),
                  "degraded": False, "worker_detail": {}}
 
 # breaker state -> gauge value (0 healthy .. 2 open)
@@ -325,6 +343,50 @@ def refresh_health_gauges(registry=None) -> None:
               "worker telemetry fragments lost in flight (pong-gap + "
               "worker-death detections; fail-open by contract)").set(
         clu.get("telemetry_dropped_total", 0))
+    peer = clu.get("peer_plane") or _PEER_IDLE
+    reg.gauge("daft_tpu_cluster_peer_pieces_hosted",
+              "shuffle pieces currently hosted on worker piece-servers"
+              ).set(peer.get("pieces_hosted", 0))
+    reg.gauge("daft_tpu_cluster_peer_piece_bytes_hosted",
+              "bytes currently hosted on worker piece-servers").set(
+        peer.get("piece_bytes_hosted", 0))
+    reg.gauge("daft_tpu_cluster_peer_pieces_served_total",
+              "piece fetches served to peers").set(
+        peer.get("pieces_served_total", 0))
+    reg.gauge("daft_tpu_cluster_peer_pieces_fetched_total",
+              "pieces pulled from peer workers").set(
+        peer.get("pieces_fetched_total", 0))
+    reg.gauge("daft_tpu_cluster_peer_pieces_refetched_total",
+              "pieces recomputed from lineage after a failed peer fetch"
+              ).set(peer.get("pieces_refetched_total", 0))
+    reg.gauge("daft_tpu_cluster_peer_bytes_served_total",
+              "payload bytes served peer-to-peer").set(
+        peer.get("peer_bytes_served_total", 0))
+    reg.gauge("daft_tpu_cluster_peer_bytes_fetched_total",
+              "payload bytes pulled from peer workers").set(
+        peer.get("peer_bytes_fetched_total", 0))
+    ela = clu.get("elastic") or _ELASTIC_IDLE
+    reg.gauge("daft_tpu_cluster_elastic_workers_target",
+              "elastic controller's current worker target").set(
+        ela.get("workers_target", 0))
+    reg.gauge("daft_tpu_cluster_elastic_workers_min",
+              "elastic pool floor (distributed_workers_min)").set(
+        ela.get("workers_min", 0))
+    reg.gauge("daft_tpu_cluster_elastic_workers_max",
+              "elastic pool ceiling (distributed_workers_max)").set(
+        ela.get("workers_max", 0))
+    reg.gauge("daft_tpu_cluster_elastic_draining",
+              "workers currently draining (graceful quiesce)").set(
+        ela.get("draining", 0))
+    reg.gauge("daft_tpu_cluster_elastic_workers_drained_total",
+              "workers retired by graceful drain (scale-down/SIGTERM)"
+              ).set(ela.get("workers_drained_total", 0))
+    reg.gauge("daft_tpu_cluster_elastic_scale_ups_total",
+              "elastic scale-up decisions taken").set(
+        ela.get("scale_ups_total", 0))
+    reg.gauge("daft_tpu_cluster_elastic_scale_downs_total",
+              "elastic scale-down decisions taken").set(
+        ela.get("scale_downs_total", 0))
     try:
         from .cluster import queries_snapshot
 
@@ -444,11 +506,28 @@ def validate_health(d: dict) -> List[str]:
               "tasks_speculated_total", "speculation_wins_total",
               "telemetry_dropped_total",
               "restarts_used", "restart_budget",
-              "restart_budget_remaining"):
+              "restart_budget_remaining", "driver_payload_bytes_total",
+              "workers_drained_total"):
         if not isinstance(d["cluster"].get(k), int):
             errs.append(f"cluster.{k} missing or non-int")
     if not isinstance(d["cluster"].get("degraded"), bool):
         errs.append("cluster.degraded missing or non-bool")
+    peer = d["cluster"].get("peer_plane")
+    if not isinstance(peer, dict):
+        errs.append("cluster.peer_plane missing or non-object")
+    else:
+        for k in _PEER_IDLE:
+            if not isinstance(peer.get(k), int):
+                errs.append(f"cluster.peer_plane.{k} missing or non-int")
+    ela = d["cluster"].get("elastic")
+    if not isinstance(ela, dict):
+        errs.append("cluster.elastic missing or non-object")
+    else:
+        for k in _ELASTIC_IDLE:
+            want = str if k == "last_scale_decision" else int
+            if not isinstance(ela.get(k), want):
+                errs.append(f"cluster.elastic.{k} missing or "
+                            f"non-{want.__name__}")
     for i, q in enumerate(d["queries"]):
         if not isinstance(q, dict):
             errs.append(f"queries[{i}] is not an object")

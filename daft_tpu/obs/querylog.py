@@ -53,6 +53,7 @@ _EVENT_COUNTERS = (
     "corruption_detected", "partitions_recomputed", "lineage_truncated",
     "spill_disk_full", "tasks_speculated", "speculation_wins",
     "telemetry_dropped", "telemetry_truncated",
+    "peer_fetches", "peer_refetches", "workers_drained",
 )
 
 

@@ -603,6 +603,126 @@ class ShuffleOp(PhysicalOp):
                     obs[1] += max(0, pre_b - post_b)
                 yield out
 
+    def _peer_execute(self, stream, ctx, n, fdo_obs, backend) -> PartStream:
+        """Peer-to-peer exchange: each source partition ships to a worker
+        as a FANOUT task (split happens there, pieces stay hosted on that
+        worker's piece-server) and each reduce output is a PeerPieceTask —
+        an unloaded scan task whose payload is a piece-LOCATION map, pulled
+        peer-to-peer by whichever worker lands the downstream task. The
+        driver moves plan metadata and location maps only, so its payload
+        bytes stay flat as the pool grows.
+
+        Robustness contract: a worker declining a fanout (pool busy,
+        ineligible partition, unroutable result) degrades THAT source to a
+        driver-side split with inline pieces — mixed buckets are fine, the
+        reader concatenates entries in source order either way. A peer
+        dying after fanout is the reader's problem: PeerPieceTask fails
+        over to the captured source task and recomputes just the lost
+        piece (see peerplane.PeerPieceTask._recompute)."""
+        from .dist.peerplane import PeerPieceTask, PieceRef
+        from .integrity.lineage import fanout_piece_recipe, unwrap_source_task
+
+        lineage_on = getattr(ctx.cfg, "lineage_recomputation", True)
+        integrity = getattr(ctx.cfg, "partition_integrity", True)
+        sid = backend.new_shuffle_id()
+        ctx.register_peer_shuffle(sid)
+        token = backend.peer_token()
+        sources: Dict[int, Any] = {}
+        entries: List[List[Any]] = [[] for _ in range(n)]
+        saw = False
+
+        def account(rows, nbytes):
+            if rows:
+                ctx.stats.bump("exchange_rows", rows)
+            if nbytes:
+                ctx.stats.bump("exchange_bytes", nbytes)
+            if fdo_obs is not None:
+                fdo_obs[0] += rows or 0
+                fdo_obs[1] += nbytes or 0
+
+        with ctx.stats.profiler.span("shuffle.fanout", kind="phase"):
+            pool = ctx.pool()
+            pending = []
+            for pi, p in enumerate(stream):
+                saw = True
+                # capture BEFORE shipping: the recipe is the failover path
+                # for every piece this source produces. A source WITHOUT a
+                # recipe (loaded/derived partition, or lineage off) never
+                # fans out remotely — a peer hosting unrecomputable pieces
+                # would turn its death into a failed query, and the driver
+                # already holds these bytes anyway.
+                src_task = unwrap_source_task(p) if lineage_on else None
+                if src_task is not None:
+                    sources[pi] = src_task
+                    spec = {"sid": sid, "src": pi, "scheme": self.scheme,
+                            "num": n, "seed": pi, "by": self.by,
+                            "crc": integrity}
+                    pending.append((pi, p, pool.submit(
+                        backend.execute_fanout, p, spec, ctx,
+                        f"shuffle.{self.scheme}", pi)))
+                else:
+                    pending.append((pi, p, None))
+            for pi, p, fut in pending:
+                res = fut.result() if fut is not None else None
+                if res is None:
+                    # declined: split here, pieces ride inline in the map
+                    if self.scheme == "hash":
+                        pieces = p.partition_by_hash(self.by, n)
+                    else:
+                        pieces = p.partition_by_random(n, seed=pi)
+                    src_task = sources.get(pi)
+                    for i, piece in enumerate(pieces):
+                        nrows = piece.num_rows_or_none() or 0
+                        if not nrows:
+                            continue
+                        if src_task is not None:
+                            piece.lineage_recipe = fanout_piece_recipe(
+                                src_task, self.by, self.scheme, n, pi, i)
+                        account(nrows, piece.size_bytes() or 0)
+                        entries[i].append(piece)
+                else:
+                    wid, (host, port), metas = res
+                    for (i, rows, nbytes, crc) in metas:
+                        account(rows, nbytes)
+                        entries[i].append(PieceRef(
+                            wid, host, port, sid, i, pi, rows, nbytes, crc))
+        if fdo_obs is not None and saw:
+            ctx.stats.fdo_observe(self.fdo_obs_key, fdo_obs[0], fdo_obs[1])
+        if not saw:
+            return
+        ctx.stats.bump("shuffles")
+        split = (self.by, self.scheme, n)
+
+        def emit(bucket_entries):
+            refs = bucket_entries
+            if not refs:
+                return MicroPartition.empty(self.schema)
+            # only the sources actually referenced by THIS bucket's remote
+            # pieces ride along (inline pieces carry their own recipe)
+            need = {e.src for e in refs if isinstance(e, PieceRef)}
+            task = PeerPieceTask(
+                self.schema, refs, token, split,
+                {s: sources[s] for s in need if s in sources},
+                checksum=integrity, stats=ctx.stats)
+            return MicroPartition.from_scan_task(task)
+
+        k = (self.reduce_to
+             if self.reduce_to is not None and 0 < self.reduce_to < n
+             else None)
+        if k is None:
+            for i in range(n):
+                yield emit(entries[i])
+            return
+        groups: List[List[int]] = [[] for _ in range(k)]
+        for i in range(n):
+            groups[i * k // n].append(i)
+        ctx.stats.bump("fdo_reduced_partitions", n - k)
+        for idxs in groups:
+            merged: List[Any] = []
+            for i in idxs:
+                merged.extend(entries[i])
+            yield emit(merged)
+
     def execute(self, inputs, ctx) -> PartStream:
         n = self.num
         src = inputs[0]
@@ -649,6 +769,25 @@ class ShuffleOp(PhysicalOp):
             stream = iter(parts)
         else:
             stream = src
+        # Peer-to-peer path (daft_tpu/dist/peerplane.py): hash/random
+        # exchanges on a peer-capable worker pool fan out ON the workers
+        # and reduce buckets become piece-location maps — payload bytes
+        # never transit the driver. Exchange v2 attachments (join-filter
+        # feed/prune, pre-combine) and range schemes keep the star path:
+        # each is defined over driver-resident pieces, and p2p must be
+        # byte-identical off, not approximately off.
+        if (self.scheme in ("hash", "random")
+                and self.filter_feed is None
+                and self.probe_filter is None
+                and combine is None
+                and getattr(ctx.cfg, "peer_shuffle", True)):
+            backend = getattr(ctx, "dist_backend", None)
+            if (backend is not None
+                    and getattr(backend, "execute_fanout", None) is not None
+                    and backend.peer_ready()):
+                yield from self._peer_execute(stream, ctx, n, fdo_obs,
+                                              backend)
+                return
         buckets = [ctx.partition_buffer() for _ in range(n)]
         # payload encoding engages on BUDGETED queries only: that is where
         # exchanged bytes gate throughput (ledger pressure -> spill IO, and

@@ -84,8 +84,28 @@ class DispatchBackend:
     def try_execute(self, op, part, ctx, op_name: str, seq: int):
         """Execute one map task remotely; return (out, rows, wall_ns), or
         None when the task is ineligible / the backend is degraded (the
-        caller runs it in-process). Raises the task's terminal error."""
+        caller runs it in-process). Raises the task's terminal error.
+
+        Dispatch locality: a backend MAY inspect the partition for a
+        placement hint — a peer-backed shuffle partition
+        (dist/peerplane.peer_preference) prefers the workers already
+        hosting its piece bytes, turning peer fetches into local store
+        reads. The hint is advisory: any ready worker remains a legal
+        target, so preference never blocks progress."""
         raise NotImplementedError
+
+    # Peer-shuffle extension (dist/peerplane.py) — OPTIONAL: ShuffleOp
+    # probes for these with getattr and keeps the star path when absent.
+    #   execute_fanout(part, spec, ctx, op_name, seq)
+    #       -> (wid, (host, port), metas) | None
+    #     Ship one source partition as a fanout task: the worker splits it
+    #     and HOSTS the pieces on its piece-server; metas are
+    #     (bucket, rows, nbytes, crc) location entries. None = declined
+    #     (caller splits driver-side, byte-identical).
+    #   peer_ready() -> bool      # any ready worker serving pieces?
+    #   new_shuffle_id() -> int   # unique per shuffle, scopes piece keys
+    #   peer_token() -> bytes     # transport auth token for peer fetches
+    #   drop_shuffles(sids)       # fleet-wide piece drop at query finish
 
 
 def run_map_task(op, part, ctx, op_name: str, seq: int):

@@ -57,6 +57,19 @@ class TestClassify:
         assert classify("dist_telemetry_wall_on_s") == "lower"
         assert classify("dist_telemetry_wall_off_s") == "lower"
 
+    def test_peer_plane_metrics(self):
+        # ISSUE 16: driver-payload metrics are named by LEG (star/p2p), so
+        # the contains-rule classifies anything with "_driver_bytes" as
+        # lower-better; the preemption-cost headline is lower-better; the
+        # weak-scaling growth ratios carry NO direction — star's growth
+        # tracking N is the topology's expected shape, not a regression
+        assert classify("dist_driver_bytes_star") == "lower"
+        assert classify("dist_driver_bytes_p2p") == "lower"
+        assert classify("q1_dist_driver_bytes") == "lower"
+        assert classify("peer_preemption_overhead_pct") == "lower"
+        assert classify("dist_star_growth_x") is None
+        assert classify("dist_p2p_growth_x") is None
+
     def test_integrity_and_speculation_suffixes(self):
         # ISSUE 12: the checksum-cost headline is lower-better (its gate
         # is < 3% on the q1 leg), the straggler-mitigation headline

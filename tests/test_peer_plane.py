@@ -1,0 +1,565 @@
+"""Peer-to-peer shuffle data plane + elastic worker pool (daft_tpu/dist/
+peerplane.py, ISSUE 16).
+
+Covers the acceptance surface:
+- identity matrix: p2p results byte-identical to the local runner (and
+  hence to the star path) across worker counts, knob settings, and plan
+  shapes — including shapes that mix p2p (hash/random) with star (range
+  sort) exchanges in one plan;
+- fault sites: ``peer.fetch`` degrades to a lineage recompute at the
+  read site (peer_refetches recorded, result identical); ``worker.drain``
+  degrades to the kill/redispatch path — never a hang in either case;
+- peer death: SIGKILLing a piece-hosting worker mid-query completes
+  byte-identically;
+- graceful drain: drain_worker() mid-query quiesces without a loss and
+  without changing results; the pool keeps serving afterward;
+- elastic pool: demand grows the fleet between distributed_workers_min
+  and _max, sustained idleness gracefully drains it back to the floor;
+- location-map staleness (unit): a stale/corrupt PieceRef falls over to
+  refetch-or-recompute, truncated lineage raises a typed transient;
+- exactly-once accounting (unit): re-stored pieces never double-count
+  hosted bytes, failed fetches never count as fetches.
+"""
+
+import os
+import pickle
+import signal
+import threading
+import time
+import zlib
+
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, faults
+from daft_tpu.context import get_context, set_execution_config
+from daft_tpu.dist import supervisor as sup
+from daft_tpu.dist.peerplane import (PeerPieceTask, PieceRef, PieceServer,
+                                     _PeerPlane, peer_preference, plane)
+from daft_tpu.errors import DaftTransientError
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    cfg_before = get_context().execution_config
+    faults.disarm()
+    yield
+    faults.disarm()
+    get_context().execution_config = cfg_before
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_teardown():
+    yield
+    sup.shutdown_worker_pool()
+    assert sup.live_worker_process_count() == 0
+
+
+@pytest.fixture(scope="module")
+def pq_glob(tmp_path_factory):
+    """Scan-backed source data: p2p only fans a partition out REMOTELY
+    when its source is re-readable (the recomputability rule), so the
+    matrix must run on files, not from_pydict."""
+    import pyarrow as pa
+    import pyarrow.parquet as papq
+
+    root = tmp_path_factory.mktemp("peerdata")
+    n = 3000
+    for i in range(4):
+        lo = i * n
+        papq.write_table(pa.table({
+            "a": list(range(lo, lo + n)),
+            "b": [v % 13 for v in range(lo, lo + n)],
+            "g": [v % 5 for v in range(lo, lo + n)],
+        }), str(root / f"f{i}.parquet"))
+    return str(root / "*.parquet")
+
+
+def _shapes(pat):
+    df = dt.read_parquet(pat)
+    other = dt.from_pydict({"b": list(range(13)),
+                            "w": [i * 10 for i in range(13)]})
+    return {
+        "hash_groupby": (df.repartition(5, "b").groupby("b")
+                         .agg(col("a").sum().alias("s"),
+                              col("a").count().alias("c")).sort("b")),
+        "random_filter": (df.repartition(4).where(col("a") % 7 == 0)
+                          .select(col("a"), col("b")).sort("a")),
+        "join": (df.repartition(3, "b").join(other, on="b")
+                 .select(col("a"), col("w")).sort("a")),
+        "two_stage": (df.repartition(6, "g").groupby("g")
+                      .agg(col("a").sum().alias("sg"))
+                      .repartition(2, "g").sort("g")),
+        "mixed_range": df.sort("a", desc=True).select(col("a"), col("g")),
+        "distinct": df.select(col("b"), col("g")).distinct().sort("b"),
+    }
+
+
+def _dist_cfg(**kw):
+    base = dict(enable_result_cache=False, scan_tasks_min_size_bytes=0)
+    base.update(kw)
+    set_execution_config(**base)
+
+
+# ---------------------------------------------------------------------------
+# byte identity
+# ---------------------------------------------------------------------------
+
+class TestByteIdentityMatrix:
+    def test_matrix_across_workers_knob_and_shapes(self, pq_glob):
+        sup.shutdown_worker_pool()
+        _dist_cfg()
+        local = {k: q.collect().to_arrow()
+                 for k, q in _shapes(pq_glob).items()}
+        for workers, p2p in ((2, True), (3, True), (2, False)):
+            sup.shutdown_worker_pool()
+            _dist_cfg(distributed_workers=workers, peer_shuffle=p2p)
+            got = {k: q.collect().to_arrow()
+                   for k, q in _shapes(pq_glob).items()}
+            for name, tbl in local.items():
+                assert got[name].equals(tbl), (workers, p2p, name)
+        sup.shutdown_worker_pool()
+
+    def test_peer_path_engaged_and_driver_bytes_drop(self, pq_glob):
+        sup.shutdown_worker_pool()
+        _dist_cfg(distributed_workers=2)
+        res = _shapes(pq_glob)["hash_groupby"].collect()
+        c = res.stats.snapshot()["counters"]
+        assert c.get("peer_fetches", 0) >= 1, c
+        rec = res.last_query_record()
+        assert rec["events"].get("peer_fetches", 0) >= 1, rec["events"]
+        p2p_bytes = c.get("dist_driver_bytes", 0)
+        # knob OFF: same plan, no peer fetches, payloads back on the driver
+        sup.shutdown_worker_pool()
+        _dist_cfg(distributed_workers=2, peer_shuffle=False)
+        res2 = _shapes(pq_glob)["hash_groupby"].collect()
+        c2 = res2.stats.snapshot()["counters"]
+        assert c2.get("peer_fetches", 0) == 0, c2
+        assert c2.get("dist_driver_bytes", 0) > p2p_bytes
+        sup.shutdown_worker_pool()
+
+    def test_exactly_once_on_a_clean_run(self, pq_glob):
+        sup.shutdown_worker_pool()
+        _dist_cfg(distributed_workers=2)
+        res = _shapes(pq_glob)["hash_groupby"].collect()
+        c = res.stats.snapshot()["counters"]
+        # nothing failed: every piece pulled exactly once, none re-derived
+        assert c.get("peer_refetches", 0) == 0, c
+        pool = sup.get_worker_pool(get_context().execution_config)
+        snap = pool.snapshot()
+        assert snap["tasks_dispatched_total"] == snap[
+            "tasks_completed_total"]
+        sup.shutdown_worker_pool()
+
+
+# ---------------------------------------------------------------------------
+# fault sites
+# ---------------------------------------------------------------------------
+
+class TestFaultSites:
+    def test_sites_registered(self):
+        assert "peer.fetch" in faults.SITES
+        assert "worker.drain" in faults.SITES
+
+    def test_peer_fetch_fault_recovers_through_lineage(self, pq_glob):
+        import json
+
+        sup.shutdown_worker_pool()
+        _dist_cfg()
+        local = _shapes(pq_glob)["hash_groupby"].collect().to_arrow()
+        # fault plans bind at worker SPAWN (ENV_FAULT_SPEC): the peer
+        # pulls happen at the workers' read sites, so the plan must cross
+        # the process boundary, not sit in this process's module globals
+        os.environ[faults.ENV_FAULT_SPEC] = json.dumps(
+            {"site": "peer.fetch", "mode": "rate", "rate": 0.4, "seed": 7})
+        t0 = time.monotonic()
+        try:
+            _dist_cfg(distributed_workers=2)
+            res = _shapes(pq_glob)["hash_groupby"].collect()
+        finally:
+            os.environ.pop(faults.ENV_FAULT_SPEC, None)
+        assert time.monotonic() - t0 < 90, "peer-fetch recovery hung"
+        assert res.to_arrow().equals(local)
+        rec = res.last_query_record()
+        assert rec["events"].get("peer_refetches", 0) >= 1, rec["events"]
+        c = res.stats.snapshot()["counters"]
+        assert c.get("peer_refetches", 0) >= 1, c
+        sup.shutdown_worker_pool()
+
+    def test_drain_fault_degrades_to_kill_never_hang(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                             enable_result_cache=False,
+                             worker_drain_grace_s=0.2)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        wid = sorted(pool.worker_pids())[0]
+        losses_before = pool.snapshot()["worker_losses_total"]
+        faults.arm("worker.drain", "always")
+        t0 = time.monotonic()
+        try:
+            ok = pool.drain_worker(wid)
+        finally:
+            faults.disarm()
+        assert ok is False
+        assert time.monotonic() - t0 < 30, "faulted drain hung"
+        # the slot was KILLED, not drained: a loss, never a graceful exit
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if pool.snapshot()["worker_losses_total"] > losses_before:
+                break
+            time.sleep(0.05)
+        snap = pool.snapshot()
+        assert snap["worker_losses_total"] > losses_before, snap
+        assert snap["workers_drained_total"] == 0
+        # and the pool still serves queries (respawn covered the kill)
+        res = dt.from_pydict({"a": list(range(2000))}).repartition(3) \
+            .select((col("a") + 1).alias("c")).collect()
+        assert sorted(res.to_pydict()["c"]) == [v + 1 for v in range(2000)]
+        sup.shutdown_worker_pool()
+
+
+# ---------------------------------------------------------------------------
+# peer death + graceful drain mid-query
+# ---------------------------------------------------------------------------
+
+class TestPeerDeathAndDrain:
+    def test_sigkill_peer_mid_query_byte_identical(self, pq_glob):
+        sup.shutdown_worker_pool()
+        _dist_cfg()
+        local = _shapes(pq_glob)["hash_groupby"].collect().to_arrow()
+        _dist_cfg(distributed_workers=2)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        killed = []
+
+        def killer():
+            # kill a piece-hosting peer shortly into the query: whatever
+            # phase it lands in (fanout, serve, reduce), the query must
+            # complete byte-identically through redispatch + lineage
+            time.sleep(0.05)
+            pids = pool.worker_pids()
+            if pids:
+                wid = sorted(pids)[-1]
+                try:
+                    os.kill(pids[wid], signal.SIGKILL)
+                    killed.append(pids[wid])
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=killer)
+        t.start()
+        res = _shapes(pq_glob)["hash_groupby"].collect()
+        t.join(timeout=30)
+        assert res.to_arrow().equals(local)
+        assert killed, "killer found no live worker"
+        assert pool.snapshot()["worker_losses_total"] >= 1
+        sup.shutdown_worker_pool()
+
+    def test_drain_while_serving_byte_identical(self, pq_glob):
+        sup.shutdown_worker_pool()
+        _dist_cfg()
+        local = _shapes(pq_glob)["hash_groupby"].collect().to_arrow()
+        _dist_cfg(distributed_workers=2, worker_drain_grace_s=0.3,
+                  worker_drain_timeout_s=8)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        wid = sorted(pool.worker_pids())[0]
+        drained = []
+
+        def _drain():
+            time.sleep(0.05)
+            drained.append(pool.drain_worker(wid))
+
+        t = threading.Thread(target=_drain)
+        t.start()
+        res = _shapes(pq_glob)["hash_groupby"].collect()
+        t.join(timeout=30)
+        assert res.to_arrow().equals(local)
+        assert drained == [True], drained
+        snap = pool.snapshot()
+        assert snap["workers_drained_total"] >= 1, snap
+        assert snap["elastic"]["workers_drained_total"] >= 1
+        # a drain is a quiesce, never a loss
+        assert snap["worker_losses_total"] == 0, snap
+        # the reduced pool keeps answering correctly
+        res2 = _shapes(pq_glob)["hash_groupby"].collect()
+        assert res2.to_arrow().equals(local)
+        sup.shutdown_worker_pool()
+
+
+# ---------------------------------------------------------------------------
+# elastic pool
+# ---------------------------------------------------------------------------
+
+class TestElasticPool:
+    def test_scale_up_under_demand_then_drain_at_idle(self, pq_glob):
+        sup.shutdown_worker_pool()
+        _dist_cfg(distributed_workers=1, distributed_workers_min=1,
+                  distributed_workers_max=3, elastic_scale_interval_s=0.1,
+                  elastic_idle_scale_down_s=0.6,
+                  worker_heartbeat_interval_s=0.1,
+                  worker_drain_grace_s=0.1, worker_drain_timeout_s=5)
+        local = None
+        results = []
+
+        def _run():
+            results.append(
+                _shapes(pq_glob)["hash_groupby"].collect().to_arrow())
+
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        assert pool.snapshot()["elastic"]["enabled"] == 1
+        threads = [threading.Thread(target=_run) for _ in range(3)]
+        for t in threads:
+            t.start()
+        # concurrent demand (busy workers + dispatch waiters) must grow
+        # the fleet above the floor; scale_ups_total is sticky, so the
+        # poll cannot miss a growth that happened between snapshots
+        grew = False
+        deadline = time.monotonic() + 25
+        while time.monotonic() < deadline:
+            if pool.snapshot()["elastic"]["scale_ups_total"] >= 1:
+                grew = True
+                break
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=60)
+        assert grew, pool.snapshot()["elastic"]
+        assert len(results) == 3
+        sup_cfg = get_context().execution_config
+        set_execution_config(distributed_workers=0)
+        local = _shapes(pq_glob)["hash_groupby"].collect().to_arrow()
+        get_context().execution_config = sup_cfg
+        for r in results:
+            assert r.equals(local)
+        # sustained idleness: graceful drains take the fleet back down to
+        # the floor — never below it, and never as a loss
+        shrunk = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = pool.snapshot()
+            if (snap["elastic"]["scale_downs_total"] >= 1
+                    and snap["workers_alive"] == 1):
+                shrunk = True
+                break
+            time.sleep(0.1)
+        assert shrunk, pool.snapshot()["elastic"]
+        snap = pool.snapshot()
+        assert snap["elastic"]["workers_min"] == 1
+        assert snap["elastic"]["workers_max"] == 3
+        assert snap["workers_drained_total"] >= 1
+        assert snap["worker_losses_total"] == 0, snap
+        # the floor-size pool still answers correctly
+        res = _shapes(pq_glob)["hash_groupby"].collect()
+        assert res.to_arrow().equals(local)
+        sup.shutdown_worker_pool()
+
+
+# ---------------------------------------------------------------------------
+# location-map staleness + accounting (unit)
+# ---------------------------------------------------------------------------
+
+class _SrcTask:
+    """Minimal re-readable scan-task surface (stable in-test storage),
+    mirroring tests/test_integrity.py."""
+
+    def __init__(self, tbl):
+        self._tbl = tbl
+        self.schema = tbl.schema
+        self.stats = None
+
+    @property
+    def materialized_schema(self):
+        return self._tbl.schema
+
+    def num_rows(self):
+        return len(self._tbl)
+
+    def size_bytes(self):
+        return self._tbl.size_bytes()
+
+    def read(self):
+        return self._tbl
+
+    def read_chunks(self):
+        return [self._tbl]
+
+    @property
+    def pushdowns(self):
+        from daft_tpu.io.scan import Pushdowns
+
+        return Pushdowns()
+
+    def with_pushdowns(self, pd):
+        from daft_tpu.spill import _SpillSlotView
+
+        return _SpillSlotView(self, pd)
+
+
+_SID = 987_654  # far above any pool-issued shuffle id
+
+
+class TestLocationMapUnit:
+    @pytest.fixture()
+    def server(self):
+        srv = PieceServer("tok")
+        srv.start()
+        yield srv
+        srv.close()
+        plane().drop_shuffles([_SID, _SID + 1])
+
+    def _hosted_piece(self):
+        """Store bucket 1 of a seeded 3-way random split of a re-readable
+        source in the process plane, exactly as execute_fanout would."""
+        from daft_tpu.micropartition import MicroPartition
+        from daft_tpu.table import Table
+
+        tbl = Table.from_pydict({"a": list(range(1200)),
+                                 "b": [i % 9 for i in range(1200)]})
+        task = _SrcTask(tbl)
+        mp = MicroPartition.from_scan_task(task)
+        piece = mp.partition_by_random(3, seed=0)[1]
+        payload = pickle.dumps(piece, protocol=pickle.HIGHEST_PROTOCOL)
+        rows = piece.num_rows_or_none() or 0
+        plane().put((_SID, 1, 0), payload, rows)
+        return task, piece.table(), payload, rows
+
+    def _ref(self, server, payload, rows, sid=_SID, crc=None):
+        return PieceRef(wid=99, host="127.0.0.1", port=server.port,
+                        sid=sid, bucket=1, src=0, rows=rows,
+                        nbytes=len(payload), crc=crc)
+
+    def test_fresh_map_serves_the_piece(self, server):
+        task, expect, payload, rows = self._hosted_piece()
+        before = plane().snapshot()
+        ref = self._ref(server, payload, rows,
+                        crc=zlib.crc32(payload))
+        pt = PeerPieceTask(task.schema, [ref], "tok", ([], "random", 3),
+                           {0: task})
+        out = pt.read()
+        assert out.to_pydict() == expect.to_pydict()
+        after = plane().snapshot()
+        assert after["pieces_fetched_total"] == \
+            before["pieces_fetched_total"] + 1
+        assert after["pieces_served_total"] == \
+            before["pieces_served_total"] + 1
+        assert after["pieces_refetched_total"] == \
+            before["pieces_refetched_total"]
+
+    def test_stale_map_recomputes_from_lineage(self, server):
+        task, expect, payload, rows = self._hosted_piece()
+        before = plane().snapshot()
+        # the map names a shuffle the peer no longer hosts (restart /
+        # post-grace drain / drop): refetch-or-recompute, same bytes
+        stale = self._ref(server, payload, rows, sid=_SID + 1)
+        pt = PeerPieceTask(task.schema, [stale], "tok", ([], "random", 3),
+                           {0: task})
+        out = pt.read()
+        assert out.to_pydict() == expect.to_pydict()
+        after = plane().snapshot()
+        assert after["pieces_refetched_total"] == \
+            before["pieces_refetched_total"] + 1
+        # a failed pull is NOT a fetch: exactly-once accounting
+        assert after["pieces_fetched_total"] == \
+            before["pieces_fetched_total"]
+
+    def test_corrupt_payload_recomputes_from_lineage(self, server):
+        task, expect, payload, rows = self._hosted_piece()
+        before = plane().snapshot()
+        bad = self._ref(server, payload, rows,
+                        crc=zlib.crc32(payload) ^ 0xFFFFFFFF)
+        pt = PeerPieceTask(task.schema, [bad], "tok", ([], "random", 3),
+                           {0: task})
+        out = pt.read()
+        assert out.to_pydict() == expect.to_pydict()
+        after = plane().snapshot()
+        assert after["pieces_refetched_total"] == \
+            before["pieces_refetched_total"] + 1
+
+    def test_truncated_lineage_raises_typed_transient(self, server):
+        task, expect, payload, rows = self._hosted_piece()
+        stale = self._ref(server, payload, rows, sid=_SID + 1)
+        pt = PeerPieceTask(task.schema, [stale], "tok", ([], "random", 3),
+                           {})  # no recovery spec: nothing to re-derive
+        with pytest.raises(DaftTransientError, match="truncated lineage"):
+            pt.read()
+
+    def test_preferred_wids_rank_by_hosted_bytes(self):
+        from daft_tpu.micropartition import MicroPartition
+        from daft_tpu.table import Table
+
+        schema = Table.from_pydict({"a": [1]}).schema
+        refs = [PieceRef(3, "h", 1, 1, 0, 0, 10, 500, None),
+                PieceRef(1, "h", 1, 1, 0, 1, 10, 2000, None),
+                PieceRef(1, "h", 1, 1, 0, 2, 10, 1500, None)]
+        pt = PeerPieceTask(schema, refs, "t", ([], "random", 4), {})
+        assert pt.preferred_wids() == [1, 3]
+        part = MicroPartition.from_scan_task(pt)
+        assert peer_preference(part) == {1, 3}
+        # loaded partitions carry no locality hint
+        assert peer_preference(
+            MicroPartition.from_pydict({"a": [1]})) is None
+
+
+class TestPlaneAccounting:
+    def test_restore_never_double_counts(self):
+        p = _PeerPlane()
+        p.put((1, 0, 0), b"abcd", 2)
+        p.put((1, 0, 0), b"abcdef", 2)  # re-dispatched fanout re-stores
+        s = p.snapshot()
+        assert s["pieces_hosted"] == 1
+        assert s["piece_bytes_hosted"] == 6
+        assert s["pieces_stored_total"] == 2
+        p.put((1, 1, 0), b"xy", 1)
+        hit = p.get((1, 0, 0), serving=True)
+        assert hit is not None and hit[0] == b"abcdef"
+        s = p.snapshot()
+        assert s["pieces_served_total"] == 1
+        assert s["peer_bytes_served_total"] == 6
+        assert p.get((9, 9, 9), serving=True) is None
+        assert p.snapshot()["pieces_served_total"] == 1  # a miss serves 0
+        assert p.drop_shuffles([1]) == 2
+        s = p.snapshot()
+        assert s["pieces_hosted"] == 0
+        assert s["piece_bytes_hosted"] == 0
+        assert s["shuffles_dropped_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_health_sections_and_gauges(self, pq_glob):
+        sup.shutdown_worker_pool()
+        _dist_cfg(distributed_workers=2,
+                  worker_heartbeat_interval_s=0.1)
+        _ = _shapes(pq_glob)["hash_groupby"].collect()
+        from daft_tpu.obs.health import validate_health
+
+        # worker piece-store snapshots ride heartbeat pongs: poll until
+        # the driver's aggregate has seen the fanout stores
+        pool = sup.get_worker_pool(get_context().execution_config)
+        deadline = time.monotonic() + 10
+        stored = 0
+        while time.monotonic() < deadline:
+            stored = pool.snapshot()["peer_plane"]["pieces_stored_total"]
+            if stored >= 1:
+                break
+            time.sleep(0.05)
+        assert stored >= 1
+        h = dt.health()
+        assert validate_health(h) == []
+        clu = h["cluster"]
+        assert clu["peer_plane"]["pieces_stored_total"] >= 1
+        assert clu["elastic"]["enabled"] == 0  # fixed-size pool
+        mt = dt.metrics_text()
+        assert "daft_tpu_cluster_peer_pieces_served_total" in mt
+        assert "daft_tpu_cluster_peer_bytes_fetched_total" in mt
+        assert "daft_tpu_cluster_elastic_workers_max" in mt
+        assert "daft_tpu_cluster_elastic_workers_drained_total" in mt
+        sup.shutdown_worker_pool()
+        h2 = dt.health()
+        assert validate_health(h2) == []
+        assert h2["cluster"]["peer_plane"]["pieces_hosted"] == 0

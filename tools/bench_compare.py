@@ -53,12 +53,21 @@ _SNAPSHOT_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 # the rung's seeded fault plan, not performance, and a plan change must
 # never read as a regression. "_hit_rate" (serving rung: plan-cache hits
 # over the repeat-shape leg) is higher-better — a falling hit rate means
-# repeat traffic is re-planning.
+# repeat traffic is re-planning. "_preemption_overhead_pct" (distributed
+# rung: the cost of gracefully draining a SIGTERMed worker mid-shuffle vs
+# an undisturbed run) is headline-pinned like the other overhead gates.
+# Driver-payload metrics ("dist_driver_bytes_star"/"dist_driver_bytes_p2p"
+# — the p2p flat-in-N gate) are named by LEG, so no fixed suffix covers
+# them: classify() special-cases any metric CONTAINING "_driver_bytes" as
+# lower-better. The growth RATIOS of that leg end in "_growth_x" and are
+# deliberately direction-free: star's growth is expected to track N, and
+# a topology change must not read as a perf regression.
 _LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_ttfr_s", "_pct",
                    "_share", "_bytes", "_peak_mb", "_rows",
                    "_misses", "_throttled", "_failures", "_errors",
                    "_overhead_pct", "_recovery_overhead_pct",
-                   "_telemetry_overhead_pct", "_shed_count")
+                   "_telemetry_overhead_pct", "_preemption_overhead_pct",
+                   "_shed_count")
 _HIGHER_SUFFIXES = ("_per_sec", "_vs_baseline", "_speedup_x", "_gbps",
                     "_mbps", "_hits", "_qps", "value", "_rows_pruned",
                     "_reduction_x", "_hit_rate")
@@ -72,6 +81,8 @@ def classify(metric: str) -> Optional[str]:
     for suf in _LOWER_SUFFIXES:
         if metric.endswith(suf):
             return "lower"
+    if "_driver_bytes" in metric:
+        return "lower"
     return None
 
 

@@ -125,6 +125,9 @@ def main() -> int:
     rc = _straggler_leg()
     if rc:
         return rc
+    rc = _peer_leg()
+    if rc:
+        return rc
 
     dt.shutdown()
     live = sup.live_worker_process_count()
@@ -193,10 +196,15 @@ def _corruption_leg() -> int:
                                 memory_budget_bytes=None)
         oracle = {name: q.collect().to_arrow()
                   for name, q in make_queries()}
+        # star plane pinned (peer_shuffle off): this leg's contract is the
+        # DRIVER-side exchange — budgeted bucket spills and driver<->worker
+        # frames — whose corruption must lineage-recompute. The peer
+        # plane's own loss/corruption recovery is _peer_leg's job.
         dt.set_execution_config(distributed_workers=WORKERS,
                                 memory_budget_bytes=120_000,
                                 worker_heartbeat_interval_s=0.2,
-                                worker_restart_budget=12)
+                                worker_restart_budget=12,
+                                peer_shuffle=False)
         _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()  # warm
         before_log = len(dt.query_log())
         faults.arm("spill.corrupt", "rate", rate=CORRUPT_SPILL_RATE,
@@ -313,6 +321,153 @@ def _straggler_leg() -> int:
     # the next leg / shutdown must not inherit the straggler fleet
     sup.shutdown_worker_pool()
     return 0
+
+
+def _peer_leg() -> int:
+    """Peer-to-peer shuffle plane (ISSUE 16): a scan-backed 5-query
+    workload with the seeded ``peer.fetch`` fault killing fetches
+    mid-pull, one REAL SIGKILL of a piece-hosting worker mid-query, and
+    one graceful drain (SIGTERM path) while the workload runs. Every
+    query must come back byte-identical to the clean local runner —
+    failed fetches fail over to lineage recompute (``peer_refetches``),
+    the drain retires its worker without failing anything
+    (``workers_drained``)."""
+    import shutil
+    import signal
+    import tempfile
+    import threading
+    import time
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import daft_tpu as dt
+    from daft_tpu import col, faults
+    from daft_tpu.dist import supervisor as sup
+    from daft_tpu.errors import DaftError
+    from daft_tpu.obs.querylog import validate_record
+
+    d = tempfile.mkdtemp(prefix="chaos_peer_src_")
+
+    def make_queries():
+        # scan-backed shuffle shapes: their fanouts ship to workers and
+        # host pieces remotely (loaded sources stay driver-side by the
+        # recomputability rule, so they would not exercise the plane)
+        df = dt.read_parquet(os.path.join(d, "*.parquet"))
+        other = dt.from_pydict({"b": list(range(13)),
+                                "w": [i * 3 for i in range(13)]})
+        return [
+            ("agg", df.repartition(6, "b").groupby("b")
+             .agg(col("a").sum().alias("s")).sort("b")),
+            ("rand", df.repartition(5).where(col("a") % 7 == 0)
+             .select(col("a")).sort("a")),
+            ("join", df.repartition(4, "b").join(other, on="b")
+             .select(col("a"), col("w")).sort("a")),
+            ("two_stage", df.repartition(6, "g").repartition(4, "b")
+             .groupby("b").agg(col("a").count().alias("c")).sort("b")),
+            ("distinct", df.repartition(4, "g").select(col("b"), col("g"))
+             .distinct().sort("b")),
+        ][:QUERIES]
+
+    try:
+        for i in range(4):
+            n = 8000
+            pq.write_table(pa.table({
+                "a": list(range(i * n, (i + 1) * n)),
+                "b": [j % 13 for j in range(n)],
+                "g": [f"g{j % 5}" for j in range(n)],
+            }), os.path.join(d, f"p{i}.parquet"))
+        dt.set_execution_config(enable_result_cache=False,
+                                scan_tasks_min_size_bytes=1,
+                                distributed_workers=0,
+                                memory_budget_bytes=None)
+        oracle = {name: q.collect().to_arrow()
+                  for name, q in make_queries()}
+        sup.shutdown_worker_pool()
+        dt.set_execution_config(distributed_workers=WORKERS,
+                                worker_heartbeat_interval_s=0.2,
+                                worker_restart_budget=12,
+                                peer_shuffle=True)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()  # warm
+        pool = sup._POOL
+        before_log = len(dt.query_log())
+        faults.arm("peer.fetch", "rate", rate=0.25, seed=CHAOS_SEED)
+        refetched = drained = 0
+
+        def sigkill_one(after_s):
+            time.sleep(after_s)
+            with pool._cond:
+                pids = [w.proc.pid for w in pool.workers
+                        if w.proc is not None and w.state == "ready"]
+            if pids:
+                try:
+                    os.kill(pids[-1], signal.SIGKILL)
+                except OSError:
+                    pass
+
+        def drain_one(after_s):
+            time.sleep(after_s)
+            with pool._cond:
+                wids = [w.wid for w in pool.workers
+                        if w.state == "ready" and not w.draining]
+            if wids:
+                pool.drain_worker(wids[0])
+
+        try:
+            for qi, (name, q) in enumerate(make_queries()):
+                chaos = None
+                if name == "join":
+                    chaos = threading.Thread(target=sigkill_one,
+                                             args=(0.05,), daemon=True)
+                elif name == "two_stage":
+                    chaos = threading.Thread(target=drain_one,
+                                             args=(0.05,), daemon=True)
+                if chaos is not None:
+                    chaos.start()
+                try:
+                    res = q.collect()
+                except DaftError as e:
+                    print(f"FAIL: peer leg query {name} errored: "
+                          f"{type(e).__name__}: {str(e)[:120]}")
+                    return 1
+                finally:
+                    if chaos is not None:
+                        chaos.join()
+                if not res.to_arrow().equals(oracle[name]):
+                    print(f"FAIL: peer leg query {name} diverged from "
+                          "the clean local runner")
+                    return 1
+                c = res.stats.snapshot()["counters"]
+                refetched += c.get("peer_refetches", 0)
+        finally:
+            faults.disarm()
+        recs = dt.query_log()[before_log:]
+        if len(recs) < QUERIES:
+            print(f"FAIL: peer leg produced {len(recs)} QueryRecords "
+                  f"for {QUERIES} queries")
+            return 1
+        for rec in recs:
+            errs = validate_record(rec)
+            if errs:
+                print(f"FAIL: peer leg record invalid: {errs}")
+                return 1
+        snap = sup.worker_pool_snapshot()
+        drained = snap["workers_drained_total"] if snap else 0
+        peer = (snap or {}).get("peer_plane", {})
+        if refetched < 1:
+            print("FAIL: peer leg never recomputed a piece — the "
+                  "peer.fetch plan was a no-op")
+            return 1
+        if drained < 1:
+            print("FAIL: peer leg never drained a worker")
+            return 1
+        print(f"CHAOS_PEER_OK {QUERIES} byte-identical, "
+              f"peer_refetches={refetched} workers_drained={drained} "
+              f"pieces_fetched={peer.get('pieces_fetched_total', 0)}")
+        sup.shutdown_worker_pool()
+        return 0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -93,6 +93,10 @@ REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
     # RUNNING execution, registered/unregistered by execute_plan — the
     # dt.health()["queries"] source; bounded by concurrent query count
     ("daft_tpu/obs/cluster.py", "_progress"),
+    # the process's peer-shuffle piece store (dist/peerplane.py): one per
+    # worker process, pieces dropped per shuffle id at query finish and
+    # cleared whole on worker exit — bounded by live shuffles
+    ("daft_tpu/dist/peerplane.py", "_PLANE"),
 }
 
 _CONTAINER_CTOR_BASES = {
