@@ -1,8 +1,13 @@
 # One-command local check: the same static gates tier-1 runs.
-#   make lint          - daftlint invariants (DTL001-DTL008) + bytecode-compile
+#   make lint          - daftlint invariants (DTL001-DTL012, incl. the
+#                        interprocedural lock-order/blocking/ledger/thread
+#                        rules), emits daftlint.sarif, + bytecode-compile
 #                        daft_tpu + profile smoke (QueryProfile schema gate)
 #                        + obs smoke (flight-recorder schema gate)
 #                        + chaos smoke (distributed-runner kill survival gate)
+#   make precommit     - fast pre-commit path: daftlint --changed-only
+#                        (git-dirty files only; unchanged-file summaries
+#                        served from the content-hash cache)
 #   make profile-smoke - tiny profiled query; validates the QueryProfile JSON,
 #                        chrome trace, and metrics dump end to end
 #   make obs-smoke     - flight recorder end to end: query log, health
@@ -19,11 +24,14 @@
 
 PY ?= python
 
-.PHONY: lint test profile-smoke obs-smoke chaos-smoke cache-smoke bench-compare
+.PHONY: lint precommit test profile-smoke obs-smoke chaos-smoke cache-smoke bench-compare
 
 lint: profile-smoke obs-smoke chaos-smoke cache-smoke
-	$(PY) -m tools.daftlint
+	$(PY) -m tools.daftlint --jobs 8 --sarif daftlint.sarif
 	$(PY) -m compileall -q daft_tpu
+
+precommit:
+	$(PY) -m tools.daftlint --changed-only --jobs 8
 
 cache-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.cache_smoke

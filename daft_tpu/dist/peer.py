@@ -48,7 +48,9 @@ class PeerGroup:
         self.nproc = nproc
         self.pid = pid
         self._round = 0
-        self._lock = threading.Lock()
+        # serializes collective rounds end-to-end: held across the
+        # round's socket traffic by design (rounds must not interleave)
+        self._lock = threading.Lock()  # daftlint: io-lock
         self._sock: Optional[socket.socket] = None
         self._hub: Optional["_Hub"] = None
         self._local_q: Optional[queue.Queue] = None

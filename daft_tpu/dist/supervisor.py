@@ -157,7 +157,9 @@ class _WorkerHandle:
         self.restarts = 0
         self.deaths = 0
         self.breaker = breaker
-        self.send_lock = threading.Lock()
+        # serializes frames onto this worker's socket — held across
+        # the send by design (interleaved frames would desync rx)
+        self.send_lock = threading.Lock()  # daftlint: io-lock
         self.ops_sent: dict = {}  # insertion-ordered op-key window
         self.rx_thread: Optional[threading.Thread] = None
         self.ledger_report = {"current": 0, "high_water": 0}
@@ -320,9 +322,14 @@ class WorkerPool:
         deadline = time.monotonic() + float(self.cfg.worker_spawn_timeout_s)
         sock = None
         try:
-            self._spawn_lock.acquire()
             while True:
-                parked = self._parked.pop(w.wid, None)
+                # _spawn_lock guards ONLY the parked-handshake dict (held
+                # for dict ops, never across IO): concurrent spawners may
+                # all block in accept() on the shared listener — the OS
+                # hands each connection to exactly one of them, and a
+                # spawner that accepts a sibling's worker parks it below
+                with self._spawn_lock:
+                    parked = self._parked.pop(w.wid, None)
                 if parked is not None:
                     # a sibling spawner already accepted and validated our
                     # worker's hello off the shared listener
@@ -332,7 +339,9 @@ class WorkerPool:
                     if remaining <= 0:
                         raise DaftTransientError(
                             f"worker {w.wid} spawn timed out")
-                    self._listener.settimeout(min(remaining, 5.0))
+                    # short accept timeout: a handshake parked for us by a
+                    # sibling must be discovered within a second
+                    self._listener.settimeout(min(remaining, 1.0))
                     try:
                         cand, _ = self._listener.accept()
                     except socket.timeout:
@@ -341,6 +350,15 @@ class WorkerPool:
                                 f"worker {w.wid} exited rc={proc.returncode}"
                                 " before handshake")
                         continue
+                    except OSError:
+                        # listener closed under us: shutdown raced in
+                        raise DaftTransientError(
+                            "worker pool shut down during spawn")
+                    # the handshake read gets its own deadline: a client
+                    # that connects and never speaks must time out instead
+                    # of wedging every subsequent spawn
+                    cand.settimeout(
+                        min(max(deadline - time.monotonic(), 0.1), 5.0))
                     try:
                         hello = recv_msg(cand)
                     except Exception:
@@ -368,15 +386,19 @@ class WorkerPool:
                 if isinstance(other, int) and other != w.wid:
                     # a concurrent spawn's worker dialed in while we held
                     # the listener: park its handshake for that spawner
-                    stale = self._parked.pop(other, None)
+                    with self._spawn_lock:
+                        stale = self._parked.pop(other, None)
+                        self._parked[other] = (cand, hello)
                     if stale is not None:
                         try:
                             stale[0].close()
                         except OSError:
                             pass
-                    self._parked[other] = (cand, hello)
                     continue
                 cand.close()  # stale/foreign connection: not ours
+            # back to a blocking socket before init/rx handoff: the
+            # handshake deadline must not apply to task traffic
+            sock.settimeout(None)
             send_msg(sock, {"type": "init", "cfg": self._worker_cfg()},
                      checksum=self._checksum)
         except BaseException:
@@ -388,8 +410,6 @@ class WorkerPool:
             except Exception:
                 pass
             raise
-        finally:
-            self._spawn_lock.release()
         with self._cond:
             if self._closed:
                 # shutdown raced this spawn: shutdown() iterated the slots
@@ -1307,6 +1327,7 @@ class WorkerPool:
                 # charged once per entry, not per duplicate: the driver
                 # ships the same payload twice but holds it once
                 entry.charged = size
+                # daftlint: ledger-escape settled-by=_on_task_reply,_on_worker_death,shutdown
                 entry.ctx.ledger.dist_started(size)
         msg = {"type": "task", "task_id": entry.task_id,
                "part": part_bytes}
@@ -1607,10 +1628,12 @@ class WorkerPool:
             self._listener.close()
         except OSError:
             pass
-        # atomic swap, NOT _spawn_lock: an in-flight handshake can hold
-        # that lock for the whole spawn timeout, and shutdown must not
-        # stall behind it (a racing spawner sees the fresh empty dict)
-        parked, self._parked = self._parked, {}
+        # _spawn_lock only guards the parked dict (held for dict ops, never
+        # across IO), so shutdown can take it: the swap can't interleave
+        # with a racing spawner's park, whose socket would otherwise leak
+        # into the dropped dict
+        with self._spawn_lock:
+            parked, self._parked = self._parked, {}
         for cand, _hello in parked.values():
             try:
                 cand.close()

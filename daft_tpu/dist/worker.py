@@ -85,7 +85,9 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
         recv_msg, send_msg
 
     log = get_logger("dist.worker")
-    send_lock = threading.Lock()
+    # held across each framed reply by design: one socket, one frame
+    # at a time (interleaved frames would desync the driver's reader)
+    send_lock = threading.Lock()  # daftlint: io-lock
     # the peer-shuffle piece server binds BEFORE the hello carries its
     # port: no dispatched reduce task can ever hold an unbound address
     peer_server = PieceServer(token)
@@ -210,7 +212,8 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                 pass
             tasks.put({"_drain": True})
 
-        threading.Thread(target=_announce, daemon=True).start()
+        threading.Thread(target=_announce, name="daft-dist-announce",
+                         daemon=True).start()
 
     try:
         signal.signal(signal.SIGTERM, _on_sigterm)

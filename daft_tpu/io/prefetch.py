@@ -135,6 +135,7 @@ class ScanPrefetcher:
                 s.future = fut
                 s.charged = True
                 self._ninflight += 1
+                # daftlint: ledger-escape settled-by=_release_locked
                 self._ledger.prefetch_started(s.est_bytes)
                 self._stats.bump("prefetch_submitted")
 

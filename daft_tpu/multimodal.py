@@ -562,7 +562,8 @@ def url_download(s: Series, max_connections: int = 32, on_error: str = "raise",
     # store configs from env under a lock, and per-url resolution serializes
     # a 10k-wide download on that lock
     client = default_io_client()
-    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="daft-mm-download") as ex:
         futs = {}
         for i, u in enumerate(urls):
             if u is None:
@@ -612,7 +613,8 @@ def url_upload(s: Series, location, on_error: str = "raise",
         return path
 
     workers = max(1, min(int(max_connections), 64))
-    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="daft-mm-upload") as ex:
         futs = {}
         for i, (v, loc) in enumerate(zip(vals, locs)):
             if v is None or loc is None:

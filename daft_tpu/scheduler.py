@@ -322,6 +322,7 @@ def dispatch(tasks: Iterator[PartitionTask], ctx,
                 # between-steps memory — the streaming path's bounded
                 # channels charge stream_inflight instead
                 task.held_bytes = held
+                # daftlint: ledger-escape settled-by=_await_result,_settle
                 ctx.ledger.exec_started(held)
             return out
         finally:

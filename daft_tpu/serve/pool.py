@@ -31,12 +31,11 @@ from typing import Deque, Dict, Optional, Tuple
 
 
 class SharedExecutorPool:
-    def __init__(self, num_workers: int,
-                 thread_name_prefix: str = "daft-serve-exec"):
+    def __init__(self, num_workers: int):
         self.num_workers = max(1, int(num_workers))
         self._exec = ThreadPoolExecutor(
             max_workers=self.num_workers,
-            thread_name_prefix=thread_name_prefix)
+            thread_name_prefix="daft-serve-exec")
         self._lock = threading.Lock()
         self._queues: Dict[str, Deque[Tuple[Future, tuple]]] = {}
         self._rr: Deque[str] = deque()  # round-robin rotation of query keys

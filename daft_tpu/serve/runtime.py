@@ -47,9 +47,13 @@ logger = get_logger("serve")
 _RUNTIMES: "weakref.WeakSet[ServingRuntime]" = weakref.WeakSet()
 _runtimes_lock = threading.Lock()
 
-# thread-name prefixes the engine owns; leaked_thread_count() scans these
+# thread-name prefixes the engine owns; leaked_thread_count() scans these.
+# Every spawn site's static name prefix must be covered by an entry here —
+# daftlint DTL012 enforces the inventory, so a new subsystem prefix that
+# forgets to register itself fails lint instead of leaking invisibly.
 _ENGINE_THREAD_PREFIXES = ("daft-serve", "daft-exec", "daft-actor",
-                           "daft-spill-writer", "daft-dist")
+                           "daft-spill-writer", "daft-dist", "daft-peer",
+                           "daft-mm")
 
 
 class QueryHandle:

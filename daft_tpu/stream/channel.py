@@ -116,6 +116,7 @@ class BoundedChannel:
             # charge (an out-of-order stream_done would be clamp-dropped
             # by the ledger and the charge would leak forever)
             if self._ledger is not None and nbytes:
+                # daftlint: ledger-escape settled-by=get,close
                 self._ledger.stream_started(nbytes)
             self._q.append((item, nbytes))
             self._qbytes += nbytes

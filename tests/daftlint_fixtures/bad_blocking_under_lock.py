@@ -1,0 +1,24 @@
+"""DTL010 fixture: blocking operations while holding a lock — one direct
+(time.sleep under the lock) and one a call away (a helper that sleeps),
+so both the direct and the interprocedural detection paths are covered.
+Dropped into a scanned tree by tests/test_daftlint.py; never imported."""
+
+import time
+import threading
+
+
+class Throttle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ticks = 0
+
+    def direct(self):
+        with self._lock:
+            time.sleep(0.5)  # blocks every other waiter on _lock
+
+    def indirect(self):
+        with self._lock:
+            self._backoff()
+
+    def _backoff(self):
+        time.sleep(0.1)

@@ -1,0 +1,19 @@
+"""DTL011 fixture: MemoryLedger charges that leak — one settled only on
+the fallthrough path (an exception between charge and settle leaks the
+account) and one never settled at all. Dropped into a scanned tree by
+tests/test_daftlint.py; never imported."""
+
+
+class Runner:
+    def __init__(self, ledger):
+        self._ledger = ledger
+
+    def run(self, task, nbytes):
+        self._ledger.exec_started(nbytes)
+        out = task()  # a raise here skips the settle below
+        self._ledger.exec_done(nbytes)
+        return out
+
+    def enqueue(self, nbytes):
+        self._ledger.prefetch_started(nbytes)
+        return nbytes

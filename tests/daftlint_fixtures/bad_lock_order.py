@@ -1,0 +1,26 @@
+"""DTL009 fixture: two locks acquired in opposite orders on two paths —
+the classic AB/BA deadlock shape, one hop apart so the cycle is only
+visible interprocedurally. Dropped into a scanned tree by
+tests/test_daftlint.py; never imported."""
+
+import threading
+
+
+class Exchange:
+    def __init__(self):
+        self._peers = threading.Lock()
+        self._rounds = threading.Lock()
+        self.stat = 0
+
+    def publish(self):
+        with self._peers:
+            self._bump()
+
+    def _bump(self):
+        with self._rounds:
+            self.stat = 1
+
+    def retire(self):
+        with self._rounds:
+            with self._peers:  # inverted vs publish -> _bump
+                self.stat = 2

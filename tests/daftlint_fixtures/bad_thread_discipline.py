@@ -1,0 +1,27 @@
+"""DTL012 fixture: engine threads that leak accounting cannot see — a
+nameless non-daemon thread, a thread named outside the daft- namespace,
+and an executor without a thread_name_prefix. Dropped into a scanned
+tree by tests/test_daftlint.py; never imported."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def _work():
+    pass
+
+
+def spawn_anonymous():
+    t = threading.Thread(target=_work)  # no name=, no daemon=
+    t.start()
+    return t
+
+
+def spawn_misnamed():
+    t = threading.Thread(target=_work, name="worker-1", daemon=True)
+    t.start()
+    return t
+
+
+def make_pool():
+    return ThreadPoolExecutor(max_workers=2)  # workers named ThreadPool-*

@@ -33,7 +33,16 @@ FIXTURE_MATRIX = {
     "bad_span_coverage.py": ("daft_tpu/_fixture_bad_span.py", "DTL006"),
     "bad_log_hygiene.py": ("daft_tpu/_fixture_bad_log.py", "DTL007"),
     "bad_ambient_state.py": ("daft_tpu/_fixture_bad_ambient.py", "DTL008"),
+    "bad_lock_order.py": ("daft_tpu/_fixture_bad_lockorder.py", "DTL009"),
+    "bad_blocking_under_lock.py": ("daft_tpu/_fixture_bad_block.py",
+                                   "DTL010"),
+    "bad_ledger_balance.py": ("daft_tpu/_fixture_bad_ledger.py", "DTL011"),
+    "bad_thread_discipline.py": ("daft_tpu/_fixture_bad_thread.py",
+                                 "DTL012"),
 }
+
+ALL_CODES = ["DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006",
+             "DTL007", "DTL008", "DTL009", "DTL010", "DTL011", "DTL012"]
 
 
 def _lint(root):
@@ -51,10 +60,9 @@ def _copied_tree(tmp_path):
 # the engine over the real tree
 # ---------------------------------------------------------------------------
 
-def test_registry_has_eight_rules():
+def test_registry_has_twelve_rules():
     codes = [r.code for r in ALL_RULES]
-    assert codes == ["DTL001", "DTL002", "DTL003", "DTL004", "DTL005",
-                     "DTL006", "DTL007", "DTL008"]
+    assert codes == ALL_CODES
     assert all(r.name and r.description for r in ALL_RULES)
 
 
@@ -280,9 +288,7 @@ def test_baseline_key_ignores_line_numbers(tmp_path):
 def _check_schema(doc):
     assert doc["version"] == 1 and doc["tool"] == "daftlint"
     assert os.path.isabs(doc["root"])
-    assert [r["code"] for r in doc["rules"]] == [
-        "DTL001", "DTL002", "DTL003", "DTL004", "DTL005", "DTL006",
-        "DTL007", "DTL008"]
+    assert [r["code"] for r in doc["rules"]] == ALL_CODES
     for r in doc["rules"]:
         assert set(r) == {"code", "name", "description"}
     counts = doc["counts"]
@@ -324,8 +330,7 @@ def test_cli_list_rules():
         [sys.executable, "-m", "tools.daftlint", "--list-rules"],
         cwd=_ROOT, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0
-    for code in ("DTL001", "DTL002", "DTL003", "DTL004", "DTL005",
-                 "DTL006", "DTL007", "DTL008"):
+    for code in ALL_CODES:
         assert code in proc.stdout
 
 

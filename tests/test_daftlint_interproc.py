@@ -1,0 +1,441 @@
+"""Engine-level coverage for tools/daftlint's interprocedural layer:
+call-graph resolution (methods, closures, decorators, cross-file
+imports), the lock-order graph, ledger flow analysis and escape
+annotations, summary-cache invalidation, SARIF output, the cond-var
+whitelist, and the full-repo lint wall-time budget."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.daftlint import ALL_RULES, Project, run_lint  # noqa: E402
+from tools.daftlint.engine import render_sarif  # noqa: E402
+from tools.daftlint.interproc import (INTERPROC_VERSION, SummaryCache,  # noqa: E402
+                                      build_model, source_digest)
+
+ALL_CODES = [r.code for r in ALL_RULES]
+
+
+def _tree(root, files):
+    """Write {relpath: source} under `root` and return a Project."""
+    for rel, src in files.items():
+        path = os.path.join(str(root), rel.replace("/", os.sep))
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(src)
+    return Project.discover(str(root), ["daft_tpu"])
+
+
+def _findings(project, rule):
+    result = run_lint(project, ALL_RULES, {})
+    return [f for f in result.new if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# call-graph resolution
+# ---------------------------------------------------------------------------
+
+def test_callgraph_method_resolution_through_inheritance(tmp_path):
+    project = _tree(tmp_path, {"daft_tpu/m.py": (
+        "import time\n\n\n"
+        "class Base:\n"
+        "    def _flush(self):\n"
+        "        time.sleep(0.1)\n\n\n"
+        "class Derived(Base):\n"
+        "    def push(self):\n"
+        "        self._flush()\n")})
+    model = build_model(project)
+    info = model.block_info.get("daft_tpu/m.py::Derived.push")
+    assert info is not None, sorted(model.block_info)
+    assert info["via"] == "daft_tpu/m.py::Base._flush"
+    leaf = model.block_leaf("daft_tpu/m.py::Derived.push")
+    assert leaf["kind"] == "time.sleep"
+    assert leaf["qual"] == "Base._flush"
+
+
+def test_callgraph_closure_resolution(tmp_path):
+    project = _tree(tmp_path, {"daft_tpu/m.py": (
+        "import time\n\n\n"
+        "def outer():\n"
+        "    def inner():\n"
+        "        time.sleep(0.1)\n"
+        "    inner()\n")})
+    model = build_model(project)
+    assert "daft_tpu/m.py::outer.<locals>.inner" in model.functions
+    info = model.block_info.get("daft_tpu/m.py::outer")
+    assert info is not None and info["via"].endswith("<locals>.inner")
+
+
+def test_callgraph_decorated_method_resolution(tmp_path):
+    project = _tree(tmp_path, {"daft_tpu/m.py": (
+        "import time\n\n\n"
+        "def traced(fn):\n"
+        "    return fn\n\n\n"
+        "class Q:\n"
+        "    @traced\n"
+        "    def _drain(self):\n"
+        "        time.sleep(0.1)\n\n"
+        "    def flush(self):\n"
+        "        self._drain()\n")})
+    model = build_model(project)
+    info = model.block_info.get("daft_tpu/m.py::Q.flush")
+    assert info is not None
+    assert info["via"] == "daft_tpu/m.py::Q._drain"
+
+
+def test_callgraph_cross_file_from_import(tmp_path):
+    project = _tree(tmp_path, {
+        "daft_tpu/__init__.py": "",
+        "daft_tpu/a.py": ("import time\n\n\n"
+                          "def helper():\n"
+                          "    time.sleep(0.1)\n"),
+        "daft_tpu/b.py": ("from .a import helper\n\n\n"
+                          "def caller():\n"
+                          "    helper()\n")})
+    model = build_model(project)
+    info = model.block_info.get("daft_tpu/b.py::caller")
+    assert info is not None
+    assert info["via"] == "daft_tpu/a.py::helper"
+
+
+# ---------------------------------------------------------------------------
+# lock-order graph (DTL009)
+# ---------------------------------------------------------------------------
+
+_AB_BA = (
+    "import threading\n\n\n"
+    "class Exchange:\n"
+    "    def __init__(self):\n"
+    "        self._peers = threading.Lock()\n"
+    "        self._rounds = threading.Lock()\n"
+    "        self.stat = 0\n\n"
+    "    def publish(self):\n"
+    "        with self._peers:\n"
+    "            self._bump()\n\n"
+    "    def _bump(self):\n"
+    "        with self._rounds:\n"
+    "            self.stat = 1\n\n"
+    "    def retire(self):\n"
+    "        with self._rounds:\n"
+    "            with self._peers:\n"
+    "                self.stat = 2\n")
+
+
+def test_lock_order_cycle_detected_with_both_witnesses(tmp_path):
+    project = _tree(tmp_path, {"daft_tpu/m.py": _AB_BA})
+    edges = build_model(project).lock_edges()
+    assert ("Exchange._peers", "Exchange._rounds") in edges
+    assert ("Exchange._rounds", "Exchange._peers") in edges
+    found = _findings(project, "DTL009")
+    assert len(found) == 1, found
+    msg = found[0].message
+    assert "Exchange._peers" in msg and "Exchange._rounds" in msg
+    # both directions of the inversion are named in the one finding
+    assert "->" in msg
+
+
+def test_lock_order_consistent_order_is_clean(tmp_path):
+    src = _AB_BA.replace(
+        "    def retire(self):\n"
+        "        with self._rounds:\n"
+        "            with self._peers:\n",
+        "    def retire(self):\n"
+        "        with self._peers:\n"
+        "            with self._rounds:\n")
+    project = _tree(tmp_path, {"daft_tpu/m.py": src})
+    assert _findings(project, "DTL009") == []
+
+
+# ---------------------------------------------------------------------------
+# ledger balance (DTL011)
+# ---------------------------------------------------------------------------
+
+def test_ledger_try_finally_settle_is_clean(tmp_path):
+    project = _tree(tmp_path, {"daft_tpu/m.py": (
+        "class R:\n"
+        "    def __init__(self, ledger):\n"
+        "        self._ledger = ledger\n\n"
+        "    def inside(self, task, n):\n"
+        "        try:\n"
+        "            self._ledger.exec_started(n)\n"
+        "            return task()\n"
+        "        finally:\n"
+        "            self._ledger.exec_done(n)\n\n"
+        "    def charge_then_try(self, task, n):\n"
+        "        self._ledger.prefetch_started(n)\n"
+        "        handle = object()\n"
+        "        try:\n"
+        "            return task(handle)\n"
+        "        finally:\n"
+        "            self._ledger.prefetch_done(n)\n")})
+    assert _findings(project, "DTL011") == []
+
+
+def test_ledger_charge_without_settle_flags(tmp_path):
+    project = _tree(tmp_path, {"daft_tpu/m.py": (
+        "class R:\n"
+        "    def __init__(self, ledger):\n"
+        "        self._ledger = ledger\n\n"
+        "    def normal_path_only(self, task, n):\n"
+        "        self._ledger.exec_started(n)\n"
+        "        out = task()\n"
+        "        self._ledger.exec_done(n)\n"
+        "        return out\n\n"
+        "    def never(self, n):\n"
+        "        self._ledger.stream_started(n)\n"
+        "        return n\n")})
+    found = _findings(project, "DTL011")
+    msgs = sorted(f.message for f in found)
+    assert len(found) == 2, found
+    assert any("normal path only" in m for m in msgs), msgs
+    assert any("never settled" in m for m in msgs), msgs
+
+
+def test_ledger_escape_annotation_verified_and_stale(tmp_path):
+    body = (
+        "class R:\n"
+        "    def __init__(self, ledger):\n"
+        "        self._ledger = ledger\n\n"
+        "    def charge(self, n):\n"
+        "        # daftlint: ledger-escape settled-by={settler}\n"
+        "        self._ledger.exec_started(n)\n\n"
+        "    def on_done(self, n):\n"
+        "        self._ledger.exec_done(n)\n")
+    good = _tree(tmp_path / "good",
+                 {"daft_tpu/m.py": body.format(settler="on_done")})
+    assert _findings(good, "DTL011") == []
+    bad = _tree(tmp_path / "bad",
+                {"daft_tpu/m.py": body.format(settler="no_such_settle")})
+    found = _findings(bad, "DTL011")
+    assert len(found) == 1 and "stale" in found[0].message, found
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock whitelists (DTL010)
+# ---------------------------------------------------------------------------
+
+def test_condition_wait_under_own_lock_not_flagged(tmp_path):
+    """cond.wait() RELEASES the condition's lock while waiting — the
+    canonical producer/consumer shape must not count as blocking under
+    the lock it releases."""
+    project = _tree(tmp_path, {"daft_tpu/m.py": (
+        "import threading\n\n\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self.item = None\n\n"
+        "    def take(self):\n"
+        "        with self._cv:\n"
+        "            while self.item is None:\n"
+        "                self._cv.wait()\n"
+        "            out, self.item = self.item, None\n"
+        "            return out\n\n"
+        "    def put(self, item):\n"
+        "        with self._cv:\n"
+        "            self.item = item\n"
+        "            self._cv.notify()\n")})
+    assert _findings(project, "DTL010") == []
+
+
+def test_io_lock_annotation_exempts_dtl010(tmp_path):
+    project = _tree(tmp_path, {"daft_tpu/m.py": (
+        "import threading\n"
+        "import time\n\n\n"
+        "class Tx:\n"
+        "    def __init__(self):\n"
+        "        self._send_lock = threading.Lock()  "
+        "# daftlint: io-lock\n\n"
+        "    def send(self):\n"
+        "        with self._send_lock:\n"
+        "            time.sleep(0.1)\n")})
+    assert _findings(project, "DTL010") == []
+
+
+# ---------------------------------------------------------------------------
+# summary cache
+# ---------------------------------------------------------------------------
+
+_CACHED_FILES = {
+    "daft_tpu/one.py": ("def f():\n    return 1\n"),
+    "daft_tpu/two.py": ("import time\n\n\n"
+                        "def g():\n    time.sleep(0.1)\n"),
+}
+
+
+def test_summary_cache_hit_then_invalidate_on_edit(tmp_path):
+    cache_path = os.path.join(str(tmp_path), "cache.json")
+    project = _tree(tmp_path, _CACHED_FILES)
+    c1 = SummaryCache(cache_path)
+    build_model(project, cache=c1)
+    assert c1.misses == len(project.files) and c1.hits == 0
+
+    # warm: every file served from the cache
+    project2 = Project.discover(str(tmp_path), ["daft_tpu"])
+    c2 = SummaryCache(cache_path)
+    build_model(project2, cache=c2)
+    assert c2.hits == len(project2.files) and c2.misses == 0
+
+    # edit one file: exactly that summary is recomputed, and the model
+    # reflects the edit (one.py now blocks)
+    with open(os.path.join(str(tmp_path), "daft_tpu", "one.py"), "w") as f:
+        f.write("import time\n\n\ndef f():\n    time.sleep(0.1)\n")
+    project3 = Project.discover(str(tmp_path), ["daft_tpu"])
+    c3 = SummaryCache(cache_path)
+    model = build_model(project3, cache=c3)
+    assert c3.misses == 1 and c3.hits == len(project3.files) - 1
+    assert "daft_tpu/one.py::f" in model.block_info
+
+
+def test_summary_cache_version_stamp_invalidates(tmp_path):
+    cache_path = os.path.join(str(tmp_path), "cache.json")
+    project = _tree(tmp_path, _CACHED_FILES)
+    c1 = SummaryCache(cache_path)
+    build_model(project, cache=c1)
+    with open(cache_path) as f:
+        data = json.load(f)
+    assert data["interproc"] == INTERPROC_VERSION
+    data["interproc"] = INTERPROC_VERSION - 1
+    with open(cache_path, "w") as f:
+        json.dump(data, f)
+    stale = SummaryCache(cache_path)
+    src = project.source("daft_tpu/one.py")
+    assert stale.get("daft_tpu/one.py", source_digest(src)) is None
+
+
+def test_parallel_summarization_matches_serial(tmp_path):
+    project = _tree(tmp_path, _CACHED_FILES)
+    serial = build_model(project)
+    project2 = Project.discover(str(tmp_path), ["daft_tpu"])
+    parallel = build_model(project2, jobs=4)
+    assert serial.summaries == parallel.summaries
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+def _check_sarif(doc, expect_rule=None):
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "daftlint"
+    assert [r["id"] for r in driver["rules"]] == ALL_CODES
+    assert "PROJECTROOT" in run["originalUriBaseIds"]
+    rule_ids = set()
+    for res in run["results"]:
+        assert res["level"] in ("error", "warning")
+        assert driver["rules"][res["ruleIndex"]]["id"] == res["ruleId"]
+        assert res["message"]["text"]
+        (loc,) = res["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uriBaseId"] == "PROJECTROOT"
+        assert phys["region"]["startLine"] >= 1
+        rule_ids.add(res["ruleId"])
+    if expect_rule is not None:
+        assert expect_rule in rule_ids, rule_ids
+
+
+def test_render_sarif_real_tree_schema():
+    from tools.daftlint import load_baseline
+    project = Project.discover(_ROOT, ["daft_tpu"])
+    baseline = load_baseline(
+        os.path.join(_ROOT, "tools", "daftlint", "baseline.json"))
+    result = run_lint(project, ALL_RULES, baseline)
+    doc = json.loads(render_sarif(result, ALL_RULES, _ROOT))
+    _check_sarif(doc)
+    # baselined findings are carried as externally-suppressed results
+    (run,) = doc["runs"]
+    suppressed = [r for r in run["results"] if r.get("suppressions")]
+    assert len(suppressed) == len(result.baselined)
+    for res in suppressed:
+        assert res["suppressions"][0]["kind"] == "external"
+
+
+def test_cli_sarif_artifact_on_bad_tree(tmp_path):
+    root = str(tmp_path)
+    shutil.copytree(os.path.join(_ROOT, "daft_tpu"),
+                    os.path.join(root, "daft_tpu"))
+    shutil.copy(
+        os.path.join(_ROOT, "tests", "daftlint_fixtures",
+                     "bad_blocking_under_lock.py"),
+        os.path.join(root, "daft_tpu", "_fixture_bad_block.py"))
+    sarif_path = os.path.join(root, "out.sarif")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.daftlint", "--root", root,
+         "--no-cache", "--sarif", sarif_path],
+        cwd=_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    with open(sarif_path) as f:
+        doc = json.load(f)
+    _check_sarif(doc, expect_rule="DTL010")
+
+
+# ---------------------------------------------------------------------------
+# --changed-only
+# ---------------------------------------------------------------------------
+
+def _git(root, *argv):
+    subprocess.run(["git", *argv], cwd=root, check=True,
+                   capture_output=True, timeout=60)
+
+
+def test_cli_changed_only_scopes_to_dirty_files(tmp_path):
+    root = str(tmp_path)
+    shutil.copytree(os.path.join(_ROOT, "daft_tpu"),
+                    os.path.join(root, "daft_tpu"))
+    _git(root, "init", "-q")
+    _git(root, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-q", "--allow-empty", "-m", "seed")
+    _git(root, "add", "-A")
+    _git(root, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-q", "-m", "tree")
+
+    # clean checkout: nothing to lint, exit 0 without running rules
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.daftlint", "--root", root,
+         "--no-cache", "--changed-only"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no linted files changed" in proc.stdout
+
+    # an untracked bad file is in scope and fails the run
+    shutil.copy(
+        os.path.join(_ROOT, "tests", "daftlint_fixtures",
+                     "bad_thread_discipline.py"),
+        os.path.join(root, "daft_tpu", "_fixture_bad_thread.py"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.daftlint", "--root", root,
+         "--no-cache", "--changed-only", "--no-baseline"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "DTL012" in proc.stdout
+    # reporting is scoped: pre-existing (committed) files are not re-reported
+    assert "_fixture_bad_thread.py" in proc.stdout
+    for line in proc.stdout.splitlines():
+        if ": DTL" in line:
+            assert "_fixture_bad_thread.py" in line, line
+
+
+# ---------------------------------------------------------------------------
+# wall-time budget
+# ---------------------------------------------------------------------------
+
+def test_full_repo_lint_wall_time_budget():
+    """ISSUE acceptance: the full-repo lint (cold cache, all 12 rules)
+    finishes inside the 30s budget that keeps `make lint` viable."""
+    start = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.daftlint", "--no-cache", "--jobs",
+         "8"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=120)
+    elapsed = time.monotonic() - start
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 30.0, f"full-repo lint took {elapsed:.1f}s"

@@ -468,3 +468,86 @@ class TestTransportUnit:
         assert type(ctx.runner()) is DistributedRunner
         set_execution_config(distributed_workers=0)
         assert type(ctx.runner()) is NativeRunner
+
+
+class TestSpawnHandshakeHardening:
+    """Regression tests for the supervisor hardening that came with the
+    interprocedural lint pass: the handshake read carries its own
+    deadline (a client that connects to the shared listener and never
+    speaks can no longer wedge every subsequent spawn), and every
+    driver-side pool thread carries a daft- accounting prefix."""
+
+    def test_silent_client_does_not_wedge_respawn(self):
+        import socket as _socket
+
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=1,
+                            enable_result_cache=False)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        pool = sup.get_worker_pool(get_context().execution_config)
+        assert pool is not None
+        silent = _socket.create_connection(("127.0.0.1", pool._port))
+        try:
+            victim = pool.workers[0]
+            os.kill(victim.pid, signal.SIGKILL)
+            # the respawn's spawner accepts the silent connection first
+            # (it is ahead in the backlog); the per-read deadline must
+            # discard it and go on to the real worker's hello
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                snap = pool.snapshot()
+                if (snap["worker_losses_total"] >= 1
+                        and snap["workers_alive"] >= 1):
+                    break
+                time.sleep(0.1)
+            snap = pool.snapshot()
+            assert snap["worker_losses_total"] >= 1, snap
+            assert snap["workers_alive"] >= 1, snap
+            res = dt.from_pydict(_data(2000)).repartition(3).select(
+                (col("a") + 7).alias("c")).collect()
+            assert sorted(res.to_pydict()["c"]) == [
+                v + 7 for v in range(2000)]
+        finally:
+            try:
+                silent.close()
+            except OSError:
+                pass
+            sup.shutdown_worker_pool()
+
+    def test_driver_pool_threads_carry_inventory_prefixes(self):
+        sup.shutdown_worker_pool()
+        set_execution_config(distributed_workers=2,
+                            enable_result_cache=False)
+        _ = dt.from_pydict({"a": [1]}).select(col("a")).collect()
+        try:
+            names = {t.name for t in threading.enumerate()}
+            assert "daft-dist-supervisor" in names
+            assert any(n.startswith("daft-dist-rx-") for n in names)
+            from daft_tpu.serve.runtime import _ENGINE_THREAD_PREFIXES
+            strays = [n for n in names if n.startswith("daft-")
+                      and not n.startswith(tuple(_ENGINE_THREAD_PREFIXES))]
+            assert not strays, strays
+        finally:
+            sup.shutdown_worker_pool()
+
+    def test_worker_announce_thread_is_named_daemon(self):
+        """The worker's shuffle-plane announce thread (a real defect: it
+        was spawned bare) stays named and daemonized."""
+        import ast as _ast
+        import inspect
+
+        from daft_tpu.dist import worker as worker_mod
+
+        tree = _ast.parse(inspect.getsource(worker_mod))
+        announce = None
+        for node in _ast.walk(tree):
+            if not isinstance(node, _ast.Call):
+                continue
+            kwargs = {kw.arg: kw.value for kw in node.keywords}
+            name = kwargs.get("name")
+            if (isinstance(name, _ast.Constant)
+                    and name.value == "daft-dist-announce"):
+                announce = kwargs
+        assert announce is not None, "announce thread lost its name"
+        daemon = announce.get("daemon")
+        assert isinstance(daemon, _ast.Constant) and daemon.value is True

@@ -555,8 +555,38 @@ class TestShutdown:
             report = dt.shutdown(timeout_s=15)
             assert report["leaked_threads"] == 0
             assert leaked_thread_count() == 0
+            # inventory completeness: any engine thread still alive at
+            # this point must be visible to leak accounting — a daft-
+            # thread outside _ENGINE_THREAD_PREFIXES is a blind spot
+            from daft_tpu.serve.runtime import _ENGINE_THREAD_PREFIXES
+            strays = [t.name for t in threading.enumerate()
+                      if t.name.startswith("daft-")
+                      and not t.name.startswith(
+                          tuple(_ENGINE_THREAD_PREFIXES))]
+            assert not strays, strays
         finally:
             _restore_cfg(old)
+
+
+class TestThreadDiscipline:
+    """The thread-naming contract DTL012 enforces statically, pinned at
+    runtime: executor workers carry their accounting prefix, and the
+    prefix inventory names every engine subsystem."""
+
+    def test_executor_threads_carry_daft_serve_prefix(self):
+        pool = SharedExecutorPool(1)
+        try:
+            fut = pool.submit(
+                "q", lambda: threading.current_thread().name, (), {})
+            assert fut.result(10).startswith("daft-serve-exec")
+        finally:
+            pool.shutdown()
+
+    def test_engine_thread_inventory_names_every_subsystem(self):
+        from daft_tpu.serve.runtime import _ENGINE_THREAD_PREFIXES
+        assert set(_ENGINE_THREAD_PREFIXES) == {
+            "daft-serve", "daft-exec", "daft-actor", "daft-spill-writer",
+            "daft-dist", "daft-peer", "daft-mm"}
 
 
 # ---------------------------------------------------------------------------

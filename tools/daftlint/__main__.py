@@ -1,30 +1,58 @@
-"""CLI: ``python -m tools.daftlint [paths...] [--json] [--baseline FILE]``.
+"""CLI: ``python -m tools.daftlint [paths...] [--json] [--sarif FILE]
+[--changed-only] [--jobs N] [--baseline FILE]``.
 
 Exits 0 when the tree is clean (modulo baseline), 1 on new findings, 2 on
 usage errors. ``--write-baseline`` rewrites the baseline from the current
 findings (for grandfathering a just-added rule's backlog — each kept entry
 should gain a ``comment`` explaining why it stays).
+
+``--changed-only`` narrows per-file reporting to the git-dirty subset
+(unstaged + staged + untracked) while project-wide analyses (call graph,
+lock order, fault-site coverage) still see the whole tree — per-file
+summaries for unchanged files come from the content-hash cache, so the
+pre-commit path stays fast as the engine grows.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
-from typing import List
+from typing import List, Optional
 
-from .engine import (Project, load_baseline, render_json, render_text,
-                     run_lint, write_baseline)
+from .engine import (Project, load_baseline, render_json, render_sarif,
+                     render_text, run_lint, write_baseline)
+from .interproc import SummaryCache
 from .rules import ALL_RULES
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 
 
+def _git_changed_files(root: str) -> Optional[List[str]]:
+    """Repo-relative paths that differ from HEAD (worktree + index) plus
+    untracked files, or None when git is unavailable."""
+    out: List[str] = []
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "diff", "--name-only", "--cached"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if res.returncode != 0:
+            return None
+        out.extend(ln.strip() for ln in res.stdout.splitlines()
+                   if ln.strip())
+    return sorted(set(out))
+
+
 def main(argv: List[str] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="daftlint",
-        description="AST invariant lints for the daft_tpu engine "
-                    "(DTL001-DTL005)")
+        description="AST + interprocedural invariant lints for the "
+                    "daft_tpu engine (DTL001-DTL012)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="directories/files to lint, relative to --root "
                          "(default: daft_tpu)")
@@ -33,6 +61,21 @@ def main(argv: List[str] = None) -> int:
                          "tool)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the machine-readable JSON report")
+    ap.add_argument("--sarif", metavar="FILE", default=None,
+                    help="also write a SARIF 2.1.0 report to FILE "
+                         "('-' for stdout instead of the text report)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only on files changed vs git HEAD "
+                         "(project-wide analyses still see the whole "
+                         "tree); exits 0 when nothing relevant changed")
+    ap.add_argument("--jobs", type=int, default=0, metavar="N",
+                    help="parallel per-file summarization workers "
+                         "(0 = serial; 'auto' sizing is min(8, cpus))")
+    ap.add_argument("--cache", metavar="FILE", default=None,
+                    help="summary-cache path (default: "
+                         "<root>/.daftlint-cache.json)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the per-file summary cache")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file for grandfathered findings "
                          f"(default: {DEFAULT_BASELINE})")
@@ -65,6 +108,26 @@ def main(argv: List[str] = None) -> int:
         print(f"daftlint: no python files found under {root} "
               f"({', '.join(subdirs)})", file=sys.stderr)
         return 2
+
+    if not args.no_cache:
+        cache_path = args.cache or os.path.join(root,
+                                                ".daftlint-cache.json")
+        project.summary_cache = SummaryCache(cache_path)
+    if args.jobs:
+        project.summary_jobs = max(0, args.jobs)
+
+    if args.changed_only:
+        changed = _git_changed_files(root)
+        if changed is None:
+            print("daftlint: --changed-only needs git; linting the full "
+                  "tree", file=sys.stderr)
+        else:
+            project.focus(changed)
+            if not project.lint_files:
+                print("daftlint: no linted files changed vs HEAD "
+                      f"({len(project.files)} files tracked)")
+                return 0
+
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     result = run_lint(project, ALL_RULES, baseline)
 
@@ -80,10 +143,17 @@ def main(argv: List[str] = None) -> int:
               f"({len(result.findings)} finding(s))")
         return 0
 
-    if args.as_json:
-        print(render_json(result, ALL_RULES, root))
+    if args.sarif == "-":
+        print(render_sarif(result, ALL_RULES, root))
     else:
-        print(render_text(result, ALL_RULES))
+        if args.sarif:
+            with open(args.sarif, "w", encoding="utf-8") as f:
+                f.write(render_sarif(result, ALL_RULES, root))
+                f.write("\n")
+        if args.as_json:
+            print(render_json(result, ALL_RULES, root))
+        else:
+            print(render_text(result, ALL_RULES))
     return result.exit_code
 
 

@@ -72,9 +72,21 @@ class Project:
         self.root = os.path.abspath(root)
         self.files: List[str] = sorted(
             p.replace(os.sep, "/") for p in files)
+        # the focus set per-file rules REPORT on. Defaults to everything;
+        # --changed-only narrows it to the git-dirty subset while
+        # project-wide rules (call graph, lock order, fault coverage)
+        # still analyze all of `files` — summaries for unchanged files
+        # come from the content-hash cache, so the narrow run stays fast
+        self.lint_files: List[str] = self.files
         self._sources: Dict[str, str] = {}
         self._trees: Dict[str, Optional[ast.Module]] = {}
         self.parse_errors: List[Finding] = []
+
+    def focus(self, files: Sequence[str]) -> None:
+        """Narrow the reporting set (``--changed-only``). Unknown paths are
+        ignored so a deleted-but-still-dirty file can't crash the run."""
+        want = {p.replace(os.sep, "/") for p in files}
+        self.lint_files = [p for p in self.files if p in want]
 
     @classmethod
     def discover(cls, root: str,
@@ -212,14 +224,20 @@ class LintResult:
 def run_lint(project: Project, rules: Sequence[Rule],
              baseline: Optional[Dict[str, dict]] = None) -> LintResult:
     baseline = baseline or {}
-    # parse everything up front: a syntax-broken file must surface as
+    # parse the focus set up front: a syntax-broken file must surface as
     # DTL000 even when the rule set under run never touches its AST
-    for rel in project.files:
+    for rel in project.lint_files:
         project.tree(rel)
     raw: List[Finding] = []
     for rule in rules:
         raw.extend(rule.run(project))
     raw.extend(project.parse_errors)
+    if project.lint_files is not project.files:
+        # focused run (--changed-only): project-wide analyses still saw
+        # the whole tree, but findings are REPORTED only for the focus
+        # set — an unchanged file's backlog is the full run's business
+        focus = set(project.lint_files)
+        raw = [f for f in raw if f.path in focus]
     per_file: Dict[str, Dict[int, Set[str]]] = {}
     kept: List[Finding] = []
     suppressed = 0
@@ -278,6 +296,61 @@ def render_json(result: LintResult, rules: Sequence[Rule],
             "suppressed": result.suppressed_count,
         },
         "findings": [f.as_dict() for f in result.findings],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def render_sarif(result: LintResult, rules: Sequence[Rule],
+                 root: str) -> str:
+    """SARIF 2.1.0 (the interchange format CI annotators ingest). One run,
+    one result per finding; baselined findings carry an ``external``
+    suppression so viewers show them greyed-out rather than as regressions.
+    New findings are ``error`` level — they fail the run — baselined ones
+    ``warning``."""
+    by_code: Dict[str, int] = {}
+    rule_objs = []
+    for i, r in enumerate(rules):
+        by_code[r.code] = i
+        rule_objs.append({
+            "id": r.code,
+            "name": r.name,
+            "shortDescription": {"text": r.description},
+        })
+    results = []
+    for f in result.findings:
+        entry: dict = {
+            "ruleId": f.rule,
+            "level": "warning" if f.baselined else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "PROJECTROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        if f.rule in by_code:
+            entry["ruleIndex"] = by_code[f.rule]
+        if f.baselined:
+            entry["suppressions"] = [{"kind": "external"}]
+        results.append(entry)
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "daftlint",
+                "informationUri": "https://github.com/daft-tpu",
+                "rules": rule_objs,
+            }},
+            "originalUriBaseIds": {
+                "PROJECTROOT": {"uri": "file://" + os.path.abspath(root)
+                                + "/"},
+            },
+            "results": results,
+        }],
     }
     return json.dumps(doc, indent=2, sort_keys=True)
 

@@ -1,0 +1,1049 @@
+"""Shared interprocedural analysis engine for daftlint.
+
+DTL003/DTL009/DTL010 (and the cross-function half of DTL011) all need the
+same substrate: who calls whom, which locks a function acquires, and which
+blocking operations it can reach. This module builds that substrate ONCE
+per lint run and the rules query it.
+
+The pipeline:
+
+1. **Per-file summaries** (`summarize_file`). A pure-local pass over one
+   file's AST producing a JSON-able dict: every function (module
+   functions, methods, nested defs — each summarized separately under a
+   qualified name like ``WorkerPool._spawn`` or ``main.<locals>.reply``),
+   its lock acquisitions (``with self._lock:`` nesting recorded with the
+   locks already held), its direct blocking operations (socket IO,
+   ``Future.result``, ``queue.get``, ``subprocess``, ``time.sleep``,
+   thread joins, semaphore/barrier waits — each with the locks lexically
+   held), its call sites (with held locks and receiver shape), its
+   MemoryLedger charge/settle calls, plus the file's declared
+   synchronization objects (``self.X = threading.Lock()`` …), classes,
+   and imports. Because a summary depends only on the file's bytes it is
+   cached by content hash (`SummaryCache`) — ``--changed-only`` re-parses
+   only edited files.
+
+2. **The model** (`Model`). Joins the summaries: resolves lock
+   references to project-wide identities (``ClassName.attr`` for
+   instance locks — instances of one class are deliberately conflated,
+   the standard approximation for lock-order analysis — and
+   ``path::NAME`` for module/closure locks), resolves call sites through
+   a tiered scheme (self/cls method -> enclosing class then bases; bare
+   name -> nested def, same-module function, ``from``-import, unique
+   project function; ``obj.meth`` -> the unique class defining ``meth``,
+   with a generic-name blocklist so ``.get``/``.close``/… never create
+   false edges), and runs two fixpoints: ``may_block`` (can this
+   function reach a blocking operation, with a witness chain) and
+   ``transitive_locks`` (locks eventually acquired, with witnesses).
+
+3. **The lock-order graph** (`Model.lock_edges`). ``L -> M`` when some
+   function acquires M while holding L, directly or through calls.
+   DTL009 reports cycles; DTL010 reports blocking ops/calls whose held
+   set is non-empty. Locks declared with a ``# daftlint: io-lock``
+   comment are IO-serialization locks (held *by contract* across the one
+   stream they serialize, e.g. a per-socket ``send_lock``); DTL010
+   skips them, DTL009 still orders them.
+
+Nested ``def`` bodies are summarized with an EMPTY held-lock set (a
+closure defined under a lock usually runs later, on another thread — the
+opposite choice DTL002 makes lexically, deliberate here to avoid false
+blocking-under-lock findings), but their decorators and default
+arguments evaluate in the enclosing context and are scanned there.
+Lambda bodies are scanned in place (they may well run inline).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import Project, dotted_name
+
+# bump to invalidate every cached summary when the analyzer changes
+INTERPROC_VERSION = 1
+
+_LOCKISH = re.compile(r"lock|cond|mutex", re.IGNORECASE)
+_QUEUEISH = re.compile(r"queue|(^|_)q$", re.IGNORECASE)
+_THREADISH = re.compile(r"thread|(^|_)proc", re.IGNORECASE)
+_SEMISH = re.compile(r"sem|slots", re.IGNORECASE)
+
+IO_LOCK_MARK = re.compile(r"#\s*daftlint:\s*io-lock")
+
+# constructor last-segment -> declared kind, for `self.X = threading.Lock()`
+_DECL_KINDS = {
+    "Lock": "lock", "RLock": "lock", "Condition": "condition",
+    "Semaphore": "semaphore", "BoundedSemaphore": "semaphore",
+    "Event": "event", "Barrier": "barrier",
+    "Queue": "queue", "SimpleQueue": "queue", "LifoQueue": "queue",
+    "PriorityQueue": "queue",
+    "Thread": "thread", "Popen": "process",
+    "ThreadPoolExecutor": "executor", "ProcessPoolExecutor": "executor",
+}
+_LOCK_KINDS = {"lock", "condition"}          # participate in held sets
+_WAITABLE_KINDS = {"semaphore", "barrier", "event"}  # acquiring them blocks
+
+# attribute names too generic to resolve through "the unique class that
+# defines this method" — without this list, `self._pieces.get(...)` would
+# resolve to some project class's `get` and fabricate call edges
+GENERIC_METHODS = frozenset({
+    "get", "put", "pop", "popleft", "popitem", "close", "join", "start",
+    "stop", "run", "send", "recv", "wait", "acquire", "release", "notify",
+    "notify_all", "set", "clear", "get_nowait", "put_nowait", "items",
+    "keys", "values", "append", "appendleft", "extend", "add", "discard",
+    "remove", "update", "copy", "read", "write", "flush", "seek", "tell",
+    "result", "cancel", "done", "submit", "map", "shutdown", "poll",
+    "kill", "terminate", "encode", "decode", "strip", "split", "format",
+    "lower", "upper", "replace", "count", "index", "sort", "reverse",
+    "insert", "search", "match", "sub", "group", "setdefault", "name",
+    "exists", "mkdir", "touch", "snapshot", "check", "bump",
+    # stdlib logging.Logger methods that collide with project classes
+    # (py_logger.exception(...) must not resolve to QueryHandle.exception)
+    "exception", "log",
+})
+
+_SOCKET_METHODS = {"accept", "recv", "recv_into", "recvfrom", "sendall",
+                   "connect", "connect_ex", "makefile"}
+_SOCKISH = re.compile(r"sock|conn|cand|listener|peer", re.IGNORECASE)
+
+# MemoryLedger charge -> the settle method(s) that balance it
+LEDGER_PAIRS: Dict[str, Tuple[str, ...]] = {
+    "prefetch_started": ("prefetch_done",),
+    "stream_started": ("stream_done",),
+    "exec_started": ("exec_done",),
+    "dist_started": ("dist_done",),
+    "async_spill_started": ("async_spill_done", "async_spill_abandoned",
+                            "async_spill_failed"),
+}
+LEDGER_SETTLES = frozenset(m for ms in LEDGER_PAIRS.values() for m in ms)
+LEDGER_METHODS = frozenset(LEDGER_PAIRS) | LEDGER_SETTLES
+
+
+# ---------------------------------------------------------------------------
+# per-file summarization (pure function of one file's source)
+# ---------------------------------------------------------------------------
+
+def _recv_of(func: ast.Attribute) -> str:
+    """Receiver shape for an attribute call: 'self'/'cls', a dotted name
+    ('time', 'entry.ctx.ledger'), or '?' for computed receivers."""
+    base = func.value
+    d = dotted_name(base)
+    if d is not None:
+        return d
+    return "?"
+
+
+def _static_str_prefix(node: ast.AST) -> Optional[str]:
+    """The static leading text of a string literal or f-string, or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                out.append(part.value)
+            else:
+                break
+        return "".join(out)
+    return None
+
+
+class _FileSummarizer:
+    """One pass over one file. Produces the JSON-able file summary."""
+
+    def __init__(self, rel: str, source: str, tree: ast.Module):
+        self.rel = rel
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.types: Dict[str, str] = {}      # "class:C.X"/"module:X"/"local:q:X" -> kind
+        self.io_locks: List[str] = []        # resolved lock ids marked io-lock
+        self.classes: Dict[str, dict] = {}   # C -> {"methods": [...], "bases": [...]}
+        self.imports: Dict[str, str] = {}    # alias -> absolute module
+        self.from_imports: Dict[str, List[str]] = {}  # name -> [module, orig]
+        self.functions: Dict[str, dict] = {}  # qual -> function summary
+        parts = rel[:-3].split("/") if rel.endswith(".py") else rel.split("/")
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        self.module = ".".join(parts)
+        self.package = ".".join(parts[:-1])
+
+    def run(self) -> dict:
+        self._collect_decls(self.tree, cls=None, qual=None)
+        self._walk_module()
+        return {"path": self.rel, "types": self.types,
+                "io_locks": sorted(set(self.io_locks)),
+                "classes": self.classes, "imports": self.imports,
+                "from_imports": self.from_imports,
+                "functions": self.functions}
+
+    # ---- pass A: declarations (types, classes, imports) -------------------
+
+    def _collect_decls(self, node: ast.AST, cls: Optional[str],
+                       qual: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                bases = [dotted_name(b) or "" for b in child.bases]
+                self.classes.setdefault(child.name, {
+                    "methods": [], "bases": [b.split(".")[-1]
+                                             for b in bases if b]})
+                for item in child.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.classes[child.name]["methods"].append(item.name)
+                self._collect_decls(child, child.name, qual)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = (f"{qual}.<locals>.{child.name}" if qual
+                     else (f"{cls}.{child.name}" if cls else child.name))
+                self._collect_decls(child, cls, q)
+            elif isinstance(child, ast.Import):
+                for a in child.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(child, ast.ImportFrom):
+                base = child.module or ""
+                if child.level:
+                    up = self.package.split(".") if self.package else []
+                    up = up[: len(up) - (child.level - 1)]
+                    base = ".".join(up + ([child.module]
+                                          if child.module else []))
+                for a in child.names:
+                    self.from_imports[a.asname or a.name] = [base, a.name]
+                self._collect_decls(child, cls, qual)
+            else:
+                self._maybe_decl(child, cls, qual)
+                self._collect_decls(child, cls, qual)
+
+    def _maybe_decl(self, node: ast.AST, cls: Optional[str],
+                    qual: Optional[str]) -> None:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            return
+        value = node.value
+        if not isinstance(value, ast.Call):
+            return
+        ctor = dotted_name(value.func)
+        if ctor is None:
+            return
+        kind = _DECL_KINDS.get(ctor.split(".")[-1])
+        if kind is None:
+            return
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            key = lock_id = None
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self" and cls is not None):
+                key, lock_id = f"class:{cls}.{tgt.attr}", f"{cls}.{tgt.attr}"
+            elif isinstance(tgt, ast.Name):
+                if qual is None:
+                    key = f"module:{tgt.id}"
+                    lock_id = f"{self.rel}::{tgt.id}"
+                else:
+                    key = f"local:{qual}:{tgt.id}"
+                    lock_id = f"{self.rel}::{qual}.{tgt.id}"
+            if key is None:
+                continue
+            self.types[key] = kind
+            line = self.lines[node.lineno - 1] if (
+                0 < node.lineno <= len(self.lines)) else ""
+            if kind in _LOCK_KINDS and IO_LOCK_MARK.search(line):
+                self.io_locks.append(lock_id)
+
+    # ---- pass B: function walks ------------------------------------------
+
+    def _walk_module(self) -> None:
+        mod = self._new_fn("<module>", None, 1)
+        self._walk_stmts(self.tree.body, mod, cls=None, held=())
+        self.functions["<module>"] = mod
+
+    def _new_fn(self, qual: str, cls: Optional[str], line: int) -> dict:
+        name = qual.split("#")[0].split(".")[-1]
+        # top-level bare name, the grouping DTL003 keys its call graph by:
+        # "C.m" and "C.m.<locals>.g" both belong to top-level function "m"
+        head = qual.split(".<locals>.")[0].split("#")[0]
+        top = None if head == "<module>" else head.split(".")[-1]
+        return {"qual": qual, "name": name, "cls": cls, "top": top,
+                "line": line, "acquires": [], "blocking": [], "calls": [],
+                "ledger": [], "guard": False, "collectives": []}
+
+    def _unique_qual(self, qual: str) -> str:
+        if qual not in self.functions:
+            return qual
+        i = 2
+        while f"{qual}#{i}" in self.functions:
+            i += 1
+        return f"{qual}#{i}"
+
+    def _walk_fn(self, node: ast.AST, qual: str, cls: Optional[str]) -> None:
+        fsum = self._new_fn(qual, cls, node.lineno)
+        self.functions[qual] = fsum
+        self._walk_stmts(node.body, fsum, cls, held=())
+
+    def _walk_stmts(self, stmts: Sequence[ast.stmt], fsum: dict,
+                    cls: Optional[str], held: Tuple[str, ...]) -> None:
+        prev: Optional[ast.stmt] = None
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # decorators/defaults evaluate HERE, in the current context
+                for dec in stmt.decorator_list:
+                    self._scan_expr(dec, fsum, cls, held)
+                for d in list(stmt.args.defaults) + [
+                        d for d in stmt.args.kw_defaults if d is not None]:
+                    self._scan_expr(d, fsum, cls, held)
+                q = self._nested_qual(fsum, cls, stmt.name)
+                self._walk_fn(stmt, q, cls=None)
+            elif isinstance(stmt, ast.ClassDef):
+                for dec in stmt.decorator_list:
+                    self._scan_expr(dec, fsum, cls, held)
+                inner_cls = stmt.name
+                body_rest = []
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        if fsum["qual"] == "<module>":
+                            q = self._unique_qual(f"{inner_cls}.{item.name}")
+                        else:
+                            q = self._unique_qual(
+                                f"{fsum['qual']}.<locals>."
+                                f"{inner_cls}.{item.name}")
+                        for dec in item.decorator_list:
+                            self._scan_expr(dec, fsum, cls, held)
+                        self._walk_fn(item, q, cls=inner_cls)
+                    else:
+                        body_rest.append(item)
+                # non-method class-body statements execute at class
+                # creation time, i.e. in the current context
+                self._walk_stmts(body_rest, fsum, inner_cls, held)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in stmt.items:
+                    ref = self._sync_ref(item.context_expr, fsum, cls)
+                    if ref is not None:
+                        fsum["acquires"].append(
+                            {"ref": ref, "line": item.context_expr.lineno,
+                             "held": list(new_held), "try": False})
+                        new_held = new_held + (ref,)
+                    else:
+                        self._scan_expr(item.context_expr, fsum, cls, held)
+                self._walk_stmts(stmt.body, fsum, cls, new_held)
+            elif isinstance(stmt, ast.Try):
+                # the canonical explicit-hold idiom: `X.acquire()` as the
+                # last statement before `try: ... finally: X.release()` —
+                # treat the try body as running under X (DTL010 would
+                # otherwise be blind to non-`with` lock holds)
+                extra = self._finally_released(prev, stmt, fsum, cls)
+                if extra is None and stmt.body:
+                    # variant: the acquire is the try's FIRST statement
+                    extra = self._finally_released(stmt.body[0], stmt,
+                                                   fsum, cls)
+                h2 = held + ((extra,) if extra else ())
+                self._walk_stmts(stmt.body, fsum, cls, h2)
+                for h in stmt.handlers:
+                    self._walk_stmts(h.body, fsum, cls, h2)
+                self._walk_stmts(stmt.orelse, fsum, cls, h2)
+                self._walk_stmts(stmt.finalbody, fsum, cls, held)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._scan_expr(stmt.test, fsum, cls, held)
+                self._walk_stmts(stmt.body, fsum, cls, held)
+                self._walk_stmts(stmt.orelse, fsum, cls, held)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(stmt.iter, fsum, cls, held)
+                self._walk_stmts(stmt.body, fsum, cls, held)
+                self._walk_stmts(stmt.orelse, fsum, cls, held)
+            elif hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+                self._scan_expr(stmt.subject, fsum, cls, held)
+                for case in stmt.cases:
+                    self._walk_stmts(case.body, fsum, cls, held)
+            else:
+                self._scan_expr(stmt, fsum, cls, held)
+            prev = stmt
+
+    def _finally_released(self, prev: Optional[ast.stmt], try_stmt: ast.Try,
+                          fsum: dict, cls: Optional[str]) -> Optional[str]:
+        """The sync ref R when `prev` is `R.acquire()` and the try's
+        finally contains `R.release()` — the explicit-hold idiom."""
+        if (not isinstance(prev, ast.Expr)
+                or not isinstance(prev.value, ast.Call)):
+            return None
+        call = prev.value
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "acquire"):
+            return None
+        ref = self._sync_ref(call.func.value, fsum, cls)
+        if ref is None:
+            return None
+        for fin in try_stmt.finalbody:
+            for n in ast.walk(fin):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "release"
+                        and self._sync_ref(n.func.value, fsum, cls) == ref):
+                    return ref
+        return None
+
+    def _nested_qual(self, fsum: dict, cls: Optional[str],
+                     name: str) -> str:
+        if fsum["qual"] == "<module>":
+            return self._unique_qual(f"{cls}.{name}" if cls else name)
+        return self._unique_qual(f"{fsum['qual']}.<locals>.{name}")
+
+    # ---- expression scan: calls, blocking ops, locks, ledger --------------
+
+    def _scan_expr(self, node: ast.AST, fsum: dict, cls: Optional[str],
+                   held: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue  # statement walk owns these
+            self._scan_expr(child, fsum, cls, held)
+        if isinstance(node, ast.Call):
+            self._classify_call(node, fsum, cls, held)
+
+    def _sync_ref(self, expr: ast.AST, fsum: dict,
+                  cls: Optional[str]) -> Optional[str]:
+        """Raw reference string when `expr` names a synchronization object:
+        's:attr' (self.attr), 'n:name' (bare name), 'a:attr' (attr on some
+        other receiver). None when `expr` isn't lockish/declared."""
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            if cls is not None and (
+                    f"class:{cls}.{expr.attr}" in self.types
+                    or _LOCKISH.search(expr.attr)):
+                return f"s:{expr.attr}"
+            if _LOCKISH.search(expr.attr):
+                return f"s:{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if (f"module:{name}" in self.types or _LOCKISH.search(name)
+                    or self._local_type(fsum["qual"], name) is not None):
+                return f"n:{name}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            if _LOCKISH.search(expr.attr):
+                return f"a:{expr.attr}"
+            return None
+        return None
+
+    def _local_type(self, qual: str, name: str) -> Optional[str]:
+        """Declared kind for a function-local name, walking enclosing
+        function scopes (closures see outer locals)."""
+        parts = qual.split(".<locals>.")
+        while parts:
+            q = ".<locals>.".join(parts)
+            kind = self.types.get(f"local:{q}:{name}")
+            if kind is not None:
+                return kind
+            parts.pop()
+        return None
+
+    def _recv_kind(self, func: ast.Attribute, fsum: dict,
+                   cls: Optional[str]) -> Optional[str]:
+        """Declared kind of an attribute call's receiver, when the file
+        declares it (self.X / module X / local X / unique class attr is
+        resolved later at the model level)."""
+        base = func.value
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and cls is not None):
+            return self.types.get(f"class:{cls}.{base.attr}")
+        if isinstance(base, ast.Name):
+            k = self._local_type(fsum["qual"], base.id)
+            if k is not None:
+                return k
+            return self.types.get(f"module:{base.id}")
+        return None
+
+    def _classify_call(self, node: ast.Call, fsum: dict,
+                       cls: Optional[str], held: Tuple[str, ...]) -> None:
+        func = node.func
+        dotted = dotted_name(func)
+        line = node.lineno
+
+        # DTL003 facts: collectives and breaker guards
+        cname = _collective_call(node)
+        if cname is not None:
+            fsum["collectives"].append(
+                [cname, line, _has_axis(node)])
+        if isinstance(func, ast.Attribute) and func.attr == "allow":
+            fsum["guard"] = True
+
+        blocked = self._maybe_blocking(node, func, dotted, fsum, cls, held)
+        if blocked:
+            return
+
+        # ledger charge/settle calls (receiver checked by the rule)
+        if (isinstance(func, ast.Attribute)
+                and func.attr in LEDGER_METHODS):
+            fsum["ledger"].append({"meth": func.attr, "line": line})
+
+        # plain call site
+        if isinstance(func, ast.Name):
+            fsum["calls"].append({"name": func.id, "recv": "", "line": line,
+                                  "held": list(held)})
+        elif isinstance(func, ast.Attribute):
+            fsum["calls"].append({"name": func.attr,
+                                  "recv": _recv_of(func), "line": line,
+                                  "held": list(held)})
+
+    def _maybe_blocking(self, node: ast.Call, func: ast.AST,
+                        dotted: Optional[str], fsum: dict,
+                        cls: Optional[str],
+                        held: Tuple[str, ...]) -> bool:
+        """Record a direct blocking operation; True when classified."""
+
+        def block(kind: str, released: Optional[str] = None) -> bool:
+            fsum["blocking"].append(
+                {"kind": kind, "line": node.lineno, "held": list(held),
+                 "rel": released})
+            return True
+
+        if dotted == "time.sleep":
+            return block("time.sleep")
+        if dotted == "open":
+            return block("file io (open)")
+        if dotted in ("os.fsync", "os.read", "os.write"):
+            return block(f"file io ({dotted})")
+        if dotted is not None and dotted.startswith("subprocess."):
+            if dotted.split(".")[-1] in ("run", "call", "check_call",
+                                         "check_output", "Popen"):
+                return block(f"subprocess ({dotted})")
+        if dotted in ("select.select", "selectors.select"):
+            return block("select")
+        if not isinstance(func, ast.Attribute):
+            return False
+
+        attr, recv = func.attr, _recv_of(func)
+        recv_last = recv.split(".")[-1]
+        rkind = self._recv_kind(func, fsum, cls)
+
+        if attr in _SOCKET_METHODS:
+            return block(f"socket.{attr}")
+        if attr == "send" and _SOCKISH.search(recv_last):
+            return block("socket.send")
+        if attr == "communicate":
+            return block("subprocess (communicate)")
+        if attr == "result":
+            return block("future.result")
+        if attr in ("wait", "wait_for"):
+            # a Condition.wait on a HELD condition releases it for the
+            # duration — the whitelist is applied at the model level by
+            # matching `rel` against the resolved held set
+            rel = self._sync_ref(func.value, fsum, cls)
+            return block(f"wait ({recv}.{attr})", released=rel)
+        if attr == "get":
+            positional = [a for a in node.args
+                          if not isinstance(a, ast.Starred)]
+            nonblock = any(
+                kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in node.keywords)
+            if (not positional and not nonblock
+                    and (rkind == "queue" or _QUEUEISH.search(recv_last))):
+                return block("queue.get")
+            return False
+        if attr == "join":
+            if isinstance(func.value, ast.Constant):
+                return False  # ", ".join(...)
+            if recv in ("os.path", "posixpath", "STORAGE"):
+                return False
+            if (rkind in ("thread", "process", "executor")
+                    or _THREADISH.search(recv_last)
+                    or recv in ("t", "th")):
+                return block("thread.join")
+            return False
+        if attr == "acquire":
+            nonblock = any(isinstance(a, ast.Constant) and a.value is False
+                           for a in node.args) or any(
+                kw.arg == "blocking" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in node.keywords)
+            ref = self._sync_ref(func.value, fsum, cls)
+            if rkind == "semaphore" or (
+                    ref is None and _SEMISH.search(recv_last)):
+                if nonblock:
+                    return True  # try-acquire: neither blocking nor a lock
+                return block("semaphore.acquire")
+            if ref is not None:
+                # explicit lock acquisition: an ordering event, not a
+                # blocking op (DTL009's territory); held-ness past this
+                # statement is not tracked (flow-insensitive)
+                fsum["acquires"].append(
+                    {"ref": ref, "line": node.lineno, "held": list(held),
+                     "try": nonblock})
+                return True
+            return False
+        return False
+
+
+# DTL003's collective matchers live here so summaries carry the facts
+COLLECTIVES = {"all_to_all", "psum", "pmax", "pmin", "pmean", "all_gather",
+               "ppermute", "pshuffle", "pbroadcast", "psum_scatter"}
+_AXIS_KEYWORDS = {"axis_name", "axis"}
+
+
+def _collective_call(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if parts[-1] in COLLECTIVES and (
+            len(parts) == 1 or parts[-2] == "lax"
+            or parts[0] in ("jax", "lax")):
+        return name
+    return None
+
+
+def _has_axis(node: ast.Call) -> bool:
+    if len(node.args) >= 2:
+        return True
+    return any(kw.arg in _AXIS_KEYWORDS for kw in node.keywords)
+
+
+def summarize_file(rel: str, source: str,
+                   tree: Optional[ast.Module]) -> dict:
+    if tree is None:
+        return {"path": rel, "types": {}, "io_locks": [], "classes": {},
+                "imports": {}, "from_imports": {}, "functions": {}}
+    return _FileSummarizer(rel, source, tree).run()
+
+
+# ---------------------------------------------------------------------------
+# summary cache (content-hash keyed, used by --changed-only)
+# ---------------------------------------------------------------------------
+
+def source_digest(source: str) -> str:
+    return hashlib.sha1(source.encode("utf-8")).hexdigest()
+
+
+class SummaryCache:
+    """Per-file summaries keyed by content hash, persisted as one JSON
+    file. A version stamp invalidates everything when the analyzer's
+    summary shape changes."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._files: Dict[str, dict] = {}
+        self.hits = 0
+        self.misses = 0
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("interproc") == INTERPROC_VERSION:
+                self._files = data.get("files", {})
+        except (OSError, ValueError):
+            self._files = {}
+
+    def get(self, rel: str, digest: str) -> Optional[dict]:
+        entry = self._files.get(rel)
+        if entry is not None and entry.get("sha") == digest:
+            self.hits += 1
+            return entry["summary"]
+        self.misses += 1
+        return None
+
+    def put(self, rel: str, digest: str, summary: dict) -> None:
+        self._files[rel] = {"sha": digest, "summary": summary}
+
+    def save(self) -> None:
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"interproc": INTERPROC_VERSION,
+                           "files": self._files}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a cache that cannot persist is only a slower cache
+
+
+# ---------------------------------------------------------------------------
+# the joined model
+# ---------------------------------------------------------------------------
+
+class Model:
+    """Project-wide view over the per-file summaries. All resolution and
+    fixpoint state is computed eagerly in __init__ (the summaries are the
+    expensive part; the joins are linear)."""
+
+    def __init__(self, project: Project, summaries: Dict[str, dict]):
+        self.project = project
+        self.summaries = summaries
+        # indexes
+        self.functions: Dict[str, dict] = {}      # "rel::qual" -> fsum
+        self.file_of: Dict[str, str] = {}         # key -> rel
+        self.class_file: Dict[str, str] = {}
+        self.class_info: Dict[str, dict] = {}
+        self.attr_kind: Dict[str, str] = {}       # "C.attr" -> kind
+        self.attr_classes: Dict[str, List[str]] = {}   # sync attr -> classes
+        self.method_classes: Dict[str, List[str]] = {}  # meth -> classes
+        self.module_file: Dict[str, str] = {}
+        self.io_locks: Set[str] = set()
+        self.module_fns: Dict[str, List[str]] = {}  # bare -> [keys]
+        for rel in sorted(summaries):
+            s = summaries[rel]
+            mod = rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+            if mod.endswith(".__init__"):
+                mod = mod[: -len(".__init__")]
+            self.module_file[mod] = rel
+            for cname, info in s["classes"].items():
+                self.class_file.setdefault(cname, rel)
+                self.class_info.setdefault(cname, info)
+                for m in info["methods"]:
+                    self.method_classes.setdefault(m, [])
+                    if cname not in self.method_classes[m]:
+                        self.method_classes[m].append(cname)
+            for tkey, kind in s["types"].items():
+                if tkey.startswith("class:"):
+                    ca = tkey[len("class:"):]
+                    self.attr_kind.setdefault(ca, kind)
+                    attr = ca.split(".", 1)[1]
+                    self.attr_classes.setdefault(attr, [])
+                    cls = ca.split(".", 1)[0]
+                    if cls not in self.attr_classes[attr]:
+                        self.attr_classes[attr].append(cls)
+            self.io_locks.update(s["io_locks"])
+            for qual, fsum in s["functions"].items():
+                key = f"{rel}::{qual}"
+                self.functions[key] = fsum
+                self.file_of[key] = rel
+                if "." not in qual and qual != "<module>":
+                    self.module_fns.setdefault(qual, []).append(key)
+        self._resolve_cache: Dict[Tuple[str, str, str, Optional[str]],
+                                  Optional[Tuple[str, str]]] = {}
+        self._compute_flow()
+
+    # ---- lock reference resolution ---------------------------------------
+
+    def resolve_lock(self, ref: str, rel: str,
+                     fsum: dict) -> Optional[Tuple[str, str]]:
+        """(lock_id, kind) for a raw 's:'/'n:'/'a:' reference, or None.
+        kind is a declared kind, or 'lock' for lockish-named undeclareds."""
+        ck = (ref, rel, fsum["qual"], fsum["cls"])
+        if ck in self._resolve_cache:
+            return self._resolve_cache[ck]
+        out = self._resolve_lock_uncached(ref, rel, fsum)
+        self._resolve_cache[ck] = out
+        return out
+
+    def _resolve_lock_uncached(self, ref: str, rel: str,
+                               fsum: dict) -> Optional[Tuple[str, str]]:
+        tag, name = ref.split(":", 1)
+        s = self.summaries[rel]
+        if tag == "s":
+            cls = fsum["cls"]
+            if cls is None:
+                return None
+            c = cls
+            seen = set()
+            while c is not None and c not in seen:
+                seen.add(c)
+                kind = self.attr_kind.get(f"{c}.{name}")
+                if kind is not None:
+                    return f"{c}.{name}", kind
+                bases = self.class_info.get(c, {}).get("bases", [])
+                c = next((b for b in bases if b in self.class_info), None)
+            if _LOCKISH.search(name):
+                return f"{cls}.{name}", "lock"
+            return None
+        if tag == "n":
+            parts = fsum["qual"].split(".<locals>.")
+            while parts:
+                q = ".<locals>.".join(parts)
+                kind = s["types"].get(f"local:{q}:{name}")
+                if kind is not None:
+                    return f"{rel}::{q}.{name}", kind
+                parts.pop()
+            kind = s["types"].get(f"module:{name}")
+            if kind is not None:
+                return f"{rel}::{name}", kind
+            if _LOCKISH.search(name):
+                return f"{rel}::{name}", "lock"
+            return None
+        # tag == "a": attribute on a non-self receiver
+        classes = [c for c in self.attr_classes.get(name, [])
+                   if self.attr_kind.get(f"{c}.{name}") in
+                   (_LOCK_KINDS | _WAITABLE_KINDS)]
+        if len(classes) == 1:
+            c = classes[0]
+            return f"{c}.{name}", self.attr_kind[f"{c}.{name}"]
+        if classes:
+            return None  # ambiguous: resolving would conflate strangers
+        if _LOCKISH.search(name):
+            return f"?.{name}", "lock"
+        return None
+
+    def held_locks(self, refs: Sequence[str], rel: str,
+                   fsum: dict) -> List[str]:
+        """Resolved lock ids (lock/condition kinds only) for a held list."""
+        out = []
+        for ref in refs:
+            r = self.resolve_lock(ref, rel, fsum)
+            if r is not None and r[1] in _LOCK_KINDS and r[0] not in out:
+                out.append(r[0])
+        return out
+
+    # ---- call resolution --------------------------------------------------
+
+    def resolve_call(self, site: dict, rel: str,
+                     fsum: dict) -> Optional[str]:
+        """Function key for a call site, or None when unresolvable."""
+        name, recv = site["name"], site["recv"]
+        s = self.summaries[rel]
+        if recv in ("self", "cls"):
+            cls = fsum["cls"]
+            seen: Set[str] = set()
+            while cls is not None and cls not in seen:
+                seen.add(cls)
+                if name in self.class_info.get(cls, {}).get("methods", []):
+                    return f"{self.class_file[cls]}::{cls}.{name}"
+                bases = self.class_info.get(cls, {}).get("bases", [])
+                cls = next((b for b in bases if b in self.class_info), None)
+            return None
+        if recv == "":
+            # nested def in an enclosing scope
+            parts = fsum["qual"].split(".<locals>.")
+            while parts:
+                q = ".<locals>.".join(parts)
+                key = f"{rel}::{q}.<locals>.{name}"
+                if key in self.functions:
+                    return key
+                parts.pop()
+            if f"{rel}::{name}" in self.functions:
+                return f"{rel}::{name}"
+            fi = s["from_imports"].get(name)
+            if fi is not None:
+                target = self.module_file.get(fi[0])
+                if target is not None:
+                    key = f"{target}::{fi[1]}"
+                    if key in self.functions:
+                        return key
+                return None
+            cands = self.module_fns.get(name, [])
+            if len(cands) == 1:
+                return cands[0]
+            return None
+        if recv != "?":
+            first = recv.split(".")[0]
+            mod = s["imports"].get(first)
+            if mod is not None:
+                rest = recv.split(".")[1:]
+                target = self.module_file.get(".".join([mod] + rest))
+                if target is None and not rest:
+                    target = self.module_file.get(mod)
+                if target is not None:
+                    key = f"{target}::{name}"
+                    if key in self.functions:
+                        return key
+                return None
+        if name in GENERIC_METHODS:
+            return None
+        cands2 = self.method_classes.get(name, [])
+        if len(cands2) == 1:
+            c = cands2[0]
+            return f"{self.class_file[c]}::{c}.{name}"
+        return None
+
+    # ---- fixpoints: may_block and transitive lock acquisition -------------
+
+    def _compute_flow(self) -> None:
+        keys = sorted(self.functions)
+        self.block_info: Dict[str, dict] = {}
+        self.acq_locks: Dict[str, Dict[str, dict]] = {k: {} for k in keys}
+        resolved_calls: Dict[str, List[Tuple[str, dict]]] = {}
+        callers: Dict[str, List[str]] = {}
+        for key in keys:
+            fsum = self.functions[key]
+            rel = self.file_of[key]
+            sites = []
+            for site in fsum["calls"]:
+                g = self.resolve_call(site, rel, fsum)
+                if g is not None and g != key:
+                    sites.append((g, site))
+                    callers.setdefault(g, []).append(key)
+            resolved_calls[key] = sites
+            if fsum["blocking"]:
+                b = fsum["blocking"][0]
+                self.block_info[key] = {
+                    "kind": b["kind"], "line": b["line"],
+                    "qual": fsum["qual"], "path": rel, "via": None}
+            for acq in fsum["acquires"]:
+                if acq["try"]:
+                    continue
+                r = self.resolve_lock(acq["ref"], rel, fsum)
+                if r is not None and r[1] in _LOCK_KINDS:
+                    self.acq_locks[key].setdefault(
+                        r[0], {"line": acq["line"], "qual": fsum["qual"],
+                               "path": rel, "via": None})
+        self.resolved_calls = resolved_calls
+        # may_block fixpoint (reverse propagation along call edges)
+        work = sorted(self.block_info)
+        while work:
+            g = work.pop()
+            for f in callers.get(g, []):
+                if f in self.block_info:
+                    continue
+                line = next(s["line"] for (gg, s) in resolved_calls[f]
+                            if gg == g)
+                self.block_info[f] = {
+                    "kind": self.block_info[g]["kind"], "line": line,
+                    "qual": self.functions[f]["qual"],
+                    "path": self.file_of[f], "via": g}
+                work.append(f)
+        # transitive lock acquisition fixpoint
+        work = [k for k in keys if self.acq_locks[k]]
+        while work:
+            g = work.pop()
+            for f in callers.get(g, []):
+                changed = False
+                for lock, w in self.acq_locks[g].items():
+                    if lock in self.acq_locks[f]:
+                        continue
+                    line = next(s["line"] for (gg, s) in resolved_calls[f]
+                                if gg == g)
+                    self.acq_locks[f][lock] = {
+                        "line": line, "qual": self.functions[f]["qual"],
+                        "path": self.file_of[f], "via": g}
+                    changed = True
+                if changed:
+                    work.append(f)
+
+    def block_chain(self, key: str, limit: int = 8) -> str:
+        """Human chain 'f -> g -> leaf (kind)' for a may-block function."""
+        names = []
+        k: Optional[str] = key
+        seen: Set[str] = set()
+        while k is not None and k not in seen and len(names) < limit:
+            seen.add(k)
+            info = self.block_info.get(k)
+            if info is None:
+                break
+            names.append(self.functions[k]["qual"])
+            k = info["via"]
+        kind = self.block_info[key]["kind"]
+        return " -> ".join(names) + f" [{kind}]"
+
+    def block_leaf(self, key: str) -> dict:
+        """The terminal (directly-blocking) function's info for a
+        may-block function — kind and qual of the actual blocking site."""
+        k = key
+        seen: Set[str] = set()
+        while k not in seen:
+            seen.add(k)
+            info = self.block_info[k]
+            if info["via"] is None:
+                return info
+            k = info["via"]
+        return self.block_info[key]
+
+    def acq_chain(self, key: str, lock: str, limit: int = 8) -> str:
+        names = []
+        k: Optional[str] = key
+        seen: Set[str] = set()
+        while k is not None and k not in seen and len(names) < limit:
+            seen.add(k)
+            w = self.acq_locks.get(k, {}).get(lock)
+            if w is None:
+                break
+            names.append(self.functions[k]["qual"])
+            k = w["via"]
+        return " -> ".join(names)
+
+    # ---- the lock-order graph --------------------------------------------
+
+    def lock_edges(self) -> Dict[Tuple[str, str], dict]:
+        """(L, M) -> witness for every 'M acquired while L held' fact,
+        direct or through calls. Self-edges are dropped: instances of one
+        class share a lock id, so L->L is usually two objects."""
+        edges: Dict[Tuple[str, str], dict] = {}
+
+        def add(L: str, M: str, witness: dict) -> None:
+            if L == M:
+                return
+            edges.setdefault((L, M), witness)
+
+        for key in sorted(self.functions):
+            fsum = self.functions[key]
+            rel = self.file_of[key]
+            for acq in fsum["acquires"]:
+                if acq["try"]:
+                    continue
+                r = self.resolve_lock(acq["ref"], rel, fsum)
+                if r is None or r[1] not in _LOCK_KINDS:
+                    continue
+                for L in self.held_locks(acq["held"], rel, fsum):
+                    add(L, r[0], {"qual": fsum["qual"], "path": rel,
+                                  "line": acq["line"], "chain": None})
+            for g, site in self.resolved_calls[key]:
+                held = self.held_locks(site["held"], rel, fsum)
+                if not held:
+                    continue
+                for M in self.acq_locks.get(g, {}):
+                    for L in held:
+                        add(L, M, {"qual": fsum["qual"], "path": rel,
+                                   "line": site["line"],
+                                   "chain": self.acq_chain(g, M)})
+        return edges
+
+
+# ---------------------------------------------------------------------------
+# model construction (cached per Project instance)
+# ---------------------------------------------------------------------------
+
+_MODELS: "weakref.WeakKeyDictionary[Project, Model]" = (
+    weakref.WeakKeyDictionary())
+
+
+def build_model(project: Project, cache: Optional[SummaryCache] = None,
+                jobs: int = 0) -> Model:
+    """Summarize every project file (cache-aware, optionally parallel) and
+    join. `jobs` <= 1 means serial."""
+    summaries: Dict[str, dict] = {}
+    # read all sources up front (cheap, and keeps worker threads read-only
+    # with respect to the Project's caches)
+    sources = {rel: project.source(rel) for rel in project.files}
+
+    def one(rel: str) -> Tuple[str, dict]:
+        src = sources[rel]
+        digest = source_digest(src)
+        if cache is not None:
+            hit = cache.get(rel, digest)
+            if hit is not None:
+                return rel, hit
+        tree = project._trees.get(rel)
+        if tree is None and rel not in project._trees:
+            try:
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError:
+                tree = None
+        summary = summarize_file(rel, src, tree)
+        if cache is not None:
+            cache.put(rel, digest, summary)
+        return rel, summary
+
+    if jobs and jobs > 1 and len(project.files) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(
+                max_workers=jobs,
+                thread_name_prefix="daftlint-summarize") as ex:
+            for rel, summary in ex.map(one, project.files):
+                summaries[rel] = summary
+    else:
+        for rel in project.files:
+            summaries[rel] = one(rel)[1]
+    if cache is not None:
+        cache.save()
+    return Model(project, summaries)
+
+
+def model_for(project: Project) -> Model:
+    """The shared Model for this Project, built on first use. The CLI can
+    preconfigure caching/parallelism by setting `project.summary_cache`
+    (a SummaryCache) and `project.summary_jobs` (int) before rules run."""
+    model = _MODELS.get(project)
+    if model is None:
+        model = build_model(project,
+                            cache=getattr(project, "summary_cache", None),
+                            jobs=getattr(project, "summary_jobs", 0))
+        _MODELS[project] = model
+    return model

@@ -8,6 +8,10 @@ from .error_hygiene import ErrorHygieneRule
 from .span_coverage import SpanCoverageRule
 from .log_hygiene import LogHygieneRule
 from .ambient_state import AmbientStateRule
+from .lock_order import LockOrderRule
+from .blocking_under_lock import BlockingUnderLockRule
+from .ledger_balance import LedgerBalanceRule
+from .thread_discipline import ThreadDisciplineRule
 
 ALL_RULES = [
     JitPurityRule(),
@@ -18,6 +22,10 @@ ALL_RULES = [
     SpanCoverageRule(),
     LogHygieneRule(),
     AmbientStateRule(),
+    LockOrderRule(),
+    BlockingUnderLockRule(),
+    LedgerBalanceRule(),
+    ThreadDisciplineRule(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in ALL_RULES}
@@ -25,4 +33,6 @@ RULES_BY_CODE = {r.code: r for r in ALL_RULES}
 __all__ = ["ALL_RULES", "RULES_BY_CODE", "JitPurityRule",
            "LockDisciplineRule", "CollectiveSafetyRule",
            "FaultSiteCoverageRule", "ErrorHygieneRule", "SpanCoverageRule",
-           "LogHygieneRule", "AmbientStateRule"]
+           "LogHygieneRule", "AmbientStateRule", "LockOrderRule",
+           "BlockingUnderLockRule", "LedgerBalanceRule",
+           "ThreadDisciplineRule"]

@@ -1,0 +1,97 @@
+"""DTL010 blocking-under-lock: no blocking operation is reachable while
+an engine state lock is held.
+
+A thread that blocks while holding a lock stalls every other thread
+needing that lock — the PR 16 class of bug (a wedged rx thread holding
+supervisor state, a handshake recv with no deadline serializing all
+spawns). The shared interprocedural model supplies, per function, the
+blocking operations it performs directly and the calls it makes, each
+with the locks lexically held; a fixpoint marks functions that can reach
+a blocking operation through any call chain.
+
+Blocking operations: socket accept/recv/send/connect, file IO,
+``Future.result``, ``queue.get``, ``subprocess``, ``time.sleep``,
+thread/process joins, and semaphore/barrier/event waits.
+
+Two whitelists, both part of the rule's contract:
+
+- **Condition waits.** ``cond.wait()``/``wait_for()`` RELEASES the
+  condition's lock for the duration, so waiting on a held condition is
+  not blocking *under that condition* (it still counts against any other
+  lock held at the same time — and a function containing a cond-wait is
+  still blocking from its CALLERS' perspective, since their locks are
+  not released).
+- **IO-serialization locks.** A lock whose declaration carries
+  ``# daftlint: io-lock`` exists to serialize one IO stream (a
+  per-socket ``send_lock``, a collective round lock) and is held across
+  that IO *by contract*. Such locks are exempt here but still ordered by
+  DTL009 — acquiring a state lock while holding an io-lock remains a
+  finding there.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..engine import Finding, Project, Rule
+from ..interproc import _WAITABLE_KINDS, model_for
+
+
+class BlockingUnderLockRule(Rule):
+    code = "DTL010"
+    name = "blocking-under-lock"
+    description = ("no blocking call (socket/file IO, future/queue/"
+                   "subprocess waits, sleeps) may be reachable while "
+                   "holding an engine lock")
+
+    def run(self, project: Project) -> List[Finding]:
+        model = model_for(project)
+        out: List[Finding] = []
+        for key in sorted(model.functions):
+            fsum = model.functions[key]
+            rel = model.file_of[key]
+
+            def held_minus_io(raw_refs):
+                return [h for h in model.held_locks(raw_refs, rel, fsum)
+                        if h not in model.io_locks]
+
+            for b in fsum["blocking"]:
+                held = held_minus_io(b["held"])
+                if b.get("rel"):
+                    r = model.resolve_lock(b["rel"], rel, fsum)
+                    if r is not None:
+                        # the cond-wait whitelist: the wait releases the
+                        # very lock it waits on
+                        held = [h for h in held if h != r[0]]
+                for lock in held:
+                    out.append(self.finding(
+                        rel, b["line"],
+                        f"blocking `{b['kind']}` in `{fsum['qual']}` "
+                        f"while holding `{lock}`"))
+            for acq in fsum["acquires"]:
+                # with/acquire on a semaphore, barrier or event is itself
+                # a wait (they are not locks, so they are not in held sets)
+                r = model.resolve_lock(acq["ref"], rel, fsum)
+                if r is None or r[1] not in _WAITABLE_KINDS or acq["try"]:
+                    continue
+                for lock in held_minus_io(acq["held"]):
+                    out.append(self.finding(
+                        rel, acq["line"],
+                        f"blocking `{r[1]} acquire ({r[0]})` in "
+                        f"`{fsum['qual']}` while holding `{lock}`"))
+            for gkey, site in model.resolved_calls[key]:
+                info = model.block_info.get(gkey)
+                if info is None:
+                    continue
+                held = held_minus_io(site["held"])
+                if not held:
+                    continue
+                g = model.functions[gkey]
+                leaf = model.block_leaf(gkey)
+                for lock in held:
+                    out.append(self.finding(
+                        rel, site["line"],
+                        f"call to `{g['qual']}` from `{fsum['qual']}` may "
+                        f"block ({leaf['kind']} in `{leaf['qual']}`) "
+                        f"while holding `{lock}`"))
+        return out

@@ -20,58 +20,23 @@ Two checks:
    callers is a finding (nothing stops a future caller skipping the
    breaker). Calls between functions within the same collectives module are
    exempt (that module IS the primitive layer).
+
+Since the interprocedural engine landed, the raw facts (collective calls,
+guard calls, call sites, top-level grouping) come from the shared
+per-file summaries (tools/daftlint/interproc.py) instead of a private
+AST walk — the semantics above are unchanged, and the name-keyed
+deliberately-coarse `safe()` fixpoint is kept verbatim: DTL003's
+contract is "every same-named caller anywhere must be guarded", stricter
+on purpose than the model's resolved call graph.
 """
 
 from __future__ import annotations
 
-import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..engine import Finding, Project, Rule, dotted_name
-
-COLLECTIVES = {"all_to_all", "psum", "pmax", "pmin", "pmean", "all_gather",
-               "ppermute", "pshuffle", "pbroadcast", "psum_scatter"}
-_AXIS_KEYWORDS = {"axis_name", "axis"}
-
-
-def _collective_call(node: ast.Call) -> Optional[str]:
-    name = dotted_name(node.func)
-    if name is None:
-        return None
-    parts = name.split(".")
-    if parts[-1] in COLLECTIVES and (
-            len(parts) == 1 or parts[-2] == "lax" or parts[0] in ("jax", "lax")):
-        return name
-    return None
-
-
-def _has_axis(node: ast.Call) -> bool:
-    if len(node.args) >= 2:
-        return True
-    return any(kw.arg in _AXIS_KEYWORDS for kw in node.keywords)
-
-
-def _top_level_functions(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
-    """(qualified name, node) for module functions and class methods."""
-    out: List[Tuple[str, ast.AST]] = []
-    for stmt in tree.body:
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out.append((stmt.name, stmt))
-        elif isinstance(stmt, ast.ClassDef):
-            for item in stmt.body:
-                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    out.append((item.name, item))
-    return out
-
-
-def _contains_guard(fn: ast.AST) -> bool:
-    """Does the function body call `<something>.allow(...)` (the breaker)?"""
-    for node in ast.walk(fn):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "allow"):
-            return True
-    return False
+from ..engine import Finding, Project, Rule
+from ..interproc import (COLLECTIVES, _collective_call, _has_axis,  # noqa: F401
+                         model_for)
 
 
 class CollectiveSafetyRule(Rule):
@@ -81,6 +46,7 @@ class CollectiveSafetyRule(Rule):
                    "reachable only via breaker-guarded wrappers")
 
     def run(self, project: Project) -> List[Finding]:
+        model = model_for(project)
         out: List[Finding] = []
         parallel_files = [r for r in project.files
                           if "parallel" in r.split("/")[:-1]]
@@ -88,53 +54,38 @@ class CollectiveSafetyRule(Rule):
         # -- check 1: axis named, and find bearing top-level functions
         bearing: Dict[str, str] = {}  # fn name -> defining file
         for rel in parallel_files:
-            tree = project.tree(rel)
-            if tree is None:
+            s = model.summaries.get(rel)
+            if s is None:
                 continue
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Call):
-                    cname = _collective_call(node)
-                    if cname is not None and not _has_axis(node):
+            for fsum in s["functions"].values():
+                for cname, line, has_axis in fsum["collectives"]:
+                    if not has_axis:
                         out.append(self.finding(
-                            rel, node.lineno,
+                            rel, line,
                             f"collective `{cname}` without an explicit "
                             "axis_name"))
-            for fname, fn in _top_level_functions(tree):
-                if any(isinstance(n, ast.Call) and _collective_call(n)
-                       for n in ast.walk(fn)):
-                    bearing[fname] = rel
+                if fsum["collectives"] and fsum["top"] is not None:
+                    bearing[fsum["top"]] = rel
         if not bearing:
             return out
 
         # -- check 2: every call to a bearing function is breaker-guarded.
-        # Build a project-wide name-keyed call graph over top-level functions.
+        # Name-keyed call graph over top-level functions, from summaries.
         guarded: Set[str] = set()
         call_sites: Dict[str, List[Tuple[str, Optional[str], int]]] = {}
         #   callee name -> [(file, enclosing top-level fn name or None, line)]
         for rel in project.files:
-            tree = project.tree(rel)
-            if tree is None:
+            s = model.summaries.get(rel)
+            if s is None:
                 continue
-            fns = _top_level_functions(tree)
-            for fname, fn in fns:
-                if _contains_guard(fn):
-                    guarded.add(fname)
-                for node in ast.walk(fn):
-                    if isinstance(node, ast.Call):
-                        callee = self._callee_name(node)
-                        if callee is not None:
-                            call_sites.setdefault(callee, []).append(
-                                (rel, fname, node.lineno))
-            # module-level call sites (outside any function)
-            in_fn = set()
-            for _fname, fn in fns:
-                in_fn.update(id(n) for n in ast.walk(fn))
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Call) and id(node) not in in_fn:
-                    callee = self._callee_name(node)
-                    if callee is not None:
-                        call_sites.setdefault(callee, []).append(
-                            (rel, None, node.lineno))
+            for fsum in s["functions"].values():
+                if fsum["guard"] and fsum["top"] is not None:
+                    guarded.add(fsum["top"])
+                for site in fsum["calls"]:
+                    if site["recv"] == "?":
+                        continue  # computed receiver: never a name match
+                    call_sites.setdefault(site["name"], []).append(
+                        (rel, fsum["top"], site["line"]))
 
         safe_memo: Dict[str, bool] = {}
 
@@ -169,10 +120,3 @@ class CollectiveSafetyRule(Rule):
                         "is not reachable through a breaker-guarded wrapper "
                         "(.allow() gate)"))
         return out
-
-    @staticmethod
-    def _callee_name(node: ast.Call) -> Optional[str]:
-        name = dotted_name(node.func)
-        if name is None:
-            return None
-        return name.split(".")[-1]

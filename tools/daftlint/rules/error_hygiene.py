@@ -104,7 +104,7 @@ class ErrorHygieneRule(Rule):
     def run(self, project: Project) -> List[Finding]:
         out: List[Finding] = []
         migrated = set(MIGRATED)
-        for rel in project.files:
+        for rel in project.lint_files:
             if rel not in migrated and MIGRATED_MARKER not in project.source(rel):
                 continue
             tree = project.tree(rel)

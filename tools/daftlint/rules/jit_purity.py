@@ -69,7 +69,7 @@ class JitPurityRule(Rule):
 
     def run(self, project: Project) -> List[Finding]:
         out: List[Finding] = []
-        for rel in project.files:
+        for rel in project.lint_files:
             segs = rel.split("/")[:-1]
             if ("kernels" not in segs and "parallel" not in segs
                     and "fuse" not in segs):

@@ -1,0 +1,268 @@
+"""DTL011 ledger-balance: every MemoryLedger charge to an inflight
+account must reach a settle on ALL exits, including exception paths.
+
+The charge/settle discipline (``exec_started``/``exec_done``,
+``prefetch_started``/``prefetch_done``, …) is the engine's admission
+accounting: an unsettled charge permanently shrinks the budget and
+eventually wedges admission. PRs 9–16 each re-fixed a leak of this shape
+by hand; this rule pins the discipline.
+
+Flow-sensitive per function. A charge is balanced when one of:
+
+- it sits inside a ``try`` whose ``finally`` performs a matching settle
+  (the canonical idiom);
+- the next statement is such a ``try`` (simple statements — assignments,
+  bare expressions — may sit between the charge and the ``try``: they
+  cannot transfer control);
+- the charge line (or the comment line above) carries a cross-function
+  escape annotation ``# daftlint: ledger-escape settled-by=f,g`` naming
+  the function(s) that settle it — a done-callback, a worker-thread
+  body, a drain path. The annotation is VERIFIED against the
+  interprocedural model: every named function must exist and must call a
+  matching settle, so a renamed or gutted settle path breaks the lint
+  run instead of silently leaking.
+
+Otherwise the rule distinguishes two failures: a settle later in the
+same function on the fallthrough path only ("an exception between charge
+and settle leaks the account") versus no settle at all.
+
+The ``MemoryLedger`` class itself is exempt (its methods ARE the
+accounting), as are parent-forwarding calls (``self._parent.X_started``
+inside the ledger's own forwarding protocol). The ``cache`` account uses
+a signed-delta API (``add``/``sub``) rather than a charge/settle pair
+and is covered by its clamp logic at runtime, not by this rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..engine import Finding, Project, Rule
+from ..interproc import LEDGER_PAIRS, model_for
+
+ESCAPE_RE = re.compile(
+    r"#\s*daftlint:\s*ledger-escape\s+settled-by=([A-Za-z0-9_.,\s]+)")
+
+# statements that cannot transfer control between a charge and its try
+_SIMPLE_STMTS = (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr,
+                 ast.Pass, ast.Import, ast.ImportFrom, ast.Global,
+                 ast.Nonlocal, ast.Delete)
+
+
+def _calls_in(node: ast.AST) -> List[ast.Call]:
+    """Calls within a statement, NOT descending into nested function or
+    class bodies (those are analyzed as their own scopes)."""
+    out: List[ast.Call] = []
+    stack: List[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        if n is not node and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _charge_of(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(method, receiver) when `call` is a ledger charge, else None."""
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in LEDGER_PAIRS):
+        base = call.func.value
+        recv = ""
+        if isinstance(base, ast.Attribute):
+            recv = base.attr
+        elif isinstance(base, ast.Name):
+            recv = base.id
+        return call.func.attr, recv
+    return None
+
+
+def _settles_in(stmts: Sequence[ast.stmt], accepted: Set[str]) -> bool:
+    for stmt in stmts:
+        for call in _calls_in(stmt):
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr in accepted):
+                return True
+    return False
+
+
+class LedgerBalanceRule(Rule):
+    code = "DTL011"
+    name = "ledger-balance"
+    description = ("every MemoryLedger charge (*_started) must reach a "
+                   "matching settle on all exits including exception "
+                   "paths, or carry a verified ledger-escape annotation")
+
+    def run(self, project: Project) -> List[Finding]:
+        model = model_for(project)
+        out: List[Finding] = []
+        for rel in project.lint_files:
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            lines = project.source(rel).splitlines()
+            self._walk(tree.body, rel, lines, cls=None, model=model,
+                       out=out)
+        return out
+
+    # ---- scope walk -------------------------------------------------------
+
+    def _walk(self, stmts: Sequence[ast.stmt], rel: str, lines: List[str],
+              cls: Optional[str], model, out: List[Finding]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.ClassDef):
+                # the ledger implementation is the accounting, not a user
+                if stmt.name != "MemoryLedger":
+                    self._walk(stmt.body, rel, lines, stmt.name, model, out)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_fn(stmt, rel, lines, model, out)
+                self._walk(stmt.body, rel, lines, None, model, out)
+            elif isinstance(stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                                   ast.With, ast.AsyncWith, ast.Try)):
+                for body in self._bodies(stmt):
+                    self._walk(body, rel, lines, cls, model, out)
+
+    @staticmethod
+    def _bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+        bodies = [getattr(stmt, "body", [])]
+        bodies.append(getattr(stmt, "orelse", []))
+        if isinstance(stmt, ast.Try):
+            bodies.append(stmt.finalbody)
+            for h in stmt.handlers:
+                bodies.append(h.body)
+        return bodies
+
+    # ---- one function -----------------------------------------------------
+
+    def _check_fn(self, fn: ast.AST, rel: str, lines: List[str],
+                  model, out: List[Finding]) -> None:
+        self._scan(fn, fn.body, rel, lines, frozenset(), model, out)
+
+    def _scan(self, fn: ast.AST, stmts: Sequence[ast.stmt], rel: str,
+              lines: List[str], fin_settles: frozenset,
+              model, out: List[Finding]) -> None:
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, checked by _walk
+            # compound statements: scan only the header expressions here
+            # (their bodies are recursed into below — scanning the whole
+            # subtree at every level would report nested charges once per
+            # enclosing block)
+            if isinstance(stmt, (ast.If, ast.While)):
+                headers: List[ast.AST] = [stmt.test]
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                headers = [stmt.iter]
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                headers = [item.context_expr for item in stmt.items]
+            elif isinstance(stmt, ast.Try):
+                headers = []
+            elif hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+                headers = [stmt.subject]
+            else:
+                headers = [stmt]
+            for call in [c for h in headers for c in _calls_in(h)]:
+                ch = _charge_of(call)
+                if ch is None:
+                    continue
+                meth, recv = ch
+                if recv == "_parent":
+                    continue  # ledger-internal forwarding
+                accepted = set(LEDGER_PAIRS[meth])
+                if accepted & fin_settles:
+                    continue  # inside try with a settling finally
+                if self._escape_ok(call, meth, accepted, rel, lines,
+                                   model, out):
+                    continue
+                if self._next_try_settles(stmts, i, accepted):
+                    continue
+                if _settles_in(fn.body, accepted):
+                    out.append(self.finding(
+                        rel, call.lineno,
+                        f"`{meth}` charge is settled on the normal path "
+                        f"only — an exception between charge and settle "
+                        f"leaks the account (wrap in try/finally or "
+                        f"annotate `# daftlint: ledger-escape "
+                        f"settled-by=...`)"))
+                else:
+                    out.append(self.finding(
+                        rel, call.lineno,
+                        f"`{meth}` charge is never settled in this "
+                        f"function (no "
+                        f"{'/'.join(sorted(accepted))} on any path; "
+                        f"annotate `# daftlint: ledger-escape "
+                        f"settled-by=...` if another function settles "
+                        f"it)"))
+            # descend, extending the finally-settle context through trys
+            if isinstance(stmt, ast.Try):
+                f2 = fin_settles
+                found = {s.func.attr
+                         for fin in stmt.finalbody
+                         for s in _calls_in(fin)
+                         if isinstance(s.func, ast.Attribute)}
+                f2 = fin_settles | frozenset(found)
+                for body in (stmt.body, stmt.orelse,
+                             *[h.body for h in stmt.handlers]):
+                    self._scan(fn, body, rel, lines, f2, model, out)
+                self._scan(fn, stmt.finalbody, rel, lines, fin_settles,
+                           model, out)
+            elif isinstance(stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                                   ast.With, ast.AsyncWith)):
+                for body in self._bodies(stmt):
+                    self._scan(fn, body, rel, lines, fin_settles, model,
+                               out)
+            elif hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+                for case in stmt.cases:
+                    self._scan(fn, case.body, rel, lines, fin_settles,
+                               model, out)
+
+    @staticmethod
+    def _next_try_settles(stmts: Sequence[ast.stmt], i: int,
+                          accepted: Set[str]) -> bool:
+        """The charge-then-try idiom: the statement after the charge
+        (skipping simple, non-control-transferring statements) is a try
+        whose finally settles."""
+        for nxt in stmts[i + 1:]:
+            if isinstance(nxt, ast.Try):
+                return _settles_in(nxt.finalbody, accepted)
+            if not isinstance(nxt, _SIMPLE_STMTS):
+                return False
+        return False
+
+    def _escape_ok(self, call: ast.Call, meth: str, accepted: Set[str],
+                   rel: str, lines: List[str], model,
+                   out: List[Finding]) -> bool:
+        """True when the charge carries a ledger-escape annotation —
+        verified or not. A stale annotation (naming a function that
+        doesn't exist or doesn't settle) emits its own targeted finding
+        here, which supersedes the generic charge-leak message: the fix
+        is to repair the annotation, not to re-derive the flow."""
+        names: List[str] = []
+        for ln in (call.lineno, call.lineno - 1):
+            if 0 < ln <= len(lines):
+                m = ESCAPE_RE.search(lines[ln - 1])
+                if m:
+                    names = [n.strip() for n in m.group(1).split(",")
+                             if n.strip()]
+                    break
+        if not names:
+            return False
+        for name in names:
+            settlers = [
+                k for k, fs in model.functions.items()
+                if (fs["name"] == name.split(".")[-1]
+                    and (name == fs["name"] or fs["qual"].endswith(name)
+                         or fs["qual"] == name))
+                and any(op["meth"] in accepted for op in fs["ledger"])]
+            if not settlers:
+                out.append(self.finding(
+                    rel, call.lineno,
+                    f"ledger-escape for `{meth}` names `{name}`, but no "
+                    f"such function settles it "
+                    f"({'/'.join(sorted(accepted))}) — stale "
+                    f"annotation"))
+        return True

@@ -1,0 +1,146 @@
+"""DTL009 lock-order: the global lock-acquisition-order graph must be
+acyclic — a cycle is a potential deadlock.
+
+Built on the shared interprocedural model (tools/daftlint/interproc.py):
+an edge ``L -> M`` exists when some function acquires M while holding L,
+either lexically (nested ``with`` blocks, or the ``acquire()/try/
+finally: release()`` idiom) or through a call chain (holding L and
+calling a function that eventually acquires M). Lock identity is
+``ClassName.attr`` for instance locks — all instances of a class share
+one node, the standard conflation for order analysis — and
+``path::NAME`` for module globals and closure-local locks.
+
+Each strongly connected component of two or more locks is reported ONCE,
+with the full ring and a witness function per edge (both chains of a
+two-lock inversion, per the contract). Self-edges are not reported:
+``L -> L`` under instance conflation is usually a parent/child pair of
+the same class (e.g. forwarding MemoryLedgers), not re-entry — DTL002
+and the runtime cover genuine re-entry.
+
+Try-acquires (``acquire(blocking=False)``) never create edges: a
+trylock cannot deadlock. IO-serialization locks (``# daftlint:
+io-lock``) still participate — exempting them from DTL010's
+blocking-under-lock check does not exempt them from ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from ..engine import Finding, Project, Rule
+from ..interproc import model_for
+
+
+def _sccs(nodes: List[str],
+          adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Tarjan's SCC, iterative, deterministic (sorted inputs)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            succs = adj.get(node, [])
+            for i in range(pi, len(succs)):
+                nxt = succs[i]
+                if nxt not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((nxt, 0))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(sorted(comp))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return out
+
+
+def _find_ring(comp: List[str], adj: Dict[str, List[str]],
+               members: Set[str]) -> List[str]:
+    """A deterministic simple cycle through the SCC, starting from its
+    smallest lock: [A, B, ..., A]."""
+    start = comp[0]
+    path = [start]
+    seen = {start}
+    node = start
+    while True:
+        nxt = None
+        for cand in adj.get(node, []):
+            if cand == start and len(path) > 1:
+                return path + [start]
+            if cand in members and cand not in seen:
+                nxt = cand
+                break
+        if nxt is None:
+            # dead end inside the SCC: backtrack (guaranteed to terminate
+            # because the SCC is strongly connected)
+            path.pop()
+            if not path:
+                return [start, start]
+            node = path[-1]
+            continue
+        path.append(nxt)
+        seen.add(nxt)
+        node = nxt
+
+
+class LockOrderRule(Rule):
+    code = "DTL009"
+    name = "lock-order"
+    description = ("the global lock-acquisition-order graph (across call "
+                   "chains) must be acyclic; a cycle is a potential "
+                   "deadlock")
+
+    def run(self, project: Project) -> List[Finding]:
+        model = model_for(project)
+        edges = model.lock_edges()
+        adj: Dict[str, List[str]] = {}
+        for (L, M) in sorted(edges):
+            adj.setdefault(L, []).append(M)
+        nodes = sorted(set(adj) | {M for (_L, M) in edges})
+        out: List[Finding] = []
+        for comp in _sccs(nodes, adj):
+            if len(comp) < 2:
+                continue
+            members = set(comp)
+            ring = _find_ring(comp, adj, members)
+            legs = []
+            for a, b in zip(ring, ring[1:]):
+                w = edges[(a, b)]
+                leg = f"`{b}` (in `{w['qual']}`"
+                if w.get("chain"):
+                    leg += f" via {w['chain']}"
+                leg += ")"
+                legs.append(leg)
+            first = edges[(ring[0], ring[1])]
+            out.append(self.finding(
+                first["path"], first["line"],
+                f"potential deadlock: lock-order cycle `{ring[0]}` -> "
+                + " -> ".join(legs)))
+        return out

@@ -68,7 +68,7 @@ class LogHygieneRule(Rule):
 
     def run(self, project: Project) -> List[Finding]:
         out: List[Finding] = []
-        for rel in project.files:
+        for rel in project.lint_files:
             if rel in _EXEMPT:
                 continue
             tree = project.tree(rel)

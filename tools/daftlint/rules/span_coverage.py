@@ -132,7 +132,7 @@ class SpanCoverageRule(Rule):
 
     def run(self, project: Project) -> List[Finding]:
         out: List[Finding] = []
-        for rel in project.files:
+        for rel in project.lint_files:
             tree = project.tree(rel)
             if tree is None:
                 continue

@@ -1,0 +1,166 @@
+"""DTL012 thread-discipline: every engine thread is nameable and
+accountable.
+
+``serve.leaked_thread_count()`` / ``dt.shutdown`` find engine threads by
+scanning ``threading.enumerate()`` for the ``_ENGINE_THREAD_PREFIXES``
+inventory — a nameless (``Thread-3``) or unprefixed thread is invisible
+to leak accounting, and a non-daemon engine thread can pin interpreter
+exit. The rule enforces, for every ``threading.Thread(...)`` in the
+project:
+
+- an explicit ``name=`` keyword whose STATIC prefix (string literal, or
+  the literal head of an f-string like ``f"daft-dist-rx-{wid}"``) starts
+  with ``daft-``;
+- an explicit ``daemon=`` keyword (a literal ``True``/``False`` — the
+  choice must be visible at the spawn site, not inherited);
+- when the project declares a ``_ENGINE_THREAD_PREFIXES`` inventory, the
+  static name prefix must be covered by some inventory entry — a new
+  subsystem prefix that forgets to register itself is caught statically,
+  before the zero-leak tests can miss it at runtime.
+
+``ThreadPoolExecutor(...)`` gets the same treatment via
+``thread_name_prefix=`` (executor threads are pool-managed, so no daemon
+requirement).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from ..engine import Finding, Project, Rule, dotted_name
+from ..interproc import _static_str_prefix
+
+
+def _inventory(project: Project) -> Tuple[Optional[str],
+                                          Tuple[str, ...]]:
+    """(declaring file, prefixes) for the project's
+    ``_ENGINE_THREAD_PREFIXES`` tuple, or (None, ()) when absent."""
+    for rel in project.files:
+        if "_ENGINE_THREAD_PREFIXES" not in project.source(rel):
+            continue
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name)
+                       and t.id == "_ENGINE_THREAD_PREFIXES"
+                       for t in node.targets):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = tuple(
+                    e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str))
+                return rel, vals
+    return None, ()
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class ThreadDisciplineRule(Rule):
+    code = "DTL012"
+    name = "thread-discipline"
+    description = ("threading.Thread needs an explicit daft- prefixed "
+                   "name= and a literal daemon= flag (and executors a "
+                   "daft- thread_name_prefix), covered by the "
+                   "_ENGINE_THREAD_PREFIXES leak-accounting inventory")
+
+    def run(self, project: Project) -> List[Finding]:
+        inv_file, prefixes = _inventory(project)
+        out: List[Finding] = []
+        for rel in project.lint_files:
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = dotted_name(node.func)
+                if dotted is None:
+                    continue
+                last = dotted.split(".")[-1]
+                if last == "Thread" and dotted in ("threading.Thread",
+                                                   "Thread"):
+                    self._check_thread(node, rel, inv_file, prefixes, out)
+                elif last in ("ThreadPoolExecutor",):
+                    self._check_executor(node, rel, inv_file, prefixes,
+                                         out)
+        return out
+
+    def _check_thread(self, node: ast.Call, rel: str,
+                      inv_file: Optional[str],
+                      prefixes: Tuple[str, ...],
+                      out: List[Finding]) -> None:
+        name_kw = _kw(node, "name")
+        if name_kw is None:
+            out.append(self.finding(
+                rel, node.lineno,
+                "threading.Thread without an explicit name= — leak "
+                "accounting cannot see a nameless thread"))
+        else:
+            self._check_prefix(node, rel, "name", name_kw, inv_file,
+                               prefixes, out)
+        daemon_kw = _kw(node, "daemon")
+        if daemon_kw is None:
+            out.append(self.finding(
+                rel, node.lineno,
+                "threading.Thread without an explicit daemon= flag — "
+                "a non-daemon engine thread can pin interpreter exit; "
+                "make the choice visible at the spawn site"))
+        elif not (isinstance(daemon_kw, ast.Constant)
+                  and isinstance(daemon_kw.value, bool)):
+            out.append(self.finding(
+                rel, node.lineno,
+                "threading.Thread daemon= must be a literal "
+                "True/False, not a computed value"))
+
+    def _check_executor(self, node: ast.Call, rel: str,
+                        inv_file: Optional[str],
+                        prefixes: Tuple[str, ...],
+                        out: List[Finding]) -> None:
+        pref_kw = _kw(node, "thread_name_prefix")
+        if pref_kw is None:
+            out.append(self.finding(
+                rel, node.lineno,
+                "ThreadPoolExecutor without thread_name_prefix= — its "
+                "workers are invisible to leak accounting"))
+        else:
+            self._check_prefix(node, rel, "thread_name_prefix", pref_kw,
+                               inv_file, prefixes, out)
+
+    def _check_prefix(self, node: ast.Call, rel: str, kw_name: str,
+                      value: ast.expr, inv_file: Optional[str],
+                      prefixes: Tuple[str, ...],
+                      out: List[Finding]) -> None:
+        static = _static_str_prefix(value)
+        if static is None:
+            out.append(self.finding(
+                rel, node.lineno,
+                f"thread {kw_name}= must be a string literal or an "
+                f"f-string with a literal head, so the daft- prefix is "
+                f"statically checkable"))
+            return
+        if not static.startswith("daft-"):
+            out.append(self.finding(
+                rel, node.lineno,
+                f"thread {kw_name}= `{static}...` does not start with "
+                f"`daft-` — engine threads must be identifiable"))
+            return
+        # the summarizer's own pool and similar tooling threads are
+        # daft-prefixed but live outside the serve inventory
+        if inv_file is None or rel == inv_file:
+            return
+        if not any(static.startswith(p) for p in prefixes):
+            out.append(self.finding(
+                rel, node.lineno,
+                f"thread prefix `{static}` is not covered by "
+                f"_ENGINE_THREAD_PREFIXES in {inv_file} — "
+                f"leaked_thread_count() would be blind to it"))
