@@ -18,15 +18,18 @@
 #   make cache-smoke   - plan/program cache cold->warm->invalidate->warm
 #                        cycle: hit counters, byte-identity, prefix replay,
 #                        gauge surfaces
+#   make batch-smoke   - dynamic-batching executor end to end: cross-morsel
+#                        coalesce, budget/timer/end flushes, byte-identity
+#                        with the knob off, warm pinned actors, zero leaks
 #   make bench-compare - diff the two newest BENCH_r*.json, flag per-metric
 #                        regressions beyond the noise threshold
 #   make test          - full tier-1 test suite (CPU jax)
 
 PY ?= python
 
-.PHONY: lint precommit test profile-smoke obs-smoke chaos-smoke cache-smoke bench-compare
+.PHONY: lint precommit test profile-smoke obs-smoke chaos-smoke cache-smoke batch-smoke bench-compare
 
-lint: profile-smoke obs-smoke chaos-smoke cache-smoke
+lint: profile-smoke obs-smoke chaos-smoke cache-smoke batch-smoke
 	$(PY) -m tools.daftlint --jobs 8 --sarif daftlint.sarif
 	$(PY) -m compileall -q daft_tpu
 
@@ -35,6 +38,9 @@ precommit:
 
 cache-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.cache_smoke
+
+batch-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.batch_smoke
 
 profile-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.profile_smoke

@@ -276,6 +276,120 @@ def run_fusion_ab(n: int = 1000, src_size: int = 96, out_size: int = 224,
         shutdown(server)
 
 
+class _EmbedScorer:
+    """The inference leg of the laion workload: a small resident "model"
+    (a fixed projection matrix — weights load once per process via the
+    pinned model actor) scoring each row's feature against it. Per-call
+    cost has a real fixed component (instance dispatch, numpy temporaries,
+    result coercion), which is exactly what dynamic batching amortizes."""
+
+    weight_bytes = 64 * 64 * 8
+
+    def __init__(self, seed: int = 7):
+        rng = np.random.RandomState(seed)
+        self.w = rng.standard_normal((64, 64))
+
+    def __call__(self, x):
+        v = x.to_numpy()
+        # deterministic per-row embedding score: rows -> 64-dim features
+        # -> projected -> reduced. Row-local by construction.
+        feats = np.cos(np.outer(v, np.arange(1, 65)))
+        return np.tanh(feats @ self.w).sum(axis=1)
+
+
+def _partitioned_frame(values: List[float], num_parts: int):
+    """A DataFrame pre-split into `num_parts` in-memory partitions —
+    shuffle-free, so the A/B walls measure UDF execution, not repartition."""
+    import daft_tpu as dt
+    from daft_tpu.dataframe import from_partitions
+    from daft_tpu.micropartition import MicroPartition
+
+    tbl = dt.from_pydict({"x": values}).collect().to_table()
+    n = len(tbl)
+    per = max(1, -(-n // num_parts))
+    parts = [MicroPartition.from_table(tbl.slice(s, min(s + per, n)))
+             for s in range(0, n, per)]
+    return from_partitions(parts, tbl.schema)
+
+
+def batching_pipeline(values: List[float], num_parts: int, batched: bool,
+                      max_rows: int = 4096):
+    """Score `values` with _EmbedScorer across `num_parts` partitions.
+    `batched` toggles the declaration (batch_udf vs plain stateful udf);
+    everything else — model, data, partitioning — is identical."""
+    import daft_tpu as dt
+
+    if batched:
+        scorer = dt.batch_udf(return_dtype=dt.DataType.float64(),
+                              max_rows=max_rows)(_EmbedScorer)
+    else:
+        scorer = dt.udf(return_dtype=dt.DataType.float64())(_EmbedScorer)
+    df = _partitioned_frame(values, num_parts)
+    return df.select(scorer(dt.col("x")).alias("score")).collect()
+
+
+def run_batching_ab(n: int = 20000, num_parts: int = 512,
+                    trials: int = 2) -> dict:
+    """Batched-vs-unbatched A/B of the inference leg (ISSUE 18):
+    dynamic batching coalesces the per-partition UDF calls into
+    budget-sized batches, amortizing per-call dispatch. Interleaved
+    best-of like the fusion leg; byte-identical score tensors gate the
+    timing. Streaming is held off for both sides so the leg isolates the
+    cross-partition coalescer (the streaming path coalesces per producer
+    and is covered by batch-smoke). Emits laion_batched_speedup_x +
+    laion_batch_fill_pct."""
+    import time
+
+    from daft_tpu.context import get_context
+
+    rng = np.random.RandomState(11)
+    values = [float(v) for v in rng.standard_normal(n)]
+    cfg = get_context().execution_config
+    saved = (cfg.dynamic_batching, cfg.streaming_execution,
+             cfg.enable_result_cache)
+    cfg.enable_result_cache = False
+    cfg.streaming_execution = False
+    try:
+        best: dict = {}
+        frames: dict = {}
+        for flag in (True, False):  # warm both sides (model load, pools)
+            cfg.dynamic_batching = flag
+            batching_pipeline(values[:256], 8, batched=flag)
+        order = [("on", "off") if i % 2 == 0 else ("off", "on")
+                 for i in range(max(trials, 1))]
+        for pair in order:
+            for mode in pair:
+                cfg.dynamic_batching = mode == "on"
+                t0 = time.perf_counter()
+                frame = batching_pipeline(values, num_parts,
+                                          batched=mode == "on")
+                wall = time.perf_counter() - t0
+                if mode not in best or wall < best[mode]:
+                    best[mode] = wall
+                    frames[mode] = frame
+        got_on = frames["on"].to_table().get_column("score").to_numpy()
+        got_off = frames["off"].to_table().get_column("score").to_numpy()
+        if got_on.shape != got_off.shape or not np.array_equal(got_on,
+                                                               got_off):
+            return {"laion_batched_speedup_x": 0.0,
+                    "laion_batching_error": "parity_mismatch"}
+        counters = frames["on"].stats.snapshot()["counters"]
+        cap = counters.get("batch_capacity_rows", 0)
+        fill = counters.get("batch_rows", 0) / cap * 100 if cap else 0.0
+        return {
+            "laion_batched_speedup_x": round(best["off"] / best["on"], 3),
+            "laion_batch_fill_pct": round(fill, 1),
+            "laion_batched_wall_s": round(best["on"], 3),
+            "laion_unbatched_wall_s": round(best["off"], 3),
+            "laion_batches_formed": counters.get("batches_formed", 0),
+            "laion_batch_rows_padded": counters.get("batch_rows_padded", 0),
+            "laion_batching_rows": n,
+        }
+    finally:
+        (cfg.dynamic_batching, cfg.streaming_execution,
+         cfg.enable_result_cache) = saved
+
+
 def shutdown(server) -> None:
     """Stop serving AND release the listening socket + pinned image bytes
     (shutdown() alone leaks the fd and the served list for the rest of a

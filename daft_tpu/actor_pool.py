@@ -151,6 +151,12 @@ def shutdown_all() -> None:
         _pools.clear()
     for p in pools:
         p.shutdown()
+    # pinned model actors (batch/actors.py) ride the same teardown paths —
+    # serve shutdown, dt.shutdown(), atexit — so "engine down" always means
+    # zero resident models too (lazy import: batch/ depends on this module)
+    from .batch.actors import shutdown_all_models
+
+    shutdown_all_models()
 
 
 atexit.register(shutdown_all)

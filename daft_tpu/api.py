@@ -272,15 +272,39 @@ def from_scan_operator(op) -> DataFrame:
 # ---------------------------------------------------------------------------
 
 def udf(return_dtype: DataType, num_cpus=None, num_gpus=None, memory_bytes=None,
-        batch_size=None, concurrency=None):
-    """Decorator: make a batch UDF (reference: daft/udf.py:441)."""
+        batch_size=None, concurrency=None, batching=None):
+    """Decorator: make a batch UDF (reference: daft/udf.py:441).
+
+    ``batching=True`` (or a dict of overrides) opts into the
+    dynamic-batching executor — see ``batch_udf`` for the dedicated
+    declaration and README "Batched inference" for semantics."""
+    from .udf import _normalize_batching
 
     def deco(fn):
         return UDF(fn, return_dtype, num_cpus=num_cpus, num_gpus=num_gpus,
                    memory_bytes=memory_bytes, batch_size=batch_size,
-                   concurrency=concurrency)
+                   concurrency=concurrency,
+                   batching=_normalize_batching(batching))
 
     return deco
+
+
+def batch_udf(*, return_dtype: DataType, max_rows=None, max_bytes=None,
+              flush_ms=None, mode=None, device=False, concurrency=None,
+              num_cpus=None, num_gpus=None, memory_bytes=None):
+    """Decorator: declare a dynamically-batched UDF (daft_tpu/batch/,
+    README "Batched inference"). The declaration is a contract that the fn
+    is row-local; the engine may then coalesce morsels into device-friendly
+    batches and re-split outputs byte-identically. Class targets become
+    pinned model actors (weights loaded once per process, resident across
+    queries)."""
+    from .udf import batch_udf as _batch_udf
+
+    return _batch_udf(return_dtype=return_dtype, max_rows=max_rows,
+                      max_bytes=max_bytes, flush_ms=flush_ms, mode=mode,
+                      device=device, concurrency=concurrency,
+                      num_cpus=num_cpus, num_gpus=num_gpus,
+                      memory_bytes=memory_bytes)
 
 
 def sql(query: str, **catalog: DataFrame) -> DataFrame:
@@ -381,6 +405,7 @@ __all__ = [
     "element",
     "interval",
     "udf",
+    "batch_udf",
     "sql",
     "sql_expr",
     "from_pydict",

@@ -1,0 +1,32 @@
+"""Dynamic-batching UDF executor (ISSUE 18, ROADMAP item 4).
+
+Decouples UDF batch size from partition size: morsels (streaming path) and
+whole partitions (non-streaming path) are coalesced across boundaries into
+device-friendly batches under a byte/row budget with a max-latency flush
+timer, applied once, and re-split to exact source boundaries — so outputs
+are byte-identical to the per-partition path (the standing invariant).
+
+Modules:
+  coalesce.py  — the budget/timer flush machine (fault site batch.coalesce)
+  actors.py    — ModelActorPool: pinned per-process model instances, LRU
+                 under the ledger's model_cache_bytes account (actor.load)
+  executor.py  — BatchingExecutor: coalesce → pad → apply → re-split
+  device.py    — jit'd apply behind the device breaker with host fallback
+"""
+
+from .actors import (ModelActorPool, get_model_pool, model_pools_snapshot,
+                     pinned_model_count, shutdown_all_models)
+from .coalesce import Coalescer, Flush
+from .executor import BatchingExecutor, BatchSettings
+
+__all__ = [
+    "BatchSettings",
+    "BatchingExecutor",
+    "Coalescer",
+    "Flush",
+    "ModelActorPool",
+    "get_model_pool",
+    "model_pools_snapshot",
+    "pinned_model_count",
+    "shutdown_all_models",
+]

@@ -1,0 +1,91 @@
+"""Device (jit'd) apply path for batch-declared UDFs, behind the breaker.
+
+A model opts in by defining ``apply_jax`` — a jax-traceable staticmethod /
+classmethod taking the same column arrays as ``__call__``. The batched apply
+then runs ``jax.jit(apply_jax)`` under the query's device breaker
+(ExecutionContext._device_attempt: fault site ``device.kernel``, failures
+recorded, breaker-open routes straight to the host instance). Without the
+opt-in — or without a live execution context on this thread — the path
+declines (returns None) and run_udf falls back to the pinned host instance,
+so host and device-breaker-tripped runs are byte-identical by construction.
+
+The execution context rides a thread-local set by the batching executor /
+BatchedUdfOp while UDF expressions evaluate; run_udf itself has no ctx
+argument (expression evaluation is context-free by design).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+import numpy as np
+
+_tl = threading.local()
+
+_jit_cache: dict = {}
+_jit_lock = threading.Lock()
+
+
+class exec_ctx_scope:
+    """``with exec_ctx_scope(ctx): ...`` — publish the ExecutionContext to
+    UDF evaluation on this thread (re-entrant: restores the prior one)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._prev = getattr(_tl, "ctx", None)
+        _tl.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _tl.ctx = self._prev
+        return False
+
+
+def current_exec_ctx():
+    return getattr(_tl, "ctx", None)
+
+
+def _jitted(fn):
+    with _jit_lock:
+        j = _jit_cache.get(fn)
+        if j is None:
+            import jax
+
+            j = jax.jit(fn)
+            _jit_cache[fn] = j
+        return j
+
+
+def device_apply(pool, args: List[Any], n: int) -> Optional[Any]:
+    """One breaker-gated device attempt for a batch. None = decline/fall
+    back to the host instance (the device layer's standard convention)."""
+    ctx = current_exec_ctx()
+    if ctx is None or not getattr(ctx.cfg, "use_device_kernels", False):
+        return None
+    fn = pool.jax_callable()
+    if fn is None:
+        return None
+    if not ctx.device_health.allow(ctx.stats):
+        ctx.stats.bump("batch_device_fallbacks")
+        return None
+
+    def attempt():
+        try:
+            import jax  # noqa: F401
+        except Exception:
+            return None  # decline, not a breaker failure: no toolchain
+        np_args = [a.to_numpy() if hasattr(a, "to_numpy") else a for a in args]
+        out = _jitted(fn)(*np_args)
+        return np.asarray(out)
+
+    out = ctx._device_attempt(attempt)
+    if out is None:
+        ctx.stats.bump("batch_device_fallbacks")
+    else:
+        ctx.stats.bump("batch_device_applies")
+    return out

@@ -1,0 +1,196 @@
+"""BatchingExecutor: coalesce → (pad) → apply → re-split.
+
+The shared engine behind BatchedUdfOp (whole partitions, non-streaming) and
+the stream adapter (morsels from the bounded channels). Both feed source
+pieces in order and get back OUTPUT pieces re-split to exactly the source
+boundaries — so every downstream consumer (further maps, _rechunk, the
+sink) sees the same piece boundaries as the unbatched path, which is what
+makes batching byte-invisible.
+
+Failure semantics: a fault at ``batch.coalesce`` permanently degrades THIS
+executor to the per-piece UDF path (each source piece evaluated alone —
+still correct, just unbatched) after settling the buffered ledger charge;
+it never fails the query. Model-load failures surface from the apply as the
+typed error raised by batch/actors.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..micropartition import MicroPartition
+from ..series import Series
+from .coalesce import Coalescer, Flush
+from .device import exec_ctx_scope
+
+# process-wide flush accounting for dt.health()["batching"] (per-query
+# counts live on RuntimeStats; health wants the engine-wide view)
+_proc_lock = threading.Lock()
+_proc_counts = {"batches_formed": 0, "flushes_budget": 0, "flushes_timer": 0,
+                "flushes_end": 0, "coalesce_faults": 0}
+
+
+def _proc_bump(key: str, n: int = 1) -> None:
+    with _proc_lock:
+        _proc_counts[key] += n
+
+
+def process_counters() -> dict:
+    with _proc_lock:
+        return dict(_proc_counts)
+
+
+def _next_bucket(n: int, floor: int = 8) -> int:
+    """Next power-of-two batch bucket ≥ n (min `floor`): stable shapes so a
+    jit'd apply recompiles O(log max_rows) times, not once per batch."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+class BatchSettings:
+    """Effective knobs: declaration-site overrides over ExecutionConfig."""
+
+    __slots__ = ("max_rows", "max_bytes", "flush_ms", "mode")
+
+    def __init__(self, max_rows: int, max_bytes: int, flush_ms: float,
+                 mode: str):
+        self.max_rows = max(1, int(max_rows))
+        self.max_bytes = max(1, int(max_bytes))
+        self.flush_ms = float(flush_ms)
+        self.mode = mode
+
+    @classmethod
+    def resolve(cls, declaration: Optional[dict], cfg) -> "BatchSettings":
+        d = declaration or {}
+        return cls(d.get("max_rows", getattr(cfg, "batch_max_rows", 4096)),
+                   d.get("max_bytes", getattr(cfg, "batch_max_bytes",
+                                              32 * 1024 * 1024)),
+                   d.get("flush_ms", getattr(cfg, "batch_flush_ms", 25.0)),
+                   d.get("mode", getattr(cfg, "batch_padding", "ragged")))
+
+
+class BatchingExecutor:
+    """One per producer (stream producer thread / op execute call). Feed
+    source pieces in order; outputs come back re-split to those boundaries,
+    possibly several pieces per feed (timer + budget both firing) or zero
+    (still buffering) — ``finish()`` drains the tail."""
+
+    def __init__(self, op_name: str, exprs, ctx,
+                 settings: Optional[BatchSettings] = None, clock=time.monotonic):
+        self.op_name = op_name
+        self.exprs = exprs
+        self.ctx = ctx
+        self.settings = settings or BatchSettings.resolve(None, ctx.cfg)
+        self._coalescer = Coalescer(self.settings.max_rows,
+                                    self.settings.max_bytes,
+                                    self.settings.flush_ms,
+                                    ledger=getattr(ctx, "ledger", None),
+                                    clock=clock)
+        self._degraded = False
+
+    # ------------------------------------------------------------ pieces
+    def _apply_one(self, part: MicroPartition) -> MicroPartition:
+        """The per-piece UDF path (the degrade target and the byte-identity
+        oracle): evaluate the projection on one source piece alone."""
+        with exec_ctx_scope(self.ctx):
+            return part.eval_expression_list(self.exprs)
+
+    def _pad(self, part: MicroPartition, rows: int):
+        """Pad to the next power-of-two bucket by repeating the last valid
+        row (any real row works — padding is sliced off after apply; the
+        last row keeps the gather contiguous)."""
+        bucket = _next_bucket(rows)
+        pad_n = bucket - rows
+        if pad_n <= 0 or rows == 0:
+            return part, 0
+        idx = np.concatenate([np.arange(rows, dtype=np.int64),
+                              np.full(pad_n, rows - 1, dtype=np.int64)])
+        return part.take(Series.from_numpy(idx, "idx")), pad_n
+
+    def _run_flush(self, f: Flush) -> List[MicroPartition]:
+        from .. import faults
+
+        ctx, stats = self.ctx, self.ctx.stats
+        prof = stats.profiler
+        try:
+            with prof.span("batch.coalesce", op=self.op_name, kind="phase",
+                           rows=f.rows, pieces=len(f.parts)):
+                faults.check("batch.coalesce", stats)
+                batch = (f.parts[0] if len(f.parts) == 1
+                         else MicroPartition.concat(f.parts))
+                pad_n = 0
+                capacity = max(self.settings.max_rows, f.rows)
+                if self.settings.mode == "padded" and f.rows:
+                    batch, pad_n = self._pad(batch, f.rows)
+                    capacity = f.rows + pad_n
+        except Exception as e:
+            # coalesce failed (injected or real): degrade this executor to
+            # the per-piece UDF path — byte-identical, never a query failure
+            stats.bump("batch_coalesce_faults")
+            _proc_bump("coalesce_faults")
+            self._degraded = True
+            self._coalescer.settle(f)
+            from ..obs.log import get_logger
+
+            get_logger("batch").warning("batch_coalesce_degraded",
+                                        op=self.op_name, error=repr(e))
+            return [self._apply_one(p) for p in f.parts]
+
+        stats.bump("batches_formed")
+        stats.bump("batch_rows", f.rows)
+        stats.bump("batch_capacity_rows", capacity)
+        if pad_n:
+            stats.bump("batch_rows_padded", pad_n)
+        stats.bump(f"batch_flushes_{f.reason}")
+        _proc_bump("batches_formed")
+        _proc_bump(f"flushes_{f.reason}")
+
+        try:
+            with prof.span("actor.apply", op=self.op_name, kind="phase",
+                           rows=f.rows):
+                with exec_ctx_scope(ctx):
+                    out = batch.eval_expression_list(self.exprs)
+            if pad_n:
+                out = out.slice(0, f.rows)
+
+            # re-split to EXACT source boundaries (prefix sums over feed
+            # order)
+            pieces: List[MicroPartition] = []
+            off = 0
+            for p in f.parts:
+                n = len(p)
+                pieces.append(out.slice(off, off + n))
+                off += n
+            return pieces
+        finally:
+            # settle even when the apply raises (e.g. a typed model-load
+            # failure) — the error may fail the query, but a handed-out
+            # flush must never leave its ledger charge outstanding
+            self._coalescer.settle(f)
+
+    # ------------------------------------------------------------ driver
+    def feed(self, part: MicroPartition) -> List[MicroPartition]:
+        if self._degraded:
+            return [self._apply_one(part)]
+        outs: List[MicroPartition] = []
+        for f in self._coalescer.feed(part):
+            outs.extend(self._run_flush(f))
+        return outs
+
+    def finish(self) -> List[MicroPartition]:
+        outs: List[MicroPartition] = []
+        for f in self._coalescer.finish():
+            outs.extend(self._run_flush(f))
+        return outs
+
+    def abort(self) -> None:
+        """Teardown without apply: settle any still-buffered ledger charge
+        (idempotent; a clean finish leaves nothing buffered)."""
+        for f in self._coalescer.finish():
+            self._coalescer.settle(f)

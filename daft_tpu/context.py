@@ -334,6 +334,31 @@ class ExecutionConfig:
     # charged to the ledger's subplan_cache_bytes account
     subplan_result_cache: bool = True
     subplan_cache_bytes: int = 64 * 1024 * 1024
+    # --- dynamic-batching UDF executor (daft_tpu/batch/, README "Batched
+    # inference") --------------------------------------------------------
+    # batch-declared UDFs (@daft_tpu.batch_udf / udf(..., batching=...))
+    # route through the BatchingExecutor: morsels/partitions coalesce
+    # across their boundaries into device-friendly batches under the
+    # row/byte budget below, results re-split to exact source boundaries.
+    # Results are byte-identical with this off (per-partition UDF path) —
+    # the standing hard invariant, and the bench laion batching A/B axis.
+    dynamic_batching: bool = True
+    # per-batch coalesce budget: a batch closes when EITHER bound is
+    # reached (declaration-site values override per UDF)
+    batch_max_rows: int = 4096
+    batch_max_bytes: int = 32 * 1024 * 1024
+    # max-latency flush: a batch older than this flushes even when under
+    # budget, so sparse streams never stall behind the coalescer
+    batch_flush_ms: float = 25.0
+    # batch shape policy: "ragged" concatenates as-is (row-offset vector
+    # kept for the re-split); "padded" pads to the next power-of-two
+    # bucket (repeating the last valid row; pad rows are sliced away
+    # after the apply) so a jit'd apply sees few distinct shapes
+    batch_padding: str = "ragged"
+    # pinned-model LRU cap (batch/actors.ModelActorPool): resident weight
+    # bytes across all pinned actor pools, charged to the ledger's
+    # model_cache_bytes account; least-recently-used pools evict past it
+    model_cache_bytes: int = 512 * 1024 * 1024
     # device circuit breaker (execution.DeviceHealth): after this many
     # CONSECUTIVE device-kernel failures the breaker opens and every
     # device-eligible partition routes straight to the host path (one trip,

@@ -1383,6 +1383,14 @@ def execute_plan(root: PhysicalOp, ctx: ExecutionContext,
         if pipe is not None:
             return pipe
         child_streams = [build(c) for c in op.children]
+        if getattr(op, "batch_declared", False) and ctx.dist_backend is None:
+            # dynamic-batching UDFs (physical.BatchedUdfOp): the op's own
+            # execute() coalesces across partitions — thread fan-out would
+            # re-pin batch size to partition size. Under a distributed
+            # backend we fall through instead: workers run map_partition
+            # and host the pinned model actors process-locally.
+            stream = op.execute(child_streams, ctx)
+            return _traced(op, stream, ctx) if trace else stream
         if (parallel and op.map_partition is not None and len(child_streams) == 1
                 and op.parallel_safe()):
             if op.device_pipelinable(ctx) and not op_resource_request(op):

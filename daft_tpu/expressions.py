@@ -767,7 +767,8 @@ class PyUdf(ExprNode):
     def __init__(self, fn: Callable, return_dtype: DataType, args: List[ExprNode],
                  fn_name: Optional[str] = None, batch_size: Optional[int] = None,
                  concurrency: Optional[int] = None, init_args: Optional[tuple] = None,
-                 resource_request: Optional[tuple] = None):
+                 resource_request: Optional[tuple] = None,
+                 batching: Optional[dict] = None):
         self.fn = fn
         self.return_dtype = return_dtype
         self.args = args
@@ -779,6 +780,13 @@ class PyUdf(ExprNode):
         # admission gate (reference: ResourceRequest, common/resource-request,
         # honored by PyRunner admission pyrunner.py:352-370)
         self.resource_request = resource_request
+        # dynamic-batching declaration (daft_tpu/batch/): the user's
+        # contract that the fn is ROW-LOCAL, so the engine may coalesce
+        # morsels/partitions into batches and re-split the output. None =
+        # undeclared (the per-partition UDF path). Keys: max_rows,
+        # max_bytes, flush_ms, mode ("ragged"|"padded"), device — all
+        # optional, ExecutionConfig fills the gaps.
+        self.batching = batching
 
     def name(self):
         return self.args[0].name() if self.args else self.fn_name
@@ -798,14 +806,16 @@ class PyUdf(ExprNode):
         args = [a.evaluate(table) for a in self.args]
         n = len(table)
         return run_udf(self.fn, args, self.return_dtype, n, self.batch_size,
-                       self.init_args, self.concurrency).rename(self.name())
+                       self.init_args, self.concurrency,
+                       batching=self.batching).rename(self.name())
 
     def children(self):
         return list(self.args)
 
     def with_children(self, c):
         return PyUdf(self.fn, self.return_dtype, c, self.fn_name, self.batch_size,
-                     self.concurrency, self.init_args, self.resource_request)
+                     self.concurrency, self.init_args, self.resource_request,
+                     self.batching)
 
     def _key(self):
         return ("udf", id(self.fn), tuple(a._key() for a in self.args))
@@ -980,6 +990,32 @@ def expr_has_udf(e: "Expression") -> bool:
         return isinstance(n, PyUdf) or any(rec(c) for c in n.children())
 
     return rec(e._node)
+
+
+def expr_has_batch_udf(e: "Expression") -> bool:
+    """True if any UDF node carries a dynamic-batching declaration
+    (daft_tpu/batch/). The planner routes such projections through
+    BatchedUdfOp instead of the per-partition UDF path."""
+    def rec(n):
+        if isinstance(n, PyUdf) and n.batching is not None:
+            return True
+        return any(rec(c) for c in n.children())
+
+    return rec(e._node)
+
+
+def expr_batch_udfs(e: "Expression") -> list:
+    """All batch-declared PyUdf nodes in the expression tree, in eval order."""
+    out = []
+
+    def rec(n):
+        if isinstance(n, PyUdf) and n.batching is not None:
+            out.append(n)
+        for c in n.children():
+            rec(c)
+
+    rec(e._node)
+    return out
 
 
 def expr_udfs_parallel_safe(e: "Expression") -> bool:

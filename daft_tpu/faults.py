@@ -119,6 +119,15 @@ SITES = {
                     "degrades the drain to the SIGKILL/redispatch loss "
                     "path — the already-proven recovery machinery — "
                     "never a hung quiesce)",
+    "batch.coalesce": "each dynamic-batching coalesce step "
+                      "(daft_tpu/batch/coalesce.py; a failure settles the "
+                      "buffered charge and degrades the op to the "
+                      "per-partition UDF path — byte-identical, never a "
+                      "query failure)",
+    "actor.load": "each pinned-model actor-pool construction "
+                  "(daft_tpu/batch/actors.py; a failed model load "
+                  "surfaces as a typed DaftError naming the model — "
+                  "never a hang, never a leaked half-initialized pool)",
 }
 
 

@@ -114,6 +114,10 @@ def render_runtime_stats(stats) -> str:
     if strm:
         lines.append("")
         lines.append(strm)
+    bat = _render_batching_line(counters)
+    if bat:
+        lines.append("")
+        lines.append(bat)
     exch = _render_exchange_line(counters)
     if exch:
         lines.append("")
@@ -180,6 +184,35 @@ def _render_streaming_line(counters: dict) -> str:
     if ttfr:
         parts.append(f"first row {ttfr / 1e6:.1f} ms")
     return "streaming: " + " · ".join(parts)
+
+
+def _render_batching_line(counters: dict) -> str:
+    """The explain_analyze 'batching:' line (README "Batched inference"):
+    batches formed, mean fill vs the row budget, padding overhead, and
+    flush-reason split. Empty when no batch formed."""
+    n = counters.get("batches_formed", 0)
+    if not n:
+        return ""
+    rows = counters.get("batch_rows", 0)
+    cap = counters.get("batch_capacity_rows", 0)
+    parts = [f"{n:,} batch(es)", f"{rows:,} rows"]
+    if cap:
+        parts.append(f"mean fill {rows / cap * 100:.1f}%")
+    padded = counters.get("batch_rows_padded", 0)
+    if padded and rows:
+        parts.append(f"pad overhead {padded / rows * 100:.1f}%")
+    flushes = []
+    for reason in ("budget", "timer", "end"):
+        c = counters.get(f"batch_flushes_{reason}", 0)
+        if c:
+            flushes.append(f"{c} {reason}")
+    if flushes:
+        parts.append("flushes " + " / ".join(flushes))
+    if counters.get("batch_coalesce_faults"):
+        parts.append(
+            f"{counters['batch_coalesce_faults']} coalesce fault(s) "
+            "degraded")
+    return "batching: " + " · ".join(parts)
 
 
 def _render_exchange_line(counters: dict) -> str:

@@ -178,6 +178,40 @@ def _streaming_snapshot() -> dict:
                 "queued_bytes": 0}
 
 
+_BATCHING_IDLE = {
+    "active_actor_pools": 0, "pinned_models": 0, "resident_weight_bytes": 0,
+    "batch_inflight_bytes": 0, "batches_formed": 0, "flushes_budget": 0,
+    "flushes_timer": 0, "flushes_end": 0, "coalesce_faults": 0,
+}
+
+
+def _batching_snapshot() -> dict:
+    """Dynamic-batching view (daft_tpu/batch/) shared by the health
+    snapshot and the gauge mirror — one fallback shape, same contract as
+    ``_streaming_snapshot``."""
+    try:
+        from ..actor_pool import pool_count
+        from ..batch.actors import pinned_model_count, resident_weight_bytes
+        from ..batch.executor import process_counters
+        from ..spill import MEMORY_LEDGER
+
+        c = process_counters()
+        return {
+            "active_actor_pools": pool_count(),
+            "pinned_models": pinned_model_count(),
+            "resident_weight_bytes": resident_weight_bytes(),
+            "batch_inflight_bytes": int(MEMORY_LEDGER.snapshot().get(
+                "batch_inflight", 0)),
+            "batches_formed": c["batches_formed"],
+            "flushes_budget": c["flushes_budget"],
+            "flushes_timer": c["flushes_timer"],
+            "flushes_end": c["flushes_end"],
+            "coalesce_faults": c["coalesce_faults"],
+        }
+    except Exception:
+        return dict(_BATCHING_IDLE)
+
+
 def engine_health() -> dict:
     """One validated snapshot of engine-wide state (see module docstring).
     The metrics-registry mirror is maintained separately by
@@ -227,6 +261,7 @@ def engine_health() -> dict:
         "admission": admission_state(),
         "cluster": cluster_state(),
         "streaming": streaming,
+        "batching": _batching_snapshot(),
         "queries": queries,
         "plan_cache": _plan_cache_snapshot(),
         "query_log": {
@@ -311,6 +346,33 @@ def refresh_health_gauges(registry=None) -> None:
     reg.gauge("daft_tpu_stream_queued_bytes",
               "bytes queued in streaming channels").set(
         strm["queued_bytes"])
+    bat = _batching_snapshot()
+    reg.gauge("daft_tpu_batch_actor_pools",
+              "live actor pools (batching view)").set(
+        bat["active_actor_pools"])
+    reg.gauge("daft_tpu_batch_pinned_models",
+              "model actor pools pinned across queries").set(
+        bat["pinned_models"])
+    reg.gauge("daft_tpu_batch_resident_weight_bytes",
+              "declared weight bytes resident in pinned models").set(
+        bat["resident_weight_bytes"])
+    reg.gauge("daft_tpu_batch_inflight_bytes",
+              "coalesce-buffer bytes awaiting a batch flush").set(
+        bat["batch_inflight_bytes"])
+    reg.gauge("daft_tpu_batch_batches_formed_total",
+              "dynamic batches formed by the coalescer").set(
+        bat["batches_formed"])
+    reg.gauge("daft_tpu_batch_flushes_budget_total",
+              "batches flushed on the row/byte budget").set(
+        bat["flushes_budget"])
+    reg.gauge("daft_tpu_batch_flushes_timer_total",
+              "batches flushed by the max-latency timer").set(
+        bat["flushes_timer"])
+    reg.gauge("daft_tpu_batch_flushes_end_total",
+              "batches flushed at source end").set(bat["flushes_end"])
+    reg.gauge("daft_tpu_batch_coalesce_faults_total",
+              "coalesce failures degraded to the per-partition path").set(
+        bat["coalesce_faults"])
     clu = cluster_state()
     reg.gauge("daft_tpu_cluster_workers_alive",
               "distributed workers currently serving tasks").set(
@@ -455,6 +517,7 @@ _TOP_KEYS = {
     "admission": dict,
     "cluster": dict,
     "streaming": dict,
+    "batching": dict,
     "queries": list,
     "plan_cache": dict,
     "query_log": dict,
@@ -497,6 +560,9 @@ def validate_health(d: dict) -> List[str]:
     for k in ("active_channels", "queued_morsels", "queued_bytes"):
         if not isinstance(d["streaming"].get(k), int):
             errs.append(f"streaming.{k} missing or non-int")
+    for k in _BATCHING_IDLE:
+        if not isinstance(d["batching"].get(k), int):
+            errs.append(f"batching.{k} missing or non-int")
     for k in _PLAN_CACHE_IDLE:
         if not isinstance(d["plan_cache"].get(k), int):
             errs.append(f"plan_cache.{k} missing or non-int")

@@ -54,6 +54,7 @@ _EVENT_COUNTERS = (
     "spill_disk_full", "tasks_speculated", "speculation_wins",
     "telemetry_dropped", "telemetry_truncated",
     "peer_fetches", "peer_refetches", "workers_drained",
+    "batches_formed", "batch_flushes_timer", "batch_rows_padded",
 )
 
 
@@ -215,6 +216,19 @@ def build_record(query_id: str, fingerprint: str, plan_ops: Dict[str, int],
             "short_circuited": counters.get("morsels_short_circuited", 0),
             "ttfr_ms": round(
                 counters.get("time_to_first_row_ns", 0) / 1e6, 3),
+        }
+    if counters.get("batches_formed"):
+        # the dynamic-batching rollup (README "Batched inference");
+        # optional like "streaming": absent when no batch formed
+        rec["batching"] = {
+            "batches": counters.get("batches_formed", 0),
+            "rows": counters.get("batch_rows", 0),
+            "capacity_rows": counters.get("batch_capacity_rows", 0),
+            "rows_padded": counters.get("batch_rows_padded", 0),
+            "flushes_budget": counters.get("batch_flushes_budget", 0),
+            "flushes_timer": counters.get("batch_flushes_timer", 0),
+            "flushes_end": counters.get("batch_flushes_end", 0),
+            "coalesce_faults": counters.get("batch_coalesce_faults", 0),
         }
     if error is not None:
         rec["error_type"] = type(error).__name__

@@ -291,6 +291,102 @@ class ProjectOp(PhysicalOp):
         return "Project: " + summarize_exprs(self.exprs)
 
 
+class BatchedUdfOp(PhysicalOp):
+    """A projection containing batch-declared UDFs (daft_tpu/batch/),
+    routed through the dynamic-batching executor instead of the
+    per-partition UDF path.
+
+    Deliberately NOT a ProjectOp subclass: both fuse passes match
+    ``isinstance(op, (ProjectOp, FilterOp))``, so this op is a fusion
+    barrier by construction — batch-declared UDFs must keep their own op
+    (the batching driver owns their evaluation), while chains above and
+    below still fuse normally.
+
+    Three entry points, all byte-identical:
+      execute()       — local non-streaming driver: coalesces whole
+                        partitions under the budget, re-splits to source
+                        partition boundaries
+      map_partition() — one-partition batched apply; the degrade target,
+                        AND the worker-side entry under the distributed
+                        runner (the op pickles like any map op, so workers
+                        host the pinned model actors process-locally)
+      stream adapter  — stream/pipeline.py builds a BatchingExecutor per
+                        producer and re-splits to morsel boundaries
+    """
+
+    # the batch declaration IS a row-locality contract (see batch_udf),
+    # which is exactly the morsel contract
+    morsel_streamable = True
+    # routing marker: execute_plan sends this op to its own execute()
+    # locally; stream/pipeline.py lifts the UDF decline for it
+    batch_declared = True
+
+    def __init__(self, child: PhysicalOp, exprs: List[Expression], schema: Schema):
+        super().__init__([child], schema, child.num_partitions)
+        self.exprs = exprs
+
+    def _map_exprs(self):
+        return self.exprs
+
+    def _settings(self, ctx):
+        from .batch.executor import BatchSettings
+        from .expressions import expr_batch_udfs
+
+        decl = None
+        for e in self.exprs:
+            udfs = expr_batch_udfs(e)
+            if udfs:
+                decl = udfs[0].batching  # first declaration wins
+                break
+        return BatchSettings.resolve(decl, ctx.cfg)
+
+    def map_partition(self, part, ctx):
+        # whole-partition batched apply: the degrade path and the
+        # distributed worker entry (pinned actors live in the worker)
+        from .batch.device import exec_ctx_scope
+
+        ctx.stats.bump("host_projections")
+        with exec_ctx_scope(ctx):
+            return part.eval_expression_list(self.exprs)
+
+    def execute(self, inputs, ctx) -> PartStream:
+        from .execution import op_resource_request
+
+        if op_resource_request(self):
+            # resource-requested UDFs run under the accountant's admission
+            # window, which is per-partition — skip cross-partition
+            # coalescing rather than hold admission across a batch
+            yield from self._map_execute(inputs, ctx)
+            return
+        from .batch.executor import BatchingExecutor
+
+        bx = BatchingExecutor(self.name(), self.exprs, ctx,
+                              settings=self._settings(ctx))
+        try:
+            for part in inputs[0]:
+                yield from bx.feed(part)
+            yield from bx.finish()
+        finally:
+            # abandoned stream (limit/error above): settle buffered charges
+            bx.abort()
+
+    def describe(self):
+        return "BatchedUdf: " + summarize_exprs(self.exprs)
+
+
+def _route_batched_udfs(op: PhysicalOp) -> PhysicalOp:
+    """Pre-fusion pass: rewrite ProjectOps whose expressions carry a
+    batching declaration into BatchedUdfOp. Runs BEFORE fuse_for_device /
+    fuse_map_chains (which would otherwise fold the projection into a
+    fused map and strand the declaration)."""
+    from .expressions import expr_has_batch_udf
+
+    op.children = [_route_batched_udfs(c) for c in op.children]
+    if type(op) is ProjectOp and any(expr_has_batch_udf(e) for e in op.exprs):
+        return BatchedUdfOp(op.children[0], op.exprs, op.schema)
+    return op
+
+
 class FilterOp(PhysicalOp):
     # row-local predicate: a row's fate depends only on its own values, so
     # morsel-wise compaction concatenates to the partition-granular result
@@ -1852,7 +1948,12 @@ def translate(plan: LogicalPlan, cfg, morsels: bool = False,
     which must therefore stay measurable (README "Plan & program cache")."""
     import time as _time
 
-    out = fuse_for_device(_translate(plan, cfg, morsels), cfg)
+    out = _translate(plan, cfg, morsels)
+    if getattr(cfg, "dynamic_batching", True):
+        # before the fuse passes: a batch-declared projection must become
+        # its own op (and a fusion barrier), not fold into a fused map
+        out = _route_batched_udfs(out)
+    out = fuse_for_device(out, cfg)
     if getattr(cfg, "expr_fusion", True):
         from .fuse import fuse_map_chains
 

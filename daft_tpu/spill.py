@@ -102,6 +102,13 @@ class MemoryLedger:
         # mark is the bench rung's streaming working-set peak.
         self.stream_inflight = 0
         self.stream_inflight_high_water = 0
+        # coalesce-buffer bytes held by the dynamic-batching UDF executor
+        # (batch/coalesce.py) between feed and flush. NOT in `current` for
+        # the prefetch_inflight reason; bounded by batch_max_bytes per
+        # live coalescer, settled at every flush — a nonzero balance after
+        # a query is a leak (tests/test_batch.py pins zero)
+        self.batch_inflight = 0
+        self.batch_inflight_high_water = 0
         # fully-materialized map-task outputs parked in the scheduler's
         # dispatch window (completed, waiting behind the head-of-line task
         # for the consumer to pull): the partition-granular path's "whole
@@ -144,11 +151,15 @@ class MemoryLedger:
         # exactly where cache memory sits
         self.plan_cache_bytes = 0
         self.subplan_cache_bytes = 0
+        # resident pinned-model weight bytes (batch/actors.ModelActorPool;
+        # LRU-evicted past cfg.model_cache_bytes)
+        self.model_cache_bytes = 0
 
     def cache_account(self, account: str, delta: int) -> None:
         """Charge/release one of the process cache accounts
         (``plan_cache_bytes`` / ``subplan_cache_bytes``); clamped at 0."""
-        if account not in ("plan_cache_bytes", "subplan_cache_bytes"):
+        if account not in ("plan_cache_bytes", "subplan_cache_bytes",
+                           "model_cache_bytes"):
             from .errors import DaftValueError
 
             raise DaftValueError(f"unknown cache account {account!r}")
@@ -168,7 +179,8 @@ class MemoryLedger:
         # runs under self._lock (every caller holds it); the lock-discipline
         # rule is lexical and cannot see through the helper
         ws = (self.current + self.stream_inflight
-              + self.prefetch_inflight + self.exec_inflight)
+              + self.prefetch_inflight + self.exec_inflight
+              + self.batch_inflight)
         if ws > self.working_set_high_water:
             self.working_set_high_water = ws  # daftlint: disable=DTL002
 
@@ -241,6 +253,23 @@ class MemoryLedger:
             self.stream_inflight -= done
         if self._parent is not None and done:
             self._parent.stream_done(done)
+
+    # --- dynamic-batching coalesce buffers (batch/coalesce.py) ----------
+    def batch_started(self, n: int) -> None:
+        with self._lock:
+            self.batch_inflight += n
+            if self.batch_inflight > self.batch_inflight_high_water:
+                self.batch_inflight_high_water = self.batch_inflight
+            self._note_working_set_locked()
+        if self._parent is not None:
+            self._parent.batch_started(n)
+
+    def batch_done(self, n: int) -> None:
+        with self._lock:
+            done = min(n, self.batch_inflight)
+            self.batch_inflight -= done
+        if self._parent is not None and done:
+            self._parent.batch_done(done)
 
     # --- parked partition-task outputs (scheduler.dispatch) -------------
     def exec_started(self, n: int) -> None:
@@ -334,6 +363,8 @@ class MemoryLedger:
             self.async_spill_inflight = 0
             self.stream_inflight = 0
             self.stream_inflight_high_water = 0
+            self.batch_inflight = 0
+            self.batch_inflight_high_water = 0
             self.exec_inflight = 0
             self.exec_inflight_high_water = 0
             self.working_set_high_water = 0
@@ -355,6 +386,8 @@ class MemoryLedger:
                 "async_spill_inflight": self.async_spill_inflight,
                 "stream_inflight": self.stream_inflight,
                 "stream_inflight_high_water": self.stream_inflight_high_water,
+                "batch_inflight": self.batch_inflight,
+                "batch_inflight_high_water": self.batch_inflight_high_water,
                 "exec_inflight": self.exec_inflight,
                 "exec_inflight_high_water": self.exec_inflight_high_water,
                 "dist_inflight": self.dist_inflight,
@@ -367,6 +400,7 @@ class MemoryLedger:
                 "disk_full_events": self.disk_full_events,
                 "plan_cache_bytes": self.plan_cache_bytes,
                 "subplan_cache_bytes": self.subplan_cache_bytes,
+                "model_cache_bytes": self.model_cache_bytes,
             }
 
 
@@ -989,6 +1023,7 @@ class PartitionBuffer:
                 and (self.ledger.current + self.ledger.stream_inflight
                      + self.ledger.prefetch_inflight
                      + self.ledger.exec_inflight
+                     + self.ledger.batch_inflight
                      + size > self.budget)):
             spilled = self._try_spill(part, size)
             if spilled is not None:

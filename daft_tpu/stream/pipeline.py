@@ -80,7 +80,12 @@ def _map_streamable(op, ctx) -> bool:
         return False
     if len(op.children) != 1:
         return False
-    if any(expr_has_udf(e) for e in op._map_exprs()):
+    if any(expr_has_udf(e) for e in op._map_exprs()) \
+            and not getattr(op, "batch_declared", False):
+        # batch-declared UDFs (physical.BatchedUdfOp) lift the decline:
+        # the batching declaration IS a row-locality + concurrency
+        # contract, and the producer loop gives each one a per-producer
+        # BatchingExecutor (see _produce_once)
         return False
     if op_resource_request(op):
         return False
@@ -487,36 +492,91 @@ def _produce_with_retry(seg: StreamSegment, part: MicroPartition, chan,
                                         0.05)))
 
 
+def _batch_executors(seg: StreamSegment, ctx) -> dict:
+    """One BatchingExecutor per batch-declared map stage, owned by THIS
+    producer call (one partition): morsels coalesce across morsel
+    boundaries within the partition, outputs re-split to the exact morsel
+    boundaries the unbatched path would have produced."""
+    execs: dict = {}
+    if not getattr(ctx.cfg, "dynamic_batching", True):
+        return execs
+    for i, mop in enumerate(seg.maps):
+        if getattr(mop, "batch_declared", False):
+            from ..batch.executor import BatchingExecutor
+
+            execs[i] = BatchingExecutor(mop.name(), mop.exprs, ctx,
+                                        settings=mop._settings(ctx))
+    return execs
+
+
 def _produce_once(seg: StreamSegment, part: MicroPartition, chan, ctx,
                   stop: threading.Event, morsel_rows: int) -> None:
     stats = ctx.stats
     prof = stats.profiler
     src_name = seg.source.name()
-    t_read = time.perf_counter_ns()
-    for m in iter_morsels(part, morsel_rows):
-        read_ns = time.perf_counter_ns() - t_read
-        if stop.is_set():
-            if getattr(stop, "short_circuit", False):
-                stats.bump("morsels_short_circuited")
-            return
-        sp = (prof.begin("morsel", kind="bg")
-              if prof.armed else None)
-        try:
-            if seg.count_source:
-                # chunk decode happened inside iter_morsels'
-                # pull: attribute it to the (bypassed) source
-                stats.record_op(src_name, len(m), read_ns,
-                                _part_bytes(m))
-            for mop in seg.maps:
+    execs = _batch_executors(seg, ctx)
+
+    def apply_maps(ms, i0):
+        """Run output morsels through maps[i0:]. A batch stage may hold
+        morsels back (still coalescing) or release several at once; every
+        released morsel keeps its source-boundary identity."""
+        for i in range(i0, len(seg.maps)):
+            mop = seg.maps[i]
+            bx = execs.get(i)
+            nxt = []
+            for m in ms:
                 t0 = time.perf_counter_ns()
-                m = mop.map_partition(m, ctx)
-                stats.record_op(mop.name(), len(m),
+                outs = bx.feed(m) if bx is not None \
+                    else [mop.map_partition(m, ctx)]
+                stats.record_op(mop.name(), sum(len(o) for o in outs),
                                 time.perf_counter_ns() - t0,
-                                _part_bytes(m))
-        finally:
-            if sp is not None:
-                sp.set_attr("rows", len(m))
-                prof.end(sp)
-        stats.bump("stream_morsels")
-        chan.put(m, _part_bytes(m))
+                                sum(_part_bytes(o) for o in outs))
+                nxt.extend(outs)
+            ms = nxt
+        return ms
+
+    try:
         t_read = time.perf_counter_ns()
+        for m in iter_morsels(part, morsel_rows):
+            read_ns = time.perf_counter_ns() - t_read
+            if stop.is_set():
+                if getattr(stop, "short_circuit", False):
+                    stats.bump("morsels_short_circuited")
+                return
+            sp = (prof.begin("morsel", kind="bg")
+                  if prof.armed else None)
+            outs = []
+            try:
+                if seg.count_source:
+                    # chunk decode happened inside iter_morsels'
+                    # pull: attribute it to the (bypassed) source
+                    stats.record_op(src_name, len(m), read_ns,
+                                    _part_bytes(m))
+                outs = apply_maps([m], 0)
+            finally:
+                if sp is not None:
+                    sp.set_attr("rows", sum(len(o) for o in outs))
+                    prof.end(sp)
+            stats.bump("stream_morsels")
+            for o in outs:
+                chan.put(o, _part_bytes(o))
+            t_read = time.perf_counter_ns()
+        # partition end: drain each batch stage bottom-up — a lower
+        # stage's tail still flows through every stage above it
+        for i in sorted(execs):
+            if stop.is_set():
+                return
+            t0 = time.perf_counter_ns()
+            tail = execs[i].finish()
+            stats.record_op(seg.maps[i].name(),
+                            sum(len(o) for o in tail),
+                            time.perf_counter_ns() - t0,
+                            sum(_part_bytes(o) for o in tail))
+            for o in apply_maps(tail, i + 1):
+                chan.put(o, _part_bytes(o))
+    finally:
+        # stop/error teardown with morsels still buffered: settle their
+        # ledger charge (a leaked batch_inflight account fails the leak
+        # tests) without running the apply
+        for bx in execs.values():
+            bx.abort()

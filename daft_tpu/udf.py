@@ -5,6 +5,13 @@ run_udf trampoline :82-200). UDFs receive Series (or scalars for literal args) i
 batches and return a Series/list/numpy array; `batch_size` splits long columns;
 class UDFs (stateful) are instantiated once per executor worker and reused —
 the TPU analog of actor pools for `.embed()`-style model UDFs.
+
+Batch-declared UDFs (`@daft_tpu.batch_udf` or `udf(..., batching=...)`) opt in
+to the dynamic-batching subsystem (daft_tpu/batch/): the declaration is the
+user's contract that the fn is row-local, so the engine may coalesce morsels
+into device-friendly batches and re-split the output. Class-target batch UDFs
+route through ModelActorPool (batch/actors.py) so weights load once per
+process and stay resident across queries.
 """
 
 from __future__ import annotations
@@ -18,6 +25,32 @@ from .datatypes import DataType
 from .series import Series
 
 _STATEFUL_INSTANCES: dict = {}
+
+_BATCHING_KEYS = ("max_rows", "max_bytes", "flush_ms", "mode", "device")
+
+
+def _normalize_batching(batching: Any) -> Optional[dict]:
+    """Validate a batching declaration into a plain dict (or None).
+
+    True means "batch with config defaults"; a dict may override any of
+    max_rows / max_bytes / flush_ms / mode ("ragged"|"padded") / device."""
+    if batching is None or batching is False:
+        return None
+    if batching is True:
+        return {}
+    if not isinstance(batching, dict):
+        raise ValueError(
+            f"batching must be True/False or a dict, got {type(batching).__name__}"
+        )
+    bad = [k for k in batching if k not in _BATCHING_KEYS]
+    if bad:
+        raise ValueError(
+            f"unknown batching key(s) {bad!r}; valid keys: {list(_BATCHING_KEYS)}"
+        )
+    mode = batching.get("mode")
+    if mode is not None and mode not in ("ragged", "padded"):
+        raise ValueError(f'batching mode must be "ragged" or "padded", got {mode!r}')
+    return dict(batching)
 
 
 def _coerce_result(out: Any, name: str, dtype: DataType, n: int) -> Series:
@@ -48,16 +81,32 @@ def _coerce_result(out: Any, name: str, dtype: DataType, n: int) -> Series:
 
 def run_udf(fn: Callable, args: List[Series], return_dtype: DataType, n: int,
             batch_size: Optional[int] = None, init_args: Optional[tuple] = None,
-            concurrency: Optional[int] = None) -> Series:
+            concurrency: Optional[int] = None,
+            batching: Optional[dict] = None) -> Series:
     """Evaluate a UDF over column batches (reference: daft/udf.py run_udf).
 
     Stateful (class) UDFs with concurrency>1 run on a persistent actor pool
     (actor_pool.py): one instance per worker, batches dispatched across them,
-    results re-assembled in order."""
+    results re-assembled in order. Batch-declared class UDFs instead pin one
+    instance per process via ModelActorPool (weights resident across queries,
+    LRU-evicted under the ledger's model_cache_bytes account)."""
     from .series import _broadcast_to
 
     name = args[0].name if args else "udf"
     args = [_broadcast_to(a, n) if len(a) != n else a for a in args]
+
+    if batching is not None and inspect.isclass(fn):
+        from .batch.actors import get_model_pool
+
+        pool = get_model_pool(fn, init_args)
+        out = None
+        if batching.get("device"):
+            from .batch.device import device_apply
+
+            out = device_apply(pool, args, n)  # None = host fallback
+        if out is None:
+            out = pool.apply(args, n)
+        return _coerce_result(out, name, return_dtype, n)
 
     if inspect.isclass(fn) and concurrency and concurrency > 1:
         from .actor_pool import get_pool
@@ -94,7 +143,8 @@ class UDF:
     def __init__(self, fn: Callable, return_dtype: DataType,
                  batch_size: Optional[int] = None, concurrency: Optional[int] = None,
                  init_args: Optional[tuple] = None, num_cpus: Optional[float] = None,
-                 num_gpus: Optional[float] = None, memory_bytes: Optional[int] = None):
+                 num_gpus: Optional[float] = None, memory_bytes: Optional[int] = None,
+                 batching: Optional[dict] = None):
         self.fn = fn
         self.return_dtype = return_dtype
         self.batch_size = batch_size
@@ -103,6 +153,7 @@ class UDF:
         self.num_cpus = num_cpus
         self.num_gpus = num_gpus
         self.memory_bytes = memory_bytes
+        self.batching = batching
         self.__name__ = getattr(fn, "__name__", "udf")
 
     def __call__(self, *exprs):
@@ -114,33 +165,80 @@ class UDF:
             rr = (self.num_cpus, self.num_gpus, self.memory_bytes)
         return Expression(PyUdf(self.fn, self.return_dtype, nodes, fn_name=self.__name__,
                                 batch_size=self.batch_size, concurrency=self.concurrency,
-                                init_args=self.init_args, resource_request=rr))
+                                init_args=self.init_args, resource_request=rr,
+                                batching=self.batching))
 
     def with_init_args(self, *args, **kwargs) -> "UDF":
         return UDF(self.fn, self.return_dtype, self.batch_size, self.concurrency,
-                   (args, kwargs), self.num_cpus, self.num_gpus, self.memory_bytes)
+                   (args, kwargs), self.num_cpus, self.num_gpus, self.memory_bytes,
+                   self.batching)
 
     def with_concurrency(self, concurrency: int) -> "UDF":
         return UDF(self.fn, self.return_dtype, self.batch_size, concurrency,
-                   self.init_args, self.num_cpus, self.num_gpus, self.memory_bytes)
+                   self.init_args, self.num_cpus, self.num_gpus, self.memory_bytes,
+                   self.batching)
 
     def override_options(self, *, num_cpus=None, num_gpus=None, memory_bytes=None) -> "UDF":
         return UDF(self.fn, self.return_dtype, self.batch_size, self.concurrency,
                    self.init_args, num_cpus or self.num_cpus, num_gpus or self.num_gpus,
-                   memory_bytes or self.memory_bytes)
+                   memory_bytes or self.memory_bytes, self.batching)
 
 
 def udf(*, return_dtype: DataType, batch_size: Optional[int] = None,
         concurrency: Optional[int] = None, num_cpus: Optional[float] = None,
-        num_gpus: Optional[float] = None, memory_bytes: Optional[int] = None):
+        num_gpus: Optional[float] = None, memory_bytes: Optional[int] = None,
+        batching: Any = None):
     """Decorator creating a UDF (reference: @daft.udf, daft/udf.py:441).
 
     def/class targets both work; class targets are stateful (one instance per
-    worker, like the reference's actor-pool UDFs).
+    worker, like the reference's actor-pool UDFs). Pass `batching=True` (or a
+    dict of overrides) to opt into the dynamic-batching executor — see
+    batch_udf for the dedicated declaration.
     """
 
     def wrap(fn):
         return UDF(fn, return_dtype, batch_size, concurrency,
-                   num_cpus=num_cpus, num_gpus=num_gpus, memory_bytes=memory_bytes)
+                   num_cpus=num_cpus, num_gpus=num_gpus, memory_bytes=memory_bytes,
+                   batching=_normalize_batching(batching))
+
+    return wrap
+
+
+def batch_udf(*, return_dtype: DataType, max_rows: Optional[int] = None,
+              max_bytes: Optional[int] = None, flush_ms: Optional[float] = None,
+              mode: Optional[str] = None, device: bool = False,
+              concurrency: Optional[int] = None,
+              num_cpus: Optional[float] = None, num_gpus: Optional[float] = None,
+              memory_bytes: Optional[int] = None):
+    """Declare a dynamically-batched UDF (daft_tpu/batch/).
+
+    The declaration is a contract that the fn is ROW-LOCAL: output row i
+    depends only on input row i. Under that contract the engine coalesces
+    morsels (and partitions) into device-friendly batches under a byte/row
+    budget with a max-latency flush timer, then re-splits results to exact
+    source boundaries — outputs are byte-identical to the unbatched path.
+
+    Class targets become pinned model actors: __init__ runs once per process
+    (weights loaded once), the instance stays resident across queries keyed
+    by model fingerprint, and eviction is LRU under the ledger's
+    model_cache_bytes budget. `device=True` additionally requests the jit'd
+    apply path behind the device breaker (host fallback on trip).
+    """
+    batching = {}
+    if max_rows is not None:
+        batching["max_rows"] = max_rows
+    if max_bytes is not None:
+        batching["max_bytes"] = max_bytes
+    if flush_ms is not None:
+        batching["flush_ms"] = flush_ms
+    if mode is not None:
+        batching["mode"] = mode
+    if device:
+        batching["device"] = True
+
+    def wrap(fn):
+        return UDF(fn, return_dtype, None, concurrency,
+                   num_cpus=num_cpus, num_gpus=num_gpus, memory_bytes=memory_bytes,
+                   batching=_normalize_batching(batching or True))
 
     return wrap

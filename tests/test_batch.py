@@ -1,0 +1,409 @@
+"""Dynamic-batching executor (ISSUE 18): byte-identity with the knob off
+across the streaming/budget/device matrix, pinned model actors shared by
+concurrent serving queries, fault-site semantics (coalesce degrades,
+actor.load surfaces typed), ledger settlement, and span parentage."""
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, faults
+from daft_tpu.batch.actors import (model_pools_snapshot, pinned_model_count,
+                                   shutdown_all_models)
+from daft_tpu.batch.coalesce import Coalescer
+from daft_tpu.batch.executor import BatchSettings, _next_bucket
+from daft_tpu.context import get_context
+from daft_tpu.errors import DaftError, DaftResourceError
+from daft_tpu.micropartition import MicroPartition
+from daft_tpu.spill import MEMORY_LEDGER
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.disarm()
+    yield
+    faults.disarm()
+    shutdown_all_models()
+
+
+@pytest.fixture
+def cfg():
+    """Fresh ExecutionConfig copy, restored afterwards."""
+    ctx = get_context()
+    old = ctx.execution_config
+    ctx.execution_config = dataclasses.replace(
+        old, enable_result_cache=False, dynamic_batching=True,
+        use_device_kernels=False)
+    yield ctx.execution_config
+    ctx.execution_config = old
+
+
+_INIT_LOCK = threading.Lock()
+
+
+class HostScorer:
+    """Host-only model: no apply_jax, so the device path always declines."""
+
+    weight_bytes = 2048
+    inits = 0
+
+    def __init__(self):
+        with _INIT_LOCK:
+            HostScorer.inits += 1
+
+    def __call__(self, v):
+        return np.asarray(v.to_numpy(), dtype=np.float64) * 2.0 - 3.0
+
+
+class JaxScorer:
+    """Device-capable model: apply_jax mirrors __call__ exactly (values kept
+    small enough that float32 on the device is exact)."""
+
+    weight_bytes = 2048
+    inits = 0
+
+    def __init__(self):
+        with _INIT_LOCK:
+            JaxScorer.inits += 1
+
+    def __call__(self, v):
+        return np.asarray(v.to_numpy(), dtype=np.float64) * 2.0 - 3.0
+
+    @staticmethod
+    def apply_jax(v):
+        return v * 2.0 - 3.0
+
+
+def _declare(cls, **kw):
+    kw.setdefault("flush_ms", 10_000.0)  # no timer nondeterminism in tests
+    return dt.batch_udf(return_dtype=dt.DataType.float64(), **kw)(cls)
+
+
+def _frame(n=4000, parts=4):
+    return (dt.from_pydict({"v": [float(i) for i in range(n)]})
+            .into_partitions(parts))
+
+
+def _run(expr, n=4000, parts=4, **collect_kw):
+    q = _frame(n, parts).select(expr.alias("s")).collect(**collect_kw)
+    return q.to_pydict()["s"], q
+
+
+# ---------------------------------------------------------------------------
+# acceptance: byte-identity matrix — batching on/off x streaming on/off x
+# budget {sub-morsel, multi-morsel, > partition} x {host, breaker-tripped}
+# ---------------------------------------------------------------------------
+
+# 4000 rows in 4 partitions; streaming morsels are 250 rows, so the budgets
+# land below one morsel, across several morsels, and past a whole partition
+_BUDGETS = {"sub_morsel": 100, "multi_morsel": 600, "over_partition": 100_000}
+
+
+class TestByteIdentityMatrix:
+    @pytest.mark.parametrize("streaming", [True, False],
+                             ids=["stream", "nostream"])
+    @pytest.mark.parametrize("budget", sorted(_BUDGETS), ids=sorted(_BUDGETS))
+    @pytest.mark.parametrize("leg", ["host", "breaker_tripped"])
+    def test_matrix(self, cfg, streaming, budget, leg):
+        cfg.streaming_execution = streaming
+        cfg.morsel_size_rows = 250
+        if leg == "breaker_tripped":
+            # device attempts all fail: the breaker trips and every batch
+            # lands on the pinned host instance — identical by construction
+            cfg.use_device_kernels = True
+            cfg.device_breaker_threshold = 1
+            cfg.device_breaker_cooldown_s = 600.0
+            faults.arm("device.kernel", "always")
+        scorer = (_declare(JaxScorer, max_rows=_BUDGETS[budget], device=True)
+                  if leg == "breaker_tripped"
+                  else _declare(HostScorer, max_rows=_BUDGETS[budget]))
+        cfg.dynamic_batching = False
+        want, q_off = _run(scorer(col("v")))
+        cfg.dynamic_batching = True
+        got, q_on = _run(scorer(col("v")))
+        assert got == want
+        c_on, c_off = q_on.stats.counters, q_off.stats.counters
+        assert c_on.get("batches_formed", 0) > 0, c_on
+        assert c_off.get("batches_formed", 0) == 0, c_off
+        if leg == "breaker_tripped":
+            assert c_on.get("batch_device_applies", 0) == 0, c_on
+
+    def test_budget_shapes_batch_counts(self, cfg):
+        """The three budget tiers actually coalesce differently: a
+        sub-morsel budget flushes every piece alone, a multi-morsel budget
+        coalesces a few, an over-partition budget coalesces everything a
+        producer sees."""
+        cfg.streaming_execution = True
+        cfg.morsel_size_rows = 250
+        formed = {}
+        for name, max_rows in _BUDGETS.items():
+            scorer = _declare(HostScorer, max_rows=max_rows)
+            _, q = _run(scorer(col("v")))
+            formed[name] = q.stats.counters.get("batches_formed", 0)
+        # 16 morsels of 250 rows over 4 producers (one per partition)
+        assert formed["sub_morsel"] == 16, formed
+        # 600-row budget: whole-morsel coalescing overshoots at 3 morsels
+        # (750 rows), so each 4-morsel producer forms 2 batches
+        assert formed["multi_morsel"] == 8, formed
+        # over-partition budget: one end-flush per producer
+        assert formed["over_partition"] == 4, formed
+
+    def test_device_success_applies_on_device(self, cfg):
+        """When jax is live and the model opts in, batches run the jit'd
+        apply — and the chosen values are float32-exact, so the result
+        still matches the host oracle."""
+        pytest.importorskip("jax")
+        cfg.streaming_execution = False
+        cfg.use_device_kernels = True
+        scorer = _declare(JaxScorer, max_rows=100_000, device=True)
+        cfg.dynamic_batching = False
+        want, _ = _run(scorer(col("v")))
+        cfg.dynamic_batching = True
+        got, q = _run(scorer(col("v")))
+        assert got == want
+        assert q.stats.counters.get("batch_device_applies", 0) >= 1, \
+            q.stats.counters
+
+    def test_padded_mode_byte_identical_and_counted(self, cfg):
+        cfg.streaming_execution = False
+        scorer = _declare(HostScorer, max_rows=100_000, mode="padded")
+        cfg.dynamic_batching = False
+        want, _ = _run(scorer(col("v")), n=3000, parts=3)
+        cfg.dynamic_batching = True
+        got, q = _run(scorer(col("v")), n=3000, parts=3)
+        assert got == want
+        c = q.stats.counters
+        # 3000 rows pad to the 4096 bucket: 1096 synthetic rows, sliced off
+        assert c.get("batch_rows_padded", 0) == 1096, c
+        assert c.get("batch_capacity_rows", 0) == 4096, c
+
+
+# ---------------------------------------------------------------------------
+# pinned model actors: load-once, warm across queries, shared by
+# concurrent serving queries
+# ---------------------------------------------------------------------------
+
+class TestPinnedActors:
+    def test_three_concurrent_queries_share_one_actor(self, cfg):
+        cfg.streaming_execution = True
+        cfg.morsel_size_rows = 500
+        HostScorer.inits = 0
+        scorer = _declare(HostScorer, max_rows=100_000)
+        want = [float(i) * 2.0 - 3.0 for i in range(4000)]
+        results, errors = {}, []
+
+        def worker(i):
+            try:
+                got, _ = _run(scorer(col("v")))
+                results[i] = got
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert all(results[i] == want for i in range(3))
+        # ONE model instance served all three queries
+        assert HostScorer.inits == 1
+        assert pinned_model_count() == 1
+        (pool,) = model_pools_snapshot()
+        assert pool["applies"] >= 3
+        assert pool["weight_bytes"] == HostScorer.weight_bytes
+
+    def test_model_stays_warm_across_queries(self, cfg):
+        cfg.streaming_execution = False
+        HostScorer.inits = 0
+        scorer = _declare(HostScorer, max_rows=100_000)
+        for _ in range(3):
+            got, _ = _run(scorer(col("v")), n=100, parts=1)
+        assert HostScorer.inits == 1
+        assert pinned_model_count() == 1
+
+    def test_shutdown_unpins_and_releases_charge(self, cfg):
+        cfg.streaming_execution = False
+        scorer = _declare(HostScorer, max_rows=100_000)
+        _run(scorer(col("v")), n=100, parts=1)
+        assert pinned_model_count() == 1
+        before = MEMORY_LEDGER.snapshot()["model_cache_bytes"]
+        assert before >= HostScorer.weight_bytes
+        shutdown_all_models()
+        assert pinned_model_count() == 0
+        after = MEMORY_LEDGER.snapshot()["model_cache_bytes"]
+        assert after == before - HostScorer.weight_bytes
+
+    def test_lru_eviction_over_budget(self, cfg):
+        from daft_tpu.batch.actors import get_model_pool
+
+        cfg.model_cache_bytes = 3000  # fits one 2048-byte model, not two
+        get_model_pool(HostScorer, None)
+        assert pinned_model_count() == 1
+        get_model_pool(JaxScorer, None)  # admits, evicts the LRU (Host)
+        assert pinned_model_count() == 1
+        (pool,) = model_pools_snapshot()
+        assert "JaxScorer" in pool["fingerprint"]
+
+
+# ---------------------------------------------------------------------------
+# fault sites
+# ---------------------------------------------------------------------------
+
+class TestFaultSites:
+    def test_coalesce_fault_degrades_byte_identical(self, cfg):
+        """A batch.coalesce failure degrades THIS executor to the per-piece
+        path: same bytes out, no query failure, fault counted."""
+        cfg.streaming_execution = True
+        cfg.morsel_size_rows = 250
+        scorer = _declare(HostScorer, max_rows=600)
+        cfg.dynamic_batching = False
+        want, _ = _run(scorer(col("v")))
+        cfg.dynamic_batching = True
+        faults.arm("batch.coalesce", "always")
+        got, q = _run(scorer(col("v")))
+        assert got == want
+        c = q.stats.counters
+        assert c.get("batch_coalesce_faults", 0) >= 1, c
+        assert c.get("batches_formed", 0) == 0, c  # every flush degraded
+        # ledger charge settled on the degrade path too
+        assert MEMORY_LEDGER.snapshot()["batch_inflight"] == 0
+
+    def test_coalesce_first_fault_only_degrades_that_producer(self, cfg):
+        cfg.streaming_execution = False  # one executor for the whole query
+        scorer = _declare(HostScorer, max_rows=600)
+        cfg.dynamic_batching = False
+        want, _ = _run(scorer(col("v")))
+        cfg.dynamic_batching = True
+        faults.arm("batch.coalesce", "first_n", n=1)
+        got, q = _run(scorer(col("v")))
+        assert got == want
+        c = q.stats.counters
+        assert c.get("batch_coalesce_faults", 0) == 1, c
+
+    def test_actor_load_fault_is_typed_and_leaves_no_pool(self, cfg):
+        cfg.streaming_execution = False
+        scorer = _declare(HostScorer, max_rows=100_000)
+        faults.arm("actor.load", "always")
+        with pytest.raises(DaftError) as ei:
+            _run(scorer(col("v")), n=100, parts=1)
+        assert isinstance(ei.value, DaftResourceError)
+        assert "HostScorer" in str(ei.value)
+        # no half-initialized pool registered, no residency charged, and
+        # the failed flush's coalesce charge settled despite the raise
+        assert pinned_model_count() == 0
+        assert MEMORY_LEDGER.snapshot()["batch_inflight"] == 0
+        # and the site heals: the same query succeeds once disarmed
+        faults.disarm()
+        got, _ = _run(scorer(col("v")), n=100, parts=1)
+        assert got == [float(i) * 2.0 - 3.0 for i in range(100)]
+        assert pinned_model_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# ledger settlement (acceptance: coalesce buffers charged AND settled)
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_streamed_query_settles_inflight_to_zero(self, cfg):
+        cfg.streaming_execution = True
+        cfg.morsel_size_rows = 250
+        scorer = _declare(HostScorer, max_rows=100_000)
+        _run(scorer(col("v")))
+        snap = MEMORY_LEDGER.snapshot()
+        assert snap["batch_inflight"] == 0
+        # the buffers really were charged while coalescing
+        assert snap["batch_inflight_high_water"] > 0
+
+    def test_coalescer_settles_through_ledger(self):
+        MEMORY_LEDGER.batch_done(MEMORY_LEDGER.snapshot()["batch_inflight"])
+        co = Coalescer(max_rows=10, max_bytes=1 << 40, flush_ms=1e9,
+                       ledger=MEMORY_LEDGER)
+        part = MicroPartition.from_pydict({"x": list(range(6))})
+        assert not co.feed(part)  # buffered: charge outstanding
+        assert MEMORY_LEDGER.snapshot()["batch_inflight"] > 0
+        (f,) = co.feed(part)  # 12 rows >= 10: budget flush
+        assert f.reason == "budget" and f.rows == 12
+        co.settle(f)
+        assert MEMORY_LEDGER.snapshot()["batch_inflight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# spans: batch.coalesce / actor.apply parented to the causing op
+# ---------------------------------------------------------------------------
+
+class TestSpans:
+    @pytest.mark.parametrize("streaming", [True, False],
+                             ids=["stream", "nostream"])
+    def test_batch_spans_present_and_zero_orphans(self, cfg, streaming):
+        cfg.streaming_execution = streaming
+        cfg.morsel_size_rows = 500
+        scorer = _declare(HostScorer, max_rows=100_000)
+        q = (_frame().select(scorer(col("v")).alias("s"))
+             .collect(profile=True))
+        qp = q.profile()
+        assert qp is not None
+        assert qp.orphan_spans == 0
+        spans = qp.spans()
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s.name, []).append(s)
+        assert by_name.get("batch.coalesce"), sorted(by_name)
+        assert by_name.get("actor.apply"), sorted(by_name)
+        sids = {s.sid for s in spans}
+        for s in by_name["batch.coalesce"] + by_name["actor.apply"]:
+            # parented to the causing op's span, and stamped with the op
+            assert s.parent in sids, (s.name, s.parent)
+            assert s.op, s.name
+
+    def test_explain_analyze_batching_line(self, cfg):
+        cfg.streaming_execution = False
+        scorer = _declare(HostScorer, max_rows=100_000)
+        text = (_frame().select(scorer(col("v")).alias("s"))
+                .explain_analyze())
+        assert "batching:" in text
+        assert "batch(es)" in text
+
+
+# ---------------------------------------------------------------------------
+# units: settings resolution, bucket shapes, timer flush
+# ---------------------------------------------------------------------------
+
+class TestUnits:
+    def test_next_bucket_power_of_two(self):
+        assert _next_bucket(1) == 8
+        assert _next_bucket(8) == 8
+        assert _next_bucket(9) == 16
+        assert _next_bucket(3000) == 4096
+
+    def test_settings_declaration_overrides_config(self, cfg):
+        cfg.batch_max_rows = 1111
+        cfg.batch_padding = "ragged"
+        s = BatchSettings.resolve({"max_rows": 7, "mode": "padded"}, cfg)
+        assert s.max_rows == 7 and s.mode == "padded"
+        assert s.max_bytes == cfg.batch_max_bytes
+        d = BatchSettings.resolve(None, cfg)
+        assert d.max_rows == 1111 and d.mode == "ragged"
+
+    def test_timer_flush_with_injected_clock(self):
+        now = [0.0]
+        co = Coalescer(max_rows=10**9, max_bytes=1 << 40, flush_ms=25.0,
+                       clock=lambda: now[0])
+        part = MicroPartition.from_pydict({"x": [1, 2]})
+        assert co.feed(part) == []
+        now[0] = 0.024  # under the deadline: still buffering
+        assert co.feed(part) == []
+        now[0] = 0.050  # oldest is 50ms old: stale run flushes first
+        (f,) = co.feed(part)
+        assert f.reason == "timer" and f.rows == 4
+        (tail,) = co.finish()
+        assert tail.reason == "end" and tail.rows == 2
+
+    def test_batch_udf_rejects_bad_mode(self):
+        with pytest.raises(ValueError):
+            dt.batch_udf(return_dtype=dt.DataType.float64(),
+                         mode="diagonal")(HostScorer)

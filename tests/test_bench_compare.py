@@ -49,6 +49,15 @@ class TestClassify:
         assert classify("distributed_task_redispatches") is None
         assert classify("distributed_workers") is None
 
+    def test_batching_suffixes(self):
+        # ISSUE 18: the batching headline is higher-better (its gate is
+        # ≥ 1.2x on the laion leg), and batch fill is higher-better (the
+        # gate is ≥ 70%); padded-row counts carry no direction (a padded
+        # bucket policy change is not a regression by itself)
+        assert classify("laion_batched_speedup_x") == "higher"
+        assert classify("laion_batch_fill_pct") == "higher"
+        assert classify("laion_batch_rows_padded") is None
+
     def test_telemetry_suffixes(self):
         # ISSUE 15: the cluster-telemetry cost headline is lower-better
         # (its gate is < 3% on the distributed q1 leg); the A/B walls are

@@ -1,0 +1,205 @@
+"""batch-smoke: the dynamic-batching executor validated end to end. Wired
+into `make lint` (and usable alone via `make batch-smoke`) so a coalescer,
+pinned-actor, or surface regression — a batch that splits a morsel, a model
+reloading per query, a gauge going dark, an actor thread leaking past
+shutdown — fails the static-gate path before any production consumer
+trips over it.
+
+Checks, in order:
+ 1. COALESCE: a streamed batched-UDF query whose partition splits into 5
+    morsels forms ONE batch (whole morsels coalesced across boundaries,
+    "end" flush), byte-identical to the same query with the knob off;
+ 2. BUDGET: the same query under a 2000-row budget forms 3 batches
+    (2 budget flushes + 1 end flush), still byte-identical;
+ 3. TIMER: a Coalescer under an injectable clock flushes the stale run
+    with reason "timer" once the oldest buffered morsel exceeds flush_ms;
+ 4. REUSE: a second query hits the SAME pinned model pool (one
+    fingerprint, applies strictly increasing, __init__ ran once);
+ 5. SURFACES: dt.health()["batching"] validates and the daft_tpu_batch_*
+    gauges appear in metrics_text(); the query ledger's batch_inflight
+    account settles to zero (no leaked coalesce charge);
+ 6. SHUTDOWN: dt.shutdown() unpins every model — zero pools, zero
+    resident bytes, zero live "daft-actor" threads.
+
+Exits nonzero with a named failure on any violation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+
+    import daft_tpu as dt
+    from daft_tpu import col
+    from daft_tpu.batch.actors import model_pools_snapshot, pinned_model_count
+    from daft_tpu.batch.coalesce import Coalescer
+    from daft_tpu.context import get_context
+    from daft_tpu.micropartition import MicroPartition
+    from daft_tpu.obs.health import validate_health
+    from daft_tpu.spill import MEMORY_LEDGER
+
+    cfg = get_context().execution_config
+    dt.set_execution_config(streaming_execution=True, dynamic_batching=True,
+                            morsel_size_rows=1000, enable_result_cache=False)
+
+    inits = {"n": 0}
+
+    class Scorer:
+        weight_bytes = 4096
+
+        def __init__(self):
+            inits["n"] += 1
+
+        def __call__(self, v):
+            return np.asarray(v.to_numpy(), dtype=np.float64) * 3.0 + 1.0
+
+    # TWO batching declarations over ONE model class: both share the same
+    # pinned pool (fingerprint = class + init args + device), so the model
+    # loads once no matter how many budgets reference it
+    big = dt.batch_udf(return_dtype=dt.DataType.float64(),
+                       max_rows=10_000, flush_ms=10_000.0)(Scorer)
+    small = dt.batch_udf(return_dtype=dt.DataType.float64(),
+                         max_rows=2000, flush_ms=10_000.0)(Scorer)
+
+    data = {"v": [float(i) for i in range(5000)]}
+
+    def run(fn_expr):
+        q = dt.from_pydict(data).select(fn_expr.alias("s")).collect()
+        return q.to_pydict()["s"], q.stats.snapshot()["counters"]
+
+    try:
+        # 1: coalesce across morsels — 5 morsels of 1000 rows, 10k budget
+        # => ONE end-flushed batch; byte-identical with the knob off
+        got, c1 = run(big(col("v")))
+        dt.set_execution_config(dynamic_batching=False)
+        want, c_off = run(big(col("v")))
+        dt.set_execution_config(dynamic_batching=True)
+        if got != want:
+            print("batch-smoke: FAIL — batched result differs from knob-off")
+            return 1
+        if c1.get("batches_formed") != 1 or c1.get("batch_rows") != 5000:
+            print(f"batch-smoke: FAIL — expected 1 coalesced batch of 5000 "
+                  f"rows, counters: {c1}")
+            return 1
+        if c1.get("batch_flushes_end") != 1:
+            print(f"batch-smoke: FAIL — expected an end flush: {c1}")
+            return 1
+        if c_off.get("batches_formed"):
+            print(f"batch-smoke: FAIL — knob-off run formed batches: {c_off}")
+            return 1
+
+        # 2: budget flushes — 2000-row budget over the same 5 morsels
+        # => 2 budget flushes + 1 end flush, still byte-identical
+        got2, c2 = run(small(col("v")))
+        if got2 != want:
+            print("batch-smoke: FAIL — budget-flushed result differs")
+            return 1
+        if c2.get("batches_formed") != 3 \
+                or c2.get("batch_flushes_budget") != 2 \
+                or c2.get("batch_flushes_end") != 1:
+            print(f"batch-smoke: FAIL — wanted 2 budget + 1 end flush: {c2}")
+            return 1
+
+        # 3: timer flush under an injectable clock (no wall-clock sleeps)
+        now = [0.0]
+        co = Coalescer(max_rows=10**9, max_bytes=1 << 40, flush_ms=25.0,
+                       clock=lambda: now[0])
+        piece = MicroPartition.from_pydict({"x": [1.0, 2.0]})
+        if co.feed(piece):
+            print("batch-smoke: FAIL — first feed flushed prematurely")
+            return 1
+        now[0] = 0.050  # 50ms later: oldest exceeds the 25ms deadline
+        due = co.feed(piece)
+        if len(due) != 1 or due[0].reason != "timer" or due[0].rows != 2:
+            print(f"batch-smoke: FAIL — wanted a 2-row timer flush, got "
+                  f"{[(f.reason, f.rows) for f in due]}")
+            return 1
+        tail = co.finish()
+        if len(tail) != 1 or tail[0].reason != "end":
+            print("batch-smoke: FAIL — finish() did not end-flush the rest")
+            return 1
+
+        # 4: actor reuse across queries — same pinned pool, no re-init
+        pools = model_pools_snapshot()
+        if inits["n"] != 1 or pinned_model_count() != 1:
+            # one instance for the one model class, pinned exactly once
+            # despite several queries (and two budget declarations) over it
+            print(f"batch-smoke: FAIL — wanted 1 pinned model / 1 init, "
+                  f"got {pinned_model_count()} pools, {inits['n']} inits")
+            return 1
+        applies_before = {p["fingerprint"]: p["applies"] for p in pools}
+        got3, _ = run(big(col("v")))
+        if got3 != want:
+            print("batch-smoke: FAIL — warm-actor rerun differs")
+            return 1
+        if inits["n"] != 1 or pinned_model_count() != 1:
+            print(f"batch-smoke: FAIL — rerun re-initialized the model "
+                  f"({inits['n']} inits, {pinned_model_count()} pools)")
+            return 1
+        grew = [p for p in model_pools_snapshot()
+                if p["applies"] > applies_before.get(p["fingerprint"], 0)]
+        if not grew:
+            print("batch-smoke: FAIL — rerun did not go through a pinned "
+                  "actor (applies flat)")
+            return 1
+
+        # 5: surfaces — health section validates, gauges exported, the
+        # coalesce ledger account settled back to zero
+        snap = dt.health()
+        errs = validate_health(snap)
+        if errs:
+            print(f"batch-smoke: FAIL — health schema: {errs}")
+            return 1
+        b = snap["batching"]
+        if b["pinned_models"] != 1 or b["batches_formed"] < 4:
+            print(f"batch-smoke: FAIL — batching section: {b}")
+            return 1
+        text = dt.metrics_text()
+        for gauge in ("daft_tpu_batch_pinned_models",
+                      "daft_tpu_batch_resident_weight_bytes",
+                      "daft_tpu_batch_batches_formed_total",
+                      "daft_tpu_batch_flushes_budget_total",
+                      "daft_tpu_batch_inflight_bytes"):
+            if gauge not in text:
+                print(f"batch-smoke: FAIL — gauge {gauge} missing")
+                return 1
+        inflight = MEMORY_LEDGER.snapshot().get("batch_inflight", 0)
+        if inflight:
+            print(f"batch-smoke: FAIL — batch_inflight leaked {inflight} "
+                  "bytes after queries completed")
+            return 1
+    finally:
+        dt.set_execution_config(
+            streaming_execution=cfg.streaming_execution,
+            dynamic_batching=True,
+            morsel_size_rows=cfg.morsel_size_rows,
+            enable_result_cache=cfg.enable_result_cache)
+        dt.shutdown(timeout_s=5)
+
+    # 6: shutdown unpins everything — no pools, no charge, no threads
+    if pinned_model_count() != 0:
+        print(f"batch-smoke: FAIL — {pinned_model_count()} model pool(s) "
+              "survived dt.shutdown()")
+        return 1
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("daft-actor") and t.is_alive()]
+    if leaked:
+        print(f"batch-smoke: FAIL — leaked actor threads: {leaked}")
+        return 1
+
+    print("batch-smoke: OK — cross-morsel coalesce, budget + timer + end "
+          "flushes, byte-identity with the knob off, warm pinned actors "
+          "across queries, health/gauges, zero leaks after shutdown")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

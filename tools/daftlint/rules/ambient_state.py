@@ -97,6 +97,14 @@ REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
     # worker process, pieces dropped per shuffle id at query finish and
     # cleared whole on worker exit — bounded by live shuffles
     ("daft_tpu/dist/peerplane.py", "_PLANE"),
+    # dynamic-batching subsystem (daft_tpu/batch/): pinned model pools
+    # persist across queries BY DESIGN (weights load once per process,
+    # LRU-bounded by cfg.model_cache_bytes, ledger-accounted, torn down
+    # by dt.shutdown); the jit cache keys compiled applies per model fn;
+    # the flush counters feed dt.health()["batching"] (bounded dict)
+    ("daft_tpu/batch/actors.py", "_model_pools"),
+    ("daft_tpu/batch/device.py", "_jit_cache"),
+    ("daft_tpu/batch/executor.py", "_proc_counts"),
 }
 
 _CONTAINER_CTOR_BASES = {

@@ -91,6 +91,12 @@ _STREAM_DRIVER_FNS = {"_produce_partition", "_produce_with_retry",
 # blind spot exactly when queries get hardest to debug
 _WORKER_TASK_FNS = {"_execute_task"}
 
+# dynamic-batching apply entry point (daft_tpu/batch/executor.py): every
+# coalesced batch runs through here, and its "batch.coalesce"/"actor.apply"
+# spans are what parent batched-UDF work to the causing op — without them
+# batched inference is a per-batch attribution blind spot
+_BATCH_EXEC_FNS = {"_run_flush"}
+
 
 def _delegates_to_stream_driver(fn: ast.FunctionDef) -> bool:
     for node in ast.walk(fn):
@@ -155,6 +161,15 @@ class SpanCoverageRule(Rule):
                             f"worker task entry `{node.name}` opens no "
                             "task-scope profiler span — remote work "
                             "would vanish from the merged cluster trace"))
+                    continue
+                if isinstance(node, ast.FunctionDef) \
+                        and node.name in _BATCH_EXEC_FNS:
+                    if not _execute_is_covered(node):
+                        out.append(self.finding(
+                            rel, node.lineno,
+                            f"batch-executor entry `{node.name}` opens no "
+                            "profiler span — coalesced batch applies must "
+                            "carry batch.coalesce/actor.apply attribution"))
                     continue
                 if not isinstance(node, ast.ClassDef) or \
                         not node.name.endswith("Op"):
