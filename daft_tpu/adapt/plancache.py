@@ -136,6 +136,7 @@ def clone_plan(op, _memo: Optional[dict] = None):
     import copy
 
     from ..fuse.compile import FusedMapOp
+    from ..fuse.segment import DeviceSegmentOp
 
     if _memo is None:
         _memo = {}
@@ -145,6 +146,13 @@ def clone_plan(op, _memo: Optional[dict] = None):
         # the once-per-query chain-counter latch (the program itself is
         # immutable and shared)
         new._recorded = False
+        new._record_lock = threading.Lock()
+    if isinstance(new, DeviceSegmentOp):
+        # same contract for the resident-segment op: fusion-counter latch,
+        # first-resident-success latch; the SegmentProgram is immutable and
+        # shared — a warm hit performs ZERO segment compiles
+        new._recorded = False
+        new._resident_recorded = False
         new._record_lock = threading.Lock()
     ff = getattr(new, "filter_feed", None)
     if ff is not None:

@@ -143,6 +143,15 @@ class ExecutionConfig:
     # device kernel layer (kernels/device.py); host pyarrow path otherwise.
     use_device_kernels: bool = False
     device_min_rows: int = 4096
+    # whole-plan device residency (fuse/segment.py): compile eligible
+    # project->filter->agg plan segments into one HBM-resident pipeline —
+    # the map program's intermediate columns feed the fused aggregation as
+    # DeviceArrays (one host->device stage at segment entry, one gather at
+    # exit, zero Arrow materialization between). Results are byte-identical
+    # with this off; any segment-compile or resident-run failure degrades
+    # to the staged per-op device path. No effect without
+    # use_device_kernels.
+    device_residency: bool = True
     # result cache (PartitionSetCache): off when benchmarking so repeated runs
     # measure execution, not cache lookups
     enable_result_cache: bool = True

@@ -1059,6 +1059,84 @@ class ExecutionContext:
 
         return finish
 
+    def eval_segment(self, part: MicroPartition, op) -> MicroPartition:
+        """Route a compiled plan segment (fuse/segment.py DeviceSegmentOp)
+        through the HBM-resident pipeline when eligible, else the retained
+        staged per-op path — byte-identical either way."""
+        with self.stats.profiler.span("fuse.segment", kind="phase"):
+            fin = self.eval_segment_dispatch(part, op)
+            if fin is not None:
+                return fin()
+            # device-ineligible partition (size/breaker/foreign): plain
+            # routing to the staged pipeline, not a degradation
+            return self._eval_segment_staged(part, op, degraded=False)
+
+    def eval_segment_dispatch(self, part: MicroPartition, op):
+        """Non-blocking launch of the resident segment pipeline; returns a
+        zero-arg resolver (staged fallback inside, truthful counters) or
+        None when this partition is device-ineligible. The whole leg sits
+        behind the DeviceHealth breaker: a launch exception (including an
+        armed ``fuse.segment`` fault) records a breaker failure; a decline
+        releases the probe slot."""
+        if self.foreign_owned(part) and not part.is_loaded():
+            return None
+        if not self._device_eligible(part):
+            return None
+
+        def _launch():
+            from .fuse.segment import run_segment_async
+
+            return run_segment_async(part.table(), op.program,
+                                     part.device_stage_cache(),
+                                     stats=self.stats, cfg=self.cfg)
+
+        resolve = self._device_attempt(_launch, launch=True)
+        if resolve is None:
+            # the resident attempt was made and failed/declined: degraded
+            return lambda: self._eval_segment_staged(part, op, degraded=True)
+        self.stats.bump("device_aggregations")
+        self.stats.bump("segment_dispatches")
+
+        def finish() -> MicroPartition:
+            with self.stats.profiler.span("fuse.segment", kind="phase"):
+                try:
+                    out = resolve()
+                except Exception:
+                    out = None
+                    self.device_health.record_failure(self.stats)
+                if out is not None:
+                    self.device_health.record_success(self.stats)
+                    # ONE boundary crossed resident: the map→agg Arrow
+                    # round-trip of the staged plan did not happen
+                    self.stats.bump("device_handoffs_elided")
+                    op._record_resident(self)
+                    from .fuse.segment import _proc_bump
+
+                    _proc_bump("handoffs_elided")
+                    return MicroPartition.from_table(out)
+                # overflow guard (a decline) or deferred failure: the
+                # segment was NOT executed resident — keep counters truthful
+                self.device_health.release_probe()
+                self.stats.bump("device_aggregations", -1)
+                return self._eval_segment_staged(part, op, degraded=True)
+
+        return finish
+
+    def _eval_segment_staged(self, part: MicroPartition, op,
+                             degraded: bool = True) -> MicroPartition:
+        """The segment as its retained staged ops: the fused map chain,
+        Arrow materialization, then the (filter-fused) aggregation —
+        EXACTLY the plan the segment pass collapsed, so results are
+        byte-identical. `degraded` marks a resident attempt that failed
+        (counted), vs. plain routing of an ineligible partition (not)."""
+        if degraded:
+            self.stats.bump("segment_fallbacks")
+            from .fuse.segment import _proc_bump
+
+            _proc_bump("segment_fallbacks")
+        mid = op.staged_map(part, self)
+        return op.staged_agg(mid, self)
+
     def prepare_broadcast(self, part: MicroPartition, on_exprs,
                           how: str = "inner") -> MicroPartition:
         """Hook for runners with a device mesh: replicate a broadcast-join

@@ -56,6 +56,9 @@ SITES = {
     "fuse.compile": "each map-chain fusion compile (daft_tpu/fuse/; a "
                     "compile-time failure falls back to the unfused op "
                     "chain, never a query failure)",
+    "fuse.segment": "each plan-segment compile AND each resident handoff "
+                    "(daft_tpu/fuse/segment.py; either failure degrades to "
+                    "the staged per-op device path, never a query failure)",
     "join.filter": "each runtime-join-filter build feed / probe prune "
                    "(daft_tpu/exchange/joinfilter.py; any failure degrades "
                    "to the unfiltered exchange, never a query failure)",

@@ -7,17 +7,26 @@ single-pass FusedMap programs (README "Expression fusion").
 - `compile.py` — FusedProgram (host segmented pass / one-jit device
   program), the FusedMapOp physical operator, and the `fuse_map_chains`
   planner pass wired into `physical.translate` behind ``cfg.expr_fusion``.
+- `segment.py` — the plan-segment compiler (README "Device residency"):
+  collapses whole project→filter→agg segments into HBM-resident
+  DeviceSegmentOps behind ``cfg.device_residency``.
 """
 
 from .compile import FusedMapOp, FusedProgram, compile_chain, fuse_map_chains
 from .graph import FusedGraph, FuseDecline, build_fused_graph
+from .segment import (DeviceSegmentOp, SegmentProgram, compile_plan_segments,
+                      run_segment_async)
 
 __all__ = [
+    "DeviceSegmentOp",
     "FusedGraph",
     "FusedMapOp",
     "FusedProgram",
     "FuseDecline",
+    "SegmentProgram",
     "build_fused_graph",
     "compile_chain",
+    "compile_plan_segments",
     "fuse_map_chains",
+    "run_segment_async",
 ]

@@ -1,0 +1,517 @@
+"""Plan-segment compiler: whole project→filter→agg segments stay HBM-resident.
+
+``compile_plan_segments`` (wired into ``physical.translate`` after
+``fuse_for_device``/``fuse_map_chains``, behind ``cfg.device_residency``)
+finds maximal device-eligible segments — an Aggregate (plain or
+filter-fused) whose child is a fused map chain (or a single Project/Filter)
+— and collapses each into one ``DeviceSegmentOp``. At runtime the segment
+executes as a resident pipeline (``run_segment_async``):
+
+- ONE host→device stage at segment entry (the map program's input columns,
+  reused from the partition's HBM residency cache);
+- the map program's outputs — every mask lane and every intermediate
+  column the aggregation reads — stay on device as DeviceArrays and feed
+  the fused aggregation program directly (``env2``), with the mask
+  conjunction acting as the aggregation predicate;
+- ONE device→host gather at segment exit (the aggregated partials).
+
+Zero Arrow materialization happens between the map and the aggregation:
+the ``FusedMapOp → Aggregate`` handoff that previously round-tripped
+Arrow↔DeviceArray is elided (counted as ``device_handoffs_elided``).
+
+Sharding/donation contract: consecutive programs run on the same default
+device with identical size buckets, so the map outputs are consumed by the
+aggregation with no resharding; when every intermediate is provably fresh
+(no bare column passthrough that could alias the partition's residency
+cache) and the backend is not CPU, the intermediate env is donated
+(``donate_argnums``) so XLA reuses its HBM for the reduction outputs.
+
+Invariants (tests/test_segment.py): results are byte-identical with
+``cfg.device_residency`` off; ANY segment-compile or resident-run failure
+— including an armed ``fuse.segment`` fault — degrades to the staged
+per-op path, never a query failure; the whole leg sits behind the existing
+DeviceHealth breaker; warm plan-cache runs perform zero segment compiles
+(the pass runs inside ``translate``, which a warm hit skips entirely).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from .. import faults
+from ..datatypes import DataType
+from ..expressions import Alias, BinaryOp, Column, Expression
+from ..micropartition import MicroPartition
+from ..physical import (
+    AggregateOp,
+    FilterOp,
+    FusedFilterAggregateOp,
+    PhysicalOp,
+    ProjectOp,
+)
+from ..schema import Field, Schema
+from .compile import FusedMapOp, FusedProgram, compile_chain
+from .graph import MASK_PREFIX
+
+__all__ = ["DeviceSegmentOp", "SegmentProgram", "compile_plan_segments",
+           "run_segment_async", "process_counters"]
+
+
+# ---------------------------------------------------------------------------
+# process-level counters (the dt.health() "device" section mirrors these —
+# health snapshots are engine-wide, RuntimeStats is per-query)
+# ---------------------------------------------------------------------------
+
+_PROC_LOCK = threading.Lock()
+_PROC_COUNTERS = {
+    "resident_segments": 0,
+    "handoffs_elided": 0,
+    "segment_fallbacks": 0,
+    "segment_compiles": 0,
+    "hbm_resident_bytes_high_water": 0,
+}
+
+
+def _proc_bump(key: str, n: int = 1) -> None:
+    with _PROC_LOCK:
+        _PROC_COUNTERS[key] += n
+
+
+def _proc_max(key: str, n: int) -> None:
+    with _PROC_LOCK:
+        if n > _PROC_COUNTERS[key]:
+            _PROC_COUNTERS[key] = n
+
+
+def process_counters() -> dict:
+    """Snapshot of the process-wide residency counters (obs/health.py)."""
+    with _PROC_LOCK:
+        return dict(_PROC_COUNTERS)
+
+
+def reset_process_counters() -> None:
+    """Test hook: zero the process-wide residency counters."""
+    with _PROC_LOCK:
+        for k in _PROC_COUNTERS:
+            _PROC_COUNTERS[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# compile-time artifact
+# ---------------------------------------------------------------------------
+
+def _peel(node):
+    while isinstance(node, Alias):
+        node = node.child
+    return node
+
+
+class SegmentProgram:
+    """Everything the resident runtime needs, planned once at translate:
+
+    - ``seg_exprs``: the pruned device map program (mask aliases + only the
+      intermediate columns the aggregation actually reads);
+    - ``inter_schema``: the schema those outputs form (mask lanes as bool
+      fields, so the aggregation's predicate/children normalize against it);
+    - ``specs``/``child_nodes``/``pred_node``/``kinds``/``modes``: the
+      planned aggregation (``_plan_agg_specs`` over ``inter_schema``, the
+      mask conjunction folded into the predicate);
+    - ``gb_inputs``: group keys remapped to the INPUT table's columns —
+      group codes compute over the unfiltered input (rows stay aligned with
+      the mask lanes; the pruning output restores filtered-first-occurrence
+      group order, exactly the staged FusedFilterAggregate semantics);
+    - ``donation_safe``: True when every resident intermediate is provably
+      fresh (no bare column passthrough whose jitted identity could hand
+      back the partition's residency-cache buffer) — the gate for
+      ``donate_argnums`` on the aggregation program.
+
+    The per-binding sharding key of a compiled segment is
+    (nodes, inter_schema, input_names, kinds, modes, segment bucket,
+    x64 mode, donate) — ``_compile_agg``'s cache key — so repeat traffic
+    with the same shape and size bucket reuses ONE XLA executable, and the
+    plan cache (adapt/plancache.py) serves the whole SegmentProgram warm
+    with zero translate/segment-compile calls."""
+
+    __slots__ = ("seg_exprs", "input_schema", "inter_schema", "specs",
+                 "child_nodes", "pred_node", "input_names", "kinds", "modes",
+                 "gb_inputs", "has_groupby", "n_masks", "donation_safe")
+
+    def __init__(self, seg_exprs, input_schema, inter_schema, specs,
+                 child_nodes, pred_node, input_names, kinds, modes,
+                 gb_inputs, n_masks):
+        self.seg_exprs = seg_exprs
+        self.input_schema = input_schema
+        self.inter_schema = inter_schema
+        self.specs = specs
+        self.child_nodes = tuple(child_nodes)
+        self.pred_node = pred_node
+        self.input_names = tuple(input_names)
+        self.kinds = tuple(kinds)
+        self.modes = tuple(modes)
+        self.gb_inputs = list(gb_inputs)
+        self.has_groupby = bool(gb_inputs)
+        self.n_masks = n_masks
+        self.donation_safe = all(
+            not isinstance(_peel(e._node), Column) for e in seg_exprs)
+
+
+def _map_program_for(child: PhysicalOp) -> Optional[FusedProgram]:
+    """The device map program of the segment's map stage: a FusedMapOp
+    carries one already; a lone Project/Filter (below the 2-op fusion
+    threshold) compiles through the same ``compile_chain`` machinery."""
+    if isinstance(child, FusedMapOp):
+        return child.program
+    base = child.children[0]
+    if isinstance(child, ProjectOp):
+        stages: List[Tuple] = [("project", list(child.exprs))]
+    elif isinstance(child, FilterOp):
+        stages = [("filter", child.predicate)]
+    else:
+        return None
+    return compile_chain(stages, base.schema, child.schema)
+
+
+def _try_compile_segment(op, child, cfg) -> Optional[SegmentProgram]:
+    """One segment compile, or None to keep the staged ops. EVERY failure
+    mode lands here — including an armed ``fuse.segment`` fault — and
+    degrades to the per-op plan, never a query failure."""
+    from ..kernels.device import (device_required_columns, epoch_cmps_for,
+                                  normalize_and_check)
+    from ..kernels.device_agg import _ExprView, _plan_agg_specs
+
+    try:
+        faults.check("fuse.segment")
+        program = _map_program_for(child)
+        if program is None or program.device_exprs is None:
+            return None
+        input_schema = child.children[0].schema
+        if normalize_and_check(program.device_exprs, input_schema) is None:
+            return None
+
+        # the intermediate schema the aggregation normalizes against:
+        # mask lanes first (bool), then the map chain's output columns
+        inter_fields = [Field(f"{MASK_PREFIX}{i}", DataType.bool())
+                        for i in range(program.n_masks)]
+        inter_fields += [Field(f.name, f.dtype) for f in child.schema]
+        inter_schema = Schema(inter_fields)
+
+        # group keys must be bare passthroughs of input columns: codes are
+        # computed over the UNFILTERED input table, so the key values must
+        # exist there unchanged (computed keys would need the intermediate
+        # gathered back to host — exactly the handoff this pass deletes)
+        out_nodes = dict(program.graph.device_outputs)
+        gb_inputs: List[Expression] = []
+        for e in (getattr(op, "groupby", None) or []):
+            node = _peel(e._node)
+            if not isinstance(node, Column):
+                return None
+            mapped = out_nodes.get(node.cname)
+            if mapped is None:
+                return None
+            mapped = _peel(mapped)
+            if not isinstance(mapped, Column):
+                return None
+            gb_inputs.append(
+                Expression(Alias(Column(mapped.cname), e._node.name())))
+
+        # mask conjunction (+ a fused filter's predicate) becomes the
+        # aggregation predicate: masked segment reductions + the pruning
+        # output replace the staged path's host compaction
+        pred = None
+        for i in range(program.n_masks):
+            m = Column(f"{MASK_PREFIX}{i}")
+            pred = m if pred is None else BinaryOp("&", pred, m)
+        if isinstance(op, FusedFilterAggregateOp):
+            pnode = op.predicate._node
+            pred = pnode if pred is None else BinaryOp("&", pred, pnode)
+
+        planned = _plan_agg_specs(
+            list(op.aggregations), inter_schema,
+            predicate=_ExprView(pred) if pred is not None else None)
+        if planned is None:
+            return None
+        specs, child_nodes, pred_nodes = planned
+        pred_node = pred_nodes[0] if pred_nodes else None
+
+        # residency gates: the aggregation env is built purely from the map
+        # program's on-device outputs — no dictionaries, no host-evaluated
+        # epoch lanes — so anything needing those declines here
+        check_nodes = list(child_nodes) + (
+            [pred_node] if pred_node is not None else [])
+        if epoch_cmps_for(check_nodes, inter_schema):
+            return None
+        needed = sorted(device_required_columns(check_nodes, inter_schema))
+        if not needed:
+            return None  # nothing resident to hand off: no segment to win
+        for nm in needed:
+            if inter_schema[nm].dtype.is_string():
+                return None  # string lanes need the dictionaries host-side
+        needed_set = set(needed)
+        seg_exprs = [e for e in program.device_exprs
+                     if e.name() in needed_set]
+        if not seg_exprs:
+            return None
+
+        kinds = tuple(s[1] for s in specs)
+        modes = tuple(s[3] for s in specs)
+        return SegmentProgram(seg_exprs, input_schema, inter_schema, specs,
+                              child_nodes, pred_node, tuple(needed), kinds,
+                              modes, gb_inputs, program.n_masks)
+    except Exception:
+        return None
+
+
+def compile_plan_segments(op: PhysicalOp, cfg, stats=None) -> PhysicalOp:
+    """Planner pass (physical.translate, after fuse_for_device +
+    fuse_map_chains): collapse each eligible Aggregate-over-map-chain into
+    one DeviceSegmentOp. ``segment_compiles`` counts real compiles — a warm
+    plan-cache hit skips translate entirely, so warm runs pin at zero."""
+    for i, c in enumerate(op.children):
+        op.children[i] = compile_plan_segments(c, cfg, stats)
+    if isinstance(op, (AggregateOp, FusedFilterAggregateOp)):
+        child = op.children[0]
+        if isinstance(child, (FusedMapOp, ProjectOp, FilterOp)):
+            prog = _try_compile_segment(op, child, cfg)
+            if prog is not None:
+                if stats is not None:
+                    stats.bump("segment_compiles")
+                _proc_bump("segment_compiles")
+                return DeviceSegmentOp(child, op, prog)
+    return op
+
+
+# ---------------------------------------------------------------------------
+# the physical operator
+# ---------------------------------------------------------------------------
+
+class DeviceSegmentOp(PhysicalOp):
+    """A project→filter→agg plan segment compiled for whole-segment device
+    residency. Executes through ``ExecutionContext.eval_segment``: the
+    resident pipeline when the partition is device-eligible, the retained
+    staged ops (``map_op`` then ``agg_op``) otherwise — byte-identical
+    either way. NOT morsel-streamable: the aggregation is a pipeline
+    breaker; the morsel stream runs BELOW it (device-morsel mode in
+    stream/pipeline.py) and re-chunks at this op's boundary."""
+
+    morsel_streamable = False
+
+    def __init__(self, map_op: PhysicalOp, agg_op: PhysicalOp,
+                 program: SegmentProgram):
+        super().__init__([map_op.children[0]], agg_op.schema,
+                         map_op.children[0].num_partitions)
+        self.map_op = map_op
+        self.agg_op = agg_op
+        self.program = program
+        self._recorded = False
+        self._resident_recorded = False
+        self._record_lock = threading.Lock()
+
+    def __getstate__(self):
+        # per-process coordination state, not program identity (the same
+        # contract as FusedMapOp: a shipped op records against the
+        # receiving process's stats)
+        state = dict(self.__dict__)
+        state.pop("_record_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._record_lock = threading.Lock()
+
+    def _record(self, ctx) -> None:
+        """Once per query: the fusion counters the staged plan would have
+        bumped (the chain IS still fused — residency only changes where its
+        outputs live), so counter-level dashboards read identically with
+        residency on or off."""
+        if self._recorded:
+            return
+        with self._record_lock:
+            if self._recorded:
+                return
+            self._recorded = True
+        if isinstance(self.map_op, FusedMapOp):
+            g = self.map_op.program.graph
+            ctx.stats.bump("fused_chains")
+            ctx.stats.bump("fused_ops_eliminated", g.n_ops - 1)
+            if g.cse_hits:
+                ctx.stats.bump("cse_hits", g.cse_hits)
+            if ctx.stats.profiler.armed:
+                ctx.stats.profiler.event(
+                    "fusion", ops=g.n_ops, cse_hits=g.cse_hits,
+                    device_program=True)
+
+    def _record_resident(self, ctx) -> None:
+        """Once per query, on the FIRST successful resident execution."""
+        if self._resident_recorded:
+            return
+        with self._record_lock:
+            if self._resident_recorded:
+                return
+            self._resident_recorded = True
+        ctx.stats.bump("device_resident_segments")
+        _proc_bump("resident_segments")
+
+    # ----------------------------------------------------------- execution
+    def map_partition(self, part, ctx):
+        self._record(ctx)
+        return ctx.eval_segment(part, self)
+
+    def map_partition_dispatch(self, part, ctx):
+        self._record(ctx)
+        return ctx.eval_segment_dispatch(part, self)
+
+    def map_partition_declined(self, part, ctx):
+        # dispatch already proved this partition device-ineligible: plain
+        # routing to the staged per-op pipeline, NOT a degradation
+        return ctx._eval_segment_staged(part, self, degraded=False)
+
+    def staged_map(self, part, ctx):
+        """The staged map stage, WITHOUT re-recording the fusion counters
+        (this op's ``_record`` already did — FusedMapOp.map_partition has
+        its own once-per-query latch that a fallback must not double-bump)."""
+        if isinstance(self.map_op, FusedMapOp):
+            return ctx.eval_fused(part, self.map_op.program)
+        return self.map_op.map_partition(part, ctx)
+
+    def staged_agg(self, mid, ctx):
+        return self.agg_op.map_partition(mid, ctx)
+
+    def map_empty(self, ctx):
+        # same contract as the staged AggregateOp: a global agg over zero
+        # partitions still yields one row (count=0, sum=null, ...)
+        if not (getattr(self.agg_op, "groupby", None) or []):
+            yield MicroPartition.empty(self.map_op.schema).agg(
+                self.agg_op.aggregations, None)
+
+    def _map_exprs(self):
+        return list(self.map_op._map_exprs()) + list(self.agg_op._map_exprs())
+
+    def execute(self, inputs, ctx):
+        self._record(ctx)
+        return self._map_execute(inputs, ctx)
+
+    def describe(self) -> str:
+        p = self.program
+        return (f"DeviceSegment[{len(p.seg_exprs)} resident col(s), "
+                f"{p.n_masks} mask(s)]: {self.map_op.describe()} => "
+                f"{self.agg_op.describe()}")
+
+
+# ---------------------------------------------------------------------------
+# the resident runtime
+# ---------------------------------------------------------------------------
+
+def run_segment_async(table, prog: SegmentProgram,
+                      stage_cache: Optional[dict], stats=None, cfg=None):
+    """Dispatch one partition through the resident segment pipeline:
+    stage inputs → launch the map program → feed its on-device outputs
+    straight into the fused aggregation program → return a zero-arg
+    resolver for the ONE result fetch. Returns None when this partition is
+    resident-ineligible (the caller degrades to the staged per-op path);
+    raises only for real device failures (the breaker's concern)."""
+    import jax
+
+    from ..kernels.device import _stage_and_run, int64_wrap_safe, size_bucket
+    from ..kernels.device_agg import (_compile_agg, _finish_agg,
+                                      group_codes_cached)
+
+    # runtime firing point of the fuse.segment fault site: the resident
+    # handoff (the compile-time firing point is _try_compile_segment)
+    faults.check("fuse.segment", stats)
+
+    n = len(table)
+    if n == 0:
+        return None
+
+    staged = _stage_and_run(table, prog.seg_exprs, stage_cache)
+    if staged is None:
+        return None
+    outs, _dts, _nodes, _dcs, _aux = staged  # async: device computes already
+    env2 = {e.name(): out for e, out in zip(prog.seg_exprs, outs)}
+
+    b = size_bucket(n)
+    check_nodes = list(prog.child_nodes) + (
+        [prog.pred_node] if prog.pred_node is not None else [])
+    # the wrap guard runs over the INTERMEDIATE env (stage_cache=None: these
+    # lanes are fresh compute, not cacheable staged columns — and must not
+    # collide cache keys with same-named input columns)
+    if not int64_wrap_safe(check_nodes, prog.inter_schema, env2, None, b):
+        return None
+
+    # group codes over the INPUT table: rows stay aligned with the mask
+    # lanes (no compaction happened); the pruning output below restores the
+    # filtered first-occurrence group order the host path produces
+    codes_dev, uniq, num_groups = group_codes_cached(
+        table, prog.gb_inputs, stage_cache, n, b, stats)
+    gbk = max(16, 1 << (num_groups - 1).bit_length())
+
+    use_pallas = bool(getattr(cfg, "use_pallas_segment_sums", False))
+    use_deep = bool(getattr(cfg, "use_pallas_deep_fusion", False))
+    # donation: only fresh intermediates (donation_safe), never on the CPU
+    # backend (jax warns + no-ops), and never when XLA could see one buffer
+    # twice (duplicate outputs would be a double donation)
+    donate = prog.donation_safe and jax.default_backend() != "cpu"
+    if donate:
+        bufs = [id(a) for vm in env2.values() for a in vm]
+        donate = len(set(bufs)) == len(bufs)
+
+    run = _compile_agg(prog.child_nodes, prog.pred_node, prog.inter_schema,
+                       prog.input_names, prog.kinds, prog.modes, gbk,
+                       use_pallas, use_deep, donate=donate)
+
+    nkey = ("nrows", n)
+    n_dev = stage_cache.get(nkey) if stage_cache is not None else None
+    if n_dev is None:
+        import jax.numpy as jnp
+
+        n_dev = jnp.int32(n)
+        if stage_cache is not None:
+            stage_cache[nkey] = n_dev
+
+    hbm = sum(int(v.nbytes) + int(m.nbytes) for v, m in env2.values())
+    if stats is not None:
+        stats.bump_max("hbm_resident_bytes_high_water", hbm)
+    _proc_max("hbm_resident_bytes_high_water", hbm)
+
+    outs_dev = run(env2, codes_dev, n_dev)  # async: device computes from here
+
+    def resolve():
+        import numpy as np
+
+        from ..schema import Field as _Field
+        from ..schema import Schema as _Schema
+        from ..series import Series
+        from ..table import Table
+
+        got = jax.device_get(outs_dev)
+        out_cols = list(uniq._columns) if uniq is not None else []
+        out_fields = list(uniq.schema) if uniq is not None else []
+        agg_outs = got[:len(prog.specs)]
+        for (alias, kind, agg_node, _mode), out in zip(prog.specs, agg_outs):
+            expected_dt = agg_node.to_field(prog.inter_schema).dtype
+            if expected_dt.is_string():
+                return None  # unreachable: string intermediates declined
+            merged = _finish_agg(kind, out, num_groups, expected_dt, n,
+                                 dictionary=None)
+            if merged is None:
+                return None  # overflow guard tripped: staged path recomputes
+            out_cols.append(merged.rename(alias))
+            out_fields.append(_Field(alias, expected_dt))
+        result = Table(_Schema(out_fields), out_cols)
+        if prog.pred_node is not None and prog.has_groupby:
+            # prune filtered-away groups; order survivors like the host
+            # path (first occurrence within the filtered rows)
+            sel_cnt, first_idx = (np.asarray(a)[:num_groups]
+                                  for a in got[-1])
+            surv = np.nonzero(sel_cnt > 0)[0]
+            order = surv[np.argsort(first_idx[surv], kind="stable")]
+            if len(order) != num_groups \
+                    or (order != np.arange(num_groups)).any():
+                import pyarrow as pa
+
+                result = result.take(Series.from_arrow(
+                    pa.array(order.astype(np.uint64)), "idx"))
+        return result
+
+    return resolve

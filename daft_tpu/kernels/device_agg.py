@@ -216,6 +216,48 @@ def device_distinct_indices(table, keys, stage_cache, n: int):
     return np.asarray(jax.device_get(first_rows))[:num_groups]
 
 
+def group_codes_cached(table, group_by, stage_cache: Optional[dict], n: int,
+                       b: int, stats=None):
+    """(codes_dev, uniq Table|None, num_groups) for ``group_by`` over
+    ``table``, cached with the partition under the stage cache (the
+    dictionary encode over string keys is the dominant per-query host cost
+    on resident data). Device kernel for 1-4 stageable keys, host
+    ``Table._group_codes`` otherwise; ungrouped degenerates to one group.
+    Shared by the staged aggregation path and the resident segment runtime
+    (fuse/segment.py) so both key the SAME cache entries — a staged run
+    warms the resident run and vice versa."""
+    from ..table import _group_codes
+
+    codes_key = ("groupcodes", tuple(e._node._key() for e in group_by), b)
+    cached = stage_cache.get(codes_key) if stage_cache is not None else None
+    if cached is None:
+        if 1 <= len(group_by) <= 4:
+            # stageable keys (int/date values, string dictionary codes,
+            # packed for multi-key): codes computed ON DEVICE (sort +
+            # boundary scan), keeping the O(rows) bookkeeping off the host
+            try:
+                cached = _try_device_group_codes(table, group_by,
+                                                 stage_cache, n)
+            except Exception:
+                cached = None
+            if cached is not None and stats is not None:
+                stats.bump("device_group_codes")
+        if cached is None:
+            if group_by:
+                key_tbl = table.eval_expression_list(list(group_by))
+                codes_np, uniq = _group_codes(key_tbl)
+                num_groups = len(uniq)
+            else:
+                codes_np = np.zeros(n, dtype=np.int64)
+                uniq = None
+                num_groups = 1
+            codes_dev = jnp.asarray(np.pad(codes_np.astype(np.int32), (0, b - n)))
+            cached = (codes_dev, uniq, num_groups)
+        if stage_cache is not None:
+            stage_cache[codes_key] = cached
+    return cached
+
+
 def device_grouped_agg(table, to_agg, group_by, stage_cache: Optional[dict] = None,
                        predicate=None, stats=None):
     """Synchronous fused grouped aggregation on device: dispatch + resolve.
@@ -287,7 +329,7 @@ def device_grouped_agg_async(table, to_agg, group_by,
     or None immediately when ineligible.
     """
     from ..schema import Field, Schema
-    from ..table import Table, _group_codes
+    from ..table import Table
 
     n = len(table)
     if n == 0:
@@ -304,34 +346,8 @@ def device_grouped_agg_async(table, to_agg, group_by,
     # dictionary encode over string keys is the dominant per-query host cost
     # on resident data) ----------------------------------------------------
     b = size_bucket(n)
-    codes_key = ("groupcodes", tuple(e._node._key() for e in group_by), b)
-    cached = stage_cache.get(codes_key) if stage_cache is not None else None
-    if cached is None:
-        if 1 <= len(group_by) <= 4:
-            # stageable keys (int/date values, string dictionary codes,
-            # packed for multi-key): codes computed ON DEVICE (sort +
-            # boundary scan), keeping the O(rows) bookkeeping off the host
-            try:
-                cached = _try_device_group_codes(table, group_by,
-                                                 stage_cache, n)
-            except Exception:
-                cached = None
-            if cached is not None and stats is not None:
-                stats.bump("device_group_codes")
-        if cached is None:
-            if group_by:
-                key_tbl = table.eval_expression_list(list(group_by))
-                codes_np, uniq = _group_codes(key_tbl)
-                num_groups = len(uniq)
-            else:
-                codes_np = np.zeros(n, dtype=np.int64)
-                uniq = None
-                num_groups = 1
-            codes_dev = jnp.asarray(np.pad(codes_np.astype(np.int32), (0, b - n)))
-            cached = (codes_dev, uniq, num_groups)
-        if stage_cache is not None:
-            stage_cache[codes_key] = cached
-    codes_dev, uniq, num_groups = cached
+    codes_dev, uniq, num_groups = group_codes_cached(table, group_by,
+                                                     stage_cache, n, b, stats)
     gb = max(16, 1 << (num_groups - 1).bit_length())  # static segment bucket
 
     # --- stage inputs -----------------------------------------------------
@@ -458,11 +474,19 @@ class _ExprView:
 
 
 def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb,
-                 use_pallas: bool = False, use_deep: bool = False):
+                 use_pallas: bool = False, use_deep: bool = False,
+                 donate: bool = False):
+    # `donate` hands the env argument's buffers to XLA (donate_argnums):
+    # the resident segment path passes a FRESH intermediate env (the map
+    # program's outputs, never stage-cache entries), so its HBM is reused
+    # for the reduction outputs instead of copied. The staged path keeps
+    # donate=False — its env aliases the partition's residency cache, which
+    # must survive the call. Part of the cache key: the two variants are
+    # different XLA executables.
     key = (tuple(n._key() for n in child_nodes),
            pred_node._key() if pred_node is not None else None,
            tuple((f.name, f.dtype) for f in schema), input_names, kinds, modes,
-           gb, x64_enabled(), use_pallas, use_deep)
+           gb, x64_enabled(), use_pallas, use_deep, donate)
     if key in _AGG_CACHE:
         return _AGG_CACHE[key]
 
@@ -477,7 +501,12 @@ def _compile_agg(child_nodes, pred_node, schema, input_names, kinds, modes, gb,
     from .pallas_ops import (_BLOCK_ROWS, _masked_segment_sums_padded,
                              build_fused_expr_sums)
 
-    @functools.partial(jax.jit, static_argnames=())
+    # donation warns and no-ops on the CPU backend, so it only ever arms on
+    # a real accelerator (the caller additionally gates on the backend)
+    _jit = (functools.partial(jax.jit, donate_argnums=(0,))
+            if donate and jax.default_backend() != "cpu" else jax.jit)
+
+    @_jit
     def run(env, codes, n):
         inbounds = jnp.arange(codes.shape[0], dtype=jnp.int32) < n
         if pred_run is not None:

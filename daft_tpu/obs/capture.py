@@ -122,6 +122,10 @@ def render_runtime_stats(stats) -> str:
     if exch:
         lines.append("")
         lines.append(exch)
+    res = _render_residency_line(counters)
+    if res:
+        lines.append("")
+        lines.append(res)
     if counters:
         lines.append("")
         lines.append("counters: " + ", ".join(
@@ -239,6 +243,25 @@ def _render_exchange_line(counters: dict) -> str:
             f"combine: {counters['exchange_precombined_rows']:,} row(s) "
             "folded pre-exchange")
     return ("exchange: " + " · ".join(parts)) if parts else ""
+
+
+def _render_residency_line(counters: dict) -> str:
+    """The explain_analyze 'residency:' line (README "Device residency"):
+    resident segments executed, operator-boundary handoffs elided, the HBM
+    high-water of the resident intermediates, and degradations to the
+    staged path. Empty when no segment ran resident."""
+    n = counters.get("device_resident_segments", 0)
+    if not n:
+        return ""
+    parts = [f"{n} resident segment(s)",
+             f"{counters.get('device_handoffs_elided', 0)} handoff(s) elided"]
+    hw = counters.get("hbm_resident_bytes_high_water", 0)
+    if hw:
+        parts.append(f"HBM high-water {hw / 1e6:.1f} MB")
+    fb = counters.get("segment_fallbacks", 0)
+    if fb:
+        parts.append(f"{fb} fallback(s) to staged")
+    return "residency: " + " · ".join(parts)
 
 
 # a bundle directory name: <stamp>_<query id>_<outcome>. Retention ONLY

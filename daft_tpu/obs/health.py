@@ -212,6 +212,25 @@ def _batching_snapshot() -> dict:
         return dict(_BATCHING_IDLE)
 
 
+_DEVICE_IDLE = {
+    "resident_segments": 0, "handoffs_elided": 0, "segment_fallbacks": 0,
+    "segment_compiles": 0, "hbm_resident_bytes_high_water": 0,
+}
+
+
+def _device_snapshot() -> dict:
+    """Device-residency view (daft_tpu/fuse/segment.py) shared by the
+    health snapshot and the gauge mirror — one fallback shape, same
+    contract as ``_batching_snapshot``."""
+    try:
+        from ..fuse.segment import process_counters
+
+        c = process_counters()
+        return {k: int(c[k]) for k in _DEVICE_IDLE}
+    except Exception:
+        return dict(_DEVICE_IDLE)
+
+
 def engine_health() -> dict:
     """One validated snapshot of engine-wide state (see module docstring).
     The metrics-registry mirror is maintained separately by
@@ -262,6 +281,7 @@ def engine_health() -> dict:
         "cluster": cluster_state(),
         "streaming": streaming,
         "batching": _batching_snapshot(),
+        "device": _device_snapshot(),
         "queries": queries,
         "plan_cache": _plan_cache_snapshot(),
         "query_log": {
@@ -373,6 +393,22 @@ def refresh_health_gauges(registry=None) -> None:
     reg.gauge("daft_tpu_batch_coalesce_faults_total",
               "coalesce failures degraded to the per-partition path").set(
         bat["coalesce_faults"])
+    dev = _device_snapshot()
+    reg.gauge("daft_tpu_device_resident_segments_total",
+              "plan segments executed HBM-resident end to end").set(
+        dev["resident_segments"])
+    reg.gauge("daft_tpu_device_handoffs_elided_total",
+              "operator-boundary Arrow round-trips elided by residency"
+              ).set(dev["handoffs_elided"])
+    reg.gauge("daft_tpu_device_segment_fallbacks_total",
+              "resident attempts degraded to the staged per-op path").set(
+        dev["segment_fallbacks"])
+    reg.gauge("daft_tpu_device_segment_compiles_total",
+              "plan-segment compiles (warm plan-cache runs add zero)").set(
+        dev["segment_compiles"])
+    reg.gauge("daft_tpu_device_hbm_resident_high_water_bytes",
+              "largest resident intermediate env of any segment").set(
+        dev["hbm_resident_bytes_high_water"])
     clu = cluster_state()
     reg.gauge("daft_tpu_cluster_workers_alive",
               "distributed workers currently serving tasks").set(
@@ -518,6 +554,7 @@ _TOP_KEYS = {
     "cluster": dict,
     "streaming": dict,
     "batching": dict,
+    "device": dict,
     "queries": list,
     "plan_cache": dict,
     "query_log": dict,
@@ -563,6 +600,9 @@ def validate_health(d: dict) -> List[str]:
     for k in _BATCHING_IDLE:
         if not isinstance(d["batching"].get(k), int):
             errs.append(f"batching.{k} missing or non-int")
+    for k in _DEVICE_IDLE:
+        if not isinstance(d["device"].get(k), int):
+            errs.append(f"device.{k} missing or non-int")
     for k in _PLAN_CACHE_IDLE:
         if not isinstance(d["plan_cache"].get(k), int):
             errs.append(f"plan_cache.{k} missing or non-int")

@@ -55,6 +55,7 @@ _EVENT_COUNTERS = (
     "telemetry_dropped", "telemetry_truncated",
     "peer_fetches", "peer_refetches", "workers_drained",
     "batches_formed", "batch_flushes_timer", "batch_rows_padded",
+    "segment_fallbacks",
 )
 
 
@@ -229,6 +230,17 @@ def build_record(query_id: str, fingerprint: str, plan_ops: Dict[str, int],
             "flushes_timer": counters.get("batch_flushes_timer", 0),
             "flushes_end": counters.get("batch_flushes_end", 0),
             "coalesce_faults": counters.get("batch_coalesce_faults", 0),
+        }
+    if counters.get("device_resident_segments"):
+        # the device-residency rollup (README "Device residency");
+        # optional like "streaming": absent when no segment ran resident
+        rec["residency"] = {
+            "resident_segments": counters.get("device_resident_segments", 0),
+            "handoffs_elided": counters.get("device_handoffs_elided", 0),
+            "hbm_high_water_bytes": counters.get(
+                "hbm_resident_bytes_high_water", 0),
+            "segment_compiles": counters.get("segment_compiles", 0),
+            "segment_fallbacks": counters.get("segment_fallbacks", 0),
         }
     if error is not None:
         rec["error_type"] = type(error).__name__

@@ -1961,6 +1961,19 @@ def translate(plan: LogicalPlan, cfg, morsels: bool = False,
         out = fuse_map_chains(out, cfg)
         if stats is not None:
             stats.bump("compile_wall_ns", _time.perf_counter_ns() - t0)
+    if getattr(cfg, "use_device_kernels", False) and getattr(
+            cfg, "device_residency", True):
+        # LAST: the segment compiler consumes the trees the fuse passes
+        # built (Aggregate-over-FusedMap), collapsing each eligible segment
+        # into one HBM-resident DeviceSegmentOp (fuse/segment.py). Part of
+        # the timed compile share — the plan cache's warm path skips it,
+        # which is what pins warm runs at zero segment compiles.
+        from .fuse import compile_plan_segments
+
+        t0 = _time.perf_counter_ns()
+        out = compile_plan_segments(out, cfg, stats)
+        if stats is not None:
+            stats.bump("compile_wall_ns", _time.perf_counter_ns() - t0)
     return out
 
 

@@ -140,10 +140,33 @@ def try_stream(op, ctx, build, trace: bool = True):
     if not getattr(cfg, "streaming_execution", True):
         return None
     if getattr(cfg, "use_device_kernels", False):
-        # the device path wants whole resident partitions: one fused kernel
+        # The device path wants whole resident partitions: one fused kernel
         # over one big buffer beats many small dispatches, and morsel
-        # slices would orphan the HBM residency caches
-        return None
+        # slices would orphan the HBM residency caches. EXCEPT in
+        # device-morsel mode (cfg.device_residency): for segment-shaped
+        # chains — every map device-pipelinable — each morsel stages to a
+        # device batch feeding its own resident program (per-morsel stage
+        # caches, same size-bucketed executables), so streaming composes
+        # with residency instead of standing it down. Mixed chains still
+        # decline: one host-only map would force every morsel through an
+        # Arrow round-trip the partition path avoids.
+        if not getattr(cfg, "device_residency", True):
+            return None
+        probe = extract_segment(op, ctx)
+        if probe is None or not probe.maps or not all(
+                m.device_pipelinable(ctx) for m in probe.maps):
+            return None
+        # only when slicing actually subdivides: a partition at or under
+        # the morsel size already IS one device batch, and the partition-
+        # granular double-buffered dispatch path pipelines it better than
+        # a one-morsel stream would
+        from ..physical import InMemoryOp as _InMem
+        msz = max(1, int(getattr(cfg, "morsel_size_rows", 128 * 1024)))
+        src = probe.source
+        if not (isinstance(src, _InMem)
+                and any((p.num_rows_or_none() or 0) > msz
+                        for p in src.parts)):
+            return None
     if getattr(ctx, "try_device_shuffle", None) is not None \
             or getattr(ctx, "scan_owner", None) is not None:
         # mesh / multi-host: partitions are pinned to devices/processes;

@@ -58,6 +58,16 @@ class TestClassify:
         assert classify("laion_batch_fill_pct") == "higher"
         assert classify("laion_batch_rows_padded") is None
 
+    def test_residency_suffixes(self):
+        # ISSUE 19: the residency headline is higher-better, and so is the
+        # elided host<->device handoff count that explains it (fewer
+        # elisions means segments stopped running resident); fallback
+        # counts carry no direction (an eligibility policy change is not a
+        # regression by itself)
+        assert classify("q1_residency_speedup_x") == "higher"
+        assert classify("q1_device_handoffs_elided") == "higher"
+        assert classify("q1_segment_fallbacks") is None
+
     def test_telemetry_suffixes(self):
         # ISSUE 15: the cluster-telemetry cost headline is lower-better
         # (its gate is < 3% on the distributed q1 leg); the A/B walls are

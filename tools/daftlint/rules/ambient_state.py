@@ -105,6 +105,11 @@ REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
     ("daft_tpu/batch/actors.py", "_model_pools"),
     ("daft_tpu/batch/device.py", "_jit_cache"),
     ("daft_tpu/batch/executor.py", "_proc_counts"),
+    # device-residency process counters (daft_tpu/fuse/segment.py):
+    # fixed-key, lock-guarded dict mirrored into dt.health()["device"] —
+    # engine-wide residency totals outlive any one query by design,
+    # reset only via reset_process_counters()
+    ("daft_tpu/fuse/segment.py", "_PROC_COUNTERS"),
 }
 
 _CONTAINER_CTOR_BASES = {

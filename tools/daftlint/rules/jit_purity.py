@@ -1,7 +1,10 @@
 """DTL001 jit-purity: functions traced by jax.jit must stay pure.
 
 Scope: files under daft_tpu/kernels/, daft_tpu/parallel/, and
-daft_tpu/fuse/ (the fusion compiler emits jit-traced programs). A traced
+daft_tpu/fuse/ — the fusion compiler emits jit-traced programs, and
+fuse/segment.py (the plan-segment compiler) composes them into resident
+pipelines whose donated buffers make any trace-time impurity fatal, not
+just wrong. A traced
 function is one decorated with `@jax.jit` / `@jit` /
 `@functools.partial(jax.jit, ...)`, or passed (by name, lambda, or through
 `jax.shard_map`/`jax.pmap`/`jax.vmap`) to a `jax.jit(...)` call.

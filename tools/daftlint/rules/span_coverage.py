@@ -97,6 +97,13 @@ _WORKER_TASK_FNS = {"_execute_task"}
 # batched inference is a per-batch attribution blind spot
 _BATCH_EXEC_FNS = {"_run_flush"}
 
+# resident-segment executor entry point (daft_tpu/execution.py): every
+# DeviceSegmentOp partition routes through here, and its "fuse.segment"
+# span — parented to the driving op, zero orphans — is what attributes
+# whole-segment resident execution (stage + map + agg + gather as ONE
+# phase) in the merged trace
+_SEGMENT_EXEC_FNS = {"eval_segment"}
+
 
 def _delegates_to_stream_driver(fn: ast.FunctionDef) -> bool:
     for node in ast.walk(fn):
@@ -133,8 +140,9 @@ class SpanCoverageRule(Rule):
     description = ("every *Op.execute(self, inputs, ctx) entry point "
                    "delegates to _map_execute or opens a profiler span; "
                    "morsel_streamable ops implement map_partition; the "
-                   "stream driver's producer and the distributed worker's "
-                   "task entry point open spans")
+                   "stream driver's producer, the distributed worker's "
+                   "task entry point, and the resident-segment executor "
+                   "open spans")
 
     def run(self, project: Project) -> List[Finding]:
         out: List[Finding] = []
@@ -170,6 +178,15 @@ class SpanCoverageRule(Rule):
                             f"batch-executor entry `{node.name}` opens no "
                             "profiler span — coalesced batch applies must "
                             "carry batch.coalesce/actor.apply attribution"))
+                    continue
+                if isinstance(node, ast.FunctionDef) \
+                        and node.name in _SEGMENT_EXEC_FNS:
+                    if not _execute_is_covered(node):
+                        out.append(self.finding(
+                            rel, node.lineno,
+                            f"segment-executor entry `{node.name}` opens "
+                            "no profiler span — HBM-resident segment "
+                            "execution must carry fuse.segment attribution"))
                     continue
                 if not isinstance(node, ast.ClassDef) or \
                         not node.name.endswith("Op"):
