@@ -58,6 +58,9 @@ class QueryHistory:
         self._queries: "OrderedDict[str, dict]" = OrderedDict()
         self._max_sites = max_sites
         self._max_queries = max_queries
+        # monotone write counter: the persist/ artifact leg's dirty
+        # marker (a save is skipped while history did not move)
+        self._mutations = 0
 
     # ----------------------------------------------------------- sites
     def observe(self, site_fp: str, rows: int, nbytes: int) -> None:
@@ -73,6 +76,7 @@ class QueryHistory:
             st.count += 1
             st.last_rows = rows
             st.last_bytes = nbytes
+            self._mutations += 1
             self._sites.move_to_end(site_fp)
             while len(self._sites) > self._max_sites:
                 self._sites.popitem(last=False)
@@ -137,6 +141,7 @@ class QueryHistory:
                                                  prof["stream_morsels"])
                     prof["runs"] = prev["runs"] + 1
                 self._queries[canonical_fp] = prof
+                self._mutations += 1
                 self._queries.move_to_end(canonical_fp)
                 while len(self._queries) > self._max_queries:
                     self._queries.popitem(last=False)
@@ -146,6 +151,47 @@ class QueryHistory:
             from .plancache import PLAN_CACHE
 
             PLAN_CACHE.revalidate(set(obs))
+
+    # ------------------------------------------------- persist artifacts
+    @property
+    def mutations(self) -> int:
+        with self._lock:
+            return self._mutations
+
+    def export(self) -> dict:
+        """Plain-data serialization for the persist/ artifact leg: site
+        EWMA rows (_SiteStats slots as tuples) + query profiles."""
+        with self._lock:
+            return {
+                "sites": {fp: (st.rows, st.bytes, st.count, st.last_rows,
+                               st.last_bytes, st.mispredicts)
+                          for fp, st in self._sites.items()},
+                "queries": {fp: dict(p)
+                            for fp, p in self._queries.items()},
+            }
+
+    def merge(self, data: dict) -> int:
+        """Merge an artifact's export; LIVE keys win (this process's own
+        observations are fresher than any file). Returns keys merged."""
+        n = 0
+        with self._lock:
+            for fp, row in (data.get("sites") or {}).items():
+                if fp in self._sites or len(self._sites) >= self._max_sites:
+                    continue
+                st = _SiteStats()
+                (st.rows, st.bytes, st.count, st.last_rows,
+                 st.last_bytes, st.mispredicts) = row
+                self._sites[fp] = st
+                self._sites.move_to_end(fp, last=False)
+                n += 1
+            for fp, p in (data.get("queries") or {}).items():
+                if fp in self._queries \
+                        or len(self._queries) >= self._max_queries:
+                    continue
+                self._queries[fp] = dict(p)
+                self._queries.move_to_end(fp, last=False)
+                n += 1
+        return n
 
     # ------------------------------------------------------------ admin
     def snapshot(self) -> dict:
@@ -160,6 +206,7 @@ class QueryHistory:
         with self._lock:
             self._sites.clear()
             self._queries.clear()
+            self._mutations += 1  # a clear IS a state change
 
 
 HISTORY = QueryHistory()

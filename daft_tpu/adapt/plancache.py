@@ -184,6 +184,7 @@ class PlanCache:
         self._generation = 0
         self.hits = 0
         self.misses = 0
+        self.inserts = 0
         self.evictions = 0
         self.demotions = 0
         self.errors = 0
@@ -235,6 +236,7 @@ class PlanCache:
             entry.bindings[binding] = cp
             entry.nbytes += cp.nbytes
             self._bytes += cp.nbytes
+            self.inserts += 1
             delta = cp.nbytes - (old.nbytes if old is not None else 0)
             while len(entry.bindings) > _BINDINGS_PER_ENTRY:
                 _, shed = entry.bindings.popitem(last=False)
@@ -348,7 +350,7 @@ class PlanCache:
             self._entries.clear()
             self._inflight.clear()
             self._bytes = 0
-            self.hits = self.misses = 0
+            self.hits = self.misses = self.inserts = 0
             self.evictions = self.demotions = self.errors = 0
         for ev in inflight:
             ev.set()
@@ -371,6 +373,55 @@ class PlanCache:
         if ev is not None:
             ev.set()
 
+    # -------------------------------------------------- persist artifacts
+    def export_artifact(self) -> list:
+        """The persist/ serialization view:
+        ``[(canonical_fp, cfg_key, [(binding, pickled-CompiledPlan)])]``.
+        Per-binding blobs, so one unpicklable plan (exotic closures)
+        skips alone; ``mem#`` bindings (process-local in-memory source
+        tokens) never persist — a fresh process can't hold their data."""
+        import pickle as _pickle
+
+        with self._lock:
+            items = [(key, list(e.bindings.items()))
+                     for key, e in self._entries.items()]
+        out = []
+        for (fp, cfg_key), bindings in items:
+            blobs = []
+            for bk, cp in bindings:
+                if "mem#" in bk:
+                    continue
+                try:
+                    blobs.append((bk, _pickle.dumps(
+                        cp, protocol=_pickle.HIGHEST_PROTOCOL)))
+                except Exception:
+                    continue  # fail open: this binding stays process-only
+            if blobs:
+                out.append((fp, cfg_key, blobs))
+        return out
+
+    def import_artifact(self, entries, cap_bytes: int) -> int:
+        """Merge an artifact's entries; LIVE bindings win (the running
+        process's plans are newer than any file). Lookup counters are NOT
+        touched — hit rates must reflect real query traffic, not the
+        load. Returns bindings merged."""
+        import pickle as _pickle
+
+        n = 0
+        for fp, cfg_key, blobs in entries:
+            for bk, blob in blobs:
+                with self._lock:
+                    e = self._entries.get((fp, cfg_key))
+                    if e is not None and bk in e.bindings:
+                        continue
+                try:
+                    cp = _pickle.loads(blob)
+                except Exception:
+                    continue  # one bad blob is one cold binding
+                self.store(fp, cfg_key, bk, cp, cap_bytes)
+                n += 1
+        return n
+
     # ------------------------------------------------------------- admin
     @property
     def generation(self) -> int:
@@ -386,6 +437,7 @@ class PlanCache:
                 "bytes": self._bytes,
                 "hits": self.hits,
                 "misses": self.misses,
+                "inserts": self.inserts,
                 "evictions": self.evictions,
                 "demotions": self.demotions,
                 "errors": self.errors,
@@ -470,6 +522,14 @@ def plan_query(plan, cfg, stats=None, optimized: bool = False,
             from ..runners import plan_cache_key
 
             faults.check("plancache.lookup", stats)
+            # warm-start: merge any on-disk artifacts before the first
+            # lookup (latched per process; inert without cfg.cache_dir).
+            # Sits BEFORE the any_armed stand-down so an armed
+            # persist.load plan reaches its site and cold-misses there.
+            if getattr(cfg, "cache_dir", None) is not None:
+                from .. import persist
+
+                persist.ensure_loaded(cfg, stats)
             # an armed fault registry stands the cache down entirely: a
             # cached plan would let an armed site (fuse.compile, ...)
             # silently never fire — chaos runs must plan for real

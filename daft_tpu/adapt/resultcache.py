@@ -236,6 +236,7 @@ def try_result_cache(op, ctx, build, trace) -> Optional[Iterator]:
         ctx.stats.bump("subplan_cache_errors")
         logger.warning("subplan_cache_key_failed", error=repr(e))
         return None
+    cap = getattr(cfg, "subplan_cache_bytes", 64 * 1024 * 1024)
     tables = RESULT_CACHE.get(key)
     if tables is not None:
         ctx.stats.bump("subplan_cache_hits")
@@ -243,6 +244,25 @@ def try_result_cache(op, ctx, build, trace) -> Optional[Iterator]:
         if p.armed:
             p.event("resultcache", kind="hit", parts=len(tables))
         return _replay(tables)
+    # memory miss: the persistent disk tier (exact replay or incremental
+    # refresh), which also re-populates the memory tier on a hit. pmeta
+    # is None whenever the tier is off/ineligible — everything below
+    # stays byte-for-byte the PR 13 path.
+    pmeta = None
+    try:
+        from ..persist import resultstore
+
+        pmeta = resultstore.prefix_meta(chain, scan, cfg)
+        if pmeta is not None:
+            tables = resultstore.disk_lookup(pmeta, chain, scan, ctx)
+            if tables is not None:
+                nbytes = sum(t.size_bytes() or 0 for t in tables)
+                RESULT_CACHE.put(key, tables, nbytes, cap)
+                return _replay(tables)
+    except Exception as e:
+        ctx.stats.bump("persist_load_failures")
+        logger.warning("persist_tier_failed", error=repr(e))
+        pmeta = None
     ctx.stats.bump("subplan_cache_misses")
     # build the real stream. The whole chain (op itself included — the
     # recursive build() below re-enters this hook) is marked so neither
@@ -252,8 +272,7 @@ def try_result_cache(op, ctx, build, trace) -> Optional[Iterator]:
     for inner in chain:
         skip.add(id(inner))
     inner_stream = build(op)
-    cap = getattr(cfg, "subplan_cache_bytes", 64 * 1024 * 1024)
-    return _teeing(inner_stream, key, cap, ctx)
+    return _teeing(inner_stream, key, cap, ctx, pmeta)
 
 
 def _replay(tables) -> Iterator:
@@ -263,7 +282,8 @@ def _replay(tables) -> Iterator:
         yield MicroPartition.from_table(t)
 
 
-def _teeing(inner, key: str, cap_bytes: int, ctx) -> Iterator:
+def _teeing(inner, key: str, cap_bytes: int, ctx,
+            pmeta: Optional[dict] = None) -> Iterator:
     """Pass-through that stores the prefix's output on CLEAN exhaustion
     (a limit short-circuit or error never stores a partial prefix).
     Accumulation is byte-bounded: once the running total passes the cap
@@ -310,3 +330,10 @@ def _teeing(inner, key: str, cap_bytes: int, ctx) -> Iterator:
         RESULT_CACHE.errors += 1
         ctx.stats.bump("subplan_cache_errors")
         logger.warning("subplan_cache_store_failed", error=repr(e))
+        return
+    if pmeta is not None:
+        # commit to the durable tier too (its own fault site + fail-open
+        # path live inside disk_store — a persist defect never surfaces)
+        from ..persist import resultstore
+
+        resultstore.disk_store(pmeta, tables, nbytes, ctx)

@@ -388,6 +388,18 @@ def shutdown(timeout_s: float = 10.0) -> dict:
     actor pools, and wait — bounded — for engine worker threads to exit.
     Also registered atexit with a short timeout. Returns
     ``{"stragglers", "leaked_threads", "waited_s"}``."""
+    # flush the warm-start artifact leg FIRST, while the caches are still
+    # whole — the next process's zero-compile warm start rides on this
+    # write landing (fail-open: a persist defect never blocks shutdown)
+    try:
+        from . import persist
+        from .context import get_context
+
+        cfg = get_context().execution_config
+        if persist.enabled(cfg):
+            persist.flush(cfg)
+    except Exception:
+        pass
     from .serve import shutdown as _shutdown
 
     return _shutdown(timeout_s=timeout_s)

@@ -377,6 +377,33 @@ class ExecutionConfig:
     # ... until the cooldown elapses, after which ONE probe partition tries
     # the device again: success re-closes the breaker, failure re-opens it.
     device_breaker_cooldown_s: float = 30.0
+    # --- persistent cache store (daft_tpu/persist/) ------------------------
+    # Directory for durable, cluster-shared cache artifacts. None (the
+    # default) disables ALL persistence — the three legs below only engage
+    # once a cache_dir is set, so the in-process cold/warm contracts stay
+    # exactly as they were. Every leg fails open: any artifact defect
+    # reads as a cold miss, never a query failure.
+    cache_dir: Optional[str] = None
+    # leg 1 — warm-start artifacts: the plan/program cache + FDO history
+    # serialize to versioned, crc-verified files (written on query
+    # completion / dt.shutdown(), loaded lazily at first planning), so a
+    # fresh process serves warm plan-cache hits with zero optimize/
+    # translate/fuse-compile calls
+    persist_artifacts: bool = True
+    # leg 2 — cluster-shared result tier: the sub-plan result cache gains
+    # a spill-IPC on-disk tier (addressed by scan-task key + chain
+    # fingerprint) served worker-to-worker through the PieceServer plane
+    persist_result_store: bool = True
+    # leg 3 — incremental refresh: when a source file's mtime/size moves,
+    # recompute ONLY the affected partitions of a disk-tier entry and
+    # splice them in, instead of discarding the whole entry
+    persist_refresh: bool = True
+    # artifact-directory hygiene: keep only the newest K artifact files
+    # per family (concurrent drivers append, the pruner bounds the dir)
+    persist_keep_last: int = 3
+    # disk-tier byte cap (results/ subdirectory; oldest entries pruned
+    # past it, counted as persist evictions)
+    persist_result_bytes: int = 256 * 1024 * 1024
 
 
 def resolve_executor_threads(cfg: "ExecutionConfig") -> int:

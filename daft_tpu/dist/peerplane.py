@@ -226,7 +226,19 @@ class PieceServer:
                     # fetcher degrades through its recipe path)
                     return
                 key = tuple(msg["key"])
-                hit = _PLANE.get(key, serving=True)
+                if key and key[0] == "rs":
+                    # persistent-result-tier fetch (persist/resultstore):
+                    # same transport, same token, same degradation — a
+                    # defect here reads as not-found and the fetcher
+                    # executes its task for real
+                    try:
+                        from ..persist.resultstore import RESULT_STORE
+
+                        hit = RESULT_STORE.serve_payload(key[1], key[2])
+                    except Exception:
+                        hit = None
+                else:
+                    hit = _PLANE.get(key, serving=True)
                 reply = {"type": "piece", "found": hit is not None}
                 if hit is not None:
                     reply["payload"], reply["rows"] = hit

@@ -145,7 +145,7 @@ class _WorkerHandle:
                  "restarts", "deaths", "breaker", "send_lock", "ops_sent",
                  "rx_thread", "ledger_report", "pid", "tasks_done",
                  "telemetry_rx", "telemetry_dropped", "peer_addr",
-                 "peer_report", "draining", "drained")
+                 "peer_report", "rs_report", "draining", "drained")
 
     def __init__(self, wid: int, breaker: WorkerHealth):
         self.wid = wid
@@ -174,6 +174,10 @@ class _WorkerHandle:
         # worker's pong-piggybacked piece-store snapshot (peerplane.py)
         self.peer_addr: Optional[Tuple[str, int]] = None
         self.peer_report: dict = {}
+        # the worker's pong-piggybacked persistent-result-store report
+        # (persist/resultstore.pong_report): hosted stable digests — the
+        # driver's peer location map — plus tier counters
+        self.rs_report: dict = {}
         # draining: quiescing on request (no new tasks; pieces still
         # served through the grace window); drained: the quiesce finished
         # — this slot's exit is NOT a worker loss
@@ -432,6 +436,7 @@ class WorkerPool:
                 w.peer_addr = (("127.0.0.1", int(peer_port))
                                if peer_port else None)
                 w.peer_report = {}
+                w.rs_report = {}
                 w.draining = False
                 w.drained = False
                 if not initial:
@@ -471,6 +476,9 @@ class WorkerPool:
                             peer = msg.get("peer")
                             if isinstance(peer, dict):
                                 w.peer_report = peer
+                            rs = msg.get("rs")
+                            if isinstance(rs, dict):
+                                w.rs_report = rs
                             tseq = msg.get("tseq")
                             if isinstance(tseq, int):
                                 # the worker attached tseq fragments ever;
@@ -1071,14 +1079,45 @@ class WorkerPool:
             return None
         from .peerplane import peer_preference
 
+        # persistent result tier: address this task's output (stable
+        # digest + exact task key) and name up to two peers whose pongs
+        # report the digest — the worker serves locally, peer-fetches, or
+        # executes + write-throughs. None = plain task (fail-open).
+        extra = None
+        try:
+            from ..persist.resultstore import task_meta
+
+            rs = task_meta(op, part, ctx.cfg)
+            if rs is not None:
+                rs["peers"] = self._rs_peers(rs["sd"])
+                extra = {"rs": rs}
+        except Exception:
+            extra = None
         try:
             return self._execute(payload, part_bytes, ctx, op_name, seq,
+                                 extra=extra,
                                  prefer=peer_preference(part))
         except _LocalFallback:
             with self._cond:
                 self.local_fallbacks_total += 1
             ctx.stats.bump("dist_local_fallbacks")
             return None
+
+    def _rs_peers(self, sd: str) -> list:
+        """Worker slots whose last pong reported hosting this stable
+        digest: ``(wid, host, port)`` rows for the task envelope (top
+        two — one fetch normally suffices; the second is the dead-peer
+        fallback)."""
+        out = []
+        with self._cond:
+            for w in self.workers:
+                if w.state != "ready" or w.peer_addr is None:
+                    continue
+                if sd in (w.rs_report.get("digests") or ()):
+                    out.append((w.wid, w.peer_addr[0], w.peer_addr[1]))
+                if len(out) >= 2:
+                    break
+        return out
 
     def execute_fanout(self, part, spec: dict, ctx, op_name: str,
                        seq: int):
@@ -1496,6 +1535,20 @@ class WorkerPool:
                     if k in peer and isinstance(v, int):
                         peer[k] += v
             peer["shuffles_active"] = len(self._live_shuffles)
+            # fleet-wide persistent-result-tier rollup from the same
+            # pong piggyback (persist/resultstore.pong_report)
+            result_store = {"entries_hosted": 0, "hits": 0, "misses": 0,
+                            "inserts": 0, "peer_serves": 0,
+                            "peer_fetches": 0}
+            for w in self.workers:
+                rs = w.rs_report or {}
+                result_store["entries_hosted"] += len(
+                    rs.get("digests") or ())
+                for k in ("hits", "misses", "inserts", "peer_serves",
+                          "peer_fetches"):
+                    v = rs.get(k)
+                    if isinstance(v, int):
+                        result_store[k] += v
             elastic = {
                 "enabled": int(self._elastic),
                 "workers_target": self.n,
@@ -1544,6 +1597,7 @@ class WorkerPool:
                     self.driver_payload_bytes_total,
                 "workers_drained_total": self.workers_drained_total,
                 "peer_plane": peer,
+                "result_store": result_store,
                 "elastic": elastic,
                 "local_fallbacks_total": self.local_fallbacks_total,
                 "restarts_used": self.restarts_used,

@@ -135,6 +135,21 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
     # during piece pulls land on the worker's RuntimeStats and ride the
     # telemetry fragments back into the driver's per-query rollup
     plane().configure(worker_id, exec_ctx.stats)
+    # persistent result tier (persist/resultstore): one store per worker
+    # slot models one store per node — peer serving between slots on one
+    # host exercises the real fleet-warming path. Fail-open: a persist
+    # defect leaves the worker serving plain tasks.
+    rs_store = None
+    try:
+        if getattr(cfg, "cache_dir", None) is not None \
+                and getattr(cfg, "persist_result_store", True):
+            from ..persist.resultstore import RESULT_STORE as rs_store
+
+            rs_store.configure(os.path.join(
+                os.path.abspath(cfg.cache_dir), f"w{worker_id}"))
+    except Exception as e:
+        rs_store = None
+        log.warning("worker_persist_configure_failed", error=repr(e))
     tasks: "queue.Queue" = queue.Queue()
     inflight = [0]
     op_cache: dict = {}
@@ -163,11 +178,20 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                 if kind == "ping":
                     with send_lock:
                         seq = tel_seq[0]
-                    reply({"type": "pong", "worker_id": worker_id,
-                           "inflight": inflight[0],
-                           "tseq": seq,
-                           "ledger": ledger_report(),
-                           "peer": plane().snapshot()})
+                    pong = {"type": "pong", "worker_id": worker_id,
+                            "inflight": inflight[0],
+                            "tseq": seq,
+                            "ledger": ledger_report(),
+                            "peer": plane().snapshot()}
+                    if rs_store is not None:
+                        # hosted result-tier digests + counters piggyback
+                        # the heartbeat: the driver's location map for
+                        # peer-serving cached prefixes
+                        try:
+                            pong["rs"] = rs_store.pong_report()
+                        except Exception:
+                            pass
+                    reply(pong)
                 elif kind == "task":
                     inflight[0] += 1
                     tasks.put(msg)
@@ -277,6 +301,24 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
                         profile=bool(msg.get("profile")))
                 except Exception:
                     collector = None
+            def _run_map(op=op, part=part, msg=msg):
+                # result-tier hook: serve the task's output from the
+                # local/peer store when the driver attached an rs
+                # address; a miss (or any defect) executes the task for
+                # real and write-throughs — the task IS the recipe
+                rs = msg.get("rs")
+                if rs is not None and rs_store is not None:
+                    from ..persist import resultstore
+
+                    cached = resultstore.worker_lookup(
+                        rs, exec_ctx, token, checksum[0])
+                    if cached is not None:
+                        return cached
+                    res = _execute_task(op, part, exec_ctx, msg)
+                    resultstore.worker_store(rs, res, exec_ctx)
+                    return res
+                return _execute_task(op, part, exec_ctx, msg)
+
             t0 = time.perf_counter_ns()
             # _execute_task fires the worker.task chaos hook: an armed
             # delay plan slows this worker (counted into the reported
@@ -285,12 +327,10 @@ def _serve(sock: socket.socket, worker_id: int, token: str) -> int:
             if collector is not None:
                 with collector:
                     out = (execute_fanout(part, spec, exec_ctx)
-                           if spec is not None
-                           else _execute_task(op, part, exec_ctx, msg))
+                           if spec is not None else _run_map())
             else:
                 out = (execute_fanout(part, spec, exec_ctx)
-                       if spec is not None
-                       else _execute_task(op, part, exec_ctx, msg))
+                       if spec is not None else _run_map())
             wall_ns = time.perf_counter_ns() - t0
             if spec is not None:
                 # a fanout's reply is piece METADATA only — the payload

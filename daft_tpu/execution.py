@@ -1391,6 +1391,18 @@ def _record_query(root: PhysicalOp, ctx: ExecutionContext, query_id: str,
             from .obs.log import get_logger
 
             get_logger("obs").error("history_fold_failed", error=repr(e))
+    if getattr(cfg, "cache_dir", None) is not None:
+        # warm-start artifact leg (daft_tpu/persist/): snapshot the plan
+        # cache + history to disk when they moved this query. maybe_save
+        # is fail-open by contract; the guard here is belt-and-braces.
+        try:
+            from . import persist
+
+            persist.maybe_save(cfg, ctx.stats)
+        except Exception as e:
+            from .obs.log import get_logger
+
+            get_logger("obs").error("persist_save_failed", error=repr(e))
 
 
 def execute_plan(root: PhysicalOp, ctx: ExecutionContext,

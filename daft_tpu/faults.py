@@ -131,6 +131,21 @@ SITES = {
                   "(daft_tpu/batch/actors.py; a failed model load "
                   "surfaces as a typed DaftError naming the model — "
                   "never a hang, never a leaked half-initialized pool)",
+    "persist.load": "each persistent-store read — warm-start artifact "
+                    "load and result disk-tier lookup "
+                    "(daft_tpu/persist/; an injected fault reads as a "
+                    "COLD MISS counted in persist_load_failures — the "
+                    "query plans/executes for real, never an error)",
+    "persist.store": "each persistent-store write — artifact save and "
+                     "result disk-tier insert (daft_tpu/persist/; an "
+                     "injected fault drops the write, counted in "
+                     "persist_store_failures — the query's own result "
+                     "is never affected)",
+    "persist.refresh": "each incremental-refresh splice of a disk-tier "
+                       "entry (daft_tpu/persist/resultstore.py; an "
+                       "injected fault degrades the refresh to a full "
+                       "cold miss — plain recompute, never a stale or "
+                       "partial entry)",
 }
 
 

@@ -218,6 +218,28 @@ _DEVICE_IDLE = {
 }
 
 
+_PERSIST_IDLE = {
+    "artifact_entries": 0, "artifact_bytes": 0, "artifact_loads": 0,
+    "artifact_saves": 0, "load_failures": 0, "store_failures": 0,
+    "evictions": 0, "disk_entries": 0, "disk_bytes": 0, "hits": 0,
+    "misses": 0, "inserts": 0, "refreshes": 0, "partitions_refreshed": 0,
+    "peer_serves": 0, "peer_fetches": 0,
+}
+
+
+def _persist_snapshot() -> dict:
+    """Persistent cache-store view (daft_tpu/persist/): warm-start
+    artifact traffic plus the durable result tier — one fallback shape,
+    same contract as ``_batching_snapshot``."""
+    try:
+        from ..persist import snapshot
+
+        s = snapshot()
+        return {k: int(s.get(k, 0)) for k in _PERSIST_IDLE}
+    except Exception:
+        return dict(_PERSIST_IDLE)
+
+
 def _device_snapshot() -> dict:
     """Device-residency view (daft_tpu/fuse/segment.py) shared by the
     health snapshot and the gauge mirror — one fallback shape, same
@@ -284,6 +306,7 @@ def engine_health() -> dict:
         "device": _device_snapshot(),
         "queries": queries,
         "plan_cache": _plan_cache_snapshot(),
+        "persist": _persist_snapshot(),
         "query_log": {
             "depth": len(QUERY_LOG),
             "capacity": QUERY_LOG.capacity,
@@ -525,6 +548,54 @@ def refresh_health_gauges(registry=None) -> None:
     reg.gauge("daft_tpu_subplan_cache_hits_total",
               "sub-plan result-cache hits (prefixes replayed)").set(
         pc["result_hits"])
+    per = _persist_snapshot()
+    reg.gauge("daft_tpu_persist_artifact_entries",
+              "plan/FDO artifact files on disk").set(
+        per["artifact_entries"])
+    reg.gauge("daft_tpu_persist_artifact_bytes",
+              "bytes held by plan/FDO artifact files").set(
+        per["artifact_bytes"])
+    reg.gauge("daft_tpu_persist_artifact_loads_total",
+              "artifact files loaded into the warm-start caches").set(
+        per["artifact_loads"])
+    reg.gauge("daft_tpu_persist_artifact_saves_total",
+              "artifact files written at query end/shutdown").set(
+        per["artifact_saves"])
+    reg.gauge("daft_tpu_persist_load_failures_total",
+              "persist loads degraded to a cold miss (corrupt/version "
+              "skew/fault; never a query failure)").set(
+        per["load_failures"])
+    reg.gauge("daft_tpu_persist_store_failures_total",
+              "persist stores dropped (query result unaffected)").set(
+        per["store_failures"])
+    reg.gauge("daft_tpu_persist_evictions_total",
+              "persisted entries pruned (keep-last-K / byte cap)").set(
+        per["evictions"])
+    reg.gauge("daft_tpu_persist_result_entries",
+              "result-tier entries on disk").set(per["disk_entries"])
+    reg.gauge("daft_tpu_persist_result_bytes",
+              "bytes held by the durable result tier").set(
+        per["disk_bytes"])
+    reg.gauge("daft_tpu_persist_hits_total",
+              "durable result-tier hits (prefixes replayed from disk)"
+              ).set(per["hits"])
+    reg.gauge("daft_tpu_persist_misses_total",
+              "durable result-tier misses").set(per["misses"])
+    reg.gauge("daft_tpu_persist_inserts_total",
+              "entries written to the durable result tier").set(
+        per["inserts"])
+    reg.gauge("daft_tpu_persist_refreshes_total",
+              "incremental refreshes (entries partially recomputed)"
+              ).set(per["refreshes"])
+    reg.gauge("daft_tpu_persist_partitions_refreshed_total",
+              "partitions recomputed by incremental refresh").set(
+        per["partitions_refreshed"])
+    reg.gauge("daft_tpu_persist_peer_serves_total",
+              "result-tier entries served to peer workers").set(
+        per["peer_serves"])
+    reg.gauge("daft_tpu_persist_peer_fetches_total",
+              "result-tier entries pulled from peer workers").set(
+        per["peer_fetches"])
     adm = admission_state()
     reg.gauge("daft_tpu_admission_active_queries",
               "queries holding an execution slot").set(
@@ -557,6 +628,7 @@ _TOP_KEYS = {
     "device": dict,
     "queries": list,
     "plan_cache": dict,
+    "persist": dict,
     "query_log": dict,
     "log": dict,
     "queries_total": int,
@@ -606,6 +678,9 @@ def validate_health(d: dict) -> List[str]:
     for k in _PLAN_CACHE_IDLE:
         if not isinstance(d["plan_cache"].get(k), int):
             errs.append(f"plan_cache.{k} missing or non-int")
+    for k in _PERSIST_IDLE:
+        if not isinstance(d["persist"].get(k), int):
+            errs.append(f"persist.{k} missing or non-int")
     for k in ("workers", "workers_alive", "workers_restarting",
               "workers_tripped", "tasks_inflight",
               "task_redispatches_total", "worker_losses_total",

@@ -56,6 +56,10 @@ _EVENT_COUNTERS = (
     "peer_fetches", "peer_refetches", "workers_drained",
     "batches_formed", "batch_flushes_timer", "batch_rows_padded",
     "segment_fallbacks",
+    "persist_hits", "persist_inserts", "persist_refreshes",
+    "persist_partitions_refreshed", "persist_peer_fetches",
+    "persist_load_failures", "persist_store_failures",
+    "persist_artifact_loads", "persist_artifact_saves",
 )
 
 

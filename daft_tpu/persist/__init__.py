@@ -1,0 +1,61 @@
+# daftlint: migrated
+"""Persistent cache store: the adapt/ caches survive process restarts.
+
+PR 13's plan/program cache, FDO history, and sub-plan result cache are
+process-level — a fleet that restarts, autoscales, or sees the same plan
+shapes on every driver pays the full optimize/translate/fuse cost and
+re-materializes prefixes the cluster already computed. This package makes
+those three surfaces durable, behind ``cfg.cache_dir`` (default None:
+everything below is inert and the in-process cold/warm contracts are
+byte-for-byte unchanged):
+
+- **warm-start artifacts** (:mod:`.artifacts`): the plan cache and FDO
+  history serialize to versioned, crc-footed on-disk artifacts written on
+  query completion / ``dt.shutdown()`` and loaded lazily at first
+  planning — a fresh process serves warm plan-cache hits with ZERO
+  optimize/translate/fuse-compile calls;
+- **cluster-shared result tier** (:mod:`.resultstore`): the sub-plan
+  result cache grows a spill-IPC-format disk tier addressed by scan-task
+  key + chain fingerprint, served worker-to-worker through the PR 16
+  ``PieceServer`` plane;
+- **incremental refresh** (:mod:`.resultstore`): an overwritten source
+  file recomputes only the affected partitions of a cached entry via
+  lineage-style recipes instead of discarding the whole entry.
+
+The governing discipline (PAPERS.md, reproducible pipelines): persistence
+must never move bytes. Results with the store cold, absent, corrupt, or
+mid-eviction are byte-identical to the store-off run — every defect,
+version skew, checksum mismatch, or armed ``persist.*`` fault site reads
+as a cold miss (counted), never a query failure.
+"""
+
+from __future__ import annotations
+
+from .artifacts import ARTIFACTS, ensure_loaded, flush, maybe_save
+from .resultstore import RESULT_STORE
+
+__all__ = ["ARTIFACTS", "RESULT_STORE", "enabled", "ensure_loaded",
+           "maybe_save", "flush", "snapshot", "reset"]
+
+
+def enabled(cfg) -> bool:
+    """Is ANY persistence leg live? Everything hangs off ``cache_dir``."""
+    return getattr(cfg, "cache_dir", None) is not None
+
+
+def snapshot() -> dict:
+    """The validated ``dt.health()["persist"]`` section: artifact-leg and
+    result-tier counters merged into one all-int dict."""
+    out = ARTIFACTS.snapshot()
+    rs = RESULT_STORE.snapshot()
+    # shared failure counters accumulate across both legs
+    for k, v in rs.items():
+        out[k] = out.get(k, 0) + v if k in out else v
+    return out
+
+
+def reset() -> None:
+    """Tests only: forget load latches and zero every counter so one
+    process can exercise multiple cold/warm cycles."""
+    ARTIFACTS.reset()
+    RESULT_STORE.reset()

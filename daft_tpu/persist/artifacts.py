@@ -1,0 +1,325 @@
+# daftlint: migrated
+"""Warm-start artifacts: the plan cache + FDO history as durable files.
+
+One artifact file is one atomic snapshot of a process's planning state:
+a pickled payload (header + per-binding compiled-plan blobs + history
+export) followed by a crc32 footer and a magic trailer, written as
+``plans-<time_ns>-<pid>.dtpa`` under ``<cache_dir>/artifacts/`` via
+temp-file + ``os.replace`` — readers never see a torn write and no lock
+file exists to go stale. Concurrent drivers sharing a ``cache_dir`` each
+write their own file; the loader merges EVERY valid artifact newest-first
+(existing keys win), and keep-last-K pruning (``cfg.persist_keep_last``)
+bounds the directory.
+
+Invalidation is entirely key-side: the payload header carries
+``ARTIFACT_VERSION`` + ``plancache.CACHE_VERSION`` and the writing
+process's cache generation; the entries carry the full-config cfg_key and
+the exact literal/mtime-bearing bindings. A version skew, crc mismatch,
+short file, or unpicklable blob reads as a cold miss (counted in
+``persist_load_failures``), never an error — and in-memory bindings
+(``mem#`` tokens are process-local) never persist at all.
+
+Fault contract (mirrors the PR 13 cache stand-down): ``persist.load`` /
+``persist.store`` fire first, so an armed plan for THEM degrades this
+layer specifically; any OTHER armed site stands the store down silently —
+chaos runs must plan and execute for real.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import time
+import zlib
+from typing import Optional
+
+from ..errors import DaftCorruptionError
+from ..obs.log import get_logger
+
+__all__ = ["ARTIFACTS", "ArtifactStore", "ARTIFACT_VERSION",
+           "ensure_loaded", "maybe_save", "flush"]
+
+logger = get_logger("persist.artifacts")
+
+# bump when the artifact payload layout changes; older files cold-miss
+ARTIFACT_VERSION = 1
+_MAGIC = b"DTPA"
+_SUFFIX = ".dtpa"
+
+
+def _artifact_dir(cfg) -> str:
+    return os.path.join(os.path.abspath(cfg.cache_dir), "artifacts")
+
+
+def _leg_on(cfg) -> bool:
+    return (getattr(cfg, "cache_dir", None) is not None
+            and getattr(cfg, "persist_artifacts", True))
+
+
+class ArtifactStore:
+    """Process-wide artifact-leg state: per-directory load latches, the
+    dirty marker that suppresses no-op rewrites, and the counters the
+    health section / querylog rollup surface."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._loaded: set = set()       # cache dirs already merged
+        self._marker: Optional[tuple] = None
+        self.artifact_entries = 0       # bindings merged at load
+        self.artifact_bytes = 0         # bytes of the last write/load
+        self.artifact_loads = 0         # valid files merged
+        self.artifact_saves = 0
+        self.load_failures = 0
+        self.store_failures = 0
+        self.evictions = 0              # keep-last-K prunes
+
+    # ----------------------------------------------------------- marker
+    def _current_marker(self) -> tuple:
+        """Cheap fingerprint of the persistable state: a save is skipped
+        while nothing was inserted/demoted/evicted and history did not
+        move — query completion calls land here per query, so the no-op
+        path must stay counter-reads only."""
+        from ..adapt.history import HISTORY
+        from ..adapt.plancache import PLAN_CACHE
+
+        return (PLAN_CACHE.inserts, PLAN_CACHE.demotions,
+                PLAN_CACHE.evictions, PLAN_CACHE.generation,
+                HISTORY.mutations)
+
+    # ------------------------------------------------------------- load
+    def ensure_loaded(self, cfg, stats=None) -> None:
+        """Merge every valid artifact under ``cfg.cache_dir`` into the
+        live plan cache / history, once per directory per process. Never
+        raises; every defect is a counted cold miss."""
+        if not _leg_on(cfg):
+            return
+        try:
+            d = _artifact_dir(cfg)
+            with self._lock:
+                if d in self._loaded:
+                    return
+            from .. import faults
+
+            try:
+                faults.check("persist.load", stats)
+            except faults.InjectedFault:
+                # the armed-load plan's pinned effect: this process plans
+                # cold (the latch still sets — re-probing a failed store
+                # every query would turn one fault into a planning tax)
+                self.load_failures += 1
+                if stats is not None:
+                    stats.bump("persist_load_failures")
+                with self._lock:
+                    self._loaded.add(d)
+                return
+            if faults.any_armed():
+                # any OTHER armed site: stand down WITHOUT latching, so a
+                # later un-armed query still warm-starts
+                return
+            with self._lock:
+                if d in self._loaded:
+                    return
+                self._loaded.add(d)
+            self._load_dir(d, cfg, stats)
+            # the just-loaded state is the on-disk state: don't rewrite it
+            self._marker = self._current_marker()
+        except Exception as e:
+            self.load_failures += 1
+            if stats is not None:
+                stats.bump("persist_load_failures")
+            logger.warning("persist_load_failed", error=repr(e))
+
+    def _load_dir(self, d: str, cfg, stats) -> None:
+        from ..adapt.history import HISTORY
+        from ..adapt.plancache import CACHE_VERSION, PLAN_CACHE
+
+        try:
+            names = sorted((n for n in os.listdir(d)
+                            if n.endswith(_SUFFIX)), reverse=True)
+        except OSError:
+            return  # no artifacts yet: a plain cold start
+        cap = getattr(cfg, "plan_cache_bytes", 64 * 1024 * 1024)
+        cur_gen = PLAN_CACHE.generation
+        merged = 0
+        for name in names:
+            path = os.path.join(d, name)
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+                if len(blob) < len(_MAGIC) + 8 \
+                        or not blob.endswith(_MAGIC):
+                    raise DaftCorruptionError(
+                        "short or unterminated artifact")
+                payload = blob[:-(len(_MAGIC) + 4)]
+                (want_crc,) = struct.unpack(
+                    "<I", blob[-(len(_MAGIC) + 4):-len(_MAGIC)])
+                if zlib.crc32(payload) & 0xFFFFFFFF != want_crc:
+                    raise DaftCorruptionError("artifact crc mismatch")
+                data = pickle.loads(payload)
+                if data.get("version") != ARTIFACT_VERSION \
+                        or data.get("cache_version") != CACHE_VERSION:
+                    raise DaftCorruptionError(
+                        f"artifact version skew "
+                        f"({data.get('version')}/"
+                        f"{data.get('cache_version')})")
+            except Exception as e:
+                # torn write, bit rot, stale format: THIS file cold-misses
+                self.load_failures += 1
+                if stats is not None:
+                    stats.bump("persist_load_failures")
+                logger.warning("persist_artifact_unreadable", path=path,
+                               error=repr(e))
+                continue
+            saved_gen = data.get("generation", 0)
+            entries = []
+            for fp, cfg_key, blobs in data.get("entries", []):
+                # the writer's generation token is process history, not
+                # plan identity: rebase onto THIS process's counter so a
+                # warm lookup's key matches
+                if saved_gen != cur_gen:
+                    cfg_key = cfg_key.replace(f"|g{saved_gen}|",
+                                              f"|g{cur_gen}|")
+                entries.append((fp, cfg_key, blobs))
+            n = PLAN_CACHE.import_artifact(entries, cap)
+            n += HISTORY.merge(data.get("history") or {})
+            self.artifact_loads += 1
+            self.artifact_entries += n
+            self.artifact_bytes += len(blob)
+            merged += n
+            if stats is not None:
+                stats.bump("persist_artifact_loads")
+        if merged:
+            logger.info("persist_warm_start", dir=d, entries=merged,
+                        files=self.artifact_loads)
+
+    # ------------------------------------------------------------- save
+    def maybe_save(self, cfg, stats=None, force: bool = False) -> bool:
+        """Write one artifact snapshot when the persistable state moved
+        since the last write/load (``force`` skips only the dirty check,
+        not the fault contract). Never raises."""
+        if not _leg_on(cfg):
+            return False
+        try:
+            marker = self._current_marker()
+            if not force and marker == self._marker:
+                return False
+            from .. import faults
+
+            try:
+                faults.check("persist.store", stats)
+            except faults.InjectedFault:
+                # the query's own result is long since streamed — a store
+                # fault only costs the NEXT process its warm start
+                self.store_failures += 1
+                if stats is not None:
+                    stats.bump("persist_store_failures")
+                return False
+            if faults.any_armed():
+                return False
+            self._write(cfg, marker, stats)
+            return True
+        except Exception as e:
+            self.store_failures += 1
+            if stats is not None:
+                stats.bump("persist_store_failures")
+            logger.warning("persist_store_failed", error=repr(e))
+            return False
+
+    def _write(self, cfg, marker: tuple, stats) -> None:
+        from ..adapt.history import HISTORY
+        from ..adapt.plancache import CACHE_VERSION, PLAN_CACHE
+
+        d = _artifact_dir(cfg)
+        os.makedirs(d, exist_ok=True)
+        payload = pickle.dumps({
+            "version": ARTIFACT_VERSION,
+            "cache_version": CACHE_VERSION,
+            "generation": PLAN_CACHE.generation,
+            "entries": PLAN_CACHE.export_artifact(),
+            "history": HISTORY.export(),
+        }, protocol=pickle.HIGHEST_PROTOCOL)
+        blob = (payload
+                + struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
+                + _MAGIC)
+        # time_ns zero-padded so lexical order IS recency order; the pid
+        # disambiguates concurrent drivers writing within one tick
+        name = f"plans-{time.time_ns():020d}-{os.getpid()}{_SUFFIX}"
+        tmp = os.path.join(d, f".{name}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(d, name))
+        self.artifact_saves += 1
+        self.artifact_bytes = len(blob)
+        self._marker = marker
+        if stats is not None:
+            stats.bump("persist_artifact_saves")
+        self._prune(d, int(getattr(cfg, "persist_keep_last", 3)))
+
+    def _prune(self, d: str, keep: int) -> None:
+        """Keep the newest ``keep`` artifacts (and sweep orphaned temp
+        files another writer abandoned). Races with a concurrent pruner
+        are benign: the loser's unlink ENOENTs."""
+        try:
+            names = sorted((n for n in os.listdir(d)
+                            if n.endswith(_SUFFIX)), reverse=True)
+        except OSError:
+            return
+        for name in names[max(keep, 1):]:
+            try:
+                os.unlink(os.path.join(d, name))
+                self.evictions += 1
+            except OSError:
+                pass
+        for name in os.listdir(d):
+            if name.endswith(".tmp"):
+                path = os.path.join(d, name)
+                try:
+                    if time.time() - os.path.getmtime(path) > 300:
+                        os.unlink(path)
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------ admin
+    def snapshot(self) -> dict:
+        return {
+            "artifact_entries": self.artifact_entries,
+            "artifact_bytes": self.artifact_bytes,
+            "artifact_loads": self.artifact_loads,
+            "artifact_saves": self.artifact_saves,
+            "load_failures": self.load_failures,
+            "store_failures": self.store_failures,
+            "evictions": self.evictions,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._loaded.clear()
+        self._marker = None
+        self.artifact_entries = self.artifact_bytes = 0
+        self.artifact_loads = self.artifact_saves = 0
+        self.load_failures = self.store_failures = self.evictions = 0
+
+
+ARTIFACTS = ArtifactStore()
+
+
+def ensure_loaded(cfg, stats=None) -> None:
+    ARTIFACTS.ensure_loaded(cfg, stats)
+
+
+def maybe_save(cfg, stats=None) -> bool:
+    return ARTIFACTS.maybe_save(cfg, stats)
+
+
+def flush(cfg, stats=None) -> bool:
+    """Shutdown-time write: force past the dirty check only when there is
+    anything cached at all (an empty process must not litter artifacts)."""
+    from ..adapt.history import HISTORY
+    from ..adapt.plancache import PLAN_CACHE
+
+    if not PLAN_CACHE.snapshot()["entries"] \
+            and not HISTORY.snapshot()["sites"]:
+        return False
+    return ARTIFACTS.maybe_save(cfg, stats)

@@ -1,0 +1,644 @@
+# daftlint: migrated
+"""Cluster-shared result tier: the sub-plan result cache on disk, served
+worker-to-worker.
+
+One entry is one materialized scan+map prefix, laid out under
+``<root>/results/`` as a commit-point meta file plus spill-IPC partition
+files:
+
+- ``<sd>.json`` — the entry's manifest, written LAST (atomic temp +
+  ``os.replace``): the exact per-task keys (mtime/size-bearing), per-file
+  crc32/bytes/rows, and the chain/config parts. ``sd`` is the **stable
+  digest**: sha1 over the mtime-LESS scan-task keys + the chain's
+  expression keys + the float-affecting config knobs — so an exact hit
+  and a refresh candidate for the same logical prefix share one address;
+- ``<sd>.p<i>.arrow`` — partition ``i`` in spill-IPC format
+  (``spill._write_spill_ipc``), crc-verified on every read.
+
+Lookup semantics: meta's exact task keys match the live scan → replay
+(byte-identical by the PR 13 keying discipline). Keys differ — a source
+file's mtime/size moved — and ``cfg.persist_refresh`` is on → recompute
+ONLY the touched partitions (``MicroPartition.from_scan_task`` + the
+chain's ``map_partition`` recipe, the lineage contract of
+integrity/lineage.py) and splice them in. Any read defect (missing file,
+crc mismatch, torn meta) is a counted cold miss.
+
+The worker tier reuses the same layout with single-task entries under
+``<cache_dir>/w<id>/`` (one store per worker models one store per node).
+The driver piggybacks each worker's hosted digests on heartbeat pongs and
+attaches up to two peer addresses to eligible map tasks; a worker missing
+an entry locally pulls it over the PR 16 ``PieceServer`` transport
+(``("rs", sd, task_key)`` fetch keys, token-authenticated, crc-framed)
+and write-throughs its own store — one worker's prefix warms the fleet.
+Every failure on that path degrades to plain execution: the map task
+itself is the lineage recipe.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import List, Optional, Tuple
+
+from ..obs.log import get_logger
+
+__all__ = ["ResultStore", "RESULT_STORE", "prefix_meta", "disk_lookup",
+           "disk_store", "task_meta"]
+
+logger = get_logger("persist.resultstore")
+
+_META_VERSION = 1
+# bounded digest list per pong: enough for real prefix reuse, small
+# enough to stay heartbeat-sized
+_PONG_DIGESTS = 256
+
+
+def _results_dir(root: str) -> str:
+    return os.path.join(root, "results")
+
+
+def _sha1(s: str) -> str:
+    return hashlib.sha1(s.encode("utf-8", "surrogatepass")).hexdigest()
+
+
+class ResultStore:
+    """Process-wide disk-tier state + counters (driver and worker alike
+    run exactly one; the worker's is pointed at its per-slot root by
+    ``configure``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._root: Optional[str] = None  # worker-side configured root
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.refreshes = 0
+        self.partitions_refreshed = 0
+        self.rs_evictions = 0
+        self.rs_load_failures = 0
+        self.rs_store_failures = 0
+        self.peer_serves = 0
+        self.peer_fetches = 0
+
+    # ------------------------------------------------------ worker setup
+    def configure(self, root: Optional[str]) -> None:
+        with self._lock:
+            self._root = root
+
+    @property
+    def root(self) -> Optional[str]:
+        with self._lock:
+            return self._root
+
+    # ------------------------------------------------------------ admin
+    def snapshot(self) -> dict:
+        d = self.root
+        if d is None:
+            # driver-side: the tier roots at the session's cache_dir
+            try:
+                from ..context import get_context
+
+                cd = getattr(get_context().execution_config,
+                             "cache_dir", None)
+                d = os.path.abspath(cd) if cd else None
+            except Exception:
+                d = None
+        disk_entries = disk_bytes = 0
+        if d is not None:
+            disk_entries, disk_bytes = _disk_usage(_results_dir(d))
+        return {
+            "disk_entries": disk_entries,
+            "disk_bytes": disk_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "inserts": self.inserts,
+            "refreshes": self.refreshes,
+            "partitions_refreshed": self.partitions_refreshed,
+            "evictions": self.rs_evictions,
+            "load_failures": self.rs_load_failures,
+            "store_failures": self.rs_store_failures,
+            "peer_serves": self.peer_serves,
+            "peer_fetches": self.peer_fetches,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._root = None
+        self.hits = self.misses = self.inserts = 0
+        self.refreshes = self.partitions_refreshed = 0
+        self.rs_evictions = self.rs_load_failures = 0
+        self.rs_store_failures = self.peer_serves = self.peer_fetches = 0
+
+    # ------------------------------------------------- pong / peer serve
+    def pong_report(self) -> dict:
+        """The heartbeat piggyback: hosted stable digests (bounded,
+        newest-mtime first) + the counters the driver aggregates."""
+        digests: List[str] = []
+        d = self.root
+        if d is not None:
+            try:
+                rd = _results_dir(d)
+                metas = [(os.path.getmtime(os.path.join(rd, n)), n)
+                         for n in os.listdir(rd) if n.endswith(".json")]
+                metas.sort(reverse=True)
+                digests = [n[:-5] for _, n in metas[:_PONG_DIGESTS]]
+            except OSError:
+                digests = []
+        return {
+            "digests": digests,
+            "hits": self.hits,
+            "misses": self.misses,
+            "inserts": self.inserts,
+            "peer_serves": self.peer_serves,
+            "peer_fetches": self.peer_fetches,
+        }
+
+    def serve_payload(self, sd: str,
+                      tk: str) -> Optional[Tuple[bytes, int]]:
+        """PieceServer hook: the raw spill-IPC bytes of a hosted
+        single-task entry (crc-verified against the manifest before a
+        byte leaves), or None — a peer's miss is its problem, never an
+        error here."""
+        d = self.root
+        if d is None:
+            return None
+        try:
+            rd = _results_dir(d)
+            meta = _read_meta(os.path.join(rd, sd + ".json"))
+            if meta is None or meta.get("task_keys") != [tk]:
+                return None
+            finfo = meta["files"][0]
+            path = os.path.join(rd, f"{sd}.p0.arrow")
+            with open(path, "rb") as f:
+                data = f.read()
+            import zlib
+
+            if zlib.crc32(data) & 0xFFFFFFFF != finfo["crc"]:
+                self.rs_load_failures += 1
+                return None
+            self.peer_serves += 1
+            return data, int(finfo.get("rows", 0))
+        except Exception as e:
+            self.rs_load_failures += 1
+            logger.warning("persist_peer_serve_failed", sd=sd,
+                           error=repr(e))
+            return None
+
+
+RESULT_STORE = ResultStore()
+
+
+# ---------------------------------------------------------------------------
+# entry IO
+# ---------------------------------------------------------------------------
+
+def _read_meta(path: str) -> Optional[dict]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if meta.get("v") != _META_VERSION:
+        return None
+    return meta
+
+
+def _write_atomic(path: str, data: bytes) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def _read_part(rd: str, sd: str, i: int, finfo: dict):
+    """One partition file back as an engine Table, crc-verified first —
+    the spill read-back contract (spill._SpillSlot._read_file_locked)."""
+    import pyarrow as pa
+
+    from ..errors import DaftCorruptionError
+    from ..integrity.checksum import crc32_file
+    from ..table import Table
+
+    path = os.path.join(rd, f"{sd}.p{i}.arrow")
+    got = crc32_file(path)
+    if got != finfo["crc"]:
+        raise DaftCorruptionError(
+            f"result-store file {path} failed its integrity check "
+            f"(crc {got:#010x} != {finfo['crc']:#010x})")
+    with pa.OSFile(path) as f:
+        at = pa.ipc.open_file(f).read_all()
+    return Table.from_arrow(at)
+
+
+def _write_part(rd: str, sd: str, i: int, table) -> dict:
+    from ..integrity.checksum import crc32_file
+    from ..spill import _write_spill_ipc
+
+    path = os.path.join(rd, f"{sd}.p{i}.arrow")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    nbytes = _write_spill_ipc(tmp, [table])
+    crc = crc32_file(tmp)
+    os.replace(tmp, path)
+    return {"crc": crc, "nbytes": nbytes, "rows": len(table)}
+
+
+def _disk_usage(rd: str) -> Tuple[int, int]:
+    entries = nbytes = 0
+    try:
+        for n in os.listdir(rd):
+            if n.endswith(".json"):
+                entries += 1
+            if not n.endswith(".tmp"):
+                try:
+                    nbytes += os.path.getsize(os.path.join(rd, n))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return entries, nbytes
+
+
+def _evict_over_cap(rd: str, cap_bytes: int, keep_sd: str) -> None:
+    """LRU-by-meta-mtime shed down to the byte cap, never touching the
+    entry just written. Unlink races with concurrent drivers ENOENT
+    harmlessly."""
+    _, total = _disk_usage(rd)
+    if total <= cap_bytes:
+        return
+    try:
+        metas = sorted(
+            ((os.path.getmtime(os.path.join(rd, n)), n[:-5])
+             for n in os.listdir(rd) if n.endswith(".json")))
+    except OSError:
+        return
+    for _, sd in metas:
+        if total <= cap_bytes:
+            break
+        if sd == keep_sd:
+            continue
+        freed = _drop_entry(rd, sd)
+        if freed:
+            total -= freed
+            RESULT_STORE.rs_evictions += 1
+
+
+def _drop_entry(rd: str, sd: str) -> int:
+    freed = 0
+    meta = _read_meta(os.path.join(rd, sd + ".json"))
+    parts = int(meta.get("parts", 0)) if meta else 64
+    try:
+        freed += os.path.getsize(os.path.join(rd, sd + ".json"))
+        os.unlink(os.path.join(rd, sd + ".json"))
+    except OSError:
+        pass
+    for i in range(parts):
+        path = os.path.join(rd, f"{sd}.p{i}.arrow")
+        try:
+            freed += os.path.getsize(path)
+            os.unlink(path)
+        except OSError:
+            if meta is None:
+                break  # unknown part count: stop at the first gap
+    return freed
+
+
+# ---------------------------------------------------------------------------
+# driver tier: the resultcache disk hooks
+# ---------------------------------------------------------------------------
+
+def prefix_meta(chain, scan, cfg) -> Optional[dict]:
+    """Address one scan+map prefix in the disk tier, or None when the
+    prefix is ineligible (factory tasks, UDF chains, leg off). Raises
+    nothing — callers treat None as 'memory tier only'."""
+    from ..adapt.resultcache import _CFG_KEY_FIELDS, _Decline, _op_key
+    from ..runners import _Uncacheable, _scan_task_key
+
+    if getattr(cfg, "cache_dir", None) is None \
+            or not getattr(cfg, "persist_result_store", True):
+        return None
+    try:
+        exact = [_scan_task_key(t) for t in scan.tasks]
+        stable = [_scan_task_key(t, stable=True) for t in scan.tasks]
+        ops = "|".join(_op_key(o) for o in chain)
+    except (_Uncacheable, _Decline):
+        return None
+    cfg_part = ",".join(f"{k}={getattr(cfg, k, None)!r}"
+                        for k in _CFG_KEY_FIELDS)
+    sd = _sha1(";".join(stable) + "||" + ops + "||" + cfg_part)
+    return {
+        "root": os.path.abspath(cfg.cache_dir),
+        "sd": sd,
+        "task_keys": exact,
+        "n_tasks": len(scan.tasks),
+        "refresh": bool(getattr(cfg, "persist_refresh", True)),
+        "cap": int(getattr(cfg, "persist_result_bytes",
+                           256 * 1024 * 1024)),
+    }
+
+
+def disk_lookup(pmeta: dict, chain, scan, ctx) -> Optional[list]:
+    """The memory-miss fallthrough: exact replay, incremental refresh, or
+    None (cold). Returns detached Tables (the memory tier's currency) so
+    the caller can both populate ``RESULT_CACHE`` and replay."""
+    from .. import faults
+
+    stats = ctx.stats
+    try:
+        try:
+            faults.check("persist.load", stats)
+        except faults.InjectedFault:
+            RESULT_STORE.rs_load_failures += 1
+            stats.bump("persist_load_failures")
+            return None
+        rd = _results_dir(pmeta["root"])
+        sd = pmeta["sd"]
+        meta = _read_meta(os.path.join(rd, sd + ".json"))
+        if meta is None:
+            RESULT_STORE.misses += 1
+            stats.bump("persist_misses")
+            return None
+        stored = meta.get("task_keys") or []
+        live = pmeta["task_keys"]
+        if stored == live:
+            tables = [_read_part(rd, sd, i, meta["files"][i])
+                      for i in range(int(meta["parts"]))]
+            RESULT_STORE.hits += 1
+            stats.bump("persist_hits")
+            p = stats.profiler
+            if p.armed:
+                p.event("persist", kind="hit", parts=len(tables))
+            return tables
+        if len(stored) != len(live) or not pmeta["refresh"]:
+            RESULT_STORE.misses += 1
+            stats.bump("persist_misses")
+            return None
+        try:
+            faults.check("persist.refresh", stats)
+        except faults.InjectedFault:
+            # the pinned degradation: a refresh fault is a FULL cold miss
+            # (plain recompute re-stores the whole entry) — never a stale
+            # or partially-spliced answer
+            RESULT_STORE.misses += 1
+            stats.bump("persist_misses")
+            return None
+        return _refresh(pmeta, meta, chain, scan, ctx)
+    except Exception as e:
+        RESULT_STORE.rs_load_failures += 1
+        stats.bump("persist_load_failures")
+        logger.warning("persist_result_lookup_failed", error=repr(e))
+        return None
+
+
+def _refresh(pmeta: dict, meta: dict, chain, scan, ctx) -> list:
+    """Materialized-view maintenance: partitions whose exact task key
+    moved recompute from their scan-task recipe (re-read + the chain's
+    ``map_partition``s — exactly integrity/lineage's per-partition
+    contract); unchanged partitions replay from disk. The spliced entry
+    replaces the stale one part-file-first, manifest last."""
+    from ..micropartition import MicroPartition
+
+    rd = _results_dir(pmeta["root"])
+    sd = pmeta["sd"]
+    stored = meta["task_keys"]
+    live = pmeta["task_keys"]
+    changed = [i for i, (a, b) in enumerate(zip(stored, live)) if a != b]
+    tables = []
+    for i in range(int(meta["parts"])):
+        if i in changed:
+            mp = MicroPartition.from_scan_task(scan.tasks[i])
+            for op in reversed(chain):
+                mp = op.map_partition(mp, ctx)
+            tables.append(mp.table())
+        else:
+            tables.append(_read_part(rd, sd, i, meta["files"][i]))
+    files = list(meta["files"])
+    for i in changed:
+        files[i] = _write_part(rd, sd, i, tables[i])
+    meta = dict(meta, task_keys=live, files=files)
+    _write_atomic(os.path.join(rd, sd + ".json"),
+                  json.dumps(meta).encode("utf-8"))
+    RESULT_STORE.refreshes += 1
+    RESULT_STORE.partitions_refreshed += len(changed)
+    ctx.stats.bump("persist_refreshes")
+    ctx.stats.bump("persist_partitions_refreshed", len(changed))
+    p = ctx.stats.profiler
+    if p.armed:
+        p.event("persist", kind="refresh", parts=len(tables),
+                recomputed=len(changed))
+    logger.info("persist_refreshed", sd=sd, parts=len(tables),
+                recomputed=len(changed))
+    return tables
+
+
+def disk_store(pmeta: dict, tables: list, nbytes: int, ctx) -> None:
+    """Persist one cleanly-exhausted prefix (the ``_teeing`` commit hook).
+    Declines when the partition/task 1:1 mapping broke (runtime pruning)
+    — a stored entry must splice per-partition against its task list.
+    Never raises."""
+    from .. import faults
+
+    stats = ctx.stats
+    try:
+        if len(tables) != pmeta["n_tasks"]:
+            return
+        if nbytes > pmeta["cap"]:
+            return
+        try:
+            faults.check("persist.store", stats)
+        except faults.InjectedFault:
+            RESULT_STORE.rs_store_failures += 1
+            stats.bump("persist_store_failures")
+            return
+        if faults.any_armed():
+            return
+        rd = _results_dir(pmeta["root"])
+        os.makedirs(rd, exist_ok=True)
+        sd = pmeta["sd"]
+        files = [_write_part(rd, sd, i, t) for i, t in enumerate(tables)]
+        meta = {
+            "v": _META_VERSION,
+            "task_keys": pmeta["task_keys"],
+            "parts": len(tables),
+            "files": files,
+        }
+        _write_atomic(os.path.join(rd, sd + ".json"),
+                      json.dumps(meta).encode("utf-8"))
+        RESULT_STORE.inserts += 1
+        stats.bump("persist_inserts")
+        _evict_over_cap(rd, pmeta["cap"], sd)
+    except Exception as e:
+        RESULT_STORE.rs_store_failures += 1
+        stats.bump("persist_store_failures")
+        logger.warning("persist_result_store_failed", error=repr(e))
+
+
+# ---------------------------------------------------------------------------
+# worker tier: per-task entries + peer fetch
+# ---------------------------------------------------------------------------
+
+def task_meta(op, part, cfg) -> Optional[dict]:
+    """Driver-side: address ONE map task's output in the worker tier, or
+    None when ineligible (loaded/unrereadable partition, non-map or
+    UDF-bearing op, armed faults, leg off). The ``sd``/``tk`` pair rides
+    the task envelope; the worker never re-derives keys."""
+    from .. import faults
+
+    if getattr(cfg, "cache_dir", None) is None \
+            or not getattr(cfg, "persist_result_store", True):
+        return None
+    if faults.any_armed():
+        return None
+    try:
+        from ..adapt.resultcache import (_CFG_KEY_FIELDS, _Decline,
+                                         _op_key)
+        from ..fuse.compile import FusedMapOp
+        from ..integrity.lineage import unwrap_source_task
+        from ..physical import FilterOp, ProjectOp
+        from ..runners import _Uncacheable, _scan_task_key
+
+        if not isinstance(op, (ProjectOp, FilterOp, FusedMapOp)):
+            return None
+        task = unwrap_source_task(part)
+        if task is None:
+            return None
+        tk = _scan_task_key(task)
+        stable = _scan_task_key(task, stable=True)
+        okey = _op_key(op)
+    except (_Uncacheable, _Decline):
+        return None
+    except Exception:
+        return None
+    cfg_part = ",".join(f"{k}={getattr(cfg, k, None)!r}"
+                        for k in _CFG_KEY_FIELDS)
+    return {"sd": _sha1(stable + "||" + okey + "||" + cfg_part),
+            "tk": tk}
+
+
+def worker_lookup(rs: dict, exec_ctx, token: str, checksum: bool):
+    """Worker-side task hook: local store, then up to two peers over the
+    PieceServer transport (write-through on a peer hit). Returns a loaded
+    MicroPartition or None — every defect means 'execute the task', which
+    IS the entry's lineage recipe. Never raises."""
+    from .. import faults
+    from ..micropartition import MicroPartition
+
+    stats = exec_ctx.stats
+    root = RESULT_STORE.root
+    if root is None:
+        return None
+    try:
+        faults.check("persist.load", stats)
+    except faults.InjectedFault:
+        RESULT_STORE.rs_load_failures += 1
+        stats.bump("persist_load_failures")
+        return None
+    except Exception:
+        return None
+    if faults.any_armed():
+        # a served entry would let an armed worker.task/scan.read site
+        # silently never fire — chaos runs execute for real
+        return None
+    sd, tk = rs.get("sd"), rs.get("tk")
+    if not sd or not tk:
+        return None
+    rd = _results_dir(root)
+    try:
+        meta = _read_meta(os.path.join(rd, sd + ".json"))
+        if meta is not None and meta.get("task_keys") == [tk]:
+            t = _read_part(rd, sd, 0, meta["files"][0])
+            RESULT_STORE.hits += 1
+            stats.bump("persist_hits")
+            return MicroPartition.from_table(t)
+    except Exception as e:
+        RESULT_STORE.rs_load_failures += 1
+        stats.bump("persist_load_failures")
+        logger.warning("persist_worker_lookup_failed", sd=sd,
+                       error=repr(e))
+    table = None
+    for peer in rs.get("peers", ()):
+        try:
+            table = _peer_fetch(peer, sd, tk, token, checksum)
+        except Exception as e:
+            logger.warning("persist_peer_fetch_failed", sd=sd,
+                           peer=peer[0] if peer else None, error=repr(e))
+            table = None
+        if table is not None:
+            RESULT_STORE.peer_fetches += 1
+            stats.bump("persist_peer_fetches")
+            try:
+                worker_store(rs, MicroPartition.from_table(table),
+                             exec_ctx)
+            except Exception as e:
+                # write-through is best-effort; the fetched table serves
+                logger.warning("persist_write_through_failed", sd=sd,
+                               error=repr(e))
+            return MicroPartition.from_table(table)
+    RESULT_STORE.misses += 1
+    stats.bump("persist_misses")
+    return None
+
+
+def _peer_fetch(peer, sd: str, tk: str, token: str, checksum: bool):
+    """One fetch round-trip: dial, ``("rs", sd, tk)`` key, parse the raw
+    spill-IPC payload. The transport frames carry their own crc; the
+    serving side verified its manifest crc before the bytes left."""
+    import pyarrow as pa
+
+    from ..dist.peerplane import FETCH_TIMEOUT_S
+    from ..dist.transport import dial, recv_msg, send_msg
+    from ..table import Table
+
+    _wid, host, port = peer
+    conn = dial(host, int(port), timeout=FETCH_TIMEOUT_S)
+    try:
+        send_msg(conn, {"type": "fetch", "token": token,
+                        "key": ("rs", sd, tk)}, checksum=checksum)
+        reply = recv_msg(conn)
+        if not reply.get("found"):
+            return None
+        at = pa.ipc.open_file(
+            pa.BufferReader(reply["payload"])).read_all()
+        return Table.from_arrow(at)
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def worker_store(rs: dict, out, exec_ctx) -> None:
+    """Write-through one executed task's output as a single-part entry.
+    Never raises; a store defect only costs the fleet a warm read."""
+    from .. import faults
+
+    stats = exec_ctx.stats
+    try:
+        root = RESULT_STORE.root
+        if root is None or out is None or not out.is_loaded():
+            return
+        try:
+            faults.check("persist.store", stats)
+        except faults.InjectedFault:
+            RESULT_STORE.rs_store_failures += 1
+            stats.bump("persist_store_failures")
+            return
+        if faults.any_armed():
+            return
+        sd = rs["sd"]
+        rd = _results_dir(root)
+        os.makedirs(rd, exist_ok=True)
+        if os.path.exists(os.path.join(rd, sd + ".json")):
+            return  # deterministic output: first writer wins
+        finfo = _write_part(rd, sd, 0, out.table())
+        meta = {"v": _META_VERSION, "task_keys": [rs["tk"]],
+                "parts": 1, "files": [finfo]}
+        _write_atomic(os.path.join(rd, sd + ".json"),
+                      json.dumps(meta).encode("utf-8"))
+        RESULT_STORE.inserts += 1
+        stats.bump("persist_inserts")
+    except Exception as e:
+        RESULT_STORE.rs_store_failures += 1
+        stats.bump("persist_store_failures")
+        logger.warning("persist_worker_store_failed", error=repr(e))

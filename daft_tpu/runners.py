@@ -132,7 +132,7 @@ def _file_fingerprint(path: str) -> str:
     return f"{st.st_mtime_ns}:{st.st_size}"
 
 
-def _scan_task_key(t) -> str:
+def _scan_task_key(t, stable: bool = False) -> str:
     from .io.pyscan import FactoryScanTask
     from .io.scan import MergedScanTask
 
@@ -143,12 +143,17 @@ def _scan_task_key(t) -> str:
     if isinstance(t, MergedScanTask):
         # fingerprint EVERY child file: the merged task's .path is only the
         # first child, and an overwrite of any other must invalidate too
-        return "+".join(_scan_task_key(c) for c in t.children)
+        return "+".join(_scan_task_key(c, stable=stable)
+                        for c in t.children)
     # storage_options and schema are part of task identity: the same file read
     # with a different delimiter or schema_hints must not share a cache entry
     opts = sorted((k, repr(v)) for k, v in t.storage_options.items())
     sch = [(f.name, str(f.dtype)) for f in t.schema]
-    return (f"{t.path}|{_file_fingerprint(t.path)}|{t.format}|{t.pushdowns!r}"
+    # the stable variant masks the mtime/size term: it addresses the same
+    # logical source across overwrites (the persist/ refresh path pairs a
+    # stable address with the exact keys to find WHICH partitions moved)
+    fp = "*" if stable else _file_fingerprint(t.path)
+    return (f"{t.path}|{fp}|{t.format}|{t.pushdowns!r}"
             f"|{t.row_group_ids}|{t.partition_values}|{opts}|{sch}")
 
 

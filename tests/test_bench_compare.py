@@ -123,6 +123,15 @@ class TestClassify:
         assert classify("serving_cold_p50_s") == "lower"
         assert classify("serving_planning_share_warm_pct") == "lower"
 
+    def test_persist_suffixes(self):
+        # persist legs (ISSUE 20): restart warm/cold p50s are ordinary
+        # lower-better walls; the persist hit rate and the fleet-warm
+        # speedup ratio are higher-better
+        assert classify("serving_restart_warm_p50_s") == "lower"
+        assert classify("serving_restart_cold_p50_s") == "lower"
+        assert classify("persist_hit_rate") == "higher"
+        assert classify("result_store_fleet_warm_x") == "higher"
+
     def test_hit_rate_direction_in_compare(self):
         prev = {"serving_plan_cache_hit_rate": 0.95,
                 "serving_warm_p50_s": 0.10}

@@ -1,0 +1,476 @@
+"""daft_tpu/persist/: persistent cache store (ISSUE 20).
+
+Pins the subsystem's contracts:
+- restart warm-start: a fresh interpreter over a shared ``cache_dir``
+  serves a repeated plan shape with ZERO optimize()/translate()/
+  fuse-compile calls, byte-identical to the cold run and to persist-off
+  (real two-interpreter test);
+- failure semantics: corrupt/truncated artifacts and armed
+  ``persist.load``/``persist.store``/``persist.refresh`` fault sites
+  degrade to a cold miss or a dropped store — NEVER a query failure —
+  with the ``persist_load_failures``/``persist_store_failures`` counters
+  moving; armed chaos plans stand the store down entirely;
+- durable result tier: a scan+map prefix replays from disk across
+  cleared memory tiers, byte-identically;
+- incremental refresh: one touched source file out of N recomputes
+  EXACTLY one partition (``persist_partitions_refreshed == 1``),
+  byte-identical to a full recompute;
+- artifact-dir hygiene: atomic temp+rename (no ``.tmp`` residue),
+  keep-last-K pruning with the evictions counter (two concurrent
+  interpreters);
+- health/gauge surfaces: ``dt.health()["persist"]`` validates and the
+  ``daft_tpu_persist_*`` gauges export.
+"""
+
+import contextlib
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+import daft_tpu as dt
+from daft_tpu import col, faults, persist
+from daft_tpu.adapt.history import HISTORY
+from daft_tpu.adapt.plancache import PLAN_CACHE
+from daft_tpu.adapt.resultcache import RESULT_CACHE
+from daft_tpu.runners import partition_set_cache
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CFG_KEYS = (
+    "cache_dir", "persist_artifacts", "persist_result_store",
+    "persist_refresh", "persist_keep_last", "persist_result_bytes",
+    "plan_cache", "plan_cache_bytes", "history_fdo",
+    "subplan_result_cache", "subplan_cache_bytes", "enable_result_cache",
+    "scan_tasks_min_size_bytes",
+)
+
+
+def _clear_all():
+    PLAN_CACHE.clear()
+    RESULT_CACHE.clear()
+    HISTORY.clear()
+    partition_set_cache().clear()
+    persist.reset()
+
+
+@pytest.fixture
+def pcfg(tmp_path):
+    """cache_dir-armed config with every in-memory tier cleared on both
+    sides, so each test starts truly cold."""
+    from daft_tpu.context import get_context
+
+    c = get_context().execution_config
+    saved = {k: getattr(c, k) for k in _CFG_KEYS}
+    c.cache_dir = str(tmp_path / "cache")
+    _clear_all()
+    yield c
+    for k, v in saved.items():
+        setattr(c, k, v)
+    _clear_all()
+    faults.disarm()
+
+
+@contextlib.contextmanager
+def counting_planner():
+    """Count every optimize() / translate() / fuse compile_chain() call —
+    the three costs the warm path must not pay."""
+    import daft_tpu.fuse.compile as fuse_compile
+    import daft_tpu.optimizer as optimizer_mod
+    import daft_tpu.physical as physical_mod
+
+    calls = {"optimize": 0, "translate": 0, "fuse_compile": 0}
+    real = (optimizer_mod.optimize, physical_mod.translate,
+            fuse_compile.compile_chain)
+
+    def opt(p, *a, **k):
+        calls["optimize"] += 1
+        return real[0](p, *a, **k)
+
+    def tr(p, *a, **k):
+        calls["translate"] += 1
+        return real[1](p, *a, **k)
+
+    def fc(*a, **k):
+        calls["fuse_compile"] += 1
+        return real[2](*a, **k)
+
+    optimizer_mod.optimize = opt
+    physical_mod.translate = tr
+    fuse_compile.compile_chain = fc
+    try:
+        yield calls
+    finally:
+        optimizer_mod.optimize = real[0]
+        physical_mod.translate = real[1]
+        fuse_compile.compile_chain = real[2]
+
+
+def _write_parquet(path, nrows=2000, nkeys=5, base=0):
+    papq.write_table(pa.table(
+        {"k": [(base + i) % nkeys for i in range(nrows)],
+         "v": [float(base + i) for i in range(nrows)]}), str(path))
+
+
+def _plan_shape(path):
+    """A whole-plan shape for the plan-cache/artifact leg."""
+    return (dt.read_parquet(str(path))
+            .with_column("w", col("v") * 2.0)
+            .groupby("k").agg(col("w").sum().alias("s")).sort("k"))
+
+
+def _prefix_shape(paths):
+    """A computed scan+map chain (not pushdown-absorbed) so the sub-plan
+    result tier engages."""
+    if not isinstance(paths, list):
+        paths = [str(paths)]
+    return (dt.read_parquet([str(p) for p in paths])
+            .select((col("v") * 2.0).alias("w"), col("k"))
+            .where(col("w") >= 0.0))
+
+
+def _artifact_files(cfg):
+    return sorted(glob.glob(os.path.join(cfg.cache_dir, "artifacts", "*")))
+
+
+class TestArtifactWarmStart:
+    def test_roundtrip_zero_replan(self, pcfg, tmp_path):
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        want = _plan_shape(p).collect().to_pydict()
+        persist.flush(pcfg)
+        assert _artifact_files(pcfg), "flush wrote no artifact"
+        _clear_all()
+        with counting_planner() as calls:
+            got = _plan_shape(p).collect().to_pydict()
+        assert calls == {"optimize": 0, "translate": 0, "fuse_compile": 0}
+        assert got == want
+        snap = PLAN_CACHE.snapshot()
+        assert snap["hits"] == 1 and snap["misses"] == 0
+        assert persist.snapshot()["artifact_loads"] >= 1
+
+    def test_off_and_on_byte_identical(self, pcfg, tmp_path):
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        on = _plan_shape(p).collect().to_pydict()
+        persist.flush(pcfg)
+        _clear_all()
+        warm = _plan_shape(p).collect().to_pydict()
+        _clear_all()
+        pcfg.cache_dir = None  # persist fully off
+        off = _plan_shape(p).collect().to_pydict()
+        assert on == warm == off
+
+    def test_corrupt_artifact_is_cold_miss_not_failure(self, pcfg,
+                                                       tmp_path):
+        from daft_tpu.integrity.checksum import flip_file_bits
+
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        want = _plan_shape(p).collect().to_pydict()
+        persist.flush(pcfg)
+        files = _artifact_files(pcfg)
+        assert files
+        for f in files:
+            flip_file_bits(f)
+        _clear_all()
+        with counting_planner() as calls:
+            got = _plan_shape(p).collect().to_pydict()
+        assert got == want  # the query never sees the corruption
+        assert calls["optimize"] >= 1  # cold: nothing loadable
+        assert persist.snapshot()["load_failures"] >= 1
+
+    def test_truncated_artifact_is_cold_miss(self, pcfg, tmp_path):
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        want = _plan_shape(p).collect().to_pydict()
+        persist.flush(pcfg)
+        for f in _artifact_files(pcfg):
+            size = os.path.getsize(f)
+            with open(f, "r+b") as fh:  # a partial write survives rename
+                fh.truncate(max(size // 2, 1))
+        _clear_all()
+        got = _plan_shape(p).collect().to_pydict()
+        assert got == want
+        assert persist.snapshot()["load_failures"] >= 1
+
+    def test_no_tmp_residue(self, pcfg, tmp_path):
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        _plan_shape(p).collect()
+        persist.flush(pcfg)
+        names = os.listdir(os.path.join(pcfg.cache_dir, "artifacts"))
+        leftovers = [n for n in names if n.endswith(".tmp")]
+        assert leftovers == []
+
+    def test_artifacts_knob_off_writes_nothing(self, pcfg, tmp_path):
+        pcfg.persist_artifacts = False
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        _plan_shape(p).collect()
+        persist.flush(pcfg)
+        assert not os.path.isdir(os.path.join(pcfg.cache_dir, "artifacts"))
+
+
+class TestFaultSites:
+    def test_load_fault_is_cold_miss(self, pcfg, tmp_path):
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        want = _plan_shape(p).collect().to_pydict()
+        persist.flush(pcfg)
+        _clear_all()
+        with faults.inject("persist.load", "first_n", n=1):
+            with counting_planner() as calls:
+                got = _plan_shape(p).collect().to_pydict()
+        assert got == want
+        assert calls["optimize"] >= 1  # load fault = cold, never an error
+        assert persist.snapshot()["load_failures"] >= 1
+
+    def test_store_fault_query_unaffected(self, pcfg, tmp_path):
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        with faults.inject("persist.store", "always"):
+            got = _plan_shape(p).collect().to_pydict()
+            persist.flush(pcfg)
+        assert len(got["k"]) == 5
+        assert _artifact_files(pcfg) == []  # nothing durable landed
+        assert persist.snapshot()["store_failures"] >= 1
+
+    def test_other_armed_site_stands_store_down(self, pcfg, tmp_path):
+        # chaos runs execute for real: any OTHER armed site silently
+        # stands the whole store down (no counters, no files)
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        faults.arm("scan.read", "nth", n=10**9)  # armed, never fires
+        try:
+            _prefix_shape(p).collect()
+            persist.flush(pcfg)
+        finally:
+            faults.disarm()
+        assert _artifact_files(pcfg) == []
+        assert not os.path.isdir(os.path.join(pcfg.cache_dir, "results"))
+        s = persist.snapshot()
+        assert s["store_failures"] == 0 and s["inserts"] == 0
+
+    def test_refresh_fault_is_full_cold_miss(self, pcfg, tmp_path):
+        pcfg.scan_tasks_min_size_bytes = 0
+        ps = [tmp_path / f"p{i}.parquet" for i in range(3)]
+        for i, p in enumerate(ps):
+            _write_parquet(p, nrows=500, base=i * 500)
+        _prefix_shape(ps).collect()
+        assert persist.snapshot()["inserts"] == 1
+        _write_parquet(ps[1], nrows=500, base=9000)  # mtime/size move
+        RESULT_CACHE.clear()
+        partition_set_cache().clear()
+        with faults.inject("persist.refresh", "first_n", n=1):
+            got = _prefix_shape(ps).collect().to_pydict()
+        s = persist.snapshot()
+        assert s["refreshes"] == 0  # fault degraded refresh to recompute
+        assert 9000.0 * 2 in got["w"]  # fresh rows served regardless
+
+
+class TestResultTier:
+    def test_disk_hit_byte_identical(self, pcfg, tmp_path):
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        want = _prefix_shape(p).collect().to_pydict()
+        rdir = os.path.join(pcfg.cache_dir, "results")
+        metas = glob.glob(os.path.join(rdir, "*.json"))
+        assert len(metas) == 1 and persist.snapshot()["inserts"] == 1
+        RESULT_CACHE.clear()
+        partition_set_cache().clear()
+        got = _prefix_shape(p).collect().to_pydict()
+        assert got == want
+        assert persist.snapshot()["hits"] == 1
+
+    def test_corrupt_part_recomputes(self, pcfg, tmp_path):
+        from daft_tpu.integrity.checksum import flip_file_bits
+
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        want = _prefix_shape(p).collect().to_pydict()
+        for f in glob.glob(
+                os.path.join(pcfg.cache_dir, "results", "*.arrow")):
+            flip_file_bits(f)
+        RESULT_CACHE.clear()
+        partition_set_cache().clear()
+        got = _prefix_shape(p).collect().to_pydict()
+        assert got == want  # crc caught it; recomputed, never served
+        assert persist.snapshot()["hits"] == 0
+        assert persist.snapshot()["load_failures"] >= 1
+
+    def test_refresh_recomputes_exactly_one_partition(self, pcfg,
+                                                      tmp_path):
+        pcfg.scan_tasks_min_size_bytes = 0  # one scan task per file
+        ps = [tmp_path / f"p{i}.parquet" for i in range(3)]
+        for i, p in enumerate(ps):
+            _write_parquet(p, nrows=500, base=i * 500)
+        _prefix_shape(ps).collect()
+        assert persist.snapshot()["inserts"] == 1
+        _write_parquet(ps[1], nrows=500, base=9000)  # touch ONE source
+        RESULT_CACHE.clear()
+        partition_set_cache().clear()
+        got = _prefix_shape(ps).collect().to_pydict()
+        s = persist.snapshot()
+        assert s["refreshes"] == 1
+        assert s["partitions_refreshed"] == 1  # ONLY the touched one
+        # byte-identity vs a full recompute with persist off
+        _clear_all()
+        pcfg.cache_dir = None
+        want = _prefix_shape(ps).collect().to_pydict()
+        assert got == want
+
+    def test_refresh_knob_off_is_plain_miss(self, pcfg, tmp_path):
+        pcfg.persist_refresh = False
+        pcfg.scan_tasks_min_size_bytes = 0
+        ps = [tmp_path / f"p{i}.parquet" for i in range(2)]
+        for i, p in enumerate(ps):
+            _write_parquet(p, nrows=500, base=i * 500)
+        _prefix_shape(ps).collect()
+        _write_parquet(ps[0], nrows=500, base=9000)
+        RESULT_CACHE.clear()
+        partition_set_cache().clear()
+        _prefix_shape(ps).collect()
+        s = persist.snapshot()
+        assert s["refreshes"] == 0 and s["partitions_refreshed"] == 0
+
+    def test_result_store_knob_off_writes_nothing(self, pcfg, tmp_path):
+        pcfg.persist_result_store = False
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        _prefix_shape(p).collect()
+        assert not os.path.isdir(os.path.join(pcfg.cache_dir, "results"))
+
+
+_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+mode, path, cache_dir = sys.argv[2], sys.argv[3], sys.argv[4]
+import daft_tpu as dt
+from daft_tpu import col, persist
+if mode != "off":
+    dt.set_execution_config(cache_dir=cache_dir)
+import daft_tpu.fuse.compile as fuse_compile
+import daft_tpu.optimizer as optimizer_mod
+import daft_tpu.physical as physical_mod
+calls = {"optimize": 0, "translate": 0, "fuse_compile": 0}
+real = (optimizer_mod.optimize, physical_mod.translate,
+        fuse_compile.compile_chain)
+optimizer_mod.optimize = (lambda p, *a, **k: (
+    calls.__setitem__("optimize", calls["optimize"] + 1),
+    real[0](p, *a, **k))[1])
+physical_mod.translate = (lambda p, *a, **k: (
+    calls.__setitem__("translate", calls["translate"] + 1),
+    real[1](p, *a, **k))[1])
+fuse_compile.compile_chain = (lambda *a, **k: (
+    calls.__setitem__("fuse_compile", calls["fuse_compile"] + 1),
+    real[2](*a, **k))[1])
+out = (dt.read_parquet(path).with_column("w", col("v") * 2.0)
+       .groupby("k").agg(col("w").sum().alias("s")).sort("k")).collect()
+got = out.to_pydict()
+snap = {k: v for k, v in persist.snapshot().items() if v}
+dt.shutdown(timeout_s=10)
+print("RESULT " + json.dumps({"calls": calls, "result": got,
+                              "persist": snap}))
+"""
+
+
+def _spawn(mode, path, cache_dir, script=_CHILD):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-c", script, _ROOT, mode, str(path),
+         str(cache_dir)],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert p.returncode == 0, f"child({mode}) died:\n{p.stderr[-3000:]}"
+    line = [ln for ln in p.stdout.splitlines()
+            if ln.startswith("RESULT ")][-1]
+    return json.loads(line[len("RESULT "):])
+
+
+class TestRestartContract:
+    def test_two_interpreter_cycle(self, tmp_path):
+        """The tentpole pin: cold interpreter plans + flushes; a FRESH
+        interpreter serves the same shape with ZERO optimize/translate/
+        fuse-compile calls, byte-identical to cold AND to persist-off;
+        then a corrupted store is a cold miss, still byte-identical."""
+        from daft_tpu.integrity.checksum import flip_file_bits
+
+        path = tmp_path / "t.parquet"
+        _write_parquet(path)
+        cache_dir = tmp_path / "cache"
+        cold = _spawn("on", path, cache_dir)
+        assert cold["calls"]["optimize"] >= 1
+        arts = glob.glob(str(cache_dir / "artifacts" / "*"))
+        assert arts, "cold interpreter flushed no artifacts"
+        warm = _spawn("on", path, cache_dir)
+        assert warm["calls"] == {"optimize": 0, "translate": 0,
+                                 "fuse_compile": 0}, warm["calls"]
+        off = _spawn("off", path, cache_dir)
+        assert warm["result"] == cold["result"] == off["result"]
+        for f in glob.glob(str(cache_dir / "artifacts" / "*")):
+            flip_file_bits(f)
+        corrupt = _spawn("on", path, cache_dir)
+        assert corrupt["calls"]["optimize"] >= 1  # cold miss, no error
+        assert corrupt["result"] == cold["result"]
+        assert corrupt["persist"].get("load_failures", 0) >= 1
+
+    def test_keep_last_k_pruning_across_interpreters(self, tmp_path):
+        """Hygiene pin: two interpreters over one dir with
+        persist_keep_last=2 — at most 2 artifact files survive, the
+        evictions counter moves, and no .tmp residue is left."""
+        script = _CHILD.replace(
+            "dt.set_execution_config(cache_dir=cache_dir)",
+            "dt.set_execution_config(cache_dir=cache_dir, "
+            "persist_keep_last=2)")
+        cache_dir = tmp_path / "cache"
+        evictions = 0
+        for i in range(3):
+            path = tmp_path / f"t{i}.parquet"
+            _write_parquet(path, base=i * 1000)
+            snap = _spawn("on", path, cache_dir, script=script)
+            evictions += snap["persist"].get("evictions", 0)
+        names = os.listdir(str(cache_dir / "artifacts"))
+        arts = [n for n in names if not n.endswith(".tmp")]
+        assert 1 <= len(arts) <= 2, names
+        assert evictions >= 1
+        assert not [n for n in names if n.endswith(".tmp")]
+
+
+class TestObservability:
+    def test_health_section_and_gauges(self, pcfg, tmp_path):
+        from daft_tpu.obs.health import validate_health
+
+        p = tmp_path / "t.parquet"
+        _write_parquet(p)
+        _prefix_shape(p).collect()
+        persist.flush(pcfg)
+        snap = dt.health()
+        assert validate_health(snap) == []
+        per = snap["persist"]
+        assert per["inserts"] >= 1 and per["artifact_saves"] >= 1
+        assert all(isinstance(v, int) for v in per.values())
+        text = dt.metrics_text()
+        for g in ("daft_tpu_persist_hits_total",
+                  "daft_tpu_persist_inserts_total",
+                  "daft_tpu_persist_load_failures_total",
+                  "daft_tpu_persist_artifact_saves_total"):
+            assert g in text, g
+
+    def test_querylog_rollup_includes_persist(self, pcfg, tmp_path):
+        from daft_tpu.obs.querylog import _EVENT_COUNTERS
+
+        for name in ("persist_hits", "persist_load_failures",
+                     "persist_partitions_refreshed"):
+            assert name in _EVENT_COUNTERS
+
+    def test_snapshot_merges_both_stores(self, pcfg):
+        s = persist.snapshot()
+        for k in ("artifact_entries", "disk_entries", "hits", "misses",
+                  "load_failures", "store_failures", "evictions"):
+            assert isinstance(s[k], int)

@@ -71,7 +71,7 @@ _LOWER_SUFFIXES = ("_s", "_ms", "_ns", "_wall_s", "_ttfr_s", "_pct",
 _HIGHER_SUFFIXES = ("_per_sec", "_vs_baseline", "_speedup_x", "_gbps",
                     "_mbps", "_hits", "_qps", "value", "_rows_pruned",
                     "_reduction_x", "_hit_rate", "_fill_pct",
-                    "_handoffs_elided")
+                    "_handoffs_elided", "_warm_x")
 
 
 def classify(metric: str) -> Optional[str]:

@@ -18,7 +18,11 @@ Checks, in order:
     fresh plan AND fresh prefix — the new rows are served, never stale;
  5. WARM AGAIN: the rewritten shape warms back up on its next run;
  6. dt.health()["plan_cache"] validates and the daft_tpu_plan_cache_* /
-    daft_tpu_subplan_cache_* gauges appear in metrics_text().
+    daft_tpu_subplan_cache_* gauges appear in metrics_text();
+ 7. RESTART: two real interpreters share a cache_dir — the first plans
+    cold and flushes plan/FDO artifacts, the second serves the same
+    shape warm from disk (zero optimize() calls, byte-identical) and
+    exports the daft_tpu_persist_* gauges.
 
 Exits nonzero with a named failure on any violation.
 """
@@ -159,12 +163,85 @@ def main() -> int:
             if gauge not in text:
                 print(f"cache-smoke: FAIL — gauge {gauge} missing")
                 return 1
+        # 7: restart warm-start — two fresh interpreters over one
+        # cache_dir (daft_tpu/persist/): cold plans + flushes, warm
+        # serves with ZERO optimize() calls and identical bytes
+        rc = _restart_leg(d)
+        if rc:
+            return rc
     finally:
         optimizer_mod.optimize = real_optimize
         dt.shutdown(timeout_s=5)
 
     print("cache-smoke: OK — cold->warm->invalidate->warm cycle, "
-          "prefix replay, hit counters, byte-identity, gauges")
+          "prefix replay, hit counters, byte-identity, gauges, "
+          "restart warm-start")
+    return 0
+
+
+_RESTART_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+path, cache_dir = sys.argv[2], sys.argv[3]
+import daft_tpu as dt
+import daft_tpu.optimizer as optimizer_mod
+from daft_tpu import col
+dt.set_execution_config(cache_dir=cache_dir)
+calls = {"optimize": 0}
+real = optimizer_mod.optimize
+def counted(plan, *a, **k):
+    calls["optimize"] += 1
+    return real(plan, *a, **k)
+optimizer_mod.optimize = counted
+out = (dt.read_parquet(path).with_column("w", col("v") * 2.0)
+       .groupby("k").agg(col("w").sum().alias("s")).sort("k")).collect()
+got = out.to_pydict()
+text = dt.metrics_text()
+dt.shutdown(timeout_s=5)
+print(json.dumps({"optimize": calls["optimize"], "result": got,
+                  "persist_gauges": "daft_tpu_persist_hits_total" in text}))
+"""
+
+
+def _restart_leg(d: str) -> int:
+    import json
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(d, "restart.parquet")
+    cache_dir = os.path.join(d, "restart_cache")
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"k": [i % 3 for i in range(500)],
+                             "v": [float(i) for i in range(500)]}), path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    runs = []
+    for leg in ("cold", "warm"):
+        p = subprocess.run([sys.executable, "-c", _RESTART_CHILD,
+                            root, path, cache_dir],
+                           capture_output=True, text=True, timeout=240,
+                           env=env)
+        if p.returncode != 0:
+            print(f"cache-smoke: FAIL — restart {leg} interpreter died:\n"
+                  f"{p.stderr[-2000:]}")
+            return 1
+        runs.append(json.loads(p.stdout.strip().splitlines()[-1]))
+    cold, warm = runs
+    if cold["optimize"] < 1:
+        print("cache-smoke: FAIL — restart cold leg never planned")
+        return 1
+    if warm["optimize"] != 0:
+        print(f"cache-smoke: FAIL — restart warm leg re-planned "
+              f"({warm['optimize']} optimize() calls, wanted 0)")
+        return 1
+    if warm["result"] != cold["result"]:
+        print("cache-smoke: FAIL — restart warm result differs from cold")
+        return 1
+    if not warm["persist_gauges"]:
+        print("cache-smoke: FAIL — daft_tpu_persist_* gauges missing")
+        return 1
     return 0
 
 

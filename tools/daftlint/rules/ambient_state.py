@@ -87,6 +87,12 @@ REGISTRY_WHITELIST: Set[Tuple[str, str]] = {
     ("daft_tpu/adapt/plancache.py", "PLAN_CACHE"),
     ("daft_tpu/adapt/history.py", "HISTORY"),
     ("daft_tpu/adapt/resultcache.py", "RESULT_CACHE"),
+    # persistent cache store (daft_tpu/persist/): durable mirrors of the
+    # adapt/ caches plus the on-disk result tier — process-level by
+    # design (warm-start across restarts), bounded (keep-last-K artifact
+    # pruning / persist_result_bytes LRU), fail-open everywhere
+    ("daft_tpu/persist/artifacts.py", "ARTIFACTS"),
+    ("daft_tpu/persist/resultstore.py", "RESULT_STORE"),
     # FDO planning collector: a thread-local scope marker, not shared state
     ("daft_tpu/adapt/fdo.py", "_tl"),
     # live query-progress registry (obs/cluster.py): one entry per
